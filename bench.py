@@ -1,0 +1,141 @@
+"""Benchmark harness — north-star metric from BASELINE.md: in-database
+FFNN inference rows/sec/chip (the reference's flagship workload,
+``src/FF/source/SimpleFF.cc`` inference_unit, run through our full
+client→store→plan→jit path, not a bare matmul).
+
+Prints ONE JSON line:
+  {"metric": ..., "value": N, "unit": ..., "vs_baseline": N}
+
+Baseline: the reference publishes no FF numbers (BASELINE.json
+published={}), so we measure the reference-equivalent ourselves: the same
+blocked FF inference computed the way netsDB does it per worker thread —
+per-block f64 GEMMs on CPU (Eigen ≈ numpy BLAS here), measured on this
+host with --cpu-baseline and recorded below.
+"""
+
+import json
+import sys
+import time
+
+import numpy as np
+
+# FFTest-style workload: batch x features -> hidden -> labels
+BATCH = 16384
+FEATURES = 1024
+HIDDEN = 4096
+LABELS = 1024
+BLOCK = (512, 512)
+
+# Measured on this container with `python bench.py --cpu-baseline`
+# (numpy/OpenBLAS f64 blocked FF inference, the reference's per-node
+# compute model). Updated whenever the workload shape changes.
+CPU_BASELINE_ROWS_PER_SEC = None  # filled after first measurement; see below
+_CPU_BASELINE_FILE = "BASELINE_CPU.json"
+
+
+def _cpu_reference_rows_per_sec() -> float:
+    """netsDB-equivalent CPU path: f64 block GEMMs + bias/relu/softmax
+    over the same blocked layout (one pseudo-cluster worker's work)."""
+    rng = np.random.default_rng(0)
+    batch = 2048  # smaller sample, extrapolates linearly in batch
+    x = rng.standard_normal((batch, FEATURES))
+    w1 = rng.standard_normal((HIDDEN, FEATURES))
+    b1 = rng.standard_normal((HIDDEN, 1))
+    wo = rng.standard_normal((LABELS, HIDDEN))
+    bo = rng.standard_normal((LABELS, 1))
+
+    def block_mm(a, b, blk=BLOCK[0]):
+        m, k = a.shape
+        n = b.shape[1]
+        out = np.zeros((m, n))
+        for i0 in range(0, m, blk):
+            for j0 in range(0, n, blk):
+                acc = np.zeros((min(blk, m - i0), min(blk, n - j0)))
+                for k0 in range(0, k, blk):
+                    acc += a[i0:i0 + blk, k0:k0 + blk] @ b[k0:k0 + blk, j0:j0 + blk]
+                out[i0:i0 + blk, j0:j0 + blk] = acc
+        return out
+
+    t0 = time.perf_counter()
+    h = np.maximum(block_mm(w1, x.T) + b1, 0)
+    z = block_mm(wo, h) + bo
+    e = np.exp(z - z.max(0, keepdims=True))
+    _ = e / e.sum(0, keepdims=True)
+    dt = time.perf_counter() - t0
+    return batch / dt
+
+
+def main():
+    if "--cpu-baseline" in sys.argv:
+        rps = _cpu_reference_rows_per_sec()
+        with open(_CPU_BASELINE_FILE, "w") as f:
+            json.dump({"cpu_ff_rows_per_sec": rps}, f)
+        print(json.dumps({"metric": "cpu_ff_rows_per_sec", "value": rps}))
+        return
+
+    import jax
+
+    from netsdb_tpu.client import Client
+    from netsdb_tpu.config import Configuration
+    from netsdb_tpu.core.blocked import BlockedTensor
+    from netsdb_tpu.models.ff import FFModel
+
+    rng = np.random.default_rng(0)
+    config = Configuration(root_dir="/tmp/netsdb_bench",
+                           default_block_shape=BLOCK)
+    client = Client(config)
+    # bfloat16 compute on TPU MXU; f32 on CPU for a fair functional run
+    on_tpu = jax.default_backend() in ("tpu", "axon")
+    model = FFModel(db="bench", block=BLOCK,
+                    compute_dtype="bfloat16" if on_tpu else None)
+    model.setup(client)
+    model.load_random_weights(client, FEATURES, HIDDEN, LABELS, seed=1)
+    x = rng.standard_normal((BATCH, FEATURES)).astype(np.float32)
+    model.load_inputs(client, x)
+
+    params = model.params_from_store(client)
+    xb = BlockedTensor.from_dense(x, BLOCK)
+    fwd = jax.jit(model.forward)
+
+    import jax.numpy as jnp
+
+    # warmup (compile) — force a real sync via scalar pull:
+    # block_until_ready is not a reliable barrier over the axon tunnel.
+    out = fwd(params, xb)
+    float(jnp.sum(out.data))
+
+    # measure controller<->device round-trip to subtract it out
+    g = jax.jit(lambda v: v + 1)
+    float(g(jnp.float32(0)))
+    t0 = time.perf_counter()
+    for _ in range(5):
+        float(g(jnp.float32(0)))
+    rtt = (time.perf_counter() - t0) / 5
+
+    iters = 50
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        out = fwd(params, xb)
+    float(jnp.sum(out.data))  # sync
+    dt = max(time.perf_counter() - t0 - rtt, 1e-9) / iters
+    rows_per_sec = BATCH / dt
+
+    # baseline: measured reference-equivalent CPU number
+    try:
+        with open(_CPU_BASELINE_FILE) as f:
+            cpu_rps = json.load(f)["cpu_ff_rows_per_sec"]
+    except (OSError, KeyError):
+        cpu_rps = _cpu_reference_rows_per_sec()
+        with open(_CPU_BASELINE_FILE, "w") as f:
+            json.dump({"cpu_ff_rows_per_sec": cpu_rps}, f)
+
+    print(json.dumps({
+        "metric": "ff_inference_rows_per_sec_per_chip",
+        "value": round(rows_per_sec, 1),
+        "unit": "rows/s",
+        "vs_baseline": round(rows_per_sec / cpu_rps, 2),
+    }))
+
+
+if __name__ == "__main__":
+    main()

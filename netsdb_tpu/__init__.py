@@ -1,0 +1,38 @@
+"""netsdb_tpu — a TPU-native in-database model-inference framework.
+
+A ground-up JAX/XLA/pallas re-design of the capabilities of netsDB
+(reference: /root/reference, a UDF-centric distributed analytics database
+derived from PlinyCompute). netsDB expresses ML inference as relational
+algebra over sets of blocked matrices executed by a hand-written C++
+master/worker runtime; here the same capabilities are expressed TPU-first:
+
+- sets of ``FFMatrixBlock`` objects (reference ``src/FF/headers/FFMatrixBlock.h``)
+  become :class:`~netsdb_tpu.core.blocked.BlockedTensor` — one logical padded
+  ``jax.Array`` whose block grid is the sharding granularity on a device mesh;
+- the Lambda/Computation UDF DAG + TCAP IR (reference ``src/lambdas``,
+  ``src/logicalPlan``) becomes a small logical plan IR lowered to jit stages;
+- the master/worker socket shuffle (reference ``src/communication``,
+  ``src/queryExecution/source/PipelineStage.cc``) becomes XLA collectives
+  over ICI/DCN via ``jax.sharding`` + ``shard_map``;
+- the Pangea storage engine (reference ``src/storage``) becomes a host-side
+  set store with a C++ page-cache runtime streaming blocks into HBM.
+"""
+
+from netsdb_tpu.config import Configuration
+from netsdb_tpu.core.blocked import BlockedTensor, BlockMeta
+from netsdb_tpu.catalog.catalog import Catalog
+from netsdb_tpu.storage.store import SetStore, SetIdentifier
+from netsdb_tpu.client import Client
+
+__version__ = "0.1.0"
+
+__all__ = [
+    "Configuration",
+    "BlockedTensor",
+    "BlockMeta",
+    "Catalog",
+    "SetStore",
+    "SetIdentifier",
+    "Client",
+    "__version__",
+]

@@ -1,0 +1,3 @@
+from netsdb_tpu.catalog.catalog import Catalog
+
+__all__ = ["Catalog"]

@@ -1,0 +1,227 @@
+"""Metadata catalog — databases, sets, registered types, nodes.
+
+TPU-native analogue of ``PDBCatalog`` over sqlite_orm (reference
+``src/catalog/headers/PDBCatalog.h:45-50``, ``PDBCatalogStorage.h:8-26``),
+which tracks PDBCatalogDatabase/Set/Node/Type rows and replicates
+registered user-type .so binaries master→workers. Here:
+
+- databases and sets persist in sqlite exactly as in the reference;
+- "types" are registered Python op/model entry points (dotted import
+  paths) instead of .so binaries — JAX needs no dynamic native loading;
+- "nodes" describe the device mesh topology instead of worker hosts; the
+  data plane is XLA collectives, so node rows are informational + used by
+  the placement advisor.
+
+Sets additionally carry tensor metadata (dtype/shape/block shape/sharding
+spec/host path), which the reference keeps inside Pangea rather than the
+catalog — folding it in here gives one source of truth.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sqlite3
+import threading
+import time
+from typing import Dict, List, Optional
+
+
+_SCHEMA = """
+CREATE TABLE IF NOT EXISTS databases (
+    name TEXT PRIMARY KEY,
+    created_at REAL NOT NULL
+);
+CREATE TABLE IF NOT EXISTS sets (
+    db_name TEXT NOT NULL,
+    set_name TEXT NOT NULL,
+    type_name TEXT NOT NULL DEFAULT 'tensor',
+    meta_json TEXT NOT NULL DEFAULT '{}',
+    persistence TEXT NOT NULL DEFAULT 'transient',
+    host_path TEXT,
+    created_at REAL NOT NULL,
+    PRIMARY KEY (db_name, set_name)
+);
+CREATE TABLE IF NOT EXISTS types (
+    type_name TEXT PRIMARY KEY,
+    entry_point TEXT NOT NULL,
+    registered_at REAL NOT NULL
+);
+CREATE TABLE IF NOT EXISTS nodes (
+    node_id INTEGER PRIMARY KEY,
+    address TEXT NOT NULL,
+    num_devices INTEGER NOT NULL,
+    device_kind TEXT NOT NULL
+);
+"""
+
+
+class Catalog:
+    """Sqlite-backed metadata store. Thread-safe via a single lock
+    (the reference serializes catalog access the same way through its
+    server handler queue)."""
+
+    def __init__(self, path: str = ":memory:"):
+        if path != ":memory:":
+            os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
+        self._conn = sqlite3.connect(path, check_same_thread=False)
+        self._lock = threading.Lock()
+        with self._lock:
+            self._conn.executescript(_SCHEMA)
+            self._conn.commit()
+
+    # --- databases (ref: PDBCatalog::registerDatabase) ----------------
+    def create_database(self, name: str) -> None:
+        with self._lock:
+            self._conn.execute(
+                "INSERT OR IGNORE INTO databases VALUES (?, ?)", (name, time.time())
+            )
+            self._conn.commit()
+
+    def database_exists(self, name: str) -> bool:
+        with self._lock:
+            cur = self._conn.execute(
+                "SELECT 1 FROM databases WHERE name = ?", (name,)
+            )
+            return cur.fetchone() is not None
+
+    def list_databases(self) -> List[str]:
+        with self._lock:
+            cur = self._conn.execute("SELECT name FROM databases ORDER BY name")
+            return [r[0] for r in cur.fetchall()]
+
+    def drop_database(self, name: str) -> None:
+        with self._lock:
+            self._conn.execute("DELETE FROM sets WHERE db_name = ?", (name,))
+            self._conn.execute("DELETE FROM databases WHERE name = ?", (name,))
+            self._conn.commit()
+
+    # --- sets (ref: PDBCatalog::registerSet) --------------------------
+    def create_set(
+        self,
+        db_name: str,
+        set_name: str,
+        type_name: str = "tensor",
+        meta: Optional[Dict] = None,
+        persistence: str = "transient",
+        host_path: Optional[str] = None,
+    ) -> None:
+        with self._lock:
+            self._conn.execute(
+                "INSERT OR REPLACE INTO sets VALUES (?, ?, ?, ?, ?, ?, ?)",
+                (
+                    db_name,
+                    set_name,
+                    type_name,
+                    json.dumps(meta or {}),
+                    persistence,
+                    host_path,
+                    time.time(),
+                ),
+            )
+            self._conn.commit()
+
+    def set_exists(self, db_name: str, set_name: str) -> bool:
+        with self._lock:
+            cur = self._conn.execute(
+                "SELECT 1 FROM sets WHERE db_name = ? AND set_name = ?",
+                (db_name, set_name),
+            )
+            return cur.fetchone() is not None
+
+    def get_set(self, db_name: str, set_name: str) -> Optional[Dict]:
+        with self._lock:
+            cur = self._conn.execute(
+                "SELECT type_name, meta_json, persistence, host_path FROM sets "
+                "WHERE db_name = ? AND set_name = ?",
+                (db_name, set_name),
+            )
+            row = cur.fetchone()
+        if row is None:
+            return None
+        return {
+            "db": db_name,
+            "set": set_name,
+            "type": row[0],
+            "meta": json.loads(row[1]),
+            "persistence": row[2],
+            "host_path": row[3],
+        }
+
+    def update_set_meta(self, db_name: str, set_name: str, meta: Dict) -> None:
+        with self._lock:
+            self._conn.execute(
+                "UPDATE sets SET meta_json = ? WHERE db_name = ? AND set_name = ?",
+                (json.dumps(meta), db_name, set_name),
+            )
+            self._conn.commit()
+
+    def list_sets(self, db_name: Optional[str] = None) -> List[Dict]:
+        with self._lock:
+            if db_name is None:
+                cur = self._conn.execute("SELECT db_name, set_name FROM sets")
+            else:
+                cur = self._conn.execute(
+                    "SELECT db_name, set_name FROM sets WHERE db_name = ?", (db_name,)
+                )
+            return [{"db": r[0], "set": r[1]} for r in cur.fetchall()]
+
+    def remove_set(self, db_name: str, set_name: str) -> None:
+        with self._lock:
+            self._conn.execute(
+                "DELETE FROM sets WHERE db_name = ? AND set_name = ?",
+                (db_name, set_name),
+            )
+            self._conn.commit()
+
+    # --- types (ref: PDBCatalog registered user types / .so files) ----
+    def register_type(self, type_name: str, entry_point: str) -> None:
+        with self._lock:
+            self._conn.execute(
+                "INSERT OR REPLACE INTO types VALUES (?, ?, ?)",
+                (type_name, entry_point, time.time()),
+            )
+            self._conn.commit()
+
+    def get_type(self, type_name: str) -> Optional[str]:
+        with self._lock:
+            cur = self._conn.execute(
+                "SELECT entry_point FROM types WHERE type_name = ?", (type_name,)
+            )
+            row = cur.fetchone()
+        return row[0] if row else None
+
+    def list_types(self) -> List[Dict]:
+        with self._lock:
+            cur = self._conn.execute("SELECT type_name, entry_point FROM types")
+            return [{"type": r[0], "entry_point": r[1]} for r in cur.fetchall()]
+
+    # --- nodes (ref: PDBCatalogNode / conf/serverlist) ----------------
+    def register_node(
+        self, node_id: int, address: str, num_devices: int, device_kind: str
+    ) -> None:
+        with self._lock:
+            self._conn.execute(
+                "INSERT OR REPLACE INTO nodes VALUES (?, ?, ?, ?)",
+                (node_id, address, num_devices, device_kind),
+            )
+            self._conn.commit()
+
+    def list_nodes(self) -> List[Dict]:
+        with self._lock:
+            cur = self._conn.execute(
+                "SELECT node_id, address, num_devices, device_kind FROM nodes"
+            )
+            return [
+                {
+                    "node_id": r[0],
+                    "address": r[1],
+                    "num_devices": r[2],
+                    "device_kind": r[3],
+                }
+                for r in cur.fetchall()
+            ]
+
+    def close(self) -> None:
+        with self._lock:
+            self._conn.close()

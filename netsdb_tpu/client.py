@@ -1,0 +1,169 @@
+"""Client facade — the ``PDBClient`` equivalent.
+
+The reference's ``PDBClient`` aggregates CatalogClient, DispatcherClient,
+DistributedStorageManagerClient and QueryClient behind one object
+(``src/mainClient/headers/PDBClient.h:28-295``): createDatabase/createSet/
+sendData/registerType/executeComputations/getSetIterator. In
+single-controller JAX there is no client⇄master RPC hop — the "client" IS
+the controller — so this facade talks directly to the catalog, the set
+store, and the query executor. The API surface is kept deliberately close
+so every reference test driver has a line-for-line analogue.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, Iterator, List, Optional, Sequence, Tuple, Union
+
+import numpy as np
+
+from netsdb_tpu.catalog.catalog import Catalog
+from netsdb_tpu.config import Configuration, DEFAULT_CONFIG
+from netsdb_tpu.core.blocked import BlockedTensor
+from netsdb_tpu.storage.store import SetIdentifier, SetStore
+
+
+def _ident(db: str, set_name: str) -> SetIdentifier:
+    return SetIdentifier(db, set_name)
+
+
+class Client:
+    """Facade over catalog + storage + execution.
+
+    Mirrors ``PDBClient`` (reference ``src/mainClient/headers/PDBClient.h``):
+
+    ===========================  =======================================
+    reference                    here
+    ===========================  =======================================
+    createDatabase               :meth:`create_database`
+    createSet<T>(db,set,...)     :meth:`create_set`
+    sendData<T>(pair, vector)    :meth:`send_data` / :meth:`send_matrix`
+    flushData                    :meth:`flush_data`
+    registerType(.so)            :meth:`register_type` (Python entry point)
+    executeComputations          :meth:`execute_computations`
+    getSetIterator<T>            :meth:`get_set_iterator`
+    removeSet / clearSet         :meth:`remove_set` / :meth:`clear_set`
+    addSharedMapping (dedup)     :meth:`add_shared_mapping`
+    ===========================  =======================================
+    """
+
+    def __init__(self, config: Configuration = DEFAULT_CONFIG,
+                 catalog_path: Optional[str] = None):
+        self.config = config
+        config.ensure_dirs()
+        self.catalog = Catalog(catalog_path or ":memory:")
+        self.store = SetStore(config)
+        self._mesh = None  # set by parallel helpers when distributed
+
+    # --- DDL ----------------------------------------------------------
+    def create_database(self, db: str) -> None:
+        self.catalog.create_database(db)
+
+    def create_set(
+        self,
+        db: str,
+        set_name: str,
+        type_name: str = "tensor",
+        persistence: str = "transient",
+        eviction: str = "lru",
+        partition_lambda: Optional[str] = None,
+    ) -> SetIdentifier:
+        """``partition_lambda`` mirrors createSet-with-dispatch-computation
+        (reference ``PDBClient.h:79-103``): a named key function the
+        dispatcher/placement layer may use to route data."""
+        if not self.catalog.database_exists(db):
+            raise KeyError(f"database {db!r} does not exist; create_database first")
+        meta: Dict[str, Any] = {}
+        if partition_lambda:
+            meta["partition_lambda"] = partition_lambda
+        self.catalog.create_set(db, set_name, type_name, meta, persistence)
+        ident = _ident(db, set_name)
+        self.store.create_set(ident, persistence=persistence, eviction=eviction)
+        return ident
+
+    def remove_set(self, db: str, set_name: str) -> None:
+        self.catalog.remove_set(db, set_name)
+        self.store.remove_set(_ident(db, set_name))
+
+    def clear_set(self, db: str, set_name: str) -> None:
+        self.store.clear_set(_ident(db, set_name))
+
+    def set_exists(self, db: str, set_name: str) -> bool:
+        return self.catalog.set_exists(db, set_name)
+
+    # --- types --------------------------------------------------------
+    def register_type(self, type_name: str, entry_point: str) -> None:
+        """Register an op/model implementation by dotted import path —
+        replaces shipping UDF .so files (ref registerType / VTableMap
+        dynamic loading, ``src/objectModel/headers/VTableMap.h:36-80``)."""
+        self.catalog.register_type(type_name, entry_point)
+
+    # --- data path ----------------------------------------------------
+    def send_data(self, db: str, set_name: str, items: Sequence[Any]) -> None:
+        self.store.add_data(_ident(db, set_name), list(items))
+
+    def send_matrix(
+        self,
+        db: str,
+        set_name: str,
+        dense: Union[np.ndarray, "Any"],
+        block_shape: Optional[Tuple[int, int]] = None,
+        dtype=None,
+    ) -> BlockedTensor:
+        """Load a dense matrix as one blocked tensor into a set — the
+        analogue of ``FFMatrixUtil::load_matrix`` generating a
+        ``Vector<Handle<FFMatrixBlock>>`` and sendData'ing it."""
+        block_shape = block_shape or self.config.default_block_shape
+        t = BlockedTensor.from_dense(dense, block_shape, dtype=dtype)
+        ident = _ident(db, set_name)
+        self.store.put_tensor(ident, t)
+        cat = self.catalog.get_set(db, set_name)
+        if cat is not None:
+            cat["meta"].update(
+                shape=list(t.shape), block_shape=list(t.meta.block_shape),
+                dtype=str(t.dtype),
+            )
+            self.catalog.update_set_meta(db, set_name, cat["meta"])
+        return t
+
+    def get_tensor(self, db: str, set_name: str) -> BlockedTensor:
+        return self.store.get_tensor(_ident(db, set_name))
+
+    def get_set_iterator(self, db: str, set_name: str) -> Iterator[Any]:
+        return self.store.scan(_ident(db, set_name))
+
+    def flush_data(self) -> None:
+        """Durably flush all persistent sets (ref flushData →
+        StorageCleanup broadcast, ``PDBClient.h:141``)."""
+        for ident in self.store.list_sets():
+            info = self.catalog.get_set(ident.db, ident.set)
+            if info and info.get("persistence") == "persistent":
+                self.store.flush(ident)
+
+    # --- dedup (ref PDBClient::addSharedPage/addSharedMapping) --------
+    def add_shared_mapping(
+        self, private_db: str, private_set: str, shared_db: str, shared_set: str,
+        mapping: Optional[Dict] = None,
+    ) -> None:
+        self.store.add_shared_mapping(
+            _ident(private_db, private_set), _ident(shared_db, shared_set), mapping
+        )
+
+    # --- query execution ----------------------------------------------
+    def execute_computations(self, *sinks, job_name: str = "job",
+                             materialize: bool = True):
+        """Plan + run a Computation DAG — ``QueryClient::executeComputations``
+        (reference ``src/queries/headers/QueryClient.h:160-224``) without the
+        client→master RPC hop. ``sinks`` are Write computations from
+        :mod:`netsdb_tpu.plan.computations`."""
+        from netsdb_tpu.plan.executor import execute_computations
+
+        return execute_computations(self, list(sinks), job_name=job_name,
+                                    materialize=materialize)
+
+    # --- stats --------------------------------------------------------
+    def collect_stats(self) -> Dict[str, Any]:
+        """Per-set storage stats (ref StorageCollectStats → ``Statistics``
+        used by the cost-based planner)."""
+        return {
+            str(i): self.store.set_stats(i) for i in self.store.list_sets()
+        }

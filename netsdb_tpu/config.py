@@ -1,0 +1,64 @@
+"""Framework configuration.
+
+TPU-native analogue of the reference ``Configuration`` object
+(``src/conf/headers/Configuration.h:22-71``): where netsDB sizes 64 MB
+shared-memory pages, shuffle page sizes and thread counts, we size tensor
+blocks (the sharding granularity), host page-store pages, and the device
+mesh. Unlike the reference's argv-populated singleton, this is a plain
+dataclass passed explicitly.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import os
+from typing import Optional, Tuple
+
+
+@dataclasses.dataclass
+class Configuration:
+    """Global knobs; defaults chosen for TPU v5e.
+
+    ``default_block_shape`` plays the role of netsDB's matrix block dims
+    (reference tests default to 100x100 or 1000x1000 blocks,
+    ``src/tests/source/FFTest.cc``); 512 is MXU/tiling friendly
+    (multiple of 128 lanes / 8 sublanes).
+
+    ``page_size_bytes`` mirrors ``Configuration::getPageSize`` (64 MB
+    default) for the host-side page store.
+    """
+
+    # --- tensor blocking ---
+    default_block_shape: Tuple[int, int] = (512, 512)
+    # --- dtypes: MXU prefers bfloat16 inputs, f32 accumulation ---
+    compute_dtype: str = "bfloat16"
+    accum_dtype: str = "float32"
+    storage_dtype: str = "float32"
+    # --- host page store (native runtime) ---
+    page_size_bytes: int = 64 * 1024 * 1024
+    shared_mem_bytes: int = 4 * 1024 * 1024 * 1024
+    # --- directories (reference: Configuration rootDir/catalog dirs) ---
+    root_dir: str = dataclasses.field(
+        default_factory=lambda: os.environ.get("NETSDB_TPU_HOME", "/tmp/netsdb_tpu")
+    )
+    # --- mesh defaults (data x model), overridden by parallel.mesh helpers ---
+    mesh_shape: Optional[Tuple[int, ...]] = None
+    mesh_axis_names: Tuple[str, ...] = ("data", "model")
+    # --- execution ---
+    num_threads: int = 4  # host-side IO/pipeline threads (not device parallelism)
+    enable_compression: bool = True  # host spill compression (ref -DENABLE_COMPRESSION)
+    log_level: str = "WARNING"
+
+    @property
+    def catalog_path(self) -> str:
+        return os.path.join(self.root_dir, "catalog.sqlite")
+
+    @property
+    def data_dir(self) -> str:
+        return os.path.join(self.root_dir, "data")
+
+    def ensure_dirs(self) -> None:
+        os.makedirs(self.data_dir, exist_ok=True)
+
+
+DEFAULT_CONFIG = Configuration()

@@ -1,0 +1,3 @@
+from netsdb_tpu.core.blocked import BlockedTensor, BlockMeta
+
+__all__ = ["BlockedTensor", "BlockMeta"]

@@ -1,0 +1,7 @@
+"""Model workloads — the reference's in-database ML applications
+(reference layer 16: ``src/FF``, ``src/LogReg``, ``src/word2vec``,
+``src/conv2d_proj``, ``src/conv2d_memory_fusion``, ``src/LSTM``)."""
+
+from netsdb_tpu.models.ff import FFModel
+
+__all__ = ["FFModel"]

@@ -1,0 +1,163 @@
+"""Feed-forward NN inference in the database — the flagship workload.
+
+Mirrors the reference FF application end to end
+(``src/FF/source/SimpleFF.cc``, driver ``src/tests/source/FFTest.cc``):
+
+- ``setup``/``create_sets`` ≙ ``ff::setup`` registering the 12 UDF .so
+  libs + ``ff::createSet`` of {inputs, w1, b1, wo, bo, y1, yo, output}
+  (``SimpleFF.cc:60-82``);
+- ``load_random_weights`` ≙ ``ff::loadMatrix`` (random blocked matrices);
+- ``inference`` ≙ ``ff::inference_unit`` (``SimpleFF.cc:331-424``):
+  stage A  y1 = relu(w1·inputsᵀ + b1); yo = wo·y1 + bo
+  stage B  output = softmax over labels (exp → row-sum → normalize);
+- the DAG built here is scan→join→agg→map→write Computations, so the
+  plan dump shows the same relational shape as the reference's TCAP.
+
+Layout convention follows the reference: inputs are (batch x features),
+weights (out x in), activations flow as (features x batch).
+
+``train_step`` has no reference analogue as a fused op (netsDB trains
+offline in TF/PyTorch and imports weights) but is required for the
+multi-chip dry-run and completes the framework: cross-entropy + SGD via
+``jax.grad`` over the same blocked tensors.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Dict, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from netsdb_tpu.client import Client
+from netsdb_tpu.core.blocked import BlockedTensor
+from netsdb_tpu.ops import nn as nn_ops
+from netsdb_tpu.ops.matmul import matmul, matmul_t
+from netsdb_tpu.plan.computations import Apply, Join, ScanSet, WriteSet
+
+
+@jax.tree_util.register_dataclass
+@dataclasses.dataclass
+class FFParams:
+    w1: BlockedTensor  # (hidden x features)
+    b1: BlockedTensor  # (hidden x 1)
+    wo: BlockedTensor  # (labels x hidden)
+    bo: BlockedTensor  # (labels x 1)
+
+
+class FFModel:
+    """One-hidden-layer FF classifier stored as database sets."""
+
+    SETS = ("inputs", "w1", "b1", "wo", "bo", "y1", "yo", "output")
+
+    def __init__(self, db: str = "ff", block: Tuple[int, int] = (512, 512),
+                 compute_dtype: Optional[str] = None):
+        self.db = db
+        self.block = block
+        self.compute_dtype = compute_dtype
+
+    # --- setup (ref ff::setup + createSet, SimpleFF.cc:60-82) ---------
+    def setup(self, client: Client) -> None:
+        client.create_database(self.db)
+        for s in self.SETS:
+            client.create_set(self.db, s)
+        client.register_type("FFMatrixBlock", "netsdb_tpu.core.blocked:BlockedTensor")
+
+    def load_weights(self, client: Client, w1, b1, wo, bo) -> None:
+        br = self.block[0]
+        client.send_matrix(self.db, "w1", w1, self.block)
+        client.send_matrix(self.db, "b1", np.asarray(b1).reshape(-1, 1), (br, 1))
+        client.send_matrix(self.db, "wo", wo, self.block)
+        client.send_matrix(self.db, "bo", np.asarray(bo).reshape(-1, 1), (br, 1))
+
+    def load_random_weights(self, client: Client, features: int, hidden: int,
+                            labels: int, seed: int = 0) -> None:
+        """ref ff::loadMatrix with random data (FFTest.cc:100-117)."""
+        rng = np.random.default_rng(seed)
+        scale1 = np.sqrt(2.0 / features)
+        scale2 = np.sqrt(2.0 / hidden)
+        self.load_weights(
+            client,
+            rng.standard_normal((hidden, features), dtype=np.float32) * scale1,
+            rng.standard_normal((hidden,), dtype=np.float32) * 0.01,
+            rng.standard_normal((labels, hidden), dtype=np.float32) * scale2,
+            rng.standard_normal((labels,), dtype=np.float32) * 0.01,
+        )
+
+    def load_inputs(self, client: Client, inputs: np.ndarray) -> None:
+        client.send_matrix(self.db, "inputs", inputs, self.block)
+
+    # --- inference (ref ff::inference_unit, SimpleFF.cc:331-424) ------
+    def build_inference_dag(self, dropout_rate: float = 0.0,
+                            key: Optional[jax.Array] = None) -> WriteSet:
+        """Computation DAG with the reference's relational shape."""
+        cd = self.compute_dtype
+        inputs = ScanSet(self.db, "inputs")
+        w1 = ScanSet(self.db, "w1")
+        b1 = ScanSet(self.db, "b1")
+        wo = ScanSet(self.db, "wo")
+        bo = ScanSet(self.db, "bo")
+        # FFTransposeMult + FFAggMatrix: w1 · inputsᵀ → (hidden x batch)
+        h = Join(w1, inputs, fn=lambda w, x: matmul_t(w, x, cd),
+                 label="FFTransposeMult")
+        # FFReluBiasSum
+        y1 = Join(h, b1,
+                  fn=lambda hh, bb: nn_ops.bias_relu(hh, bb, dropout_rate, key),
+                  label="FFReluBiasSum")
+        # FFInputLayerJoin + FFAggMatrix: wo · y1 → (labels x batch)
+        yo_lin = Join(wo, y1, fn=lambda w, y: matmul(w, y, cd),
+                      label="FFInputLayerJoin")
+        # FFTransposeBiasSum → FFRowAggregate → FFOutputLayer, fused
+        out = Join(yo_lin, bo,
+                   fn=lambda y, b: nn_ops.ff_output_layer(y, b, axis=0),
+                   label="FFOutputLayer")
+        return WriteSet(out, self.db, "output")
+
+    def inference(self, client: Client, dropout_rate: float = 0.0,
+                  key: Optional[jax.Array] = None) -> BlockedTensor:
+        sink = self.build_inference_dag(dropout_rate, key)
+        results = client.execute_computations(sink, job_name=f"{self.db}-inference")
+        return next(iter(results.values()))
+
+    # --- pure-function forms (for jit/bench/sharding) -----------------
+    def params_from_store(self, client: Client) -> FFParams:
+        return FFParams(
+            w1=client.get_tensor(self.db, "w1"),
+            b1=client.get_tensor(self.db, "b1"),
+            wo=client.get_tensor(self.db, "wo"),
+            bo=client.get_tensor(self.db, "bo"),
+        )
+
+    def forward(self, params: FFParams, inputs: BlockedTensor) -> BlockedTensor:
+        """(batch x features) → softmax probs (labels x batch). Same math
+        as the DAG, one traced function."""
+        cd = self.compute_dtype
+        h = nn_ops.bias_relu(matmul_t(params.w1, inputs, cd), params.b1)
+        yo = matmul(params.wo, h, cd)
+        return nn_ops.ff_output_layer(yo, params.bo, axis=0)
+
+    def logits(self, params: FFParams, inputs: BlockedTensor) -> BlockedTensor:
+        cd = self.compute_dtype
+        h = nn_ops.bias_relu(matmul_t(params.w1, inputs, cd), params.b1)
+        return matmul(params.wo, h, cd)
+
+    # --- training (TPU-first extension; powers dryrun_multichip) ------
+    def loss(self, params: FFParams, inputs: BlockedTensor,
+             labels_onehot: BlockedTensor) -> jax.Array:
+        """Masked softmax cross-entropy. ``labels_onehot``: (labels x batch)
+        blocked like the output."""
+        lg = self.logits(params, inputs)
+        logits_masked = jnp.where(lg.mask(jnp.bool_), lg.data, -jnp.inf)
+        logp = jax.nn.log_softmax(logits_masked, axis=0)
+        logp = jnp.nan_to_num(logp, nan=0.0, neginf=0.0)
+        batch = inputs.shape[0]
+        return -jnp.sum(labels_onehot.data * logp) / batch
+
+    def train_step(self, params: FFParams, inputs: BlockedTensor,
+                   labels_onehot: BlockedTensor,
+                   lr: float = 0.1) -> Tuple[FFParams, jax.Array]:
+        loss, grads = jax.value_and_grad(self.loss)(params, inputs, labels_onehot)
+        new = jax.tree_util.tree_map(lambda p, g: p - lr * g, params, grads)
+        return new, loss

@@ -1,0 +1,20 @@
+"""Op layer — one traced TPU-native function per reference UDF family.
+
+Reference → here:
+
+- ``FFTransposeMult``/``FFInputLayerJoin`` + ``FFAggMatrix`` → :mod:`.matmul`
+- ``FFReluBiasSum``/``FFTransposeBiasSum*``/``FFRowAggregate``/``FFOutputLayer``
+  → :mod:`.nn`
+- the 25 ``LASilly*`` DSL ops → :mod:`.linalg`
+- ``Conv2DSelect`` (ATen) and ``conv2d_memory_fusion`` (im2col) → :mod:`.conv`
+- ``LSTMThreeWaySum``/``LSTMHiddenState`` → :mod:`.lstm`
+- ``Word2Vec``/``EmbeddingLookupSparse`` → :mod:`.embedding`
+"""
+
+from netsdb_tpu.ops import conv, embedding, linalg, lstm, nn
+from netsdb_tpu.ops.matmul import gram, matmul, matmul_t, t_matmul
+
+__all__ = [
+    "conv", "embedding", "linalg", "lstm", "nn",
+    "gram", "matmul", "matmul_t", "t_matmul",
+]

@@ -1,0 +1,134 @@
+"""Conv2D — both reference execution modes, TPU-native.
+
+Mode 1, "UDF-encapsulated": the reference wraps a whole conv in one
+``Conv2DSelect`` SelectionComp that calls ATen ``at::conv2d`` (or a
+hand-rolled Eigen spatial loop) per ``TensorData`` object
+(``src/conv2d_proj/headers/Conv2DSelect.h:13-216``). TPU equivalent:
+``lax.conv_general_dilated``, which XLA lowers straight onto the MXU.
+
+Mode 2, "memory fusion" / relational rewrite: conv as matmul via im2col —
+MultiSelections ``ImageToChunks``/``ImageBlockToMatrix`` flatten image
+patches to a matrix, ``KernelToChunks`` flattens filters, then the
+standard FFTransposeMult+FFAggMatrix blocked matmul, then
+``ConvChunksToImage`` reassembles (``src/conv2d_memory_fusion``; driver
+``src/tests/source/PipelinedConv2dMemFuseTest.cc:137-299``). TPU
+equivalent below: an explicit patch-extraction + one ``dot_general`` —
+kept because it exercises the blocked-matmul path and is the shape the
+framework's relational planner produces.
+
+Layouts: images NCHW, kernels OIHW (reference conv2d README defaults:
+112x112x3 images, 64 7x7x3 filters).
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Tuple, Union
+
+import jax
+import jax.numpy as jnp
+
+from netsdb_tpu.core.blocked import BlockedTensor
+from netsdb_tpu.ops.common import mxu_dot
+from netsdb_tpu.ops.matmul import matmul_t
+
+Padding = Union[str, Tuple[int, int]]
+
+
+def _pad_pair(padding: Padding, k: int, in_size: int, stride: int) -> Tuple[int, int]:
+    if padding == "SAME":
+        # stride-aware SAME: output ceil(in/s) positions
+        total = max((-(-in_size // stride) - 1) * stride + k - in_size, 0)
+        return (total // 2, total - total // 2)
+    if padding == "VALID":
+        return (0, 0)
+    return tuple(padding)
+
+
+def conv2d_direct(
+    images: jax.Array,  # (N, C, H, W)
+    kernels: jax.Array,  # (O, I, KH, KW)
+    bias: Optional[jax.Array] = None,  # (O,)
+    stride: Tuple[int, int] = (1, 1),
+    padding: Padding = "VALID",
+    activation: Optional[str] = None,
+    compute_dtype: Optional[str] = None,
+) -> jax.Array:
+    """Reference mode 1 (``Conv2DSelect::computeConvOpATen``), one XLA conv."""
+    if compute_dtype is not None:
+        images = images.astype(compute_dtype)
+        kernels = kernels.astype(compute_dtype)
+        precision = jax.lax.Precision.DEFAULT
+    else:
+        precision = jax.lax.Precision.HIGHEST  # see ops.common.mxu_dot
+    pads = (
+        _pad_pair(padding, kernels.shape[2], images.shape[2], stride[0]),
+        _pad_pair(padding, kernels.shape[3], images.shape[3], stride[1]),
+    )
+    out = jax.lax.conv_general_dilated(
+        images, kernels, window_strides=stride, padding=pads,
+        dimension_numbers=("NCHW", "OIHW", "NCHW"),
+        preferred_element_type=jnp.float32,
+        precision=precision,
+    )
+    if bias is not None:
+        out = out + bias.reshape(1, -1, 1, 1)
+    if activation == "relu":
+        out = jax.nn.relu(out)
+    elif activation == "sigmoid":
+        out = jax.nn.sigmoid(out)
+    return out
+
+
+def im2col(
+    images: jax.Array,  # (N, C, H, W)
+    kh: int, kw: int,
+    stride: Tuple[int, int] = (1, 1),
+    padding: Padding = "VALID",
+) -> Tuple[jax.Array, Tuple[int, int]]:
+    """Patch matrix (N*OH*OW, C*KH*KW) — the ``ImageToChunks`` →
+    ``ImageBlockToMatrix`` rewrite (``src/conv2d_memory_fusion/headers/
+    ImageBlockToMatrix.h``). Returns (matrix, (OH, OW))."""
+    n, c, h, w = images.shape
+    ph = _pad_pair(padding, kh, h, stride[0])
+    pw = _pad_pair(padding, kw, w, stride[1])
+    x = jnp.pad(images, ((0, 0), (0, 0), ph, pw))
+    oh = (x.shape[2] - kh) // stride[0] + 1
+    ow = (x.shape[3] - kw) // stride[1] + 1
+    # extract patches via conv_general_dilated_patches (XLA-native im2col)
+    patches = jax.lax.conv_general_dilated_patches(
+        x, (kh, kw), stride, padding="VALID",
+        dimension_numbers=("NCHW", "OIHW", "NCHW"),
+    )  # (N, C*KH*KW, OH, OW)
+    mat = patches.transpose(0, 2, 3, 1).reshape(n * oh * ow, c * kh * kw)
+    return mat, (oh, ow)
+
+
+def conv2d_im2col(
+    images: jax.Array,  # (N, C, H, W)
+    kernels: jax.Array,  # (O, I, KH, KW)
+    bias: Optional[jax.Array] = None,
+    stride: Tuple[int, int] = (1, 1),
+    padding: Padding = "VALID",
+    activation: Optional[str] = None,
+    block_shape: Tuple[int, int] = (256, 256),
+    compute_dtype: Optional[str] = None,
+) -> jax.Array:
+    """Reference mode 2: im2col + blocked matmul + fold
+    (``PipelinedConv2dMemFuseTest.cc:137-299`` pipeline as one function:
+    ImageToChunks→ImageBlockToMatrix→KernelBiasJoin→FFTransposeMult→
+    FFAggMatrix→ConvChunksToImage)."""
+    n = images.shape[0]
+    o, i, kh, kw = kernels.shape
+    mat, (oh, ow) = im2col(images, kh, kw, stride, padding)
+    kmat = kernels.reshape(o, i * kh * kw)
+    a = BlockedTensor.from_dense(mat, block_shape, dtype=compute_dtype)
+    b = BlockedTensor.from_dense(kmat, (min(block_shape[0], o), block_shape[1]),
+                                 dtype=compute_dtype)
+    out = matmul_t(a, b, compute_dtype).to_dense()  # (N*OH*OW, O)
+    if bias is not None:
+        out = out + bias[None, :]
+    if activation == "relu":
+        out = jax.nn.relu(out)
+    elif activation == "sigmoid":
+        out = jax.nn.sigmoid(out)
+    return out.reshape(n, oh, ow, o).transpose(0, 3, 1, 2)

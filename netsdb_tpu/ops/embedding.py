@@ -1,0 +1,77 @@
+"""Embedding lookup — the word2vec workload family, TPU-native.
+
+The reference expresses embedding lookup as a blocked matmul of the
+weight matrix against one-hot input columns (``src/word2vec/source/
+Word2Vec.cc:19-80``: scan weights x scan one-hot inputs →
+``FFTransposeMult`` → ``FFAggMatrix``), plus a sparse variant
+``EmbeddingLookupSparse``/``EmbeddingSegment`` that averages per-segment
+rows. On TPU the idiomatic lookup is a gather (``jnp.take``); the matmul
+formulation is kept because (a) it is what the relational planner
+produces and (b) for small vocabularies one-hot matmul on the MXU beats
+gather. ``SemanticClassifier`` — a whole FC layer inside one UDF
+(``src/word2vec/headers/SemanticClassifier.h``) — lives in
+``netsdb_tpu.models.text_classifier``.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+from netsdb_tpu.core.blocked import BlockedTensor
+from netsdb_tpu.ops.matmul import matmul_t
+
+
+def one_hot_matrix(ids: jax.Array, vocab: int, dtype=jnp.float32) -> jax.Array:
+    """(batch, vocab) one-hot rows — the generated input sets of the
+    reference word2vec test."""
+    return jax.nn.one_hot(ids, vocab, dtype=dtype)
+
+
+def embedding_matmul(weights: BlockedTensor, onehot: BlockedTensor,
+                     compute_dtype: Optional[str] = None) -> BlockedTensor:
+    """Lookup as W·onehotᵀ-style blocked matmul (reference Word2Vec.cc
+    path). ``weights``: (vocab x dim) blocked; ``onehot``: (batch x vocab)
+    blocked. Result: (batch x dim)."""
+    return matmul_t(onehot, transpose_weights_cached(weights), compute_dtype)
+
+
+def transpose_weights_cached(weights: BlockedTensor) -> BlockedTensor:
+    # onehot (batch x vocab) · (dim x vocab)ᵀ ≡ gather of weight rows
+    from netsdb_tpu.ops.linalg import transpose
+
+    return transpose(weights)
+
+
+def embedding_lookup(weights: BlockedTensor, ids: jax.Array) -> jax.Array:
+    """Gather path: rows of (vocab x dim) weights by id — the TPU-native
+    formulation (XLA dynamic-gather), numerically identical to the
+    one-hot matmul. Returns logical (ids..., dim) — padded weight
+    columns are sliced off."""
+    table = weights.to_dense()
+    return jnp.take(table, ids, axis=0)
+
+
+def embedding_lookup_sparse(
+    weights: BlockedTensor,
+    ids: jax.Array,  # (nnz,) flat token ids
+    segment_ids: jax.Array,  # (nnz,) ascending example ids
+    num_segments: int,
+    combiner: str = "mean",
+) -> jax.Array:
+    """Segment-combined sparse lookup — reference
+    ``EmbeddingLookupSparse.h``/``EmbeddingSegment.h`` (bag-of-words text
+    classification front end). Returns (num_segments, dim)."""
+    rows = jnp.take(weights.to_dense(), ids, axis=0)  # (nnz, dim)
+    summed = jax.ops.segment_sum(rows, segment_ids, num_segments)
+    if combiner == "sum":
+        return summed
+    counts = jax.ops.segment_sum(jnp.ones_like(segment_ids, dtype=rows.dtype),
+                                 segment_ids, num_segments)
+    if combiner == "mean":
+        return summed / jnp.maximum(counts, 1.0)[:, None]
+    if combiner == "sqrtn":
+        return summed / jnp.sqrt(jnp.maximum(counts, 1.0))[:, None]
+    raise ValueError(combiner)

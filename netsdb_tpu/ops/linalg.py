@@ -1,0 +1,165 @@
+"""Linear-algebra op set — every operator of the reference LA DSL.
+
+One function per ``LASilly*`` UDF library (reference
+``src/sharedLibraries/headers/LASilly*.h``, built as per-op .so files by
+``SConstruct:393-700``) and per PDML grammar production
+(``src/linearAlgebraDSL/source/LALexer.l``, ``LAParser.y``; operator
+inventory demonstrated by ``DSLSamples/sample00_Parser.pdml``):
+
+    + - * '* %*% ^T ^-1  max min rowMax rowMin rowSum colMax colMin colSum
+    duplicateRow duplicateCol  load zeros ones identity
+
+In the reference each op is a join or aggregation over blocks (e.g. add =
+equi-join on (rowIdx,colIdx) + elementwise Eigen add); here each is one
+traced jnp op on the padded array, masked where zero-padding is not
+neutral.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from netsdb_tpu.core.blocked import BlockMeta, BlockedTensor
+from netsdb_tpu.ops.common import neutral_fill
+from netsdb_tpu.ops.matmul import matmul, matmul_t, t_matmul  # noqa: F401  (re-export)
+
+
+def _aligned(a: BlockedTensor, b: BlockedTensor) -> None:
+    if a.shape != b.shape:
+        raise ValueError(f"shape mismatch {a.shape} vs {b.shape}")
+    if a.meta.block_shape != b.meta.block_shape:
+        raise ValueError(
+            f"block mismatch {a.meta.block_shape} vs {b.meta.block_shape}; reblock first"
+        )
+
+
+def add(a: BlockedTensor, b: BlockedTensor) -> BlockedTensor:
+    """A + B — ref ``LASillyAddJoin.h``."""
+    _aligned(a, b)
+    return a.with_data(a.data + b.data)
+
+
+def subtract(a: BlockedTensor, b: BlockedTensor) -> BlockedTensor:
+    """A - B — ref ``LASillySubstractJoin.h``."""
+    _aligned(a, b)
+    return a.with_data(a.data - b.data)
+
+
+def scale_multiply(a: BlockedTensor, b: BlockedTensor) -> BlockedTensor:
+    """Elementwise A * B (the DSL ``*``) — ref ``LASillyScaleMultiplyJoin.h``."""
+    _aligned(a, b)
+    return a.with_data(a.data * b.data)
+
+
+def scalar_multiply(a: BlockedTensor, s: float) -> BlockedTensor:
+    return a.with_data(a.data * s)
+
+
+def transpose(a: BlockedTensor) -> BlockedTensor:
+    """Aᵀ — ref ``LASillyTransposeSelection.h`` (swaps block indices)."""
+    meta = BlockMeta(a.shape[::-1], a.meta.block_shape[::-1])
+    return BlockedTensor(jnp.swapaxes(a.data, 0, 1), meta)
+
+
+def max_element(a: BlockedTensor) -> jnp.ndarray:
+    """Global max — ref ``LASillyMaxElementAggregate.h``. Scalar result
+    (the reference writes an ``LAMaxElementOutputType`` set)."""
+    return jnp.max(neutral_fill(a, -jnp.inf))
+
+
+def min_element(a: BlockedTensor) -> jnp.ndarray:
+    """Global min — ref ``LASillyMinElementAggregate.h``."""
+    return jnp.min(neutral_fill(a, jnp.inf))
+
+
+def row_max(a: BlockedTensor) -> BlockedTensor:
+    """Per-row max → (n,1) — ref ``LASillyRowMaxAggregate.h``."""
+    return _row_reduce(a, jnp.max, -jnp.inf)
+
+
+def row_min(a: BlockedTensor) -> BlockedTensor:
+    return _row_reduce(a, jnp.min, jnp.inf)
+
+
+def row_sum(a: BlockedTensor) -> BlockedTensor:
+    return _row_reduce(a, jnp.sum, 0.0)
+
+
+def col_max(a: BlockedTensor) -> BlockedTensor:
+    """Per-col max → (1,m) — ref ``LASillyColMaxAggregate.h``."""
+    return _col_reduce(a, jnp.max, -jnp.inf)
+
+
+def col_min(a: BlockedTensor) -> BlockedTensor:
+    return _col_reduce(a, jnp.min, jnp.inf)
+
+
+def col_sum(a: BlockedTensor) -> BlockedTensor:
+    return _col_reduce(a, jnp.sum, 0.0)
+
+
+def _row_reduce(a, fn, fill) -> BlockedTensor:
+    data = neutral_fill(a, fill) if fill != 0.0 else a.data
+    r = fn(data, axis=1, keepdims=True)
+    # rows that are pure padding: neutralize to 0 for the margin invariant
+    if a.meta.is_padded:
+        rows = jnp.arange(a.meta.padded_shape[0])[:, None] < a.shape[0]
+        r = jnp.where(rows, r, 0.0).astype(a.data.dtype)
+    return BlockedTensor(r, BlockMeta((a.shape[0], 1), (a.meta.block_shape[0], 1)))
+
+
+def _col_reduce(a, fn, fill) -> BlockedTensor:
+    data = neutral_fill(a, fill) if fill != 0.0 else a.data
+    r = fn(data, axis=0, keepdims=True)
+    if a.meta.is_padded:
+        cols = jnp.arange(a.meta.padded_shape[1])[None, :] < a.shape[1]
+        r = jnp.where(cols, r, 0.0).astype(a.data.dtype)
+    return BlockedTensor(r, BlockMeta((1, a.shape[1]), (1, a.meta.block_shape[1])))
+
+
+def duplicate_row(v: BlockedTensor, n_rows: int, block_rows: int) -> BlockedTensor:
+    """Tile a (1,m) row vector to (n_rows, m) — ref
+    ``LASillyDuplicateRowMultiSelection.h`` (used by sample03_NN:
+    ``X - duplicateRow(t,100,10)``)."""
+    row = v.to_dense().reshape(1, -1)
+    return BlockedTensor.from_dense(
+        jnp.broadcast_to(row, (n_rows, row.shape[1])),
+        (block_rows, v.meta.block_shape[1]),
+    )
+
+
+def duplicate_col(v: BlockedTensor, n_cols: int, block_cols: int) -> BlockedTensor:
+    """Tile a (n,1) col vector to (n, n_cols) — ref
+    ``LASillyDuplicateColMultiSelection.h``."""
+    col = v.to_dense().reshape(-1, 1)
+    return BlockedTensor.from_dense(
+        jnp.broadcast_to(col, (col.shape[0], n_cols)),
+        (v.meta.block_shape[0], block_cols),
+    )
+
+
+def identity(n: int, block: int, dtype=jnp.float32) -> BlockedTensor:
+    """identity(n, block) — ref DSL TOKEN_IDENTITY."""
+    return BlockedTensor.from_dense(jnp.eye(n, dtype=dtype), (block, block))
+
+
+def zeros(rows: int, cols: int, brows: int, bcols: int, dtype=jnp.float32):
+    return BlockedTensor.zeros((rows, cols), (brows, bcols), dtype)
+
+
+def ones(rows: int, cols: int, brows: int, bcols: int, dtype=jnp.float32):
+    return BlockedTensor.from_dense(
+        jnp.ones((rows, cols), dtype=dtype), (brows, bcols)
+    )
+
+
+def inverse(a: BlockedTensor) -> BlockedTensor:
+    """A⁻¹ (DSL ``^-1``). The reference restricts inversion to
+    single-block matrices (``LASillyInverse1Aggregate.h`` gathers all
+    blocks into one, Eigen-inverts, re-splits via Inverse2/Inverse3) —
+    we invert the dense logical matrix (any blocking) which strictly
+    subsumes that."""
+    if a.shape[0] != a.shape[1]:
+        raise ValueError(f"inverse of non-square {a.shape}")
+    inv = jnp.linalg.inv(a.to_dense().astype(jnp.float32))
+    return BlockedTensor.from_dense(inv.astype(a.data.dtype), a.meta.block_shape)

@@ -1,0 +1,105 @@
+"""LSTM cell — the reference's recurrent workload, TPU-native.
+
+The reference expresses ONE LSTM cell as a computation DAG over
+``FFMatrixBlock`` sets: 8 blocked matmuls (x and h against the 4 gate
+weights, each ``FFInputLayerJoin``+``FFAggMatrix``), gate fusion
+``LSTMThreeWaySum`` (gate = act(xW + hU + b)), cell-state update
+``LSTMTwoSum``/``LSTMHiddenState`` (c' = f⊙c + i⊙g, h' = o⊙tanh c')
+(reference ``src/LSTM/headers/LSTMThreeWaySum.h``, ``LSTMHiddenState.h``;
+driver ``src/tests/source/LSTMTest.cc``). Here the whole cell is one
+traced function — XLA fuses the gate elementwise chain into the matmuls,
+and the 8 matmuls ride the MXU.
+
+Layout follows the reference: activations are (features x batch); weight
+W_* is (hidden x input), U_* is (hidden x hidden), biases (hidden x 1).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from netsdb_tpu.core.blocked import BlockedTensor
+from netsdb_tpu.ops.matmul import matmul
+
+
+@jax.tree_util.register_dataclass
+@dataclasses.dataclass
+class LSTMParams:
+    """The 12 weight sets the reference LSTMTest creates
+    (w_{i,f,c,o}, u_{i,f,c,o}, b_{i,f,c,o})."""
+
+    w_i: BlockedTensor
+    w_f: BlockedTensor
+    w_c: BlockedTensor
+    w_o: BlockedTensor
+    u_i: BlockedTensor
+    u_f: BlockedTensor
+    u_c: BlockedTensor
+    u_o: BlockedTensor
+    b_i: BlockedTensor
+    b_f: BlockedTensor
+    b_c: BlockedTensor
+    b_o: BlockedTensor
+
+
+def three_way_sum(wx: BlockedTensor, uh: BlockedTensor, b: BlockedTensor,
+                  activation: str) -> jax.Array:
+    """gate = act(wx + uh + b) — reference ``LSTMThreeWaySum`` join."""
+    z = wx.data + uh.data + (b.data if b.data.ndim == 2 else b.data[:, None])
+    if activation == "sigmoid":
+        return jax.nn.sigmoid(z)
+    if activation == "tanh":
+        return jnp.tanh(z)
+    raise ValueError(activation)
+
+
+def lstm_cell(
+    params: LSTMParams,
+    x: BlockedTensor,  # (input x batch)
+    h: BlockedTensor,  # (hidden x batch)
+    c: BlockedTensor,  # (hidden x batch)
+    compute_dtype: Optional[str] = None,
+) -> Tuple[BlockedTensor, BlockedTensor]:
+    """One cell step → (h', c'). Biases broadcast into padded batch
+    columns (g=tanh(b_c)≠0 times i=sigmoid(b_i)≠0), so the states are
+    re-masked to keep the zero-margin invariant — it would otherwise
+    compound across scan steps."""
+    from netsdb_tpu.ops.common import remask
+
+    mm = lambda w, v: matmul(w, v, compute_dtype)
+    i = three_way_sum(mm(params.w_i, x), mm(params.u_i, h), params.b_i, "sigmoid")
+    f = three_way_sum(mm(params.w_f, x), mm(params.u_f, h), params.b_f, "sigmoid")
+    g = three_way_sum(mm(params.w_c, x), mm(params.u_c, h), params.b_c, "tanh")
+    o = three_way_sum(mm(params.w_o, x), mm(params.u_o, h), params.b_o, "sigmoid")
+    c_new = f * c.data + i * g  # reference LSTMTwoSum + LSTMHiddenState
+    h_new = o * jnp.tanh(c_new)
+    return (
+        remask(h.with_data(h_new.astype(h.data.dtype))),
+        remask(c.with_data(c_new.astype(c.data.dtype))),
+    )
+
+
+def lstm_unroll(params: LSTMParams, xs, h0: BlockedTensor, c0: BlockedTensor,
+                compute_dtype: Optional[str] = None):
+    """Run the cell over a sequence with ``lax.scan`` (compiler-friendly
+    loop; the reference re-runs its DAG per step from the driver).
+    ``xs``: array (T, input_padded, batch_padded) sharing x's blocking."""
+    from netsdb_tpu.core.blocked import BlockMeta
+
+    x_meta = BlockMeta(
+        (params.w_i.shape[1], h0.shape[1]),
+        (params.w_i.meta.block_shape[1], h0.meta.block_shape[1]),
+    )
+
+    def step(carry, x_t):
+        h, c = carry
+        h2, c2 = lstm_cell(params, BlockedTensor(x_t, x_meta), h, c,
+                           compute_dtype)
+        return (h2, c2), h2.data
+
+    (h, c), hs = jax.lax.scan(step, (h0, c0), xs)
+    return h, c, hs

@@ -1,0 +1,80 @@
+"""Blocked matmul — the heart of netsDB's in-database inference, TPU-native.
+
+The reference computes C = A·Bᵀ as a relational plan: equi-join
+``FFMatrixBlock``s on the contraction block index, per-pair Eigen GEMM in
+the join projection, then ``FFAggMatrix`` cluster-aggregation summing
+partial products by output block index (reference
+``src/FF/headers/FFTransposeMult.h:38-92``, ``FFInputLayerJoin.h``,
+``FFAggMatrix.h:11-30``) — SUMMA expressed as join+groupby, shuffled over
+TCP. On TPU the whole join+aggregate collapses into ONE
+``lax.dot_general`` on the padded arrays: XLA tiles it onto the MXU and,
+under a sharded mesh (see ``netsdb_tpu.parallel``), inserts the
+psum-over-contraction collective that the reference's shuffle performed
+by hand.
+
+Zero padding is safe under contraction, so no masking is needed here;
+output metadata keeps the logical (unpadded) shape.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax.numpy as jnp
+
+from netsdb_tpu.core.blocked import BlockMeta, BlockedTensor
+from netsdb_tpu.ops.common import mxu_dot
+
+
+def _contract(ad, bd, a_pad_k, b_pad_k, k, compute_dtype):
+    # Align contraction extents when block granularities differ.
+    if a_pad_k != b_pad_k:
+        ad = ad[..., :k]
+        bd = bd[:k, :]
+    return mxu_dot(ad, bd, compute_dtype)
+
+
+def matmul(a: BlockedTensor, b: BlockedTensor,
+           compute_dtype: Optional[str] = None) -> BlockedTensor:
+    """C = A·B (reference ``FFInputLayerJoin`` + ``FFAggMatrix``)."""
+    (m, ka), (kb, n) = a.shape, b.shape
+    if ka != kb:
+        raise ValueError(f"matmul contraction mismatch {a.shape} x {b.shape}")
+    out = _contract(a.data, b.data, a.meta.padded_shape[1],
+                    b.meta.padded_shape[0], ka, compute_dtype)
+    meta = BlockMeta((m, n), (a.meta.block_shape[0], b.meta.block_shape[1]))
+    return BlockedTensor(out, meta)
+
+
+def matmul_t(a: BlockedTensor, b: BlockedTensor,
+             compute_dtype: Optional[str] = None) -> BlockedTensor:
+    """C = A·Bᵀ (reference ``FFTransposeMult``: join on matching block
+    col-index of both inputs)."""
+    (m, ka), (n, kb) = a.shape, b.shape
+    if ka != kb:
+        raise ValueError(f"matmul_t contraction mismatch {a.shape} x {b.shape}")
+    bd = jnp.swapaxes(b.data, 0, 1)
+    out = _contract(a.data, bd, a.meta.padded_shape[1],
+                    b.meta.padded_shape[1], ka, compute_dtype)
+    meta = BlockMeta((m, n), (a.meta.block_shape[0], b.meta.block_shape[0]))
+    return BlockedTensor(out, meta)
+
+
+def t_matmul(a: BlockedTensor, b: BlockedTensor,
+             compute_dtype: Optional[str] = None) -> BlockedTensor:
+    """C = Aᵀ·B (the LA DSL ``'*`` transpose-multiply, reference
+    ``LASillyTransposeMultiply1Join.h``; Gram matrix = X '* X)."""
+    (ka, m), (kb, n) = a.shape, b.shape
+    if ka != kb:
+        raise ValueError(f"t_matmul contraction mismatch {a.shape} x {b.shape}")
+    ad = jnp.swapaxes(a.data, 0, 1)
+    out = _contract(ad, b.data, a.meta.padded_shape[0],
+                    b.meta.padded_shape[0], ka, compute_dtype)
+    meta = BlockMeta((m, n), (a.meta.block_shape[1], b.meta.block_shape[1]))
+    return BlockedTensor(out, meta)
+
+
+def gram(x: BlockedTensor, compute_dtype: Optional[str] = None) -> BlockedTensor:
+    """Xᵀ·X — the reference's flagship self-learning benchmark task
+    (``documentation.md:5-10``)."""
+    return t_matmul(x, x, compute_dtype)

@@ -1,0 +1,108 @@
+"""NN elementwise/reduction ops — the FF UDF family, TPU-native.
+
+Each function here replaces one reference join/aggregation UDF over
+``FFMatrixBlock`` sets (citations per function). They are plain traced
+functions, so XLA fuses them into the producing matmul — the fusion the
+reference approximates with hand-written "memory fusion" UDF variants.
+
+All ops maintain the zero-padded-margin invariant (see
+``netsdb_tpu.ops.common``); shapes in comments use the reference's
+layout convention for FF inference: activations are (features x batch).
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+from netsdb_tpu.core.blocked import BlockMeta, BlockedTensor
+from netsdb_tpu.ops.common import neutral_fill, remask
+
+
+def _broadcast_bias(x: BlockedTensor, bias: BlockedTensor) -> jax.Array:
+    """Bias (n,) or (n,1) broadcast along columns of x (n,m), on padded
+    arrays — bias blocks join x blocks by row-block index in the reference
+    (``FFReluBiasSum.h`` join condition)."""
+    b = bias.data
+    if b.ndim == 1:
+        b = b[:, None]
+    if b.shape[0] != x.data.shape[0]:
+        raise ValueError(
+            f"bias rows {b.shape[0]} != x padded rows {x.data.shape[0]} "
+            f"(bias must share x's row blocking)"
+        )
+    return b
+
+
+def relu(x: BlockedTensor) -> BlockedTensor:
+    return x.with_data(jax.nn.relu(x.data))
+
+
+def bias_relu(x: BlockedTensor, bias: BlockedTensor,
+              dropout_rate: float = 0.0,
+              key: Optional[jax.Array] = None) -> BlockedTensor:
+    """relu(x + bias) with optional inverted dropout — reference
+    ``FFReluBiasSum`` join (``src/FF/headers/FFReluBiasSum.h``)."""
+    y = jax.nn.relu(x.data + _broadcast_bias(x, bias))
+    if dropout_rate > 0.0:
+        if key is None:
+            raise ValueError("dropout requires a PRNG key")
+        keep = jax.random.bernoulli(key, 1.0 - dropout_rate, y.shape)
+        y = jnp.where(keep, y / (1.0 - dropout_rate), 0.0)
+    # the bias broadcasts into padded batch columns → relu(bias) garbage
+    # in the margin unless re-masked
+    return remask(x.with_data(y))
+
+
+def bias_sigmoid(x: BlockedTensor, bias: BlockedTensor) -> BlockedTensor:
+    """sigmoid(x + bias) — reference ``FFTransposeBiasSumSigmoid`` (logistic
+    regression head, ``SimpleFF.cc:428-499``). sigmoid(0)=0.5, so remask."""
+    y = jax.nn.sigmoid(x.data + _broadcast_bias(x, bias))
+    return remask(x.with_data(y))
+
+
+def bias_exp(x: BlockedTensor, bias: BlockedTensor) -> BlockedTensor:
+    """exp(x + bias) — reference ``FFTransposeBiasSum`` (softmax numerator
+    stage of ``SimpleFF.cc:292-329``). exp(0)=1, so remask."""
+    y = jnp.exp(x.data + _broadcast_bias(x, bias))
+    return remask(x.with_data(y))
+
+
+def row_sum(x: BlockedTensor) -> BlockedTensor:
+    """Per-row sum → (n,1) — reference ``FFRowAggregate``. Single
+    implementation shared with the LA op set."""
+    from netsdb_tpu.ops import linalg
+
+    return linalg.row_sum(x)
+
+
+def col_sum(x: BlockedTensor) -> BlockedTensor:
+    from netsdb_tpu.ops import linalg
+
+    return linalg.col_sum(x)
+
+
+def softmax(x: BlockedTensor, axis: int = 0) -> BlockedTensor:
+    """Masked softmax along ``axis`` — reference ``FFOutputLayer`` join of
+    the exp-matrix with row-sums (``SimpleFF.cc:292-329``); fused into one
+    op with -inf padding masking (netsDB never pads, we must)."""
+    logits = neutral_fill(x, -jnp.inf)
+    y = jax.nn.softmax(logits, axis=axis)
+    # rows/cols that are ALL padding produce NaN (softmax of all -inf)
+    y = jnp.nan_to_num(y, nan=0.0, posinf=0.0, neginf=0.0)
+    return remask(x.with_data(y.astype(x.data.dtype)))
+
+
+def ff_output_layer(y: BlockedTensor, bias: BlockedTensor,
+                    axis: int = 0) -> BlockedTensor:
+    """exp(y+b) normalized along ``axis`` — the complete reference
+    inference tail (``FFTransposeBiasSum`` → ``FFRowAggregate`` →
+    ``FFOutputLayer``), one fused op. Uses the max-subtracted stable form
+    rather than the reference's raw exp."""
+    z = y.data + _broadcast_bias(y, bias)
+    masked = neutral_fill(y.with_data(z), -jnp.inf)
+    out = jax.nn.softmax(masked, axis=axis)
+    out = jnp.nan_to_num(out, nan=0.0, posinf=0.0, neginf=0.0)
+    return remask(y.with_data(out.astype(y.data.dtype)))

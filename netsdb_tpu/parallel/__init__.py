@@ -1,0 +1,8 @@
+from netsdb_tpu.parallel.mesh import (
+    default_mesh,
+    make_mesh,
+    shard_blocked,
+    replicate,
+)
+
+__all__ = ["default_mesh", "make_mesh", "shard_blocked", "replicate"]

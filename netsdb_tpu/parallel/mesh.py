@@ -1,0 +1,101 @@
+"""Device mesh runtime — the distributed layer, TPU-native.
+
+What the reference builds with a hand-written socket runtime — dispatcher
+partitioning across workers (``src/dispatcher/headers/PartitionPolicy.h:29``),
+per-stage broadcast to all nodes (``QuerySchedulerServer.cc:216-330``),
+hash-repartition shuffle with combiner threads + snappy over TCP
+(``PipelineStage.cc:1215-1516``), broadcast-join replication
+(``PipelineStage.cc:1518-1650``) — is here a ``jax.sharding.Mesh`` plus
+``NamedSharding`` placements: XLA inserts the all-gathers / psums /
+all-to-alls over ICI/DCN that those threads implemented by hand
+(SURVEY §2.6 mapping table).
+
+Mesh convention: axes ``("data", "model")`` — batch rows shard over
+``data`` (the dispatcher's round-robin across workers), weight rows/cols
+shard over ``model`` (the hash-partitioned join side); replication over
+an axis is the broadcast join. Multi-host: call
+``jax.distributed.initialize`` before building the mesh; same code runs
+on a virtual CPU mesh for tests (the pseudo-cluster analogue).
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Sequence, Tuple
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from netsdb_tpu.core.blocked import BlockedTensor
+
+_default_mesh: Optional[Mesh] = None
+
+
+def make_mesh(
+    shape: Optional[Tuple[int, ...]] = None,
+    axis_names: Tuple[str, ...] = ("data", "model"),
+    devices: Optional[Sequence[jax.Device]] = None,
+) -> Mesh:
+    """Build a mesh over available devices. Default shape: all devices on
+    ``data`` (pure data parallelism, the reference's only cross-node
+    strategy), 1 on ``model``."""
+    devices = list(devices if devices is not None else jax.devices())
+    n = len(devices)
+    if shape is None:
+        shape = (n,) + (1,) * (len(axis_names) - 1)
+    if int(np.prod(shape)) != n:
+        raise ValueError(f"mesh shape {shape} != {n} devices")
+    arr = np.asarray(devices).reshape(shape)
+    return Mesh(arr, axis_names)
+
+
+def default_mesh() -> Mesh:
+    global _default_mesh
+    if _default_mesh is None:
+        _default_mesh = make_mesh()
+    return _default_mesh
+
+
+def set_default_mesh(mesh: Mesh) -> None:
+    global _default_mesh
+    _default_mesh = mesh
+
+
+def _divisible_spec(t: BlockedTensor, mesh: Mesh, spec: P) -> P:
+    """Drop sharding on dims the padded shape can't divide evenly —
+    mirrors the dispatcher falling back to DEFAULT policy when a set
+    can't be partitioned by the preferred lambda."""
+    fixed = []
+    for dim, axis in enumerate(spec):
+        if axis is None:
+            fixed.append(None)
+            continue
+        size = np.prod([mesh.shape[a] for a in (axis if isinstance(axis, tuple) else (axis,))])
+        if t.meta.padded_shape[dim] % size == 0:
+            fixed.append(axis)
+        else:
+            fixed.append(None)
+    return P(*fixed)
+
+
+def shard_blocked(t: BlockedTensor, mesh: Optional[Mesh] = None,
+                  spec: Optional[P] = None) -> BlockedTensor:
+    """Place a blocked tensor on the mesh with a NamedSharding. The block
+    grid is the natural granularity: padded dims are whole multiples of
+    the block, so any mesh axis dividing the grid gives block-aligned
+    shards (netsDB's "blocks live on the node that hashed them")."""
+    mesh = mesh or default_mesh()
+    if spec is None:
+        spec = P(*([None] * t.meta.rank))
+    spec = _divisible_spec(t, mesh, spec)
+    sharding = NamedSharding(mesh, spec)
+    return t.with_data(jax.device_put(t.data, sharding))
+
+
+def replicate(t: BlockedTensor, mesh: Optional[Mesh] = None) -> BlockedTensor:
+    """Replicate across the mesh — the broadcast-join placement
+    (``BroadcastJoinBuildHTJobStage``: model weights on every node)."""
+    mesh = mesh or default_mesh()
+    return t.with_data(
+        jax.device_put(t.data, NamedSharding(mesh, P(*([None] * t.meta.rank))))
+    )

@@ -1,0 +1,18 @@
+from netsdb_tpu.plan.computations import (
+    Aggregate,
+    Apply,
+    Computation,
+    Filter,
+    Join,
+    MultiApply,
+    ScanSet,
+    WriteSet,
+)
+from netsdb_tpu.plan.executor import execute_computations
+from netsdb_tpu.plan.planner import LogicalPlan, plan_from_sinks
+
+__all__ = [
+    "Computation", "ScanSet", "Apply", "MultiApply", "Filter", "Join",
+    "Aggregate", "WriteSet", "LogicalPlan", "plan_from_sinks",
+    "execute_computations",
+]

@@ -1,0 +1,223 @@
+"""Computation DAG — the user-facing query API (reference layer 9).
+
+The reference's ``Computation`` subclasses (SelectionComp /
+MultiSelectionComp / JoinComp / AggregateComp / PartitionComp / ScanSet /
+SetWriter — ``src/lambdas/headers/Computation.h:21-97``) carry ``Lambda``
+trees of per-tuple C++ logic and compile themselves to TCAP strings.
+Here each node carries a traced-Python function over set values
+(``BlockedTensor``s or host objects); "compiling" is composing those
+functions into jit stages (``netsdb_tpu.plan.planner``), with XLA as the
+physical optimizer. The node taxonomy is kept 1:1 so every reference
+query has a structural analogue, and ``to_plan_string`` emits a
+TCAP-like textual dump (debuggability + test surface, standing in for
+``src/logicalPlan``'s IR).
+"""
+
+from __future__ import annotations
+
+import itertools
+from typing import Any, Callable, List, Optional, Sequence
+
+_ids = itertools.count()
+
+
+class Computation:
+    """DAG node. ``inputs`` are upstream Computations; ``op_kind`` mirrors
+    the reference class name it replaces."""
+
+    op_kind = "Computation"
+
+    def __init__(self, inputs: Sequence["Computation"]):
+        self.inputs: List[Computation] = list(inputs)
+        self.node_id = next(_ids)
+        self.output_name = f"{self.op_kind}_{self.node_id}"
+
+    # --- evaluation hook (overridden) --------------------------------
+    def evaluate(self, *args: Any) -> Any:
+        raise NotImplementedError
+
+    # --- TCAP-like dump ----------------------------------------------
+    def plan_atom(self) -> str:
+        ins = ", ".join(i.output_name for i in self.inputs)
+        return f"{self.output_name} <= {self.op_kind.upper()}({ins})"
+
+    def __repr__(self):
+        return f"<{self.op_kind} #{self.node_id}>"
+
+
+class ScanSet(Computation):
+    """Read a stored set — reference ``ScanUserSet``/``ScanSet``
+    (``src/lambdas/headers/ScanSet.h``). Leaf node."""
+
+    op_kind = "Scan"
+
+    def __init__(self, db: str, set_name: str):
+        super().__init__([])
+        self.db = db
+        self.set_name = set_name
+        self.output_name = f"scan_{db}_{set_name}_{self.node_id}"
+
+    def plan_atom(self) -> str:
+        return f"{self.output_name} <= SCAN('{self.db}', '{self.set_name}')"
+
+
+class Apply(Computation):
+    """1-in selection/projection — reference ``SelectionComp``
+    (``src/lambdas/headers/SelectionComp.h``): projection lambda only."""
+
+    op_kind = "Apply"
+
+    def __init__(self, input_: Computation, fn: Callable[[Any], Any],
+                 label: str = ""):
+        super().__init__([input_])
+        self.fn = fn
+        self.label = label or getattr(fn, "__name__", "fn")
+
+    def evaluate(self, x):
+        return self.fn(x)
+
+    def plan_atom(self) -> str:
+        return (f"{self.output_name} <= APPLY({self.inputs[0].output_name}, "
+                f"'{self.label}')")
+
+
+class Filter(Computation):
+    """Selection predicate — reference ``SelectionComp::getSelection``
+    (FILTER atom in TCAP, ``src/logicalPlan/source/Lexer.l``). For host
+    object sets; tensor pipelines express filtering as masks."""
+
+    op_kind = "Filter"
+
+    def __init__(self, input_: Computation, pred: Callable[[Any], bool],
+                 label: str = ""):
+        super().__init__([input_])
+        self.pred = pred
+        self.label = label or getattr(pred, "__name__", "pred")
+
+    def evaluate(self, items):
+        return [x for x in items if self.pred(x)]
+
+    def plan_atom(self) -> str:
+        return (f"{self.output_name} <= FILTER({self.inputs[0].output_name}, "
+                f"'{self.label}')")
+
+
+class MultiApply(Computation):
+    """1-in → many-out flatten — reference ``MultiSelectionComp``
+    (FLATTEN atom). ``fn`` returns a list per input value."""
+
+    op_kind = "Flatten"
+
+    def __init__(self, input_: Computation, fn: Callable[[Any], List[Any]],
+                 label: str = ""):
+        super().__init__([input_])
+        self.fn = fn
+        self.label = label or getattr(fn, "__name__", "fn")
+
+    def evaluate(self, items):
+        out: List[Any] = []
+        for x in items:
+            out.extend(self.fn(x))
+        return out
+
+    def plan_atom(self) -> str:
+        return (f"{self.output_name} <= FLATTEN({self.inputs[0].output_name}, "
+                f"'{self.label}')")
+
+
+class Join(Computation):
+    """2-in combine — reference ``JoinComp`` (``src/lambdas/headers/
+    JoinComp.h``). For tensor pipelines the join-on-block-index +
+    projection collapses into one traced fn (e.g. ``ops.matmul_t``); for
+    host sets an equi-join on key fns (hash join, as the reference's
+    broadcast/partitioned hash joins)."""
+
+    op_kind = "Join"
+
+    def __init__(self, left: Computation, right: Computation,
+                 fn: Optional[Callable[[Any, Any], Any]] = None,
+                 left_key: Optional[Callable] = None,
+                 right_key: Optional[Callable] = None,
+                 project: Optional[Callable[[Any, Any], Any]] = None,
+                 label: str = ""):
+        super().__init__([left, right])
+        self.fn = fn
+        self.left_key = left_key
+        self.right_key = right_key
+        self.project = project
+        self.label = label or (getattr(fn, "__name__", "join") if fn else "equijoin")
+
+    def evaluate(self, left, right):
+        if self.fn is not None:
+            return self.fn(left, right)
+        # host-side hash equi-join (reference broadcast join: build small
+        # side hash table, probe the large side)
+        table = {}
+        for r in right:
+            table.setdefault(self.right_key(r), []).append(r)
+        out = []
+        proj = self.project or (lambda a, b: (a, b))
+        for l in left:
+            for r in table.get(self.left_key(l), ()):
+                out.append(proj(l, r))
+        return out
+
+    def plan_atom(self) -> str:
+        return (f"{self.output_name} <= JOIN({self.inputs[0].output_name}, "
+                f"{self.inputs[1].output_name}, '{self.label}')")
+
+
+class Aggregate(Computation):
+    """Group-by/reduce — reference ``AggregateComp``/``ClusterAggregateComp``
+    (``src/lambdas/headers/AggregateComp.h``). Tensor pipelines pass a
+    traced reduction fn; host sets pass key/value fns + combiner (the
+    CombinerProcessor/AggregationProcessor pair collapses into one dict
+    fold — the cross-node shuffle it implemented is XLA's problem now)."""
+
+    op_kind = "Aggregate"
+
+    def __init__(self, input_: Computation,
+                 fn: Optional[Callable[[Any], Any]] = None,
+                 key: Optional[Callable] = None,
+                 value: Optional[Callable] = None,
+                 combine: Optional[Callable[[Any, Any], Any]] = None,
+                 label: str = ""):
+        super().__init__([input_])
+        self.fn = fn
+        self.key = key
+        self.value = value
+        self.combine = combine
+        self.label = label or (getattr(fn, "__name__", "agg") if fn else "groupby")
+
+    def evaluate(self, x):
+        if self.fn is not None:
+            return self.fn(x)
+        acc = {}
+        for item in x:
+            k = self.key(item)
+            v = self.value(item)
+            acc[k] = self.combine(acc[k], v) if k in acc else v
+        return acc
+
+    def plan_atom(self) -> str:
+        return (f"{self.output_name} <= AGGREGATE({self.inputs[0].output_name}, "
+                f"'{self.label}')")
+
+
+class WriteSet(Computation):
+    """Materialize into a set — reference ``SetWriter``/``WriteUserSet``.
+    Sink node; stage boundary (the reference's pipeline breaker)."""
+
+    op_kind = "Write"
+
+    def __init__(self, input_: Computation, db: str, set_name: str):
+        super().__init__([input_])
+        self.db = db
+        self.set_name = set_name
+
+    def evaluate(self, x):
+        return x
+
+    def plan_atom(self) -> str:
+        return (f"{self.output_name} <= OUTPUT({self.inputs[0].output_name}, "
+                f"'{self.db}', '{self.set_name}')")

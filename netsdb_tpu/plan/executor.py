@@ -1,0 +1,166 @@
+"""Query executor — QueryScheduler + PipelineStage, single-controller.
+
+The reference ships JobStages to every worker, whose backend builds
+pipelines from TCAP and runs them threaded over pages
+(``QuerySchedulerServer.cc:216-330``, ``PipelineStage.cc:933-1213``);
+shuffles/broadcasts move bytes over TCP. Here one controller process
+evaluates the DAG: tensor subgraphs are composed into a single traced
+function and jit-compiled (XLA fuses the whole stage and, when inputs
+are sharded over a mesh, inserts the collectives the reference's
+shuffle threads implemented by hand); host-object nodes (relational
+workloads) run eagerly.
+
+The per-job compiled-function cache replaces the master's
+``materializedWorkloads`` precompiled-plan cache
+(``QuerySchedulerServer.cc:1242-1264``,
+``src/queryPlanning/headers/PreCompiledWorkload.h``).
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Any, Dict, List, Optional
+
+import jax
+
+from netsdb_tpu.core.blocked import BlockedTensor
+from netsdb_tpu.plan.computations import (
+    Aggregate,
+    Computation,
+    Filter,
+    Join,
+    ScanSet,
+    WriteSet,
+)
+from netsdb_tpu.plan.planner import LogicalPlan, plan_from_sinks
+from netsdb_tpu.storage.store import SetIdentifier
+
+# job_name+canonical-plan → compiled callable (the PreCompiledWorkload
+# analogue, QuerySchedulerServer.cc:1242-1264). LRU-bounded: a serving
+# loop rebuilding DAGs must not grow this without bound.
+from collections import OrderedDict
+
+_COMPILED_CACHE_CAP = 64
+_compiled_cache: "OrderedDict[str, Any]" = OrderedDict()
+
+
+def _is_traceable(node: Computation) -> bool:
+    """Host-object nodes can't go under jit: equi-joins/group-bys over
+    Python records and predicate filters stay eager."""
+    if isinstance(node, Filter):
+        return False
+    if isinstance(node, Join) and node.fn is None:
+        return False
+    if isinstance(node, Aggregate) and node.fn is None:
+        return False
+    return True
+
+
+def _evaluate(plan: LogicalPlan, scan_values: Dict[int, Any]) -> Dict[int, Any]:
+    """Replay the DAG in topo order, memoizing shared subgraphs (the
+    reference would materialize these as intermediate per-job sets)."""
+    values: Dict[int, Any] = dict(scan_values)
+    for node in plan.topo:
+        if node.node_id in values:
+            continue
+        args = [values[i.node_id] for i in node.inputs]
+        values[node.node_id] = node.evaluate(*args)
+    return values
+
+
+def execute_computations(
+    client,
+    sinks: List[WriteSet],
+    job_name: str = "job",
+    materialize: bool = True,
+) -> Dict[SetIdentifier, Any]:
+    """Plan and run; returns {output set ident: value} and (by default)
+    materializes results into the store — the reference's OUTPUT sets."""
+    plan = plan_from_sinks(sinks)
+    t0 = time.perf_counter()
+
+    scan_values: Dict[int, Any] = {}
+    tensor_scans: List[ScanSet] = []
+    for node in plan.topo:
+        if isinstance(node, ScanSet):
+            ident = SetIdentifier(node.db, node.set_name)
+            items = client.store.get_items(ident)
+            if len(items) == 1 and isinstance(items[0], BlockedTensor):
+                scan_values[node.node_id] = items[0]
+                tensor_scans.append(node)
+            else:
+                scan_values[node.node_id] = items
+
+    all_traceable = all(_is_traceable(n) for n in plan.topo)
+
+    num_scans = sum(isinstance(n, ScanSet) for n in plan.topo)
+
+    if all_traceable and tensor_scans:
+        # Cache only pure-tensor jobs: host-object scan values are traced
+        # as constants, so a cached callable would pin stale data.
+        cacheable = len(tensor_scans) == num_scans
+        cache_key = f"{job_name}::{plan.cache_key()}"
+        fn = None
+        if cacheable and cache_key in _compiled_cache:
+            fn = _compiled_cache[cache_key]
+            _compiled_cache.move_to_end(cache_key)
+        if fn is None:
+            # canonical arg keys (topo position) so independently built
+            # DAGs of the same shape hit one traced signature; host-object
+            # scan values are closed over (non-cacheable jobs only)
+            canon = {n.node_id: i for i, n in enumerate(plan.topo)}
+            host_values = {k: v for k, v in scan_values.items()
+                           if not isinstance(v, BlockedTensor)}
+
+            def run(tensor_args: Dict[int, BlockedTensor],
+                    _plan=plan, _canon=canon, _host=host_values):
+                merged = dict(_host)
+                for n in _plan.topo:
+                    if isinstance(n, ScanSet) and _canon[n.node_id] in tensor_args:
+                        merged[n.node_id] = tensor_args[_canon[n.node_id]]
+                values = _evaluate(_plan, merged)
+                return [values[s.inputs[0].node_id] for s in _plan.sinks]
+
+            fn = jax.jit(run)
+            if cacheable:
+                _compiled_cache[cache_key] = fn
+                while len(_compiled_cache) > _COMPILED_CACHE_CAP:
+                    _compiled_cache.popitem(last=False)
+        topo_pos = {n.node_id: i for i, n in enumerate(plan.topo)}
+        canon_args = {topo_pos[n.node_id]: scan_values[n.node_id]
+                      for n in tensor_scans}
+        out_list = fn(canon_args)
+        sink_vals = {s.node_id: out_list[i] for i, s in enumerate(plan.sinks)}
+    else:
+        values = _evaluate(plan, scan_values)
+        sink_vals = {s.node_id: values[s.inputs[0].node_id] for s in plan.sinks}
+
+    results: Dict[SetIdentifier, Any] = {}
+    for sink in plan.sinks:
+        out = sink_vals[sink.node_id]
+        ident = SetIdentifier(sink.db, sink.set_name)
+        results[ident] = out
+        if materialize:
+            client.store.create_set(ident)
+            if isinstance(out, BlockedTensor):
+                client.store.put_tensor(ident, out)
+            elif isinstance(out, dict):
+                client.store.clear_set(ident)
+                client.store.add_data(ident, list(out.items()))
+            else:
+                client.store.clear_set(ident)
+                client.store.add_data(ident, list(out))
+
+    elapsed = time.perf_counter() - t0
+    # stage timing record — feeds the Lachesis-lite advisor (§2.4)
+    try:
+        from netsdb_tpu.learning.history import record_job
+
+        record_job(job_name, plan, elapsed)
+    except ImportError:
+        pass
+    return results
+
+
+def clear_compiled_cache() -> None:
+    _compiled_cache.clear()

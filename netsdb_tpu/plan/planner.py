@@ -1,0 +1,107 @@
+"""Logical planner — TCAP + TCAPAnalyzer, collapsed to what TPU needs.
+
+The reference compiles the Computation DAG to a textual TCAP program
+(``src/queryPlanning/headers/QueryGraphAnalyzer.h``), then a cost-based
+``TCAPAnalyzer`` greedily cuts it into JobStages at pipeline breakers,
+re-planning after each stage using storage stats
+(``src/queryPlanning/headers/TCAPAnalyzer.h:20-40``,
+``QuerySchedulerServer.cc:1332-1420``). Under XLA the physical operator
+ordering/fusion inside a stage is the compiler's job, so planning
+reduces to: topo-sort the DAG, memoize shared subgraphs (the reference
+materializes these as intermediate sets), and cut stages at
+materialization points (WriteSet sinks) — exactly the "stages = jit
+boundaries" translation of SURVEY §7. The TCAP-like dump is kept as the
+debuggable plan artifact.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Dict, List, Sequence
+
+from netsdb_tpu.plan.computations import Computation, ScanSet, WriteSet
+
+
+@dataclasses.dataclass
+class JobStage:
+    """One materialization unit — analogue of ``TupleSetJobStage``
+    (``src/builtInPDBObjects/headers/TupleSetJobStage.h:20-50``): the topo
+    slice of nodes from scans to one sink."""
+
+    stage_id: int
+    sink: WriteSet
+    nodes: List[Computation]  # topo order, sink last
+
+    @property
+    def scans(self) -> List[ScanSet]:
+        return [n for n in self.nodes if isinstance(n, ScanSet)]
+
+
+@dataclasses.dataclass
+class LogicalPlan:
+    sinks: List[WriteSet]
+    topo: List[Computation]  # whole-DAG topo order
+    stages: List[JobStage]
+
+    def to_plan_string(self) -> str:
+        """TCAP-like textual dump (test/debug surface)."""
+        lines = [n.plan_atom() for n in self.topo]
+        return "\n".join(lines)
+
+    def cache_key(self) -> str:
+        """Canonical structural key: node names renumbered by topo
+        position so two independently-built DAGs of the same shape share
+        compiled code (process-global node_ids would never collide).
+        Like the reference's per-job-name ``PreCompiledWorkload`` cache,
+        this keys on structure + labels, not lambda identity: reusing a
+        label for behaviorally different lambdas under one job name
+        serves the first compilation."""
+        from netsdb_tpu.plan.computations import ScanSet, WriteSet
+
+        names = {n.node_id: f"n{i}" for i, n in enumerate(self.topo)}
+        atoms = []
+        for n in self.topo:
+            ins = ",".join(names[i.node_id] for i in n.inputs)
+            extra = ""
+            if isinstance(n, ScanSet):
+                extra = f"{n.db}:{n.set_name}"
+            elif isinstance(n, WriteSet):
+                extra = f"{n.db}:{n.set_name}"
+            else:
+                extra = getattr(n, "label", "")
+            atoms.append(f"{names[n.node_id]}={n.op_kind}({ins};{extra})")
+        return "|".join(atoms)
+
+
+def _topo_sort(sinks: Sequence[Computation]) -> List[Computation]:
+    order: List[Computation] = []
+    seen: Dict[int, bool] = {}
+
+    def visit(node: Computation, path: set):
+        if node.node_id in seen:
+            return
+        if node.node_id in path:
+            raise ValueError("computation graph has a cycle")
+        path = path | {node.node_id}
+        for dep in node.inputs:
+            visit(dep, path)
+        seen[node.node_id] = True
+        order.append(node)
+
+    for s in sinks:
+        visit(s, set())
+    return order
+
+
+def plan_from_sinks(sinks: Sequence[WriteSet]) -> LogicalPlan:
+    """Build the plan from sink computations — the DFS-from-sinks walk of
+    ``QueryGraphAnalyzer::parseTCAPString``."""
+    for s in sinks:
+        if not isinstance(s, WriteSet):
+            raise TypeError(f"sink {s!r} is not a WriteSet")
+    topo = _topo_sort(sinks)
+    stages = []
+    for i, sink in enumerate(sinks):
+        sub = _topo_sort([sink])
+        stages.append(JobStage(stage_id=i, sink=sink, nodes=sub))
+    return LogicalPlan(sinks=list(sinks), topo=topo, stages=stages)

@@ -1,0 +1,3 @@
+from netsdb_tpu.storage.store import SetStore, SetIdentifier, CacheStats
+
+__all__ = ["SetStore", "SetIdentifier", "CacheStats"]

@@ -1,0 +1,297 @@
+"""Host-side set store — the Pangea storage engine, TPU-shaped.
+
+The reference's worker-frontend ``PangeaStorageServer`` owns
+databases→sets→64 MB shared-memory pages with a pin/unpin ``PageCache``
+and flush threads spilling to ``PartitionedFile``s on disk (reference
+``src/serverFunctionalities/headers/PangeaStorageServer.h:31-52``,
+``src/storage/headers/PDBPage.h:17-33``, ``PageCache.h:106-118``,
+``PartitionedFile.h``). Its job: keep hot sets in RAM, stream pages to
+the execution pipelines, survive restarts.
+
+On TPU the equivalent capability is: keep sets on host (numpy) or device
+(jax.Array) with an LRU spill-to-disk cache, stream blocks into HBM on
+demand, and persist sets as files. Sets hold either tensors
+(:class:`BlockedTensor`) or arbitrary host objects (relational rows for
+the TPCH-style workloads). Cache accounting mirrors ``CacheStats``
+(``src/storage/headers/CacheStats.h:8-60``); eviction policy per set
+mirrors ``LocalitySet`` {LRU, MRU, Random}
+(``src/storage/headers/LocalitySet.h:16-24``).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import os
+import pickle
+import random
+import time
+from collections import OrderedDict
+from typing import Any, Dict, Iterator, List, NamedTuple, Optional
+
+import jax
+import numpy as np
+
+from netsdb_tpu.config import Configuration, DEFAULT_CONFIG
+from netsdb_tpu.core.blocked import BlockedTensor, BlockMeta
+
+
+class SetIdentifier(NamedTuple):
+    """(database, set) pair — reference ``SetIdentifier`` builtin object."""
+
+    db: str
+    set: str
+
+    def __str__(self) -> str:
+        return f"{self.db}:{self.set}"
+
+
+@dataclasses.dataclass
+class CacheStats:
+    """Hit/miss/eviction counters (ref ``CacheStats.h:8-60``)."""
+
+    hits: int = 0
+    misses: int = 0
+    evictions: int = 0
+    spills: int = 0
+    loads: int = 0
+
+    def as_dict(self) -> Dict[str, int]:
+        return dataclasses.asdict(self)
+
+
+@dataclasses.dataclass
+class _StoredSet:
+    """One set's in-memory state."""
+
+    ident: SetIdentifier
+    items: Optional[List[Any]]  # None => spilled to disk
+    persistence: str = "transient"  # ref PersistenceType (DataTypes.h:53)
+    eviction: str = "lru"  # ref LocalitySet replacement policy
+    last_access: float = 0.0
+    nbytes: int = 0
+    # dedup: set whose physical storage this set aliases
+    # (ref SharedTensorBlockSet, src/deduplication/headers/SharedTensorBlockSet.h:25)
+    alias_of: Optional[SetIdentifier] = None
+    shared_mapping: Optional[Dict] = None
+
+
+def _item_nbytes(item: Any) -> int:
+    if isinstance(item, BlockedTensor):
+        return int(np.prod(item.meta.padded_shape)) * item.data.dtype.itemsize
+    if isinstance(item, (np.ndarray, jax.Array)):
+        return int(item.nbytes)
+    return 256  # rough per-object estimate for host records
+
+
+class SetStore:
+    """All sets of all databases on this host.
+
+    Single-controller JAX means one store per process plays the role of
+    every worker's Pangea instance at once; sharded device placement of a
+    set's tensor is handled by ``netsdb_tpu.parallel``.
+    """
+
+    def __init__(self, config: Configuration = DEFAULT_CONFIG,
+                 max_host_bytes: Optional[int] = None):
+        self.config = config
+        self.config.ensure_dirs()
+        self._sets: "OrderedDict[SetIdentifier, _StoredSet]" = OrderedDict()
+        self.stats = CacheStats()
+        self.max_host_bytes = max_host_bytes or config.shared_mem_bytes
+
+    # --- set lifecycle ------------------------------------------------
+    def create_set(
+        self,
+        ident: SetIdentifier,
+        persistence: str = "transient",
+        eviction: str = "lru",
+    ) -> None:
+        if ident not in self._sets:
+            self._sets[ident] = _StoredSet(
+                ident=ident, items=[], persistence=persistence, eviction=eviction,
+                last_access=time.time(),
+            )
+
+    def exists(self, ident: SetIdentifier) -> bool:
+        return ident in self._sets or os.path.exists(self._spill_path(ident))
+
+    def remove_set(self, ident: SetIdentifier) -> None:
+        self._sets.pop(ident, None)
+        path = self._spill_path(ident)
+        if os.path.exists(path):
+            os.remove(path)
+
+    def clear_set(self, ident: SetIdentifier) -> None:
+        s = self._sets.get(ident)
+        if s is not None:
+            s.items = []
+            s.nbytes = 0
+
+    def list_sets(self) -> List[SetIdentifier]:
+        return list(self._sets.keys())
+
+    # --- data path (ref: StorageAddData / UserSet::addObject) ---------
+    def add_data(self, ident: SetIdentifier, items: List[Any]) -> None:
+        s = self._require(ident)
+        if s.alias_of is not None:
+            raise ValueError(f"set {ident} aliases {s.alias_of}; it is read-only")
+        if s.items is None:  # evicted to disk: reload before appending
+            self._load_from_spill(s)
+        s.items.extend(items)
+        s.nbytes += sum(_item_nbytes(i) for i in items)
+        s.last_access = time.time()
+        self._maybe_evict(exclude=ident)
+
+    def put_tensor(self, ident: SetIdentifier, tensor: BlockedTensor) -> None:
+        """Replace a set's contents with one tensor — the dominant pattern
+        for model-weight sets (each netsDB weight set is exactly one
+        blocked matrix)."""
+        s = self._require(ident)
+        s.items = [tensor]
+        s.nbytes = _item_nbytes(tensor)
+        s.last_access = time.time()
+        self._maybe_evict(exclude=ident)
+
+    def get_tensor(self, ident: SetIdentifier) -> BlockedTensor:
+        items = self.get_items(ident)
+        tensors = [i for i in items if isinstance(i, BlockedTensor)]
+        if len(tensors) != 1:
+            raise ValueError(
+                f"set {ident} holds {len(tensors)} tensors; expected exactly 1"
+            )
+        return tensors[0]
+
+    def get_items(self, ident: SetIdentifier) -> List[Any]:
+        s = self._require(ident)
+        if s.alias_of is not None:
+            # Shared-storage set: physical pages live in another set
+            # (ref PartitionTensorBlockSharedPageIterator).
+            return self.get_items(s.alias_of)
+        if s.items is None:
+            self._load_from_spill(s)
+        else:
+            self.stats.hits += 1
+        s.last_access = time.time()
+        return s.items
+
+    def scan(self, ident: SetIdentifier) -> Iterator[Any]:
+        """Stream a set's items — reference ``SetScan`` / ``SetIterator``
+        (``src/queries/headers/SetIterator.h``)."""
+        yield from self.get_items(ident)
+
+    def add_shared_mapping(
+        self, private: SetIdentifier, shared: SetIdentifier, mapping: Optional[Dict] = None
+    ) -> None:
+        """Point ``private`` at ``shared``'s physical storage — model-dedup
+        client API ``addSharedPage``/``addSharedMapping`` (reference
+        ``src/mainClient/headers/PDBClient.h:113-138``)."""
+        s = self._require(private)
+        s.alias_of = shared
+        s.shared_mapping = mapping or {}
+        s.items = []
+        s.nbytes = 0
+
+    # --- persistence (ref: flush threads → PartitionedFile) -----------
+    def _spill_path(self, ident: SetIdentifier) -> str:
+        safe = f"{ident.db}__{ident.set}".replace("/", "_")
+        return os.path.join(self.config.data_dir, f"{safe}.pdbset")
+
+    def flush(self, ident: SetIdentifier) -> str:
+        """Write a set durably to disk (keeps it in RAM)."""
+        s = self._require(ident)
+        items = self.get_items(ident)
+        path = self._spill_path(ident)
+        payload = []
+        for item in items:
+            if isinstance(item, BlockedTensor):
+                payload.append(
+                    ("tensor", np.asarray(item.data), item.meta.shape,
+                     item.meta.block_shape)
+                )
+            else:
+                payload.append(("object", item, None, None))
+        with open(path, "wb") as f:
+            pickle.dump(
+                {"ident": tuple(s.ident), "persistence": s.persistence,
+                 "items": payload},
+                f, protocol=pickle.HIGHEST_PROTOCOL,
+            )
+        self.stats.spills += 1
+        return path
+
+    def _load_from_spill(self, s: _StoredSet) -> None:
+        path = self._spill_path(s.ident)
+        if not os.path.exists(path):
+            raise KeyError(f"set {s.ident} has no data in RAM or on disk")
+        with open(path, "rb") as f:
+            blob = pickle.load(f)
+        items: List[Any] = []
+        for kind, data, shape, block_shape in blob["items"]:
+            if kind == "tensor":
+                meta = BlockMeta(tuple(shape), tuple(block_shape))
+                import jax.numpy as jnp
+
+                items.append(BlockedTensor(jnp.asarray(data), meta))
+            else:
+                items.append(data)
+        s.items = items
+        s.nbytes = sum(_item_nbytes(i) for i in items)
+        self.stats.misses += 1
+        self.stats.loads += 1
+
+    def load_set(self, ident: SetIdentifier) -> None:
+        """Recover a persisted set after restart (ref: sets survive soft
+        reboot, README.md:101-113)."""
+        if ident not in self._sets:
+            self._sets[ident] = _StoredSet(ident=ident, items=None,
+                                           persistence="persistent")
+        self.get_items(ident)
+
+    # --- eviction (ref: PageCache::evict + LocalitySet policies) ------
+    def _maybe_evict(self, exclude: Optional[SetIdentifier] = None) -> None:
+        total = sum(s.nbytes for s in self._sets.values() if s.items is not None)
+        if total <= self.max_host_bytes:
+            return
+        candidates = [
+            s for s in self._sets.values()
+            if s.items is not None and s.ident != exclude and s.nbytes > 0
+            and s.alias_of is None
+        ]
+        # Policy per set; mixed policies resolved by sorting key.
+        def key(s: _StoredSet):
+            if s.eviction == "mru":
+                return -s.last_access
+            if s.eviction == "random":
+                return random.random()
+            return s.last_access  # lru
+
+        for s in sorted(candidates, key=key):
+            if total <= self.max_host_bytes:
+                break
+            self.flush(s.ident)
+            total -= s.nbytes
+            s.items = None
+            s.nbytes = 0
+            self.stats.evictions += 1
+
+    def _require(self, ident: SetIdentifier) -> _StoredSet:
+        if ident not in self._sets:
+            if os.path.exists(self._spill_path(ident)):
+                self._sets[ident] = _StoredSet(ident=ident, items=None,
+                                               persistence="persistent")
+                return self._sets[ident]
+            raise KeyError(f"unknown set {ident}; create_set first")
+        return self._sets[ident]
+
+    # --- stats (ref: StorageCollectStats → Statistics) ----------------
+    def set_stats(self, ident: SetIdentifier) -> Dict[str, Any]:
+        s = self._require(ident)
+        items = s.items if s.items is not None else []
+        return {
+            "ident": str(ident),
+            "num_items": len(items),
+            "nbytes": s.nbytes,
+            "in_memory": s.items is not None,
+            "persistence": s.persistence,
+            "alias_of": str(s.alias_of) if s.alias_of else None,
+        }

@@ -1,0 +1,44 @@
+"""Test fixture: run everything on a virtual 8-device CPU mesh.
+
+The reference's only multi-node fixture is the pseudo-cluster
+(``scripts/startPseudoCluster.py:33-51`` — real processes, one machine);
+ours is XLA host-platform virtual devices, which exercises the same
+sharding/collective code paths the real TPU mesh uses.
+
+Env vars must be set before jax initializes its backends, hence the
+top-of-file placement.
+"""
+
+import os
+
+# Force CPU even when the ambient environment selects a TPU platform:
+# tests need the 8-device virtual mesh and f32-exact numerics. The env var
+# alone is not enough under the axon TPU plugin — jax.config wins.
+os.environ["JAX_PLATFORMS"] = "cpu"
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (
+        _flags + " --xla_force_host_platform_device_count=8"
+    ).strip()
+
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+
+import tempfile
+
+import pytest
+
+from netsdb_tpu.config import Configuration
+
+
+@pytest.fixture()
+def config(tmp_path):
+    return Configuration(root_dir=str(tmp_path / "netsdb"))
+
+
+@pytest.fixture()
+def client(config):
+    from netsdb_tpu.client import Client
+
+    return Client(config)

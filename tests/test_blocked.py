@@ -1,0 +1,96 @@
+"""Golden tests for BlockedTensor vs NumPy (SURVEY §4: the reference has
+no numeric assertions; we build a real pyramid)."""
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from netsdb_tpu.core.blocked import BlockMeta, BlockedTensor
+
+
+def test_meta_grid_exact():
+    m = BlockMeta((100, 100), (50, 50))
+    assert m.grid == (2, 2)
+    assert m.padded_shape == (100, 100)
+    assert not m.is_padded
+    assert m.num_blocks == 4
+
+
+def test_meta_grid_ragged():
+    # ragged last block, as in FFMatrixBlock.h:79-87
+    m = BlockMeta((105, 98), (50, 50))
+    assert m.grid == (3, 2)
+    assert m.padded_shape == (150, 100)
+    assert m.is_padded
+
+
+def test_from_dense_roundtrip_ragged():
+    rng = np.random.default_rng(0)
+    x = rng.standard_normal((105, 98)).astype(np.float32)
+    t = BlockedTensor.from_dense(x, (50, 50))
+    np.testing.assert_array_equal(np.asarray(t.to_dense()), x)
+    # padded margin must be zero
+    assert float(jnp.abs(t.data[105:, :]).sum()) == 0.0
+    assert float(jnp.abs(t.data[:, 98:]).sum()) == 0.0
+
+
+def test_block_access():
+    x = np.arange(24, dtype=np.float32).reshape(4, 6)
+    t = BlockedTensor.from_dense(x, (2, 3))
+    np.testing.assert_array_equal(np.asarray(t.block(0, 0)), x[:2, :3])
+    np.testing.assert_array_equal(np.asarray(t.block(1, 1)), x[2:, 3:])
+    with pytest.raises(IndexError):
+        t.meta.block_slice((2, 0))
+
+
+def test_blocks_iterator_covers_grid():
+    x = np.random.default_rng(1).standard_normal((5, 7)).astype(np.float32)
+    t = BlockedTensor.from_dense(x, (2, 4))
+    seen = dict(t.blocks())
+    assert set(seen) == {(0, 0), (0, 1), (1, 0), (1, 1), (2, 0), (2, 1)}
+    rebuilt = BlockedTensor.from_blocks(seen, (5, 7), (2, 4))
+    np.testing.assert_array_equal(np.asarray(rebuilt.to_dense()), x)
+
+
+def test_from_blocks_ragged_unpadded_inputs():
+    x = np.random.default_rng(2).standard_normal((5, 5)).astype(np.float32)
+    blocks = {
+        (0, 0): x[:4, :4],
+        (0, 1): x[:4, 4:],  # 4x1 unpadded
+        (1, 0): x[4:, :4],  # 1x4
+        (1, 1): x[4:, 4:],  # 1x1
+    }
+    t = BlockedTensor.from_blocks(blocks, (5, 5), (4, 4))
+    np.testing.assert_array_equal(np.asarray(t.to_dense()), x)
+
+
+def test_mask():
+    t = BlockedTensor.from_dense(np.ones((3, 5), np.float32), (2, 4))
+    m = np.asarray(t.mask())
+    assert m.shape == (4, 8)
+    assert m[:3, :5].all()
+    assert m[3:, :].sum() == 0 and m[:, 5:].sum() == 0
+
+
+def test_pytree_jit():
+    import jax
+
+    x = np.random.default_rng(3).standard_normal((10, 10)).astype(np.float32)
+    t = BlockedTensor.from_dense(x, (4, 4))
+
+    @jax.jit
+    def double(bt):
+        return bt.with_data(bt.data * 2)
+
+    out = double(t)
+    assert isinstance(out, BlockedTensor)
+    assert out.meta == t.meta
+    np.testing.assert_allclose(np.asarray(out.to_dense()), x * 2, rtol=1e-6)
+
+
+def test_reblock():
+    x = np.random.default_rng(4).standard_normal((9, 9)).astype(np.float32)
+    t = BlockedTensor.from_dense(x, (4, 4))
+    r = t.reblock((3, 3))
+    assert r.meta.grid == (3, 3)
+    np.testing.assert_array_equal(np.asarray(r.to_dense()), x)

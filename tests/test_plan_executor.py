@@ -1,0 +1,139 @@
+"""Tests for the logical plan IR + executor (reference analogues: TCAP
+generation tests in src/logicalPlanTests, scheduler paths)."""
+
+import numpy as np
+import pytest
+
+from netsdb_tpu.core.blocked import BlockedTensor
+from netsdb_tpu.ops import nn as nn_ops
+from netsdb_tpu.ops.matmul import matmul_t
+from netsdb_tpu.plan import (
+    Aggregate,
+    Apply,
+    Filter,
+    Join,
+    MultiApply,
+    ScanSet,
+    WriteSet,
+    plan_from_sinks,
+)
+from netsdb_tpu.plan.executor import clear_compiled_cache
+from netsdb_tpu.storage.store import SetIdentifier
+
+
+def test_plan_string_shape(client):
+    client.create_database("db")
+    client.create_set("db", "a")
+    scan = ScanSet("db", "a")
+    ap = Apply(scan, lambda t: t, label="ident")
+    sink = WriteSet(ap, "db", "out")
+    plan = plan_from_sinks([sink])
+    s = plan.to_plan_string()
+    assert "SCAN('db', 'a')" in s
+    assert "APPLY" in s and "'ident'" in s
+    assert "OUTPUT" in s and "'out'" in s
+    assert len(plan.stages) == 1
+    assert plan.stages[0].scans == [scan]
+
+
+def test_plan_rejects_non_sink():
+    with pytest.raises(TypeError):
+        plan_from_sinks([ScanSet("db", "a")])
+
+
+def test_tensor_pipeline_jit_executes(client):
+    clear_compiled_cache()
+    client.create_database("db")
+    client.create_set("db", "x")
+    client.create_set("db", "w")
+    rng = np.random.default_rng(0)
+    x = rng.standard_normal((6, 4)).astype(np.float32)  # batch x feat
+    w = rng.standard_normal((5, 4)).astype(np.float32)  # out x feat
+    client.send_matrix("db", "x", x, (4, 4))
+    client.send_matrix("db", "w", w, (4, 4))
+
+    j = Join(ScanSet("db", "w"), ScanSet("db", "x"),
+             fn=lambda a, b: matmul_t(a, b), label="FFTransposeMult")
+    r = Apply(j, nn_ops.relu, label="relu")
+    sink = WriteSet(r, "db", "y")
+    out = client.execute_computations(sink, job_name="t1")
+    got = np.asarray(out[SetIdentifier("db", "y")].to_dense())
+    np.testing.assert_allclose(got, np.maximum(w @ x.T, 0), rtol=1e-5)
+    # materialized into the store too
+    np.testing.assert_allclose(
+        np.asarray(client.get_tensor("db", "y").to_dense()), got, rtol=1e-6
+    )
+
+
+def test_shared_subgraph_memoized(client):
+    """A node feeding two sinks must evaluate once (the reference
+    materializes shared intermediates)."""
+    calls = []
+    client.create_database("db")
+    client.create_set("db", "x")
+    client.send_matrix("db", "x", np.ones((4, 4), np.float32), (4, 4))
+
+    def counted(t):
+        calls.append(1)
+        return t.with_data(t.data * 2)
+
+    shared = Apply(ScanSet("db", "x"), counted, label="shared")
+    s1 = WriteSet(Apply(shared, lambda t: t, label="a"), "db", "o1")
+    s2 = WriteSet(Apply(shared, lambda t: t, label="b"), "db", "o2")
+    client.execute_computations(s1, s2, job_name="shared-test")
+    assert len(calls) == 1  # traced once
+
+
+def test_host_relational_pipeline(client):
+    """Filter→equi-join→group-by over host records — the TPCH-style path
+    (reference Test47Join / aggregation drivers)."""
+    client.create_database("db")
+    client.create_set("db", "orders", type_name="object")
+    client.create_set("db", "customers", type_name="object")
+    client.send_data("db", "orders", [
+        {"cust": 1, "price": 10.0}, {"cust": 1, "price": 5.0},
+        {"cust": 2, "price": 7.0}, {"cust": 3, "price": 1.0},
+    ])
+    client.send_data("db", "customers", [
+        {"id": 1, "name": "ann"}, {"id": 2, "name": "bob"},
+    ])
+
+    orders = ScanSet("db", "orders")
+    custs = ScanSet("db", "customers")
+    big = Filter(orders, lambda o: o["price"] >= 5.0, label="price>=5")
+    joined = Join(big, custs, left_key=lambda o: o["cust"],
+                  right_key=lambda c: c["id"],
+                  project=lambda o, c: {"name": c["name"], "price": o["price"]})
+    total = Aggregate(joined, key=lambda r: r["name"],
+                      value=lambda r: r["price"], combine=lambda a, b: a + b)
+    sink = WriteSet(total, "db", "totals")
+    out = client.execute_computations(sink, job_name="tpch-lite")
+    got = dict(out[SetIdentifier("db", "totals")])
+    assert got == {"ann": 15.0, "bob": 7.0}
+
+
+def test_multiapply_flatten(client):
+    client.create_database("db")
+    client.create_set("db", "docs", type_name="object")
+    client.send_data("db", "docs", ["a b", "c"])
+    words = MultiApply(ScanSet("db", "docs"), lambda d: d.split(), label="split")
+    counts = Aggregate(words, key=lambda w: w, value=lambda w: 1,
+                       combine=lambda a, b: a + b)
+    out = client.execute_computations(WriteSet(counts, "db", "wc"))
+    got = dict(out[SetIdentifier("db", "wc")])
+    assert got == {"a": 1, "b": 1, "c": 1}
+
+
+def test_compiled_cache_reused(client):
+    clear_compiled_cache()
+    from netsdb_tpu.plan import executor as ex
+
+    client.create_database("db")
+    client.create_set("db", "x")
+    client.send_matrix("db", "x", np.ones((4, 4), np.float32), (4, 4))
+    sink = WriteSet(Apply(ScanSet("db", "x"), lambda t: t, label="id"),
+                    "db", "o")
+    client.execute_computations(sink, job_name="cache-test")
+    assert len(ex._compiled_cache) == 1
+    client.execute_computations(sink, job_name="cache-test")
+    assert len(ex._compiled_cache) == 1

@@ -1,0 +1,116 @@
+"""Regression tests for code-review findings (margin invariant under
+ragged batches, spilled-set append, stride-aware SAME padding, compiled
+cache structural keying)."""
+
+import jax
+import numpy as np
+
+from netsdb_tpu.core.blocked import BlockedTensor
+from netsdb_tpu.ops import conv as conv_ops
+from netsdb_tpu.ops import lstm as lstm_ops
+from netsdb_tpu.ops import nn as nn_ops
+from netsdb_tpu.storage.store import SetIdentifier, SetStore
+
+
+def bt(x, block):
+    return BlockedTensor.from_dense(np.asarray(x, np.float32), block)
+
+
+def test_bias_relu_ragged_batch_margin_stays_zero():
+    # batch 3 < block 4: bias must not leak relu(bias) into padded cols
+    x = np.zeros((4, 3), np.float32)
+    b = np.ones((4, 1), np.float32)
+    out = nn_ops.bias_relu(bt(x, (4, 4)), bt(b, (4, 1)))
+    raw = np.asarray(out.data)
+    assert raw[:, 3:].sum() == 0
+    # downstream row_sum must see only logical columns
+    rs = np.asarray(nn_ops.row_sum(out).to_dense())
+    np.testing.assert_allclose(rs, np.full((4, 1), 3.0), rtol=1e-6)
+
+
+def test_lstm_cell_ragged_batch_margin_stays_zero():
+    rng = np.random.default_rng(0)
+    nin, nh, batch = 4, 4, 2  # batch 2 < block 4
+
+    def w(shape):
+        return bt(rng.standard_normal(shape), (4, 4))
+
+    p = lstm_ops.LSTMParams(
+        w_i=w((nh, nin)), w_f=w((nh, nin)), w_c=w((nh, nin)), w_o=w((nh, nin)),
+        u_i=w((nh, nh)), u_f=w((nh, nh)), u_c=w((nh, nh)), u_o=w((nh, nh)),
+        b_i=bt(np.ones((nh, 1)), (4, 1)), b_f=bt(np.ones((nh, 1)), (4, 1)),
+        b_c=bt(np.ones((nh, 1)), (4, 1)), b_o=bt(np.ones((nh, 1)), (4, 1)),
+    )
+    x = bt(rng.standard_normal((nin, batch)), (4, 4))
+    h = bt(np.zeros((nh, batch)), (4, 4))
+    c = bt(np.zeros((nh, batch)), (4, 4))
+    h2, c2 = lstm_ops.lstm_cell(p, x, h, c)
+    assert np.abs(np.asarray(h2.data)[:, batch:]).sum() == 0
+    assert np.abs(np.asarray(c2.data)[:, batch:]).sum() == 0
+
+
+def test_add_data_to_evicted_set_reloads(config):
+    store = SetStore(config, max_host_bytes=800)
+    a, b = SetIdentifier("db", "a"), SetIdentifier("db", "b")
+    store.create_set(a)
+    store.create_set(b)
+    store.add_data(a, [np.ones(64, np.float32)])  # 256B
+    store.add_data(b, [np.ones(200, np.float32)])  # 800B → evicts a
+    assert store.stats.evictions >= 1
+    store.add_data(a, [np.zeros(8, np.float32)])  # must reload, not crash
+    items = store.get_items(a)
+    assert len(items) == 2 and items[0].sum() == 64
+
+
+def test_same_padding_with_stride_matches_xla_same():
+    rng = np.random.default_rng(1)
+    imgs = rng.standard_normal((1, 2, 7, 7)).astype(np.float32)
+    ker = rng.standard_normal((3, 2, 3, 3)).astype(np.float32)
+    ours = conv_ops.conv2d_direct(imgs, ker, stride=(2, 2), padding="SAME")
+    ref = jax.lax.conv_general_dilated(
+        imgs, ker, (2, 2), "SAME",
+        dimension_numbers=("NCHW", "OIHW", "NCHW"))
+    np.testing.assert_allclose(np.asarray(ours), np.asarray(ref), rtol=1e-5,
+                               atol=1e-6)
+    fused = conv_ops.conv2d_im2col(imgs, ker, stride=(2, 2), padding="SAME",
+                                   block_shape=(16, 16))
+    np.testing.assert_allclose(np.asarray(fused), np.asarray(ref), rtol=1e-4,
+                               atol=1e-5)
+
+
+def test_compiled_cache_hits_across_rebuilt_dags(client):
+    """Independently built DAGs of the same shape must share one cache
+    entry (node_ids differ per build)."""
+    from netsdb_tpu.plan import Apply, ScanSet, WriteSet
+    from netsdb_tpu.plan import executor as ex
+
+    ex.clear_compiled_cache()
+    client.create_database("db")
+    client.create_set("db", "x")
+    client.send_matrix("db", "x", np.ones((4, 4), np.float32), (4, 4))
+
+    def build():
+        return WriteSet(Apply(ScanSet("db", "x"),
+                              lambda t: t.with_data(t.data * 3), label="x3"),
+                        "db", "o")
+
+    client.execute_computations(build(), job_name="serve")
+    client.execute_computations(build(), job_name="serve")
+    client.execute_computations(build(), job_name="serve")
+    assert len(ex._compiled_cache) == 1
+    # and fresh data is picked up, not the first call's
+    client.send_matrix("db", "x", np.full((4, 4), 2.0, np.float32), (4, 4))
+    client.execute_computations(build(), job_name="serve")
+    got = np.asarray(client.get_tensor("db", "o").to_dense())
+    np.testing.assert_array_equal(got, np.full((4, 4), 6.0))
+
+
+def test_embedding_returns_logical_dim():
+    from netsdb_tpu.ops import embedding as emb
+
+    w = bt(np.random.default_rng(2).standard_normal((10, 5)), (8, 8))
+    out = emb.embedding_lookup(w, np.array([1, 2]))
+    assert out.shape == (2, 5)  # not padded 8
+    sparse = emb.embedding_lookup_sparse(
+        w, np.array([1, 2]), np.array([0, 0]), 1, "mean")
+    assert sparse.shape == (1, 5)

@@ -1,0 +1,128 @@
+"""Tests for catalog + set store + client facade (reference analogues:
+storage round-trip drivers Test19/Test28, catalog registration paths)."""
+
+import numpy as np
+import pytest
+
+from netsdb_tpu.catalog.catalog import Catalog
+from netsdb_tpu.core.blocked import BlockedTensor
+from netsdb_tpu.storage.store import SetIdentifier, SetStore
+
+
+def test_catalog_crud(tmp_path):
+    cat = Catalog(str(tmp_path / "cat.sqlite"))
+    cat.create_database("db1")
+    assert cat.database_exists("db1")
+    cat.create_set("db1", "s1", "tensor", {"shape": [4, 4]}, "persistent")
+    info = cat.get_set("db1", "s1")
+    assert info["meta"]["shape"] == [4, 4]
+    assert info["persistence"] == "persistent"
+    cat.register_type("FFMatrixBlock", "netsdb_tpu.core.blocked:BlockedTensor")
+    assert cat.get_type("FFMatrixBlock").endswith("BlockedTensor")
+    cat.register_node(0, "localhost", 8, "cpu")
+    assert cat.list_nodes()[0]["num_devices"] == 8
+    cat.remove_set("db1", "s1")
+    assert cat.get_set("db1", "s1") is None
+    cat.close()
+
+
+def test_catalog_persists_across_reopen(tmp_path):
+    p = str(tmp_path / "cat.sqlite")
+    cat = Catalog(p)
+    cat.create_database("db")
+    cat.create_set("db", "weights")
+    cat.close()
+    cat2 = Catalog(p)
+    assert cat2.set_exists("db", "weights")
+    cat2.close()
+
+
+def test_store_tensor_roundtrip(config):
+    store = SetStore(config)
+    ident = SetIdentifier("db", "w1")
+    store.create_set(ident)
+    x = np.random.default_rng(0).standard_normal((10, 6)).astype(np.float32)
+    store.put_tensor(ident, BlockedTensor.from_dense(x, (4, 4)))
+    got = store.get_tensor(ident)
+    np.testing.assert_array_equal(np.asarray(got.to_dense()), x)
+
+
+def test_store_flush_and_reload(config):
+    store = SetStore(config)
+    ident = SetIdentifier("db", "w")
+    store.create_set(ident, persistence="persistent")
+    x = np.arange(12, dtype=np.float32).reshape(3, 4)
+    store.put_tensor(ident, BlockedTensor.from_dense(x, (2, 2)))
+    store.flush(ident)
+
+    # simulate restart: fresh store, same data dir
+    store2 = SetStore(config)
+    store2.load_set(ident)
+    np.testing.assert_array_equal(
+        np.asarray(store2.get_tensor(ident).to_dense()), x
+    )
+
+
+def test_store_eviction_spills_lru(config):
+    store = SetStore(config, max_host_bytes=1000)
+    a, b = SetIdentifier("db", "a"), SetIdentifier("db", "b")
+    for ident in (a, b):
+        store.create_set(ident)
+    store.put_tensor(a, BlockedTensor.from_dense(np.ones((16, 16), np.float32), (8, 8)))
+    store.put_tensor(b, BlockedTensor.from_dense(np.ones((16, 16), np.float32), (8, 8)))
+    # total 2 KB > 1 KB cap: LRU set a must have been spilled
+    assert store.stats.evictions >= 1
+    assert not store.set_stats(a)["in_memory"]
+    # transparent reload on access
+    t = store.get_tensor(a)
+    assert np.asarray(t.to_dense()).sum() == 256
+
+
+def test_store_shared_mapping_dedup(config):
+    store = SetStore(config)
+    shared = SetIdentifier("db", "shared_w")
+    private = SetIdentifier("db", "model2_w")
+    store.create_set(shared)
+    store.create_set(private)
+    x = np.random.default_rng(1).standard_normal((8, 8)).astype(np.float32)
+    store.put_tensor(shared, BlockedTensor.from_dense(x, (4, 4)))
+    store.add_shared_mapping(private, shared)
+    np.testing.assert_array_equal(
+        np.asarray(store.get_tensor(private).to_dense()), x
+    )
+    # no double storage
+    assert store.set_stats(private)["nbytes"] == 0
+
+
+def test_store_host_objects(config):
+    store = SetStore(config)
+    ident = SetIdentifier("db", "employees")
+    store.create_set(ident)
+    rows = [{"name": f"e{i}", "salary": i * 100} for i in range(10)]
+    store.add_data(ident, rows)
+    assert list(store.scan(ident)) == rows
+
+
+def test_client_facade(client):
+    client.create_database("ff")
+    client.create_set("ff", "inputs")
+    client.create_set("ff", "w1", persistence="persistent")
+    x = np.random.default_rng(2).standard_normal((20, 10)).astype(np.float32)
+    client.send_matrix("ff", "w1", x, block_shape=(8, 8))
+    got = client.get_tensor("ff", "w1")
+    np.testing.assert_array_equal(np.asarray(got.to_dense()), x)
+    # catalog carries tensor meta
+    info = client.catalog.get_set("ff", "w1")
+    assert info["meta"]["shape"] == [20, 10]
+    stats = client.collect_stats()
+    assert "ff:w1" in stats
+    with pytest.raises(KeyError):
+        client.create_set("nodb", "s")
+
+
+def test_client_send_data_iterator(client):
+    client.create_database("db")
+    client.create_set("db", "comments", type_name="object")
+    client.send_data("db", "comments", [1, 2, 3])
+    client.send_data("db", "comments", [4])
+    assert list(client.get_set_iterator("db", "comments")) == [1, 2, 3, 4]
