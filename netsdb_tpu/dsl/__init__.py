@@ -1,0 +1,4 @@
+from netsdb_tpu.dsl.interp import LAInterpreter, run_pdml
+from netsdb_tpu.dsl.parser import parse_program
+
+__all__ = ["LAInterpreter", "run_pdml", "parse_program"]

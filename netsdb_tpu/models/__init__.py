@@ -2,6 +2,14 @@
 (reference layer 16: ``src/FF``, ``src/LogReg``, ``src/word2vec``,
 ``src/conv2d_proj``, ``src/conv2d_memory_fusion``, ``src/LSTM``)."""
 
+from netsdb_tpu.models.conv2d import Conv2DModel
 from netsdb_tpu.models.ff import FFModel
+from netsdb_tpu.models.logreg import LogRegModel
+from netsdb_tpu.models.lstm_model import LSTMModel
+from netsdb_tpu.models.text_classifier import TextClassifierModel
+from netsdb_tpu.models.word2vec import Word2VecModel
 
-__all__ = ["FFModel"]
+__all__ = [
+    "Conv2DModel", "FFModel", "LogRegModel", "LSTMModel",
+    "TextClassifierModel", "Word2VecModel",
+]

@@ -1,0 +1,102 @@
+"""Conv2D model serving — both reference modes as database workloads.
+
+Mode "direct" mirrors ``src/conv2d_proj`` (driver ``src/tests/source/
+Conv2dProjTest.cc``): images as rank-4 tensors in a set, one Selection
+applying the conv per tensor (ATen there, ``lax.conv_general_dilated``
+here). Mode "im2col" mirrors ``src/conv2d_memory_fusion`` (driver
+``PipelinedConv2dMemFuseTest.cc:137-299``): the relational
+image→chunks→matrix→matmul→image rewrite, here the explicit-patches +
+blocked-matmul pipeline. Reference default shapes: 112x112x3 images,
+64 7x7x3 filters (``model-inference/convolutional-neural-network/
+README.md:8-16``).
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Tuple
+
+import numpy as np
+
+from netsdb_tpu.client import Client
+from netsdb_tpu.core.blocked import BlockedTensor
+from netsdb_tpu.ops import conv as conv_ops
+from netsdb_tpu.plan.computations import Apply, Join, ScanSet, WriteSet
+
+
+class Conv2DModel:
+    SETS = ("images", "kernels", "bias", "output")
+
+    def __init__(self, db: str = "conv", mode: str = "direct",
+                 stride: Tuple[int, int] = (1, 1), padding="VALID",
+                 activation: Optional[str] = None,
+                 block: Tuple[int, int] = (256, 256),
+                 compute_dtype: Optional[str] = None):
+        if mode not in ("direct", "im2col"):
+            raise ValueError(f"unknown conv mode {mode!r}")
+        self.db = db
+        self.mode = mode
+        self.stride = stride
+        self.padding = padding
+        self.activation = activation
+        self.block = block
+        self.compute_dtype = compute_dtype
+
+    def setup(self, client: Client) -> None:
+        client.create_database(self.db)
+        for s in self.SETS:
+            client.create_set(self.db, s, type_name="tensor4d")
+
+    def load(self, client: Client, images: np.ndarray, kernels: np.ndarray,
+             bias: Optional[np.ndarray] = None) -> None:
+        """images (N,C,H,W); kernels (O,I,KH,KW); bias (O,). Rank-4
+        tensors are stored as raw arrays (reference ``TensorData``
+        N-rank type, ``src/conv2d_proj/headers/TensorData.h``)."""
+        client.send_data(self.db, "images", [np.asarray(images, np.float32)])
+        client.send_data(self.db, "kernels", [np.asarray(kernels, np.float32)])
+        if bias is not None:
+            client.send_data(self.db, "bias", [np.asarray(bias, np.float32)])
+
+    def _conv(self, images, kernels, bias, activation):
+        kw = dict(stride=self.stride, padding=self.padding,
+                  activation=activation, compute_dtype=self.compute_dtype)
+        if self.mode == "direct":
+            return conv_ops.conv2d_direct(images, kernels, bias, **kw)
+        return conv_ops.conv2d_im2col(images, kernels, bias,
+                                      block_shape=self.block, **kw)
+
+    def build_inference_dag(self) -> WriteSet:
+        images = ScanSet(self.db, "images")
+        kernels = ScanSet(self.db, "kernels")
+        bias = ScanSet(self.db, "bias")
+
+        def apply_conv(img_items, ker_items):
+            # conv only; bias + activation joined in downstream
+            return [self._conv(img, ker_items[0], None, None)
+                    for img in img_items]
+
+        def bias_act(conv_items, bias_items):
+            import jax.nn as jnn
+
+            b = bias_items[0] if bias_items else None
+            out = []
+            for c in conv_items:
+                if b is not None:
+                    c = c + b.reshape(1, -1, 1, 1)
+                if self.activation == "relu":
+                    c = jnn.relu(c)
+                elif self.activation == "sigmoid":
+                    c = jnn.sigmoid(c)
+                out.append(c)
+            return out
+
+        conv = Join(images, kernels, fn=apply_conv,
+                    label="Conv2DSelect" if self.mode == "direct"
+                    else "ConvMemoryFusion")
+        out = Join(conv, bias, fn=bias_act, label="KernelBiasJoin")
+        return WriteSet(out, self.db, "output")
+
+    def inference(self, client: Client):
+        """Run conv over every image tensor in the images set."""
+        res = client.execute_computations(self.build_inference_dag(),
+                                          job_name=f"{self.db}-{self.mode}")
+        return next(iter(res.values()))

@@ -1,0 +1,96 @@
+"""Logistic regression in the database.
+
+Mirrors the reference LogReg workload (``src/LogReg/headers/
+Logistic_Regression.h``; driver ``src/tests/source/
+LogisticRegressionTest.cc``), which reuses the FF operator family:
+one ``FFTransposeMult`` + ``FFAggMatrix`` matmul followed by
+``FFTransposeBiasSumSigmoid`` (``src/FF/source/SimpleFF.cc:428-499``).
+Adds a training step (logistic loss + SGD) for the TPU-first story.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from netsdb_tpu.client import Client
+from netsdb_tpu.core.blocked import BlockedTensor
+from netsdb_tpu.ops import nn as nn_ops
+from netsdb_tpu.ops.matmul import matmul_t
+from netsdb_tpu.plan.computations import Join, ScanSet, WriteSet
+
+
+@jax.tree_util.register_dataclass
+@dataclasses.dataclass
+class LogRegParams:
+    w: BlockedTensor  # (1 x features) — a single-row blocked matrix
+    b: BlockedTensor  # (1 x 1)
+
+
+class LogRegModel:
+    SETS = ("inputs", "w", "b", "output")
+
+    def __init__(self, db: str = "logreg", block: Tuple[int, int] = (512, 512),
+                 compute_dtype: Optional[str] = None):
+        self.db = db
+        self.block = block
+        self.compute_dtype = compute_dtype
+
+    def setup(self, client: Client) -> None:
+        client.create_database(self.db)
+        for s in self.SETS:
+            client.create_set(self.db, s)
+
+    def load_weights(self, client: Client, w: np.ndarray, b: float) -> None:
+        client.send_matrix(self.db, "w", np.asarray(w).reshape(1, -1),
+                           (1, self.block[1]))
+        client.send_matrix(self.db, "b", np.asarray([[b]], dtype=np.float32),
+                           (1, 1))
+
+    def load_inputs(self, client: Client, x: np.ndarray) -> None:
+        client.send_matrix(self.db, "inputs", x, self.block)
+
+    def build_inference_dag(self) -> WriteSet:
+        cd = self.compute_dtype
+        w = ScanSet(self.db, "w")
+        x = ScanSet(self.db, "inputs")
+        b = ScanSet(self.db, "b")
+        z = Join(w, x, fn=lambda ww, xx: matmul_t(ww, xx, cd),
+                 label="FFTransposeMult")
+        out = Join(z, b, fn=lambda zz, bb: nn_ops.bias_sigmoid(zz, bb),
+                   label="FFTransposeBiasSumSigmoid")
+        return WriteSet(out, self.db, "output")
+
+    def inference(self, client: Client) -> BlockedTensor:
+        """probabilities (1 x batch)."""
+        res = client.execute_computations(self.build_inference_dag(),
+                                          job_name=f"{self.db}-inference")
+        return next(iter(res.values()))
+
+    # --- pure forms ---------------------------------------------------
+    def params_from_store(self, client: Client) -> LogRegParams:
+        return LogRegParams(w=client.get_tensor(self.db, "w"),
+                            b=client.get_tensor(self.db, "b"))
+
+    def forward(self, params: LogRegParams, x: BlockedTensor) -> BlockedTensor:
+        z = matmul_t(params.w, x, self.compute_dtype)
+        return nn_ops.bias_sigmoid(z, params.b)
+
+    def loss(self, params: LogRegParams, x: BlockedTensor,
+             y: jax.Array) -> jax.Array:
+        """Binary cross-entropy; ``y``: (batch,) in {0,1}."""
+        z = matmul_t(params.w, x, self.compute_dtype)
+        logits = z.to_dense().reshape(-1) + params.b.data[0, 0]
+        return jnp.mean(
+            jnp.maximum(logits, 0) - logits * y
+            + jnp.log1p(jnp.exp(-jnp.abs(logits)))
+        )
+
+    def train_step(self, params: LogRegParams, x: BlockedTensor, y: jax.Array,
+                   lr: float = 0.5):
+        l, g = jax.value_and_grad(self.loss)(params, x, y)
+        return jax.tree_util.tree_map(lambda p, gg: p - lr * gg, params, g), l

@@ -1,0 +1,91 @@
+"""LSTM cell serving in the database.
+
+Mirrors the reference LSTM workload (``src/tests/source/LSTMTest.cc``,
+559 LoC): twelve weight sets (w/u per gate + biases), input and state
+sets, one cell step as a computation DAG of 8 matmuls +
+``LSTMThreeWaySum``/``LSTMHiddenState`` fusions. The reference driver
+re-issues the DAG per timestep; here a sequence runs under one
+``lax.scan`` (``ops.lstm.lstm_unroll``) so XLA compiles the whole
+recurrence once.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Tuple
+
+import jax.numpy as jnp
+import numpy as np
+
+from netsdb_tpu.client import Client
+from netsdb_tpu.core.blocked import BlockedTensor
+from netsdb_tpu.ops.lstm import LSTMParams, lstm_cell, lstm_unroll
+
+_GATES = ("i", "f", "c", "o")
+
+
+class LSTMModel:
+    def __init__(self, db: str = "lstm", block: Tuple[int, int] = (512, 512),
+                 compute_dtype: Optional[str] = None):
+        self.db = db
+        self.block = block
+        self.compute_dtype = compute_dtype
+
+    @property
+    def weight_sets(self):
+        return ([f"w_{g}" for g in _GATES] + [f"u_{g}" for g in _GATES]
+                + [f"b_{g}" for g in _GATES])
+
+    def setup(self, client: Client) -> None:
+        client.create_database(self.db)
+        for s in self.weight_sets + ["x", "h", "c", "h_out", "c_out"]:
+            client.create_set(self.db, s)
+
+    def load_weights(self, client: Client, weights: dict) -> None:
+        """``weights``: {'w_i': (hidden x input), ..., 'b_i': (hidden,)}."""
+        for g in _GATES:
+            client.send_matrix(self.db, f"w_{g}", weights[f"w_{g}"], self.block)
+            client.send_matrix(self.db, f"u_{g}", weights[f"u_{g}"], self.block)
+            b = np.asarray(weights[f"b_{g}"]).reshape(-1, 1)
+            client.send_matrix(self.db, f"b_{g}", b, (self.block[0], 1))
+
+    def load_state(self, client: Client, h: np.ndarray, c: np.ndarray) -> None:
+        client.send_matrix(self.db, "h", h, self.block)
+        client.send_matrix(self.db, "c", c, self.block)
+
+    def params_from_store(self, client: Client) -> LSTMParams:
+        g = lambda name: client.get_tensor(self.db, name)
+        return LSTMParams(
+            w_i=g("w_i"), w_f=g("w_f"), w_c=g("w_c"), w_o=g("w_o"),
+            u_i=g("u_i"), u_f=g("u_f"), u_c=g("u_c"), u_o=g("u_o"),
+            b_i=g("b_i"), b_f=g("b_f"), b_c=g("b_c"), b_o=g("b_o"),
+        )
+
+    def step(self, client: Client, x: np.ndarray) -> Tuple[BlockedTensor, BlockedTensor]:
+        """One cell step from stored state; writes h_out/c_out sets (the
+        LSTMTest driver's per-step executeComputations)."""
+        params = self.params_from_store(client)
+        xb = BlockedTensor.from_dense(np.asarray(x, np.float32), self.block)
+        h = client.get_tensor(self.db, "h")
+        c = client.get_tensor(self.db, "c")
+        h2, c2 = lstm_cell(params, xb, h, c, self.compute_dtype)
+        from netsdb_tpu.storage.store import SetIdentifier
+
+        client.store.put_tensor(SetIdentifier(self.db, "h_out"), h2)
+        client.store.put_tensor(SetIdentifier(self.db, "c_out"), c2)
+        return h2, c2
+
+    def run_sequence(self, client: Client, xs: np.ndarray):
+        """``xs``: (T, input, batch) → (h_T, c_T, all h). One lax.scan."""
+        params = self.params_from_store(client)
+        h = client.get_tensor(self.db, "h")
+        c = client.get_tensor(self.db, "c")
+        T = xs.shape[0]
+        # x's row blocking must match w's COLUMN blocking (x rows are the
+        # contraction dim of w·x), and its column blocking h's
+        x_block = (self.block[1], self.block[1])
+        xs_padded = jnp.stack([
+            BlockedTensor.from_dense(np.asarray(xs[t], np.float32),
+                                     x_block).data
+            for t in range(T)
+        ])
+        return lstm_unroll(params, xs_padded, h, c, self.compute_dtype)

@@ -1,0 +1,94 @@
+"""Text classification: word2vec front end + semantic-classifier layer.
+
+Mirrors the reference text-classification pipeline
+(``model-inference/text-classification/README.md:1-37``; driver
+``src/word2vec/source/TestSemanticClassifier.cc``): layer 1 is the
+word2vec embedding matmul, layer 2 is ``SemanticClassifier`` — an entire
+FC layer (weights + bias + softmax) encapsulated in one UDF
+(``src/word2vec/headers/SemanticClassifier.h``). Here layer 2 is one
+traced function for the same reason the reference fused it: it avoids a
+shuffle between layers — XLA fuses it into the embedding matmul.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Tuple
+
+import jax
+import numpy as np
+
+from netsdb_tpu.client import Client
+from netsdb_tpu.core.blocked import BlockedTensor
+from netsdb_tpu.ops import embedding as emb_ops
+from netsdb_tpu.ops import nn as nn_ops
+from netsdb_tpu.ops.matmul import matmul_t
+from netsdb_tpu.plan.computations import Join, ScanSet, WriteSet
+
+
+class TextClassifierModel:
+    SETS = ("embeddings", "inputs", "fc_w", "fc_b", "output")
+
+    def __init__(self, db: str = "textcls", block: Tuple[int, int] = (512, 512),
+                 compute_dtype: Optional[str] = None):
+        self.db = db
+        self.block = block
+        self.compute_dtype = compute_dtype
+
+    def setup(self, client: Client) -> None:
+        client.create_database(self.db)
+        for s in self.SETS:
+            client.create_set(self.db, s)
+
+    def load_weights(self, client: Client, embeddings: np.ndarray,
+                     fc_w: np.ndarray, fc_b: np.ndarray) -> None:
+        """``embeddings``: (vocab x dim); ``fc_w``: (classes x dim);
+        ``fc_b``: (classes,)."""
+        client.send_matrix(self.db, "embeddings", embeddings, self.block)
+        client.send_matrix(self.db, "fc_w", fc_w, self.block)
+        client.send_matrix(self.db, "fc_b",
+                           np.asarray(fc_b).reshape(-1, 1),
+                           (self.block[0], 1))
+
+    def load_onehot_inputs(self, client: Client, ids: np.ndarray,
+                           vocab: int) -> None:
+        onehot = np.asarray(emb_ops.one_hot_matrix(np.asarray(ids), vocab))
+        client.send_matrix(self.db, "inputs", onehot, self.block)
+
+    def semantic_classifier(self, feats: BlockedTensor, w: BlockedTensor,
+                            b: BlockedTensor) -> BlockedTensor:
+        """The whole-FC-layer UDF: softmax(W·featsᵀ + b) over classes.
+        ``feats``: (batch x dim) → output (classes x batch)."""
+        z = matmul_t(w, feats, self.compute_dtype)
+        return nn_ops.ff_output_layer(z, b, axis=0)
+
+    def build_inference_dag(self) -> WriteSet:
+        cd = self.compute_dtype
+        emb = ScanSet(self.db, "embeddings")
+        x = ScanSet(self.db, "inputs")
+        w = ScanSet(self.db, "fc_w")
+        b = ScanSet(self.db, "fc_b")
+        feats = Join(x, emb, fn=lambda o, t: emb_ops.embedding_matmul(t, o, cd),
+                     label="Word2Vec")
+        z = Join(w, feats, fn=lambda ww, ff: matmul_t(ww, ff, cd),
+                 label="SemanticClassifierMatmul")
+        probs = Join(z, b, fn=lambda zz, bb: nn_ops.ff_output_layer(zz, bb, axis=0),
+                     label="SemanticClassifierSoftmax")
+        return WriteSet(probs, self.db, "output")
+
+    def inference(self, client: Client) -> BlockedTensor:
+        res = client.execute_computations(self.build_inference_dag(),
+                                          job_name=f"{self.db}-inference")
+        return next(iter(res.values()))
+
+    def classify_bag_of_words(self, client: Client, token_ids, segment_ids,
+                              num_docs: int) -> jax.Array:
+        """Sparse path: per-document mean embedding → FC layer → argmax.
+        (reference EmbeddingLookupSparse front end)."""
+        feats = emb_ops.embedding_lookup_sparse(
+            client.get_tensor(self.db, "embeddings"), np.asarray(token_ids),
+            np.asarray(segment_ids), num_docs, "mean")  # (docs x dim)
+        fb = BlockedTensor.from_dense(feats, self.block)
+        probs = self.semantic_classifier(
+            fb, client.get_tensor(self.db, "fc_w"),
+            client.get_tensor(self.db, "fc_b"))
+        return probs.to_dense().argmax(axis=0)

@@ -1,0 +1,70 @@
+"""Word2vec embedding serving in the database.
+
+Mirrors the reference word2vec workload (``src/word2vec/source/
+Word2Vec.cc:19-80``): an embedding matrix set is scanned and multiplied
+against one-hot input rows via ``FFTransposeMult``+``FFAggMatrix``. The
+TPU build serves both formulations: the relational matmul DAG (what the
+planner produces) and the gather path (what a TPU should run), plus the
+sparse segment-combined variant (``EmbeddingLookupSparse.h``).
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Tuple
+
+import jax
+import numpy as np
+
+from netsdb_tpu.client import Client
+from netsdb_tpu.core.blocked import BlockedTensor
+from netsdb_tpu.ops import embedding as emb_ops
+from netsdb_tpu.plan.computations import Join, ScanSet, WriteSet
+
+
+class Word2VecModel:
+    SETS = ("weights", "inputs", "output")
+
+    def __init__(self, db: str = "w2v", block: Tuple[int, int] = (512, 512),
+                 compute_dtype: Optional[str] = None):
+        self.db = db
+        self.block = block
+        self.compute_dtype = compute_dtype
+
+    def setup(self, client: Client) -> None:
+        client.create_database(self.db)
+        for s in self.SETS:
+            client.create_set(self.db, s)
+
+    def load_embeddings(self, client: Client, table: np.ndarray) -> None:
+        """``table``: (vocab x dim)."""
+        client.send_matrix(self.db, "weights", table, self.block)
+
+    def load_onehot_inputs(self, client: Client, ids: np.ndarray,
+                           vocab: int) -> None:
+        onehot = np.asarray(emb_ops.one_hot_matrix(np.asarray(ids), vocab))
+        client.send_matrix(self.db, "inputs", onehot, self.block)
+
+    def build_inference_dag(self) -> WriteSet:
+        """Relational form: onehot ⋈ weights matmul (Word2Vec.cc shape)."""
+        cd = self.compute_dtype
+        w = ScanSet(self.db, "weights")
+        x = ScanSet(self.db, "inputs")
+        out = Join(x, w, fn=lambda o, t: emb_ops.embedding_matmul(t, o, cd),
+                   label="FFTransposeMult")
+        return WriteSet(out, self.db, "output")
+
+    def inference(self, client: Client) -> BlockedTensor:
+        res = client.execute_computations(self.build_inference_dag(),
+                                          job_name=f"{self.db}-inference")
+        return next(iter(res.values()))
+
+    def lookup(self, client: Client, ids: np.ndarray) -> jax.Array:
+        """Gather path — no one-hot materialization."""
+        return emb_ops.embedding_lookup(
+            client.get_tensor(self.db, "weights"), np.asarray(ids))
+
+    def lookup_sparse(self, client: Client, ids, segment_ids, num_segments,
+                      combiner: str = "mean") -> jax.Array:
+        return emb_ops.embedding_lookup_sparse(
+            client.get_tensor(self.db, "weights"), np.asarray(ids),
+            np.asarray(segment_ids), num_segments, combiner)

@@ -94,10 +94,13 @@ def im2col(
     x = jnp.pad(images, ((0, 0), (0, 0), ph, pw))
     oh = (x.shape[2] - kh) // stride[0] + 1
     ow = (x.shape[3] - kw) // stride[1] + 1
-    # extract patches via conv_general_dilated_patches (XLA-native im2col)
+    # extract patches via conv_general_dilated_patches (XLA-native im2col).
+    # HIGHEST precision: this lowers to a conv with an identity kernel, and
+    # the TPU default would round the input values themselves to bfloat16.
     patches = jax.lax.conv_general_dilated_patches(
         x, (kh, kw), stride, padding="VALID",
         dimension_numbers=("NCHW", "OIHW", "NCHW"),
+        precision=jax.lax.Precision.HIGHEST,
     )  # (N, C*KH*KW, OH, OW)
     mat = patches.transpose(0, 2, 3, 1).reshape(n * oh * ow, c * kh * kw)
     return mat, (oh, ow)

@@ -34,11 +34,12 @@ def embedding_matmul(weights: BlockedTensor, onehot: BlockedTensor,
                      compute_dtype: Optional[str] = None) -> BlockedTensor:
     """Lookup as W·onehotᵀ-style blocked matmul (reference Word2Vec.cc
     path). ``weights``: (vocab x dim) blocked; ``onehot``: (batch x vocab)
-    blocked. Result: (batch x dim)."""
-    return matmul_t(onehot, transpose_weights_cached(weights), compute_dtype)
+    blocked. Result: (batch x dim). The transpose is re-materialized per
+    call — prefer :func:`embedding_lookup` for serving loops."""
+    return matmul_t(onehot, _transpose_weights(weights), compute_dtype)
 
 
-def transpose_weights_cached(weights: BlockedTensor) -> BlockedTensor:
+def _transpose_weights(weights: BlockedTensor) -> BlockedTensor:
     # onehot (batch x vocab) · (dim x vocab)ᵀ ≡ gather of weight rows
     from netsdb_tpu.ops.linalg import transpose
 

@@ -1,0 +1,13 @@
+"""Analytics workloads — the reference's shared-library UDF families
+(``src/sharedLibraries/headers``: KMeans*, GMM/, LDA*,
+RankUpdateAggregation/PageRank, TopK) re-expressed as jit-compiled
+algorithms over the framework's sets."""
+
+from netsdb_tpu.workloads.kmeans import kmeans, kmeans_on_set
+from netsdb_tpu.workloads.gmm import gmm_em
+from netsdb_tpu.workloads.lda import lda_em
+from netsdb_tpu.workloads.pagerank import pagerank, pagerank_on_set
+from netsdb_tpu.workloads.topk import top_k, top_k_on_set
+
+__all__ = ["kmeans", "kmeans_on_set", "gmm_em", "lda_em", "pagerank",
+           "pagerank_on_set", "top_k", "top_k_on_set"]

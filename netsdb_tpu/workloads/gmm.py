@@ -1,0 +1,65 @@
+"""Gaussian mixture model via EM — reference ``src/sharedLibraries/
+headers/GMM/`` (GmmAggregate etc.; driver ``src/tests/source/TestGmm.cc``).
+
+The reference's E-step is a selection computing per-point
+responsibilities and its M-step an aggregation of weighted sums; here
+both steps are one jitted loop with diagonal covariances (the
+reference's GMM is diagonal too — ``GmmModel.h`` stores per-dim vars).
+"""
+
+from __future__ import annotations
+
+from typing import NamedTuple, Tuple
+
+import jax
+import jax.numpy as jnp
+
+
+class GMMState(NamedTuple):
+    means: jax.Array    # (k, d)
+    variances: jax.Array  # (k, d)
+    weights: jax.Array  # (k,)
+
+
+def _log_prob(points, state: GMMState) -> jax.Array:
+    """(n, k) log N(x; mu_k, diag var_k) + log w_k."""
+    diff = points[:, None, :] - state.means[None, :, :]
+    var = jnp.maximum(state.variances, 1e-6)
+    ll = -0.5 * jnp.sum(diff * diff / var[None], axis=-1)
+    ll = ll - 0.5 * jnp.sum(jnp.log(2 * jnp.pi * var), axis=-1)[None]
+    return ll + jnp.log(jnp.maximum(state.weights, 1e-12))[None]
+
+
+def gmm_em(points: jax.Array, k: int, iters: int = 20,
+           seed: int = 0) -> Tuple[GMMState, jax.Array]:
+    """→ (final state, responsibilities (n,k)). Whole EM under jit."""
+    n, d = points.shape
+    # k-means init (a few Lloyd rounds) — random point picks collapse
+    # components when two seeds land in one cluster
+    from netsdb_tpu.workloads.kmeans import kmeans
+
+    init_means, _ = kmeans(points, k, iters=5, seed=seed)
+    init = GMMState(
+        means=init_means,
+        variances=jnp.ones((k, d), points.dtype) * jnp.var(points, axis=0)[None],
+        weights=jnp.full((k,), 1.0 / k, points.dtype),
+    )
+
+    def step(_, state):
+        logp = _log_prob(points, state)                    # E
+        resp = jax.nn.softmax(logp, axis=1)
+        nk = jnp.maximum(resp.sum(0), 1e-8)                # M
+        means = (resp.T @ points) / nk[:, None]
+        ex2 = (resp.T @ (points * points)) / nk[:, None]
+        return GMMState(means=means,
+                        variances=jnp.maximum(ex2 - means * means, 1e-6),
+                        weights=nk / n)
+
+    state = jax.lax.fori_loop(0, iters, step, init)
+    resp = jax.nn.softmax(_log_prob(points, state), axis=1)
+    return state, resp
+
+
+def gmm_log_likelihood(points: jax.Array, state: GMMState) -> jax.Array:
+    return jnp.mean(jax.scipy.special.logsumexp(_log_prob(points, state),
+                                                axis=1))

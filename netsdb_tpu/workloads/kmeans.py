@@ -1,0 +1,65 @@
+"""KMeans — reference ``KMeansAggregate.h``/``KMeansQuery.h`` family.
+
+The reference runs Lloyd's algorithm as repeated executeComputations:
+a selection computes each point's nearest centroid, an aggregation
+groups points by centroid id summing vectors and counts
+(``src/sharedLibraries/headers/KMeansAggregate.h``,
+``KMeansDataCountAggregate.h``; driver ``src/tests/source/TestKMeans.cc``).
+Here one jitted ``lax.fori_loop`` does all iterations on-device: assign =
+argmin pairwise distance (one matmul on the MXU), update = segment-sum.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from netsdb_tpu.storage.store import SetIdentifier
+
+
+def _assign(points: jax.Array, centroids: jax.Array) -> jax.Array:
+    # ||p-c||² = ||p||² - 2 p·c + ||c||²; argmin over c (‖p‖² constant)
+    dots = points @ centroids.T
+    c2 = jnp.sum(centroids * centroids, axis=1)
+    return jnp.argmin(c2[None, :] - 2.0 * dots, axis=1)
+
+
+def kmeans(points: jax.Array, k: int, iters: int = 10,
+           init_centroids: Optional[jax.Array] = None,
+           seed: int = 0) -> Tuple[jax.Array, jax.Array]:
+    """→ (centroids (k,d), assignments (n,)). Whole loop under jit."""
+    n, d = points.shape
+    if init_centroids is None:
+        idx = jax.random.choice(jax.random.key(seed), n, (k,), replace=False)
+        init_centroids = points[idx]
+
+    def body(_, cents):
+        assign = _assign(points, cents)
+        sums = jax.ops.segment_sum(points, assign, num_segments=k)
+        counts = jax.ops.segment_sum(jnp.ones((n,), points.dtype), assign,
+                                     num_segments=k)
+        # empty cluster keeps its old centroid (reference keeps stale agg)
+        return jnp.where(counts[:, None] > 0, sums / jnp.maximum(counts, 1)[:, None],
+                         cents)
+
+    cents = jax.lax.fori_loop(0, iters, body, init_centroids)
+    return cents, _assign(points, cents)
+
+
+def kmeans_on_set(client, db: str, set_name: str, k: int, iters: int = 10,
+                  out_set: str = "kmeans_centroids", seed: int = 0):
+    """Set-oriented driver (TestKMeans shape): points from a tensor set
+    (n x d), centroids written back as a set."""
+    pts = client.get_tensor(db, set_name)
+    points = pts.to_dense()
+    cents, assign = jax.jit(lambda p: kmeans(p, k, iters, seed=seed))(points)
+    if not client.set_exists(db, out_set):
+        client.create_set(db, out_set)
+    from netsdb_tpu.core.blocked import BlockedTensor
+
+    client.store.put_tensor(SetIdentifier(db, out_set),
+                            BlockedTensor.from_dense(cents,
+                                                     pts.meta.block_shape))
+    return cents, assign

@@ -1,0 +1,55 @@
+"""Latent Dirichlet Allocation — reference ``src/sharedLibraries/headers/
+LDA*`` (LDADocWordTopicAssignment, LDAInitialTopicProbSelection, …;
+driver ``src/tests/source/TestLDA.cc``).
+
+The reference runs collapsed-Gibbs-flavored updates as repeated
+join/aggregate rounds over doc-word-topic assignment sets. Here the
+same doc-topic/word-topic decomposition is learned with batch EM
+(PLSA-with-priors — the deterministic counterpart of the reference's
+sampled updates), one jitted loop over a dense (docs x vocab) count
+matrix: E-step responsibilities and M-step count aggregations are the
+matmuls/segment-sums the reference expressed relationally.
+"""
+
+from __future__ import annotations
+
+from typing import NamedTuple, Tuple
+
+import jax
+import jax.numpy as jnp
+
+
+class LDAState(NamedTuple):
+    doc_topic: jax.Array   # (docs, k) θ
+    topic_word: jax.Array  # (k, vocab) φ
+
+
+def lda_em(counts: jax.Array, k: int, iters: int = 50, alpha: float = 0.1,
+           beta: float = 0.01, seed: int = 0) -> LDAState:
+    """``counts``: (docs x vocab) word counts → fitted θ, φ."""
+    docs, vocab = counts.shape
+    key1, key2 = jax.random.split(jax.random.key(seed))
+    theta = jax.random.dirichlet(key1, jnp.full((k,), 1.0), (docs,))
+    phi = jax.random.dirichlet(key2, jnp.full((vocab,), 1.0), (k,))
+
+    def step(_, state):
+        theta, phi = state
+        # E+M fused without the (docs,k,vocab) responsibility cube:
+        #   resp[d,t,w] = θ[d,t]φ[t,w]/norm[d,w]
+        #   Σ_w resp·counts = θ ⊙ (counts/norm @ φᵀ)   (doc-topic counts)
+        #   Σ_d resp·counts = φ ⊙ (θᵀ @ counts/norm)   (topic-word counts)
+        norm = jnp.maximum(theta @ phi, 1e-12)
+        ratio = counts / norm
+        dt = theta * (ratio @ phi.T) + alpha
+        tw = phi * (theta.T @ ratio) + beta
+        return (dt / dt.sum(1, keepdims=True),
+                tw / tw.sum(1, keepdims=True))
+
+    theta, phi = jax.lax.fori_loop(0, iters, step, (theta, phi))
+    return LDAState(doc_topic=theta, topic_word=phi)
+
+
+def lda_perplexity(counts: jax.Array, state: LDAState) -> jax.Array:
+    probs = jnp.maximum(state.doc_topic @ state.topic_word, 1e-12)
+    ll = jnp.sum(counts * jnp.log(probs))
+    return jnp.exp(-ll / jnp.maximum(counts.sum(), 1.0))

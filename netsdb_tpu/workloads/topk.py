@@ -1,0 +1,36 @@
+"""Top-K — reference ``src/sharedLibraries/headers/TopKTest.h`` (driver
+``src/tests/source/TestTopK.cc``): an aggregation maintaining the K
+nearest/highest-scored items. On TPU: ``jax.lax.top_k`` over a scored
+array; the set driver scores host objects with a user lambda first."""
+
+from __future__ import annotations
+
+from typing import Any, Callable, List, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+def top_k(scores: jax.Array, k: int) -> Tuple[jax.Array, jax.Array]:
+    """→ (values, indices), descending."""
+    return jax.lax.top_k(scores, k)
+
+
+def top_k_on_set(client, db: str, set_name: str, k: int,
+                 score: Callable[[Any], float],
+                 out_set: str = "topk") -> List[Any]:
+    """Score every item in a set, keep the K best (reference TopK over
+    arbitrary pdb::Objects with a distance lambda)."""
+    items = list(client.get_set_iterator(db, set_name))
+    if not items:
+        return []
+    scores = jnp.asarray([score(it) for it in items], jnp.float32)
+    k = min(k, len(items))
+    _, idx = top_k(scores, k)
+    winners = [items[int(i)] for i in np.asarray(idx)]
+    if not client.set_exists(db, out_set):
+        client.create_set(db, out_set, type_name="object")
+    client.clear_set(db, out_set)
+    client.send_data(db, out_set, winners)
+    return winners

@@ -1,0 +1,131 @@
+"""LA DSL tests — mirror the reference DSLSamples (sample00_Parser,
+sample01_Gram, sample03_NN) with numeric oracles."""
+
+import numpy as np
+import pytest
+
+from netsdb_tpu.dsl import parse_program, run_pdml
+from netsdb_tpu.dsl.interp import load_block_file
+
+
+def write_block_file(path, dense, br, bc):
+    """Emit the reference TestDataGenerator format."""
+    rows, cols = dense.shape
+    with open(path, "w") as f:
+        for i in range(rows // br):
+            for j in range(cols // bc):
+                block = dense[i * br:(i + 1) * br, j * bc:(j + 1) * bc]
+                f.write(f"{i} {j} " + " ".join(str(v) for v in block.ravel())
+                        + "\n")
+
+
+def test_parser_handles_sample00_surface():
+    # every operator from DSLSamples/sample00_Parser.pdml
+    prog = """
+A = zeros(4,4,2,2)
+B = ones(4,4,2,2)
+D = identity(4,2)
+E = A + B
+F = A - B
+G = A * B
+H = A '* B
+I = A %*% B
+J = A^T
+L = max(B)
+M = min(B)
+N = rowMax(B)
+O = rowMin(B)
+P = rowSum(B)
+Q = colMax(B)
+R = colMin(B)
+S = colSum(B)
+T = duplicateRow(P^T, 2, 2)
+U = duplicateCol(P, 2, 2)
+"""
+    stmts = parse_program(prog)
+    assert len(stmts) == 19
+    env = run_pdml(prog)
+    assert env["E"].shape == (8, 8)
+    assert np.asarray(env["L"].to_dense()).item() == 1.0
+    assert env["I"].shape == (8, 8)
+    assert env["T"].shape == (4, 8)   # row vector tiled to 4 rows
+    assert env["U"].shape == (8, 4)
+
+
+def test_precedence_matmul_binds_like_reference():
+    # mult ops are same-precedence, left-assoc: D %*% M * D = (D %*% M) * D
+    prog = """
+D = ones(2,2,1,1)
+M = ones(2,2,1,1)
+R = D %*% M * D
+"""
+    env = run_pdml(prog)
+    np.testing.assert_array_equal(np.asarray(env["R"].to_dense()),
+                                  np.full((2, 2), 2.0))
+
+
+def test_gram_task_from_block_file(tmp_path):
+    """sample01_Gram: X1 = load(...); Result = X1 '* X1."""
+    rng = np.random.default_rng(0)
+    dense = rng.standard_normal((12, 4)).astype(np.float32)
+    path = tmp_path / "gram.data"
+    write_block_file(str(path), dense, 4, 2)
+    loaded = load_block_file(str(path), 4, 2, 3, 2)
+    np.testing.assert_allclose(loaded, dense, rtol=1e-6)
+
+    prog = f'X1 = load(4,2,3,2,"{path}")\nResult = X1 \'* X1\n'
+    env = run_pdml(prog)
+    np.testing.assert_allclose(np.asarray(env["Result"].to_dense()),
+                               dense.T @ dense, rtol=1e-4, atol=1e-5)
+
+
+def test_nn_task_sample03(tmp_path):
+    """sample03_NN: i = min(rowSum(D %*% M * D)), D = X - duplicateRow(t,...)."""
+    rng = np.random.default_rng(1)
+    X = rng.standard_normal((8, 4)).astype(np.float32)
+    t = rng.standard_normal((1, 4)).astype(np.float32)
+    M = rng.standard_normal((4, 4)).astype(np.float32)
+    for name, arr, br, bc in (("X", X, 4, 2), ("t", t, 1, 2), ("M", M, 2, 2)):
+        write_block_file(str(tmp_path / f"{name}.data"), arr, br, bc)
+    prog = f"""
+X = load(4,2,2,2,"{tmp_path}/X.data")
+t = load(1,2,1,2,"{tmp_path}/t.data")
+M = load(2,2,2,2,"{tmp_path}/M.data")
+D = X - duplicateRow(t,4,2)
+i = min(rowSum(D %*% M * D))
+"""
+    env = run_pdml(prog)
+    D = X - t
+    expect = ((D @ M) * D).sum(1).min()
+    assert np.asarray(env["i"].to_dense()).item() == pytest.approx(expect,
+                                                                   rel=1e-4)
+
+
+def test_inverse_and_transpose_postfix():
+    prog = """
+A = identity(3,2)
+B = A^-1
+C = (A + A)^T
+"""
+    env = run_pdml(prog)
+    np.testing.assert_allclose(np.asarray(env["B"].to_dense()), np.eye(6),
+                               atol=1e-5)
+    np.testing.assert_allclose(np.asarray(env["C"].to_dense()), 2 * np.eye(6),
+                               atol=1e-5)
+
+
+def test_materializes_sets_through_client(client):
+    run_pdml("A = ones(2,2,2,2)\nB = A + A\n", client=client, db="la")
+    got = np.asarray(client.get_tensor("la", "B").to_dense())
+    np.testing.assert_array_equal(got, np.full((4, 4), 2.0))
+
+
+def test_parse_errors():
+    with pytest.raises(SyntaxError):
+        parse_program("A = ")
+    with pytest.raises(SyntaxError):
+        parse_program("= B")
+    with pytest.raises(NameError):
+        run_pdml("A = B + B\n")
+    with pytest.raises(SyntaxError):
+        parse_program('A = load(1,2,"x.data")')

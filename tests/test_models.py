@@ -1,0 +1,189 @@
+"""Model-family tests mirroring the reference drivers with numeric
+oracles (LogisticRegressionTest.cc, Word2Vec.cc, TestSemanticClassifier.cc,
+Conv2dProjTest.cc, PipelinedConv2dMemFuseTest.cc, LSTMTest.cc)."""
+
+import jax
+import numpy as np
+import pytest
+
+from netsdb_tpu.core.blocked import BlockedTensor
+from netsdb_tpu.models.conv2d import Conv2DModel
+from netsdb_tpu.models.logreg import LogRegModel
+from netsdb_tpu.models.lstm_model import LSTMModel
+from netsdb_tpu.models.text_classifier import TextClassifierModel
+from netsdb_tpu.models.word2vec import Word2VecModel
+
+RNG = np.random.default_rng(11)
+
+
+class TestLogReg:
+    def test_inference_matches_numpy(self, client):
+        model = LogRegModel(block=(8, 8))
+        model.setup(client)
+        w = RNG.standard_normal(10).astype(np.float32)
+        b = 0.3
+        x = RNG.standard_normal((25, 10)).astype(np.float32)
+        model.load_weights(client, w, b)
+        model.load_inputs(client, x)
+        out = np.asarray(model.inference(client).to_dense()).ravel()
+        expect = 1 / (1 + np.exp(-(x @ w + b)))
+        np.testing.assert_allclose(out, expect, rtol=1e-5, atol=1e-6)
+
+    def test_training_learns_separable_data(self, client):
+        model = LogRegModel(block=(8, 8))
+        model.setup(client)
+        n, d = 200, 5
+        true_w = RNG.standard_normal(d)
+        x = RNG.standard_normal((n, d)).astype(np.float32)
+        y = (x @ true_w > 0).astype(np.float32)
+        model.load_weights(client, np.zeros(d, np.float32), 0.0)
+        model.load_inputs(client, x)
+        params = model.params_from_store(client)
+        xb = BlockedTensor.from_dense(x, (8, 8))
+        step = jax.jit(model.train_step)
+        for _ in range(60):
+            params, loss = step(params, xb, y)
+        probs = np.asarray(model.forward(params, xb).to_dense()).ravel()
+        acc = ((probs > 0.5) == y).mean()
+        assert acc > 0.95
+
+
+class TestWord2Vec:
+    def test_matmul_dag_matches_table_rows(self, client):
+        vocab, dim = 30, 12
+        model = Word2VecModel(block=(8, 8))
+        model.setup(client)
+        table = RNG.standard_normal((vocab, dim)).astype(np.float32)
+        ids = np.array([3, 0, 29, 7, 7])
+        model.load_embeddings(client, table)
+        model.load_onehot_inputs(client, ids, vocab)
+        out = np.asarray(model.inference(client).to_dense())
+        np.testing.assert_allclose(out, table[ids], rtol=1e-4, atol=1e-5)
+
+    def test_gather_matches_matmul(self, client):
+        vocab, dim = 20, 6
+        model = Word2VecModel(block=(8, 8))
+        model.setup(client)
+        table = RNG.standard_normal((vocab, dim)).astype(np.float32)
+        model.load_embeddings(client, table)
+        ids = np.array([1, 19, 4])
+        np.testing.assert_allclose(np.asarray(model.lookup(client, ids)),
+                                   table[ids], rtol=1e-6)
+
+
+class TestTextClassifier:
+    def test_pipeline_matches_numpy(self, client):
+        vocab, dim, classes = 40, 16, 3
+        model = TextClassifierModel(block=(8, 8))
+        model.setup(client)
+        emb = RNG.standard_normal((vocab, dim)).astype(np.float32)
+        fc_w = RNG.standard_normal((classes, dim)).astype(np.float32)
+        fc_b = RNG.standard_normal(classes).astype(np.float32)
+        ids = np.array([0, 5, 39, 12])
+        model.load_weights(client, emb, fc_w, fc_b)
+        model.load_onehot_inputs(client, ids, vocab)
+        out = np.asarray(model.inference(client).to_dense())  # (classes x batch)
+        feats = emb[ids]  # (batch x dim)
+        z = fc_w @ feats.T + fc_b[:, None]
+        e = np.exp(z - z.max(0, keepdims=True))
+        expect = e / e.sum(0, keepdims=True)
+        np.testing.assert_allclose(out, expect, rtol=1e-4, atol=1e-6)
+
+    def test_bag_of_words_classification(self, client):
+        vocab, dim, classes = 15, 8, 2
+        model = TextClassifierModel(block=(8, 8))
+        model.setup(client)
+        emb = RNG.standard_normal((vocab, dim)).astype(np.float32)
+        fc_w = RNG.standard_normal((classes, dim)).astype(np.float32)
+        fc_b = np.zeros(classes, np.float32)
+        model.load_weights(client, emb, fc_w, fc_b)
+        token_ids = np.array([0, 1, 2, 9, 10])
+        segs = np.array([0, 0, 0, 1, 1])
+        pred = np.asarray(model.classify_bag_of_words(client, token_ids, segs, 2))
+        feats = np.stack([emb[[0, 1, 2]].mean(0), emb[[9, 10]].mean(0)])
+        expect = (fc_w @ feats.T).argmax(0)
+        np.testing.assert_array_equal(pred, expect)
+
+
+class TestConv2D:
+    def _manual(self, imgs, ker, bias, act):
+        out = jax.lax.conv_general_dilated(
+            imgs, ker, (1, 1), "VALID",
+            dimension_numbers=("NCHW", "OIHW", "NCHW"))
+        out = np.asarray(out) + bias.reshape(1, -1, 1, 1)
+        if act == "relu":
+            out = np.maximum(out, 0)
+        return out
+
+    @pytest.mark.parametrize("mode", ["direct", "im2col"])
+    def test_inference_both_modes(self, client, mode):
+        model = Conv2DModel(db=f"conv_{mode}", mode=mode, activation="relu",
+                            block=(32, 32))
+        model.setup(client)
+        imgs = RNG.standard_normal((2, 3, 14, 14)).astype(np.float32)
+        ker = RNG.standard_normal((8, 3, 7, 7)).astype(np.float32)
+        bias = RNG.standard_normal(8).astype(np.float32)
+        model.load(client, imgs, ker, bias)
+        out = model.inference(client)
+        assert len(out) == 1
+        got = np.asarray(out[0])
+        expect = self._manual(imgs, ker, bias, "relu")
+        np.testing.assert_allclose(got, expect, rtol=1e-3, atol=1e-4)
+
+    def test_multiple_image_tensors(self, client):
+        model = Conv2DModel(db="convmulti", mode="direct", block=(16, 16))
+        model.setup(client)
+        ker = RNG.standard_normal((2, 1, 3, 3)).astype(np.float32)
+        i1 = RNG.standard_normal((1, 1, 6, 6)).astype(np.float32)
+        i2 = RNG.standard_normal((1, 1, 8, 8)).astype(np.float32)
+        client.send_data("convmulti", "images", [i1, i2])
+        client.send_data("convmulti", "kernels", [ker])
+        out = model.inference(client)
+        assert len(out) == 2
+        assert np.asarray(out[0]).shape == (1, 2, 4, 4)
+        assert np.asarray(out[1]).shape == (1, 2, 6, 6)
+
+
+class TestLSTMModel:
+    def _weights(self, nin, nh):
+        w = {}
+        for g in "ifco":
+            w[f"w_{g}"] = (RNG.standard_normal((nh, nin)) * 0.3).astype(np.float32)
+            w[f"u_{g}"] = (RNG.standard_normal((nh, nh)) * 0.3).astype(np.float32)
+            w[f"b_{g}"] = RNG.standard_normal(nh).astype(np.float32) * 0.1
+        return w
+
+    def test_step_and_sequence(self, client):
+        nin, nh, batch, T = 6, 10, 4, 3
+        model = LSTMModel(block=(8, 8))
+        model.setup(client)
+        w = self._weights(nin, nh)
+        model.load_weights(client, w)
+        model.load_state(client, np.zeros((nh, batch), np.float32),
+                         np.zeros((nh, batch), np.float32))
+        xs = RNG.standard_normal((T, nin, batch)).astype(np.float32)
+
+        # numpy oracle
+        def sig(v):
+            return 1 / (1 + np.exp(-v))
+
+        h_np = np.zeros((nh, batch))
+        c_np = np.zeros((nh, batch))
+        for t in range(T):
+            gi = sig(w["w_i"] @ xs[t] + w["u_i"] @ h_np + w["b_i"][:, None])
+            gf = sig(w["w_f"] @ xs[t] + w["u_f"] @ h_np + w["b_f"][:, None])
+            gg = np.tanh(w["w_c"] @ xs[t] + w["u_c"] @ h_np + w["b_c"][:, None])
+            go = sig(w["w_o"] @ xs[t] + w["u_o"] @ h_np + w["b_o"][:, None])
+            c_np = gf * c_np + gi * gg
+            h_np = go * np.tanh(c_np)
+
+        hT, cT, hs = model.run_sequence(client, xs)
+        np.testing.assert_allclose(np.asarray(hT.to_dense()), h_np,
+                                   rtol=1e-4, atol=1e-5)
+        np.testing.assert_allclose(np.asarray(cT.to_dense()), c_np,
+                                   rtol=1e-4, atol=1e-5)
+        assert hs.shape[0] == T
+
+        # single step writes state sets
+        h2, c2 = model.step(client, xs[0])
+        assert client.get_tensor("lstm", "h_out").shape == (nh, batch)

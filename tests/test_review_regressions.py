@@ -105,6 +105,53 @@ def test_compiled_cache_hits_across_rebuilt_dags(client):
     np.testing.assert_array_equal(got, np.full((4, 4), 6.0))
 
 
+def test_dsl_mixed_block_elementwise_auto_reblocks():
+    from netsdb_tpu.dsl import run_pdml
+
+    env = run_pdml("A = ones(2,2,2,2)\nB = ones(1,1,4,4)\nC = A + B\n"
+                   "D = A - B\nE = A %*% B + ones(4,4,1,1)\n")
+    np.testing.assert_array_equal(np.asarray(env["C"].to_dense()),
+                                  np.full((4, 4), 2.0))
+    np.testing.assert_array_equal(np.asarray(env["E"].to_dense()),
+                                  np.full((4, 4), 5.0))
+
+
+def test_lstm_model_run_sequence_non_square_block(client):
+    from netsdb_tpu.models.lstm_model import LSTMModel
+
+    rng = np.random.default_rng(5)
+    nin, nh, batch = 10, 12, 3
+    model = LSTMModel(block=(4, 8))
+    model.setup(client)
+    w = {}
+    for g in "ifco":
+        w[f"w_{g}"] = (rng.standard_normal((nh, nin)) * 0.3).astype(np.float32)
+        w[f"u_{g}"] = (rng.standard_normal((nh, nh)) * 0.3).astype(np.float32)
+        w[f"b_{g}"] = rng.standard_normal(nh).astype(np.float32) * 0.1
+    model.load_weights(client, w)
+    model.load_state(client, np.zeros((nh, batch), np.float32),
+                     np.zeros((nh, batch), np.float32))
+    xs = rng.standard_normal((2, nin, batch)).astype(np.float32)
+    hT, cT, hs = model.run_sequence(client, xs)  # crashed before fix
+    assert hT.shape == (nh, batch)
+    assert np.isfinite(np.asarray(hT.to_dense())).all()
+
+
+def test_q13_word_params_change_result(client):
+    from netsdb_tpu.workloads import tpch
+
+    tables = tpch.generate(scale=1, seed=7)
+    tpch.load_tables(client, "tpch13", tables)
+    default = dict(tpch.run_query(client, "q13", db="tpch13"))
+    # absurd words that match nothing → strictly more orders counted
+    nofilter = dict(tpch.run_query(client, "q13", db="tpch13",
+                                   word1="zzz", word2="qqq"))
+    total_orders_default = sum(k * v for k, v in default.items())
+    total_orders_nofilter = sum(k * v for k, v in nofilter.items())
+    assert total_orders_nofilter == len(tables["orders"])
+    assert total_orders_default < total_orders_nofilter
+
+
 def test_embedding_returns_logical_dim():
     from netsdb_tpu.ops import embedding as emb
 

@@ -1,0 +1,206 @@
+"""TPC-H query tests — each query checked against a direct-Python oracle
+over the same generated tables (reference: src/tpch/source/Query*)."""
+
+import pytest
+
+from netsdb_tpu.workloads import tpch
+
+
+@pytest.fixture(scope="module")
+def tables():
+    return tpch.generate(scale=1, seed=42)
+
+
+@pytest.fixture()
+def loaded(client, tables):
+    tpch.load_tables(client, "tpch", tables)
+    return client, tables
+
+
+def test_q01_pricing_summary(loaded):
+    client, t = loaded
+    rows = tpch.run_query(client, "q01")
+    oracle = {}
+    for l in t["lineitem"]:
+        if l["l_shipdate"] <= "1998-09-02":
+            k = (l["l_returnflag"], l["l_linestatus"])
+            o = oracle.setdefault(k, {"qty": 0, "count": 0})
+            o["qty"] += l["l_quantity"]
+            o["count"] += 1
+    got = dict(rows)
+    assert set(got) == set(oracle)
+    for k in oracle:
+        assert got[k]["sum_qty"] == oracle[k]["qty"]
+        assert got[k]["count"] == oracle[k]["count"]
+        assert got[k]["avg_qty"] == pytest.approx(
+            oracle[k]["qty"] / oracle[k]["count"])
+
+
+def test_q02_min_cost_supplier(loaded):
+    client, t = loaded
+    # pick a (size, suffix) pair that actually matches some parts
+    part = t["part"][0]
+    rows = tpch.run_query(client, "q02", size=part["p_size"],
+                          type_suffix=part["p_type"].split()[-1])
+    got = dict(rows)
+    # oracle for that part: min supplycost among suppliers in EUROPE nations
+    nations = {n["n_nationkey"] for n in t["nation"]
+               if t["region"][n["n_regionkey"]]["r_name"] == "EUROPE"}
+    sups = {s["s_suppkey"] for s in t["supplier"]
+            if s["s_nationkey"] in nations}
+    costs = [ps["ps_supplycost"] for ps in t["partsupp"]
+             if ps["ps_partkey"] == part["p_partkey"]
+             and ps["ps_suppkey"] in sups]
+    if costs:
+        assert got[part["p_partkey"]]["cost"] == pytest.approx(min(costs))
+    else:
+        assert part["p_partkey"] not in got
+
+
+def test_q03_shipping_priority(loaded):
+    client, t = loaded
+    rows = tpch.run_query(client, "q03", segment="BUILDING",
+                          date="1995-03-15")
+    assert len(rows) <= 10
+    # descending revenue
+    revs = [r["revenue"] for r in rows]
+    assert revs == sorted(revs, reverse=True)
+    # oracle check of the top row
+    segs = {c["c_custkey"] for c in t["customer"]
+            if c["c_mktsegment"] == "BUILDING"}
+    okeys = {o["o_orderkey"]: o for o in t["orders"]
+             if o["o_custkey"] in segs and o["o_orderdate"] < "1995-03-15"}
+    oracle = {}
+    for l in t["lineitem"]:
+        if l["l_orderkey"] in okeys and l["l_shipdate"] > "1995-03-15":
+            oracle[l["l_orderkey"]] = oracle.get(l["l_orderkey"], 0) + \
+                l["l_extendedprice"] * (1 - l["l_discount"])
+    if oracle:
+        assert rows[0]["revenue"] == pytest.approx(max(oracle.values()))
+
+
+def test_q04_order_priority(loaded):
+    client, t = loaded
+    rows = tpch.run_query(client, "q04")
+    late = {l["l_orderkey"] for l in t["lineitem"]
+            if l["l_commitdate"] < l["l_receiptdate"]}
+    oracle = {}
+    for o in t["orders"]:
+        if "1993-07-01" <= o["o_orderdate"] < "1993-10-01" and \
+                o["o_orderkey"] in late:
+            oracle[o["o_orderpriority"]] = oracle.get(
+                o["o_orderpriority"], 0) + 1
+    assert dict(rows) == oracle
+
+
+def test_q06_forecast_revenue(loaded):
+    client, t = loaded
+    rows = tpch.run_query(client, "q06")
+    oracle = sum(l["l_extendedprice"] * l["l_discount"]
+                 for l in t["lineitem"]
+                 if "1994-01-01" <= l["l_shipdate"] < "1995-01-01"
+                 and 0.05 <= l["l_discount"] <= 0.07
+                 and l["l_quantity"] < 24)
+    got = dict(rows)
+    if oracle:
+        assert got["revenue"] == pytest.approx(oracle, rel=1e-9)
+    else:
+        assert got.get("revenue", 0) == 0
+
+
+def test_q12_shipmodes(loaded):
+    client, t = loaded
+    rows = tpch.run_query(client, "q12")
+    orders = {o["o_orderkey"]: o for o in t["orders"]}
+    oracle = {}
+    for l in t["lineitem"]:
+        if (l["l_shipmode"] in ("MAIL", "SHIP")
+                and l["l_commitdate"] < l["l_receiptdate"]
+                and l["l_shipdate"] < l["l_commitdate"]
+                and "1994-01-01" <= l["l_receiptdate"] < "1995-01-01"):
+            pri = orders[l["l_orderkey"]]["o_orderpriority"]
+            o = oracle.setdefault(l["l_shipmode"], {"high": 0, "low": 0})
+            if pri in ("1-URGENT", "2-HIGH"):
+                o["high"] += 1
+            else:
+                o["low"] += 1
+    assert dict(rows) == oracle
+
+
+def test_q13_customer_distribution(loaded):
+    import re
+
+    client, t = loaded
+    rows = tpch.run_query(client, "q13")
+    pat = re.compile("special.*requests")
+    per_cust = {}
+    for o in t["orders"]:
+        if pat.search(o["o_comment"]):
+            continue
+        per_cust[o["o_custkey"]] = per_cust.get(o["o_custkey"], 0) + 1
+    oracle = {}
+    for c in t["customer"]:
+        n = per_cust.get(c["c_custkey"], 0)
+        oracle[n] = oracle.get(n, 0) + 1
+    assert dict(rows) == oracle
+    # histogram covers every customer, including zero-order ones
+    assert sum(dict(rows).values()) == len(t["customer"])
+
+
+def test_q14_promo_effect(loaded):
+    client, t = loaded
+    rows = tpch.run_query(client, "q14")
+    parts = {p["p_partkey"]: p for p in t["part"]}
+    promo = total = 0.0
+    for l in t["lineitem"]:
+        if "1995-09-01" <= l["l_shipdate"] < "1995-10-01":
+            rev = l["l_extendedprice"] * (1 - l["l_discount"])
+            total += rev
+            if parts[l["l_partkey"]]["p_type"].startswith("PROMO"):
+                promo += rev
+    expect = 100.0 * promo / total if total else 0.0
+    assert dict(rows)["promo_revenue_pct"] == pytest.approx(expect)
+
+
+def test_q17_small_quantity_revenue(loaded):
+    client, t = loaded
+    part = t["part"][3]
+    rows = tpch.run_query(client, "q17", brand=part["p_brand"],
+                          container=part["p_container"])
+    sel = {p["p_partkey"] for p in t["part"]
+           if p["p_brand"] == part["p_brand"]
+           and p["p_container"] == part["p_container"]}
+    qty = {}
+    for l in t["lineitem"]:
+        if l["l_partkey"] in sel:
+            q = qty.setdefault(l["l_partkey"], [0, 0])
+            q[0] += l["l_quantity"]
+            q[1] += 1
+    oracle = sum(l["l_extendedprice"] / 7.0 for l in t["lineitem"]
+                 if l["l_partkey"] in sel
+                 and l["l_quantity"] < 0.2 * qty[l["l_partkey"]][0]
+                 / qty[l["l_partkey"]][1])
+    got = dict(rows)
+    if oracle:
+        assert got["avg_yearly"] == pytest.approx(oracle)
+    else:
+        assert got.get("avg_yearly", 0) == 0
+
+
+def test_q22_sales_opportunity(loaded):
+    client, t = loaded
+    prefixes = ("13", "31", "23", "29", "30", "18", "17")
+    rows = tpch.run_query(client, "q22", prefixes=prefixes)
+    sel = [c for c in t["customer"] if c["c_phone"][:2] in prefixes]
+    pos = [c["c_acctbal"] for c in sel if c["c_acctbal"] > 0]
+    avg = sum(pos) / len(pos) if pos else 0.0
+    have_orders = {o["o_custkey"] for o in t["orders"]}
+    oracle = {}
+    for c in sel:
+        if c["c_acctbal"] > avg and c["c_custkey"] not in have_orders:
+            o = oracle.setdefault(c["c_phone"][:2], {"n": 0, "bal": 0.0})
+            o["n"] += 1
+            o["bal"] += c["c_acctbal"]
+    got = {k: v for k, v in rows}
+    assert {k: v["n"] for k, v in got.items()} == \
+        {k: v["n"] for k, v in oracle.items()}

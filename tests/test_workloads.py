@@ -1,0 +1,146 @@
+"""Analytics workload tests (reference drivers: TestKMeans, TestGmm,
+TestLDA, TestPageRank, TestTopK) with numeric oracles."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from netsdb_tpu.workloads import (
+    gmm_em, kmeans, kmeans_on_set, lda_em, pagerank, pagerank_on_set,
+    top_k, top_k_on_set,
+)
+
+
+def three_blobs(n_per=50, seed=0):
+    rng = np.random.default_rng(seed)
+    centers = np.array([[0, 0], [10, 10], [-10, 10]], np.float32)
+    pts = np.concatenate([
+        rng.standard_normal((n_per, 2)).astype(np.float32) * 0.5 + c
+        for c in centers
+    ])
+    labels = np.repeat(np.arange(3), n_per)
+    return pts, labels, centers
+
+
+class TestKMeans:
+    def test_recovers_blobs(self):
+        pts, labels, centers = three_blobs()
+        cents, assign = jax.jit(lambda p: kmeans(p, 3, 15))(jnp.asarray(pts))
+        cents = np.asarray(cents)
+        # each true center has a found centroid within 0.5
+        for c in centers:
+            assert np.min(np.linalg.norm(cents - c, axis=1)) < 0.5
+        # cluster purity: same-blob points share an assignment
+        assign = np.asarray(assign)
+        for b in range(3):
+            blob = assign[labels == b]
+            assert (blob == np.bincount(blob).argmax()).mean() == 1.0
+
+    def test_set_driver(self, client):
+        pts, _, _ = three_blobs(n_per=20)
+        client.create_database("ml")
+        client.create_set("ml", "points")
+        client.send_matrix("ml", "points", pts, (16, 2))
+        cents, assign = kmeans_on_set(client, "ml", "points", 3, iters=10)
+        stored = client.get_tensor("ml", "kmeans_centroids")
+        assert stored.shape == (3, 2)
+
+
+class TestGMM:
+    def test_recovers_blobs(self):
+        pts, labels, centers = three_blobs(seed=3)
+        state, resp = jax.jit(lambda p: gmm_em(p, 3, 25))(jnp.asarray(pts))
+        means = np.asarray(state.means)
+        for c in centers:
+            assert np.min(np.linalg.norm(means - c, axis=1)) < 0.5
+        # weights roughly uniform, responsibilities hard on separated blobs
+        np.testing.assert_allclose(np.asarray(state.weights), 1 / 3, atol=0.05)
+        assert np.asarray(resp).max(1).mean() > 0.95
+
+    def test_likelihood_improves(self):
+        from netsdb_tpu.workloads.gmm import gmm_log_likelihood
+
+        pts, _, _ = three_blobs(seed=4)
+        p = jnp.asarray(pts)
+        s1, _ = gmm_em(p, 3, 1)
+        s20, _ = gmm_em(p, 3, 20)
+        assert float(gmm_log_likelihood(p, s20)) >= float(
+            gmm_log_likelihood(p, s1)) - 1e-3
+
+
+class TestLDA:
+    def test_separates_disjoint_topics(self):
+        # two disjoint vocabularies → topics must separate them
+        rng = np.random.default_rng(0)
+        docs_a = rng.poisson(3.0, (20, 5)).astype(np.float32)
+        docs_b = rng.poisson(3.0, (20, 5)).astype(np.float32)
+        counts = np.zeros((40, 10), np.float32)
+        counts[:20, :5] = docs_a
+        counts[20:, 5:] = docs_b
+        state = jax.jit(lambda c: lda_em(c, 2, 60))(jnp.asarray(counts))
+        phi = np.asarray(state.topic_word)
+        # each topic concentrates on one half of the vocabulary
+        mass_first_half = phi[:, :5].sum(1)
+        assert (mass_first_half.max() > 0.95) and (mass_first_half.min() < 0.05)
+        theta = np.asarray(state.doc_topic)
+        a_topic = theta[:20].mean(0).argmax()
+        b_topic = theta[20:].mean(0).argmax()
+        assert a_topic != b_topic
+
+    def test_perplexity_decreases(self):
+        from netsdb_tpu.workloads.lda import lda_perplexity
+
+        rng = np.random.default_rng(1)
+        counts = jnp.asarray(rng.poisson(2.0, (30, 12)).astype(np.float32))
+        p1 = float(lda_perplexity(counts, lda_em(counts, 3, 2)))
+        p50 = float(lda_perplexity(counts, lda_em(counts, 3, 50)))
+        assert p50 <= p1 + 1e-3
+
+
+class TestPageRank:
+    def test_star_graph(self):
+        # all nodes link to node 0 → node 0 must rank highest
+        n = 5
+        src = jnp.asarray([1, 2, 3, 4], jnp.int32)
+        dst = jnp.asarray([0, 0, 0, 0], jnp.int32)
+        ranks = np.asarray(pagerank(src, dst, n, iters=30))
+        assert ranks.argmax() == 0
+        assert ranks[0] > 3 * ranks[1]
+        np.testing.assert_allclose(ranks.sum(), 1.0, atol=1e-3)
+
+    def test_cycle_uniform(self):
+        n = 4
+        src = jnp.asarray([0, 1, 2, 3], jnp.int32)
+        dst = jnp.asarray([1, 2, 3, 0], jnp.int32)
+        ranks = np.asarray(pagerank(src, dst, n, iters=50))
+        np.testing.assert_allclose(ranks, 0.25, atol=1e-4)
+
+    def test_set_driver(self, client):
+        client.create_database("web")
+        client.create_set("web", "links", type_name="object")
+        client.send_data("web", "links", [(1, 0), (2, 0), (0, 1)])
+        ranks = pagerank_on_set(client, "web", "links", 3, iters=20)
+        stored = list(client.get_set_iterator("web", "ranks"))
+        assert len(stored) == 3
+        assert stored[0][1] == pytest.approx(float(ranks[0]))
+        assert ranks.argmax() == 0
+
+
+class TestTopK:
+    def test_topk_values(self):
+        vals, idx = top_k(jnp.asarray([3.0, 9.0, 1.0, 7.0]), 2)
+        np.testing.assert_array_equal(np.asarray(vals), [9.0, 7.0])
+        np.testing.assert_array_equal(np.asarray(idx), [1, 3])
+
+    def test_set_driver_with_score_lambda(self, client):
+        client.create_database("db")
+        client.create_set("db", "emps", type_name="object")
+        client.send_data("db", "emps", [
+            {"name": "a", "salary": 10}, {"name": "b", "salary": 99},
+            {"name": "c", "salary": 50},
+        ])
+        winners = top_k_on_set(client, "db", "emps", 2,
+                               score=lambda e: e["salary"])
+        assert [w["name"] for w in winners] == ["b", "c"]
+        assert len(list(client.get_set_iterator("db", "topk"))) == 2
