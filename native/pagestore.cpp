@@ -1,0 +1,459 @@
+// Host-side page store — the native runtime under the set store.
+//
+// C++ re-design of the reference's Pangea storage core for a
+// single-controller TPU host: one mmap'd pool carved into pages by a
+// free-list bin allocator (reference SharedMem + SlabAllocator/TLSF,
+// src/memory/headers/SharedMem.h, SlabAllocator.h, tlsf.h), a page
+// table with pin/unpin refcounts and per-set eviction policy
+// (reference PDBPage refcounts + PageCache pin/evict protocol,
+// src/storage/headers/PDBPage.h:17-33, PageCache.h:106-118,
+// LocalitySet.h:16-24), per-set spill files with a page index
+// (reference PartitionedFile.h), hit/miss/evict counters (reference
+// CacheStats.h:8-60), and a background flusher thread (reference
+// flush producer/consumer threads, PDBFlushConsumerWork.cc).
+//
+// What is deliberately NOT ported: the frontend/backend fork +
+// shared-memory offset handoff and the socket protocol — JAX is
+// single-process on the host side, so the "backend" is the Python
+// caller holding a raw pointer.
+//
+// C ABI at the bottom; Python binds with ctypes
+// (netsdb_tpu/native/pagestore.py).
+
+#include <algorithm>
+#include <atomic>
+#include <chrono>
+#include <condition_variable>
+#include <cstdint>
+#include <cstdio>
+#include <cstring>
+#include <map>
+#include <mutex>
+#include <random>
+#include <string>
+#include <sys/mman.h>
+#include <thread>
+#include <unordered_map>
+#include <vector>
+
+namespace {
+
+enum EvictPolicy : int32_t { LRU = 0, MRU = 1, RANDOM = 2 };
+
+struct Page {
+  uint64_t id = 0;
+  uint64_t set_id = 0;
+  uint8_t* data = nullptr;  // null => evicted to spill
+  uint64_t size = 0;        // payload bytes
+  uint64_t cap = 0;         // allocated bytes (bin size)
+  std::atomic<int32_t> pins{0};
+  bool dirty = false;
+  bool on_disk = false;
+  uint64_t last_access = 0;
+};
+
+struct SetInfo {
+  uint64_t id;
+  int32_t policy = LRU;
+  std::vector<uint64_t> pages;
+};
+
+struct Stats {
+  std::atomic<uint64_t> hits{0}, misses{0}, evictions{0}, spills{0},
+      loads{0}, bytes_allocated{0}, bytes_in_use{0};
+};
+
+// Address-ordered first-fit allocator with free-block coalescing over
+// one anonymous mmap pool (the classic K&R scheme; plays the role of
+// the reference's SlabAllocator/TLSF). Coalescing matters: after many
+// small pages are evicted, their spans must merge so a larger page can
+// still be allocated — a segregated-bin design without coalescing
+// strands the freed memory in small bins. First-fit is O(#free spans),
+// which at page granularity (dozens of spans) is noise next to the
+// page memcpy itself.
+class Arena {
+ public:
+  explicit Arena(uint64_t pool_bytes) : pool_size_(pool_bytes) {
+    base_ = static_cast<uint8_t*>(mmap(nullptr, pool_bytes,
+                                       PROT_READ | PROT_WRITE,
+                                       MAP_PRIVATE | MAP_ANONYMOUS, -1, 0));
+    ok_ = base_ != MAP_FAILED;
+    if (ok_) free_spans_[0] = pool_size_;  // one span: the whole pool
+  }
+  ~Arena() {
+    if (ok_) munmap(base_, pool_size_);
+  }
+  bool ok() const { return ok_; }
+
+  static uint64_t round_up(uint64_t size) {
+    return (size + kGrain - 1) & ~(kGrain - 1);
+  }
+
+  uint8_t* alloc(uint64_t size, uint64_t* cap_out) {
+    uint64_t cap = round_up(size);
+    std::lock_guard<std::mutex> g(mu_);
+    for (auto it = free_spans_.begin(); it != free_spans_.end(); ++it) {
+      if (it->second >= cap) {
+        uint64_t off = it->first;
+        uint64_t span = it->second;
+        free_spans_.erase(it);
+        if (span > cap) free_spans_[off + cap] = span - cap;
+        *cap_out = cap;
+        return base_ + off;
+      }
+    }
+    return nullptr;
+  }
+
+  void free(uint8_t* p, uint64_t cap) {
+    uint64_t off = static_cast<uint64_t>(p - base_);
+    std::lock_guard<std::mutex> g(mu_);
+    auto it = free_spans_.emplace(off, cap).first;
+    // merge with successor
+    auto next = std::next(it);
+    if (next != free_spans_.end() && it->first + it->second == next->first) {
+      it->second += next->second;
+      free_spans_.erase(next);
+    }
+    // merge with predecessor
+    if (it != free_spans_.begin()) {
+      auto prev = std::prev(it);
+      if (prev->first + prev->second == it->first) {
+        prev->second += it->second;
+        free_spans_.erase(it);
+      }
+    }
+  }
+
+ private:
+  static constexpr uint64_t kGrain = 4096;
+  uint8_t* base_ = nullptr;
+  uint64_t pool_size_;
+  bool ok_ = false;
+  std::mutex mu_;
+  std::map<uint64_t, uint64_t> free_spans_;  // offset → span bytes
+};
+
+class PageStore {
+ public:
+  PageStore(uint64_t pool_bytes, uint64_t evict_watermark, std::string dir,
+            bool background_flush)
+      : arena_(pool_bytes), watermark_(evict_watermark), dir_(std::move(dir)) {
+    if (background_flush) {
+      flusher_ = std::thread([this] { flush_loop(); });
+      has_flusher_ = true;
+    }
+  }
+  ~PageStore() {
+    if (has_flusher_) {
+      {
+        std::lock_guard<std::mutex> g(mu_);
+        stop_ = true;
+      }
+      cv_.notify_all();
+      flusher_.join();
+    }
+    std::lock_guard<std::mutex> g(mu_);
+    for (auto& kv : pages_) delete kv.second;
+  }
+  bool ok() { return arena_.ok(); }
+
+  int create_set(uint64_t set_id, int32_t policy) {
+    std::lock_guard<std::mutex> g(mu_);
+    auto& s = sets_[set_id];
+    s.id = set_id;
+    s.policy = policy;
+    return 0;
+  }
+
+  // Allocate a pinned page; caller writes through ptr then unpins.
+  int64_t alloc_page(uint64_t set_id, uint64_t size) {
+    std::unique_lock<std::mutex> g(mu_);
+    if (sets_.find(set_id) == sets_.end()) return -1;
+    uint64_t cap = 0;
+    uint8_t* buf = arena_.alloc(size, &cap);
+    if (buf == nullptr) {
+      // evict cold pages, then retry once (reference PageCache evicts
+      // under memory pressure before failing the pin)
+      evict_locked(size);
+      buf = arena_.alloc(size, &cap);
+      if (buf == nullptr) return -2;
+    }
+    Page* p = new Page();
+    p->id = next_page_++;
+    p->set_id = set_id;
+    p->data = buf;
+    p->size = size;
+    p->cap = cap;
+    p->pins = 1;
+    p->dirty = true;
+    p->last_access = ++clock_;
+    pages_[p->id] = p;
+    sets_[set_id].pages.push_back(p->id);
+    stats_.bytes_allocated += cap;
+    stats_.bytes_in_use += cap;
+    maybe_wake_flusher();
+    return static_cast<int64_t>(p->id);
+  }
+
+  // Pin: returns payload pointer, transparently reloading from spill.
+  uint8_t* pin(uint64_t page_id, uint64_t* size_out) {
+    std::unique_lock<std::mutex> g(mu_);
+    auto it = pages_.find(page_id);
+    if (it == pages_.end()) return nullptr;
+    Page* p = it->second;
+    if (p->data == nullptr) {
+      stats_.misses++;
+      if (!load_locked(p)) return nullptr;
+      stats_.loads++;
+    } else {
+      stats_.hits++;
+    }
+    p->pins++;
+    p->last_access = ++clock_;
+    *size_out = p->size;
+    return p->data;
+  }
+
+  int unpin(uint64_t page_id, bool dirty) {
+    std::lock_guard<std::mutex> g(mu_);
+    auto it = pages_.find(page_id);
+    if (it == pages_.end()) return -1;
+    Page* p = it->second;
+    if (p->pins <= 0) return -2;
+    p->pins--;
+    if (dirty) {
+      p->dirty = true;
+      p->on_disk = false;
+    }
+    return 0;
+  }
+
+  int free_page(uint64_t page_id) {
+    std::lock_guard<std::mutex> g(mu_);
+    auto it = pages_.find(page_id);
+    if (it == pages_.end()) return -1;
+    Page* p = it->second;
+    if (p->pins > 0) return -2;
+    drop_buffer_locked(p);
+    auto& vec = sets_[p->set_id].pages;
+    vec.erase(std::remove(vec.begin(), vec.end(), page_id), vec.end());
+    delete p;
+    pages_.erase(it);
+    return 0;
+  }
+
+  // Flush every dirty page of a set to its spill file (durable write;
+  // page stays resident — eviction additionally drops the buffer).
+  int flush_set(uint64_t set_id) {
+    std::unique_lock<std::mutex> g(mu_);
+    auto it = sets_.find(set_id);
+    if (it == sets_.end()) return -1;
+    for (uint64_t pid : it->second.pages) {
+      Page* p = pages_[pid];
+      if (p->dirty && p->data != nullptr) {
+        if (!spill_locked(p)) return -2;
+      }
+    }
+    return 0;
+  }
+
+  int64_t set_page_count(uint64_t set_id) {
+    std::lock_guard<std::mutex> g(mu_);
+    auto it = sets_.find(set_id);
+    if (it == sets_.end()) return -1;
+    return static_cast<int64_t>(it->second.pages.size());
+  }
+
+  int64_t set_page_id(uint64_t set_id, uint64_t index) {
+    std::lock_guard<std::mutex> g(mu_);
+    auto it = sets_.find(set_id);
+    if (it == sets_.end() || index >= it->second.pages.size()) return -1;
+    return static_cast<int64_t>(it->second.pages[index]);
+  }
+
+  void get_stats(uint64_t* out) {  // 7 slots
+    out[0] = stats_.hits;
+    out[1] = stats_.misses;
+    out[2] = stats_.evictions;
+    out[3] = stats_.spills;
+    out[4] = stats_.loads;
+    out[5] = stats_.bytes_allocated;
+    out[6] = stats_.bytes_in_use;
+  }
+
+ private:
+  std::string spill_path(const Page* p) {
+    return dir_ + "/set_" + std::to_string(p->set_id) + "_page_" +
+           std::to_string(p->id) + ".pg";
+  }
+
+  bool spill_locked(Page* p) {
+    FILE* f = fopen(spill_path(p).c_str(), "wb");
+    if (!f) return false;
+    bool ok = fwrite(p->data, 1, p->size, f) == p->size;
+    fclose(f);
+    if (ok) {
+      p->dirty = false;
+      p->on_disk = true;
+      stats_.spills++;
+    }
+    return ok;
+  }
+
+  bool load_locked(Page* p) {
+    uint64_t cap = 0;
+    uint8_t* buf = arena_.alloc(p->size, &cap);
+    if (buf == nullptr) {
+      evict_locked(p->size);
+      buf = arena_.alloc(p->size, &cap);
+      if (buf == nullptr) return false;
+    }
+    FILE* f = fopen(spill_path(p).c_str(), "rb");
+    if (!f) {
+      arena_.free(buf, cap);
+      return false;
+    }
+    bool ok = fread(buf, 1, p->size, f) == p->size;
+    fclose(f);
+    if (!ok) {
+      arena_.free(buf, cap);
+      return false;
+    }
+    p->data = buf;
+    p->cap = cap;
+    stats_.bytes_in_use += cap;
+    return true;
+  }
+
+  void drop_buffer_locked(Page* p) {
+    if (p->data != nullptr) {
+      arena_.free(p->data, p->cap);
+      stats_.bytes_in_use -= p->cap;
+      p->data = nullptr;
+    }
+  }
+
+  // Evict unpinned resident pages (policy per owning set) until
+  // `needed` bytes could plausibly be satisfied.
+  void evict_locked(uint64_t needed) {
+    std::vector<Page*> candidates;
+    for (auto& kv : pages_) {
+      Page* p = kv.second;
+      if (p->data != nullptr && p->pins.load() == 0) candidates.push_back(p);
+    }
+    // precompute keys: a comparator drawing fresh randoms per call
+    // violates strict weak ordering (UB in std::sort)
+    std::mt19937 rng(12345);
+    std::vector<std::pair<uint64_t, Page*>> keyed;
+    keyed.reserve(candidates.size());
+    for (Page* p : candidates) {
+      uint64_t key;
+      switch (sets_[p->set_id].policy) {
+        case MRU:
+          key = UINT64_MAX - p->last_access;
+          break;
+        case RANDOM:
+          key = rng();
+          break;
+        default:
+          key = p->last_access;  // LRU
+      }
+      keyed.emplace_back(key, p);
+    }
+    std::sort(keyed.begin(), keyed.end(),
+              [](const auto& a, const auto& b) { return a.first < b.first; });
+    uint64_t freed = 0;
+    for (auto& [key, p] : keyed) {
+      if (freed >= needed) break;
+      if (p->dirty && !spill_locked(p)) continue;
+      freed += p->cap;
+      drop_buffer_locked(p);
+      stats_.evictions++;
+    }
+  }
+
+  void maybe_wake_flusher() {
+    if (has_flusher_ && stats_.bytes_in_use > watermark_) cv_.notify_one();
+  }
+
+  // Background flusher: writes dirty unpinned pages out ahead of
+  // eviction pressure (reference flush consumer threads). Predicate is
+  // stop_ only — waking on "over watermark" would keep the predicate
+  // true after flushing (spilling doesn't shrink bytes_in_use) and spin
+  // with the mutex held, starving every other operation.
+  void flush_loop() {
+    std::unique_lock<std::mutex> g(mu_);
+    while (!stop_) {
+      cv_.wait_for(g, std::chrono::milliseconds(200),
+                   [this] { return stop_; });
+      if (stop_) break;
+      if (stats_.bytes_in_use <= watermark_) continue;
+      for (auto& kv : pages_) {
+        Page* p = kv.second;
+        if (p->dirty && p->data != nullptr && p->pins.load() == 0) {
+          spill_locked(p);
+        }
+      }
+    }
+  }
+
+  Arena arena_;
+  uint64_t watermark_;
+  std::string dir_;
+  std::unordered_map<uint64_t, Page*> pages_;
+  std::map<uint64_t, SetInfo> sets_;
+  uint64_t next_page_ = 1;
+  uint64_t clock_ = 0;
+  Stats stats_;
+  std::mutex mu_;
+  std::condition_variable cv_;
+  std::thread flusher_;
+  bool has_flusher_ = false;
+  bool stop_ = false;
+};
+
+}  // namespace
+
+extern "C" {
+
+void* ps_create(uint64_t pool_bytes, uint64_t evict_watermark,
+                const char* spill_dir, int background_flush) {
+  auto* ps = new PageStore(pool_bytes, evict_watermark, spill_dir,
+                           background_flush != 0);
+  if (!ps->ok()) {
+    delete ps;
+    return nullptr;
+  }
+  return ps;
+}
+void ps_destroy(void* h) { delete static_cast<PageStore*>(h); }
+int ps_create_set(void* h, uint64_t set_id, int32_t policy) {
+  return static_cast<PageStore*>(h)->create_set(set_id, policy);
+}
+int64_t ps_alloc_page(void* h, uint64_t set_id, uint64_t size) {
+  return static_cast<PageStore*>(h)->alloc_page(set_id, size);
+}
+uint8_t* ps_pin(void* h, uint64_t page_id, uint64_t* size_out) {
+  return static_cast<PageStore*>(h)->pin(page_id, size_out);
+}
+int ps_unpin(void* h, uint64_t page_id, int dirty) {
+  return static_cast<PageStore*>(h)->unpin(page_id, dirty != 0);
+}
+int ps_free_page(void* h, uint64_t page_id) {
+  return static_cast<PageStore*>(h)->free_page(page_id);
+}
+int ps_flush_set(void* h, uint64_t set_id) {
+  return static_cast<PageStore*>(h)->flush_set(set_id);
+}
+int64_t ps_set_page_count(void* h, uint64_t set_id) {
+  return static_cast<PageStore*>(h)->set_page_count(set_id);
+}
+int64_t ps_set_page_id(void* h, uint64_t set_id, uint64_t index) {
+  return static_cast<PageStore*>(h)->set_page_id(set_id, index);
+}
+void ps_stats(void* h, uint64_t* out7) {
+  static_cast<PageStore*>(h)->get_stats(out7);
+}
+
+}  // extern "C"

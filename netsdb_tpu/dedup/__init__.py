@@ -1,0 +1,9 @@
+from netsdb_tpu.dedup.detector import (
+    block_fingerprints,
+    dedup_weight_sets,
+    find_shared_blocks,
+    pack_blocks_into_pages,
+)
+
+__all__ = ["block_fingerprints", "find_shared_blocks", "dedup_weight_sets",
+           "pack_blocks_into_pages"]

@@ -1,0 +1,107 @@
+"""Model-weight deduplication — the reference's tensor-dedup subsystem.
+
+The reference shares identical tensor blocks across models at the
+storage level: ``TensorBlockIndex`` maps distinct blocks, private sets
+iterate pages physically owned by a shared set
+(``src/deduplication/headers/TensorBlockIndex.h:36``,
+``SharedTensorBlockSet.h:25``), and offline Python tooling detects
+duplicates (pairwise/LSH, ``model-inference/deduplication/indexing``)
+and packs distinct blocks into pages greedily
+(``model-inference/deduplication/page-packing``).
+
+Here detection fingerprints blocks by content hash — exact for
+bit-identical blocks, optionally on quantized values so near-identical
+fine-tuned weights dedup too — and storage sharing reuses the set
+store's alias mechanism (``SetStore.add_shared_mapping``).
+"""
+
+from __future__ import annotations
+
+import hashlib
+from typing import Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from netsdb_tpu.core.blocked import BlockedTensor
+from netsdb_tpu.storage.store import SetIdentifier
+
+
+def _fingerprint(block: np.ndarray, quantize: Optional[float]) -> str:
+    if quantize:
+        block = np.round(block / quantize).astype(np.int64)
+    return hashlib.sha256(np.ascontiguousarray(block).tobytes()).hexdigest()
+
+
+def block_fingerprints(tensor: BlockedTensor,
+                       quantize: Optional[float] = None) -> Dict[tuple, str]:
+    """{block index: content hash} — the TensorBlockIndex of one tensor."""
+    return {idx: _fingerprint(np.asarray(blk), quantize)
+            for idx, blk in tensor.blocks()}
+
+
+def find_shared_blocks(client, sets: Sequence[Tuple[str, str]],
+                       quantize: Optional[float] = None) -> Dict[str, List[Tuple[str, tuple]]]:
+    """Across the given (db, set) weight sets, group block locations by
+    fingerprint. Returns {hash: [(set_key, block_index), ...]} restricted
+    to hashes appearing in ≥2 locations (the dedup opportunities)."""
+    table: Dict[str, List[Tuple[str, tuple]]] = {}
+    for db, set_name in sets:
+        t = client.get_tensor(db, set_name)
+        for idx, h in block_fingerprints(t, quantize).items():
+            table.setdefault(h, []).append((f"{db}:{set_name}", idx))
+    return {h: locs for h, locs in table.items() if len(locs) > 1}
+
+
+def dedup_weight_sets(client, private_db: str, private_set: str,
+                      shared_db: str, shared_set: str,
+                      quantize: Optional[float] = None) -> Dict:
+    """If two weight sets are fully identical (all blocks match), alias
+    the private set onto the shared one — the addSharedMapping client
+    flow (``src/mainClient/headers/PDBClient.h:113-138``). Returns the
+    block mapping (or partial-overlap report when not fully dedupable)."""
+    a = client.get_tensor(private_db, private_set)
+    b = client.get_tensor(shared_db, shared_set)
+    fa = block_fingerprints(a, quantize)
+    fb = block_fingerprints(b, quantize)
+    matches = {idx: idx for idx in fa if idx in fb and fa[idx] == fb[idx]}
+    report = {"total_blocks": len(fa), "matching_blocks": len(matches),
+              "aliased": False}
+    if len(matches) == len(fa) and a.meta == b.meta:
+        client.add_shared_mapping(private_db, private_set,
+                                  shared_db, shared_set,
+                                  mapping={str(k): str(v)
+                                           for k, v in matches.items()})
+        report["aliased"] = True
+    return report
+
+
+def pack_blocks_into_pages(block_sizes: Dict[str, int], page_size: int,
+                           groups: Optional[List[List[str]]] = None
+                           ) -> List[List[str]]:
+    """Greedy page packing of distinct blocks (reference
+    ``page-packing`` greedy algorithm): blocks that are shared by the
+    same model group are co-located first, then first-fit-decreasing
+    into ``page_size`` bins. Returns pages as lists of block keys."""
+    pages: List[List[str]] = []
+    page_used: List[int] = []
+
+    def fit(keys: List[str]):
+        for k in sorted(keys, key=lambda k: -block_sizes[k]):
+            size = block_sizes[k]
+            if size > page_size:
+                raise ValueError(f"block {k} ({size}) exceeds page size")
+            for i, used in enumerate(page_used):
+                if used + size <= page_size:
+                    pages[i].append(k)
+                    page_used[i] += size
+                    break
+            else:
+                pages.append([k])
+                page_used.append(size)
+
+    seen = set()
+    for group in (groups or []):
+        fit([k for k in group if k in block_sizes and k not in seen])
+        seen.update(group)
+    fit([k for k in block_sizes if k not in seen])
+    return pages

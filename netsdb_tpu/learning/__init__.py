@@ -1,0 +1,4 @@
+from netsdb_tpu.learning.history import HistoryDB, record_job, set_history_db
+from netsdb_tpu.learning.advisor import PlacementAdvisor
+
+__all__ = ["HistoryDB", "record_job", "set_history_db", "PlacementAdvisor"]

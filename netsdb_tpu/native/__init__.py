@@ -1,0 +1,3 @@
+from netsdb_tpu.native.pagestore import NativePageStore, native_available
+
+__all__ = ["NativePageStore", "native_available"]

@@ -1,0 +1,38 @@
+"""Build the native runtime (g++ → shared library), cached by mtime.
+
+Replaces the reference's SCons build of the storage engine
+(``SConstruct``); one translation unit keeps it dependency-free.
+"""
+
+from __future__ import annotations
+
+import os
+import subprocess
+import threading
+
+_lock = threading.Lock()
+
+_SRC = os.path.join(os.path.dirname(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__)))), "native", "pagestore.cpp")
+_OUT_DIR = os.path.join(os.path.dirname(_SRC), "build")
+_OUT = os.path.join(_OUT_DIR, "libpagestore.so")
+
+
+class NativeBuildError(RuntimeError):
+    pass
+
+
+def build_library(force: bool = False) -> str:
+    """Compile if missing or stale; returns the .so path."""
+    with _lock:
+        if (not force and os.path.exists(_OUT)
+                and os.path.getmtime(_OUT) >= os.path.getmtime(_SRC)):
+            return _OUT
+        os.makedirs(_OUT_DIR, exist_ok=True)
+        cmd = ["g++", "-std=c++17", "-O2", "-fPIC", "-shared", "-pthread",
+               _SRC, "-o", _OUT]
+        proc = subprocess.run(cmd, capture_output=True, text=True)
+        if proc.returncode != 0:
+            raise NativeBuildError(
+                f"native build failed:\n{proc.stderr[-2000:]}")
+        return _OUT

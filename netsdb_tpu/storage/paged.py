@@ -1,0 +1,180 @@
+"""Paged tensor streaming — PageCache → pipeline feeding, TPU-shaped.
+
+In the reference, a backend scan pins 64 MB pages one by one and feeds
+them through ``PageCircularBuffer`` to the pipeline threads
+(``src/storage/headers/PageScanner.h``, ``PageCircularBuffer.h``), so a
+set larger than RAM streams from ``PartitionedFile`` through the
+``PageCache``. Here the same role: a large matrix is stored row-block-
+wise as pages in the native C++ page store (``native/pagestore.cpp``) —
+which caches hot pages in its arena and spills cold ones — and is
+streamed block-by-block into device HBM (``jax.device_put`` per chunk),
+so working sets larger than host RAM or HBM flow through without ever
+materializing densely.
+
+Falls back to a pure-Python page dict when the native toolchain is
+unavailable.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Dict, Iterator, Optional, Tuple
+
+import numpy as np
+
+from netsdb_tpu.config import Configuration, DEFAULT_CONFIG
+
+
+class _PyPageBackend:
+    """Fallback backend with the same surface as NativePageStore."""
+
+    def __init__(self):
+        self._pages: Dict[int, bytes] = {}
+        self._sets: Dict[int, list] = {}
+        self._next = 1
+
+    def create_set(self, set_id, policy="lru"):
+        self._sets.setdefault(set_id, [])
+
+    def write_page(self, set_id, payload) -> int:
+        data = payload if isinstance(payload, bytes) else \
+            np.ascontiguousarray(payload).tobytes()
+        pid = self._next
+        self._next += 1
+        self._pages[pid] = data
+        self._sets[set_id].append(pid)
+        return pid
+
+    def read_page(self, page_id) -> bytes:
+        return self._pages[page_id]
+
+    def free_page(self, page_id) -> None:
+        self._pages.pop(page_id, None)
+        for pages in self._sets.values():
+            if page_id in pages:
+                pages.remove(page_id)
+
+    def set_pages(self, set_id):
+        return list(self._sets[set_id])
+
+    def flush_set(self, set_id):
+        pass
+
+    def stats(self):
+        return {"hits": 0, "misses": 0, "evictions": 0, "spills": 0,
+                "loads": 0,
+                "bytes_allocated": sum(len(v) for v in self._pages.values()),
+                "bytes_in_use": sum(len(v) for v in self._pages.values())}
+
+    def close(self):
+        pass
+
+
+class PagedTensorStore:
+    """Row-block paged storage for large matrices."""
+
+    def __init__(self, config: Configuration = DEFAULT_CONFIG,
+                 pool_bytes: Optional[int] = None,
+                 force_python: bool = False):
+        self.config = config
+        config.ensure_dirs()
+        self._meta: Dict[int, Tuple[Tuple[int, int], Tuple[int, int], np.dtype]] = {}
+        self._ids: Dict[str, int] = {}
+        if force_python:
+            self.backend = _PyPageBackend()
+            self.native = False
+        else:
+            try:
+                from netsdb_tpu.native.pagestore import NativePageStore
+
+                self.backend = NativePageStore(
+                    pool_bytes or config.shared_mem_bytes,
+                    os.path.join(config.data_dir, "pages"),
+                )
+                self.native = True
+            except Exception:
+                self.backend = _PyPageBackend()
+                self.native = False
+
+    def _set_id(self, name: str) -> int:
+        if name not in self._ids:
+            self._ids[name] = len(self._ids) + 1
+        return self._ids[name]
+
+    def put(self, name: str, dense: np.ndarray,
+            row_block: Optional[int] = None) -> None:
+        """Page a matrix in as contiguous row-blocks."""
+        dense = np.ascontiguousarray(dense)
+        if dense.ndim != 2:
+            raise ValueError(f"paged store holds matrices; got rank-{dense.ndim} "
+                             f"array of shape {dense.shape}")
+        rows, cols = dense.shape
+        row_block = row_block or max(
+            1, self.config.page_size_bytes // max(dense.dtype.itemsize * cols, 1))
+        replacing = name in self._ids
+        sid = self._set_id(name)
+        self.backend.create_set(sid)
+        if replacing:  # drop the old pages, else reads mix stale data
+            for pid in self.backend.set_pages(sid):
+                self.backend.free_page(pid)
+        for r0 in range(0, rows, row_block):
+            self.backend.write_page(sid, dense[r0:r0 + row_block])
+        self._meta[sid] = ((rows, cols), (row_block, cols), dense.dtype)
+
+    def stream_blocks(self, name: str) -> Iterator[Tuple[int, np.ndarray]]:
+        """Yield (start_row, block) in order — the PageScanner loop."""
+        sid = self._ids[name]
+        (rows, cols), (rb, _), dtype = self._meta[sid]
+        r0 = 0
+        for pid in self.backend.set_pages(sid):
+            raw = self.backend.read_page(pid)
+            n = min(rb, rows - r0)
+            yield r0, np.frombuffer(raw, dtype=dtype).reshape(n, cols)
+            r0 += n
+
+    def to_device_blocked(self, name: str, block_shape=None):
+        """Stream into HBM chunk-by-chunk and assemble a BlockedTensor —
+        the dense array never exists on host."""
+        import jax
+        import jax.numpy as jnp
+
+        from netsdb_tpu.core.blocked import BlockMeta, BlockedTensor
+
+        sid = self._ids[name]
+        (rows, cols), _, dtype = self._meta[sid]
+        block_shape = block_shape or self.config.default_block_shape
+        meta = BlockMeta((rows, cols), tuple(block_shape))
+        chunks = []
+        for r0, block in self.stream_blocks(name):
+            chunks.append(jax.device_put(block))
+        data = jnp.concatenate(chunks, axis=0)
+        pad = [(0, p - s) for s, p in zip((rows, cols), meta.padded_shape)]
+        if any(p for _, p in pad):
+            data = jnp.pad(data, pad)
+        return BlockedTensor(data, meta)
+
+    def matmul_streamed(self, name: str, rhs: np.ndarray) -> np.ndarray:
+        """out = M @ rhs with M streamed page-by-page through the device —
+        the larger-than-HBM compute pattern (reference: pipelines over
+        pinned pages). Only one page + rhs live on device at a time."""
+        import jax
+        import jax.numpy as jnp
+
+        rhs_dev = jax.device_put(rhs)
+
+        @jax.jit
+        def block_mm(a, b):
+            return jax.lax.dot_general(a, b, (((1,), (0,)), ((), ())),
+                                       precision=jax.lax.Precision.HIGHEST,
+                                       preferred_element_type=jnp.float32)
+
+        outs = []
+        for _, block in self.stream_blocks(name):
+            outs.append(np.asarray(block_mm(jax.device_put(block), rhs_dev)))
+        return np.concatenate(outs, axis=0)
+
+    def stats(self) -> dict:
+        return self.backend.stats()
+
+    def close(self):
+        self.backend.close()

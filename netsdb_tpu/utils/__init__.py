@@ -1,0 +1,3 @@
+from netsdb_tpu.utils.profiling import StageTimer, profile_trace, get_logger
+
+__all__ = ["StageTimer", "profile_trace", "get_logger"]

@@ -1,0 +1,74 @@
+"""Tracing / profiling / logging — the reference's observability kit.
+
+Reference: ``-DPROFILING`` wall-clock spans around planning and each
+pipeline phase (``QuerySchedulerServer.cc:1336-1341``,
+``PipelineStage.cc:1084-1101``), ``CacheStats`` counters, and the
+pthread-safe ``PDBLogger`` file logger (``src/pdbServer/headers/
+PDBLogger.h``). Here: a StageTimer span collector (always on — spans
+are cheap), a ``jax.profiler`` trace context for real device profiles,
+and stdlib logging configured PDBLogger-style.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import logging
+import time
+from collections import defaultdict
+from typing import Dict, Iterator, List, Optional
+
+
+class StageTimer:
+    """Named wall-clock spans with summary stats (the -DPROFILING spans,
+    queryable instead of printed)."""
+
+    def __init__(self):
+        self.spans: Dict[str, List[float]] = defaultdict(list)
+
+    @contextlib.contextmanager
+    def span(self, name: str) -> Iterator[None]:
+        t0 = time.perf_counter()
+        try:
+            yield
+        finally:
+            self.spans[name].append(time.perf_counter() - t0)
+
+    def summary(self) -> Dict[str, Dict[str, float]]:
+        out = {}
+        for name, times in self.spans.items():
+            out[name] = {"count": len(times), "total_s": sum(times),
+                         "mean_s": sum(times) / len(times),
+                         "max_s": max(times)}
+        return out
+
+    def reset(self) -> None:
+        self.spans.clear()
+
+
+# process-global timer used by the executor
+GLOBAL_TIMER = StageTimer()
+
+
+@contextlib.contextmanager
+def profile_trace(log_dir: str) -> Iterator[None]:
+    """Capture a device profile viewable in TensorBoard/XProf — the
+    capability the reference approximates with printf spans."""
+    import jax
+
+    with jax.profiler.trace(log_dir):
+        yield
+
+
+def get_logger(name: str = "netsdb_tpu", level: Optional[str] = None,
+               log_file: Optional[str] = None) -> logging.Logger:
+    """PDBLogger equivalent: per-component, optionally file-backed."""
+    logger = logging.getLogger(name)
+    if not logger.handlers:
+        handler = (logging.FileHandler(log_file) if log_file
+                   else logging.StreamHandler())
+        handler.setFormatter(logging.Formatter(
+            "%(asctime)s %(name)s %(levelname)s %(message)s"))
+        logger.addHandler(handler)
+    if level:
+        logger.setLevel(level)
+    return logger
