@@ -1,0 +1,141 @@
+"""Operator CLI — the reference's binaries + scripts layer, collapsed.
+
+netsDB ships ``pdb-cluster``/``pdb-server`` binaries and a zoo of launch
+scripts (``src/mainServer``, ``scripts/startMaster.sh``,
+``startWorkers.sh``, ``startPseudoCluster.py`` — SURVEY layer 17).
+Single-controller JAX needs no resident servers, so the operator surface
+is one CLI:
+
+    python -m netsdb_tpu info                 # cluster/devices (ResourceManager)
+    python -m netsdb_tpu bench                # the benchmark harness
+    python -m netsdb_tpu pdml PROG.pdml       # run a LA DSL program
+    python -m netsdb_tpu demo-ff [...]        # FFTest.cc equivalent
+    python -m netsdb_tpu tpch [--query q01]   # TPC-H demo queries
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+import time
+
+
+def _cmd_info(args) -> int:
+    import jax
+
+    from netsdb_tpu.parallel.distributed import cluster_info
+
+    info = cluster_info()
+    info["backend"] = jax.default_backend()
+    print(json.dumps(info, indent=2))
+    return 0
+
+
+def _cmd_bench(args) -> int:
+    import os
+
+    root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    if root not in sys.path:
+        sys.path.insert(0, root)
+    import bench
+
+    bench.main()
+    return 0
+
+
+def _cmd_pdml(args) -> int:
+    from netsdb_tpu.dsl import run_pdml
+
+    with open(args.file) as f:
+        text = f.read()
+    env = run_pdml(text)
+    for name, tensor in env.items():
+        print(f"{name}: shape={tensor.shape} block={tensor.meta.block_shape}")
+        if args.print_values:
+            import numpy as np
+
+            print(np.asarray(tensor.to_dense()))
+    return 0
+
+
+def _cmd_demo_ff(args) -> int:
+    import numpy as np
+
+    from netsdb_tpu.client import Client
+    from netsdb_tpu.config import Configuration
+    from netsdb_tpu.models.ff import FFModel
+
+    client = Client(Configuration())
+    block = (args.block, args.block)
+    model = FFModel(block=block)
+    model.setup(client)
+    model.load_random_weights(client, args.features, args.hidden, args.labels)
+    rng = np.random.default_rng(0)
+    x = rng.standard_normal((args.batch, args.features)).astype(np.float32)
+    model.load_inputs(client, x)
+    t0 = time.perf_counter()
+    out = model.inference(client)
+    probs = np.asarray(out.to_dense())
+    dt = time.perf_counter() - t0
+    print(json.dumps({
+        "batch": args.batch, "features": args.features,
+        "hidden": args.hidden, "labels": args.labels,
+        "output_shape": list(probs.shape),
+        "cols_sum_to_one": bool(np.allclose(probs.sum(0), 1.0, atol=1e-3)),
+        "elapsed_s": round(dt, 4),
+    }))
+    return 0
+
+
+def _cmd_tpch(args) -> int:
+    from netsdb_tpu.client import Client
+    from netsdb_tpu.config import Configuration
+    from netsdb_tpu.workloads import tpch
+
+    client = Client(Configuration())
+    tpch.load_tables(client, scale=args.scale)
+    queries = [args.query] if args.query else list(tpch.QUERIES)
+    for q in queries:
+        t0 = time.perf_counter()
+        rows = tpch.run_query(client, q)
+        dt = time.perf_counter() - t0
+        n = len(rows) if hasattr(rows, "__len__") else 1
+        print(f"{q}: {n} rows in {dt*1e3:.1f} ms")
+        if args.print_values:
+            print(rows)
+    return 0
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser(prog="netsdb_tpu")
+    sub = parser.add_subparsers(dest="cmd", required=True)
+
+    sub.add_parser("info", help="cluster and device info")
+    sub.add_parser("bench", help="run the benchmark harness")
+
+    p = sub.add_parser("pdml", help="run a PDML linear-algebra program")
+    p.add_argument("file")
+    p.add_argument("--print-values", action="store_true")
+
+    p = sub.add_parser("demo-ff", help="FF inference demo (FFTest shape)")
+    p.add_argument("--batch", type=int, default=1000)
+    p.add_argument("--features", type=int, default=512)
+    p.add_argument("--hidden", type=int, default=1024)
+    p.add_argument("--labels", type=int, default=10)
+    p.add_argument("--block", type=int, default=256)
+
+    p = sub.add_parser("tpch", help="run TPC-H demo queries")
+    p.add_argument("--query", default=None,
+                   choices=["q01", "q02", "q03", "q04", "q06", "q12", "q13",
+                            "q14", "q17", "q22"])
+    p.add_argument("--scale", type=int, default=1)
+    p.add_argument("--print-values", action="store_true")
+
+    args = parser.parse_args(argv)
+    return {"info": _cmd_info, "bench": _cmd_bench, "pdml": _cmd_pdml,
+            "demo-ff": _cmd_demo_ff, "tpch": _cmd_tpch}[args.cmd](args)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -7,9 +7,10 @@ from netsdb_tpu.models.ff import FFModel
 from netsdb_tpu.models.logreg import LogRegModel
 from netsdb_tpu.models.lstm_model import LSTMModel
 from netsdb_tpu.models.text_classifier import TextClassifierModel
+from netsdb_tpu.models.transformer import TransformerLayerModel
 from netsdb_tpu.models.word2vec import Word2VecModel
 
 __all__ = [
     "Conv2DModel", "FFModel", "LogRegModel", "LSTMModel",
-    "TextClassifierModel", "Word2VecModel",
+    "TextClassifierModel", "TransformerLayerModel", "Word2VecModel",
 ]

@@ -1,0 +1,111 @@
+"""Transformer layer serving — the long-context flagship.
+
+No reference analogue exists (netsDB predates attention, SURVEY §5);
+this model completes the framework's long-context story: a transformer
+block whose weights live in database sets like every other model's, a
+single-chip forward, and a sequence-parallel forward where activations
+are sharded on the sequence axis and attention runs as ring attention
+over the mesh (``netsdb_tpu.parallel.ring``) — the capability that
+subsumes the reference's "scale the big dimension" relational SUMMA.
+
+Layer = pre-LN MHA + residual, pre-LN MLP (gelu) + residual.
+x: (batch, seq, embed).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from netsdb_tpu.client import Client
+from netsdb_tpu.ops.attention import mha_forward
+from netsdb_tpu.parallel.ring import ring_attention
+
+_HI = jax.lax.Precision.HIGHEST
+
+
+@jax.tree_util.register_dataclass
+@dataclasses.dataclass
+class TransformerLayerParams:
+    w_qkv: jax.Array   # (E, 3E)
+    w_out: jax.Array   # (E, E)
+    w_up: jax.Array    # (E, 4E)
+    w_down: jax.Array  # (4E, E)
+
+
+class TransformerLayerModel:
+    SETS = ("w_qkv", "w_out", "w_up", "w_down")
+
+    def __init__(self, db: str = "transformer", num_heads: int = 8):
+        self.db = db
+        self.num_heads = num_heads
+
+    def setup(self, client: Client) -> None:
+        client.create_database(self.db)
+        for s in self.SETS:
+            client.create_set(self.db, s)
+
+    def load_random_weights(self, client: Client, embed: int,
+                            seed: int = 0) -> None:
+        rng = np.random.default_rng(seed)
+        scale = embed ** -0.5
+        for name, shape in (("w_qkv", (embed, 3 * embed)),
+                            ("w_out", (embed, embed)),
+                            ("w_up", (embed, 4 * embed)),
+                            ("w_down", (4 * embed, embed))):
+            client.send_matrix(self.db, name,
+                               rng.standard_normal(shape).astype(np.float32)
+                               * scale, (min(512, shape[0]), min(512, shape[1])))
+
+    def params_from_store(self, client: Client) -> TransformerLayerParams:
+        g = lambda n: client.get_tensor(self.db, n).to_dense()
+        return TransformerLayerParams(w_qkv=g("w_qkv"), w_out=g("w_out"),
+                                      w_up=g("w_up"), w_down=g("w_down"))
+
+    # --- math ---------------------------------------------------------
+    @staticmethod
+    def _ln(x):
+        mu = x.mean(-1, keepdims=True)
+        var = x.var(-1, keepdims=True)
+        return (x - mu) * jax.lax.rsqrt(var + 1e-5)
+
+    def _mlp(self, x, p: TransformerLayerParams):
+        h = jax.nn.gelu(jnp.einsum("bse,ef->bsf", x, p.w_up, precision=_HI))
+        return jnp.einsum("bsf,fe->bse", h, p.w_down, precision=_HI)
+
+    def forward(self, p: TransformerLayerParams, x: jax.Array,
+                causal: bool = True) -> jax.Array:
+        """Single-chip forward."""
+        a = mha_forward(self._ln(x), p.w_qkv, p.w_out, self.num_heads,
+                        causal=causal)
+        x = x + a
+        return x + self._mlp(self._ln(x), p)
+
+    def forward_sp(self, p: TransformerLayerParams, x: jax.Array, mesh: Mesh,
+                   axis: str = "data", causal: bool = True) -> jax.Array:
+        """Sequence-parallel forward: x sharded (None, axis, None). The
+        projections/MLP are per-position (XLA keeps them local); the
+        attention core rotates k/v around the ring."""
+        from netsdb_tpu.ops.attention import merge_project, qkv_project
+
+        q, k, v = qkv_project(self._ln(x), p.w_qkv, self.num_heads)
+        spec = NamedSharding(mesh, P(None, None, axis, None))
+        q, k, v = (jax.lax.with_sharding_constraint(t, spec)
+                   for t in (q, k, v))
+        out = ring_attention(q, k, v, mesh, axis=axis, causal=causal)
+        x = x + merge_project(out, p.w_out)
+        return x + self._mlp(self._ln(x), p)
+
+    def loss(self, p: TransformerLayerParams, x: jax.Array,
+             targets: jax.Array) -> jax.Array:
+        """Simple next-step regression loss for the training dry-run."""
+        out = self.forward(p, x)
+        return jnp.mean((out - targets) ** 2)
+
+    def train_step(self, p, x, targets, lr: float = 1e-2):
+        l, g = jax.value_and_grad(self.loss)(p, x, targets)
+        return jax.tree_util.tree_map(lambda a, b: a - lr * b, p, g), l

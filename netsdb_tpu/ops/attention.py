@@ -1,0 +1,125 @@
+"""Attention ops — the long-context compute core.
+
+The reference has no attention anywhere (SURVEY §5: no sequence
+dimension exists in netsDB), but this framework treats long-context as
+first-class: serving modern models through the same set/computation API
+requires attention plus sequence parallelism. This module provides the
+single-device formulations; :mod:`netsdb_tpu.parallel.ring` distributes
+them over the mesh.
+
+Layouts: q/k/v are (batch, heads, seq, head_dim) — B H S D.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+NEG_INF = -1e30
+
+
+def attention(q: jax.Array, k: jax.Array, v: jax.Array,
+              causal: bool = True,
+              scale: Optional[float] = None) -> jax.Array:
+    """Plain softmax attention (the reference formulation everything
+    else must match numerically)."""
+    d = q.shape[-1]
+    scale = scale if scale is not None else d ** -0.5
+    logits = jnp.einsum("bhqd,bhkd->bhqk", q, k,
+                        precision=jax.lax.Precision.HIGHEST) * scale
+    if causal:
+        s_q, s_k = logits.shape[-2], logits.shape[-1]
+        mask = jnp.tril(jnp.ones((s_q, s_k), jnp.bool_), k=s_k - s_q)
+        logits = jnp.where(mask, logits, NEG_INF)
+    probs = jax.nn.softmax(logits, axis=-1)
+    return jnp.einsum("bhqk,bhkd->bhqd", probs, v,
+                      precision=jax.lax.Precision.HIGHEST)
+
+
+def _block_attn(q, k, v, carry_num, carry_den, carry_max, mask):
+    """One online-softmax accumulation step (the flash-attention update
+    rule): combine the running (num, den, max) with a new k/v block."""
+    scale_logits = jnp.einsum("bhqd,bhkd->bhqk", q, k,
+                              precision=jax.lax.Precision.HIGHEST)
+    scale_logits = jnp.where(mask, scale_logits, NEG_INF)
+    block_max = jnp.max(scale_logits, axis=-1, keepdims=True)
+    new_max = jnp.maximum(carry_max, block_max)
+    correction = jnp.exp(carry_max - new_max)
+    p = jnp.exp(scale_logits - new_max)
+    new_den = carry_den * correction + p.sum(-1, keepdims=True)
+    new_num = carry_num * correction + jnp.einsum(
+        "bhqk,bhkd->bhqd", p, v, precision=jax.lax.Precision.HIGHEST)
+    return new_num, new_den, new_max
+
+
+def blockwise_attention(q: jax.Array, k: jax.Array, v: jax.Array,
+                        block_size: int, causal: bool = True,
+                        scale: Optional[float] = None) -> jax.Array:
+    """Attention with k/v processed in blocks via online softmax —
+    O(block) memory in the sequence dim, the single-device form of ring
+    attention. Numerically identical to :func:`attention`."""
+    b, h, s, d = q.shape
+    if s % block_size != 0:
+        raise ValueError(f"seq {s} not divisible by block {block_size}")
+    scale = scale if scale is not None else d ** -0.5
+    q = q * scale
+    n_blocks = s // block_size
+    kb = k.reshape(b, h, n_blocks, block_size, d)
+    vb = v.reshape(b, h, n_blocks, block_size, d)
+    q_pos = jnp.arange(s)[:, None]
+
+    def body(i, carry):
+        num, den, mx = carry
+        k_i = kb[:, :, i]
+        v_i = vb[:, :, i]
+        if causal:
+            k_pos = i * block_size + jnp.arange(block_size)[None, :]
+            mask = q_pos >= k_pos
+        else:
+            mask = jnp.ones((s, block_size), jnp.bool_)
+        return _block_attn(q, k_i, v_i, num, den, mx, mask)
+
+    num0 = jnp.zeros_like(q)
+    den0 = jnp.zeros((b, h, s, 1), q.dtype)
+    max0 = jnp.full((b, h, s, 1), NEG_INF, q.dtype)
+    num, den, _ = jax.lax.fori_loop(0, n_blocks, body, (num0, den0, max0))
+    return num / jnp.maximum(den, 1e-30)
+
+
+def qkv_project(x: jax.Array, w_qkv: jax.Array, num_heads: int):
+    """x (B,S,E) → q/k/v (B,H,S,D) — shared by local and
+    sequence-parallel layers."""
+    b, s, e = x.shape
+    d = e // num_heads
+    qkv = jnp.einsum("bse,ef->bsf", x, w_qkv,
+                     precision=jax.lax.Precision.HIGHEST)
+    q, k, v = jnp.split(qkv, 3, axis=-1)
+
+    def heads(t):
+        return t.reshape(b, s, num_heads, d).transpose(0, 2, 1, 3)
+
+    return heads(q), heads(k), heads(v)
+
+
+def merge_project(out: jax.Array, w_out: jax.Array) -> jax.Array:
+    """(B,H,S,D) attention output → (B,S,E) through the out projection."""
+    b, h, s, d = out.shape
+    out = out.transpose(0, 2, 1, 3).reshape(b, s, h * d)
+    return jnp.einsum("bse,ef->bsf", out, w_out,
+                      precision=jax.lax.Precision.HIGHEST)
+
+
+def mha_forward(x: jax.Array, w_qkv: jax.Array, w_out: jax.Array,
+                num_heads: int, causal: bool = True,
+                block_size: Optional[int] = None) -> jax.Array:
+    """Full multi-head attention layer: x (B, S, E), w_qkv (E, 3E),
+    w_out (E, E) — the flagship long-context layer the parallel plans
+    shard."""
+    q, k, v = qkv_project(x, w_qkv, num_heads)
+    if block_size:
+        out = blockwise_attention(q, k, v, block_size, causal)
+    else:
+        out = attention(q, k, v, causal)
+    return merge_project(out, w_out)

@@ -1,8 +1,25 @@
+from netsdb_tpu.parallel.collectives import (
+    all_to_all_resharding,
+    matmul_allgather,
+    matmul_psum,
+    matmul_psum_scatter,
+)
+from netsdb_tpu.parallel.distributed import (
+    cluster_info,
+    hybrid_mesh,
+    initialize_cluster,
+)
 from netsdb_tpu.parallel.mesh import (
     default_mesh,
     make_mesh,
-    shard_blocked,
     replicate,
+    shard_blocked,
 )
+from netsdb_tpu.parallel.ring import ring_attention, ulysses_attention
 
-__all__ = ["default_mesh", "make_mesh", "shard_blocked", "replicate"]
+__all__ = [
+    "default_mesh", "make_mesh", "shard_blocked", "replicate",
+    "matmul_psum", "matmul_psum_scatter", "matmul_allgather",
+    "all_to_all_resharding", "ring_attention", "ulysses_attention",
+    "initialize_cluster", "hybrid_mesh", "cluster_info",
+]

@@ -1,0 +1,99 @@
+"""Explicit collective matmuls under shard_map — the shuffle, spelled out.
+
+``pjit`` + ``NamedSharding`` lets XLA choose collectives automatically
+(:mod:`netsdb_tpu.parallel.mesh`); this module is the explicit form for
+when the schedule matters, mirroring the reference's hand-built data
+movement 1:1 (SURVEY §2.6):
+
+- reference hash-repartition shuffle + combiners
+  (``PipelineStage.cc:1215-1516``) → ``matmul_psum`` /
+  ``matmul_psum_scatter`` (contraction-sharded partial products reduced
+  over ICI);
+- reference broadcast join (``PipelineStage.cc:1518-1650``) →
+  ``matmul_allgather`` (gather the small side, compute locally).
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, PartitionSpec as P
+
+_HI = jax.lax.Precision.HIGHEST
+
+
+def _dot(a, b):
+    return jax.lax.dot_general(a, b, (((1,), (0,)), ((), ())),
+                               precision=_HI,
+                               preferred_element_type=jnp.float32)
+
+
+def matmul_psum(a: jax.Array, b: jax.Array, mesh: Mesh,
+                axis: str = "model") -> jax.Array:
+    """C = A·B with the CONTRACTION dim sharded: each device multiplies
+    its k-slice, then one psum combines partial products — exactly the
+    reference's join-on-block-index + FFAggMatrix shuffle, as one ICI
+    all-reduce. Output replicated."""
+
+    def local(a_blk, b_blk):
+        return jax.lax.psum(_dot(a_blk, b_blk), axis)
+
+    fn = jax.shard_map(local, mesh=mesh,
+                       in_specs=(P(None, axis), P(axis, None)),
+                       out_specs=P(None, None))
+    return fn(a, b)
+
+
+def matmul_psum_scatter(a: jax.Array, b: jax.Array, mesh: Mesh,
+                        axis: str = "model") -> jax.Array:
+    """Same contraction sharding, but the reduction scatters: each device
+    keeps one row-shard of C (reduce_scatter ≈ the reference's
+    per-destination-node combiner threads, which shipped each partition
+    to its owner instead of replicating)."""
+
+    def local(a_blk, b_blk):
+        part = _dot(a_blk, b_blk)
+        return jax.lax.psum_scatter(part, axis, scatter_dimension=0,
+                                    tiled=True)
+
+    fn = jax.shard_map(local, mesh=mesh,
+                       in_specs=(P(None, axis), P(axis, None)),
+                       out_specs=P(axis, None))
+    return fn(a, b)
+
+
+def matmul_allgather(a: jax.Array, b: jax.Array, mesh: Mesh,
+                     axis: str = "model") -> jax.Array:
+    """C = A·B with A row-sharded and B small: all-gather B (the
+    broadcast join's replicated hash table), multiply locally, keep the
+    row shard. One all-gather of the small side, no reduction."""
+
+    def local(a_blk, b_blk):
+        b_full = jax.lax.all_gather(b_blk, axis, axis=0, tiled=True)
+        return _dot(a_blk, b_full)
+
+    fn = jax.shard_map(local, mesh=mesh,
+                       in_specs=(P(axis, None), P(axis, None)),
+                       out_specs=P(axis, None))
+    return fn(a, b)
+
+
+def all_to_all_resharding(x: jax.Array, mesh: Mesh, axis: str,
+                          from_dim: int, to_dim: int) -> jax.Array:
+    """Re-shard an array from one dim to another with a single
+    all-to-all — the primitive under Ulysses sequence parallelism and
+    the analogue of the reference's full-shuffle repartition."""
+
+    def local(blk):
+        return jax.lax.all_to_all(blk, axis, split_axis=to_dim,
+                                  concat_axis=from_dim, tiled=True)
+
+    in_spec = [None] * x.ndim
+    in_spec[from_dim] = axis
+    out_spec = [None] * x.ndim
+    out_spec[to_dim] = axis
+    fn = jax.shard_map(local, mesh=mesh, in_specs=(P(*in_spec),),
+                       out_specs=P(*out_spec))
+    return fn(x)

@@ -1,0 +1,87 @@
+"""Multi-host runtime — the master/worker cluster layer, TPU-native.
+
+The reference runs a hand-rolled cluster: ``conf/serverlist``, ssh'd
+worker launches, TCP control RPC, static membership (SURVEY §3.1, §5 —
+no failure handling). On TPU pods the control plane is JAX's
+single-controller runtime: ``jax.distributed.initialize`` connects the
+per-host processes, devices form one global mesh, and XLA routes
+collectives over ICI within a slice and DCN across slices. This module
+is the thin layer that replaces ``startMaster.sh``/``startWorkers.sh``:
+
+- :func:`initialize_cluster` — per-host bring-up (coordinator address ≈
+  the master line of ``conf/serverlist``);
+- :func:`hybrid_mesh` — (dcn, ici) two-level mesh so cross-host axes
+  only carry DCN-friendly traffic (data parallelism outer, model/
+  sequence parallelism inner);
+- :func:`cluster_info` — the ResourceManager's getAllResources
+  equivalent.
+
+Single-process multi-device (the CI/virtual-device case) skips
+initialize and still produces correct meshes, mirroring the
+pseudo-cluster fixture.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Dict, Optional, Sequence, Tuple
+
+import jax
+import numpy as np
+from jax.sharding import Mesh
+
+
+def initialize_cluster(coordinator_address: Optional[str] = None,
+                       num_processes: Optional[int] = None,
+                       process_id: Optional[int] = None) -> bool:
+    """Connect this host into the cluster. No-ops (returns False) when
+    single-process. Args fall back to the standard env vars, so launch
+    scripts stay trivial (the startWorkers.sh role)."""
+    coordinator_address = coordinator_address or os.environ.get(
+        "NETSDB_TPU_COORDINATOR")
+    if coordinator_address is None and num_processes is None:
+        return False  # single-controller, single-host
+    jax.distributed.initialize(
+        coordinator_address=coordinator_address,
+        num_processes=num_processes,
+        process_id=process_id,
+    )
+    return True
+
+
+def hybrid_mesh(ici_shape: Sequence[int],
+                ici_axes: Sequence[str] = ("data", "model"),
+                dcn_axis: str = "hosts") -> Mesh:
+    """Mesh with the slowest (DCN) dimension outermost: hosts × ici.
+    Shard batch over ``hosts`` (pure data parallelism — one gradient
+    all-reduce over DCN per step) and tensors over the ici axes."""
+    n_hosts = jax.process_count()
+    if n_hosts > 1:
+        # never fall back silently here: a hosts=1 mesh over global
+        # devices would route model/sequence collectives over DCN
+        from jax.experimental import mesh_utils
+
+        devs = mesh_utils.create_hybrid_device_mesh(
+            mesh_shape=tuple(ici_shape),
+            dcn_mesh_shape=(n_hosts,) + (1,) * (len(ici_shape) - 1),
+        )
+        return Mesh(devs.reshape((n_hosts,) + tuple(ici_shape)),
+                    (dcn_axis,) + tuple(ici_axes))
+    devices = jax.devices()
+    total = int(np.prod(ici_shape))
+    if total != len(devices):
+        raise ValueError(f"ici shape {ici_shape} != {len(devices)} devices")
+    arr = np.asarray(devices).reshape((1,) + tuple(ici_shape))
+    return Mesh(arr, (dcn_axis,) + tuple(ici_axes))
+
+
+def cluster_info() -> Dict:
+    """getAllResources equivalent (reference
+    ``ResourceManagerServer.h:16-33``)."""
+    return {
+        "process_index": jax.process_index(),
+        "process_count": jax.process_count(),
+        "local_devices": [str(d) for d in jax.local_devices()],
+        "global_device_count": jax.device_count(),
+        "device_kind": jax.devices()[0].device_kind if jax.devices() else None,
+    }

@@ -1,0 +1,114 @@
+"""Sequence/context parallelism: ring attention + Ulysses all-to-all.
+
+The reference's only "scale the big dimension" mechanism is its
+relational SUMMA shuffle (SURVEY §2.6/§5); the TPU framework makes long
+sequences first-class with the two standard schemes:
+
+- **Ring attention** (`ring_attention`): q/k/v sharded on the sequence
+  axis; k/v blocks rotate around the mesh ring with ``ppermute`` while
+  each device accumulates its queries' online-softmax state — ICI
+  transfers overlap compute, sequence length scales with the number of
+  devices. Causal masking uses global block offsets.
+- **Ulysses / all-to-all** (`ulysses_attention`): ``all_to_all``
+  re-shards from sequence-parallel to head-parallel, runs full local
+  attention per head group, and re-shards back — two collectives,
+  no ring.
+
+Both run under ``shard_map`` over a named mesh axis and are validated
+against single-device attention on the virtual CPU mesh.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, PartitionSpec as P
+
+from netsdb_tpu.ops.attention import NEG_INF, _block_attn, attention
+
+
+def _ring_attention_local(q, k, v, axis_name: str, causal: bool,
+                          scale: float):
+    """Per-device body: rotate k/v around the ring, fold each arriving
+    block into the online-softmax accumulator."""
+    n_dev = jax.lax.psum(1, axis_name)
+    my_idx = jax.lax.axis_index(axis_name)
+    b, h, s_local, d = q.shape
+    q = q * scale
+
+    q_pos = (my_idx * s_local + jnp.arange(s_local))[:, None]
+
+    def step(i, carry):
+        num, den, mx, k_cur, v_cur = carry
+        # rotation sends j→j+1, so after i steps device m holds the block
+        # that ORIGINATED at device (m - i) % n
+        src = (my_idx - i) % n_dev
+        k_pos = (src * s_local + jnp.arange(s_local))[None, :]
+        mask = (q_pos >= k_pos) if causal else jnp.ones(
+            (s_local, s_local), jnp.bool_)
+        num, den, mx = _block_attn(q, k_cur, v_cur, num, den, mx, mask)
+        # rotate: pass k/v to the next device in the ring
+        perm = [(j, (j + 1) % n_dev) for j in range(n_dev)]
+        k_nxt = jax.lax.ppermute(k_cur, axis_name, perm)
+        v_nxt = jax.lax.ppermute(v_cur, axis_name, perm)
+        return num, den, mx, k_nxt, v_nxt
+
+    # derive initial carries from q so they inherit its varying manual
+    # axis (a plain zeros() is axis-invariant and fails scan's carry check)
+    num0 = jnp.zeros_like(q)
+    den0 = jnp.zeros_like(q[..., :1])
+    max0 = jnp.full_like(q[..., :1], NEG_INF)
+    num, den, _, _, _ = jax.lax.fori_loop(
+        0, n_dev, step, (num0, den0, max0, k, v))
+    return num / jnp.maximum(den, 1e-30)
+
+
+def ring_attention(q: jax.Array, k: jax.Array, v: jax.Array, mesh: Mesh,
+                   axis: str = "data", causal: bool = True,
+                   scale: Optional[float] = None) -> jax.Array:
+    """q/k/v (B, H, S, D) sequence-sharded over ``axis``; returns the
+    exact attention output with the same sharding."""
+    d = q.shape[-1]
+    scale = scale if scale is not None else d ** -0.5
+    spec = P(None, None, axis, None)
+    fn = jax.shard_map(
+        functools.partial(_ring_attention_local, axis_name=axis,
+                          causal=causal, scale=scale),
+        mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec)
+    return fn(q, k, v)
+
+
+def _ulysses_local(q, k, v, axis_name: str, causal: bool, scale):
+    """seq-sharded → all_to_all → head-sharded full attention → back."""
+    n_dev = jax.lax.psum(1, axis_name)
+
+    def seq_to_heads(t):  # (B, H, S/n, D) → (B, H/n, S, D)
+        return jax.lax.all_to_all(t, axis_name, split_axis=1, concat_axis=2,
+                                  tiled=True)
+
+    def heads_to_seq(t):  # (B, H/n, S, D) → (B, H, S/n, D)
+        return jax.lax.all_to_all(t, axis_name, split_axis=2, concat_axis=1,
+                                  tiled=True)
+
+    qh, kh, vh = seq_to_heads(q), seq_to_heads(k), seq_to_heads(v)
+    out = attention(qh, kh, vh, causal=causal, scale=scale)
+    return heads_to_seq(out)
+
+
+def ulysses_attention(q: jax.Array, k: jax.Array, v: jax.Array, mesh: Mesh,
+                      axis: str = "data", causal: bool = True,
+                      scale: Optional[float] = None) -> jax.Array:
+    """Ulysses sequence parallelism: heads must divide the axis size."""
+    n = mesh.shape[axis]
+    if q.shape[1] % n != 0:
+        raise ValueError(f"heads {q.shape[1]} not divisible by mesh axis "
+                         f"{axis}={n}")
+    spec = P(None, None, axis, None)
+    fn = jax.shard_map(
+        functools.partial(_ulysses_local, axis_name=axis, causal=causal,
+                          scale=scale),
+        mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec)
+    return fn(q, k, v)
