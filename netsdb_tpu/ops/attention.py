@@ -111,15 +111,49 @@ def merge_project(out: jax.Array, w_out: jax.Array) -> jax.Array:
                       precision=jax.lax.Precision.HIGHEST)
 
 
+def attention_dispatch(q: jax.Array, k: jax.Array, v: jax.Array,
+                       causal: bool = True, scale: Optional[float] = None,
+                       impl: Optional[str] = None,
+                       block_size: Optional[int] = None) -> jax.Array:
+    """Pick the attention implementation: 'full', 'blockwise', or
+    'flash' (pallas kernel). ``impl=None`` auto-selects: flash on TPU
+    when the sequence divides its blocks, else blockwise when a
+    block_size is given, else full."""
+    from netsdb_tpu.ops.common import on_tpu
+
+    s = q.shape[2]
+    if impl is None:
+        # flash only when the sequence is a whole number of 256-blocks —
+        # shorter/unaligned sequences use the exact paths (Mosaic needs
+        # tile-aligned blocks, and short sequences don't need flash)
+        if on_tpu() and s % 256 == 0:
+            impl = "flash"
+        elif block_size:
+            impl = "blockwise"
+        else:
+            impl = "full"
+    if impl == "flash":
+        from netsdb_tpu.ops.pallas_kernels import flash_attention
+
+        blk = block_size or min(256, s)
+        return flash_attention(q, k, v, causal=causal, scale=scale,
+                               block_q=blk, block_k=blk)
+    if impl == "blockwise":
+        return blockwise_attention(q, k, v, block_size or min(256, s),
+                                   causal, scale)
+    if impl == "full":
+        return attention(q, k, v, causal, scale)
+    raise ValueError(f"unknown attention impl {impl!r}")
+
+
 def mha_forward(x: jax.Array, w_qkv: jax.Array, w_out: jax.Array,
                 num_heads: int, causal: bool = True,
-                block_size: Optional[int] = None) -> jax.Array:
+                block_size: Optional[int] = None,
+                impl: Optional[str] = None) -> jax.Array:
     """Full multi-head attention layer: x (B, S, E), w_qkv (E, 3E),
     w_out (E, E) — the flagship long-context layer the parallel plans
     shard."""
     q, k, v = qkv_project(x, w_qkv, num_heads)
-    if block_size:
-        out = blockwise_attention(q, k, v, block_size, causal)
-    else:
-        out = attention(q, k, v, causal)
+    out = attention_dispatch(q, k, v, causal=causal, impl=impl,
+                             block_size=block_size)
     return merge_project(out, w_out)

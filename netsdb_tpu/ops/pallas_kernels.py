@@ -1,0 +1,140 @@
+"""Pallas TPU kernels for the hot ops.
+
+The reference hand-writes its per-block math in Eigen inside join UDFs
+(``FFTransposeMult.h:80-92``); the TPU analogue of "hand-tuned inner
+loop" is a pallas kernel. XLA already fuses the elementwise chains this
+framework emits, so pallas is reserved for the patterns XLA cannot
+schedule optimally by itself — above all attention, where the online-
+softmax accumulator must live in VMEM across k-blocks instead of
+round-tripping (S x S) logits through HBM.
+
+``flash_attention`` follows the standard TPU flash pattern: grid
+(batch*heads, q_blocks, k_blocks) with the k-block dimension innermost
+(sequential on TPU), accumulators (m, l, acc) in VMEM scratch carried
+across k iterations, causal blocks skipped entirely when fully masked.
+Falls back to interpret mode off-TPU so tests run on CPU.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+
+NEG_INF = -1e30
+
+
+def _flash_kernel(q_ref, k_ref, v_ref, o_ref, m_ref, l_ref, acc_ref, *,
+                  block_q: int, block_k: int, causal: bool, scale: float,
+                  num_k_blocks: int):
+    qi = pl.program_id(1)
+    ki = pl.program_id(2)
+
+    @pl.when(ki == 0)
+    def _init():
+        m_ref[:] = jnp.full_like(m_ref, NEG_INF)
+        l_ref[:] = jnp.zeros_like(l_ref)
+        acc_ref[:] = jnp.zeros_like(acc_ref)
+
+    q_start = qi * block_q
+    k_start = ki * block_k
+
+    # causal: the block is fully masked iff its first key position is
+    # beyond the last query position — skip all compute
+    live = (q_start + block_q - 1 >= k_start) if causal else True
+
+    @pl.when(live)
+    def _compute():
+        # dtype policy matches ops.common.mxu_dot: f32 inputs run the MXU
+        # multi-pass (HIGHEST, exact); bf16 inputs are the reduced-
+        # precision opt-in and ride the native bf16 path
+        precision = (jax.lax.Precision.HIGHEST
+                     if q_ref.dtype == jnp.float32
+                     else jax.lax.Precision.DEFAULT)
+        q = q_ref[0]
+        k = k_ref[0]
+        v = v_ref[0]
+        logits = jax.lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32,
+            precision=precision) * scale  # (block_q, block_k) f32
+        if causal:
+            q_pos = q_start + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 0)
+            k_pos = k_start + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 1)
+            logits = jnp.where(q_pos >= k_pos, logits, NEG_INF)
+        m_prev = m_ref[:]
+        block_max = jnp.max(logits, axis=1, keepdims=True)
+        m_new = jnp.maximum(m_prev, block_max)
+        p = jnp.exp(logits - m_new)
+        correction = jnp.exp(m_prev - m_new)
+        l_ref[:] = l_ref[:] * correction + jnp.sum(p, axis=1, keepdims=True)
+        acc_ref[:] = acc_ref[:] * correction + jax.lax.dot_general(
+            p, v.astype(jnp.float32), (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+            precision=precision)
+        m_ref[:] = m_new
+
+    @pl.when(ki == num_k_blocks - 1)
+    def _finalize():
+        o_ref[0] = (acc_ref[:] / jnp.maximum(l_ref[:], 1e-30)).astype(
+            o_ref.dtype)
+
+
+def flash_attention(q: jax.Array, k: jax.Array, v: jax.Array,
+                    causal: bool = True, scale: Optional[float] = None,
+                    block_q: int = 256, block_k: int = 256,
+                    interpret: Optional[bool] = None) -> jax.Array:
+    """Fused attention: q/k/v (B, H, S, D) → (B, H, S, D). Numerically
+    equivalent to ``ops.attention.attention``; never materializes the
+    (S, S) score matrix in HBM."""
+    b, h, s, d = q.shape
+    block_q = min(block_q, s)
+    block_k = min(block_k, s)
+    if s % block_q or s % block_k:
+        raise ValueError(f"seq {s} not divisible by blocks "
+                         f"({block_q}, {block_k})")
+    if interpret is None:
+        from netsdb_tpu.ops.common import on_tpu
+
+        interpret = not on_tpu()
+    scale = scale if scale is not None else d ** -0.5
+    bh = b * h
+    qf = q.reshape(bh, s, d)
+    kf = k.reshape(bh, s, d)
+    vf = v.reshape(bh, s, d)
+    num_q = s // block_q
+    num_k = s // block_k
+
+    kernel = functools.partial(
+        _flash_kernel, block_q=block_q, block_k=block_k, causal=causal,
+        scale=scale, num_k_blocks=num_k)
+
+    out = pl.pallas_call(
+        kernel,
+        grid=(bh, num_q, num_k),
+        in_specs=[
+            pl.BlockSpec((1, block_q, d), lambda b_, qi, ki: (b_, qi, 0)),
+            pl.BlockSpec((1, block_k, d), lambda b_, qi, ki: (b_, ki, 0)),
+            pl.BlockSpec((1, block_k, d), lambda b_, qi, ki: (b_, ki, 0)),
+        ],
+        out_specs=pl.BlockSpec((1, block_q, d), lambda b_, qi, ki: (b_, qi, 0)),
+        out_shape=jax.ShapeDtypeStruct((bh, s, d), q.dtype),
+        scratch_shapes=[
+            _vmem((block_q, 1), jnp.float32),   # running max m
+            _vmem((block_q, 1), jnp.float32),   # running denom l
+            _vmem((block_q, d), jnp.float32),   # running numerator acc
+        ],
+        interpret=interpret,
+    )(qf, kf, vf)
+    return out.reshape(b, h, s, d)
+
+
+def _vmem(shape, dtype):
+    from jax.experimental.pallas import tpu as pltpu
+
+    return pltpu.VMEM(shape, dtype)

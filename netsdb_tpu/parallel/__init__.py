@@ -15,11 +15,12 @@ from netsdb_tpu.parallel.mesh import (
     replicate,
     shard_blocked,
 )
+from netsdb_tpu.parallel.pipeline import pipeline_apply
 from netsdb_tpu.parallel.ring import ring_attention, ulysses_attention
 
 __all__ = [
     "default_mesh", "make_mesh", "shard_blocked", "replicate",
     "matmul_psum", "matmul_psum_scatter", "matmul_allgather",
     "all_to_all_resharding", "ring_attention", "ulysses_attention",
-    "initialize_cluster", "hybrid_mesh", "cluster_info",
+    "initialize_cluster", "hybrid_mesh", "cluster_info", "pipeline_apply",
 ]

@@ -1,0 +1,85 @@
+"""Pipeline parallelism — GPipe-style microbatch schedule over a mesh axis.
+
+The reference has no cross-node pipelining (SURVEY §2.6: sequential
+JobStages with materialized intermediates); this module adds it as a
+first-class strategy: layer stages sharded over a ``pp`` mesh axis,
+microbatch activations rotated stage-to-stage with ``ppermute`` under
+``shard_map``. The schedule is the plain GPipe fill-drain loop:
+``n_micro + n_stages - 1`` steps, stage i processing microbatch t-i at
+step t; outputs accumulate at the last stage and are psum-broadcast at
+the end (one small collective).
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Callable
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, PartitionSpec as P
+
+
+def _pipeline_local(stage_params, xs, *, stage_fn, axis_name: str):
+    """Per-device body. ``stage_params``: this device's stage slice
+    (leading dim 1). ``xs``: (n_micro, ...) microbatches, replicated."""
+    n_stages = jax.lax.psum(1, axis_name)
+    my_idx = jax.lax.axis_index(axis_name)
+    n_micro = xs.shape[0]
+    params = jax.tree_util.tree_map(lambda t: t[0], stage_params)
+
+    steps = n_micro + n_stages - 1
+    perm = [(j, (j + 1) % n_stages) for j in range(n_stages)]
+
+    def step(t, carry):
+        buf, outs = carry
+        # stage 0 ingests microbatch t (clamped; masked out when t >= n_micro)
+        mb_in = jax.lax.dynamic_index_in_dim(
+            xs, jnp.minimum(t, n_micro - 1), axis=0, keepdims=False)
+        inp = jnp.where(my_idx == 0, mb_in, buf)
+        y = stage_fn(params, inp)
+        # collect at the last stage: step t finishes microbatch t-(n-1)
+        out_idx = t - (n_stages - 1)
+        valid = (my_idx == n_stages - 1) & (out_idx >= 0)
+        updated = jax.lax.dynamic_update_index_in_dim(
+            outs, y, jnp.maximum(out_idx, 0), axis=0)
+        outs = jnp.where(valid, updated, outs)
+        # hand activations to the next stage
+        buf = jax.lax.ppermute(y, axis_name, perm)
+        return buf, outs
+
+    # initial carries must carry the mesh-axis "varying" tag the loop
+    # body produces (ppermute/axis_index outputs vary per device)
+    buf0 = jax.lax.pcast(jnp.zeros_like(xs[0]), axis_name, to="varying")
+    outs0 = jax.lax.pcast(jnp.zeros_like(xs), axis_name, to="varying")
+    _, outs = jax.lax.fori_loop(0, steps, step, (buf0, outs0))
+    # only the last stage holds real outputs; broadcast to all
+    mine = jnp.where(my_idx == n_stages - 1, outs, jnp.zeros_like(outs))
+    return jax.lax.psum(mine, axis_name)
+
+
+def pipeline_apply(stage_fn: Callable, stacked_params, xs: jax.Array,
+                   mesh: Mesh, axis: str = "pp") -> jax.Array:
+    """Run ``n_stages`` sequential stages over ``n_micro`` microbatches.
+
+    ``stage_fn(params, x) -> y`` applies ONE stage (x and y same shape).
+    ``stacked_params``: pytree whose leaves have leading dim n_stages ==
+    mesh axis size (stage i's weights at index i — sharded so each
+    device holds exactly its stage). ``xs``: (n_micro, ...) microbatches.
+    Returns (n_micro, ...) outputs, replicated."""
+    n_stages = mesh.shape[axis]
+    leaves = jax.tree_util.tree_leaves(stacked_params)
+    if leaves and leaves[0].shape[0] != n_stages:
+        raise ValueError(
+            f"stacked params leading dim {leaves[0].shape[0]} != pipeline "
+            f"stages {n_stages}")
+    param_specs = jax.tree_util.tree_map(
+        lambda t: P(axis, *([None] * (t.ndim - 1))), stacked_params)
+    fn = jax.shard_map(
+        functools.partial(_pipeline_local, stage_fn=stage_fn,
+                          axis_name=axis),
+        mesh=mesh,
+        in_specs=(param_specs, P(*([None] * xs.ndim))),
+        out_specs=P(*([None] * xs.ndim)),
+    )
+    return fn(stacked_params, xs)
