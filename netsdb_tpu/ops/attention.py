@@ -123,10 +123,12 @@ def attention_dispatch(q: jax.Array, k: jax.Array, v: jax.Array,
 
     s = q.shape[2]
     if impl is None:
-        # flash only when the sequence is a whole number of 256-blocks —
-        # shorter/unaligned sequences use the exact paths (Mosaic needs
-        # tile-aligned blocks, and short sequences don't need flash)
-        if on_tpu() and s % 256 == 0:
+        # flash only when the sequence is a whole number of pallas blocks
+        # AND the block the kernel would use is lane-aligned — an explicit
+        # caller block_size that Mosaic can't tile (not a multiple of 128)
+        # must keep the exact blockwise path, not be silently overridden
+        blk = block_size or min(256, s)
+        if on_tpu() and s % 256 == 0 and blk % 128 == 0 and s % blk == 0:
             impl = "flash"
         elif block_size:
             impl = "blockwise"

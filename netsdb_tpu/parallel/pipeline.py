@@ -68,11 +68,11 @@ def pipeline_apply(stage_fn: Callable, stacked_params, xs: jax.Array,
     device holds exactly its stage). ``xs``: (n_micro, ...) microbatches.
     Returns (n_micro, ...) outputs, replicated."""
     n_stages = mesh.shape[axis]
-    leaves = jax.tree_util.tree_leaves(stacked_params)
-    if leaves and leaves[0].shape[0] != n_stages:
-        raise ValueError(
-            f"stacked params leading dim {leaves[0].shape[0]} != pipeline "
-            f"stages {n_stages}")
+    for leaf in jax.tree_util.tree_leaves(stacked_params):
+        if leaf.shape[0] != n_stages:
+            raise ValueError(
+                f"stacked params leading dim {leaf.shape[0]} != pipeline "
+                f"stages {n_stages}")
     param_specs = jax.tree_util.tree_map(
         lambda t: P(axis, *([None] * (t.ndim - 1))), stacked_params)
     fn = jax.shard_map(
