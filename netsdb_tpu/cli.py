@@ -107,6 +107,21 @@ def _cmd_tpch(args) -> int:
     return 0
 
 
+def _cmd_micro_bench(args) -> int:
+    from netsdb_tpu.workloads import micro_bench
+
+    names = None
+    if args.only:
+        names = [n.strip() for n in args.only.split(",") if n.strip()]
+        unknown = [n for n in names if n not in micro_bench.BENCHMARKS]
+        if unknown:
+            print(f"unknown benchmark(s) {unknown}; available: "
+                  f"{', '.join(micro_bench.BENCHMARKS)}", file=sys.stderr)
+            return 2
+    micro_bench.run_all(names=names)
+    return 0
+
+
 def main(argv=None) -> int:
     parser = argparse.ArgumentParser(prog="netsdb_tpu")
     sub = parser.add_subparsers(dest="cmd", required=True)
@@ -125,6 +140,11 @@ def main(argv=None) -> int:
     p.add_argument("--labels", type=int, default=10)
     p.add_argument("--block", type=int, default=256)
 
+    p = sub.add_parser("micro-bench",
+                       help="runtime micro-benchmarks (serviceBenchmarks)")
+    p.add_argument("--only", default=None,
+                   help="comma-separated benchmark names")
+
     p = sub.add_parser("tpch", help="run TPC-H demo queries")
     p.add_argument("--query", default=None,
                    choices=["q01", "q02", "q03", "q04", "q06", "q12", "q13",
@@ -134,7 +154,8 @@ def main(argv=None) -> int:
 
     args = parser.parse_args(argv)
     return {"info": _cmd_info, "bench": _cmd_bench, "pdml": _cmd_pdml,
-            "demo-ff": _cmd_demo_ff, "tpch": _cmd_tpch}[args.cmd](args)
+            "demo-ff": _cmd_demo_ff, "tpch": _cmd_tpch,
+            "micro-bench": _cmd_micro_bench}[args.cmd](args)
 
 
 if __name__ == "__main__":

@@ -69,9 +69,10 @@ def pipeline_apply(stage_fn: Callable, stacked_params, xs: jax.Array,
     Returns (n_micro, ...) outputs, replicated."""
     n_stages = mesh.shape[axis]
     for leaf in jax.tree_util.tree_leaves(stacked_params):
-        if leaf.shape[0] != n_stages:
+        dim = leaf.shape[0] if getattr(leaf, "ndim", 0) else None
+        if dim != n_stages:
             raise ValueError(
-                f"stacked params leading dim {leaf.shape[0]} != pipeline "
+                f"stacked params leading dim {dim} != pipeline "
                 f"stages {n_stages}")
     param_specs = jax.tree_util.tree_map(
         lambda t: P(axis, *([None] * (t.ndim - 1))), stacked_params)

@@ -1,0 +1,159 @@
+"""Runtime micro-benchmarks — the reference's serviceBenchmarks family.
+
+``src/serviceBenchmarks/source`` times four substrate pieces in
+isolation: allocator throughput (``AllocationTest.cc``), int- and
+string-keyed hash-map inserts under different allocators
+(``HashMapTest.cc``, ``StringHashMapTest.cc``), and the shuffle write
+path (``ShuffleTest.cc``). These exist to size the runtime's building
+blocks, not the queries. The equivalents here time OUR building blocks:
+the native arena (pagestore), host group-by (what hash aggregation
+became), device segment-sum (what keyed aggregation becomes on TPU),
+and the all-to-all resharding collective (what the shuffle became).
+
+Each benchmark returns ``(ops, seconds, ops_per_sec)``; ``run_all``
+prints one line per benchmark. Used by the CLI (``micro-bench``
+subcommand) and smoke-tested in ``tests/test_micro_bench.py``.
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Callable, Dict, List, Tuple
+
+import numpy as np
+
+Result = Tuple[int, float, float]
+
+
+def _timed(n_ops: int, fn: Callable[[], None]) -> Result:
+    t0 = time.perf_counter()
+    fn()
+    dt = max(time.perf_counter() - t0, 1e-9)
+    return n_ops, dt, n_ops / dt
+
+
+def bench_arena_alloc(n: int = 20_000, size: int = 4096,
+                      pool_mb: int = 64) -> Result:
+    """Native arena page write/free churn — ``AllocationTest.cc`` /
+    ``SlabAllocator`` role. Falls back to a host bytearray pool if the
+    native library is unavailable."""
+    import tempfile
+
+    from netsdb_tpu.native.pagestore import NativePageStore, native_available
+
+    payload = bytes(size)
+    if native_available():
+        with tempfile.TemporaryDirectory() as d:
+            store = NativePageStore(pool_bytes=pool_mb << 20, spill_dir=d)
+            store.create_set(1)
+
+            def run():
+                live: List[int] = []
+                for i in range(n):
+                    live.append(store.write_page(1, payload))
+                    if len(live) > 64:  # bounded live set → free-list churn
+                        store.free_page(live.pop(0))
+                for h in live:
+                    store.free_page(h)
+
+            res = _timed(n, run)
+            store.close()
+            return res
+
+    def run():
+        live: List[bytearray] = []
+        for i in range(n):
+            live.append(bytearray(size))
+            if len(live) > 64:
+                live.pop(0)
+
+    return _timed(n, run)
+
+
+def bench_int_groupby(n: int = 1_000_000, keys: int = 10_000) -> Result:
+    """Int-keyed hash aggregation on the host — ``HashMapTest.cc``'s
+    unordered_map insert loop (what CombinerProcessor did per page)."""
+    ks = np.random.default_rng(0).integers(0, keys, n).tolist()
+
+    def run():
+        acc: Dict[int, int] = {}
+        for k in ks:
+            acc[k] = acc.get(k, 0) + 1
+
+    return _timed(n, run)
+
+
+def bench_string_groupby(n: int = 300_000, keys: int = 10_000) -> Result:
+    """String-keyed variant — ``StringHashMapTest.cc``."""
+    ks = [str(x) for x in
+          np.random.default_rng(1).integers(0, keys, n).tolist()]
+
+    def run():
+        acc: Dict[str, int] = {}
+        for k in ks:
+            acc[k] = acc.get(k, 0) + 1
+
+    return _timed(n, run)
+
+
+def bench_segment_sum(n: int = 1_000_000, keys: int = 10_000) -> Result:
+    """The same keyed aggregation where it actually runs in this
+    framework: ``jax.ops.segment_sum`` on the device — the TPU path
+    that replaces the host hash map for tensor aggregations."""
+    import jax
+    import jax.numpy as jnp
+
+    rng = np.random.default_rng(2)
+    seg = jnp.asarray(rng.integers(0, keys, n))
+    val = jnp.asarray(rng.standard_normal(n), jnp.float32)
+    f = jax.jit(lambda s, v: jax.ops.segment_sum(v, s, num_segments=keys))
+    float(jnp.sum(f(seg, val)))  # compile + sync
+
+    def run():
+        float(jnp.sum(f(seg, val)))
+
+    return _timed(n, run)
+
+
+def bench_shuffle(elems_per_dev: int = 1 << 16) -> Result:
+    """All-to-all resharding over the device mesh — ``ShuffleTest.cc``'s
+    role (the ShuffleSink/combiner/snappy/TCP path became one XLA
+    collective)."""
+    import jax
+    import jax.numpy as jnp
+
+    from netsdb_tpu.parallel.collectives import all_to_all_resharding
+    from netsdb_tpu.parallel.mesh import make_mesh
+
+    n_dev = len(jax.devices())
+    mesh = make_mesh((n_dev,), ("data",))
+    # (n_dev, elems) sharded on dim 0 → resharded onto dim 1
+    x = jnp.arange(n_dev * elems_per_dev, dtype=jnp.float32
+                   ).reshape(n_dev, elems_per_dev)
+    f = jax.jit(lambda v: all_to_all_resharding(v, mesh, "data",
+                                                from_dim=0, to_dim=1))
+    float(jnp.sum(f(x)))  # compile + sync
+    total = n_dev * elems_per_dev
+
+    def run():
+        float(jnp.sum(f(x)))
+
+    return _timed(total, run)
+
+
+BENCHMARKS: Dict[str, Callable[[], Result]] = {
+    "arena_alloc": bench_arena_alloc,
+    "int_groupby": bench_int_groupby,
+    "string_groupby": bench_string_groupby,
+    "segment_sum": bench_segment_sum,
+    "shuffle": bench_shuffle,
+}
+
+
+def run_all(names=None, out=print) -> Dict[str, Result]:
+    results = {}
+    for name in (names or BENCHMARKS):
+        ops, secs, rate = BENCHMARKS[name]()
+        results[name] = (ops, secs, rate)
+        out(f"{name}: {ops} ops in {secs:.3f}s = {rate:,.0f} ops/s")
+    return results

@@ -30,13 +30,13 @@ import dataclasses
 import math
 import random
 import zlib
-from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
+from typing import List, Sequence, Tuple
 
 import numpy as np
 
 from netsdb_tpu.core.blocked import BlockedTensor
 from netsdb_tpu.plan.computations import (
-    Aggregate, Apply, Filter, Join, ScanSet, WriteSet,
+    Aggregate, Filter, Join, ScanSet, WriteSet,
 )
 
 # Feature layout: 9 time-derived features (reference
@@ -160,7 +160,10 @@ def comment_features(c: Comment,
     """Comment → dense feature vector. The reference emits author-time
     features + comment-time features + numeric fields + a 400k-wide
     sparse body encoding; we emit the same signal with the body hashed
-    into ``hash_dim`` buckets (dense, MXU-friendly)."""
+    into ``hash_dim - 9`` buckets (dense, MXU-friendly; the total
+    vector width is ``feature_dim(hash_dim)``)."""
+    if hash_dim <= 9:
+        raise ValueError(f"hash_dim must be > 9, got {hash_dim}")
     feats = _time_features(c.author_created_utc)
     feats += _time_features(c.created_utc)
     numeric = [

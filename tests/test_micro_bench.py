@@ -1,0 +1,31 @@
+"""Smoke tests for the serviceBenchmarks analogues — tiny sizes, just
+asserting each benchmark runs and reports sane numbers."""
+
+from netsdb_tpu.workloads import micro_bench as mb
+
+
+def test_arena_alloc():
+    ops, secs, rate = mb.bench_arena_alloc(n=500, size=1024, pool_mb=8)
+    assert ops == 500 and secs > 0 and rate > 0
+
+
+def test_groupbys():
+    for fn in (mb.bench_int_groupby, mb.bench_string_groupby):
+        ops, secs, rate = fn(n=5000, keys=100)
+        assert ops == 5000 and rate > 0
+
+
+def test_segment_sum():
+    ops, _, rate = mb.bench_segment_sum(n=10_000, keys=64)
+    assert ops == 10_000 and rate > 0
+
+
+def test_shuffle_on_mesh():
+    ops, _, rate = mb.bench_shuffle(elems_per_dev=1 << 10)
+    assert ops > 0 and rate > 0
+
+
+def test_run_all_smoke(capsys):
+    lines = []
+    mb.run_all(names=["int_groupby"], out=lines.append)
+    assert len(lines) == 1 and "ops/s" in lines[0]

@@ -1,0 +1,90 @@
+"""tpchBench micro-family tests — nested-object queries vs direct-Python
+oracles (reference drivers under ``src/tpchBench/source``)."""
+
+import heapq
+
+import pytest
+
+from netsdb_tpu.workloads import tpch_bench as tb
+
+
+@pytest.fixture(scope="module")
+def customers():
+    return tb.generate(num_customers=40, seed=5)
+
+
+@pytest.fixture()
+def loaded(client, customers):
+    tb.load(client, customers)
+    return client
+
+
+def test_int_selection_and_not_partition(loaded, customers):
+    loaded.execute_computations(
+        tb.customer_int_selection(threshold=20),
+        tb.customer_int_selection(threshold=20, negate=True),
+        job_name="tb-int")
+    sel = list(loaded.get_set_iterator("tpchbench", "selected_int"))
+    not_sel = list(loaded.get_set_iterator("tpchbench", "selected_int_not"))
+    assert sorted(c.custKey for c in sel) == [
+        c.custKey for c in customers if c.custKey > 20]
+    # selection + negation partition the input exactly
+    assert len(sel) + len(not_sel) == len(customers)
+
+
+def test_string_selection(loaded, customers):
+    loaded.execute_computations(
+        tb.customer_string_selection(segment="BUILDING"), job_name="tb-str")
+    sel = list(loaded.get_set_iterator("tpchbench", "selected_str"))
+    assert sorted(c.custKey for c in sel) == sorted(
+        c.custKey for c in customers if c.mktsegment == "BUILDING")
+
+
+def test_flatten_triples(loaded, customers):
+    res = loaded.execute_computations(tb.flatten_triples(), job_name="tb-flat")
+    triples = next(iter(res.values()))
+    expect = [(c.name, li.supplierName, li.partKey)
+              for c in customers for o in c.orders for li in o.lineItems]
+    got = [(t.customerName, t.supplierName, t.partKey) for t in triples]
+    assert sorted(got) == sorted(expect)
+
+
+def test_group_by_supplier(loaded, customers):
+    loaded.execute_computations(tb.flatten_triples(), job_name="tb-flat2")
+    res = loaded.execute_computations(tb.group_by_supplier(),
+                                      job_name="tb-group")
+    info = next(iter(res.values()))
+    oracle = {}
+    for c in customers:
+        for o in c.orders:
+            for li in o.lineItems:
+                oracle.setdefault(li.supplierName, {}).setdefault(
+                    c.name, []).append(li.partKey)
+    assert set(info) == set(oracle)
+    for sup in oracle:
+        assert set(info[sup]) == set(oracle[sup])
+        for cust in oracle[sup]:
+            assert sorted(info[sup][cust]) == sorted(oracle[sup][cust])
+
+
+def test_count_customers(loaded, customers):
+    res = loaded.execute_computations(tb.count_customers(), job_name="tb-count")
+    counts = next(iter(res.values()))
+    assert counts[0] == len(customers)
+
+
+def test_top_jaccard(loaded, customers):
+    query = [1, 2, 3, 7, 11, 13]
+    k = 4
+    res = loaded.execute_computations(
+        tb.top_jaccard(query_parts=query, k=k), job_name="tb-jaccard")
+    top = next(iter(res.values()))[0]
+    q = frozenset(query)
+
+    def jac(c):
+        parts = frozenset(li.partKey for o in c.orders for li in o.lineItems)
+        return len(parts & q) / len(parts | q) if parts | q else 0.0
+
+    oracle = heapq.nlargest(k, ((jac(c), c.custKey, c.name)
+                                for c in customers))
+    assert top == oracle
