@@ -113,6 +113,10 @@ def _cmd_micro_bench(args) -> int:
     names = None
     if args.only:
         names = [n.strip() for n in args.only.split(",") if n.strip()]
+        if not names:
+            print(f"--only given but no benchmark names; available: "
+                  f"{', '.join(micro_bench.BENCHMARKS)}", file=sys.stderr)
+            return 2
         unknown = [n for n in names if n not in micro_bench.BENCHMARKS]
         if unknown:
             print(f"unknown benchmark(s) {unknown}; available: "

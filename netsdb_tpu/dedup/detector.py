@@ -105,3 +105,58 @@ def pack_blocks_into_pages(block_sizes: Dict[str, int], page_size: int,
         seen.update(group)
     fit([k for k in block_sizes if k not in seen])
     return pages
+
+
+def bin_pack_tensors(tensors: Dict[str, List[str]], blocks_per_page: int
+                     ) -> Tuple[List[List[str]], Dict[str, List[int]]]:
+    """Tensor-aware bin packing — the reference's "Greedy-2" page
+    packer (``model-inference/deduplication/page-packing/algorithms/
+    PagePacking.py::bin_pack_greedy`` + ``findMinBinsMaxCover``): the
+    objective is not just few pages overall but few pages PER TENSOR,
+    so loading any one model touches a minimal page set even when its
+    blocks are shared with other models.
+
+    ``tensors``: name → list of block ids (shared blocks appear in
+    several tensors). ``blocks_per_page``: page capacity in blocks (the
+    reference's ``l``). Returns ``(pages, mapping)`` where ``pages`` is
+    a list of block-id lists and ``mapping[tensor]`` the sorted page
+    indices that cover all its blocks.
+
+    Strategy (same shape as the reference's): seed with the largest
+    tensor, its blocks ordered by global frequency; then for each next
+    tensor (size-descending) cover as much as possible from existing
+    pages (max-cover reuse), pack only the uncovered remainder into new
+    pages."""
+    if blocks_per_page <= 0:
+        raise ValueError("blocks_per_page must be positive")
+    freq: Dict[str, int] = {}
+    for blocks in tensors.values():
+        for b in set(blocks):
+            freq[b] = freq.get(b, 0) + 1
+
+    pages: List[List[str]] = []
+    where: Dict[str, int] = {}  # block id → page index
+    mapping: Dict[str, List[int]] = {}
+
+    def pack_new(blocks: List[str]) -> List[int]:
+        """Append blocks (frequency-ordered) onto the last non-full
+        page, then fresh pages."""
+        used = []
+        for b in sorted(blocks, key=lambda b: -freq[b]):
+            if pages and len(pages[-1]) < blocks_per_page:
+                pages[-1].append(b)
+                where[b] = len(pages) - 1
+            else:
+                pages.append([b])
+                where[b] = len(pages) - 1
+            used.append(where[b])
+        return used
+
+    for name in sorted(tensors, key=lambda n: -len(tensors[n])):
+        blocks = list(dict.fromkeys(tensors[name]))  # dedup, keep order
+        covered = [b for b in blocks if b in where]
+        fresh = [b for b in blocks if b not in where]
+        page_ids = {where[b] for b in covered}
+        page_ids.update(pack_new(fresh))
+        mapping[name] = sorted(page_ids)
+    return pages, mapping

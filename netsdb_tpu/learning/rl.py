@@ -7,7 +7,9 @@ TCP and receives an action index from ``scripts/pangeaDeepRL/
 rlServer.py`` (state dim ``S_DIM = 4*K + 7``, action space ``A_DIM =
 K + 1`` — K candidate partition lambdas plus "no partition";
 actor/critic nets in ``a3c.py``; enabled by
-``-DAPPLY_REINFORCEMENT_LEARNING``). The DRL placement optimizer
+``-DAPPLY_REINFORCEMENT_LEARNING``). Here the action space is the K
+candidates without the extra "no partition" arm: on a mesh some
+sharding is always chosen, so opting out isn't an action. The DRL placement optimizer
 (``DRLBasedDataPlacementOptimizerForLoadJob.h``) builds the state from
 job-history stats for each candidate.
 

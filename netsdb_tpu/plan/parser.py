@@ -135,7 +135,19 @@ class ParsedPlan:
             spec = registry[label]
             return dict(spec) if isinstance(spec, dict) else {"fn": spec}
 
+        arity = {  # kind → (n_inputs, n_literals)
+            "SCAN": (0, 2), "APPLY": (1, 1), "FILTER": (1, 1),
+            "FLATTEN": (1, 1), "JOIN": (2, 1), "AGGREGATE": (1, 1),
+            "OUTPUT": (1, 2),
+        }
         for a in order:
+            if a.kind in arity:
+                n_in, n_lit = arity[a.kind]
+                if len(a.inputs) != n_in or len(a.literals) != n_lit:
+                    raise PlanParseError(
+                        f"atom {a.name!r}: {a.kind} takes {n_in} input(s) "
+                        f"and {n_lit} literal(s), got {len(a.inputs)} and "
+                        f"{len(a.literals)}")
             ins = [built[s] for s in a.inputs]
             if a.kind == "SCAN":
                 built[a.name] = ScanSet(a.literals[0], a.literals[1])

@@ -1,0 +1,111 @@
+"""Model checkpointing — the durability story for device state.
+
+The reference's "checkpoint" is storage-level: weight sets flushed to
+``PartitionedFile``s survive restart, catalog sqlite persists metadata,
+and ``PreCompiledWorkload`` caches plans (SURVEY §5 "Checkpoint /
+resume": ``WorkerMain.cc:131``, ``conf/headers/DataTypes.h:53``). Our
+store already mirrors that (``storage/store.py::flush``/``load_set``).
+This module adds the TPU-idiomatic layer on top: orbax snapshots of
+whole parameter pytrees (``FFParams``, transformer stacks, optimizer
+state) with step numbering and latest-step resume — what
+checkpoint/resume means for a training loop on real hardware. Falls
+back to a NumPy ``.npz``-per-leaf format when orbax is unavailable.
+
+``BlockedTensor`` leaves round-trip because they are registered
+pytrees (``core/blocked.py``): orbax sees their ``jax.Array`` leaves
+and the BlockMeta aux data reconstructs the blocking.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import re
+from typing import Any, Optional
+
+import jax
+import numpy as np
+
+_STEP_RE = re.compile(r"^step_(\d+)$")
+
+
+def _step_dir(root: str, step: int) -> str:
+    return os.path.join(root, f"step_{step}")
+
+
+def list_steps(root: str) -> list:
+    """All checkpointed steps under ``root``, ascending."""
+    if not os.path.isdir(root):
+        return []
+    steps = []
+    for name in os.listdir(root):
+        m = _STEP_RE.match(name)
+        if m and os.path.isdir(os.path.join(root, name)):
+            steps.append(int(m.group(1)))
+    return sorted(steps)
+
+
+def latest_step(root: str) -> Optional[int]:
+    steps = list_steps(root)
+    return steps[-1] if steps else None
+
+
+def _try_orbax():
+    try:
+        import orbax.checkpoint as ocp
+        return ocp
+    except Exception:
+        return None
+
+
+def save(root: str, pytree: Any, step: int) -> str:
+    """Snapshot ``pytree`` as ``root/step_<step>``. Overwrites an
+    existing snapshot of the same step (the semantics a retrying
+    training loop needs)."""
+    path = _step_dir(root, step)
+    ocp = _try_orbax()
+    if ocp is not None:
+        ckptr = ocp.PyTreeCheckpointer()
+        ckptr.save(path, jax.tree_util.tree_map(np.asarray, pytree),
+                   force=True)
+        return path
+    # numpy fallback: flatten to leaves + treedef-less structure file
+    os.makedirs(path, exist_ok=True)
+    leaves, treedef = jax.tree_util.tree_flatten(pytree)
+    np.savez(os.path.join(path, "leaves.npz"),
+             **{f"leaf_{i}": np.asarray(l) for i, l in enumerate(leaves)})
+    with open(os.path.join(path, "treedef.json"), "w") as f:
+        json.dump({"n_leaves": len(leaves)}, f)
+    return path
+
+
+def restore(root: str, target: Any, step: Optional[int] = None) -> Any:
+    """Restore into the structure of ``target`` (a template pytree with
+    the right shapes — the standard orbax restore contract). ``step``
+    defaults to the latest."""
+    if step is None:
+        step = latest_step(root)
+        if step is None:
+            raise FileNotFoundError(f"no checkpoints under {root}")
+    path = _step_dir(root, step)
+    if not os.path.isdir(path):
+        raise FileNotFoundError(f"no checkpoint at {path}")
+    ocp = _try_orbax()
+    leaves_t, treedef = jax.tree_util.tree_flatten(target)
+    if ocp is not None:
+        ckptr = ocp.PyTreeCheckpointer()
+        restored = ckptr.restore(
+            path, item=jax.tree_util.tree_map(np.asarray, target))
+        leaves_r = jax.tree_util.tree_leaves(restored)
+    else:
+        data = np.load(os.path.join(path, "leaves.npz"))
+        with open(os.path.join(path, "treedef.json")) as f:
+            n_saved = json.load(f)["n_leaves"]
+        leaves_r = [data[f"leaf_{i}"] for i in range(n_saved)]
+    if len(leaves_r) != len(leaves_t):
+        raise ValueError(
+            f"checkpoint has {len(leaves_r)} leaves, target expects "
+            f"{len(leaves_t)}")
+    import jax.numpy as jnp
+    leaves = [jnp.asarray(r) for r in leaves_r]
+    return jax.tree_util.tree_unflatten(treedef, leaves)

@@ -1,0 +1,145 @@
+"""Dispatcher partition policies, checkpointing, and tensor-aware page
+packing (reference: src/dispatcher PartitionPolicy family; SURVEY §5
+checkpoint/resume; page-packing Greedy-2)."""
+
+import numpy as np
+import pytest
+
+from netsdb_tpu.storage import checkpoint as ckpt
+from netsdb_tpu.storage.dispatcher import (
+    FairPolicy, HashPolicy, RandomPolicy, RoundRobinPolicy,
+    dispatch_to_sets, make_policy,
+)
+
+
+# --- partition policies ----------------------------------------------
+
+def test_roundrobin_even_and_stateful():
+    p = RoundRobinPolicy()
+    parts = p.partition(list(range(10)), 4)
+    assert [len(x) for x in parts] == [3, 3, 2, 2]
+    # continues where it left off (reference policy keeps node cursor)
+    parts2 = p.partition(list(range(2)), 4)
+    assert [len(x) for x in parts2] == [0, 0, 1, 1]
+
+
+def test_random_partitions_everything():
+    parts = RandomPolicy(seed=1).partition(list(range(100)), 3)
+    assert sum(len(x) for x in parts) == 100
+    assert sorted(sum(parts, [])) == list(range(100))
+
+
+def test_fair_weighted_split():
+    p = FairPolicy(weights=[3, 1])
+    parts = p.partition(list(range(40)), 2)
+    assert [len(x) for x in parts] == [30, 10]
+    with pytest.raises(ValueError):
+        p.partition([], 3)  # shard count must match weights
+    with pytest.raises(ValueError):
+        FairPolicy([])
+
+
+def test_hash_copartitions_equal_keys():
+    p = HashPolicy(key_fn=lambda x: x["k"])
+    items_a = [{"k": i % 5, "v": i} for i in range(50)]
+    items_b = [{"k": i % 5, "v": -i} for i in range(25)]
+    pa = p.partition(items_a, 4)
+    pb = p.partition(items_b, 4)
+    shard_of_a = {it["k"]: s for s, part in enumerate(pa) for it in part}
+    shard_of_b = {it["k"]: s for s, part in enumerate(pb) for it in part}
+    assert shard_of_a == shard_of_b  # co-partitioned for joins
+
+
+def test_hash_rejects_unstable_keys():
+    class Key:
+        pass
+
+    p = HashPolicy(key_fn=lambda x: x)
+    with pytest.raises(TypeError, match="primitive"):
+        p.partition([Key()], 4)
+    # tuples of primitives are fine
+    p2 = HashPolicy(key_fn=lambda x: (x, str(x)))
+    assert sum(len(s) for s in p2.partition([1, 2, 3], 4)) == 3
+
+
+def test_make_policy_errors():
+    with pytest.raises(ValueError, match="unknown policy"):
+        make_policy("nope")
+
+
+def test_dispatch_to_sets(client):
+    client.create_database("disp")
+    names = dispatch_to_sets(client, "disp", "events", list(range(9)), 3)
+    assert names == ["events_shard0", "events_shard1", "events_shard2"]
+    all_items = []
+    for n in names:
+        all_items += list(client.get_set_iterator("disp", n))
+    assert sorted(all_items) == list(range(9))
+
+
+# --- checkpointing ----------------------------------------------------
+
+def test_checkpoint_roundtrip_ffparams(tmp_path):
+    from netsdb_tpu.core.blocked import BlockedTensor
+    from netsdb_tpu.models.ff import FFParams
+
+    rng = np.random.default_rng(0)
+    def bt(shape):
+        return BlockedTensor.from_dense(
+            rng.standard_normal(shape).astype(np.float32), (8, 8))
+    params = FFParams(w1=bt((16, 24)), b1=bt((16, 1)),
+                      wo=bt((8, 16)), bo=bt((8, 1)))
+    root = str(tmp_path / "ckpts")
+    ckpt.save(root, params, step=3)
+    ckpt.save(root, params, step=7)
+    assert ckpt.list_steps(root) == [3, 7]
+    assert ckpt.latest_step(root) == 7
+
+    zeros = FFParams(w1=bt((16, 24)), b1=bt((16, 1)),
+                     wo=bt((8, 16)), bo=bt((8, 1)))
+    restored = ckpt.restore(root, zeros)  # latest
+    np.testing.assert_allclose(np.asarray(restored.w1.to_dense()),
+                               np.asarray(params.w1.to_dense()))
+    assert restored.w1.meta.block_shape == params.w1.meta.block_shape
+
+    r3 = ckpt.restore(root, zeros, step=3)
+    np.testing.assert_allclose(np.asarray(r3.wo.to_dense()),
+                               np.asarray(params.wo.to_dense()))
+
+
+def test_checkpoint_missing(tmp_path):
+    with pytest.raises(FileNotFoundError):
+        ckpt.restore(str(tmp_path / "nope"), target={"a": np.zeros(2)})
+
+
+# --- tensor-aware page packing ---------------------------------------
+
+def test_bin_pack_tensors_shares_pages():
+    from netsdb_tpu.dedup.detector import bin_pack_tensors
+
+    # two models sharing most blocks (the dedup scenario)
+    shared = [f"s{i}" for i in range(8)]
+    tensors = {
+        "model_a": shared + ["a0", "a1"],
+        "model_b": shared + ["b0"],
+    }
+    pages, mapping = bin_pack_tensors(tensors, blocks_per_page=4)
+    # every tensor fully covered
+    placed = {b for p in pages for b in p}
+    for name, blocks in tensors.items():
+        assert set(blocks) <= placed
+        covered = {b for i in mapping[name] for b in pages[i]}
+        assert set(blocks) <= covered
+    # shared blocks stored once (dedup property)
+    assert sum(len(p) for p in pages) == len(placed) == 11
+    # each page within capacity
+    assert all(len(p) <= 4 for p in pages)
+    # sharing means fewer pages than separate packing (3+3 if split)
+    assert len(pages) <= 4
+
+
+def test_bin_pack_tensors_validates():
+    from netsdb_tpu.dedup.detector import bin_pack_tensors
+
+    with pytest.raises(ValueError):
+        bin_pack_tensors({"t": ["a"]}, blocks_per_page=0)

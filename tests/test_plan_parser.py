@@ -32,6 +32,14 @@ def test_parse_errors():
         parse_plan("a <= SCAN('d', 's')\na <= SCAN('d', 't')")
 
 
+def test_arity_errors():
+    for text in ("s <= SCAN('d')",              # missing literal
+                 "s <= SCAN('d', 's')\nj <= JOIN(s, 'lbl')",   # one input
+                 "s <= SCAN('d', 's')\nw <= OUTPUT(s, 'db')"):  # one literal
+        with pytest.raises(PlanParseError, match="takes"):
+            parse_plan(text).to_computations({"lbl": lambda a, b: (a, b)})
+
+
 def test_unknown_kind_parses_but_wont_build():
     p = parse_plan("a <= SCAN('d', 's')\nb <= MYSTERY(a, 'x')")
     assert p.atoms[1].kind == "MYSTERY"
