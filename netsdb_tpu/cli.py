@@ -111,7 +111,7 @@ def _cmd_micro_bench(args) -> int:
     from netsdb_tpu.workloads import micro_bench
 
     names = None
-    if args.only:
+    if args.only is not None:
         names = [n.strip() for n in args.only.split(",") if n.strip()]
         if not names:
             print(f"--only given but no benchmark names; available: "

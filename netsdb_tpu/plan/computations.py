@@ -68,9 +68,13 @@ class Apply(Computation):
     op_kind = "Apply"
 
     def __init__(self, input_: Computation, fn: Callable[[Any], Any],
-                 label: str = ""):
+                 label: str = "", traceable: bool = True):
+        """``traceable=False`` marks a host-side projection (numpy / Python
+        object work) that must run eagerly outside jit — the reference
+        analogue is a C++ lambda that touches non-tensor state."""
         super().__init__([input_])
         self.fn = fn
+        self.traceable = traceable
         self.label = label or getattr(fn, "__name__", "fn")
 
     def evaluate(self, x):

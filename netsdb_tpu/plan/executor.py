@@ -53,7 +53,7 @@ def _is_traceable(node: Computation) -> bool:
         return False
     if isinstance(node, Aggregate) and node.fn is None:
         return False
-    return True
+    return getattr(node, "traceable", True)
 
 
 def _evaluate(plan: LogicalPlan, scan_values: Dict[int, Any]) -> Dict[int, Any]:

@@ -90,7 +90,11 @@ def restore(root: str, target: Any, step: Optional[int] = None) -> Any:
     path = _step_dir(root, step)
     if not os.path.isdir(path):
         raise FileNotFoundError(f"no checkpoint at {path}")
-    ocp = _try_orbax()
+    # dispatch on what is actually on disk, not on which library this
+    # process happens to have: a checkpoint written by the npz fallback
+    # must restore in an orbax-enabled process and vice versa
+    is_npz = os.path.exists(os.path.join(path, "leaves.npz"))
+    ocp = None if is_npz else _try_orbax()
     leaves_t, treedef = jax.tree_util.tree_flatten(target)
     if ocp is not None:
         ckptr = ocp.PyTreeCheckpointer()
@@ -98,6 +102,10 @@ def restore(root: str, target: Any, step: Optional[int] = None) -> Any:
             path, item=jax.tree_util.tree_map(np.asarray, target))
         leaves_r = jax.tree_util.tree_leaves(restored)
     else:
+        if not is_npz:
+            raise FileNotFoundError(
+                f"checkpoint at {path} is in orbax format but orbax is "
+                f"not importable here")
         data = np.load(os.path.join(path, "leaves.npz"))
         with open(os.path.join(path, "treedef.json")) as f:
             n_saved = json.load(f)["n_leaves"]

@@ -109,7 +109,14 @@ def _stable_key_bytes(key: Any) -> bytes:
     """Canonical encoding for hash routing: only value types whose
     textual form is stable across processes (default object repr embeds
     a memory address, which would silently break co-partitioning)."""
-    if key is None or isinstance(key, (bool, int, float, str, bytes)):
+    if isinstance(key, (bool, int, float)):
+        # numerically equal keys must route identically regardless of
+        # Python type (1 == 1.0 == True, 0.0 == -0.0): the host equi-join
+        # treats them as one key, so co-partitioning must too
+        if isinstance(key, float) and not key.is_integer():
+            return repr(key).encode()
+        return repr(int(key)).encode()
+    if key is None or isinstance(key, (str, bytes)):
         return repr(key).encode()
     if isinstance(key, (tuple, list)):
         return b"(" + b",".join(_stable_key_bytes(k) for k in key) + b")"

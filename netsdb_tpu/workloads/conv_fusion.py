@@ -1,0 +1,268 @@
+"""Conv2D memory-fusion — the reference's staged relational im2col rewrite.
+
+The reference subsystem ``src/conv2d_memory_fusion`` (driver
+``src/tests/source/PipelinedConv2dMemFuseTest.cc:137-299``) lowers conv2d
+onto the blocked-matmul engine through four materialized jobs:
+
+1. ``kernel_bias_join``: Kernel set → ``KernelToChunks`` →
+   ``ImageChunksToBlock`` → ``ImageBlockToMatrix`` → ``KernelBiasJoin``
+   (bias written into the extra trailing column) → ``kernel_flat`` set.
+2. ``image_ops``: Image set → ``ImageToChunks`` (im2col windows, each row
+   ending in a literal 1.0 so the bias column multiplies through) →
+   ``ImageChunksToBlock`` → ``ImageBlockToMatrix`` → ``image_flat`` set.
+3. ``conv2d``: ``FFTransposeMult`` ⋈ + ``FFAggMatrix`` Σ over the two
+   blocked matrices → ``result`` set.
+4. reassembly: ``ConvResultToChunks`` → ``ImageChunksToBlock`` →
+   ``ConvChunksToImage`` → output Image set (commented out in the
+   reference driver but shipped in ``headers/ConvChunksToImage.h``).
+
+Here each reference Computation is the same node kind on our plan DAG
+(MultiApply = MultiSelectionComp, Aggregate = AggregateComp, Join =
+JoinComp), the chunk→block→matrix plumbing is host-side data prep (as the
+reference's per-tuple C++ lambdas are), and the one hot loop — the big
+matmul — is a single jitted blocked ``dot_general`` on the MXU
+(``ops.matmul.matmul_t``) instead of per-block-pair Eigen GEMMs.
+
+The fused single-kernel fast path for production serving is
+``ops.conv.conv2d_im2col``; this module is the capability-parity staged
+pipeline (debuggable, materialized, set-to-set).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import List, Optional, Tuple
+
+import numpy as np
+
+from netsdb_tpu.client import Client
+from netsdb_tpu.core.blocked import BlockedTensor
+from netsdb_tpu.ops.matmul import matmul_t
+from netsdb_tpu.plan.computations import (
+    Aggregate, Apply, Join, MultiApply, ScanSet, WriteSet)
+
+
+# --- record types (reference headers/Image.h, Kernel.h, ImageChunk.h) ---
+
+@dataclass
+class Image:
+    """(C,H,W) tensor with an integer key — reference ``Image.h``."""
+    key: int
+    data: np.ndarray  # (C, H, W)
+
+    @property
+    def channels(self) -> int:
+        return self.data.shape[0]
+
+    def window_count(self, k: int, stride: int, padding: int) -> int:
+        _, h, w = self.data.shape
+        oh = (h + 2 * padding - k) // stride + 1
+        ow = (w + 2 * padding - k) // stride + 1
+        return oh * ow
+
+
+@dataclass
+class Kernel:
+    """One filter (I,KH,KW), key = output-channel index — ``Kernel.h``."""
+    key: int
+    data: np.ndarray  # (I, KH, KW)
+
+
+@dataclass
+class Chunk:
+    """A block_y-wide slice of one im2col row — reference ``ImageChunk.h``
+    (fields block_row/y_index/chunk/block_row_start)."""
+    row: int          # global row index in the flattened matrix
+    y_index: int      # column-block index
+    values: np.ndarray  # length == block_y (zero-padded tail)
+
+
+def _row_chunks(row_index: int, values: np.ndarray, block_y: int) -> List[Chunk]:
+    n_blocks = -(-len(values) // block_y)
+    padded = np.zeros(n_blocks * block_y, np.float32)
+    padded[:len(values)] = values
+    return [Chunk(row_index, j, padded[j * block_y:(j + 1) * block_y])
+            for j in range(n_blocks)]
+
+
+# --- the pipeline builder ----------------------------------------------
+
+@dataclass
+class ConvFusionPipeline:
+    """Staged conv2d-as-relational-algebra over the engine.
+
+    Shapes follow the reference driver: images (C,H,W), kernels (O,I,KH,KW);
+    flattened width = C*KH*KW + 1 (the +1 carries the bias through the
+    matmul — ``PipelinedConv2dMemFuseTest.cc`` "147 + 1").
+    """
+    db: str = "convfuse"
+    kernel_size: int = 7
+    stride: int = 1
+    padding: int = 0
+    block: Tuple[int, int] = (64, 64)
+    compute_dtype: Optional[str] = None
+
+    SETS = ("images", "kernels", "bias",
+            "kernel_flat", "image_flat", "result", "output")
+
+    # -- setup / load ---------------------------------------------------
+
+    def setup(self, client: Client) -> None:
+        client.create_database(self.db)
+        for s in self.SETS:
+            client.create_set(self.db, s)
+
+    def load(self, client: Client, images: np.ndarray, kernels: np.ndarray,
+             bias: Optional[np.ndarray] = None) -> None:
+        """images (N,C,H,W) → N Image records; kernels (O,I,KH,KW) → O
+        Kernel records; bias (O,) stored whole (an FFMatrixBlock set in
+        the reference)."""
+        images = np.asarray(images, np.float32)
+        kernels = np.asarray(kernels, np.float32)
+        client.send_data(self.db, "images",
+                         [Image(i, images[i]) for i in range(len(images))])
+        client.send_data(self.db, "kernels",
+                         [Kernel(o, kernels[o]) for o in range(len(kernels))])
+        b = (np.zeros(len(kernels), np.float32) if bias is None
+             else np.asarray(bias, np.float32))
+        client.send_data(self.db, "bias", [b])
+
+    # -- per-stage computation factories (reference header per name) ----
+
+    def _flat_width(self, channels: int) -> int:
+        return channels * self.kernel_size * self.kernel_size + 1
+
+    def image_to_chunks(self, img: Image) -> List[Chunk]:
+        """``ImageToChunks.h``: im2col window rows (c-major, then kh, kw)
+        with a trailing 1.0; global row = key*windows + window."""
+        k, s, p = self.kernel_size, self.stride, self.padding
+        data = img.data
+        if p:
+            data = np.pad(data, ((0, 0), (p, p), (p, p)))
+        c, h, w = data.shape
+        oh = (h - k) // s + 1
+        ow = (w - k) // s + 1
+        row_start = img.key * oh * ow
+        out: List[Chunk] = []
+        for wi in range(oh * ow):
+            y, x = (wi // ow) * s, (wi % ow) * s
+            patch = data[:, y:y + k, x:x + k].reshape(-1)
+            row = np.concatenate([patch, [1.0]]).astype(np.float32)
+            out.extend(_row_chunks(row_start + wi, row, self.block[1]))
+        return out
+
+    def kernel_to_chunks(self, ker: Kernel) -> List[Chunk]:
+        """``KernelToChunks.h``: one row per filter, same layout, last
+        column left 0 for the bias join to fill."""
+        flat = ker.data.reshape(-1).astype(np.float32)
+        row = np.concatenate([flat, [0.0]]).astype(np.float32)
+        return _row_chunks(ker.key, row, self.block[1])
+
+    def chunks_to_blocks(self, scan):
+        """``ImageChunksToBlock.h``: aggregate chunks of the same
+        (row-block, col-block) into one partial block; disjoint rows sum."""
+        bx, by = self.block
+
+        def place(ch: Chunk) -> np.ndarray:
+            blk = np.zeros((bx, by), np.float32)
+            blk[ch.row % bx] = ch.values
+            return blk
+
+        return Aggregate(scan, key=lambda ch: (ch.row // bx, ch.y_index),
+                         value=place, combine=np.add,
+                         label="ImageChunksToBlock")
+
+    def blocks_to_matrix(self, blocks_node, total_rows: int, total_cols: int):
+        """``ImageBlockToMatrix.h``: {(bi,bj): block} dict → one blocked
+        matrix of the given logical shape (zero block-padded)."""
+        def assemble(block_dict) -> BlockedTensor:
+            return BlockedTensor.from_blocks(
+                block_dict, (total_rows, total_cols), self.block)
+
+        return Apply(blocks_node, assemble, label="ImageBlockToMatrix")
+
+    # -- the four jobs --------------------------------------------------
+
+    def build_kernel_flat(self, channels: int, num_filters: int) -> WriteSet:
+        """Job 1 — ``kernel_bias_join``."""
+        width = self._flat_width(channels)
+        scan = ScanSet(self.db, "kernels")
+        chunks = MultiApply(scan, self.kernel_to_chunks, label="KernelToChunks")
+        matrix = self.blocks_to_matrix(self.chunks_to_blocks(chunks),
+                                       num_filters, width)
+        bias = ScanSet(self.db, "bias")
+
+        def bias_join(kmat: BlockedTensor, bias_items) -> BlockedTensor:
+            dense = np.array(kmat.to_dense())
+            b = np.asarray(bias_items[0], np.float32)
+            dense[:len(b), width - 1] = b
+            return BlockedTensor.from_dense(dense, self.block)
+
+        joined = Join(matrix, bias, fn=bias_join, label="KernelBiasJoin")
+        return WriteSet(joined, self.db, "kernel_flat")
+
+    def build_image_flat(self, channels: int, total_windows: int) -> WriteSet:
+        """Job 2 — ``image_ops``."""
+        width = self._flat_width(channels)
+        scan = ScanSet(self.db, "images")
+        chunks = MultiApply(scan, self.image_to_chunks, label="ImageToChunks")
+        matrix = self.blocks_to_matrix(self.chunks_to_blocks(chunks),
+                                       total_windows, width)
+        return WriteSet(matrix, self.db, "image_flat")
+
+    def build_conv(self) -> WriteSet:
+        """Job 3 — ``conv2d``: FFTransposeMult ⋈ + FFAggMatrix Σ. The
+        join-on-contraction-block-index + block-product aggregation is one
+        ``dot_general`` on the MXU (SURVEY §2.6 relational-SUMMA row)."""
+        image_flat = ScanSet(self.db, "image_flat")
+        kernel_flat = ScanSet(self.db, "kernel_flat")
+        prod = Join(image_flat, kernel_flat,
+                    fn=lambda a, b: matmul_t(
+                        a, b, compute_dtype=self.compute_dtype),
+                    label="FFTransposeMult+FFAggMatrix")
+        return WriteSet(prod, self.db, "result")
+
+    def build_reassemble(self, out_h: int, out_w: int,
+                         num_filters: int) -> WriteSet:
+        """Job 4 — ``ConvResultToChunks`` + ``ConvChunksToImage``: rows of
+        the result matrix regrouped per image into (O, out_h, out_w)."""
+        result = ScanSet(self.db, "result")
+        windows = out_h * out_w
+
+        def to_images(res: BlockedTensor) -> List[Image]:
+            dense = np.asarray(res.to_dense())[:, :num_filters]
+            n = dense.shape[0] // windows
+            return [Image(i, dense[i * windows:(i + 1) * windows]
+                          .reshape(out_h, out_w, num_filters)
+                          .transpose(2, 0, 1))
+                    for i in range(n)]
+
+        images = Apply(result, to_images, label="ConvChunksToImage",
+                       traceable=False)
+        return WriteSet(images, self.db, "output")
+
+    # -- driver ---------------------------------------------------------
+
+    def run(self, client: Client, images: np.ndarray, kernels: np.ndarray,
+            bias: Optional[np.ndarray] = None) -> List[Image]:
+        """The full staged pipeline, one ``execute_computations`` per
+        reference job (same materialization boundaries)."""
+        images = np.asarray(images, np.float32)
+        kernels = np.asarray(kernels, np.float32)
+        n, c, h, w = images.shape
+        o = kernels.shape[0]
+        k, s, p = self.kernel_size, self.stride, self.padding
+        oh = (h + 2 * p - k) // s + 1
+        ow = (w + 2 * p - k) // s + 1
+
+        self.setup(client)
+        self.load(client, images, kernels, bias)
+        client.execute_computations(self.build_kernel_flat(c, o),
+                                    job_name=f"{self.db}-kernel_bias_join")
+        client.execute_computations(self.build_image_flat(c, n * oh * ow),
+                                    job_name=f"{self.db}-image_ops")
+        client.execute_computations(self.build_conv(),
+                                    job_name=f"{self.db}-conv2d")
+        client.execute_computations(self.build_reassemble(oh, ow, o),
+                                    job_name=f"{self.db}-reassemble")
+        return list(client.get_set_iterator(self.db, "output"))

@@ -1,0 +1,63 @@
+"""Staged conv2d memory-fusion pipeline vs the direct conv oracle
+(reference driver ``PipelinedConv2dMemFuseTest.cc``; oracle parity with
+``src/conv2d_proj``'s ATen conv → our ``conv2d_direct``)."""
+
+import numpy as np
+import pytest
+
+from netsdb_tpu.ops.conv import conv2d_direct
+from netsdb_tpu.workloads.conv_fusion import ConvFusionPipeline, Image
+
+
+@pytest.fixture
+def small_case():
+    rng = np.random.default_rng(7)
+    images = rng.standard_normal((3, 2, 12, 12)).astype(np.float32)
+    kernels = rng.standard_normal((5, 2, 3, 3)).astype(np.float32)
+    bias = rng.standard_normal(5).astype(np.float32)
+    return images, kernels, bias
+
+
+def test_staged_pipeline_matches_direct_conv(client, small_case):
+    images, kernels, bias = small_case
+    pipe = ConvFusionPipeline(db="cf1", kernel_size=3, block=(16, 16))
+    out = pipe.run(client, images, kernels, bias)
+
+    ref = np.asarray(conv2d_direct(images, kernels, bias))
+    assert len(out) == 3
+    for img in out:
+        assert isinstance(img, Image)
+        np.testing.assert_allclose(img.data, ref[img.key], rtol=1e-4,
+                                   atol=1e-4)
+
+
+def test_stride_and_padding(client, small_case):
+    images, kernels, bias = small_case
+    pipe = ConvFusionPipeline(db="cf2", kernel_size=3, stride=2, padding=1,
+                              block=(16, 16))
+    out = pipe.run(client, images, kernels, bias)
+    ref = np.asarray(conv2d_direct(images, kernels, bias, stride=(2, 2),
+                                   padding=(1, 1)))
+    assert out[0].data.shape == ref[0].shape
+    for img in out:
+        np.testing.assert_allclose(img.data, ref[img.key], rtol=1e-4,
+                                   atol=1e-4)
+
+
+def test_intermediate_sets_materialized(client, small_case):
+    """The reference materializes kernel_flat / image_flat / result as
+    real sets between jobs — they must be scannable blocked matrices."""
+    images, kernels, bias = small_case
+    pipe = ConvFusionPipeline(db="cf3", kernel_size=3, block=(16, 16))
+    pipe.run(client, images, kernels, bias)
+
+    kflat = next(client.get_set_iterator("cf3", "kernel_flat"))
+    iflat = next(client.get_set_iterator("cf3", "image_flat"))
+    width = 2 * 3 * 3 + 1
+    assert kflat.shape == (5, width)
+    assert iflat.shape == (3 * 10 * 10, width)
+    # bias landed in the trailing column; image rows end in 1.0
+    np.testing.assert_allclose(np.asarray(kflat.to_dense())[:, -1], bias,
+                               rtol=1e-6)
+    np.testing.assert_allclose(np.asarray(iflat.to_dense())[:, width - 1],
+                               np.ones(300), rtol=1e-6)
