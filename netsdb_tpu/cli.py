@@ -107,6 +107,108 @@ def _cmd_tpch(args) -> int:
     return 0
 
 
+def _cmd_selftest(args) -> int:
+    """Scripted integration sequence — the reference's
+    ``scripts/integratedTests.py:72-240`` (boot pseudo-cluster, then run
+    selection, aggregation, LDA, FF, LSTM drivers checking exit codes).
+    Here: the same workload sequence in-process, each step validated."""
+    import numpy as np
+
+    from netsdb_tpu.client import Client
+    from netsdb_tpu.config import Configuration
+
+    client = Client(Configuration())
+    rng = np.random.default_rng(0)
+    failures = []
+
+    def check(cond, what):
+        # explicit raise (not assert): must still fail under python -O,
+        # since this command's whole job is exit-code-checked validation
+        if not cond:
+            raise RuntimeError(f"check failed: {what}")
+
+    def step(name, fn):
+        t0 = time.perf_counter()
+        try:
+            fn()
+            print(f"[ok]   {name} ({time.perf_counter() - t0:.2f}s)")
+        except Exception as e:  # mirror exit-code checking: keep going
+            failures.append(name)
+            print(f"[FAIL] {name}: {type(e).__name__}: {e}")
+
+    def selection():  # bin/test74-style selection over an object set
+        from netsdb_tpu.plan.computations import Filter, ScanSet, WriteSet
+
+        client.create_database("st")
+        client.create_set("st", "emps")
+        client.send_data("st", "emps",
+                         [{"id": i, "salary": i * 100} for i in range(100)])
+        res = client.execute_computations(
+            WriteSet(Filter(ScanSet("st", "emps"),
+                            lambda r: r["salary"] > 5000), "st", "rich"),
+            job_name="selftest-selection")
+        check(len(next(iter(res.values()))) == 49, "selection row count")
+
+    def aggregation():  # bin/test90-style group-by
+        from netsdb_tpu.plan.computations import (Aggregate, ScanSet,
+                                                  WriteSet)
+
+        res = client.execute_computations(
+            WriteSet(Aggregate(ScanSet("st", "emps"),
+                               key=lambda r: r["id"] % 5,
+                               value=lambda r: r["salary"],
+                               combine=lambda a, b: a + b),
+                     "st", "by_dept"), job_name="selftest-agg")
+        out = next(iter(res.values()))
+        check(len(out) == 5 and sum(out.values()) == sum(
+            i * 100 for i in range(100)), "aggregation groups/total")
+
+    def lda():
+        from netsdb_tpu.workloads.lda import lda_em
+
+        counts = rng.integers(0, 5, size=(20, 30)).astype(np.float32)
+        state = lda_em(np.asarray(counts), k=3, iters=5)
+        check(bool(np.all(np.isfinite(np.asarray(state.doc_topic)))),
+              "lda finite doc_topic")
+
+    def ff():  # FFTest 100 100
+        from netsdb_tpu.models.ff import FFModel
+
+        m = FFModel(db="stff", block=(32, 32))
+        m.setup(client)
+        m.load_random_weights(client, 100, 100, 10)
+        m.load_inputs(client,
+                      rng.standard_normal((64, 100)).astype(np.float32))
+        probs = np.asarray(m.inference(client).to_dense())
+        check(bool(np.allclose(probs.sum(0), 1.0, atol=1e-3)),
+              "ff softmax columns sum to 1")
+
+    def lstm():
+        from netsdb_tpu.models.lstm_model import LSTMModel
+
+        nin, nh, batch, T = 16, 16, 8, 4
+        m = LSTMModel(db="stlstm", block=(8, 8))
+        m.setup(client)
+        w = {}
+        for g in "ifco":
+            w[f"w_{g}"] = rng.standard_normal((nh, nin)).astype(np.float32) * 0.3
+            w[f"u_{g}"] = rng.standard_normal((nh, nh)).astype(np.float32) * 0.3
+            w[f"b_{g}"] = rng.standard_normal(nh).astype(np.float32) * 0.1
+        m.load_weights(client, w)
+        m.load_state(client, np.zeros((nh, batch), np.float32),
+                     np.zeros((nh, batch), np.float32))
+        xs = rng.standard_normal((T, nin, batch)).astype(np.float32)
+        hT, _cT, _hs = m.run_sequence(client, xs)
+        check(bool(np.all(np.isfinite(np.asarray(hT.to_dense())))),
+              "lstm finite hidden state")
+
+    for name, fn in [("selection", selection), ("aggregation", aggregation),
+                     ("lda", lda), ("ff", ff), ("lstm", lstm)]:
+        step(name, fn)
+    print(f"{5 - len(failures)}/5 passed")
+    return 1 if failures else 0
+
+
 def _cmd_micro_bench(args) -> int:
     from netsdb_tpu.workloads import micro_bench
 
@@ -149,6 +251,9 @@ def main(argv=None) -> int:
     p.add_argument("--only", default=None,
                    help="comma-separated benchmark names")
 
+    sub.add_parser("selftest",
+                   help="scripted integration sequence (integratedTests.py)")
+
     p = sub.add_parser("tpch", help="run TPC-H demo queries")
     p.add_argument("--query", default=None,
                    choices=["q01", "q02", "q03", "q04", "q06", "q12", "q13",
@@ -159,7 +264,8 @@ def main(argv=None) -> int:
     args = parser.parse_args(argv)
     return {"info": _cmd_info, "bench": _cmd_bench, "pdml": _cmd_pdml,
             "demo-ff": _cmd_demo_ff, "tpch": _cmd_tpch,
-            "micro-bench": _cmd_micro_bench}[args.cmd](args)
+            "micro-bench": _cmd_micro_bench,
+            "selftest": _cmd_selftest}[args.cmd](args)
 
 
 if __name__ == "__main__":

@@ -83,6 +83,28 @@ CANDIDATE_LAMBDAS: Dict[str, Tuple[str, ...]] = {
 DEFAULT_QUERIES = ("q01", "q02", "q03", "q04", "q06",
                    "q12", "q13", "q14", "q17", "q22")
 
+# The partition key each query actually needs per table (its join/probe
+# key in workloads/tpch.py). When the active scheme partitions a table
+# by a different key, the engine must re-shuffle that table before the
+# co-partitioned join — precisely the cost the reference's self-learning
+# observes in RUN_STAT and learns to avoid (documentation.md:5-10: the
+# win is reusing a placement that matches the workload's keys).
+QUERY_JOIN_KEYS: Dict[str, Dict[str, str]] = {
+    "q01": {},  # single-table scan+aggregate
+    "q02": {"part": "p_partkey", "partsupp": "ps_partkey",
+            "supplier": "s_suppkey", "nation": "n_nationkey",
+            "region": "r_regionkey"},
+    "q03": {"customer": "c_custkey", "orders": "o_custkey",
+            "lineitem": "l_orderkey"},
+    "q04": {"orders": "o_orderkey", "lineitem": "l_orderkey"},
+    "q06": {},  # single-table scan
+    "q12": {"orders": "o_orderkey", "lineitem": "l_orderkey"},
+    "q13": {"customer": "c_custkey", "orders": "o_custkey"},
+    "q14": {"lineitem": "l_partkey", "part": "p_partkey"},
+    "q17": {"lineitem": "l_partkey", "part": "p_partkey"},
+    "q22": {"customer": "c_custkey", "orders": "o_custkey"},
+}
+
 _SCHEMA = """
 CREATE TABLE IF NOT EXISTS lambda_statistics (
     lambda_id INTEGER PRIMARY KEY, table_name TEXT, column_name TEXT);
@@ -224,7 +246,15 @@ def gen_trace(client, trace_db: TraceDB,
               db: str = "tpch", scale: int = 1, seed: int = 0,
               n_shards: int = 2) -> None:
     """Run the suite once per scheme, recording RUN_STAT rows —
-    ``tpchGenTrace.cc``'s main loop."""
+    ``tpchGenTrace.cc``'s main loop.
+
+    The recorded time is repartition cost + query cost. Repartition
+    happens for every table whose scheme key differs from the key the
+    query joins on (``QUERY_JOIN_KEYS``): those rows are re-dispatched
+    into join-keyed shard sets first, the single-controller stand-in for
+    the reference's cross-node shuffle. A scheme matching the workload's
+    join keys therefore genuinely runs faster — the signal the
+    reference's RUN_STAT captures."""
     schemes = list(schemes) if schemes is not None else trace_db.schemes()
     tables = tpch.generate(scale, seed)
     for scheme in schemes:
@@ -232,6 +262,17 @@ def gen_trace(client, trace_db: TraceDB,
                          n_shards=n_shards)
         for qname in queries:
             t0 = time.perf_counter()
+            for table, req_key in QUERY_JOIN_KEYS.get(qname, {}).items():
+                if scheme.column_for(table) == req_key:
+                    continue  # co-partitioned already: no shuffle
+                for i in range(n_shards):
+                    shard = f"{table}_reshuffle_shard{i}"
+                    if client.set_exists(db, shard):
+                        client.clear_set(db, shard)
+                dispatch_to_sets(
+                    client, db, f"{table}_reshuffle", tables[table],
+                    n_shards,
+                    policy=HashPolicy(lambda r, c=req_key: r[c]))
             tpch.run_query(client, qname, db=db)
             trace_db.record_run(scheme.scheme_id, qname,
                                 time.perf_counter() - t0)

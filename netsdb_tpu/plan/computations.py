@@ -208,6 +208,43 @@ class Aggregate(Computation):
                 f"'{self.label}')")
 
 
+class Partition(Computation):
+    """Repartition by key — reference ``PartitionComp``
+    (``src/lambdas/headers/PartitionComp.h``, TCAP APPLY-PARTITION atom
+    ``AtomicComputationClasses.h:497``): route each item to one of
+    ``num_partitions`` by its partition-lambda key. Routing uses the
+    dispatcher's stable hash, so a set materialized from this node is
+    co-partitioned with any set ingested via
+    ``HashPolicy`` with the same key fn (the reference's co-located
+    join setup). Output is {partition_id: [items]}."""
+
+    op_kind = "Partition"
+
+    def __init__(self, input_: Computation, key_fn: Callable[[Any], Any],
+                 num_partitions: int, label: str = ""):
+        super().__init__([input_])
+        if num_partitions < 1:
+            raise ValueError(f"num_partitions must be >= 1, got "
+                             f"{num_partitions}")
+        self.key_fn = key_fn
+        self.num_partitions = num_partitions
+        self.traceable = False  # host-object routing, never under jit
+        self.label = label or getattr(key_fn, "__name__", "partition")
+
+    def evaluate(self, items):
+        from netsdb_tpu.storage.dispatcher import HashPolicy
+
+        # same routing as the dispatcher by construction (the
+        # co-partitioning guarantee in the class docstring)
+        parts = HashPolicy(self.key_fn).partition(items,
+                                                  self.num_partitions)
+        return dict(enumerate(parts))
+
+    def plan_atom(self) -> str:
+        return (f"{self.output_name} <= PARTITION("
+                f"{self.inputs[0].output_name}, '{self.label}')")
+
+
 class WriteSet(Computation):
     """Materialize into a set — reference ``SetWriter``/``WriteUserSet``.
     Sink node; stage boundary (the reference's pipeline breaker)."""

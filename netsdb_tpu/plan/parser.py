@@ -21,8 +21,8 @@ import re
 from typing import Any, Dict, List
 
 from netsdb_tpu.plan.computations import (
-    Aggregate, Apply, Computation, Filter, Join, MultiApply, ScanSet,
-    WriteSet,
+    Aggregate, Apply, Computation, Filter, Join, MultiApply, Partition,
+    ScanSet, WriteSet,
 )
 
 # name <= KIND(arg, arg, ...) ; args are bare identifiers or 'quoted'
@@ -138,7 +138,7 @@ class ParsedPlan:
         arity = {  # kind → (n_inputs, n_literals)
             "SCAN": (0, 2), "APPLY": (1, 1), "FILTER": (1, 1),
             "FLATTEN": (1, 1), "JOIN": (2, 1), "AGGREGATE": (1, 1),
-            "OUTPUT": (1, 2),
+            "PARTITION": (1, 1), "OUTPUT": (1, 2),
         }
         for a in order:
             if a.kind in arity:
@@ -168,6 +168,18 @@ class ParsedPlan:
             elif a.kind == "AGGREGATE":
                 built[a.name] = Aggregate(ins[0], label=a.literals[0],
                                           **kwargs_for(a))
+            elif a.kind == "PARTITION":
+                kw = kwargs_for(a)
+                key_fn = kw.pop("key_fn", None)
+                key_fn = key_fn or kw.pop("fn", None)
+                kw.pop("fn", None)
+                if key_fn is None or "num_partitions" not in kw:
+                    raise PlanParseError(
+                        f"PARTITION label {a.literals[0]!r}: registry entry "
+                        f"must be a dict with 'key_fn' (or 'fn') and "
+                        f"'num_partitions'")
+                built[a.name] = Partition(ins[0], key_fn,
+                                          label=a.literals[0], **kw)
             elif a.kind == "OUTPUT":
                 built[a.name] = WriteSet(ins[0], a.literals[0],
                                          a.literals[1])

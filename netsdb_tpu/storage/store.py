@@ -147,6 +147,8 @@ class SetStore:
         for model-weight sets (each netsDB weight set is exactly one
         blocked matrix)."""
         s = self._require(ident)
+        if s.alias_of is not None:
+            raise ValueError(f"set {ident} aliases {s.alias_of}; it is read-only")
         s.items = [tensor]
         s.nbytes = _item_nbytes(tensor)
         s.last_access = time.time()

@@ -119,6 +119,8 @@ class ConvFusionPipeline:
         the reference)."""
         images = np.asarray(images, np.float32)
         kernels = np.asarray(kernels, np.float32)
+        for s in ("images", "kernels", "bias"):  # a load replaces, as
+            client.clear_set(self.db, s)         # tpch.load_tables does
         client.send_data(self.db, "images",
                          [Image(i, images[i]) for i in range(len(images))])
         client.send_data(self.db, "kernels",

@@ -105,6 +105,90 @@ def generate(scale: int = 1, seed: int = 0) -> Dict[str, List[Dict[str, Any]]]:
             "orders": orders, "lineitem": lineitem}
 
 
+# dbgen column layouts (official TPC-H spec order) — the reference's
+# ``tpchDataLoader.cc`` parses the same pipe-separated .tbl files.
+# (name, type) with type in {int, float, str}.
+_TBL_SCHEMAS: Dict[str, List[tuple]] = {
+    "region": [("r_regionkey", int), ("r_name", str), ("r_comment", str)],
+    "nation": [("n_nationkey", int), ("n_name", str),
+               ("n_regionkey", int), ("n_comment", str)],
+    "supplier": [("s_suppkey", int), ("s_name", str), ("s_address", str),
+                 ("s_nationkey", int), ("s_phone", str),
+                 ("s_acctbal", float), ("s_comment", str)],
+    "customer": [("c_custkey", int), ("c_name", str), ("c_address", str),
+                 ("c_nationkey", int), ("c_phone", str),
+                 ("c_acctbal", float), ("c_mktsegment", str),
+                 ("c_comment", str)],
+    "part": [("p_partkey", int), ("p_name", str), ("p_mfgr", str),
+             ("p_brand", str), ("p_type", str), ("p_size", int),
+             ("p_container", str), ("p_retailprice", float),
+             ("p_comment", str)],
+    "partsupp": [("ps_partkey", int), ("ps_suppkey", int),
+                 ("ps_availqty", int), ("ps_supplycost", float),
+                 ("ps_comment", str)],
+    "orders": [("o_orderkey", int), ("o_custkey", int),
+               ("o_orderstatus", str), ("o_totalprice", float),
+               ("o_orderdate", str), ("o_orderpriority", str),
+               ("o_clerk", str), ("o_shippriority", int),
+               ("o_comment", str)],
+    "lineitem": [("l_orderkey", int), ("l_partkey", int),
+                 ("l_suppkey", int), ("l_linenumber", int),
+                 ("l_quantity", float), ("l_extendedprice", float),
+                 ("l_discount", float), ("l_tax", float),
+                 ("l_returnflag", str), ("l_linestatus", str),
+                 ("l_shipdate", str), ("l_commitdate", str),
+                 ("l_receiptdate", str), ("l_shipinstruct", str),
+                 ("l_shipmode", str), ("l_comment", str)],
+}
+
+
+def parse_tbl(path: str, table: str) -> List[Dict[str, Any]]:
+    """Parse one dbgen ``.tbl`` file (pipe-separated, trailing pipe) into
+    row dicts — ``tpchDataLoader.cc``'s per-table parse loop."""
+    schema = _TBL_SCHEMAS.get(table)
+    if schema is None:
+        raise ValueError(f"unknown TPC-H table {table!r}; "
+                         f"one of {sorted(_TBL_SCHEMAS)}")
+    rows = []
+    with open(path) as f:
+        for lineno, line in enumerate(f, 1):
+            line = line.rstrip("\r\n")
+            if not line:
+                continue
+            fields = line.split("|")
+            if fields and fields[-1] == "":
+                fields.pop()  # dbgen's trailing delimiter
+            if len(fields) != len(schema):
+                raise ValueError(
+                    f"{path}:{lineno}: expected {len(schema)} fields for "
+                    f"{table}, got {len(fields)}")
+            rows.append({name: typ(val)
+                         for (name, typ), val in zip(schema, fields)})
+    return rows
+
+
+def load_tbl_dir(client, directory: str, db: str = "tpch",
+                 tables=None) -> Dict[str, int]:
+    """Load a dbgen output directory (``<table>.tbl`` files) — the
+    reference's data-loading workflow (``README.md:216-256``:
+    dbgen then tpchDataLoader). Returns {table: row count}."""
+    import os
+
+    counts = {}
+    client.create_database(db)
+    for table in (tables or sorted(_TBL_SCHEMAS)):
+        path = os.path.join(directory, f"{table}.tbl")
+        if not os.path.exists(path):
+            continue
+        rows = parse_tbl(path, table)
+        if not client.set_exists(db, table):
+            client.create_set(db, table, type_name="object")
+        client.clear_set(db, table)
+        client.send_data(db, table, rows)
+        counts[table] = len(rows)
+    return counts
+
+
 def load_tables(client, db: str = "tpch", tables=None, scale: int = 1,
                 seed: int = 0) -> None:
     """``tpchDataLoader`` analogue."""

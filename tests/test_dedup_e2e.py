@@ -1,0 +1,86 @@
+"""End-to-end model deduplication — reference
+``src/tests/source/FFTestWithDeduplication.cc`` and
+``TextClassifierDeduplication.cc``: two models whose weight sets overlap
+are stored once via addSharedMapping, and both still serve correct
+inference from the deduped storage."""
+
+import numpy as np
+
+from netsdb_tpu.core.blocked import BlockedTensor
+from netsdb_tpu.dedup.detector import dedup_weight_sets, find_shared_blocks
+from netsdb_tpu.models.ff import FFModel
+from netsdb_tpu.storage.store import SetIdentifier
+
+
+BLOCK = (16, 16)
+
+
+def _load_two_models(client, share_w1=True):
+    """Model A and model B; B reuses A's hidden layer (the common
+    fine-tuned-model scenario the dedup paper targets)."""
+    rng = np.random.default_rng(3)
+    a = FFModel(db="ffa", block=BLOCK)
+    b = FFModel(db="ffb", block=BLOCK)
+    a.setup(client)
+    b.setup(client)
+    a.load_random_weights(client, features=32, hidden=48, labels=8, seed=1)
+    b.load_random_weights(client, features=32, hidden=48, labels=8, seed=2)
+    if share_w1:
+        # B's w1/b1 identical to A's (shared backbone)
+        for name in ("w1", "b1"):
+            t = client.get_tensor("ffa", name)
+            client.store.put_tensor(
+                SetIdentifier("ffb", name),
+                BlockedTensor(t.data, t.meta))
+    x = rng.standard_normal((24, 32)).astype(np.float32)
+    return a, b, x
+
+
+def test_detect_and_alias_shared_backbone(client):
+    a, b, x = _load_two_models(client)
+    shared = find_shared_blocks(client, [("ffa", "w1"), ("ffb", "w1")])
+    # every w1 block appears in both models
+    assert all(len(locs) == 2 for locs in shared.values())
+    assert len(shared) == client.get_tensor("ffa", "w1").meta.num_blocks
+
+    report = dedup_weight_sets(client, "ffb", "w1", "ffa", "w1")
+    assert report["aliased"] and report["matching_blocks"] == report["total_blocks"]
+
+    # distinct sets do NOT alias
+    report2 = dedup_weight_sets(client, "ffb", "wo", "ffa", "wo")
+    assert not report2["aliased"]
+
+
+def test_inference_correct_after_dedup(client):
+    a, b, x = _load_two_models(client)
+    a_model_params = a.params_from_store(client)
+    b_model_params = b.params_from_store(client)
+    xa = BlockedTensor.from_dense(x, BLOCK)
+    before_a = np.asarray(a.forward(a_model_params, xa).to_dense())
+    before_b = np.asarray(b.forward(b_model_params, xa).to_dense())
+
+    for name in ("w1", "b1"):
+        rep = dedup_weight_sets(client, "ffb", name, "ffa", name)
+        assert rep["aliased"]
+
+    # both models serve the same outputs from deduped storage
+    after_a = np.asarray(
+        a.forward(a.params_from_store(client), xa).to_dense())
+    after_b = np.asarray(
+        b.forward(b.params_from_store(client), xa).to_dense())
+    np.testing.assert_allclose(after_a, before_a, rtol=1e-6)
+    np.testing.assert_allclose(after_b, before_b, rtol=1e-6)
+    # ... and B genuinely reads A's storage (alias, not a copy)
+    ident = SetIdentifier("ffb", "w1")
+    assert client.store._sets[ident].alias_of is not None
+
+
+def test_alias_set_is_read_only(client):
+    a, b, x = _load_two_models(client)
+    dedup_weight_sets(client, "ffb", "w1", "ffa", "w1")
+    import pytest
+
+    with pytest.raises(ValueError, match="alias"):
+        client.store.put_tensor(
+            SetIdentifier("ffb", "w1"),
+            client.get_tensor("ffa", "wo"))

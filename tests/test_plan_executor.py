@@ -137,3 +137,52 @@ def test_compiled_cache_reused(client):
     assert len(ex._compiled_cache) == 1
     client.execute_computations(sink, job_name="cache-test")
     assert len(ex._compiled_cache) == 1
+
+
+class TestPartitionComp:
+    """Partition node — reference PartitionComp (TCAP PARTITION atom)."""
+
+    def test_partition_routes_by_stable_hash(self, client):
+        from netsdb_tpu.plan.computations import Partition, ScanSet, WriteSet
+        from netsdb_tpu.storage.dispatcher import HashPolicy
+
+        client.create_database("pt")
+        client.create_set("pt", "src")
+        rows = [{"k": i % 7, "v": i} for i in range(50)]
+        client.send_data("pt", "src", rows)
+        node = Partition(ScanSet("pt", "src"), lambda r: r["k"], 4,
+                         label="byK")
+        res = client.execute_computations(WriteSet(node, "pt", "parts"),
+                                          job_name="pt-job")
+        parts = next(iter(res.values()))
+        assert set(parts) == {0, 1, 2, 3}
+        assert sum(len(v) for v in parts.values()) == 50
+        # co-partitioned with the dispatcher's HashPolicy on the same key
+        disp = HashPolicy(lambda r: r["k"]).partition(rows, 4)
+        for i in range(4):
+            assert parts[i] == disp[i]
+
+    def test_partition_round_trips_through_plan_text(self):
+        from netsdb_tpu.plan.computations import Partition, ScanSet, WriteSet
+        from netsdb_tpu.plan.parser import parse_plan
+        from netsdb_tpu.plan.planner import plan_from_sinks
+
+        node = Partition(ScanSet("pt", "src"), lambda r: r["k"], 2,
+                         label="byK")
+        text = plan_from_sinks([WriteSet(node, "pt", "out")]).to_plan_string()
+        assert "PARTITION" in text
+        parsed = parse_plan(text)
+        sinks = parsed.to_computations(
+            {"byK": {"fn": lambda r: r["k"], "num_partitions": 2}})
+        rebuilt = sinks[0].inputs[0]
+        assert rebuilt.op_kind == "Partition"
+        out = rebuilt.evaluate([{"k": 1}, {"k": 2}, {"k": 1}])
+        assert sum(len(v) for v in out.values()) == 3
+
+    def test_partition_validates_count(self):
+        import pytest
+
+        from netsdb_tpu.plan.computations import Partition, ScanSet
+
+        with pytest.raises(ValueError, match="num_partitions"):
+            Partition(ScanSet("a", "b"), lambda r: r, 0)

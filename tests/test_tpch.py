@@ -204,3 +204,39 @@ def test_q22_sales_opportunity(loaded):
     got = {k: v for k, v in rows}
     assert {k: v["n"] for k, v in got.items()} == \
         {k: v["n"] for k, v in oracle.items()}
+
+
+class TestTblLoader:
+    """dbgen .tbl ingestion — reference tpchDataLoader.cc."""
+
+    def _write_tbl(self, tmp_path):
+        (tmp_path / "region.tbl").write_text(
+            "0|AFRICA|nothing special|\n1|AMERICA|also nothing|\n")
+        (tmp_path / "lineitem.tbl").write_text(
+            "1|10|2|1|17|21168.23|0.04|0.02|N|O|1996-03-13|1996-02-12|"
+            "1996-03-22|DELIVER IN PERSON|TRUCK|egular courts|\n")
+        return tmp_path
+
+    def test_parse_and_load(self, client, tmp_path):
+        from netsdb_tpu.workloads.tpch import load_tbl_dir, parse_tbl
+
+        d = self._write_tbl(tmp_path)
+        rows = parse_tbl(str(d / "lineitem.tbl"), "lineitem")
+        assert rows[0]["l_orderkey"] == 1
+        assert rows[0]["l_extendedprice"] == 21168.23
+        assert rows[0]["l_shipmode"] == "TRUCK"
+
+        counts = load_tbl_dir(client, str(d), db="tpchtbl")
+        assert counts == {"region": 2, "lineitem": 1}
+        got = list(client.get_set_iterator("tpchtbl", "region"))
+        assert got[0]["r_name"] == "AFRICA"
+
+    def test_field_count_mismatch(self, tmp_path):
+        import pytest
+
+        from netsdb_tpu.workloads.tpch import parse_tbl
+
+        p = tmp_path / "nation.tbl"
+        p.write_text("0|ALGERIA|\n")
+        with pytest.raises(ValueError, match="expected 4 fields"):
+            parse_tbl(str(p), "nation")

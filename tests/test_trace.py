@@ -44,6 +44,24 @@ def test_gen_trace_records_runs(client, trace_db):
     assert total == len(list(client.get_set_iterator("tpch", "lineitem")))
 
 
+def test_trace_times_depend_on_scheme(client, trace_db):
+    """A scheme matching the query's join keys skips the repartition
+    shuffle; a mismatched one pays it — the RUN_STAT signal train()
+    learns from."""
+    schemes = tr.prepare_training(trace_db)
+    # baseline partitions lineitem by l_orderkey (q04's join key);
+    # find the variant that partitions lineitem by l_partkey instead
+    mismatch = next(s for s in schemes
+                    if s.column_for("lineitem") == "l_partkey")
+    base = schemes[0]
+    tr.gen_trace(client, trace_db, schemes=[base, mismatch],
+                 queries=("q04",), scale=1, n_shards=2)
+    # the mismatched scheme re-dispatched lineitem by l_orderkey
+    shards = [f"lineitem_reshuffle_shard{i}" for i in range(2)]
+    n = sum(len(list(client.get_set_iterator("tpch", s))) for s in shards)
+    assert n == len(list(client.get_set_iterator("tpch", "lineitem")))
+
+
 def test_train_prefers_faster_scheme(trace_db):
     schemes = tr.prepare_training(trace_db)[:3]
     # synthetic trace: scheme 1 is decisively fastest for q03
