@@ -1,0 +1,178 @@
+// Native columnar parser for TPC-H dbgen ".tbl" files.
+//
+// Role parity: the reference ingests dbgen output with a C++ loader
+// (/root/reference/src/tpch/source/tpchDataLoader.cc — per-table parse
+// loops over '|'-separated lines feeding object sets). Here the parser
+// is columnar: numeric columns land in contiguous int64/double buffers
+// and string columns in a concatenated blob + offsets, which is what
+// the TPU ingestion path wants (arrays, not per-row objects).
+//
+// C ABI (ctypes-friendly), one result handle per parse:
+//   tp_parse(path, n_cols, types) -> handle (NULL on open failure)
+//   types[i]: 0 = int64, 1 = double, 2 = string
+//   tp_num_rows / tp_error_msg / tp_int_col / tp_float_col
+//   tp_str_data + tp_str_offsets (n_rows+1 offsets into the blob)
+//   tp_free(handle)
+//
+// Tolerates CRLF, requires dbgen's trailing '|' optional, and reports
+// the first malformed line (1-based) in the error message.
+
+#include <cstdint>
+#include <cstdio>
+#include <cstdlib>
+#include <cstring>
+#include <string>
+#include <vector>
+
+namespace {
+
+struct Column {
+  int type;  // 0 int, 1 double, 2 string
+  std::vector<int64_t> ints;
+  std::vector<double> floats;
+  std::string str_data;
+  std::vector<int64_t> str_offsets;  // n_rows + 1
+};
+
+struct TblResult {
+  std::vector<Column> cols;
+  int64_t num_rows = 0;
+  std::string error;
+};
+
+bool parse_line(const char* p, const char* end, TblResult* r, int64_t lineno) {
+  size_t n_cols = r->cols.size();
+  for (size_t c = 0; c < n_cols; ++c) {
+    const char* field = p;
+    while (p < end && *p != '|') ++p;
+    if (p == end && c + 1 < n_cols) {
+      r->error = "line " + std::to_string(lineno) + ": expected " +
+                 std::to_string(n_cols) + " fields, got " +
+                 std::to_string(c + 1);
+      return false;
+    }
+    size_t len = static_cast<size_t>(p - field);
+    Column& col = r->cols[c];
+    switch (col.type) {
+      case 0: {
+        char* endp = nullptr;
+        long long v = strtoll(field, &endp, 10);
+        if (len == 0 || endp != field + len) {  // empty must error, as
+          r->error = "line " + std::to_string(lineno) + ": field " +
+                     std::to_string(c + 1) + " is not an integer";
+          return false;  // the Python parser's int("") does
+        }
+        col.ints.push_back(static_cast<int64_t>(v));
+        break;
+      }
+      case 1: {
+        char* endp = nullptr;
+        double v = strtod(field, &endp);
+        if (len == 0 || endp != field + len) {
+          r->error = "line " + std::to_string(lineno) + ": field " +
+                     std::to_string(c + 1) + " is not a number";
+          return false;
+        }
+        col.floats.push_back(v);
+        break;
+      }
+      default:
+        col.str_data.append(field, len);
+        col.str_offsets.push_back(
+            static_cast<int64_t>(col.str_data.size()));
+    }
+    if (p < end) ++p;  // skip '|'
+  }
+  // remaining content after the last parsed field must be empty or the
+  // dbgen trailing delimiter already consumed
+  if (p < end) {
+    r->error = "line " + std::to_string(lineno) + ": expected " +
+               std::to_string(n_cols) + " fields, got more";
+    return false;
+  }
+  return true;
+}
+
+}  // namespace
+
+extern "C" {
+
+void* tp_parse(const char* path, int n_cols, const int* types) {
+  FILE* f = fopen(path, "rb");
+  if (!f) return nullptr;
+  auto* r = new TblResult();
+  r->cols.resize(static_cast<size_t>(n_cols));
+  for (int i = 0; i < n_cols; ++i) {
+    r->cols[static_cast<size_t>(i)].type = types[i];
+    if (types[i] == 2)
+      r->cols[static_cast<size_t>(i)].str_offsets.push_back(0);
+  }
+
+  std::vector<char> buf;
+  buf.reserve(1 << 20);
+  char chunk[1 << 16];
+  size_t got;
+  while ((got = fread(chunk, 1, sizeof chunk, f)) > 0)
+    buf.insert(buf.end(), chunk, chunk + got);
+  fclose(f);
+  buf.push_back('\0');  // strtoll/strtod on a final numeric field must
+                        // not scan past the buffer
+
+  const char* p = buf.data();
+  const char* end = p + buf.size() - 1;
+  int64_t lineno = 0;
+  while (p < end) {
+    const char* nl = static_cast<const char*>(
+        memchr(p, '\n', static_cast<size_t>(end - p)));
+    const char* line_end = nl ? nl : end;
+    // tolerate CRLF
+    const char* trimmed = line_end;
+    while (trimmed > p && trimmed[-1] == '\r') --trimmed;
+    ++lineno;
+    if (trimmed > p) {  // skip blank lines
+      // strip one trailing '|' (dbgen's trailing delimiter)
+      const char* content_end = trimmed;
+      if (content_end > p && content_end[-1] == '|') --content_end;
+      if (!parse_line(p, content_end, r, lineno)) {
+        return r;  // error recorded; caller checks tp_error_msg
+      }
+      ++r->num_rows;
+    }
+    if (!nl) break;
+    p = nl + 1;
+  }
+  return r;
+}
+
+int64_t tp_num_rows(void* h) {
+  return static_cast<TblResult*>(h)->num_rows;
+}
+
+const char* tp_error_msg(void* h) {
+  TblResult* r = static_cast<TblResult*>(h);
+  return r->error.empty() ? nullptr : r->error.c_str();
+}
+
+const int64_t* tp_int_col(void* h, int col) {
+  return static_cast<TblResult*>(h)
+      ->cols[static_cast<size_t>(col)].ints.data();
+}
+
+const double* tp_float_col(void* h, int col) {
+  return static_cast<TblResult*>(h)
+      ->cols[static_cast<size_t>(col)].floats.data();
+}
+
+const char* tp_str_data(void* h, int col) {
+  return static_cast<TblResult*>(h)
+      ->cols[static_cast<size_t>(col)].str_data.data();
+}
+
+const int64_t* tp_str_offsets(void* h, int col) {
+  return static_cast<TblResult*>(h)
+      ->cols[static_cast<size_t>(col)].str_offsets.data();
+}
+
+void tp_free(void* h) { delete static_cast<TblResult*>(h); }
+
+}  // extern "C"

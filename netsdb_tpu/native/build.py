@@ -12,27 +12,30 @@ import threading
 
 _lock = threading.Lock()
 
-_SRC = os.path.join(os.path.dirname(os.path.dirname(os.path.dirname(
-    os.path.abspath(__file__)))), "native", "pagestore.cpp")
-_OUT_DIR = os.path.join(os.path.dirname(_SRC), "build")
-_OUT = os.path.join(_OUT_DIR, "libpagestore.so")
+_NATIVE_DIR = os.path.join(os.path.dirname(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__)))), "native")
+_OUT_DIR = os.path.join(_NATIVE_DIR, "build")
 
 
 class NativeBuildError(RuntimeError):
     pass
 
 
-def build_library(force: bool = False) -> str:
-    """Compile if missing or stale; returns the .so path."""
+def build_library(name: str = "pagestore", force: bool = False) -> str:
+    """Compile ``native/<name>.cpp`` if missing or stale; returns the
+    .so path. One translation unit per library keeps it
+    dependency-free."""
+    src = os.path.join(_NATIVE_DIR, f"{name}.cpp")
+    out = os.path.join(_OUT_DIR, f"lib{name}.so")
     with _lock:
-        if (not force and os.path.exists(_OUT)
-                and os.path.getmtime(_OUT) >= os.path.getmtime(_SRC)):
-            return _OUT
+        if (not force and os.path.exists(out)
+                and os.path.getmtime(out) >= os.path.getmtime(src)):
+            return out
         os.makedirs(_OUT_DIR, exist_ok=True)
         cmd = ["g++", "-std=c++17", "-O2", "-fPIC", "-shared", "-pthread",
-               _SRC, "-o", _OUT]
+               src, "-o", out]
         proc = subprocess.run(cmd, capture_output=True, text=True)
         if proc.returncode != 0:
             raise NativeBuildError(
                 f"native build failed:\n{proc.stderr[-2000:]}")
-        return _OUT
+        return out

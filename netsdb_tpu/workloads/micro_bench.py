@@ -141,12 +141,41 @@ def bench_shuffle(elems_per_dev: int = 1 << 16) -> Result:
     return _timed(total, run)
 
 
+def bench_planner(n: int = 2_000) -> Result:
+    """Plan build + textual dump + re-parse round-trips on a
+    selection⋈join DAG — the reference's ``src/optimizerBenchmark``
+    (MovieStar⋈StarsIn TCAP generation/optimization experiments). Times
+    the planner substrate itself, not query execution."""
+    from netsdb_tpu.plan.computations import (Aggregate, Filter, Join,
+                                              ScanSet, WriteSet)
+    from netsdb_tpu.plan.parser import parse_plan
+    from netsdb_tpu.plan.planner import plan_from_sinks
+
+    def build():
+        movies = ScanSet("mdb", "movies")
+        stars = ScanSet("mdb", "starsin")
+        sel = Filter(movies, lambda m: True, label="SimpleMovieSelection")
+        j = Join(sel, stars, left_key=lambda m: m["title"],
+                 right_key=lambda s: s["movie"], label="SimpleMovieJoin")
+        agg = Aggregate(j, key=lambda p: p[0]["title"], value=lambda p: 1,
+                        combine=lambda a, b: a + b, label="countStars")
+        return WriteSet(agg, "mdb", "out")
+
+    def run():
+        for _ in range(n):
+            plan = plan_from_sinks([build()])
+            parse_plan(plan.to_plan_string())
+
+    return _timed(n, run)
+
+
 BENCHMARKS: Dict[str, Callable[[], Result]] = {
     "arena_alloc": bench_arena_alloc,
     "int_groupby": bench_int_groupby,
     "string_groupby": bench_string_groupby,
     "segment_sum": bench_segment_sum,
     "shuffle": bench_shuffle,
+    "planner": bench_planner,
 }
 
 

@@ -29,3 +29,10 @@ def test_run_all_smoke(capsys):
     lines = []
     mb.run_all(names=["int_groupby"], out=lines.append)
     assert len(lines) == 1 and "ops/s" in lines[0]
+
+
+def test_planner_bench_runs():
+    from netsdb_tpu.workloads.micro_bench import bench_planner
+
+    ops, secs, rate = bench_planner(n=50)
+    assert ops == 50 and rate > 0

@@ -173,3 +173,53 @@ class TestPagedTensorStore:
         np.testing.assert_allclose(out, m @ rhs, rtol=2e-4, atol=1e-3)
         assert pts.stats()["evictions"] > 0
         pts.close()
+
+
+class TestNativeTblParse:
+    """Native columnar .tbl parser (native/tblparse.cpp) vs the Python
+    row parser oracle."""
+
+    def _gen(self, tmp_path, n=500):
+        import random
+
+        rng = random.Random(0)
+        lines = []
+        for i in range(n):
+            lines.append(f"{i}|{rng.randrange(10)}|{rng.randrange(100)}|"
+                         f"{i%7}|{rng.uniform(1,50):.2f}|"
+                         f"{rng.uniform(1000,99999):.2f}|0.04|0.02|N|O|"
+                         f"1996-03-13|1996-02-12|1996-03-22|NONE|TRUCK|c{i}|")
+        p = tmp_path / "lineitem.tbl"
+        p.write_text("\n".join(lines) + "\n")
+        return str(p)
+
+    def test_matches_python_parser(self, tmp_path):
+        from netsdb_tpu.native import tblparse
+        from netsdb_tpu.workloads.tpch import parse_tbl, parse_tbl_columnar
+
+        path = self._gen(tmp_path)
+        cols = parse_tbl_columnar(path, "lineitem")
+        rows = parse_tbl(path, "lineitem")
+        assert len(rows) == len(cols["l_orderkey"]) == 500
+        for i in (0, 250, 499):
+            for k, v in rows[i].items():
+                got = cols[k][i]
+                assert got == v or abs(got - v) < 1e-9, (k, got, v)
+        # native path actually engaged when the toolchain exists
+        if tblparse.available():
+            assert cols["l_orderkey"].dtype.kind == "i"
+            assert cols["l_extendedprice"].dtype.kind == "f"
+
+    def test_native_error_reporting(self, tmp_path):
+        import pytest
+
+        from netsdb_tpu.native import tblparse
+
+        if not tblparse.available():
+            pytest.skip("native toolchain unavailable")
+        p = tmp_path / "nation.tbl"
+        p.write_text("0|ALGERIA|\n")
+        from netsdb_tpu.workloads.tpch import _TBL_SCHEMAS
+
+        with pytest.raises(ValueError, match="line 1"):
+            tblparse.parse_columnar(str(p), _TBL_SCHEMAS["nation"])
