@@ -17,11 +17,13 @@
 // Tolerates CRLF, requires dbgen's trailing '|' optional, and reports
 // the first malformed line (1-based) in the error message.
 
+#include <charconv>
 #include <cstdint>
 #include <cstdio>
 #include <cstdlib>
 #include <cstring>
 #include <string>
+#include <system_error>
 #include <vector>
 
 namespace {
@@ -54,23 +56,34 @@ bool parse_line(const char* p, const char* end, TblResult* r, int64_t lineno) {
     size_t len = static_cast<size_t>(p - field);
     Column& col = r->cols[c];
     switch (col.type) {
+      // std::from_chars (not strtoll/strtod): locale-independent, and
+      // its error code distinguishes overflow from malformed input —
+      // corrupt out-of-range fields must error, not clamp silently.
       case 0: {
-        char* endp = nullptr;
-        long long v = strtoll(field, &endp, 10);
-        if (len == 0 || endp != field + len) {  // empty must error, as
+        int64_t v = 0;
+        auto res = std::from_chars(field, field + len, v, 10);
+        if (len == 0 || res.ptr != field + len ||
+            res.ec != std::errc()) {  // empty must error, as the Python
           r->error = "line " + std::to_string(lineno) + ": field " +
-                     std::to_string(c + 1) + " is not an integer";
-          return false;  // the Python parser's int("") does
+                     std::to_string(c + 1) +
+                     (res.ec == std::errc::result_out_of_range
+                          ? " overflows int64"
+                          : " is not an integer");
+          return false;  // parser's int("") does
         }
-        col.ints.push_back(static_cast<int64_t>(v));
+        col.ints.push_back(v);
         break;
       }
       case 1: {
-        char* endp = nullptr;
-        double v = strtod(field, &endp);
-        if (len == 0 || endp != field + len) {
+        double v = 0.0;
+        auto res = std::from_chars(field, field + len, v);
+        if (len == 0 || res.ptr != field + len ||
+            res.ec != std::errc()) {
           r->error = "line " + std::to_string(lineno) + ": field " +
-                     std::to_string(c + 1) + " is not a number";
+                     std::to_string(c + 1) +
+                     (res.ec == std::errc::result_out_of_range
+                          ? " is out of double range"
+                          : " is not a number");
           return false;
         }
         col.floats.push_back(v);
@@ -108,15 +121,20 @@ void* tp_parse(const char* path, int n_cols, const int* types) {
       r->cols[static_cast<size_t>(i)].str_offsets.push_back(0);
   }
 
+  // Size the buffer in one allocation: vector-growth reallocation on a
+  // multi-GB .tbl would transiently double the raw-bytes footprint.
   std::vector<char> buf;
-  buf.reserve(1 << 20);
+  if (fseek(f, 0, SEEK_END) == 0) {
+    long sz = ftell(f);
+    if (sz > 0) buf.reserve(static_cast<size_t>(sz) + 1);
+    fseek(f, 0, SEEK_SET);
+  }
   char chunk[1 << 16];
   size_t got;
   while ((got = fread(chunk, 1, sizeof chunk, f)) > 0)
     buf.insert(buf.end(), chunk, chunk + got);
   fclose(f);
-  buf.push_back('\0');  // strtoll/strtod on a final numeric field must
-                        // not scan past the buffer
+  buf.push_back('\0');
 
   const char* p = buf.data();
   const char* end = p + buf.size() - 1;

@@ -223,3 +223,18 @@ class TestNativeTblParse:
 
         with pytest.raises(ValueError, match="line 1"):
             tblparse.parse_columnar(str(p), _TBL_SCHEMAS["nation"])
+
+    def test_native_rejects_int_overflow(self, tmp_path):
+        """Out-of-range integers must error, not clamp to INT64_MAX."""
+        import pytest
+
+        from netsdb_tpu.native import tblparse
+
+        if not tblparse.available():
+            pytest.skip("native toolchain unavailable")
+        from netsdb_tpu.workloads.tpch import _TBL_SCHEMAS
+
+        p = tmp_path / "region.tbl"
+        p.write_text("99999999999999999999999|AFRICA|comment|\n")
+        with pytest.raises(ValueError, match="overflow"):
+            tblparse.parse_columnar(str(p), _TBL_SCHEMAS["region"])
