@@ -107,6 +107,18 @@ def _cmd_tpch(args) -> int:
     return 0
 
 
+def _cmd_tpch_bench(args) -> int:
+    """Columnar TPC-H on device at dbgen scale — the perf counterpart of
+    ``tpch`` (reference baseline: BASELINE.md query times)."""
+    import json
+
+    from netsdb_tpu.relational import bench
+
+    res = bench.main(sf=args.sf, iters=args.iters)
+    print(json.dumps(res, indent=2))
+    return 0
+
+
 def _cmd_selftest(args) -> int:
     """Scripted integration sequence — the reference's
     ``scripts/integratedTests.py:72-240`` (boot pseudo-cluster, then run
@@ -261,10 +273,16 @@ def main(argv=None) -> int:
     p.add_argument("--scale", type=int, default=1)
     p.add_argument("--print-values", action="store_true")
 
+    p = sub.add_parser("tpch-bench",
+                       help="columnar TPC-H device benchmark (dbgen scale)")
+    p.add_argument("--sf", type=float, default=0.1,
+                   help="TPC-H scale factor (lineitem ≈ 6M rows at sf=1)")
+    p.add_argument("--iters", type=int, default=10)
+
     args = parser.parse_args(argv)
     return {"info": _cmd_info, "bench": _cmd_bench, "pdml": _cmd_pdml,
             "demo-ff": _cmd_demo_ff, "tpch": _cmd_tpch,
-            "micro-bench": _cmd_micro_bench,
+            "micro-bench": _cmd_micro_bench, "tpch-bench": _cmd_tpch_bench,
             "selftest": _cmd_selftest}[args.cmd](args)
 
 

@@ -1,0 +1,19 @@
+"""Columnar device-relational engine — the TPU-native redesign of the
+reference's relational core.
+
+The reference executes relational plans row-at-a-time over 64 MB pages
+with hand-written executors (``src/lambdas/headers/Pipeline.h``,
+``src/queryExecution``); its headline numbers are TPC-H query times.
+On TPU the same queries become vectorized array programs: columns are
+device arrays, filters are masks (static shapes — XLA requirement),
+group-by is ``segment_sum``, equi-joins are sort+searchsorted gathers.
+Everything jit-compiles to a single fused XLA program per query.
+
+``netsdb_tpu.workloads.tpch`` (host row DAGs) remains the capability-
+parity path; this package is the performance path.
+"""
+
+from netsdb_tpu.relational.table import ColumnTable, date_to_int
+from netsdb_tpu.relational import kernels
+
+__all__ = ["ColumnTable", "date_to_int", "kernels"]

@@ -1,0 +1,173 @@
+"""TPC-H device benchmark: columnar queries at dbgen-like scale.
+
+The reference's only published end-to-end numbers are TPC-H query
+times on its CPU cluster (SURVEY.md §6 / BASELINE.md: Q01 13.4-17.9 s,
+Q02 77-94 s, Q04 188-210 s, RUN_STAT traces in
+``/root/reference/model-inference/../gen_trace.sql``). This module
+generates SF-scaled columnar tables directly (dbgen row counts:
+lineitem ≈ 6M·SF, orders = 1.5M·SF, customer = 150k·SF, part = 200k·SF)
+and times the jitted columnar queries on the attached device.
+
+Timing protocol (axon tunnel): scalar-pull sync, RTT-subtracted —
+``jax.block_until_ready`` is not a reliable barrier over the tunnel.
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Dict
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from netsdb_tpu.relational.queries import COLUMNAR_QUERIES, Tables
+from netsdb_tpu.relational.table import ColumnTable
+
+# reference-published wall times (seconds) — BASELINE.md §6
+PUBLISHED = {"q01": 13.4, "q02": 77.4, "q04": 188.5}
+
+
+def generate_columnar(sf: float = 0.1, seed: int = 0) -> Tables:
+    """dbgen-shaped synthetic tables, built directly as columns (no row
+    dicts — row generation at SF≥0.1 would dominate the benchmark).
+    Distributions follow dbgen's ranges; string domains are the real
+    TPC-H enumerations, dictionary-encoded."""
+    rng = np.random.default_rng(seed)
+    n_li = int(6_000_000 * sf)
+    n_ord = int(1_500_000 * sf)
+    n_cust = int(150_000 * sf)
+    n_part = int(200_000 * sf)
+
+    def dates(n):
+        return (rng.integers(1992, 1999, n) * 10000
+                + rng.integers(1, 13, n) * 100
+                + rng.integers(1, 29, n)).astype(np.int32)
+
+    flags = ["A", "N", "R"]
+    status = ["F", "O"]
+    modes = ["AIR", "FOB", "MAIL", "RAIL", "REG AIR", "SHIP", "TRUCK"]
+    prios = ["1-URGENT", "2-HIGH", "3-MEDIUM", "4-NOT SPECIFIED", "5-LOW"]
+    segs = ["AUTOMOBILE", "BUILDING", "FURNITURE", "HOUSEHOLD", "MACHINERY"]
+    brands = sorted(f"Brand#{i}{j}" for i in range(1, 6) for j in range(1, 6))
+    containers = sorted(["SM CASE", "MED BOX", "LG JAR", "WRAP PACK",
+                         "JUMBO PKG"])
+    types = sorted(["PROMO BURNISHED", "STANDARD POLISHED",
+                    "ECONOMY ANODIZED", "PROMO PLATED", "MEDIUM BRUSHED"])
+
+    commit = dates(n_li)
+    lineitem = ColumnTable(
+        cols={
+            "l_orderkey": rng.integers(0, n_ord, n_li).astype(np.int32),
+            "l_partkey": rng.integers(0, n_part, n_li).astype(np.int32),
+            "l_quantity": rng.integers(1, 51, n_li).astype(np.int32),
+            "l_extendedprice": (rng.uniform(1000, 100000, n_li)
+                                .astype(np.float32)),
+            "l_discount": np.round(rng.uniform(0.0, 0.1, n_li), 2)
+            .astype(np.float32),
+            "l_tax": np.round(rng.uniform(0.0, 0.08, n_li), 2)
+            .astype(np.float32),
+            "l_returnflag": rng.integers(0, 3, n_li).astype(np.int32),
+            "l_linestatus": rng.integers(0, 2, n_li).astype(np.int32),
+            "l_shipmode": rng.integers(0, 7, n_li).astype(np.int32),
+            "l_shipdate": dates(n_li),
+            "l_commitdate": commit,
+            "l_receiptdate": (commit
+                              + rng.integers(-5, 15, n_li).astype(np.int32)),
+        },
+        dicts={"l_returnflag": flags, "l_linestatus": status,
+               "l_shipmode": modes},
+    )
+    orders = ColumnTable(
+        cols={
+            "o_orderkey": np.arange(n_ord, dtype=np.int32),
+            "o_custkey": rng.integers(0, n_cust, n_ord).astype(np.int32),
+            "o_orderdate": dates(n_ord),
+            "o_orderpriority": rng.integers(0, 5, n_ord).astype(np.int32),
+        },
+        dicts={"o_orderpriority": prios},
+    )
+    customer = ColumnTable(
+        cols={
+            "c_custkey": np.arange(n_cust, dtype=np.int32),
+            "c_mktsegment": rng.integers(0, 5, n_cust).astype(np.int32),
+            "c_acctbal": rng.uniform(-999, 9999, n_cust).astype(np.float32),
+        },
+        dicts={"c_mktsegment": segs},
+    )
+    part = ColumnTable(
+        cols={
+            "p_partkey": np.arange(n_part, dtype=np.int32),
+            "p_brand": rng.integers(0, len(brands), n_part).astype(np.int32),
+            "p_container": rng.integers(0, len(containers), n_part)
+            .astype(np.int32),
+            "p_size": rng.integers(1, 51, n_part).astype(np.int32),
+            "p_type": rng.integers(0, len(types), n_part).astype(np.int32),
+        },
+        dicts={"p_brand": brands, "p_container": containers,
+               "p_type": types},
+    )
+    tables = {"lineitem": lineitem, "orders": orders, "customer": customer,
+              "part": part}
+    for t in tables.values():
+        t.cols = {k: jnp.asarray(v) for k, v in t.cols.items()}
+    return tables
+
+
+def _rtt() -> float:
+    g = jax.jit(lambda v: v + 1)
+    float(g(jnp.float32(0)))
+    t0 = time.perf_counter()
+    for _ in range(5):
+        float(g(jnp.float32(0)))
+    return (time.perf_counter() - t0) / 5
+
+
+def bench_queries(tables: Tables,
+                  names=("q01", "q03", "q04", "q06", "q12", "q13", "q14",
+                         "q17"),
+                  iters: int = 10) -> Dict[str, Dict[str, float]]:
+    """Steady-state per-query seconds (compile excluded — the compiled-
+    plan cache is the reference's PreCompiledWorkload, so steady state
+    is the honest comparison; compile time is reported separately)."""
+    out: Dict[str, Dict[str, float]] = {}
+    rtt = _rtt()
+    n_li = tables["lineitem"].num_rows
+    for name in names:
+        fn = COLUMNAR_QUERIES[name]
+        t0 = time.perf_counter()
+        fn(tables)  # compile + first run (result pull syncs)
+        first = time.perf_counter() - t0
+        t0 = time.perf_counter()
+        for _ in range(iters):
+            fn(tables)
+        wall = (time.perf_counter() - t0) / iters
+        dev = wall - rtt
+        entry = {"seconds_wall": wall, "first_run_seconds": first,
+                 "controller_rtt": rtt,
+                 "lineitem_rows_per_sec": n_li / wall}
+        if dev > 0.2 * rtt:
+            entry["seconds_device"] = dev
+        else:
+            # query finishes inside controller-RTT noise; wall time is
+            # an upper bound and the device time is unresolvable
+            entry["seconds_device_below_rtt"] = True
+        out[name] = entry
+    return out
+
+
+def main(sf: float = 0.1, iters: int = 10):
+    tables = generate_columnar(sf)
+    res = bench_queries(tables, iters=iters)
+    # published-baseline comparison only at SF 1: the reference's scale
+    # factor is unrecorded, and dividing its full-scale wall time by a
+    # smaller run's would inflate the ratio by the scale difference
+    if sf >= 1.0:
+        for name, secs in PUBLISHED.items():
+            if name in res:
+                res[name]["published_baseline_seconds"] = secs
+                res[name]["speedup_vs_published"] = \
+                    secs / res[name]["seconds_wall"]
+    return {"scale_factor": sf,
+            "lineitem_rows": tables["lineitem"].num_rows,
+            "queries": res}

@@ -1,0 +1,224 @@
+"""ColumnTable: a relation as a struct of device arrays.
+
+Design rules (all driven by XLA's static-shape compilation model):
+
+- **Numeric columns** are ``int32`` / ``float32`` device arrays.
+- **String columns** are dictionary-encoded at ingest: an ``int32``
+  code array plus a host-side ``list[str]`` dictionary. Predicates on
+  strings become integer compares on device; the strings themselves
+  never leave the host.
+- **Dates** are ``int32`` yyyymmdd (order-isomorphic to ISO strings, so
+  range predicates are int compares — same trick the reference's
+  drivers use with encoded ints, ``src/tpch/source/Query06/``).
+- **Filters never shrink arrays.** A filtered table keeps every row and
+  carries a boolean ``valid`` mask; aggregations apply the mask. This
+  keeps every intermediate shape static so one jit covers all
+  selectivities. (The reference's row pipeline has the same structure
+  inverted: its FilterExecutor emits a bitmap consumed downstream —
+  ``src/lambdas/headers/FilterExecutor.h``.)
+
+Row↔column conversion accepts the row dicts produced by
+``workloads.tpch.generate``/``parse_tbl`` so the columnar engine can be
+golden-tested against the host row engine on identical data.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import re
+from typing import Any, Dict, List, Optional, Sequence
+
+import jax.numpy as jnp
+import numpy as np
+
+_DATE_RE = re.compile(r"^(\d{4})-(\d{2})-(\d{2})$")
+
+
+def date_to_int(s: str) -> int:
+    """ISO date string → yyyymmdd int32."""
+    m = _DATE_RE.match(s)
+    if not m:
+        raise ValueError(f"not an ISO date: {s!r}")
+    y, mo, d = m.groups()
+    return int(y) * 10000 + int(mo) * 100 + int(d)
+
+
+def int_to_date(v: int) -> str:
+    v = int(v)
+    return f"{v // 10000:04d}-{(v // 100) % 100:02d}-{v % 100:02d}"
+
+
+@dataclasses.dataclass
+class ColumnTable:
+    """A relation: named device columns + optional validity mask.
+
+    ``dicts[name]`` present ⇒ ``cols[name]`` holds int32 codes into it.
+    ``valid`` of None means "all rows valid" (saves a mask op on the
+    common unfiltered scan).
+    """
+
+    cols: Dict[str, jnp.ndarray]
+    dicts: Dict[str, List[str]] = dataclasses.field(default_factory=dict)
+    valid: Optional[jnp.ndarray] = None
+
+    # --- construction -------------------------------------------------
+    @staticmethod
+    def from_rows(rows: Sequence[Dict[str, Any]],
+                  date_cols: Sequence[str] = ()) -> "ColumnTable":
+        """Build from row dicts. Column kinds are inferred from the first
+        row: str → dictionary-encoded (unless named in ``date_cols`` or
+        shaped like an ISO date, then yyyymmdd int32), int → int32,
+        float → float32."""
+        if not rows:
+            raise ValueError("from_rows needs at least one row")
+        names = list(rows[0].keys())
+        cols: Dict[str, jnp.ndarray] = {}
+        dicts: Dict[str, List[str]] = {}
+        for name in names:
+            v0 = rows[0][name]
+            values = [r[name] for r in rows]
+            if isinstance(v0, str):
+                if name in date_cols or _DATE_RE.match(v0):
+                    cols[name] = jnp.asarray(
+                        np.fromiter((date_to_int(v) for v in values),
+                                    np.int32, len(values)))
+                else:
+                    uniq = sorted(set(values))
+                    code = {s: i for i, s in enumerate(uniq)}
+                    cols[name] = jnp.asarray(
+                        np.fromiter((code[v] for v in values),
+                                    np.int32, len(values)))
+                    dicts[name] = uniq
+            elif isinstance(v0, bool):
+                cols[name] = jnp.asarray(np.asarray(values, np.bool_))
+            elif isinstance(v0, int):
+                cols[name] = jnp.asarray(np.asarray(values, np.int32))
+            else:
+                cols[name] = jnp.asarray(np.asarray(values, np.float32))
+        return ColumnTable(cols, dicts)
+
+    @staticmethod
+    def from_columns(cols: Dict[str, np.ndarray],
+                     dicts: Optional[Dict[str, List[str]]] = None,
+                     date_cols: Sequence[str] = ()) -> "ColumnTable":
+        """Build from the columnar parser's output
+        (``workloads.tpch.parse_tbl_columnar``): numeric numpy arrays
+        and object arrays of strings."""
+        out: Dict[str, jnp.ndarray] = {}
+        dd: Dict[str, List[str]] = dict(dicts or {})
+        for name, arr in cols.items():
+            a = np.asarray(arr)
+            if a.dtype.kind in "OUS":
+                vals = [str(x) for x in a.tolist()]
+                if name in date_cols or (len(vals) and _DATE_RE.match(vals[0])):
+                    out[name] = jnp.asarray(
+                        np.fromiter((date_to_int(v) for v in vals),
+                                    np.int32, len(vals)))
+                else:
+                    uniq = sorted(set(vals))
+                    code = {s: i for i, s in enumerate(uniq)}
+                    out[name] = jnp.asarray(
+                        np.fromiter((code[v] for v in vals),
+                                    np.int32, len(vals)))
+                    dd[name] = uniq
+            elif a.dtype.kind == "i":
+                out[name] = jnp.asarray(a.astype(np.int32))
+            elif a.dtype.kind == "f":
+                out[name] = jnp.asarray(a.astype(np.float32))
+            else:
+                out[name] = jnp.asarray(a)
+        return ColumnTable(out, dd)
+
+    # --- shape / access ----------------------------------------------
+    @property
+    def num_rows(self) -> int:
+        return int(next(iter(self.cols.values())).shape[0])
+
+    def __getitem__(self, name: str) -> jnp.ndarray:
+        return self.cols[name]
+
+    def mask(self) -> jnp.ndarray:
+        """Validity as a bool array (materializes all-true if unset)."""
+        if self.valid is not None:
+            return self.valid
+        n = self.num_rows
+        return jnp.ones((n,), jnp.bool_)
+
+    def code(self, name: str, value: str) -> int:
+        """Dictionary code of ``value`` in string column ``name``; -1 if
+        absent (compares false against every row on device)."""
+        try:
+            return self.dicts[name].index(value)
+        except ValueError:
+            return -1
+
+    def codes_where(self, name: str, pred) -> List[int]:
+        """All dictionary codes whose string satisfies ``pred`` — for
+        LIKE-style predicates evaluated once on the host dictionary
+        instead of per row (e.g. Q02 'ends with BRUSHED', Q13 comment
+        NOT LIKE)."""
+        return [i for i, s in enumerate(self.dicts[name]) if pred(s)]
+
+    def decode(self, name: str, code: int) -> str:
+        return self.dicts[name][int(code)]
+
+    # --- relational verbs (mask algebra) ------------------------------
+    def filter(self, mask: jnp.ndarray) -> "ColumnTable":
+        """AND a predicate mask into validity. Shapes unchanged."""
+        new = mask if self.valid is None else (self.valid & mask)
+        return ColumnTable(self.cols, self.dicts, new)
+
+    def select(self, names: Sequence[str]) -> "ColumnTable":
+        return ColumnTable({n: self.cols[n] for n in names},
+                           {n: d for n, d in self.dicts.items() if n in names},
+                           self.valid)
+
+    def with_column(self, name: str, arr: jnp.ndarray,
+                    dictionary: Optional[List[str]] = None) -> "ColumnTable":
+        cols = dict(self.cols)
+        cols[name] = arr
+        dicts = dict(self.dicts)
+        if dictionary is not None:
+            dicts[name] = dictionary
+        return ColumnTable(cols, dicts, self.valid)
+
+    # --- persistence (store spill / checkpoint) -----------------------
+    def __getstate__(self):
+        """Pickle via host numpy (device arrays aren't spill-portable);
+        lets a ColumnTable live in a SetStore set like any object and
+        survive ``flush``/``load_set``."""
+        return {"cols": {n: np.asarray(c) for n, c in self.cols.items()},
+                "dicts": self.dicts,
+                "valid": None if self.valid is None else np.asarray(self.valid)}
+
+    def __setstate__(self, state):
+        self.cols = {n: jnp.asarray(c) for n, c in state["cols"].items()}
+        self.dicts = state["dicts"]
+        v = state["valid"]
+        self.valid = None if v is None else jnp.asarray(v)
+
+    # --- host materialization ----------------------------------------
+    def to_rows(self, date_cols: Sequence[str] = ()) -> List[Dict[str, Any]]:
+        """Decode to row dicts (drops invalid rows). Host-side; for
+        tests and result iteration, not the hot path."""
+        host = {n: np.asarray(c) for n, c in self.cols.items()}
+        ok = np.asarray(self.mask())
+        out = []
+        for i in range(len(ok)):
+            if not ok[i]:
+                continue
+            row = {}
+            for n, c in host.items():
+                v = c[i]
+                if n in self.dicts:
+                    row[n] = self.dicts[n][int(v)]
+                elif n in date_cols:
+                    row[n] = int_to_date(int(v))
+                elif c.dtype.kind == "f":
+                    row[n] = float(v)
+                elif c.dtype.kind == "b":
+                    row[n] = bool(v)
+                else:
+                    row[n] = int(v)
+            out.append(row)
+        return out

@@ -212,6 +212,35 @@ def load_tbl_dir(client, directory: str, db: str = "tpch",
     return counts
 
 
+def load_tbl_dir_columnar(client, directory: str, db: str = "tpch",
+                          tables=None) -> Dict[str, int]:
+    """Columnar dbgen ingestion: ``<table>.tbl`` → one ColumnTable per
+    set (native parser fast path), the input format of the device
+    relational engine (:mod:`netsdb_tpu.relational`). Returns
+    {table: row count}."""
+    import os
+
+    from netsdb_tpu.relational.table import ColumnTable
+
+    date_cols = {"o_orderdate", "l_shipdate", "l_commitdate",
+                 "l_receiptdate"}
+    counts = {}
+    client.create_database(db)
+    for table in (tables or sorted(_TBL_SCHEMAS)):
+        path = os.path.join(directory, f"{table}.tbl")
+        if not os.path.exists(path):
+            continue
+        cols = parse_tbl_columnar(path, table)
+        ct = ColumnTable.from_columns(cols, date_cols=date_cols)
+        set_name = f"{table}_columnar"
+        if not client.set_exists(db, set_name):
+            client.create_set(db, set_name, type_name="columnar")
+        client.clear_set(db, set_name)
+        client.send_data(db, set_name, [ct])
+        counts[table] = ct.num_rows
+    return counts
+
+
 def load_tables(client, db: str = "tpch", tables=None, scale: int = 1,
                 seed: int = 0) -> None:
     """``tpchDataLoader`` analogue."""
