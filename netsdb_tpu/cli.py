@@ -221,6 +221,19 @@ def _cmd_selftest(args) -> int:
     return 1 if failures else 0
 
 
+def _cmd_la_bench(args) -> int:
+    """The reference's headline LA tasks (Gram / linreg / matmul at
+    200000x1000 scale — BASELINE.md rows 1-3) via the PDML DSL."""
+    from netsdb_tpu.workloads import la_tasks
+
+    tasks = list(la_tasks.TASKS) if args.task == "all" else [args.task]
+    for t in tasks:
+        res = la_tasks.run_task(t, rows=args.rows, cols=args.cols,
+                                block=args.block, iters=args.iters)
+        print(json.dumps(res))
+    return 0
+
+
 def _cmd_micro_bench(args) -> int:
     from netsdb_tpu.workloads import micro_bench
 
@@ -258,6 +271,16 @@ def main(argv=None) -> int:
     p.add_argument("--labels", type=int, default=10)
     p.add_argument("--block", type=int, default=256)
 
+    p = sub.add_parser("la-bench",
+                       help="headline LA tasks (Gram/linreg/matmul) vs "
+                            "the reference's published numbers")
+    p.add_argument("--task", default="all",
+                   choices=["all", "gram", "linreg", "matmul"])
+    p.add_argument("--rows", type=int, default=200000)
+    p.add_argument("--cols", type=int, default=1000)
+    p.add_argument("--block", type=int, default=1000)
+    p.add_argument("--iters", type=int, default=5)
+
     p = sub.add_parser("micro-bench",
                        help="runtime micro-benchmarks (serviceBenchmarks)")
     p.add_argument("--only", default=None,
@@ -283,6 +306,7 @@ def main(argv=None) -> int:
     return {"info": _cmd_info, "bench": _cmd_bench, "pdml": _cmd_pdml,
             "demo-ff": _cmd_demo_ff, "tpch": _cmd_tpch,
             "micro-bench": _cmd_micro_bench, "tpch-bench": _cmd_tpch_bench,
+            "la-bench": _cmd_la_bench,
             "selftest": _cmd_selftest}[args.cmd](args)
 
 

@@ -76,8 +76,8 @@ def _q01_core(n_groups, n_ls, ship, rf, ls, qty, price, disc, tax, delta):
     charge = disc_price * (1.0 + tax)
     rows = [K.segment_sum(v, seg, n_groups, mask)
             for v in (qty, price, disc_price, charge, disc)]
-    rows.append(K.segment_count(seg, n_groups, mask).astype(jnp.float32))
-    return jnp.stack(rows)  # (6, n_groups) — one host pull
+    # counts stay int32: a float32 count saturates at 2^24 rows/group
+    return jnp.stack(rows), K.segment_count(seg, n_groups, mask)
 
 
 def cq01(tables: Tables, delta_date: str = "1998-09-02"):
@@ -85,7 +85,7 @@ def cq01(tables: Tables, delta_date: str = "1998-09-02"):
     li = tables["lineitem"]
     n_ls = len(li.dicts["l_linestatus"])
     n_groups = len(li.dicts["l_returnflag"]) * n_ls
-    packed = np.asarray(_q01_core(
+    sums, counts = jax.device_get(_q01_core(
         n_groups, n_ls, li["l_shipdate"], li["l_returnflag"],
         li["l_linestatus"], li["l_quantity"], li["l_extendedprice"],
         li["l_discount"], li["l_tax"], date_to_int(delta_date)))
@@ -93,12 +93,12 @@ def cq01(tables: Tables, delta_date: str = "1998-09-02"):
              "sum_disc")
     out = []
     for g in range(n_groups):
-        cnt = int(packed[5, g])
+        cnt = int(counts[g])
         if cnt == 0:
             continue
         key = (li.decode("l_returnflag", g // n_ls),
                li.decode("l_linestatus", g % n_ls))
-        v = {names[i]: float(packed[i, g]) for i in range(5)}
+        v = {names[i]: float(sums[i, g]) for i in range(5)}
         v["count"] = cnt
         v["avg_qty"] = v["sum_qty"] / cnt
         v["avg_price"] = v["sum_base_price"] / cnt
@@ -285,14 +285,25 @@ def cq12(tables: Tables, mode1: str = "MAIL", mode2: str = "SHIP",
 
 
 # ---------------------------------------------------------------- Q13
-@functools.partial(jax.jit, static_argnums=(0,))
-def _q13_counts(n_cust, o_cust, keep):
-    return K.segment_count(o_cust, n_cust, keep)
+# Static histogram domain: per-customer order counts are ~10-40 at any
+# dbgen scale factor (orders/customer is fixed by the spec), so a
+# generous static cap keeps n_buckets host-static — no mid-query host
+# pull of max(counts) and no per-dataset recompile. Overflow (counts
+# >= cap) is detected on device and handled by an exact host fallback.
+_Q13_CAP = 256
+
+
+@functools.partial(jax.jit, static_argnums=(0, 1))
+def _q13_core(n_cust, cap, o_cust, keep, c_key):
+    counts = K.segment_count(o_cust, n_cust, keep)
+    per_cust = jnp.take(counts, c_key)
+    hist = K.bincount_masked(jnp.minimum(per_cust, cap - 1), cap)
+    return hist, jnp.max(per_cust, initial=0)
 
 
 @functools.partial(jax.jit, static_argnums=(0,))
-def _q13_hist(n_buckets, counts, c_key):
-    return K.bincount_masked(jnp.take(counts, c_key), n_buckets)
+def _q13_per_cust(n_cust, o_cust, keep, c_key):
+    return jnp.take(K.segment_count(o_cust, n_cust, keep), c_key)
 
 
 def cq13(tables: Tables, word1: str = "special", word2: str = "requests"):
@@ -309,10 +320,14 @@ def cq13(tables: Tables, word1: str = "special", word2: str = "requests"):
         keep = jnp.take(keep_lut, orders["o_comment"])
     else:
         keep = jnp.ones((orders.num_rows,), jnp.bool_)
-    counts = _q13_counts(n_cust, orders["o_custkey"], keep)
-    n_buckets = int(jnp.max(counts)) + 1
-    hist = np.asarray(_q13_hist(n_buckets, counts, cust["c_custkey"]))
-    return [(i, int(hist[i])) for i in range(n_buckets) if hist[i]]
+    hist, maxc = jax.device_get(_q13_core(
+        n_cust, _Q13_CAP, orders["o_custkey"], keep, cust["c_custkey"]))
+    maxc = int(maxc)
+    if maxc >= _Q13_CAP:  # beyond any dbgen shape: exact host fallback
+        per = np.asarray(_q13_per_cust(n_cust, orders["o_custkey"], keep,
+                                       cust["c_custkey"]))
+        hist = np.bincount(per, minlength=maxc + 1)
+    return [(i, int(hist[i])) for i in range(maxc + 1) if hist[i]]
 
 
 # ---------------------------------------------------------------- Q14

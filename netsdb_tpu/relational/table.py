@@ -48,6 +48,21 @@ def int_to_date(v: int) -> str:
     return f"{v // 10000:04d}-{(v // 100) % 100:02d}-{v % 100:02d}"
 
 
+def _encode_strings(values: List[str], is_date: bool):
+    """Shared string-column encoder: ISO dates → yyyymmdd int32 (no
+    dictionary), anything else → dictionary codes. Returns
+    ``(codes, dictionary_or_None)``. Single definition so both ingestion
+    paths (from_rows / from_columns) stay type-identical on the same
+    data."""
+    if is_date:
+        return jnp.asarray(np.fromiter((date_to_int(v) for v in values),
+                                       np.int32, len(values))), None
+    uniq = sorted(set(values))
+    code = {s: i for i, s in enumerate(uniq)}
+    return jnp.asarray(np.fromiter((code[v] for v in values),
+                                   np.int32, len(values))), uniq
+
+
 @dataclasses.dataclass
 class ColumnTable:
     """A relation: named device columns + optional validity mask.
@@ -78,16 +93,9 @@ class ColumnTable:
             v0 = rows[0][name]
             values = [r[name] for r in rows]
             if isinstance(v0, str):
-                if name in date_cols or _DATE_RE.match(v0):
-                    cols[name] = jnp.asarray(
-                        np.fromiter((date_to_int(v) for v in values),
-                                    np.int32, len(values)))
-                else:
-                    uniq = sorted(set(values))
-                    code = {s: i for i, s in enumerate(uniq)}
-                    cols[name] = jnp.asarray(
-                        np.fromiter((code[v] for v in values),
-                                    np.int32, len(values)))
+                is_date = name in date_cols or bool(_DATE_RE.match(v0))
+                cols[name], uniq = _encode_strings(values, is_date)
+                if uniq is not None:
                     dicts[name] = uniq
             elif isinstance(v0, bool):
                 cols[name] = jnp.asarray(np.asarray(values, np.bool_))
@@ -110,16 +118,10 @@ class ColumnTable:
             a = np.asarray(arr)
             if a.dtype.kind in "OUS":
                 vals = [str(x) for x in a.tolist()]
-                if name in date_cols or (len(vals) and _DATE_RE.match(vals[0])):
-                    out[name] = jnp.asarray(
-                        np.fromiter((date_to_int(v) for v in vals),
-                                    np.int32, len(vals)))
-                else:
-                    uniq = sorted(set(vals))
-                    code = {s: i for i, s in enumerate(uniq)}
-                    out[name] = jnp.asarray(
-                        np.fromiter((code[v] for v in vals),
-                                    np.int32, len(vals)))
+                is_date = name in date_cols or bool(
+                    len(vals) and _DATE_RE.match(vals[0]))
+                out[name], uniq = _encode_strings(vals, is_date)
+                if uniq is not None:
                     dd[name] = uniq
             elif a.dtype.kind == "i":
                 out[name] = jnp.asarray(a.astype(np.int32))

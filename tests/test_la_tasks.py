@@ -1,0 +1,67 @@
+"""Headline LA tasks (workloads/la_tasks.py) — golden numerics vs NumPy
+at small scale, including ragged (non-dividing) blocking, plus the
+whole-program jit path (compile_pdml) against eager DSL evaluation.
+
+Reference scenario: the Gram / linear-regression / matmul tasks of
+``selfLearning/documentation.md:5-10``, driven through the LA DSL
+(``TestLA21_Instance.cc``)."""
+
+import numpy as np
+import pytest
+
+from netsdb_tpu.workloads import la_tasks
+from netsdb_tpu.dsl.interp import LAInterpreter
+
+ROWS, COLS, BLOCK = 50, 12, 8  # ragged on purpose
+LAM = 1.0
+
+
+def _np_env(task):
+    env = la_tasks.make_inputs(task, ROWS, COLS, BLOCK, lam=LAM)
+    return env, {k: np.asarray(v.to_dense()) for k, v in env.items()}
+
+
+@pytest.mark.parametrize("task", la_tasks.TASKS)
+def test_task_matches_numpy(task):
+    env, npenv = _np_env(task)
+    out = la_tasks.compile_pdml(la_tasks.PROGRAMS[task])(env)
+    X = npenv["X"].astype(np.float64)
+    if task == "gram":
+        got = np.asarray(out["G"].to_dense())
+        want = X.T @ X
+    elif task == "matmul":
+        got = np.asarray(out["C"].to_dense())
+        want = X @ npenv["W"].astype(np.float64)
+    else:
+        got = np.asarray(out["w"].to_dense())
+        want = np.linalg.solve(X.T @ X + LAM * np.eye(COLS),
+                               X.T @ npenv["y"].astype(np.float64))
+    assert got.shape == want.shape
+    np.testing.assert_allclose(got, want, rtol=2e-4, atol=2e-4)
+
+
+@pytest.mark.parametrize("task", la_tasks.TASKS)
+def test_jit_matches_eager(task):
+    env, _ = _np_env(task)
+    jitted = la_tasks.compile_pdml(la_tasks.PROGRAMS[task])(env)
+    interp = LAInterpreter()
+    interp.env.update(env)
+    eager = interp.run(la_tasks.PROGRAMS[task])
+    for name, val in jitted.items():
+        np.testing.assert_allclose(np.asarray(val.to_dense()),
+                                   np.asarray(eager[name].to_dense()),
+                                   rtol=1e-5, atol=1e-5)
+
+
+def test_run_task_reports_baselines():
+    res = la_tasks.run_task("gram", rows=64, cols=16, block=8, iters=2)
+    assert res["ref_best_s"] == 22.78 and res["ref_plain_s"] == 41.27
+    assert res["exec_s_median"] > 0 and res["speedup_vs_ref_best"] > 0
+
+
+def test_make_inputs_zero_margin():
+    env = la_tasks.make_inputs("linreg", ROWS, COLS, BLOCK, lam=LAM)
+    for t in env.values():
+        data = np.asarray(t.data)
+        mask = np.asarray(t.mask())
+        assert np.all(data[mask == 0.0] == 0.0)

@@ -27,10 +27,8 @@ def tables(data):
 
 @pytest.fixture(scope="module")
 def row_results(data):
-    """Run every row-engine query once on a shared client."""
-    import jax
-
-    jax.config.update("jax_platforms", "cpu")
+    """Run every row-engine query once on a shared client.
+    (Platform is pinned to the virtual CPU mesh by conftest.py.)"""
     import tempfile
 
     from netsdb_tpu.client import Client
@@ -214,6 +212,15 @@ class TestColumnarVsRowEngine:
     def test_query_matches(self, name, tables, row_results):
         got = COLUMNAR_QUERIES[name](tables)
         self._close(got, row_results[name], name)
+
+    def test_q13_empty_customer_table(self, tables):
+        """Zero-row customer (reachable via from_columns loaders) must
+        yield an empty histogram, not a zero-size reduction error."""
+        t2 = dict(tables)
+        t2["customer"] = ColumnTable(
+            {"c_custkey": np.zeros((0,), np.int32)})
+        got = COLUMNAR_QUERIES["q13"](t2)
+        assert got == [] or all(cnt == 0 for _, cnt in got)
 
     def test_q02_independent_of_nation_row_order(self, data, tables,
                                                  row_results):
