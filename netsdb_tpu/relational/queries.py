@@ -159,9 +159,8 @@ def cq02(tables: Tables, size: int = 15, type_suffix: str = "BRUSHED",
     s_names = np.asarray(sup["s_name"])
     n_names = np.asarray(nat["n_name"])
     out = []
-    for pk in range(n_part):
-        if not ints[0, pk]:
-            continue
+    for pk in np.nonzero(ints[0])[0]:  # only qualifying parts
+        pk = int(pk)
         out.append((pk, {"partkey": pk, "cost": float(cost_min[pk]),
                          "s_name": sup.decode(
                              "s_name", int(s_names[ints[1, pk]])),
