@@ -234,6 +234,18 @@ def _cmd_la_bench(args) -> int:
     return 0
 
 
+def _cmd_conv_bench(args) -> int:
+    """Conv2d batch-latency p50 (both modes) vs the reference's ATen
+    CPU path at its documented shapes."""
+    from netsdb_tpu.workloads.conv_bench import run_conv_bench
+
+    print(json.dumps(run_conv_bench(
+        batch=args.batch, hw=args.hw, cin=args.cin, cout=args.cout,
+        k=args.k, iters=args.iters,
+        compute_dtype=args.compute_dtype), indent=2))
+    return 0
+
+
 def _cmd_micro_bench(args) -> int:
     from netsdb_tpu.workloads import micro_bench
 
@@ -281,6 +293,16 @@ def main(argv=None) -> int:
     p.add_argument("--block", type=int, default=1000)
     p.add_argument("--iters", type=int, default=5)
 
+    p = sub.add_parser("conv-bench",
+                       help="conv2d batch latency p50 vs ATen CPU path")
+    p.add_argument("--batch", type=int, default=64)
+    p.add_argument("--hw", type=int, default=112)
+    p.add_argument("--cin", type=int, default=3)
+    p.add_argument("--cout", type=int, default=64)
+    p.add_argument("--k", type=int, default=7)
+    p.add_argument("--iters", type=int, default=20)
+    p.add_argument("--compute-dtype", default=None)
+
     p = sub.add_parser("micro-bench",
                        help="runtime micro-benchmarks (serviceBenchmarks)")
     p.add_argument("--only", default=None,
@@ -306,7 +328,7 @@ def main(argv=None) -> int:
     return {"info": _cmd_info, "bench": _cmd_bench, "pdml": _cmd_pdml,
             "demo-ff": _cmd_demo_ff, "tpch": _cmd_tpch,
             "micro-bench": _cmd_micro_bench, "tpch-bench": _cmd_tpch_bench,
-            "la-bench": _cmd_la_bench,
+            "la-bench": _cmd_la_bench, "conv-bench": _cmd_conv_bench,
             "selftest": _cmd_selftest}[args.cmd](args)
 
 

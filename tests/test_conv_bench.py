@@ -1,0 +1,31 @@
+"""Conv bench harness — smoke at tiny shape plus a torch differential
+oracle: our direct conv must numerically match the reference's ATen op
+(``src/conv2d_proj/headers/Conv2DSelect.h``) on identical inputs."""
+
+import numpy as np
+
+from netsdb_tpu.workloads.conv_bench import run_conv_bench
+
+
+def test_conv_bench_smoke():
+    res = run_conv_bench(batch=2, hw=16, cin=3, cout=4, k=3, iters=2)
+    for mode in ("direct", "im2col"):
+        assert res[mode]["p50_ms"] > 0
+        assert res[mode]["p90_ms"] >= res[mode]["p50_ms"]
+        assert res[mode]["speedup_vs_torch_cpu_p50"] > 0
+    assert res["torch_cpu_reference"]["p50_ms"] > 0
+
+
+def test_direct_matches_torch():
+    import torch
+    import jax.numpy as jnp
+
+    from netsdb_tpu.ops.conv import conv2d_direct
+
+    rng = np.random.default_rng(4)
+    x = rng.standard_normal((2, 3, 12, 12)).astype(np.float32)
+    w = rng.standard_normal((5, 3, 3, 3)).astype(np.float32)
+    ours = np.asarray(conv2d_direct(jnp.asarray(x), jnp.asarray(w)))
+    with torch.no_grad():
+        ref = torch.conv2d(torch.from_numpy(x), torch.from_numpy(w)).numpy()
+    np.testing.assert_allclose(ours, ref, rtol=1e-4, atol=1e-4)
