@@ -84,10 +84,11 @@ def main():
     config = Configuration(root_dir="/tmp/netsdb_bench",
                            default_block_shape=BLOCK)
     client = Client(config)
+    from netsdb_tpu.ops.common import on_tpu
+
     # bfloat16 compute on TPU MXU; f32 on CPU for a fair functional run
-    on_tpu = jax.default_backend() in ("tpu", "axon")
     model = FFModel(db="bench", block=BLOCK,
-                    compute_dtype="bfloat16" if on_tpu else None)
+                    compute_dtype="bfloat16" if on_tpu() else None)
     model.setup(client)
     model.load_random_weights(client, FEATURES, HIDDEN, LABELS, seed=1)
     x = rng.standard_normal((BATCH, FEATURES)).astype(np.float32)
@@ -104,20 +105,36 @@ def main():
     out = fwd(params, xb)
     float(jnp.sum(out.data))
 
-    # measure controller<->device round-trip to subtract it out
-    g = jax.jit(lambda v: v + 1)
-    float(g(jnp.float32(0)))
-    t0 = time.perf_counter()
-    for _ in range(5):
-        float(g(jnp.float32(0)))
-    rtt = (time.perf_counter() - t0) / 5
+    # Timing protocol: the controller<->device tunnel adds a large NOISY
+    # per-dispatch overhead (tens to hundreds of ms), so per-dispatch
+    # wall times are useless. Instead the iteration loop runs ON DEVICE
+    # via lax.scan — each iteration's input depends on the previous
+    # output (a +0-sized scalar perturbation), so XLA can neither hoist
+    # the forward pass out of the loop nor elide iterations — and
+    # throughput is the slope between a short and a long scan, which
+    # cancels the fixed dispatch+sync overhead exactly. Median of 3.
+    from functools import partial
 
-    iters = 50
-    t0 = time.perf_counter()
-    for _ in range(iters):
-        out = fwd(params, xb)
-    float(jnp.sum(out.data))  # sync
-    dt = max(time.perf_counter() - t0 - rtt, 1e-9) / iters
+    @partial(jax.jit, static_argnums=2)
+    def loop(p, x0, n):
+        def step(carry, _):
+            x = x0.with_data(x0.data + carry)
+            o = model.forward(p, x)
+            return o.data[0, 0].astype(jnp.float32) * 1e-20, None
+        c, _ = jax.lax.scan(step, jnp.float32(0.0), None, length=n)
+        return c
+
+    lo, hi = 4, 36
+    for n in (lo, hi):
+        float(loop(params, xb, n))  # compile + warm
+
+    def timed(n: int) -> float:
+        t0 = time.perf_counter()
+        float(loop(params, xb, n))  # scalar pull = real sync
+        return time.perf_counter() - t0
+
+    slopes = sorted((timed(hi) - timed(lo)) / (hi - lo) for _ in range(3))
+    dt = max(slopes[1], 1e-9)
     rows_per_sec = BATCH / dt
 
     # baseline: measured reference-equivalent CPU number

@@ -100,7 +100,8 @@ class FFModel:
         wo = ScanSet(self.db, "wo")
         bo = ScanSet(self.db, "bo")
         # FFTransposeMult + FFAggMatrix: w1 · inputsᵀ → (hidden x batch)
-        h = Join(w1, inputs, fn=lambda w, x: matmul_t(w, x, cd),
+        h = Join(w1, inputs, fn=lambda w, x: matmul_t(w, x, cd,
+                                                      accum_dtype=cd),
                  label="FFTransposeMult")
         # FFReluBiasSum
         y1 = Join(h, b1,
@@ -121,6 +122,43 @@ class FFModel:
         results = client.execute_computations(sink, job_name=f"{self.db}-inference")
         return next(iter(results.values()))
 
+    def build_fused_inference_dag(self, params: "FFParams",
+                                  out_mode: str = "softmax") -> WriteSet:
+        """Whole network inside ONE computation — the reference's
+        ``src/FF_proj`` variant (``FullyConnectedNetwork.h:18-127``): a
+        single SelectionComp holding all weights as members, scanning
+        only the input set. ``out_mode="label"`` mirrors FF_proj's head
+        (sigmoid then 0.5-threshold ``outLabel`` —
+        ``FullyConnectedNetwork.cc:13-25``); "softmax" uses the standard
+        inference tail."""
+        if out_mode not in ("softmax", "label"):
+            raise ValueError(
+                f"out_mode must be 'softmax' or 'label', got {out_mode!r}")
+        cd = self.compute_dtype
+
+        def whole_network(x: BlockedTensor) -> BlockedTensor:
+            h = nn_ops.bias_relu(matmul_t(params.w1, x, cd, accum_dtype=cd),
+                                 params.b1)
+            yo = matmul(params.wo, h, cd)
+            if out_mode == "label":
+                p = nn_ops.bias_sigmoid(yo, params.bo)
+                # padding margins are sigmoid-remasked to 0 → stay 0
+                return p.with_data((p.data > 0.5).astype(p.data.dtype))
+            return nn_ops.ff_output_layer(yo, params.bo, axis=0)
+
+        net = Apply(ScanSet(self.db, "inputs"), whole_network,
+                    label="FullyConnectedNetwork")
+        return WriteSet(net, self.db, "output")
+
+    def inference_fused(self, client: Client,
+                        out_mode: str = "softmax") -> BlockedTensor:
+        """FF_proj-style single-UDF inference over stored weights."""
+        sink = self.build_fused_inference_dag(self.params_from_store(client),
+                                              out_mode)
+        results = client.execute_computations(
+            sink, job_name=f"{self.db}-inference-fused-{out_mode}")
+        return next(iter(results.values()))
+
     # --- pure-function forms (for jit/bench/sharding) -----------------
     def params_from_store(self, client: Client) -> FFParams:
         return FFParams(
@@ -132,15 +170,20 @@ class FFModel:
 
     def forward(self, params: FFParams, inputs: BlockedTensor) -> BlockedTensor:
         """(batch x features) → softmax probs (labels x batch). Same math
-        as the DAG, one traced function."""
+        as the DAG, one traced function. When reduced precision is opted
+        in (``compute_dtype``) the hidden activation also stays in that
+        dtype (accum_dtype), halving its HBM traffic; the output layer
+        always accumulates f32 for the softmax."""
         cd = self.compute_dtype
-        h = nn_ops.bias_relu(matmul_t(params.w1, inputs, cd), params.b1)
+        h = nn_ops.bias_relu(matmul_t(params.w1, inputs, cd, accum_dtype=cd),
+                             params.b1)
         yo = matmul(params.wo, h, cd)
         return nn_ops.ff_output_layer(yo, params.bo, axis=0)
 
     def logits(self, params: FFParams, inputs: BlockedTensor) -> BlockedTensor:
         cd = self.compute_dtype
-        h = nn_ops.bias_relu(matmul_t(params.w1, inputs, cd), params.b1)
+        h = nn_ops.bias_relu(matmul_t(params.w1, inputs, cd, accum_dtype=cd),
+                             params.b1)
         return matmul(params.wo, h, cd)
 
     # --- training (TPU-first extension; powers dryrun_multichip) ------

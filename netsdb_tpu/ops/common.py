@@ -24,7 +24,9 @@ def on_tpu() -> bool:
 
 def mxu_dot(a: jax.Array, b: jax.Array, compute_dtype: Optional[str] = None,
             accum_dtype=jnp.float32) -> jax.Array:
-    """Matmul routed onto the MXU, always accumulating f32.
+    """Matmul routed onto the MXU, accumulating in ``accum_dtype``
+    (f32 unless the caller overrides it — e.g. the FF inference chain
+    keeps hidden activations in bf16 to halve their HBM traffic).
 
     ``compute_dtype=None`` means full input-dtype accuracy: on TPU the
     MXU's DEFAULT precision decomposes f32 into single-pass bfloat16,

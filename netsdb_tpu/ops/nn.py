@@ -33,7 +33,10 @@ def _broadcast_bias(x: BlockedTensor, bias: BlockedTensor) -> jax.Array:
             f"bias rows {b.shape[0]} != x padded rows {x.data.shape[0]} "
             f"(bias must share x's row blocking)"
         )
-    return b
+    # compute in the activation's dtype: when the caller opted into
+    # bf16 activations (matmul accum_dtype), a f32 bias must not
+    # promote the whole elementwise chain back to f32
+    return b.astype(x.data.dtype)
 
 
 def relu(x: BlockedTensor) -> BlockedTensor:

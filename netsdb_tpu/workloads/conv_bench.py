@@ -107,6 +107,6 @@ def run_conv_bench(batch: int = 64, hw: int = 112, cin: int = 3,
             p50_for_speedup = p50_dev
         stats["p50_wall_ms"] = round(p50_wall * 1e3, 3)
         stats["speedup_vs_torch_cpu_p50"] = round(
-            cpu["p50_ms"] / (p50_for_speedup * 1e3), 1)
+            cpu["p50_ms"] / (p50_for_speedup * 1e3), 3)
         out[name] = stats
     return out

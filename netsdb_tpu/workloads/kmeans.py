@@ -28,12 +28,35 @@ def _assign(points: jax.Array, centroids: jax.Array) -> jax.Array:
 
 def kmeans(points: jax.Array, k: int, iters: int = 10,
            init_centroids: Optional[jax.Array] = None,
-           seed: int = 0) -> Tuple[jax.Array, jax.Array]:
-    """→ (centroids (k,d), assignments (n,)). Whole loop under jit."""
+           seed: int = 0, init: str = "random") -> Tuple[jax.Array, jax.Array]:
+    """→ (centroids (k,d), assignments (n,)). Whole loop under jit.
+
+    ``init="sample"`` uses the reference's MLLib-compliant Bernoulli
+    sampling init (``Sampler::computeFractionForSampleSize`` +
+    shuffle + distinct — ``TestKMeansMLLibCompliant.cc:462-530``); k may
+    shrink if the sample has duplicate points, as there.
+    """
+    if init not in ("random", "sample"):
+        raise ValueError(f"init must be 'random' or 'sample', got {init!r}")
     n, d = points.shape
     if init_centroids is None:
-        idx = jax.random.choice(jax.random.key(seed), n, (k,), replace=False)
-        init_centroids = points[idx]
+        if init == "sample":
+            if isinstance(points, jax.core.Tracer):
+                raise ValueError(
+                    "init='sample' is host-only (Bernoulli sampling has a "
+                    "data-dependent size): call kmeans outside jit, or "
+                    "pass init_centroids explicitly")
+            import numpy as np
+
+            from netsdb_tpu.utils.sampler import sample_k_distinct
+
+            init_centroids = jnp.asarray(
+                sample_k_distinct(np.asarray(points), k, seed=seed))
+            k = int(init_centroids.shape[0])
+        else:
+            idx = jax.random.choice(jax.random.key(seed), n, (k,),
+                                    replace=False)
+            init_centroids = points[idx]
 
     def body(_, cents):
         assign = _assign(points, cents)

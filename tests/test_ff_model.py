@@ -89,6 +89,35 @@ def test_train_step_reduces_loss(loaded):
     assert np.isfinite(losses).all()
 
 
+def test_fused_inference_matches_staged(loaded):
+    """FF_proj variant (whole network in one computation) must agree
+    with the staged relational DAG."""
+    model, client, (x, w1, b1, wo, bo) = loaded
+    out = model.inference_fused(client)
+    np.testing.assert_allclose(
+        np.asarray(out.to_dense()), np_forward(x, w1, b1, wo, bo),
+        rtol=1e-4, atol=1e-6,
+    )
+    dump_sink = model.build_fused_inference_dag(
+        model.params_from_store(client))
+    from netsdb_tpu.plan import plan_from_sinks
+
+    dump = plan_from_sinks([dump_sink]).to_plan_string()
+    assert "FullyConnectedNetwork" in dump
+    # exactly one scan: weights live inside the UDF, not in sets
+    assert dump.count("SCAN(") == 1, dump
+
+
+def test_fused_inference_label_head(loaded):
+    """FF_proj's sigmoid + outLabel threshold head
+    (FullyConnectedNetwork.cc:13-25)."""
+    model, client, (x, w1, b1, wo, bo) = loaded
+    out = np.asarray(model.inference_fused(client, out_mode="label").to_dense())
+    z = wo @ np.maximum(w1 @ x.T + b1[:, None], 0) + bo[:, None]
+    expect = (1 / (1 + np.exp(-z)) > 0.5).astype(np.float32)
+    np.testing.assert_array_equal(out, expect)
+
+
 def test_random_weight_accuracy_pipeline(client):
     """Mirror of FFTest's accuracy check (FFTest.cc:146-176): with the
     'true' model generating labels, inference must recover them."""

@@ -1,0 +1,81 @@
+"""Sampler utilities — reference ``src/utilities/headers/Sampler.h`` and
+its KMeans-init consumer (``TestKMeansMLLibCompliant.cc:462-530``)."""
+
+import numpy as np
+import pytest
+
+from netsdb_tpu.utils.sampler import (bernoulli_sample_rows,
+                                      compute_fraction_for_sample_size,
+                                      num_std, randomize_in_place,
+                                      sample_k_distinct)
+
+
+def test_num_std_brackets():
+    # Sampler.h:14-22 thresholds
+    assert num_std(3) == 12.0
+    assert num_std(10) == 9.0
+    assert num_std(100) == 6.0
+
+
+def test_fraction_without_replacement_bounds():
+    f = compute_fraction_for_sample_size(10, 1000, with_replacement=False)
+    assert 10 / 1000 < f <= 1.0
+    # sampling nearly everything clamps at 1
+    assert compute_fraction_for_sample_size(999, 1000) == 1.0
+    with pytest.raises(ValueError):
+        compute_fraction_for_sample_size(5, 0)
+
+
+def test_fraction_with_replacement_matches_formula():
+    f = compute_fraction_for_sample_size(100, 10_000, with_replacement=True)
+    assert f == pytest.approx((100 + 6.0 * np.sqrt(100)) / 10_000)
+
+
+def test_fraction_guarantees_sample_size():
+    # the whole point: Bernoulli(fraction) over total yields >= k w.h.p.
+    rng = np.random.default_rng(0)
+    total, k = 5000, 25
+    f = compute_fraction_for_sample_size(k, total)
+    shortfalls = sum((rng.random(total) < f).sum() < k for _ in range(200))
+    assert shortfalls == 0
+
+
+def test_randomize_in_place_permutes():
+    items = list(range(50))
+    shuffled = list(items)
+    randomize_in_place(shuffled, seed=3)
+    assert sorted(shuffled) == items
+    assert shuffled != items
+
+
+def test_bernoulli_sample_rows_subset():
+    pts = np.arange(200, dtype=np.float32).reshape(100, 2)
+    take = bernoulli_sample_rows(pts, 0.3, seed=1)
+    assert 0 < take.shape[0] < 100
+    assert all(any((row == pts[i]).all() for i in range(100)) for row in take)
+
+
+def test_sample_k_distinct_dedups():
+    pts = np.repeat(np.arange(8, dtype=np.float32)[:, None], 2, axis=1)
+    pts = np.concatenate([pts] * 10)  # 80 rows, only 8 distinct
+    out = sample_k_distinct(pts, 20, seed=0)
+    # <= k after the distinct pass (the reference shrinks k the same way)
+    assert 1 <= out.shape[0] <= 8
+    assert np.unique(out, axis=0).shape[0] == out.shape[0]
+
+
+def test_kmeans_sample_init():
+    import jax.numpy as jnp
+
+    from netsdb_tpu.workloads.kmeans import kmeans
+
+    rng = np.random.default_rng(5)
+    pts = jnp.asarray(np.concatenate([
+        rng.standard_normal((60, 2)) + 8,
+        rng.standard_normal((60, 2)) - 8,
+    ]).astype(np.float32))
+    cents, assign = kmeans(pts, 2, iters=10, seed=2, init="sample")
+    assert cents.shape[1] == 2
+    # the two blobs are separated
+    means = sorted(float(c[0]) for c in cents)
+    assert means[0] < 0 < means[1]
