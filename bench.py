@@ -120,21 +120,25 @@ def main():
         def step(carry, _):
             x = x0.with_data(x0.data + carry)
             o = model.forward(p, x)
-            return o.data[0, 0].astype(jnp.float32) * 1e-20, None
+            # reduce over the WHOLE output so no slice-pushdown can
+            # shrink the per-iteration work
+            return jnp.sum(o.data).astype(jnp.float32) * 1e-20, None
         c, _ = jax.lax.scan(step, jnp.float32(0.0), None, length=n)
         return c
 
-    lo, hi = 4, 36
-    for n in (lo, hi):
-        float(loop(params, xb, n))  # compile + warm
+    from netsdb_tpu.utils.timing import scan_slope_seconds
 
-    def timed(n: int) -> float:
+    res = scan_slope_seconds(lambda n: float(loop(params, xb, n)),
+                             lo=4, hi=36)
+    if res["below_noise"]:
+        # device time unresolvable: report the single-dispatch wall
+        # time as an upper bound rather than a clamped-denominator lie
         t0 = time.perf_counter()
-        float(loop(params, xb, n))  # scalar pull = real sync
-        return time.perf_counter() - t0
-
-    slopes = sorted((timed(hi) - timed(lo)) / (hi - lo) for _ in range(3))
-    dt = max(slopes[1], 1e-9)
+        out = fwd(params, xb)
+        float(jnp.sum(out.data))
+        dt = time.perf_counter() - t0
+    else:
+        dt = res["seconds_per_iter"]
     rows_per_sec = BATCH / dt
 
     # baseline: measured reference-equivalent CPU number

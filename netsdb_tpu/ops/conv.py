@@ -27,10 +27,6 @@ from typing import Optional, Tuple, Union
 import jax
 import jax.numpy as jnp
 
-from netsdb_tpu.core.blocked import BlockedTensor
-from netsdb_tpu.ops.common import mxu_dot
-from netsdb_tpu.ops.matmul import matmul_t
-
 Padding = Union[str, Tuple[int, int]]
 
 
@@ -89,20 +85,22 @@ def im2col(
     ``ImageBlockToMatrix`` rewrite (``src/conv2d_memory_fusion/headers/
     ImageBlockToMatrix.h``). Returns (matrix, (OH, OW))."""
     n, c, h, w = images.shape
-    ph = _pad_pair(padding, kh, h, stride[0])
-    pw = _pad_pair(padding, kw, w, stride[1])
+    sh, sw = stride
+    ph = _pad_pair(padding, kh, h, sh)
+    pw = _pad_pair(padding, kw, w, sw)
     x = jnp.pad(images, ((0, 0), (0, 0), ph, pw))
-    oh = (x.shape[2] - kh) // stride[0] + 1
-    ow = (x.shape[3] - kw) // stride[1] + 1
-    # extract patches via conv_general_dilated_patches (XLA-native im2col).
-    # HIGHEST precision: this lowers to a conv with an identity kernel, and
-    # the TPU default would round the input values themselves to bfloat16.
-    patches = jax.lax.conv_general_dilated_patches(
-        x, (kh, kw), stride, padding="VALID",
-        dimension_numbers=("NCHW", "OIHW", "NCHW"),
-        precision=jax.lax.Precision.HIGHEST,
-    )  # (N, C*KH*KW, OH, OW)
-    mat = patches.transpose(0, 2, 3, 1).reshape(n * oh * ow, c * kh * kw)
+    oh = (x.shape[2] - kh) // sh + 1
+    ow = (x.shape[3] - kw) // sw + 1
+    # Patch extraction as KH*KW strided slices + stack: pure data
+    # movement the compiler schedules as copies. The alternative,
+    # ``conv_general_dilated_patches`` (identity-kernel conv), costs 7x
+    # more here — at HIGHEST precision the fake conv runs the MXU
+    # multi-pass over data that is never actually multiplied.
+    cols = jnp.stack(
+        [x[:, :, di:di + (oh - 1) * sh + 1:sh, dj:dj + (ow - 1) * sw + 1:sw]
+         for di in range(kh) for dj in range(kw)],
+        axis=2)  # (N, C, KH*KW, OH, OW), feature order (C, KH, KW)
+    mat = cols.transpose(0, 3, 4, 1, 2).reshape(n * oh * ow, c * kh * kw)
     return mat, (oh, ow)
 
 
@@ -116,18 +114,30 @@ def conv2d_im2col(
     block_shape: Tuple[int, int] = (256, 256),
     compute_dtype: Optional[str] = None,
 ) -> jax.Array:
-    """Reference mode 2: im2col + blocked matmul + fold
+    """Reference mode 2: im2col + matmul + fold
     (``PipelinedConv2dMemFuseTest.cc:137-299`` pipeline as one function:
     ImageToChunks→ImageBlockToMatrix→KernelBiasJoin→FFTransposeMult→
-    FFAggMatrix→ConvChunksToImage)."""
+    FFAggMatrix→ConvChunksToImage). ``block_shape`` is accepted for API
+    symmetry with the staged pipeline (``workloads/conv_fusion.py``,
+    which materializes actual blocked sets) but the fused op contracts
+    the patch axis directly — see the comment below."""
     n = images.shape[0]
     o, i, kh, kw = kernels.shape
     mat, (oh, ow) = im2col(images, kh, kw, stride, padding)
     kmat = kernels.reshape(o, i * kh * kw)
-    a = BlockedTensor.from_dense(mat, block_shape, dtype=compute_dtype)
-    b = BlockedTensor.from_dense(kmat, (min(block_shape[0], o), block_shape[1]),
-                                 dtype=compute_dtype)
-    out = matmul_t(a, b, compute_dtype).to_dense()  # (N*OH*OW, O)
+    if compute_dtype is not None:
+        mat = mat.astype(compute_dtype)
+        kmat = kmat.astype(compute_dtype)
+        precision = jax.lax.Precision.DEFAULT
+    else:
+        precision = jax.lax.Precision.HIGHEST
+    # contract the patch axis directly (the K dim is tiny — C*KH*KW —
+    # so routing through BlockedTensor would zero-pad it to the block
+    # size and waste most of the MXU contraction)
+    out = jax.lax.dot_general(
+        mat, kmat, (((1,), (1,)), ((), ())),
+        preferred_element_type=jnp.float32, precision=precision,
+    )  # (N*OH*OW, O)
     if bias is not None:
         out = out + bias[None, :]
     if activation == "relu":

@@ -1,0 +1,53 @@
+"""Device-throughput timing over a high-latency controller link.
+
+Per-dispatch wall times through the axon tunnel carry tens-to-hundreds
+of ms of NOISY fixed overhead, so steady-state device time is measured
+as the SLOPE between a short and a long on-device loop: the caller
+wraps its workload in a ``lax.scan`` whose carry depends on each
+iteration's full output (so XLA can neither hoist the body nor
+slice-push it down to a single element), and the fixed dispatch+sync
+overhead cancels in the subtraction.
+
+Used by ``bench.py`` (flagship FF metric) and
+``netsdb_tpu/workloads/conv_bench.py`` — one implementation so the
+protocol cannot diverge between benchmarks.
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Callable, Dict, List
+
+
+def scan_slope_seconds(run: Callable[[int], None], lo: int, hi: int,
+                       repeats: int = 3,
+                       max_escalations: int = 2) -> Dict[str, object]:
+    """Median seconds-per-iteration of ``run(n)`` (an n-iteration
+    on-device loop that blocks until complete).
+
+    If the median slope comes out non-positive — the signal is buried
+    in controller noise — the loop lengths are escalated (``hi`` x4,
+    recompiling) up to ``max_escalations`` times; if it STILL fails,
+    ``below_noise=True`` is returned and ``seconds_per_iter`` is None
+    so callers must fall back to a wall-time upper bound instead of
+    reporting an astronomical throughput from a clamped denominator.
+    """
+    for attempt in range(max_escalations + 1):
+        for n in (lo, hi):
+            run(n)  # compile + warm this pair of lengths
+        slopes: List[float] = []
+        for _ in range(repeats):
+            t0 = time.perf_counter()
+            run(lo)
+            t_lo = time.perf_counter() - t0
+            t0 = time.perf_counter()
+            run(hi)
+            t_hi = time.perf_counter() - t0
+            slopes.append((t_hi - t_lo) / (hi - lo))
+        med = sorted(slopes)[len(slopes) // 2]
+        if med > 0:
+            return {"seconds_per_iter": med, "slopes": slopes,
+                    "below_noise": False, "lo": lo, "hi": hi}
+        hi *= 4
+    return {"seconds_per_iter": None, "slopes": slopes,
+            "below_noise": True, "lo": lo, "hi": hi // 4}
