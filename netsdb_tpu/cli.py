@@ -246,6 +246,26 @@ def _cmd_conv_bench(args) -> int:
     return 0
 
 
+def _cmd_model_bench(args) -> int:
+    """word2vec / LSTM / text-classifier inference throughput vs the
+    netsDB-equivalent CPU path (no reference-published numbers exist)."""
+    from netsdb_tpu.workloads.model_bench import run_model_bench
+
+    print(json.dumps(run_model_bench(scale=args.scale), indent=2))
+    return 0
+
+
+def _cmd_attention_bench(args) -> int:
+    """Long-context flash-vs-naive attention (beyond-reference)."""
+    from netsdb_tpu.workloads.attention_bench import bench_attention
+
+    seqs = [int(s) for s in args.seqs.split(",")]
+    print(json.dumps(bench_attention(seq_lens=seqs, batch=args.batch,
+                                     heads=args.heads,
+                                     head_dim=args.head_dim), indent=2))
+    return 0
+
+
 def _cmd_micro_bench(args) -> int:
     from netsdb_tpu.workloads import micro_bench
 
@@ -303,6 +323,19 @@ def main(argv=None) -> int:
     p.add_argument("--iters", type=int, default=20)
     p.add_argument("--compute-dtype", default=None)
 
+    p = sub.add_parser("model-bench",
+                       help="word2vec/LSTM/text-classifier throughput "
+                            "vs netsDB-equivalent CPU path")
+    p.add_argument("--scale", type=float, default=1.0,
+                   help="multiplier on all benchmark dimensions")
+
+    p = sub.add_parser("attention-bench",
+                       help="flash vs naive attention at long seq lens")
+    p.add_argument("--seqs", default="1024,2048,4096,8192")
+    p.add_argument("--batch", type=int, default=2)
+    p.add_argument("--heads", type=int, default=8)
+    p.add_argument("--head-dim", type=int, default=128)
+
     p = sub.add_parser("micro-bench",
                        help="runtime micro-benchmarks (serviceBenchmarks)")
     p.add_argument("--only", default=None,
@@ -328,6 +361,8 @@ def main(argv=None) -> int:
     return {"info": _cmd_info, "bench": _cmd_bench, "pdml": _cmd_pdml,
             "demo-ff": _cmd_demo_ff, "tpch": _cmd_tpch,
             "micro-bench": _cmd_micro_bench, "tpch-bench": _cmd_tpch_bench,
+            "model-bench": _cmd_model_bench,
+            "attention-bench": _cmd_attention_bench,
             "la-bench": _cmd_la_bench, "conv-bench": _cmd_conv_bench,
             "selftest": _cmd_selftest}[args.cmd](args)
 

@@ -137,9 +137,12 @@ def attention_dispatch(q: jax.Array, k: jax.Array, v: jax.Array,
     if impl == "flash":
         from netsdb_tpu.ops.pallas_kernels import flash_attention
 
-        blk = block_size or min(256, s)
-        return flash_attention(q, k, v, causal=causal, scale=scale,
-                               block_q=blk, block_k=blk)
+        if block_size:
+            return flash_attention(q, k, v, causal=causal, scale=scale,
+                                   block_q=block_size, block_k=block_size)
+        # no explicit block: use the kernel's tuned defaults (1024^2,
+        # ~3x the throughput of 256^2 at long seq — see flash_attention)
+        return flash_attention(q, k, v, causal=causal, scale=scale)
     if impl == "blockwise":
         return blockwise_attention(q, k, v, block_size or min(256, s),
                                    causal, scale)

@@ -87,17 +87,27 @@ def _flash_kernel(q_ref, k_ref, v_ref, o_ref, m_ref, l_ref, acc_ref, *,
 
 def flash_attention(q: jax.Array, k: jax.Array, v: jax.Array,
                     causal: bool = True, scale: Optional[float] = None,
-                    block_q: int = 256, block_k: int = 256,
+                    block_q: int = 1024, block_k: int = 1024,
                     interpret: Optional[bool] = None) -> jax.Array:
     """Fused attention: q/k/v (B, H, S, D) → (B, H, S, D). Numerically
     equivalent to ``ops.attention.attention``; never materializes the
-    (S, S) score matrix in HBM."""
+    (S, S) score matrix in HBM.
+
+    Default 1024x1024 blocks measured fastest on v5e at D=128 (95
+    TFLOP/s vs 32 at 256x256 — bigger tiles amortize the scratch
+    read-modify-write per k-step; 2048-square tiles exceed VMEM)."""
+    import math
+
     b, h, s, d = q.shape
-    block_q = min(block_q, s)
-    block_k = min(block_k, s)
-    if s % block_q or s % block_k:
-        raise ValueError(f"seq {s} not divisible by blocks "
-                         f"({block_q}, {block_k})")
+    # shrink requested blocks to divisors of s (gcd keeps the largest
+    # power-of-two factor, so e.g. s=2560 with the 1024 default runs
+    # 512-blocks instead of raising)
+    block_q = math.gcd(min(block_q, s), s)
+    block_k = math.gcd(min(block_k, s), s)
+    if block_q < 8 or block_k < 8:
+        raise ValueError(
+            f"seq {s} shares no usable block size with requested blocks "
+            f"(gcd gives {block_q}, {block_k}; need >= 8 sublanes)")
     if interpret is None:
         from netsdb_tpu.ops.common import on_tpu
 

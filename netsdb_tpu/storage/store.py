@@ -212,12 +212,30 @@ class SetStore:
                 )
             else:
                 payload.append(("object", item, None, None))
+        record = {"ident": tuple(s.ident), "persistence": s.persistence,
+                  "items": payload}
         with open(path, "wb") as f:
-            pickle.dump(
-                {"ident": tuple(s.ident), "persistence": s.persistence,
-                 "items": payload},
-                f, protocol=pickle.HIGHEST_PROTOCOL,
-            )
+            if self.config.enable_compression:
+                # reference -DENABLE_COMPRESSION snappy-compresses its
+                # shuffle/page byte streams (PipelineStage.cc:179-196);
+                # level 1 = the same speed-over-ratio tradeoff. Streamed
+                # (compressobj wrapper) because flush runs from
+                # _maybe_evict under memory pressure — materializing
+                # pickle+compressed copies of a multi-GB set there
+                # would spike RAM exactly when it is scarce.
+                import zlib
+
+                f.write(b"NZ01")
+                comp = zlib.compressobj(1)
+
+                class _W:
+                    def write(self, chunk):
+                        f.write(comp.compress(chunk))
+
+                pickle.dump(record, _W(), protocol=pickle.HIGHEST_PROTOCOL)
+                f.write(comp.flush())
+            else:
+                pickle.dump(record, f, protocol=pickle.HIGHEST_PROTOCOL)
         self.stats.spills += 1
         return path
 
@@ -226,7 +244,12 @@ class SetStore:
         if not os.path.exists(path):
             raise KeyError(f"set {s.ident} has no data in RAM or on disk")
         with open(path, "rb") as f:
-            blob = pickle.load(f)
+            raw = f.read()
+        if raw[:4] == b"NZ01":  # compressed spill (see flush)
+            import zlib
+
+            raw = zlib.decompress(raw[4:])
+        blob = pickle.loads(raw)
         items: List[Any] = []
         for kind, data, shape, block_shape in blob["items"]:
             if kind == "tensor":

@@ -20,22 +20,27 @@ from typing import Callable, Dict, List
 
 
 def scan_slope_seconds(run: Callable[[int], None], lo: int, hi: int,
-                       repeats: int = 3,
-                       max_escalations: int = 2) -> Dict[str, object]:
+                       repeats: int = 3, max_escalations: int = 4,
+                       min_delta_seconds: float = 0.2) -> Dict[str, object]:
     """Median seconds-per-iteration of ``run(n)`` (an n-iteration
     on-device loop that blocks until complete).
 
-    If the median slope comes out non-positive — the signal is buried
-    in controller noise — the loop lengths are escalated (``hi`` x4,
-    recompiling) up to ``max_escalations`` times; if it STILL fails,
+    The slope is only trustworthy when the long loop takes measurably
+    longer than the short one RELATIVE TO controller noise (~tens of
+    ms): if the median (t_hi - t_lo) delta is below
+    ``min_delta_seconds`` — or non-positive — the loop lengths are
+    escalated (``hi`` x4, recompiling) up to ``max_escalations`` times.
+    Without this, a fast kernel measured with short loops reports
+    noise as throughput (observed: an LSTM "measured" at 6x the chip's
+    peak FLOP/s with hi=20). If escalation runs out,
     ``below_noise=True`` is returned and ``seconds_per_iter`` is None
-    so callers must fall back to a wall-time upper bound instead of
-    reporting an astronomical throughput from a clamped denominator.
+    so callers fall back to a wall-time upper bound instead of
+    reporting an astronomical number from a noise denominator.
     """
     for attempt in range(max_escalations + 1):
         for n in (lo, hi):
             run(n)  # compile + warm this pair of lengths
-        slopes: List[float] = []
+        deltas: List[float] = []
         for _ in range(repeats):
             t0 = time.perf_counter()
             run(lo)
@@ -43,11 +48,13 @@ def scan_slope_seconds(run: Callable[[int], None], lo: int, hi: int,
             t0 = time.perf_counter()
             run(hi)
             t_hi = time.perf_counter() - t0
-            slopes.append((t_hi - t_lo) / (hi - lo))
-        med = sorted(slopes)[len(slopes) // 2]
-        if med > 0:
-            return {"seconds_per_iter": med, "slopes": slopes,
+            deltas.append(t_hi - t_lo)
+        med_delta = sorted(deltas)[len(deltas) // 2]
+        if med_delta >= min_delta_seconds:
+            return {"seconds_per_iter": med_delta / (hi - lo),
+                    "slopes": [d / (hi - lo) for d in deltas],
                     "below_noise": False, "lo": lo, "hi": hi}
         hi *= 4
-    return {"seconds_per_iter": None, "slopes": slopes,
+    return {"seconds_per_iter": None,
+            "slopes": [d / (hi // 4 - lo) for d in deltas],
             "below_noise": True, "lo": lo, "hi": hi // 4}

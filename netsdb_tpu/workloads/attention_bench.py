@@ -1,0 +1,72 @@
+"""Long-context attention benchmark: pallas flash vs naive softmax.
+
+The reference has NO attention anywhere in its tree (SURVEY §5
+long-context note) — this is the beyond-reference long-context
+capability, so the comparison here is internal: the naive formulation
+(materializes the (S, S) score matrix in HBM, ``ops.attention``)
+against the pallas flash kernel (online-softmax accumulators in VMEM,
+``ops.pallas_kernels.flash_attention``), both causal bf16.
+
+Timing via ``utils.timing.scan_slope_seconds``; reports tokens/s and
+the achieved fraction of the attention-FLOP roofline (4*S^2*D*B*H
+causal-halved matmul FLOPs per forward).
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Dict, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from netsdb_tpu.ops.attention import attention
+from netsdb_tpu.ops.pallas_kernels import flash_attention
+from netsdb_tpu.utils.timing import scan_slope_seconds
+
+
+def bench_attention(seq_lens: Sequence[int] = (1024, 2048, 4096, 8192),
+                    batch: int = 2, heads: int = 8, head_dim: int = 128,
+                    seed: int = 0) -> Dict[str, Dict]:
+    rng = np.random.default_rng(seed)
+    out: Dict[str, Dict] = {}
+    for s in seq_lens:
+        q, k, v = (jnp.asarray(rng.standard_normal((batch, heads, s, head_dim)),
+                               jnp.bfloat16) for _ in range(3))
+        entry: Dict[str, object] = {"batch": batch, "heads": heads,
+                                    "head_dim": head_dim}
+        # causal: half the S^2 logits are live; 2 matmuls (QK^T, PV)
+        flops = 2 * 2 * batch * heads * s * s * head_dim / 2
+
+        for name, fn in (("naive", attention), ("flash", flash_attention)):
+            @partial(jax.jit, static_argnums=3)
+            def loop(qq, kk, vv, n, fn=fn):
+                def step(carry, _):
+                    o = fn(qq + carry, kk, vv, True)
+                    return (jnp.sum(o) * 1e-20).astype(qq.dtype), None
+                c, _ = jax.lax.scan(step, jnp.zeros((), qq.dtype), None,
+                                    length=n)
+                return c
+
+            try:
+                res = scan_slope_seconds(
+                    lambda n: float(loop(q, k, v, n)), lo=4, hi=16)
+            except Exception as e:  # naive path OOMs at long seq
+                entry[name] = {"error": str(e)[:200]}
+                continue
+            if res["below_noise"]:
+                entry[name] = {"below_device_noise": True}
+                continue
+            dt = res["seconds_per_iter"]
+            entry[name] = {
+                "ms": round(dt * 1e3, 3),
+                "tokens_per_sec": round(batch * s / dt, 1),
+                "tflops": round(flops / dt / 1e12, 1),
+            }
+        n_ms = entry.get("naive", {}).get("ms")
+        f_ms = entry.get("flash", {}).get("ms")
+        if n_ms and f_ms:
+            entry["flash_speedup"] = round(n_ms / f_ms, 2)
+        out[f"seq_{s}"] = entry
+    return out

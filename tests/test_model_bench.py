@@ -1,0 +1,15 @@
+"""Model-family benchmark smoke tests (CPU, tiny scale)."""
+
+from netsdb_tpu.workloads.model_bench import run_model_bench
+
+
+def test_model_bench_smoke():
+    res = run_model_bench(scale=0.01)
+    assert set(res) == {"word2vec", "lstm", "text_classifier"}
+    for name, r in res.items():
+        cpu_key = [k for k in r if k.startswith("cpu_")]
+        assert cpu_key and r[cpu_key[0]] > 0, (name, r)
+        if not r.get("below_device_noise"):
+            tpu_key = [k for k in r if k.startswith("tpu_")]
+            assert tpu_key and r[tpu_key[0]] > 0, (name, r)
+            assert r["speedup"] > 0, (name, r)

@@ -44,10 +44,20 @@ def test_flash_custom_scale_and_dtype_preserved():
     assert out.dtype == q.dtype
 
 
-def test_flash_rejects_indivisible_seq():
+def test_flash_rejects_unusable_seq():
+    # gcd(100, 64) = 4 < 8 sublanes → no usable block
     q, k, v = qkv(s=100)
-    with pytest.raises(ValueError, match="not divisible"):
+    with pytest.raises(ValueError, match="usable block"):
         flash_attention(q, k, v, block_q=64, block_k=64)
+
+
+def test_flash_gcd_block_fallback():
+    # s=96 with block 64 → gcd 32: runs instead of raising, matches ref
+    q, k, v = qkv(s=96)
+    out = flash_attention(q, k, v, block_q=64, block_k=64)
+    ref = attention(q, k, v)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               rtol=2e-2, atol=2e-2)
 
 
 def test_dispatch_explicit_impls_agree():

@@ -1,6 +1,8 @@
 """Tests for catalog + set store + client facade (reference analogues:
 storage round-trip drivers Test19/Test28, catalog registration paths)."""
 
+import os
+
 import numpy as np
 import pytest
 
@@ -61,6 +63,45 @@ def test_store_flush_and_reload(config):
     np.testing.assert_array_equal(
         np.asarray(store2.get_tensor(ident).to_dense()), x
     )
+
+
+def test_store_spill_compression(config, tmp_path):
+    """Spill compression (ref -DENABLE_COMPRESSION snappy streams,
+    PipelineStage.cc:179-196): compressed and plain spills both load;
+    old uncompressed files stay readable with compression on."""
+    from netsdb_tpu.config import Configuration
+
+    x = np.zeros((64, 64), dtype=np.float32)  # compresses well
+    ident = SetIdentifier("db", "z")
+
+    store = SetStore(config)  # enable_compression=True default
+    store.create_set(ident, persistence="persistent")
+    store.put_tensor(ident, BlockedTensor.from_dense(x, (16, 16)))
+    path = store.flush(ident)
+    with open(path, "rb") as f:
+        head = f.read(4)
+    assert head == b"NZ01"
+    assert os.path.getsize(path) < x.nbytes // 10
+
+    store2 = SetStore(config)
+    store2.load_set(ident)
+    np.testing.assert_array_equal(
+        np.asarray(store2.get_tensor(ident).to_dense()), x)
+
+    # compression off → plain pickle; still loads under compression on
+    cfg_off = Configuration(root_dir=str(tmp_path / "plain"),
+                            enable_compression=False)
+    s3 = SetStore(cfg_off)
+    s3.create_set(ident, persistence="persistent")
+    s3.put_tensor(ident, BlockedTensor.from_dense(x, (16, 16)))
+    p3 = s3.flush(ident)
+    with open(p3, "rb") as f:
+        assert f.read(4) != b"NZ01"
+    cfg_on = Configuration(root_dir=str(tmp_path / "plain"))
+    s4 = SetStore(cfg_on)
+    s4.load_set(ident)
+    np.testing.assert_array_equal(
+        np.asarray(s4.get_tensor(ident).to_dense()), x)
 
 
 def test_store_eviction_spills_lru(config):
