@@ -51,11 +51,33 @@ def _in_range(segment_ids: jnp.ndarray, num_segments: int,
     return ok if mask is None else (ok & mask)
 
 
+# Below this group count, a broadcast-compare + column reduce beats the
+# scatter-add: TPU scatters with millions of colliding updates
+# serialize, while the dense form is one fused streaming pass (measured
+# on Q01 @ SF1, 12 groups: 52.6 ms scatter → ~2 ms dense). Above it the
+# O(N*G) dense work loses; large-G queries (Q13's per-customer counts)
+# keep the scatter.
+_DENSE_SEGMENT_LIMIT = 64
+
+
+def _dense_segment_reduce(v: jnp.ndarray, segment_ids: jnp.ndarray,
+                          num_segments: int, identity, reduce_axis0):
+    """(N,) → (G,) via broadcast-compare + column reduce; ``v`` must
+    already carry ``identity`` in masked rows."""
+    eq = segment_ids[:, None] == jnp.arange(num_segments,
+                                            dtype=segment_ids.dtype)
+    return reduce_axis0(jnp.where(eq, v[:, None],
+                                  jnp.asarray(identity, v.dtype)))
+
+
 def segment_sum(values: jnp.ndarray, segment_ids: jnp.ndarray,
                 num_segments: int,
                 mask: Optional[jnp.ndarray] = None) -> jnp.ndarray:
     """Per-segment sum; masked and out-of-range rows contribute 0."""
     v = _masked(values, _in_range(segment_ids, num_segments, mask), 0)
+    if num_segments <= _DENSE_SEGMENT_LIMIT:
+        return _dense_segment_reduce(v, segment_ids, num_segments, 0,
+                                     lambda m: m.sum(axis=0))
     ids = jnp.clip(segment_ids, 0, num_segments - 1)
     return jnp.zeros((num_segments,), v.dtype).at[ids].add(v)
 
@@ -72,6 +94,9 @@ def segment_min(values: jnp.ndarray, segment_ids: jnp.ndarray,
     """Per-segment min; empty segments hold +inf (f32) / max (i32)."""
     big = jnp.inf if values.dtype.kind == "f" else jnp.iinfo(values.dtype).max
     v = _masked(values, _in_range(segment_ids, num_segments, mask), big)
+    if num_segments <= _DENSE_SEGMENT_LIMIT:
+        return _dense_segment_reduce(v, segment_ids, num_segments, big,
+                                     lambda m: m.min(axis=0))
     ids = jnp.clip(segment_ids, 0, num_segments - 1)
     init = jnp.full((num_segments,), big, values.dtype)
     return init.at[ids].min(v)
@@ -83,6 +108,9 @@ def segment_max(values: jnp.ndarray, segment_ids: jnp.ndarray,
     small = (-jnp.inf if values.dtype.kind == "f"
              else jnp.iinfo(values.dtype).min)
     v = _masked(values, _in_range(segment_ids, num_segments, mask), small)
+    if num_segments <= _DENSE_SEGMENT_LIMIT:
+        return _dense_segment_reduce(v, segment_ids, num_segments, small,
+                                     lambda m: m.max(axis=0))
     ids = jnp.clip(segment_ids, 0, num_segments - 1)
     init = jnp.full((num_segments,), small, values.dtype)
     return init.at[ids].max(v)
@@ -115,6 +143,7 @@ def _sentineled(keys: jnp.ndarray, mask: Optional[jnp.ndarray]) -> jnp.ndarray:
 def pk_fk_join(pk_keys: jnp.ndarray, fk_keys: jnp.ndarray,
                pk_mask: Optional[jnp.ndarray] = None,
                fk_mask: Optional[jnp.ndarray] = None,
+               key_space: Optional[int] = None,
                ) -> Tuple[jnp.ndarray, jnp.ndarray]:
     """Equi-join a unique-key (primary) side into a foreign-key side.
 
@@ -122,12 +151,36 @@ def pk_fk_join(pk_keys: jnp.ndarray, fk_keys: jnp.ndarray,
     row i of the probe side matches row ``gather_idx[i]`` of the build
     side iff ``match_mask[i]``. Columns of the build side are then
     brought over with ``jnp.take(col, gather_idx)`` — the vectorized
-    JoinMap probe. O((P+F) log P) via one sort of the build side.
+    JoinMap probe.
+
+    With ``key_space`` (a static bound: all keys in [0, key_space) —
+    the host-side table metadata every ColumnTable already tracks), the
+    join is a dense lookup table: one scatter to build, one gather to
+    probe. Measured ~19x faster than sort+binary-search at SF-1 TPC-H
+    scale (49 ms vs 947 ms for 6M probes into 1.5M build rows) — TPU
+    binary search serializes, gathers stream. Without it, falls back
+    to sort + ``searchsorted(method="sort")`` (TPU's while-loop "scan"
+    method is another ~8x slower).
     """
+    if key_space is not None:
+        p = pk_keys.shape[0]
+        valid_pk = (pk_keys >= 0) & (pk_keys < key_space)
+        if pk_mask is not None:
+            valid_pk = valid_pk & pk_mask
+        # invalid build rows route to an extra trash slot
+        slot = jnp.where(valid_pk, pk_keys, jnp.int32(key_space))
+        lut = jnp.full((key_space + 1,), jnp.int32(-1)).at[slot].set(
+            jnp.arange(p, dtype=jnp.int32), mode="drop")
+        fk_in = (fk_keys >= 0) & (fk_keys < key_space)
+        pos = jnp.take(lut, jnp.clip(fk_keys, 0, key_space - 1))
+        hit = fk_in & (pos >= 0)
+        if fk_mask is not None:
+            hit = hit & fk_mask
+        return jnp.maximum(pos, 0), hit
     pk = _sentineled(pk_keys, pk_mask)
     order = jnp.argsort(pk)
     pk_sorted = pk[order]
-    pos = jnp.searchsorted(pk_sorted, fk_keys)
+    pos = jnp.searchsorted(pk_sorted, fk_keys, method="sort")
     pos_c = jnp.clip(pos, 0, pk.shape[0] - 1)
     hit = pk_sorted[pos_c] == fk_keys
     if fk_mask is not None:
@@ -140,14 +193,15 @@ def pk_fk_join(pk_keys: jnp.ndarray, fk_keys: jnp.ndarray,
 
 def member(build_keys: jnp.ndarray, probe_keys: jnp.ndarray,
            build_mask: Optional[jnp.ndarray] = None,
-           probe_mask: Optional[jnp.ndarray] = None) -> jnp.ndarray:
+           probe_mask: Optional[jnp.ndarray] = None,
+           key_space: Optional[int] = None) -> jnp.ndarray:
     """Semi-join membership: for each probe row, does any valid build
     row share its key? (Q04 EXISTS, Q22 NOT EXISTS.) Build keys need
     not be unique."""
     _, hit = pk_fk_join(
-        # duplicates are fine for membership: searchsorted finds the
-        # leftmost equal element
-        build_keys, probe_keys, build_mask, probe_mask)
+        # duplicates are fine for membership: any representative row
+        # (leftmost via searchsorted, last-writer via the LUT) works
+        build_keys, probe_keys, build_mask, probe_mask, key_space)
     return hit
 
 

@@ -109,23 +109,24 @@ def cq01(tables: Tables, delta_date: str = "1998-09-02"):
 
 
 # ---------------------------------------------------------------- Q02
-@functools.partial(jax.jit, static_argnums=(0,))
-def _q02_core(n_part, p_key, p_size, p_type, ps_part, ps_supp, ps_cost,
+@functools.partial(jax.jit, static_argnums=(0, 1, 2, 3))
+def _q02_core(n_part, n_sup, n_nat, n_reg_ks,
+              p_key, p_size, p_type, ps_part, ps_supp, ps_cost,
               s_key, s_nat, r_key, r_name, n_key, n_reg,
               type_ok, size, region_code):
     part_ok = (p_size == size) & jnp.take(type_ok, p_type)
     # partsupp ⋈ part (restrict to qualifying parts)
-    _, phit = K.pk_fk_join(p_key, ps_part, part_ok)
+    _, phit = K.pk_fk_join(p_key, ps_part, part_ok, key_space=n_part)
     # supplier ⋈ nation ⋈ region chain, evaluated on the supplier side;
     # nation columns come through the join's row index (keys need not
     # equal row positions)
-    nidx, nhit = K.pk_fk_join(n_key, s_nat)
+    nidx, nhit = K.pk_fk_join(n_key, s_nat, key_space=n_nat)
     sup_region = jnp.take(n_reg, nidx)
-    ridx, rhit = K.pk_fk_join(r_key, sup_region)
+    ridx, rhit = K.pk_fk_join(r_key, sup_region, key_space=n_reg_ks)
     in_region = nhit & rhit & (jnp.take(r_name, ridx) == region_code)
     sup_ok = in_region
     # partsupp ⋈ supplier
-    sidx, shit = K.pk_fk_join(s_key, ps_supp, sup_ok)
+    sidx, shit = K.pk_fk_join(s_key, ps_supp, sup_ok, key_space=n_sup)
     valid = phit & shit
     # min cost per part, then the first row achieving it (the row
     # engine's combine keeps the earlier row on ties)
@@ -149,7 +150,9 @@ def cq02(tables: Tables, size: int = 15, type_suffix: str = "BRUSHED",
     n_part = key_space(ps, "ps_partkey")
     type_ok = _lut(part.dicts["p_type"], lambda s: s.endswith(type_suffix))
     ints, cost_min = _q02_core(
-        n_part, part["p_partkey"], part["p_size"], part["p_type"],
+        n_part, key_space(sup, "s_suppkey"),
+        key_space(nat, "n_nationkey"), key_space(reg, "r_regionkey"),
+        part["p_partkey"], part["p_size"], part["p_type"],
         ps["ps_partkey"], ps["ps_suppkey"], ps["ps_supplycost"],
         sup["s_suppkey"], sup["s_nationkey"],
         reg["r_regionkey"], reg["r_name"],
@@ -170,13 +173,13 @@ def cq02(tables: Tables, size: int = 15, type_suffix: str = "BRUSHED",
 
 
 # ---------------------------------------------------------------- Q03
-@functools.partial(jax.jit, static_argnums=(0, 1))
-def _q03_core(n_orders, k, c_key, c_seg, o_key, o_cust, o_date,
+@functools.partial(jax.jit, static_argnums=(0, 1, 2))
+def _q03_core(n_orders, k, n_cust, c_key, c_seg, o_key, o_cust, o_date,
               l_okey, l_ship, l_price, l_disc, seg_code, d):
     cust_ok = c_seg == seg_code
-    _, chit = K.pk_fk_join(c_key, o_cust, cust_ok)
+    _, chit = K.pk_fk_join(c_key, o_cust, cust_ok, key_space=n_cust)
     order_ok = chit & (o_date < d)
-    oidx, ohit = K.pk_fk_join(o_key, l_okey, order_ok)
+    oidx, ohit = K.pk_fk_join(o_key, l_okey, order_ok, key_space=n_orders)
     li_ok = ohit & (l_ship > d)
     rev = K.segment_sum(l_price * (1.0 - l_disc), l_okey, n_orders, li_ok)
     odate_per_order = K.segment_min(
@@ -192,7 +195,8 @@ def cq03(tables: Tables, segment: str = "BUILDING",
     """Top unshipped orders by revenue."""
     cust, orders, li = tables["customer"], tables["orders"], tables["lineitem"]
     ints, rev = _q03_core(
-        key_space(li, "l_orderkey"), k, cust["c_custkey"],
+        key_space(li, "l_orderkey"), k, key_space(cust, "c_custkey"),
+        cust["c_custkey"],
         cust["c_mktsegment"], orders["o_orderkey"], orders["o_custkey"],
         orders["o_orderdate"], li["l_orderkey"], li["l_shipdate"],
         li["l_extendedprice"], li["l_discount"],
@@ -206,11 +210,11 @@ def cq03(tables: Tables, segment: str = "BUILDING",
 
 
 # ---------------------------------------------------------------- Q04
-@functools.partial(jax.jit, static_argnums=(0,))
-def _q04_core(n_pri, o_key, o_date, o_pri, l_okey, l_commit, l_receipt,
-              a, b):
+@functools.partial(jax.jit, static_argnums=(0, 1))
+def _q04_core(n_pri, n_okey, o_key, o_date, o_pri, l_okey, l_commit,
+              l_receipt, a, b):
     late = l_commit < l_receipt
-    has_late = K.member(l_okey, o_key, late)
+    has_late = K.member(l_okey, o_key, late, key_space=n_okey)
     in_q = (o_date >= a) & (o_date < b)
     return K.segment_count(o_pri, n_pri, has_late & in_q)
 
@@ -220,7 +224,8 @@ def cq04(tables: Tables, d0: str = "1993-07-01", d1: str = "1993-10-01"):
     orders, li = tables["orders"], tables["lineitem"]
     n_pri = len(orders.dicts["o_orderpriority"])
     counts = np.asarray(_q04_core(
-        n_pri, orders["o_orderkey"], orders["o_orderdate"],
+        n_pri, key_space(li, "l_orderkey"),
+        orders["o_orderkey"], orders["o_orderdate"],
         orders["o_orderpriority"], li["l_orderkey"], li["l_commitdate"],
         li["l_receiptdate"], date_to_int(d0), date_to_int(d1)))
     out = [(orders.decode("o_orderpriority", i), int(counts[i]))
@@ -249,13 +254,13 @@ def cq06(tables: Tables, d0: str = "1994-01-01", d1: str = "1995-01-01",
 
 
 # ---------------------------------------------------------------- Q12
-@functools.partial(jax.jit, static_argnums=(0,))
-def _q12_core(n_modes, o_key, o_pri, l_okey, l_mode, l_ship, l_commit,
-              l_receipt, hi_lut, m1, m2, a, b):
+@functools.partial(jax.jit, static_argnums=(0, 1))
+def _q12_core(n_modes, n_okey, o_key, o_pri, l_okey, l_mode, l_ship,
+              l_commit, l_receipt, hi_lut, m1, m2, a, b):
     mask = (((l_mode == m1) | (l_mode == m2))
             & (l_commit < l_receipt) & (l_ship < l_commit)
             & (l_receipt >= a) & (l_receipt < b))
-    oidx, ohit = K.pk_fk_join(o_key, l_okey)
+    oidx, ohit = K.pk_fk_join(o_key, l_okey, key_space=n_okey)
     mask = mask & ohit
     high = jnp.take(hi_lut, jnp.take(o_pri, oidx))
     return jnp.stack([K.segment_count(l_mode, n_modes, mask & high),
@@ -271,7 +276,8 @@ def cq12(tables: Tables, mode1: str = "MAIL", mode2: str = "SHIP",
     hi = _lut(orders.dicts["o_orderpriority"],
               lambda s: s in ("1-URGENT", "2-HIGH"))
     packed = np.asarray(_q12_core(
-        n_modes, orders["o_orderkey"], orders["o_orderpriority"],
+        n_modes, key_space(li, "l_orderkey"),
+        orders["o_orderkey"], orders["o_orderpriority"],
         li["l_orderkey"], li["l_shipmode"], li["l_shipdate"],
         li["l_commitdate"], li["l_receiptdate"], hi, m1, m2,
         date_to_int(d0), date_to_int(d1)))
@@ -330,11 +336,11 @@ def cq13(tables: Tables, word1: str = "special", word2: str = "requests"):
 
 
 # ---------------------------------------------------------------- Q14
-@jax.jit
-def _q14_core(p_key, p_type, l_part, l_ship, l_price, l_disc, promo_lut,
-              a, b):
+@functools.partial(jax.jit, static_argnums=(0,))
+def _q14_core(n_pkey, p_key, p_type, l_part, l_ship, l_price, l_disc,
+              promo_lut, a, b):
     mask = (l_ship >= a) & (l_ship < b)
-    pidx, phit = K.pk_fk_join(p_key, l_part)
+    pidx, phit = K.pk_fk_join(p_key, l_part, key_space=n_pkey)
     mask = mask & phit
     rev = jnp.where(mask, l_price * (1.0 - l_disc), 0.0)
     is_promo = jnp.take(promo_lut, jnp.take(p_type, pidx))
@@ -346,6 +352,7 @@ def cq14(tables: Tables, d0: str = "1995-09-01", d1: str = "1995-10-01"):
     li, part = tables["lineitem"], tables["part"]
     promo = _lut(part.dicts["p_type"], lambda s: s.startswith("PROMO"))
     pr, total = np.asarray(_q14_core(
+        key_space(li, "l_partkey"),
         part["p_partkey"], part["p_type"], li["l_partkey"], li["l_shipdate"],
         li["l_extendedprice"], li["l_discount"], promo,
         date_to_int(d0), date_to_int(d1)))
@@ -358,7 +365,7 @@ def cq14(tables: Tables, d0: str = "1995-09-01", d1: str = "1995-10-01"):
 def _q17_core(n_part, p_key, p_brand, p_cont, l_part, l_qty, l_price,
               brand_code, cont_code):
     part_ok = (p_brand == brand_code) & (p_cont == cont_code)
-    _, phit = K.pk_fk_join(p_key, l_part, part_ok)
+    _, phit = K.pk_fk_join(p_key, l_part, part_ok, key_space=n_part)
     qty = l_qty.astype(jnp.float32)
     avg = K.segment_mean(qty, l_part, n_part, phit)
     small = phit & (qty < 0.2 * jnp.take(avg, l_part))
@@ -377,15 +384,15 @@ def cq17(tables: Tables, brand: str = "Brand#23", container: str = "MED BOX"):
 
 
 # ---------------------------------------------------------------- Q22
-@functools.partial(jax.jit, static_argnums=(0,))
-def _q22_core(n_pref, c_key, c_phone, c_bal, o_cust, code_lut):
+@functools.partial(jax.jit, static_argnums=(0, 1))
+def _q22_core(n_pref, n_ckey, c_key, c_phone, c_bal, o_cust, code_lut):
     pref = jnp.take(code_lut, c_phone)
     in_pref = pref >= 0
     pos = in_pref & (c_bal > 0)
     avg = (jnp.sum(jnp.where(pos, c_bal, 0.0))
            / jnp.maximum(jnp.sum(pos.astype(jnp.int32)), 1))
     rich = in_pref & (c_bal > avg)
-    has_orders = K.member(o_cust, c_key)
+    has_orders = K.member(o_cust, c_key, key_space=n_ckey)
     sel = rich & ~has_orders
     seg = jnp.clip(pref, 0, n_pref - 1)
     return jnp.stack([K.segment_count(seg, n_pref, sel).astype(jnp.float32),
@@ -404,7 +411,8 @@ def cq22(tables: Tables,
         (pref_idx.get(s[:2], -1) for s in phone_dict), np.int32,
         len(phone_dict)))
     packed = np.asarray(_q22_core(
-        len(pref_list), cust["c_custkey"], cust["c_phone"],
+        len(pref_list), key_space(orders, "o_custkey"),
+        cust["c_custkey"], cust["c_phone"],
         cust["c_acctbal"], orders["o_custkey"], code_lut))
     return [(pref_list[i], {"n": int(packed[0, i]),
                             "bal": float(packed[1, i])})

@@ -21,6 +21,7 @@ mirrors ``LocalitySet`` {LRU, MRU, Random}
 from __future__ import annotations
 
 import dataclasses
+import io
 import os
 import pickle
 import random
@@ -244,12 +245,38 @@ class SetStore:
         if not os.path.exists(path):
             raise KeyError(f"set {s.ident} has no data in RAM or on disk")
         with open(path, "rb") as f:
-            raw = f.read()
-        if raw[:4] == b"NZ01":  # compressed spill (see flush)
-            import zlib
+            magic = f.read(4)
+            if magic == b"NZ01":  # compressed spill (see flush)
+                # streamed, mirroring flush: never hold compressed +
+                # decompressed + deserialized copies at once
+                import zlib
 
-            raw = zlib.decompress(raw[4:])
-        blob = pickle.loads(raw)
+                decomp = zlib.decompressobj()
+
+                class _R:
+                    """Minimal file-like over the decompressed stream."""
+
+                    def __init__(self):
+                        self.buf = b""
+
+                    def read(self, n=-1):
+                        while (n < 0 or len(self.buf) < n):
+                            chunk = f.read(1 << 20)
+                            if not chunk:
+                                self.buf += decomp.flush()
+                                break
+                            self.buf += decomp.decompress(chunk)
+                        out, self.buf = ((self.buf, b"") if n < 0 else
+                                         (self.buf[:n], self.buf[n:]))
+                        return out
+
+                    def readline(self):  # pickle protocol 2+ never calls
+                        raise io.UnsupportedOperation("readline")
+
+                blob = pickle.load(_R())
+            else:
+                f.seek(0)
+                blob = pickle.load(f)
         items: List[Any] = []
         for kind, data, shape, block_shape in blob["items"]:
             if kind == "tensor":

@@ -32,6 +32,19 @@ def _device_seconds(loop, *args) -> Optional[float]:
     return res["seconds_per_iter"] if not res["below_noise"] else None
 
 
+def _cpu_median_seconds(fn, repeats: int = 3) -> float:
+    """Median wall time of ``fn()`` — same median-of-repeats discipline
+    as the device side, so one cold run (BLAS pool spin-up, scheduler
+    hiccup) cannot inflate the published speedup."""
+    fn()  # warm
+    times = []
+    for _ in range(repeats):
+        t0 = time.perf_counter()
+        fn()
+        times.append(time.perf_counter() - t0)
+    return sorted(times)[len(times) // 2]
+
+
 def bench_word2vec(vocab: int = 100_000, dim: int = 512,
                    batch: int = 65536, seed: int = 0) -> Dict[str, float]:
     """Embedding serving. TPU path = gather; CPU baseline = the
@@ -55,9 +68,7 @@ def bench_word2vec(vocab: int = 100_000, dim: int = 512,
     onehot = np.zeros((cpu_batch, vocab))
     onehot[np.arange(cpu_batch), rng.integers(0, vocab, cpu_batch)] = 1.0
     tbl64 = np.asarray(table, np.float64)
-    t0 = time.perf_counter()
-    _ = onehot @ tbl64
-    cpu = (time.perf_counter() - t0) / cpu_batch
+    cpu = _cpu_median_seconds(lambda: onehot @ tbl64) / cpu_batch
     out = {"vocab": vocab, "dim": dim, "batch": batch,
            "cpu_onehot_matmul_ids_per_sec": round(1.0 / cpu, 1)}
     if dev is not None:
@@ -116,12 +127,14 @@ def bench_lstm(hidden: int = 1024, inp: int = 1024, batch: int = 1024,
          for k in ("w_i", "w_f", "w_c", "w_o", "u_i", "u_f", "u_c", "u_o")}
     xs = rng.standard_normal((inp, cpu_batch))
     hs = rng.standard_normal((hidden, cpu_batch))
-    t0 = time.perf_counter()
-    for gate_w, gate_u in (("w_i", "u_i"), ("w_f", "u_f"),
-                           ("w_c", "u_c"), ("w_o", "u_o")):
-        z = w[gate_w] @ xs + w[gate_u] @ hs
-        _ = 1.0 / (1.0 + np.exp(-z))
-    cpu = (time.perf_counter() - t0) / cpu_batch
+
+    def cpu_cell():
+        for gate_w, gate_u in (("w_i", "u_i"), ("w_f", "u_f"),
+                               ("w_c", "u_c"), ("w_o", "u_o")):
+            z = w[gate_w] @ xs + w[gate_u] @ hs
+            _ = 1.0 / (1.0 + np.exp(-z))
+
+    cpu = _cpu_median_seconds(cpu_cell) / cpu_batch
     out = {"hidden": hidden, "input": inp, "batch": batch,
            "cpu_cell_rows_per_sec": round(1.0 / cpu, 1)}
     if dev is not None:
@@ -159,12 +172,14 @@ def bench_text_classifier(vocab: int = 50_000, dim: int = 512,
     t64 = np.asarray(table, np.float64)
     w64 = np.asarray(w, np.float64)
     cids = rng.integers(0, vocab, cpu_batch)
-    t0 = time.perf_counter()
-    feats = t64[cids]
-    logits = feats @ w64.T + np.asarray(b, np.float64)
-    e = np.exp(logits - logits.max(1, keepdims=True))
-    _ = e / e.sum(1, keepdims=True)
-    cpu = (time.perf_counter() - t0) / cpu_batch
+
+    def cpu_cls():
+        feats = t64[cids]
+        logits = feats @ w64.T + np.asarray(b, np.float64)
+        e = np.exp(logits - logits.max(1, keepdims=True))
+        _ = e / e.sum(1, keepdims=True)
+
+    cpu = _cpu_median_seconds(cpu_cls) / cpu_batch
     out = {"vocab": vocab, "dim": dim, "labels": labels, "batch": batch,
            "cpu_docs_per_sec": round(1.0 / cpu, 1)}
     if dev is not None:
