@@ -114,7 +114,8 @@ def merge_project(out: jax.Array, w_out: jax.Array) -> jax.Array:
 def attention_dispatch(q: jax.Array, k: jax.Array, v: jax.Array,
                        causal: bool = True, scale: Optional[float] = None,
                        impl: Optional[str] = None,
-                       block_size: Optional[int] = None) -> jax.Array:
+                       block_size: Optional[int] = None,
+                       out_vma=None) -> jax.Array:
     """Pick the attention implementation: 'full', 'blockwise', or
     'flash' (pallas kernel). ``impl=None`` auto-selects: flash on TPU
     when the sequence divides its blocks, else blockwise when a
@@ -139,10 +140,12 @@ def attention_dispatch(q: jax.Array, k: jax.Array, v: jax.Array,
 
         if block_size:
             return flash_attention(q, k, v, causal=causal, scale=scale,
-                                   block_q=block_size, block_k=block_size)
+                                   block_q=block_size, block_k=block_size,
+                                   out_vma=out_vma)
         # no explicit block: use the kernel's tuned defaults (1024^2,
         # ~3x the throughput of 256^2 at long seq — see flash_attention)
-        return flash_attention(q, k, v, causal=causal, scale=scale)
+        return flash_attention(q, k, v, causal=causal, scale=scale,
+                               out_vma=out_vma)
     if impl == "blockwise":
         return blockwise_attention(q, k, v, block_size or min(256, s),
                                    causal, scale)

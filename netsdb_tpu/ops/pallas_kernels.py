@@ -88,7 +88,8 @@ def _flash_kernel(q_ref, k_ref, v_ref, o_ref, m_ref, l_ref, acc_ref, *,
 def flash_attention(q: jax.Array, k: jax.Array, v: jax.Array,
                     causal: bool = True, scale: Optional[float] = None,
                     block_q: int = 1024, block_k: int = 1024,
-                    interpret: Optional[bool] = None) -> jax.Array:
+                    interpret: Optional[bool] = None,
+                    out_vma=None) -> jax.Array:
     """Fused attention: q/k/v (B, H, S, D) → (B, H, S, D). Numerically
     equivalent to ``ops.attention.attention``; never materializes the
     (S, S) score matrix in HBM.
@@ -133,7 +134,13 @@ def flash_attention(q: jax.Array, k: jax.Array, v: jax.Array,
             pl.BlockSpec((1, block_k, d), lambda b_, qi, ki: (b_, ki, 0)),
         ],
         out_specs=pl.BlockSpec((1, block_q, d), lambda b_, qi, ki: (b_, qi, 0)),
-        out_shape=jax.ShapeDtypeStruct((bh, s, d), q.dtype),
+        # inside a shard_map manual region, shard_map's vma check needs
+        # to know which mesh axes the output varies over — callers there
+        # pass out_vma={axis_name} (see parallel.ring ulysses path)
+        out_shape=(jax.ShapeDtypeStruct((bh, s, d), q.dtype,
+                                        vma=frozenset(out_vma))
+                   if out_vma else
+                   jax.ShapeDtypeStruct((bh, s, d), q.dtype)),
         scratch_shapes=[
             _vmem((block_q, 1), jnp.float32),   # running max m
             _vmem((block_q, 1), jnp.float32),   # running denom l

@@ -27,7 +27,7 @@ import jax
 import jax.numpy as jnp
 from jax.sharding import Mesh, PartitionSpec as P
 
-from netsdb_tpu.ops.attention import NEG_INF, _block_attn, attention
+from netsdb_tpu.ops.attention import NEG_INF, _block_attn, attention_dispatch
 
 
 def _ring_attention_local(q, k, v, axis_name: str, causal: bool,
@@ -94,7 +94,14 @@ def _ulysses_local(q, k, v, axis_name: str, causal: bool, scale):
                                   tiled=True)
 
     qh, kh, vh = seq_to_heads(q), seq_to_heads(k), seq_to_heads(v)
-    out = attention(qh, kh, vh, causal=causal, scale=scale)
+    # after the re-shard each device holds the FULL sequence for its
+    # head group, so the local attention is where the (S, S) memory
+    # blow-up would happen — dispatch picks the pallas flash kernel on
+    # TPU (VMEM accumulators, no (S,S) in HBM), full attention on CPU;
+    # out_vma tells shard_map's vma check the kernel output varies over
+    # this mesh axis (pallas out_shape carries no annotation by itself)
+    out = attention_dispatch(qh, kh, vh, causal=causal, scale=scale,
+                             out_vma={axis_name})
     return heads_to_seq(out)
 
 

@@ -254,10 +254,12 @@ class SetStore:
                 decomp = zlib.decompressobj()
 
                 class _R:
-                    """Minimal file-like over the decompressed stream."""
+                    """Minimal file-like over the decompressed stream.
+                    Buffer is a bytearray: in-place append, so a large
+                    pickle frame read stays linear, not quadratic."""
 
                     def __init__(self):
-                        self.buf = b""
+                        self.buf = bytearray()
 
                     def read(self, n=-1):
                         while (n < 0 or len(self.buf) < n):
@@ -266,8 +268,11 @@ class SetStore:
                                 self.buf += decomp.flush()
                                 break
                             self.buf += decomp.decompress(chunk)
-                        out, self.buf = ((self.buf, b"") if n < 0 else
-                                         (self.buf[:n], self.buf[n:]))
+                        if n < 0:
+                            out, self.buf = bytes(self.buf), bytearray()
+                        else:
+                            out = bytes(self.buf[:n])
+                            del self.buf[:n]
                         return out
 
                     def readline(self):  # pickle protocol 2+ never calls

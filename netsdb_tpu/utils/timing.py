@@ -16,7 +16,16 @@ protocol cannot diverge between benchmarks.
 from __future__ import annotations
 
 import time
-from typing import Callable, Dict, List
+from typing import Callable, Dict, List, Optional
+
+
+def device_seconds(run: Callable[[int], None], lo: int = 4, hi: int = 20,
+                   **kw) -> Optional[float]:
+    """Seconds-per-iteration via :func:`scan_slope_seconds`, or None when
+    the signal never clears controller noise (callers must then fall
+    back to a wall-time upper bound, never a clamped denominator)."""
+    res = scan_slope_seconds(run, lo=lo, hi=hi, **kw)
+    return res["seconds_per_iter"] if not res["below_noise"] else None
 
 
 def scan_slope_seconds(run: Callable[[int], None], lo: int, hi: int,

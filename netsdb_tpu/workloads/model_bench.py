@@ -24,12 +24,11 @@ import numpy as np
 
 from netsdb_tpu.core.blocked import BlockedTensor
 from netsdb_tpu.ops.lstm import LSTMParams, lstm_cell
-from netsdb_tpu.utils.timing import scan_slope_seconds
+from netsdb_tpu.utils.timing import device_seconds
 
 
 def _device_seconds(loop, *args) -> Optional[float]:
-    res = scan_slope_seconds(lambda n: float(loop(*args, n)), lo=4, hi=20)
-    return res["seconds_per_iter"] if not res["below_noise"] else None
+    return device_seconds(lambda n: float(loop(*args, n)))
 
 
 def _cpu_median_seconds(fn, repeats: int = 3) -> float:
