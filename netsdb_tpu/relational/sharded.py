@@ -1,0 +1,195 @@
+"""Mesh-distributed relational execution — the reference's multi-node
+query plan, re-expressed as shardings + collectives.
+
+The reference scales queries by partitioning sets across workers and
+running the same pipeline on each node's partition, with two data
+movements (SURVEY §2.6):
+
+- **local pre-aggregation + hash-repartition shuffle**: each node's
+  ``CombinerProcessor`` folds its partition, then partial aggregates
+  stream to the owning node where ``AggregationProcessor`` merges them
+  (``src/queryExecution/headers/CombinerProcessor.h:20``,
+  ``PipelineStage.cc:1215-1516``). TPU form: row-shard the fact table
+  over a mesh axis, run the SAME per-shard kernels as the single-chip
+  engine, and ``psum`` the fixed-shape partial aggregates over ICI —
+  the shuffle is one collective.
+- **broadcast join**: the small side is replicated to every node as a
+  ``SharedHashSet`` (``BroadcastJoinBuildHTJobStage``,
+  ``HermesExecutionServer.cc:172-369``). TPU form: dimension-table
+  columns replicated in the shard_map (``P(None)``); each shard probes
+  its rows against the full build LUT locally.
+
+Any query whose result is a fixed-shape aggregate distributes this way;
+``sharded_query`` wraps a local kernel accordingly, and the concrete
+``sharded_q01`` / ``sharded_q06`` / ``sharded_q04`` bodies below REUSE
+the single-chip query cores' logic so the distributed answers are
+bit-comparable to the local engine (tests cross-check both on the
+virtual 8-device CPU mesh).
+
+Row padding: a sharded axis must divide the device count, so fact
+columns are padded and a validity mask rides along (the mask approach
+every tensor op in this framework uses).
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Callable, Dict, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import Mesh, PartitionSpec as P
+
+from netsdb_tpu.relational import kernels as K
+from netsdb_tpu.relational.queries import Tables, key_space
+from netsdb_tpu.relational.table import date_to_int
+
+
+def shard_fact_columns(cols: Dict[str, jnp.ndarray], n_shards: int,
+                       ) -> Tuple[Dict[str, jnp.ndarray], jnp.ndarray]:
+    """Pad each column to a multiple of ``n_shards`` and return the
+    validity mask (False on padding rows) — the dispatcher's
+    round-robin row partitioning (``PartitionPolicy.h:29``) with the
+    remainder handled by masking instead of ragged partitions."""
+    n = next(iter(cols.values())).shape[0]
+    padded = -(-n // n_shards) * n_shards
+    out = {}
+    for name, c in cols.items():
+        pad = padded - n
+        out[name] = jnp.pad(c, (0, pad)) if pad else c
+    valid = jnp.arange(padded) < n
+    return out, valid
+
+
+def sharded_query(local_kernel: Callable[..., jax.Array], mesh: Mesh,
+                  axis: str, fact: Dict[str, jnp.ndarray],
+                  replicated: Sequence[jax.Array] = (),
+                  scalars: Sequence = ()) -> jax.Array:
+    """Run ``local_kernel(valid, fact_cols..., replicated..., scalars...)``
+    per shard and psum its fixed-shape aggregate over ``axis``.
+
+    ``local_kernel`` must return per-shard PARTIAL aggregates whose sum
+    over shards is the global answer (the combiner/aggregator contract).
+    """
+    n_shards = mesh.shape[axis]
+    fact_p, valid = shard_fact_columns(fact, n_shards)
+    names = sorted(fact_p)
+
+    def body(valid_s, *args):
+        k = len(names)
+        cols = dict(zip(names, args[:k]))
+        rep = args[k:k + len(replicated)]
+        partial = local_kernel(valid_s, cols, *rep, *scalars)
+        return jax.lax.psum(partial, axis)
+
+    fn = jax.shard_map(
+        body, mesh=mesh,
+        in_specs=(P(axis),) + (P(axis),) * len(names)
+        + (P(),) * len(replicated),
+        out_specs=P(),
+    )
+    return fn(valid, *[fact_p[n] for n in names], *replicated)
+
+
+# ------------------------------------------------------------------ Q01
+_Q01_COLS = ("l_shipdate", "l_returnflag", "l_linestatus", "l_quantity",
+             "l_extendedprice", "l_discount", "l_tax")
+
+
+def _q01_local(valid, li, n_groups: int, n_ls: int, delta: int):
+    mask = valid & (li["l_shipdate"] <= delta)
+    seg = li["l_returnflag"] * n_ls + li["l_linestatus"]
+    qty = li["l_quantity"].astype(jnp.float32)
+    disc_price = li["l_extendedprice"] * (1.0 - li["l_discount"])
+    charge = disc_price * (1.0 + li["l_tax"])
+    rows = [K.segment_sum(v, seg, n_groups, mask)
+            for v in (qty, li["l_extendedprice"], disc_price, charge,
+                      li["l_discount"])]
+    # counts stay int32 through the psum — f32 partials would absorb
+    # +1 increments past 2^24 rows/group (same guard as the single-chip
+    # core, queries.py _q01_core)
+    return jnp.stack(rows), K.segment_count(seg, n_groups, mask)
+
+
+def sharded_q01(tables: Tables, mesh: Mesh, axis: str = "data",
+                delta_date: str = "1998-09-02"):
+    """Distributed pricing-summary → (sums (5, groups) f32,
+    counts (groups,) i32), identical to the single-chip core's."""
+    li = tables["lineitem"]
+    n_ls = len(li.dicts["l_linestatus"])
+    n_groups = len(li.dicts["l_returnflag"]) * n_ls
+    kern = functools.partial(_q01_local, n_groups=n_groups, n_ls=n_ls,
+                             delta=date_to_int(delta_date))
+    return sharded_query(kern, mesh, axis,
+                         {k: li.cols[k] for k in _Q01_COLS})
+
+
+# ------------------------------------------------------------------ Q06
+def _q06_local(valid, li, a, b, disc, qty):
+    c = li
+    mask = (valid & (c["l_shipdate"] >= a) & (c["l_shipdate"] < b)
+            & (c["l_discount"] >= disc - 0.011)
+            & (c["l_discount"] <= disc + 0.011)
+            & (c["l_quantity"] < qty))
+    return jnp.sum(jnp.where(mask, c["l_extendedprice"] * c["l_discount"],
+                             0.0))
+
+
+def sharded_q06(tables: Tables, mesh: Mesh, axis: str = "data",
+                d0: str = "1994-01-01", d1: str = "1995-01-01",
+                discount: float = 0.06, quantity: int = 24) -> jax.Array:
+    li = tables["lineitem"]
+    cols = {k: li.cols[k] for k in ("l_shipdate", "l_discount",
+                                    "l_quantity", "l_extendedprice")}
+
+    def local(valid, c):
+        return _q06_local(valid, c, date_to_int(d0), date_to_int(d1),
+                          discount, quantity)
+
+    return sharded_query(local, mesh, axis, cols)
+
+
+# ------------------------------------------------------------------ Q04
+def sharded_q04(tables: Tables, mesh: Mesh, axis: str = "data",
+                d0: str = "1993-07-01",
+                d1: str = "1993-10-01") -> jax.Array:
+    """Distributed EXISTS semi-join + count in two collective phases —
+    the reference's plan shape exactly:
+
+    1. lineitem row-sharded: each shard marks the order keys for which
+       it holds a late item; ``psum`` merges the marks (combiner →
+       shuffle → aggregator).
+    2. orders row-sharded, the merged mark table REPLICATED — the
+       broadcast-join build side (``BroadcastJoinBuildHTJobStage``) —
+       and probed per shard; the per-priority counts psum again.
+    """
+    orders, li = tables["orders"], tables["lineitem"]
+    n_pri = len(orders.dicts["o_orderpriority"])
+    n_okey = key_space(li, "l_orderkey")
+    a, b = date_to_int(d0), date_to_int(d1)
+
+    def mark_local(valid, c):
+        late = valid & (c["l_commitdate"] < c["l_receiptdate"])
+        marks = K.segment_count(c["l_orderkey"], n_okey, late)
+        return jnp.minimum(marks, 1)
+
+    marks = sharded_query(
+        mark_local, mesh, axis,
+        {k: li.cols[k] for k in
+         ("l_orderkey", "l_commitdate", "l_receiptdate")})
+
+    def count_local(valid, o, marks_rep):
+        ok = o["o_orderkey"]
+        in_space = (ok >= 0) & (ok < n_okey)
+        has_late = valid & in_space & (
+            jnp.take(marks_rep, jnp.clip(ok, 0, n_okey - 1)) > 0)
+        in_q = (o["o_orderdate"] >= a) & (o["o_orderdate"] < b)
+        return K.segment_count(o["o_orderpriority"], n_pri,
+                               has_late & in_q)
+
+    return sharded_query(
+        count_local, mesh, axis,
+        {k: orders.cols[k] for k in
+         ("o_orderkey", "o_orderdate", "o_orderpriority")},
+        replicated=(marks,))
