@@ -34,7 +34,7 @@ every tensor op in this framework uses).
 from __future__ import annotations
 
 import functools
-from typing import Callable, Dict, Sequence, Tuple
+from typing import Callable, Dict, Optional, Sequence, Tuple
 
 import jax
 import jax.numpy as jnp
@@ -42,7 +42,9 @@ import numpy as np
 from jax.sharding import Mesh, PartitionSpec as P
 
 from netsdb_tpu.relational import kernels as K
-from netsdb_tpu.relational.queries import Tables, key_space
+import re
+
+from netsdb_tpu.relational.queries import Tables, _lut, key_space
 from netsdb_tpu.relational.table import date_to_int
 
 
@@ -65,23 +67,29 @@ def shard_fact_columns(cols: Dict[str, jnp.ndarray], n_shards: int,
 def sharded_query(local_kernel: Callable[..., jax.Array], mesh: Mesh,
                   axis: str, fact: Dict[str, jnp.ndarray],
                   replicated: Sequence[jax.Array] = (),
-                  scalars: Sequence = ()) -> jax.Array:
+                  scalars: Sequence = (),
+                  combine: Optional[Callable] = None) -> jax.Array:
     """Run ``local_kernel(valid, fact_cols..., replicated..., scalars...)``
-    per shard and psum its fixed-shape aggregate over ``axis``.
+    per shard and combine its fixed-shape partial aggregate over
+    ``axis`` (default ``psum``; pass ``jax.lax.pmin``/``pmax`` for
+    min/max merges — the reference's AggregationProcessor runs the
+    aggregate's own combine the same way).
 
-    ``local_kernel`` must return per-shard PARTIAL aggregates whose sum
-    over shards is the global answer (the combiner/aggregator contract).
+    ``local_kernel`` must return per-shard PARTIAL aggregates whose
+    combine over shards is the global answer. The result may be a
+    pytree (e.g. ``(sums, counts)``) — each leaf is combined.
     """
     n_shards = mesh.shape[axis]
     fact_p, valid = shard_fact_columns(fact, n_shards)
     names = sorted(fact_p)
+    combine = combine or jax.lax.psum
 
     def body(valid_s, *args):
         k = len(names)
         cols = dict(zip(names, args[:k]))
         rep = args[k:k + len(replicated)]
         partial = local_kernel(valid_s, cols, *rep, *scalars)
-        return jax.lax.psum(partial, axis)
+        return jax.tree_util.tree_map(lambda x: combine(x, axis), partial)
 
     fn = jax.shard_map(
         body, mesh=mesh,
@@ -90,6 +98,37 @@ def sharded_query(local_kernel: Callable[..., jax.Array], mesh: Mesh,
         out_specs=P(),
     )
     return fn(valid, *[fact_p[n] for n in names], *replicated)
+
+
+def sharded_key_marks(mesh: Mesh, axis: str, key_col: jnp.ndarray,
+                      n_keys: int,
+                      row_mask: Optional[jnp.ndarray] = None,
+                      extra_cols: Optional[Dict[str, jnp.ndarray]] = None,
+                      mask_fn: Optional[Callable] = None) -> jax.Array:
+    """0/1 existence marks per key, psum-merged over shards — the
+    build-HT half of a distributed semi/anti-join (Q04's late-order
+    set, Q22's has-orders set). ``mask_fn(valid, cols)`` may narrow
+    which rows mark (cols include ``key`` plus ``extra_cols``)."""
+    fact = {"key": key_col}
+    if row_mask is not None:
+        fact["row_mask"] = row_mask
+    fact.update(extra_cols or {})
+
+    def local(valid, c):
+        m = valid if row_mask is None else (valid & c["row_mask"])
+        if mask_fn is not None:
+            m = m & mask_fn(valid, c)
+        return jnp.minimum(K.segment_count(c["key"], n_keys, m), 1)
+
+    return sharded_query(local, mesh, axis, fact)
+
+
+def probe_marks(marks: jnp.ndarray, keys: jnp.ndarray,
+                n_keys: int) -> jnp.ndarray:
+    """Per-row membership against a psum-merged mark table (the probe
+    half; out-of-space keys are non-members)."""
+    in_space = (keys >= 0) & (keys < n_keys)
+    return in_space & (jnp.take(marks, jnp.clip(keys, 0, n_keys - 1)) > 0)
 
 
 # ------------------------------------------------------------------ Q01
@@ -169,21 +208,14 @@ def sharded_q04(tables: Tables, mesh: Mesh, axis: str = "data",
     n_okey = key_space(li, "l_orderkey")
     a, b = date_to_int(d0), date_to_int(d1)
 
-    def mark_local(valid, c):
-        late = valid & (c["l_commitdate"] < c["l_receiptdate"])
-        marks = K.segment_count(c["l_orderkey"], n_okey, late)
-        return jnp.minimum(marks, 1)
-
-    marks = sharded_query(
-        mark_local, mesh, axis,
-        {k: li.cols[k] for k in
-         ("l_orderkey", "l_commitdate", "l_receiptdate")})
+    marks = sharded_key_marks(
+        mesh, axis, li["l_orderkey"], n_okey,
+        extra_cols={"l_commitdate": li["l_commitdate"],
+                    "l_receiptdate": li["l_receiptdate"]},
+        mask_fn=lambda valid, c: c["l_commitdate"] < c["l_receiptdate"])
 
     def count_local(valid, o, marks_rep):
-        ok = o["o_orderkey"]
-        in_space = (ok >= 0) & (ok < n_okey)
-        has_late = valid & in_space & (
-            jnp.take(marks_rep, jnp.clip(ok, 0, n_okey - 1)) > 0)
+        has_late = valid & probe_marks(marks_rep, o["o_orderkey"], n_okey)
         in_q = (o["o_orderdate"] >= a) & (o["o_orderdate"] < b)
         return K.segment_count(o["o_orderpriority"], n_pri,
                                has_late & in_q)
@@ -193,3 +225,280 @@ def sharded_q04(tables: Tables, mesh: Mesh, axis: str = "data",
         {k: orders.cols[k] for k in
          ("o_orderkey", "o_orderdate", "o_orderpriority")},
         replicated=(marks,))
+
+
+# ------------------------------------------------------------------ Q12
+def sharded_q12(tables: Tables, mesh: Mesh, axis: str = "data",
+                mode1: str = "MAIL", mode2: str = "SHIP",
+                d0: str = "1994-01-01", d1: str = "1995-01-01") -> jax.Array:
+    """Late-shipmode counts: lineitem sharded, orders replicated (the
+    broadcast-join side feeding the priority lookup)."""
+    li, orders = tables["lineitem"], tables["orders"]
+    n_modes = len(li.dicts["l_shipmode"])
+    n_okey = key_space(li, "l_orderkey")
+    m1, m2 = li.code("l_shipmode", mode1), li.code("l_shipmode", mode2)
+    hi = _lut(orders.dicts["o_orderpriority"],
+              lambda s: s in ("1-URGENT", "2-HIGH"))
+    a, b = date_to_int(d0), date_to_int(d1)
+
+    def local(valid, c, o_key, o_pri, hi_lut):
+        mask = (valid & ((c["l_shipmode"] == m1) | (c["l_shipmode"] == m2))
+                & (c["l_commitdate"] < c["l_receiptdate"])
+                & (c["l_shipdate"] < c["l_commitdate"])
+                & (c["l_receiptdate"] >= a) & (c["l_receiptdate"] < b))
+        oidx, ohit = K.pk_fk_join(o_key, c["l_orderkey"], key_space=n_okey)
+        mask = mask & ohit
+        high = jnp.take(hi_lut, jnp.take(o_pri, oidx))
+        return jnp.stack([
+            K.segment_count(c["l_shipmode"], n_modes, mask & high),
+            K.segment_count(c["l_shipmode"], n_modes, mask & ~high)])
+
+    return sharded_query(
+        local, mesh, axis,
+        {k: li.cols[k] for k in ("l_orderkey", "l_shipmode", "l_shipdate",
+                                 "l_commitdate", "l_receiptdate")},
+        replicated=(orders["o_orderkey"], orders["o_orderpriority"], hi))
+
+
+# ------------------------------------------------------------------ Q13
+def sharded_q13(tables: Tables, mesh: Mesh, axis: str = "data",
+                word1: str = "special",
+                word2: str = "requests") -> jax.Array:
+    """Per-customer order counts (n_cust,) int32, psum-merged; the
+    histogram finishes on the merged vector exactly as the single-chip
+    query does."""
+    cust, orders = tables["customer"], tables["orders"]
+    n_cust = key_space(cust, "c_custkey")
+    if "o_comment" in orders.dicts:
+        pat = re.compile(f"{re.escape(word1)}.*{re.escape(word2)}")
+        keep_lut = _lut(orders.dicts["o_comment"],
+                        lambda s: not pat.search(s))
+        keep = jnp.take(keep_lut, orders["o_comment"])
+    else:
+        keep = jnp.ones((orders["o_custkey"].shape[0],), jnp.bool_)
+
+    def local(valid, c):
+        return K.segment_count(c["o_custkey"], n_cust, valid & c["keep"])
+
+    counts = sharded_query(local, mesh, axis,
+                           {"o_custkey": orders["o_custkey"],
+                            "keep": keep})
+    return jnp.take(counts, cust["c_custkey"])  # per-customer, zeros kept
+
+
+# ------------------------------------------------------------------ Q14
+def sharded_q14(tables: Tables, mesh: Mesh, axis: str = "data",
+                d0: str = "1995-09-01",
+                d1: str = "1995-10-01") -> jax.Array:
+    """(promo_revenue, total_revenue): lineitem sharded, part replicated."""
+    li, part = tables["lineitem"], tables["part"]
+    n_pkey = key_space(li, "l_partkey")
+    promo = _lut(part.dicts["p_type"], lambda s: s.startswith("PROMO"))
+    a, b = date_to_int(d0), date_to_int(d1)
+
+    def local(valid, c, p_key, p_type, promo_lut):
+        mask = valid & (c["l_shipdate"] >= a) & (c["l_shipdate"] < b)
+        pidx, phit = K.pk_fk_join(p_key, c["l_partkey"], key_space=n_pkey)
+        mask = mask & phit
+        rev = jnp.where(mask, c["l_extendedprice"] * (1.0 - c["l_discount"]),
+                        0.0)
+        is_promo = jnp.take(promo_lut, jnp.take(p_type, pidx))
+        return jnp.stack([jnp.sum(jnp.where(is_promo, rev, 0.0)),
+                          jnp.sum(rev)])
+
+    return sharded_query(
+        local, mesh, axis,
+        {k: li.cols[k] for k in ("l_partkey", "l_shipdate",
+                                 "l_extendedprice", "l_discount")},
+        replicated=(part["p_partkey"], part["p_type"], promo))
+
+
+# ------------------------------------------------------------------ Q17
+def sharded_q17(tables: Tables, mesh: Mesh, axis: str = "data",
+                brand: str = "Brand#23",
+                container: str = "MED BOX") -> jax.Array:
+    """Small-quantity revenue, two phases: (1) per-part qty sums+counts
+    psum (the global avg needs every shard's rows), (2) the avg table
+    replicated back and the below-avg revenue summed per shard."""
+    li, part = tables["lineitem"], tables["part"]
+    n_part = key_space(li, "l_partkey")
+    brand_code = part.code("p_brand", brand)
+    cont_code = part.code("p_container", container)
+    li_cols = {k: li.cols[k] for k in ("l_partkey", "l_quantity",
+                                       "l_extendedprice")}
+
+    def phase1(valid, c, p_key, p_brand, p_cont):
+        part_ok = (p_brand == brand_code) & (p_cont == cont_code)
+        _, phit = K.pk_fk_join(p_key, c["l_partkey"], part_ok,
+                               key_space=n_part)
+        phit = phit & valid
+        qty = c["l_quantity"].astype(jnp.float32)
+        return (K.segment_sum(qty, c["l_partkey"], n_part, phit),
+                K.segment_count(c["l_partkey"], n_part, phit))
+
+    sums, cnts = sharded_query(
+        phase1, mesh, axis, li_cols,
+        replicated=(part["p_partkey"], part["p_brand"],
+                    part["p_container"]))
+    avg = sums / jnp.maximum(cnts, 1).astype(jnp.float32)
+
+    def phase2(valid, c, p_key, p_brand, p_cont, avg_rep):
+        part_ok = (p_brand == brand_code) & (p_cont == cont_code)
+        _, phit = K.pk_fk_join(p_key, c["l_partkey"], part_ok,
+                               key_space=n_part)
+        phit = phit & valid
+        qty = c["l_quantity"].astype(jnp.float32)
+        small = phit & (qty < 0.2 * jnp.take(avg_rep, c["l_partkey"]))
+        return jnp.sum(jnp.where(small, c["l_extendedprice"], 0.0))
+
+    total = sharded_query(
+        phase2, mesh, axis, li_cols,
+        replicated=(part["p_partkey"], part["p_brand"],
+                    part["p_container"], avg))
+    return total / 7.0
+
+
+# ------------------------------------------------------------------ Q22
+def sharded_q22(tables: Tables, mesh: Mesh, axis: str = "data",
+                prefixes: Tuple[str, ...] = ("13", "31", "23", "29", "30",
+                                             "18", "17")) -> jax.Array:
+    """Anti-join in three collective phases: order marks psum; global
+    positive-balance average psum; per-prefix counts/sums psum with the
+    marks replicated (broadcast anti-join probe)."""
+    cust, orders = tables["customer"], tables["orders"]
+    pref_list = sorted(set(prefixes))
+    pref_idx = {p: i for i, p in enumerate(pref_list)}
+    n_pref = len(pref_list)
+    phone_dict = cust.dicts["c_phone"]
+    code_lut = jnp.asarray(np.fromiter(
+        (pref_idx.get(s[:2], -1) for s in phone_dict), np.int32,
+        len(phone_dict)))
+    n_ckey = key_space(orders, "o_custkey")
+
+    marks = sharded_key_marks(mesh, axis, orders["o_custkey"], n_ckey)
+
+    cust_cols = {k: cust.cols[k] for k in ("c_custkey", "c_phone",
+                                           "c_acctbal")}
+
+    def avg_local(valid, c, lut):
+        pref = jnp.take(lut, c["c_phone"])
+        pos = valid & (pref >= 0) & (c["c_acctbal"] > 0)
+        return (jnp.sum(jnp.where(pos, c["c_acctbal"], 0.0)),
+                jnp.sum(pos.astype(jnp.int32)))
+
+    bal_sum, bal_cnt = sharded_query(avg_local, mesh, axis, cust_cols,
+                                     replicated=(code_lut,))
+    avg = bal_sum / jnp.maximum(bal_cnt, 1).astype(jnp.float32)
+
+    def count_local(valid, c, lut, marks_rep, avg_rep):
+        pref = jnp.take(lut, c["c_phone"])
+        has_orders = probe_marks(marks_rep, c["c_custkey"], n_ckey)
+        sel = (valid & (pref >= 0) & (c["c_acctbal"] > avg_rep)
+               & ~has_orders)
+        seg = jnp.clip(pref, 0, n_pref - 1)
+        return jnp.stack([
+            K.segment_count(seg, n_pref, sel).astype(jnp.float32),
+            K.segment_sum(c["c_acctbal"], seg, n_pref, sel)])
+
+    return sharded_query(count_local, mesh, axis, cust_cols,
+                         replicated=(code_lut, marks, avg))
+
+
+# ------------------------------------------------------------------ Q03
+def sharded_q03(tables: Tables, mesh: Mesh, axis: str = "data",
+                segment: str = "BUILDING", date: str = "1995-03-15",
+                k: int = 10):
+    """Top unshipped orders: lineitem sharded, customer/orders
+    replicated; per-order revenue psum-merged, top-k on the merged
+    vector (small) outside the map."""
+    cust, orders, li = tables["customer"], tables["orders"], tables["lineitem"]
+    n_orders = key_space(li, "l_orderkey")
+    n_cust = key_space(cust, "c_custkey")
+    seg_code = cust.code("c_mktsegment", segment)
+    d = date_to_int(date)
+
+    def local(valid, c, c_key, c_seg, o_key, o_cust, o_date):
+        cust_ok = c_seg == seg_code
+        _, chit = K.pk_fk_join(c_key, o_cust, cust_ok, key_space=n_cust)
+        order_ok = chit & (o_date < d)
+        oidx, ohit = K.pk_fk_join(o_key, c["l_orderkey"], order_ok,
+                                  key_space=n_orders)
+        li_ok = valid & ohit & (c["l_shipdate"] > d)
+        rev = c["l_extendedprice"] * (1.0 - c["l_discount"])
+        return K.segment_sum(rev, c["l_orderkey"], n_orders, li_ok)
+
+    rev = sharded_query(
+        local, mesh, axis,
+        {q: li.cols[q] for q in ("l_orderkey", "l_shipdate",
+                                 "l_extendedprice", "l_discount")},
+        replicated=(cust["c_custkey"], cust["c_mktsegment"],
+                    orders["o_orderkey"], orders["o_custkey"],
+                    orders["o_orderdate"]))
+    top_idx, top_ok = K.top_k_masked(rev, k, rev > 0)
+    # order date lookup for the winners — the same guarded LUT probe as
+    # every other join in this module
+    oidx, ohit = K.pk_fk_join(orders["o_orderkey"], top_idx,
+                              key_space=n_orders)
+    odate = jnp.where(ohit, jnp.take(orders["o_orderdate"], oidx), 0)
+    return top_idx, top_ok, odate, jnp.take(rev, top_idx)
+
+
+# ------------------------------------------------------------------ Q02
+def sharded_q02(tables: Tables, mesh: Mesh, axis: str = "data",
+                size: int = 15, type_suffix: str = "BRUSHED",
+                region: str = "EUROPE"):
+    """Min-cost supplier per part: partsupp sharded, the entire
+    dimension chain (part/supplier/nation/region) replicated; the
+    per-part min cost merges with ``pmin`` (the aggregate's own
+    combine), then a second pmin pass picks the global winner row."""
+    part, ps = tables["part"], tables["partsupp"]
+    sup, nat, reg = tables["supplier"], tables["nation"], tables["region"]
+    n_part = key_space(ps, "ps_partkey")
+    n_sup = key_space(sup, "s_suppkey")
+    n_nat = key_space(nat, "n_nationkey")
+    n_reg = key_space(reg, "r_regionkey")
+    type_ok = _lut(part.dicts["p_type"], lambda s: s.endswith(type_suffix))
+    region_code = reg.code("r_name", region)
+    ps_cols = {q: ps.cols[q] for q in ("ps_partkey", "ps_suppkey",
+                                       "ps_supplycost")}
+    dims = (part["p_partkey"], part["p_size"], part["p_type"],
+            sup["s_suppkey"], sup["s_nationkey"],
+            nat["n_nationkey"], nat["n_regionkey"],
+            reg["r_regionkey"], reg["r_name"], type_ok)
+
+    def valid_mask(valid, c, p_key, p_size, p_type, s_key, s_nat, n_key,
+                   n_regk, r_key, r_name, tok):
+        part_ok = (p_size == size) & jnp.take(tok, p_type)
+        _, phit = K.pk_fk_join(p_key, c["ps_partkey"], part_ok,
+                               key_space=n_part)
+        nidx, nhit = K.pk_fk_join(n_key, s_nat, key_space=n_nat)
+        sup_region = jnp.take(n_regk, nidx)
+        ridx, rhit = K.pk_fk_join(r_key, sup_region, key_space=n_reg)
+        in_region = nhit & rhit & (jnp.take(r_name, ridx) == region_code)
+        _, shit = K.pk_fk_join(s_key, c["ps_suppkey"], in_region,
+                               key_space=n_sup)
+        return valid & phit & shit
+
+    def phase1(valid, c, *dims_r):
+        ok = valid_mask(valid, c, *dims_r)
+        return K.segment_min(c["ps_supplycost"], c["ps_partkey"], n_part,
+                             ok)
+
+    cost_min = sharded_query(phase1, mesh, axis, ps_cols,
+                             replicated=dims, combine=jax.lax.pmin)
+
+    def phase2(valid, c, *args):
+        *dims_r, cmin = args
+        ok = valid_mask(valid, c, *dims_r)
+        at_min = ok & (c["ps_supplycost"] == jnp.take(cmin,
+                                                      c["ps_partkey"]))
+        # global row ids travel as a fact column so winner correctness
+        # does not depend on shard_fact_columns' internal row layout
+        return K.segment_min(c["row_id"], c["ps_partkey"], n_part, at_min)
+
+    winner = sharded_query(
+        phase2, mesh, axis,
+        {**ps_cols,
+         "row_id": jnp.arange(ps.num_rows, dtype=jnp.int32)},
+        replicated=dims + (cost_min,), combine=jax.lax.pmin)
+    return winner, cost_min

@@ -74,3 +74,148 @@ def test_sharded_q01_other_mesh_shapes(tables):
         np.testing.assert_allclose(np.asarray(s), np.asarray(rs),
                                    rtol=1e-5, atol=1e-3)
         np.testing.assert_array_equal(np.asarray(c), np.asarray(rc))
+
+
+def test_sharded_q12_matches_local(tables, mesh):
+    from netsdb_tpu.relational.sharded import sharded_q12
+    li, orders = tables["lineitem"], tables["orders"]
+    from netsdb_tpu.relational.queries import _lut
+    n_modes = len(li.dicts["l_shipmode"])
+    m1, m2 = li.code("l_shipmode", "MAIL"), li.code("l_shipmode", "SHIP")
+    hi = _lut(orders.dicts["o_orderpriority"],
+              lambda s: s in ("1-URGENT", "2-HIGH"))
+    expect = np.asarray(Q._q12_core(
+        n_modes, Q.key_space(li, "l_orderkey"),
+        orders["o_orderkey"], orders["o_orderpriority"], li["l_orderkey"],
+        li["l_shipmode"], li["l_shipdate"], li["l_commitdate"],
+        li["l_receiptdate"], hi, m1, m2,
+        Q.date_to_int("1994-01-01"), Q.date_to_int("1995-01-01")))
+    got = np.asarray(sharded_q12(tables, mesh))
+    np.testing.assert_array_equal(got, expect)
+
+
+def test_sharded_q13_matches_local(tables, mesh):
+    import re
+
+    import jax.numpy as jnp
+
+    from netsdb_tpu.relational.queries import _lut
+    from netsdb_tpu.relational.sharded import sharded_q13
+    cust, orders = tables["customer"], tables["orders"]
+    n_cust = Q.key_space(cust, "c_custkey")
+    if "o_comment" in orders.dicts:
+        pat = re.compile("special.*requests")
+        keep = jnp.take(_lut(orders.dicts["o_comment"],
+                             lambda s: not pat.search(s)),
+                        orders["o_comment"])
+    else:
+        keep = jnp.ones((orders["o_custkey"].shape[0],), jnp.bool_)
+    expect = np.asarray(Q._q13_per_cust(
+        n_cust, orders["o_custkey"], keep, cust["c_custkey"]))
+    got = np.asarray(sharded_q13(tables, mesh))
+    np.testing.assert_array_equal(got, expect)
+
+
+def test_sharded_q14_matches_local(tables, mesh):
+    from netsdb_tpu.relational.sharded import sharded_q14
+    from netsdb_tpu.relational.queries import _lut
+    li, part = tables["lineitem"], tables["part"]
+    promo = _lut(part.dicts["p_type"], lambda s: s.startswith("PROMO"))
+    expect = np.asarray(Q._q14_core(
+        Q.key_space(li, "l_partkey"), part["p_partkey"], part["p_type"],
+        li["l_partkey"], li["l_shipdate"], li["l_extendedprice"],
+        li["l_discount"], promo, Q.date_to_int("1995-09-01"),
+        Q.date_to_int("1995-10-01")))
+    got = np.asarray(sharded_q14(tables, mesh))
+    np.testing.assert_allclose(got, expect, rtol=1e-5, atol=1e-3)
+
+
+def test_sharded_q17_matches_local(tables, mesh):
+    from netsdb_tpu.relational.sharded import sharded_q17
+    li, part = tables["lineitem"], tables["part"]
+    brand = part.dicts["p_brand"][0]
+    cont = part.dicts["p_container"][0]
+    expect = float(Q._q17_core(
+        Q.key_space(li, "l_partkey"), part["p_partkey"], part["p_brand"],
+        part["p_container"], li["l_partkey"], li["l_quantity"],
+        li["l_extendedprice"], part.code("p_brand", brand),
+        part.code("p_container", cont)))
+    got = float(sharded_q17(tables, mesh, brand=brand, container=cont))
+    assert got == pytest.approx(expect, rel=1e-5, abs=1e-3)
+
+
+def test_sharded_q22_matches_local(tables, mesh):
+    from netsdb_tpu.relational.sharded import sharded_q22
+    import jax.numpy as jnp
+    cust, orders = tables["customer"], tables["orders"]
+    prefixes = ("13", "31", "23", "29", "30", "18", "17")
+    pref_list = sorted(set(prefixes))
+    pref_idx = {p: i for i, p in enumerate(pref_list)}
+    phone_dict = cust.dicts["c_phone"]
+    code_lut = jnp.asarray(np.fromiter(
+        (pref_idx.get(s[:2], -1) for s in phone_dict), np.int32,
+        len(phone_dict)))
+    expect = np.asarray(Q._q22_core(
+        len(pref_list), Q.key_space(orders, "o_custkey"),
+        cust["c_custkey"], cust["c_phone"], cust["c_acctbal"],
+        orders["o_custkey"], code_lut))
+    got = np.asarray(sharded_q22(tables, mesh, prefixes=prefixes))
+    np.testing.assert_allclose(got, expect, rtol=1e-5, atol=1e-2)
+
+
+def test_sharded_q03_matches_local(tables, mesh):
+    from netsdb_tpu.relational.sharded import sharded_q03
+    cust, orders, li = (tables["customer"], tables["orders"],
+                        tables["lineitem"])
+    seg = cust.dicts["c_mktsegment"][0]
+    ints, rev = Q._q03_core(
+        Q.key_space(li, "l_orderkey"), 10, Q.key_space(cust, "c_custkey"),
+        cust["c_custkey"], cust["c_mktsegment"], orders["o_orderkey"],
+        orders["o_custkey"], orders["o_orderdate"], li["l_orderkey"],
+        li["l_shipdate"], li["l_extendedprice"], li["l_discount"],
+        cust.code("c_mktsegment", seg), Q.date_to_int("1995-03-15"))
+    ints, rev = np.asarray(ints), np.asarray(rev)
+    top_idx, top_ok, odate, grev = sharded_q03(tables, mesh, segment=seg)
+    np.testing.assert_array_equal(np.asarray(top_idx), ints[0])
+    np.testing.assert_array_equal(np.asarray(top_ok), ints[1].astype(bool))
+    # odates agree where the slot is live
+    live = ints[1].astype(bool)
+    np.testing.assert_array_equal(np.asarray(odate)[live], ints[2][live])
+    np.testing.assert_allclose(np.asarray(grev), rev, rtol=1e-5, atol=1e-2)
+
+
+def test_sharded_q02_matches_local(tables, mesh):
+    from netsdb_tpu.relational.sharded import sharded_q02
+    from netsdb_tpu.relational.queries import _lut
+    part, ps = tables["part"], tables["partsupp"]
+    sup, nat, reg = (tables["supplier"], tables["nation"],
+                     tables["region"])
+    size = int(np.asarray(part["p_size"])[0])
+    suffix = part.dicts["p_type"][0].split()[-1]
+    region = reg.dicts["r_name"][0]
+    n_part = Q.key_space(ps, "ps_partkey")
+    type_ok = _lut(part.dicts["p_type"], lambda s: s.endswith(suffix))
+    ints, cost_min = Q._q02_core(
+        n_part, Q.key_space(sup, "s_suppkey"),
+        Q.key_space(nat, "n_nationkey"), Q.key_space(reg, "r_regionkey"),
+        part["p_partkey"], part["p_size"], part["p_type"],
+        ps["ps_partkey"], ps["ps_suppkey"], ps["ps_supplycost"],
+        sup["s_suppkey"], sup["s_nationkey"], reg["r_regionkey"],
+        reg["r_name"], nat["n_nationkey"], nat["n_regionkey"],
+        type_ok, size, reg.code("r_name", region))
+    ints = np.asarray(ints)
+    winner, g_cost = sharded_q02(tables, mesh, size=size,
+                                 type_suffix=suffix, region=region)
+    winner, g_cost = np.asarray(winner), np.asarray(g_cost)
+    has = ints[0].astype(bool)
+    # min costs agree everywhere a part qualifies
+    np.testing.assert_allclose(g_cost[has], np.asarray(cost_min)[has],
+                               rtol=1e-6, atol=1e-4)
+    imax = np.iinfo(np.int32).max
+    np.testing.assert_array_equal(winner < imax, has)
+    # winning rows resolve to the same supplier cost (row ids may differ
+    # when several rows tie at the min — any-representative semantics)
+    ps_cost = np.asarray(ps["ps_supplycost"])
+    live = winner[has]
+    np.testing.assert_allclose(ps_cost[live], g_cost[has], rtol=1e-6,
+                               atol=1e-4)
