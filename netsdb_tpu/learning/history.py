@@ -11,7 +11,7 @@ the placement advisor (``netsdb_tpu.learning.advisor``) learns from.
 
 from __future__ import annotations
 
-import json
+
 import os
 import sqlite3
 import threading

@@ -36,7 +36,7 @@ planner (``src/queryPlanning/headers/TCAPAnalyzer.h``).
 from __future__ import annotations
 
 import functools
-from typing import Callable, Dict, List, Tuple
+from typing import Callable, Dict, List, Sequence, Tuple
 
 import jax
 import jax.numpy as jnp
@@ -399,17 +399,25 @@ def _q22_core(n_pref, n_ckey, c_key, c_phone, c_bal, o_cust, code_lut):
                       K.segment_sum(c_bal, seg, n_pref, sel)])
 
 
+def q22_code_lut(phone_dict: List[str], prefixes: Sequence[str]
+                 ) -> Tuple[List[str], jnp.ndarray]:
+    """Phone-dictionary → prefix-group code LUT (-1 = no group). Shared
+    by the local and sharded Q22 engines so prefix semantics cannot
+    diverge."""
+    pref_list = sorted(set(prefixes))
+    pref_idx = {p: i for i, p in enumerate(pref_list)}
+    lut = jnp.asarray(np.fromiter(
+        (pref_idx.get(s[:2], -1) for s in phone_dict), np.int32,
+        len(phone_dict)))
+    return pref_list, lut
+
+
 def cq22(tables: Tables,
          prefixes: Tuple[str, ...] = ("13", "31", "23", "29", "30", "18",
                                       "17")):
     """Well-funded customers with no orders, grouped by phone prefix."""
     cust, orders = tables["customer"], tables["orders"]
-    pref_list = sorted(set(prefixes))
-    pref_idx = {p: i for i, p in enumerate(pref_list)}
-    phone_dict = cust.dicts["c_phone"]
-    code_lut = jnp.asarray(np.fromiter(
-        (pref_idx.get(s[:2], -1) for s in phone_dict), np.int32,
-        len(phone_dict)))
+    pref_list, code_lut = q22_code_lut(cust.dicts["c_phone"], prefixes)
     packed = np.asarray(_q22_core(
         len(pref_list), key_space(orders, "o_custkey"),
         cust["c_custkey"], cust["c_phone"],

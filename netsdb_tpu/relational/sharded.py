@@ -44,7 +44,8 @@ from jax.sharding import Mesh, PartitionSpec as P
 from netsdb_tpu.relational import kernels as K
 import re
 
-from netsdb_tpu.relational.queries import Tables, _lut, key_space
+from netsdb_tpu.relational.queries import (Tables, _lut, key_space,
+                                           q22_code_lut)
 from netsdb_tpu.relational.table import date_to_int
 
 
@@ -366,13 +367,8 @@ def sharded_q22(tables: Tables, mesh: Mesh, axis: str = "data",
     positive-balance average psum; per-prefix counts/sums psum with the
     marks replicated (broadcast anti-join probe)."""
     cust, orders = tables["customer"], tables["orders"]
-    pref_list = sorted(set(prefixes))
-    pref_idx = {p: i for i, p in enumerate(pref_list)}
+    pref_list, code_lut = q22_code_lut(cust.dicts["c_phone"], prefixes)
     n_pref = len(pref_list)
-    phone_dict = cust.dicts["c_phone"]
-    code_lut = jnp.asarray(np.fromiter(
-        (pref_idx.get(s[:2], -1) for s in phone_dict), np.int32,
-        len(phone_dict)))
     n_ckey = key_space(orders, "o_custkey")
 
     marks = sharded_key_marks(mesh, axis, orders["o_custkey"], n_ckey)

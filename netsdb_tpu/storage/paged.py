@@ -80,6 +80,10 @@ class PagedTensorStore:
         config.ensure_dirs()
         self._meta: Dict[int, Tuple[Tuple[int, int], Tuple[int, int], np.dtype]] = {}
         self._ids: Dict[str, int] = {}
+        # live prefetch reader threads: must be joined before the
+        # backend is destroyed (a reader mid-read_page on a freed C++
+        # arena is a use-after-free)
+        self._readers: list = []
         if force_python:
             self.backend = _PyPageBackend()
             self.native = False
@@ -121,16 +125,80 @@ class PagedTensorStore:
             self.backend.write_page(sid, dense[r0:r0 + row_block])
         self._meta[sid] = ((rows, cols), (row_block, cols), dense.dtype)
 
-    def stream_blocks(self, name: str) -> Iterator[Tuple[int, np.ndarray]]:
-        """Yield (start_row, block) in order — the PageScanner loop."""
+    def stream_blocks(self, name: str,
+                      prefetch: int = 2) -> Iterator[Tuple[int, np.ndarray]]:
+        """Yield (start_row, block) in order — the PageScanner loop.
+
+        ``prefetch`` pages are read ahead on a background thread (the
+        reference's PageCircularBuffer between its scan thread and the
+        pipeline threads — ``src/storage/headers/PageCircularBuffer.h``)
+        so disk/arena reads overlap the consumer's compute; 0 disables.
+        """
         sid = self._ids[name]
         (rows, cols), (rb, _), dtype = self._meta[sid]
+        pids = self.backend.set_pages(sid)
+        starts = []
         r0 = 0
-        for pid in self.backend.set_pages(sid):
-            raw = self.backend.read_page(pid)
-            n = min(rb, rows - r0)
-            yield r0, np.frombuffer(raw, dtype=dtype).reshape(n, cols)
-            r0 += n
+        for _ in pids:
+            starts.append(r0)
+            r0 += min(rb, rows - r0)
+
+        def view(raw, start):
+            n = min(rb, rows - start)
+            return np.frombuffer(raw, dtype=dtype).reshape(n, cols)
+
+        if prefetch <= 0 or len(pids) <= 1:
+            for pid, start in zip(pids, starts):
+                yield start, view(self.backend.read_page(pid), start)
+            return
+
+        import queue
+        import threading
+
+        q: "queue.Queue" = queue.Queue(maxsize=prefetch)
+        SENTINEL = object()
+        stop = threading.Event()
+
+        def put(item) -> bool:
+            while not stop.is_set():
+                try:
+                    q.put(item, timeout=0.1)
+                    return True
+                except queue.Full:
+                    continue
+            return False
+
+        def reader():
+            try:
+                for pid, start in zip(pids, starts):
+                    if not put((start, self.backend.read_page(pid))):
+                        return  # consumer abandoned the stream
+            except BaseException as e:  # ANY death must unblock the consumer
+                put((SENTINEL, e))
+                return
+            put((SENTINEL, None))
+
+        t = threading.Thread(target=reader, daemon=True)
+        self._readers = [(rt, rs) for rt, rs in self._readers
+                         if rt.is_alive()]
+        self._readers.append((t, stop))
+        t.start()
+        try:
+            while True:
+                try:
+                    start, raw = q.get(timeout=0.5)
+                except queue.Empty:
+                    if not t.is_alive():  # died without a sentinel
+                        raise RuntimeError("page reader thread died")
+                    continue
+                if start is SENTINEL:
+                    if raw is not None:
+                        raise raw
+                    break
+                yield start, view(raw, start)
+        finally:
+            stop.set()
+            t.join(timeout=5)
 
     def to_device_blocked(self, name: str, block_shape=None):
         """Stream into HBM chunk-by-chunk and assemble a BlockedTensor —
@@ -177,4 +245,11 @@ class PagedTensorStore:
         return self.backend.stats()
 
     def close(self):
+        # stop + join any live prefetch readers BEFORE freeing the
+        # native arena they may be reading from
+        for t, stop in self._readers:
+            stop.set()
+        for t, stop in self._readers:
+            t.join(timeout=30)
+        self._readers.clear()
         self.backend.close()

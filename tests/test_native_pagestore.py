@@ -238,3 +238,19 @@ class TestNativeTblParse:
         p.write_text("99999999999999999999999|AFRICA|comment|\n")
         with pytest.raises(ValueError, match="overflow"):
             tblparse.parse_columnar(str(p), _TBL_SCHEMAS["region"])
+
+
+def test_stream_blocks_prefetch_matches_and_abandons(config):
+    """Read-ahead streaming (PageCircularBuffer role): identical bytes,
+    and an abandoned generator must not wedge the reader thread."""
+    pts = PagedTensorStore(config, pool_bytes=1 << 22)
+    m = np.arange(256 * 64, dtype=np.float32).reshape(256, 64)
+    pts.put("pf", m, row_block=32)
+    got = np.concatenate([b for _, b in pts.stream_blocks("pf", prefetch=2)])
+    np.testing.assert_array_equal(got, m)
+    plain = np.concatenate([b for _, b in pts.stream_blocks("pf",
+                                                            prefetch=0)])
+    np.testing.assert_array_equal(plain, m)
+    g = pts.stream_blocks("pf", prefetch=2)
+    next(g)
+    g.close()  # must return promptly (reader observes the stop flag)

@@ -139,6 +139,29 @@ def test_compiled_cache_reused(client):
     assert len(ex._compiled_cache) == 1
 
 
+def test_compiled_cache_sees_mutated_input(client):
+    """A cached plan re-run after the input set changes must read the
+    NEW data (the cache holds the compiled pipeline, never results —
+    the reference's PreCompiledWorkload contract)."""
+    client.create_database("db")
+    client.create_set("db", "m")
+    client.send_matrix("db", "m", np.full((4, 4), 2.0, np.float32), (4, 4))
+    sink = WriteSet(Apply(ScanSet("db", "m"),
+                          lambda t: t.with_data(t.data * 10.0),
+                          label="x10"), "db", "mo")
+    out1 = next(iter(client.execute_computations(
+        sink, job_name="mut-test").values()))
+    assert float(np.asarray(out1.to_dense())[0, 0]) == 20.0
+    # mutate the input set, rerun the SAME computation object
+    from netsdb_tpu.storage.store import SetIdentifier
+
+    client.store.clear_set(SetIdentifier("db", "m"))
+    client.send_matrix("db", "m", np.full((4, 4), 3.0, np.float32), (4, 4))
+    out2 = next(iter(client.execute_computations(
+        sink, job_name="mut-test").values()))
+    assert float(np.asarray(out2.to_dense())[0, 0]) == 30.0
+
+
 class TestPartitionComp:
     """Partition node — reference PartitionComp (TCAP PARTITION atom)."""
 

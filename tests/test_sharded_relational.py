@@ -76,6 +76,22 @@ def test_sharded_q01_other_mesh_shapes(tables):
         np.testing.assert_array_equal(np.asarray(c), np.asarray(rc))
 
 
+@pytest.mark.parametrize("qname", ["q04", "q06", "q17", "q22"])
+def test_sharded_mesh_shape_invariance(tables, qname):
+    """Multi-phase and pmin plans must also be partition-count
+    invariant (covers semi-join, scalar-sum, two-phase-avg, and
+    anti-join shapes; q01 above covers the groupby shape)."""
+    from netsdb_tpu.relational import sharded as S
+
+    fn = getattr(S, f"sharded_{qname}")
+    ref = fn(tables, make_mesh((2,), ("data",), devices=jax.devices()[:2]))
+    got = fn(tables, make_mesh((8,), ("data",), devices=jax.devices()[:8]))
+    for a, b in zip(jax.tree_util.tree_leaves(got),
+                    jax.tree_util.tree_leaves(ref)):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=1e-5, atol=1e-2)
+
+
 def test_sharded_q12_matches_local(tables, mesh):
     from netsdb_tpu.relational.sharded import sharded_q12
     li, orders = tables["lineitem"], tables["orders"]
@@ -145,16 +161,11 @@ def test_sharded_q17_matches_local(tables, mesh):
 
 
 def test_sharded_q22_matches_local(tables, mesh):
+    from netsdb_tpu.relational.queries import q22_code_lut
     from netsdb_tpu.relational.sharded import sharded_q22
-    import jax.numpy as jnp
     cust, orders = tables["customer"], tables["orders"]
     prefixes = ("13", "31", "23", "29", "30", "18", "17")
-    pref_list = sorted(set(prefixes))
-    pref_idx = {p: i for i, p in enumerate(pref_list)}
-    phone_dict = cust.dicts["c_phone"]
-    code_lut = jnp.asarray(np.fromiter(
-        (pref_idx.get(s[:2], -1) for s in phone_dict), np.int32,
-        len(phone_dict)))
+    pref_list, code_lut = q22_code_lut(cust.dicts["c_phone"], prefixes)
     expect = np.asarray(Q._q22_core(
         len(pref_list), Q.key_space(orders, "o_custkey"),
         cust["c_custkey"], cust["c_phone"], cust["c_acctbal"],
