@@ -14,10 +14,22 @@ host with --cpu-baseline and recorded below.
 """
 
 import json
+import os
 import sys
 import time
 
-import numpy as np
+try:
+    import numpy as np
+except ModuleNotFoundError:  # pragma: no cover
+    # the image's PATH python has an empty site-packages; the real
+    # environment (jax/numpy/torch) lives in /opt/venv — re-exec there.
+    # (Both interpreters resolve to the same binary, so the loop guard
+    # is an env flag, not an executable-path comparison.)
+    _venv = "/opt/venv/bin/python"
+    if os.path.exists(_venv) and not os.environ.get("NETSDB_BENCH_REEXEC"):
+        os.environ["NETSDB_BENCH_REEXEC"] = "1"
+        os.execv(_venv, [_venv, os.path.abspath(__file__)] + sys.argv[1:])
+    raise
 
 # FFTest-style workload: batch x features -> hidden -> labels
 BATCH = 16384

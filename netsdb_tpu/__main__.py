@@ -1,5 +1,16 @@
+import os
 import sys
 
-from netsdb_tpu.cli import main
+try:
+    from netsdb_tpu.cli import main
+except ModuleNotFoundError:  # pragma: no cover
+    # PATH python in this image has an empty site-packages; the real
+    # environment lives in /opt/venv — re-exec the CLI there (env-flag
+    # loop guard: both interpreters resolve to the same binary)
+    _venv = "/opt/venv/bin/python"
+    if os.path.exists(_venv) and not os.environ.get("NETSDB_CLI_REEXEC"):
+        os.environ["NETSDB_CLI_REEXEC"] = "1"
+        os.execv(_venv, [_venv, "-m", "netsdb_tpu"] + sys.argv[1:])
+    raise
 
 sys.exit(main())

@@ -251,5 +251,17 @@ class PagedTensorStore:
             stop.set()
         for t, stop in self._readers:
             t.join(timeout=30)
+        still_alive = [t for t, _ in self._readers if t.is_alive()]
         self._readers.clear()
+        if still_alive:
+            # a reader wedged inside read_page (hung IO): destroying the
+            # arena under it is a use-after-free — leak the backend
+            # instead (process exit reclaims it)
+            import warnings
+
+            warnings.warn(
+                f"PagedTensorStore.close: {len(still_alive)} prefetch "
+                f"reader(s) did not stop; leaking the page store to "
+                f"avoid freeing memory they may still touch")
+            return
         self.backend.close()

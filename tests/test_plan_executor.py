@@ -153,9 +153,7 @@ def test_compiled_cache_sees_mutated_input(client):
         sink, job_name="mut-test").values()))
     assert float(np.asarray(out1.to_dense())[0, 0]) == 20.0
     # mutate the input set, rerun the SAME computation object
-    from netsdb_tpu.storage.store import SetIdentifier
-
-    client.store.clear_set(SetIdentifier("db", "m"))
+    client.clear_set("db", "m")
     client.send_matrix("db", "m", np.full((4, 4), 3.0, np.float32), (4, 4))
     out2 = next(iter(client.execute_computations(
         sink, job_name="mut-test").values()))
