@@ -22,13 +22,17 @@ try:
     import numpy as np
 except ModuleNotFoundError:  # pragma: no cover
     # the image's PATH python has an empty site-packages; the real
-    # environment (jax/numpy/torch) lives in /opt/venv — re-exec there.
-    # (Both interpreters resolve to the same binary, so the loop guard
-    # is an env flag, not an executable-path comparison.)
-    _venv = "/opt/venv/bin/python"
-    if os.path.exists(_venv) and not os.environ.get("NETSDB_BENCH_REEXEC"):
-        os.environ["NETSDB_BENCH_REEXEC"] = "1"
-        os.execv(_venv, [_venv, os.path.abspath(__file__)] + sys.argv[1:])
+    # environment lives in /opt/venv — re-exec there via the shared
+    # helper, loaded by FILE PATH (importing the package here would
+    # re-trigger the very error being handled)
+    import importlib.util
+
+    _p = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                      "netsdb_tpu", "_reexec.py")
+    _spec = importlib.util.spec_from_file_location("_netsdb_reexec", _p)
+    _mod = importlib.util.module_from_spec(_spec)
+    _spec.loader.exec_module(_mod)
+    _mod.maybe_reexec("NETSDB_BENCH_REEXEC")
     raise
 
 # FFTest-style workload: batch x features -> hidden -> labels

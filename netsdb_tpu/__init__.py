@@ -18,28 +18,18 @@ master/worker runtime; here the same capabilities are expressed TPU-first:
   set store with a C++ page-cache runtime streaming blocks into HBM.
 """
 
-import os as _os
-import sys as _sys
-
 try:
     import jax as _jax  # noqa: F401  (probe only)
 except ModuleNotFoundError:  # pragma: no cover
     # The image's PATH python has an empty site-packages; the real
-    # environment lives in /opt/venv. ONLY when invoked as the CLI
-    # (`python -m netsdb_tpu ...`, i.e. argv[0] is our __main__.py),
-    # re-exec there — a plain `import netsdb_tpu` from some other
-    # broken interpreter must fail normally, not hijack the process.
-    _venv = "/opt/venv/bin/python"
-    # under `python -m pkg`, argv[0] is literally "-m" while the package
-    # __init__ imports (runpy sets the real path only afterwards)
-    if (_sys.argv
-            and (_sys.argv[0] == "-m"
-                 or _sys.argv[0].endswith(_os.path.join("netsdb_tpu",
-                                                        "__main__.py")))
-            and _os.path.exists(_venv)
-            and not _os.environ.get("NETSDB_CLI_REEXEC")):
-        _os.environ["NETSDB_CLI_REEXEC"] = "1"
-        _os.execv(_venv, [_venv, "-m", "netsdb_tpu"] + _sys.argv[1:])
+    # environment lives in /opt/venv. ONLY for `python -m
+    # netsdb_tpu[...]` invocations, re-exec the ORIGINAL command line
+    # there — a plain `import netsdb_tpu` from some other broken
+    # interpreter must fail normally, not hijack the process.
+    from netsdb_tpu import _reexec
+
+    _reexec.maybe_reexec("NETSDB_CLI_REEXEC",
+                         require_module_prefix="netsdb_tpu")
     raise
 
 from netsdb_tpu.config import Configuration

@@ -1,16 +1,15 @@
-import os
 import sys
 
 try:
     from netsdb_tpu.cli import main
 except ModuleNotFoundError:  # pragma: no cover
-    # PATH python in this image has an empty site-packages; the real
-    # environment lives in /opt/venv — re-exec the CLI there (env-flag
-    # loop guard: both interpreters resolve to the same binary)
-    _venv = "/opt/venv/bin/python"
-    if os.path.exists(_venv) and not os.environ.get("NETSDB_CLI_REEXEC"):
-        os.environ["NETSDB_CLI_REEXEC"] = "1"
-        os.execv(_venv, [_venv, "-m", "netsdb_tpu"] + sys.argv[1:])
+    # second line of defense: the package import probe (see
+    # __init__.py) handles missing jax; this catches a partially
+    # broken environment discovered later in the CLI's own imports
+    from netsdb_tpu import _reexec
+
+    _reexec.maybe_reexec("NETSDB_CLI_REEXEC",
+                         require_module_prefix="netsdb_tpu")
     raise
 
 sys.exit(main())

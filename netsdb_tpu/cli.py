@@ -214,10 +214,55 @@ def _cmd_selftest(args) -> int:
         check(bool(np.all(np.isfinite(np.asarray(hT.to_dense())))),
               "lstm finite hidden state")
 
-    for name, fn in [("selection", selection), ("aggregation", aggregation),
-                     ("lda", lda), ("ff", ff), ("lstm", lstm)]:
+    def conv():  # Conv2dProjTest shapes, numpy differential oracle
+        from netsdb_tpu.ops.conv import conv2d_direct, conv2d_im2col
+
+        x = rng.standard_normal((2, 3, 16, 16)).astype(np.float32)
+        k = rng.standard_normal((4, 3, 3, 3)).astype(np.float32)
+        d = np.asarray(conv2d_direct(x, k))
+        m = np.asarray(conv2d_im2col(x, k))
+        check(bool(np.allclose(d, m, rtol=1e-4, atol=1e-4)),
+              "conv direct vs im2col agree")
+
+    def tpch_columnar():  # columnar engine vs host row engine, Q01/Q06
+        from netsdb_tpu.relational.queries import (COLUMNAR_QUERIES,
+                                                   tables_from_rows)
+        from netsdb_tpu.workloads import tpch as row_engine
+
+        from netsdb_tpu.utils.compare import structurally_close
+
+        data = row_engine.generate(scale=1, seed=4)
+        tabs = tables_from_rows(data)
+        row_engine.load_tables(client, tables=data)
+        for qn in ("q01", "q06"):
+            rows = sorted(row_engine.run_query(client, qn), key=str)
+            col = sorted(COLUMNAR_QUERIES[qn](tabs), key=str)
+            check(structurally_close(col, rows),
+                  f"columnar {qn} equals row engine")
+
+    def pdml():  # LA DSL program (TestLA-style)
+        from netsdb_tpu.dsl.interp import run_pdml
+
+        env = run_pdml("A = ones(4,4,2,2)\nB = identity(4,2)\n"
+                       "C = (A + B) %*% B\nD = rowSum(C)")
+        check(env["D"].shape == (8, 1), "pdml rowSum shape")
+
+    def dedup():  # shared-weight block fingerprinting
+        from netsdb_tpu.core.blocked import BlockedTensor
+        from netsdb_tpu.dedup.detector import block_fingerprints
+
+        t = rng.standard_normal((16, 16)).astype(np.float32)
+        bt = BlockedTensor.from_dense(t, (8, 8))
+        fps = block_fingerprints(bt)
+        check(len(fps) == 4, "dedup fingerprints one per block")
+
+    steps = [("selection", selection), ("aggregation", aggregation),
+             ("lda", lda), ("ff", ff), ("lstm", lstm), ("conv", conv),
+             ("tpch-columnar", tpch_columnar), ("pdml", pdml),
+             ("dedup", dedup)]
+    for name, fn in steps:
         step(name, fn)
-    print(f"{5 - len(failures)}/5 passed")
+    print(f"{len(steps) - len(failures)}/{len(steps)} passed")
     return 1 if failures else 0
 
 

@@ -253,10 +253,12 @@ class PagedTensorStore:
             t.join(timeout=30)
         still_alive = [t for t, _ in self._readers if t.is_alive()]
         self._readers.clear()
-        if still_alive:
+        if still_alive or getattr(self, "_leaked", False):
             # a reader wedged inside read_page (hung IO): destroying the
             # arena under it is a use-after-free — leak the backend
-            # instead (process exit reclaims it)
+            # instead (process exit reclaims it). The flag makes later
+            # close() calls keep leaking rather than free it after all.
+            self._leaked = True
             import warnings
 
             warnings.warn(

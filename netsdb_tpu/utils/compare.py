@@ -1,0 +1,20 @@
+"""Structural result comparison shared by the selftest CLI and the
+engine-parity tests — one definition of "the two engines agree" so
+tolerances cannot silently diverge between CI and the shipped selftest.
+"""
+
+from __future__ import annotations
+
+
+def structurally_close(a, b, rtol: float = 2e-4, atol: float = 2e-3) -> bool:
+    """Recursive equality over dict/list/tuple structures with float
+    tolerance at the leaves (f32 device results vs f64 host oracles)."""
+    if isinstance(a, dict) and isinstance(b, dict):
+        return set(a) == set(b) and all(
+            structurally_close(a[k], b[k], rtol, atol) for k in a)
+    if isinstance(a, (list, tuple)) and isinstance(b, (list, tuple)):
+        return len(a) == len(b) and all(
+            structurally_close(x, y, rtol, atol) for x, y in zip(a, b))
+    if isinstance(a, float) or isinstance(b, float):
+        return abs(float(a) - float(b)) <= max(rtol * abs(float(b)), atol)
+    return a == b

@@ -193,6 +193,11 @@ class TestColumnarVsRowEngine:
     """Differential testing: both engines, same data, same answers."""
 
     def _close(self, a, b, path=""):
+        # same leaf tolerance as the shared engine-parity comparator
+        # (utils/compare.py, used by the selftest CLI); the recursion
+        # here is kept for the path-annotated assertion messages
+        from netsdb_tpu.utils.compare import structurally_close
+
         if isinstance(a, dict):
             assert set(a) == set(b), (path, a, b)
             for k in a:
@@ -202,8 +207,7 @@ class TestColumnarVsRowEngine:
             for i, (x, y) in enumerate(zip(a, b)):
                 self._close(x, y, f"{path}[{i}]")
         elif isinstance(a, float) or isinstance(b, float):
-            assert float(a) == pytest.approx(float(b), rel=2e-4, abs=2e-3), \
-                (path, a, b)
+            assert structurally_close(a, b), (path, a, b)
         else:
             assert a == b, (path, a, b)
 
