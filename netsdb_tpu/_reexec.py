@@ -50,10 +50,14 @@ def maybe_reexec(flag: str,
     if args is None:
         return
     if require_module_prefix is not None:
-        try:
-            mod = args[args.index("-m") + 1]
-        except (ValueError, IndexError):
+        # "-m" must be the interpreter's own option (directly after
+        # argv[0]) — scanning the whole line would let a SCRIPT's
+        # "-m netsdb_tpu" argument hijack `python my_tool.py -m
+        # netsdb_tpu` into a re-exec. Interpreter flags before -m are
+        # rare here; if present we conservatively decline.
+        if len(args) < 3 or args[1] != "-m":
             return
+        mod = args[2]
         if mod != require_module_prefix and not mod.startswith(
                 require_module_prefix + "."):
             return

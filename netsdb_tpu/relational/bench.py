@@ -198,9 +198,35 @@ def bench_queries(tables: Tables,
     return out
 
 
+def bench_suite(tables: Tables, iters: int = 10) -> Dict[str, float]:
+    """The whole ten-query suite as ONE fused jitted program (see
+    queries.compile_suite): wall seconds for all ten queries per call,
+    one controller round-trip total."""
+    from netsdb_tpu.relational.queries import compile_suite
+
+    suite = compile_suite(tables)
+
+    def sync(out):
+        leaves = jax.tree_util.tree_leaves(out)
+        return float(jnp.sum(leaves[-1].astype(jnp.float32)))
+
+    t0 = time.perf_counter()
+    sync(suite())  # compile + first run
+    first = time.perf_counter() - t0
+    times = []
+    for _ in range(iters):
+        t0 = time.perf_counter()
+        sync(suite())
+        times.append(time.perf_counter() - t0)
+    wall = sorted(times)[len(times) // 2]
+    return {"all_ten_queries_wall_seconds": wall,
+            "first_run_seconds": first}
+
+
 def main(sf: float = 0.1, iters: int = 10):
     tables = generate_columnar(sf)
     res = bench_queries(tables, iters=iters)
+    res["suite_fused"] = bench_suite(tables, iters=iters)
     # published-baseline comparison only at SF 1: the reference's scale
     # factor is unrecorded, and dividing its full-scale wall time by a
     # smaller run's would inflate the ratio by the scale difference

@@ -80,15 +80,21 @@ def _q01_core(n_groups, n_ls, ship, rf, ls, qty, price, disc, tax, delta):
     return jnp.stack(rows), K.segment_count(seg, n_groups, mask)
 
 
+def _args_q01(tables: Tables, delta_date: str = "1998-09-02"):
+    li = tables["lineitem"]
+    n_ls = len(li.dicts["l_linestatus"])
+    n_groups = len(li.dicts["l_returnflag"]) * n_ls
+    return (n_groups, n_ls, li["l_shipdate"], li["l_returnflag"],
+            li["l_linestatus"], li["l_quantity"], li["l_extendedprice"],
+            li["l_discount"], li["l_tax"], date_to_int(delta_date))
+
+
 def cq01(tables: Tables, delta_date: str = "1998-09-02"):
     """Pricing summary report. One segment-reduction pass over lineitem."""
     li = tables["lineitem"]
     n_ls = len(li.dicts["l_linestatus"])
     n_groups = len(li.dicts["l_returnflag"]) * n_ls
-    sums, counts = jax.device_get(_q01_core(
-        n_groups, n_ls, li["l_shipdate"], li["l_returnflag"],
-        li["l_linestatus"], li["l_quantity"], li["l_extendedprice"],
-        li["l_discount"], li["l_tax"], date_to_int(delta_date)))
+    sums, counts = jax.device_get(_q01_core(*_args_q01(tables, delta_date)))
     names = ("sum_qty", "sum_base_price", "sum_disc_price", "sum_charge",
              "sum_disc")
     out = []
@@ -142,22 +148,26 @@ def _q02_core(n_part, n_sup, n_nat, n_reg_ks,
     return ints, cost_min
 
 
+def _args_q02(tables: Tables, size: int = 15, type_suffix: str = "BRUSHED",
+              region: str = "EUROPE"):
+    part, ps = tables["part"], tables["partsupp"]
+    sup, nat, reg = tables["supplier"], tables["nation"], tables["region"]
+    type_ok = _lut(part.dicts["p_type"], lambda s: s.endswith(type_suffix))
+    return (key_space(ps, "ps_partkey"), key_space(sup, "s_suppkey"),
+            key_space(nat, "n_nationkey"), key_space(reg, "r_regionkey"),
+            part["p_partkey"], part["p_size"], part["p_type"],
+            ps["ps_partkey"], ps["ps_suppkey"], ps["ps_supplycost"],
+            sup["s_suppkey"], sup["s_nationkey"],
+            reg["r_regionkey"], reg["r_name"],
+            nat["n_nationkey"], nat["n_regionkey"],
+            type_ok, size, reg.code("r_name", region))
+
+
 def cq02(tables: Tables, size: int = 15, type_suffix: str = "BRUSHED",
          region: str = "EUROPE"):
     """Minimum-cost supplier per qualifying part."""
-    part, ps = tables["part"], tables["partsupp"]
-    sup, nat, reg = tables["supplier"], tables["nation"], tables["region"]
-    n_part = key_space(ps, "ps_partkey")
-    type_ok = _lut(part.dicts["p_type"], lambda s: s.endswith(type_suffix))
-    ints, cost_min = _q02_core(
-        n_part, key_space(sup, "s_suppkey"),
-        key_space(nat, "n_nationkey"), key_space(reg, "r_regionkey"),
-        part["p_partkey"], part["p_size"], part["p_type"],
-        ps["ps_partkey"], ps["ps_suppkey"], ps["ps_supplycost"],
-        sup["s_suppkey"], sup["s_nationkey"],
-        reg["r_regionkey"], reg["r_name"],
-        nat["n_nationkey"], nat["n_regionkey"],
-        type_ok, size, reg.code("r_name", region))
+    sup, nat = tables["supplier"], tables["nation"]
+    ints, cost_min = _q02_core(*_args_q02(tables, size, type_suffix, region))
     ints, cost_min = np.asarray(ints), np.asarray(cost_min)
     s_names = np.asarray(sup["s_name"])
     n_names = np.asarray(nat["n_name"])
@@ -190,17 +200,22 @@ def _q03_core(n_orders, k, n_cust, c_key, c_seg, o_key, o_cust, o_date,
     return ints, jnp.take(rev, top_idx)
 
 
+def _args_q03(tables: Tables, segment: str = "BUILDING",
+              date: str = "1995-03-15", k: int = 10):
+    cust, orders, li = (tables["customer"], tables["orders"],
+                        tables["lineitem"])
+    return (key_space(li, "l_orderkey"), k, key_space(cust, "c_custkey"),
+            cust["c_custkey"],
+            cust["c_mktsegment"], orders["o_orderkey"], orders["o_custkey"],
+            orders["o_orderdate"], li["l_orderkey"], li["l_shipdate"],
+            li["l_extendedprice"], li["l_discount"],
+            cust.code("c_mktsegment", segment), date_to_int(date))
+
+
 def cq03(tables: Tables, segment: str = "BUILDING",
          date: str = "1995-03-15", k: int = 10):
     """Top unshipped orders by revenue."""
-    cust, orders, li = tables["customer"], tables["orders"], tables["lineitem"]
-    ints, rev = _q03_core(
-        key_space(li, "l_orderkey"), k, key_space(cust, "c_custkey"),
-        cust["c_custkey"],
-        cust["c_mktsegment"], orders["o_orderkey"], orders["o_custkey"],
-        orders["o_orderdate"], li["l_orderkey"], li["l_shipdate"],
-        li["l_extendedprice"], li["l_discount"],
-        cust.code("c_mktsegment", segment), date_to_int(date))
+    ints, rev = _q03_core(*_args_q03(tables, segment, date, k))
     ints, rev = np.asarray(ints), np.asarray(rev)
     rows = [{"okey": int(ints[0, j]), "odate": int_to_date(int(ints[2, j])),
              "revenue": float(rev[j])}
@@ -219,15 +234,21 @@ def _q04_core(n_pri, n_okey, o_key, o_date, o_pri, l_okey, l_commit,
     return K.segment_count(o_pri, n_pri, has_late & in_q)
 
 
-def cq04(tables: Tables, d0: str = "1993-07-01", d1: str = "1993-10-01"):
-    """Orders with ≥1 late lineitem, counted per priority."""
+def _args_q04(tables: Tables, d0: str = "1993-07-01",
+              d1: str = "1993-10-01"):
     orders, li = tables["orders"], tables["lineitem"]
     n_pri = len(orders.dicts["o_orderpriority"])
-    counts = np.asarray(_q04_core(
-        n_pri, key_space(li, "l_orderkey"),
-        orders["o_orderkey"], orders["o_orderdate"],
-        orders["o_orderpriority"], li["l_orderkey"], li["l_commitdate"],
-        li["l_receiptdate"], date_to_int(d0), date_to_int(d1)))
+    return (n_pri, key_space(li, "l_orderkey"),
+            orders["o_orderkey"], orders["o_orderdate"],
+            orders["o_orderpriority"], li["l_orderkey"], li["l_commitdate"],
+            li["l_receiptdate"], date_to_int(d0), date_to_int(d1))
+
+
+def cq04(tables: Tables, d0: str = "1993-07-01", d1: str = "1993-10-01"):
+    """Orders with ≥1 late lineitem, counted per priority."""
+    orders = tables["orders"]
+    n_pri = len(orders.dicts["o_orderpriority"])
+    counts = np.asarray(_q04_core(*_args_q04(tables, d0, d1)))
     out = [(orders.decode("o_orderpriority", i), int(counts[i]))
            for i in range(n_pri) if counts[i]]
     out.sort(key=lambda kv: kv[0])
@@ -243,13 +264,18 @@ def _q06_core(ship, discount, quantity, price, a, b, disc, qty):
     return jnp.sum(jnp.where(mask, price * discount, 0.0))
 
 
+def _args_q06(tables: Tables, d0: str = "1994-01-01",
+              d1: str = "1995-01-01", disc: float = 0.06, qty: int = 24):
+    li = tables["lineitem"]
+    return (li["l_shipdate"], li["l_discount"],
+            li["l_quantity"], li["l_extendedprice"],
+            date_to_int(d0), date_to_int(d1), disc, qty)
+
+
 def cq06(tables: Tables, d0: str = "1994-01-01", d1: str = "1995-01-01",
          disc: float = 0.06, qty: int = 24):
     """Revenue-change forecast: one fused filtered reduction."""
-    li = tables["lineitem"]
-    rev = float(_q06_core(li["l_shipdate"], li["l_discount"],
-                          li["l_quantity"], li["l_extendedprice"],
-                          date_to_int(d0), date_to_int(d1), disc, qty))
+    rev = float(_q06_core(*_args_q06(tables, d0, d1, disc, qty)))
     return [("revenue", rev)]
 
 
@@ -267,20 +293,26 @@ def _q12_core(n_modes, n_okey, o_key, o_pri, l_okey, l_mode, l_ship,
                       K.segment_count(l_mode, n_modes, mask & ~high)])
 
 
-def cq12(tables: Tables, mode1: str = "MAIL", mode2: str = "SHIP",
-         d0: str = "1994-01-01", d1: str = "1995-01-01"):
-    """High/low-priority lineitems per ship mode."""
+def _args_q12(tables: Tables, mode1: str = "MAIL", mode2: str = "SHIP",
+              d0: str = "1994-01-01", d1: str = "1995-01-01"):
     orders, li = tables["orders"], tables["lineitem"]
     n_modes = len(li.dicts["l_shipmode"])
     m1, m2 = li.code("l_shipmode", mode1), li.code("l_shipmode", mode2)
     hi = _lut(orders.dicts["o_orderpriority"],
               lambda s: s in ("1-URGENT", "2-HIGH"))
-    packed = np.asarray(_q12_core(
-        n_modes, key_space(li, "l_orderkey"),
-        orders["o_orderkey"], orders["o_orderpriority"],
-        li["l_orderkey"], li["l_shipmode"], li["l_shipdate"],
-        li["l_commitdate"], li["l_receiptdate"], hi, m1, m2,
-        date_to_int(d0), date_to_int(d1)))
+    return (n_modes, key_space(li, "l_orderkey"),
+            orders["o_orderkey"], orders["o_orderpriority"],
+            li["l_orderkey"], li["l_shipmode"], li["l_shipdate"],
+            li["l_commitdate"], li["l_receiptdate"], hi, m1, m2,
+            date_to_int(d0), date_to_int(d1))
+
+
+def cq12(tables: Tables, mode1: str = "MAIL", mode2: str = "SHIP",
+         d0: str = "1994-01-01", d1: str = "1995-01-01"):
+    """High/low-priority lineitems per ship mode."""
+    li = tables["lineitem"]
+    m1, m2 = li.code("l_shipmode", mode1), li.code("l_shipmode", mode2)
+    packed = np.asarray(_q12_core(*_args_q12(tables, mode1, mode2, d0, d1)))
     out = [(li.decode("l_shipmode", m),
             {"high": int(packed[0, m]), "low": int(packed[1, m])})
            for m in (m1, m2)
@@ -311,22 +343,33 @@ def _q13_per_cust(n_cust, o_cust, keep, c_key):
     return jnp.take(K.segment_count(o_cust, n_cust, keep), c_key)
 
 
-def cq13(tables: Tables, word1: str = "special", word2: str = "requests"):
-    """Histogram of per-customer order counts (zero included — the
-    left-outer-join semantics)."""
+def _q13_keep(tables: Tables, word1: str, word2: str) -> jnp.ndarray:
     import re
 
-    cust, orders = tables["customer"], tables["orders"]
-    n_cust = key_space(cust, "c_custkey")
+    orders = tables["orders"]
     if "o_comment" in orders.dicts:
         pat = re.compile(f"{re.escape(word1)}.*{re.escape(word2)}")
         keep_lut = _lut(orders.dicts["o_comment"],
                         lambda s: not pat.search(s))
-        keep = jnp.take(keep_lut, orders["o_comment"])
-    else:
-        keep = jnp.ones((orders.num_rows,), jnp.bool_)
-    hist, maxc = jax.device_get(_q13_core(
-        n_cust, _Q13_CAP, orders["o_custkey"], keep, cust["c_custkey"]))
+        return jnp.take(keep_lut, orders["o_comment"])
+    return jnp.ones((orders.num_rows,), jnp.bool_)
+
+
+def _args_q13(tables: Tables, word1: str = "special",
+              word2: str = "requests"):
+    cust, orders = tables["customer"], tables["orders"]
+    return (key_space(cust, "c_custkey"), _Q13_CAP, orders["o_custkey"],
+            _q13_keep(tables, word1, word2), cust["c_custkey"])
+
+
+def cq13(tables: Tables, word1: str = "special", word2: str = "requests"):
+    """Histogram of per-customer order counts (zero included — the
+    left-outer-join semantics)."""
+    cust, orders = tables["customer"], tables["orders"]
+    n_cust = key_space(cust, "c_custkey")
+    args = _args_q13(tables, word1, word2)
+    keep = args[3]  # reused by the over-cap exact fallback below
+    hist, maxc = jax.device_get(_q13_core(*args))
     maxc = int(maxc)
     if maxc >= _Q13_CAP:  # beyond any dbgen shape: exact host fallback
         per = np.asarray(_q13_per_cust(n_cust, orders["o_custkey"], keep,
@@ -347,15 +390,19 @@ def _q14_core(n_pkey, p_key, p_type, l_part, l_ship, l_price, l_disc,
     return jnp.stack([jnp.sum(jnp.where(is_promo, rev, 0.0)), jnp.sum(rev)])
 
 
-def cq14(tables: Tables, d0: str = "1995-09-01", d1: str = "1995-10-01"):
-    """% of revenue from promo parts."""
+def _args_q14(tables: Tables, d0: str = "1995-09-01",
+              d1: str = "1995-10-01"):
     li, part = tables["lineitem"], tables["part"]
     promo = _lut(part.dicts["p_type"], lambda s: s.startswith("PROMO"))
-    pr, total = np.asarray(_q14_core(
-        key_space(li, "l_partkey"),
-        part["p_partkey"], part["p_type"], li["l_partkey"], li["l_shipdate"],
-        li["l_extendedprice"], li["l_discount"], promo,
-        date_to_int(d0), date_to_int(d1)))
+    return (key_space(li, "l_partkey"),
+            part["p_partkey"], part["p_type"], li["l_partkey"],
+            li["l_shipdate"], li["l_extendedprice"], li["l_discount"],
+            promo, date_to_int(d0), date_to_int(d1))
+
+
+def cq14(tables: Tables, d0: str = "1995-09-01", d1: str = "1995-10-01"):
+    """% of revenue from promo parts."""
+    pr, total = np.asarray(_q14_core(*_args_q14(tables, d0, d1)))
     pct = 100.0 * float(pr) / float(total) if total else 0.0
     return [("promo_revenue_pct", pct)]
 
@@ -372,14 +419,19 @@ def _q17_core(n_part, p_key, p_brand, p_cont, l_part, l_qty, l_price,
     return jnp.sum(jnp.where(small, l_price, 0.0)) / 7.0
 
 
+def _args_q17(tables: Tables, brand: str = "Brand#23",
+              container: str = "MED BOX"):
+    li, part = tables["lineitem"], tables["part"]
+    return (key_space(li, "l_partkey"), part["p_partkey"],
+            part["p_brand"], part["p_container"], li["l_partkey"],
+            li["l_quantity"], li["l_extendedprice"],
+            part.code("p_brand", brand),
+            part.code("p_container", container))
+
+
 def cq17(tables: Tables, brand: str = "Brand#23", container: str = "MED BOX"):
     """Revenue from small-quantity orders of one brand/container."""
-    li, part = tables["lineitem"], tables["part"]
-    total = float(_q17_core(
-        key_space(li, "l_partkey"), part["p_partkey"], part["p_brand"],
-        part["p_container"], li["l_partkey"], li["l_quantity"],
-        li["l_extendedprice"], part.code("p_brand", brand),
-        part.code("p_container", container)))
+    total = float(_q17_core(*_args_q17(tables, brand, container)))
     return [("avg_yearly", total)] if total else []
 
 
@@ -412,16 +464,22 @@ def q22_code_lut(phone_dict: List[str], prefixes: Sequence[str]
     return pref_list, lut
 
 
+def _args_q22(tables: Tables,
+              prefixes: Sequence[str] = ("13", "31", "23", "29", "30",
+                                         "18", "17")):
+    cust, orders = tables["customer"], tables["orders"]
+    pref_list, code_lut = q22_code_lut(cust.dicts["c_phone"], prefixes)
+    return (len(pref_list), key_space(orders, "o_custkey"),
+            cust["c_custkey"], cust["c_phone"],
+            cust["c_acctbal"], orders["o_custkey"], code_lut)
+
+
 def cq22(tables: Tables,
          prefixes: Tuple[str, ...] = ("13", "31", "23", "29", "30", "18",
                                       "17")):
     """Well-funded customers with no orders, grouped by phone prefix."""
-    cust, orders = tables["customer"], tables["orders"]
-    pref_list, code_lut = q22_code_lut(cust.dicts["c_phone"], prefixes)
-    packed = np.asarray(_q22_core(
-        len(pref_list), key_space(orders, "o_custkey"),
-        cust["c_custkey"], cust["c_phone"],
-        cust["c_acctbal"], orders["o_custkey"], code_lut))
+    pref_list = sorted(set(prefixes))  # q22_code_lut's group order
+    packed = np.asarray(_q22_core(*_args_q22(tables, prefixes)))
     return [(pref_list[i], {"n": int(packed[0, i]),
                             "bal": float(packed[1, i])})
             for i in range(len(pref_list)) if packed[0, i]]
@@ -437,3 +495,55 @@ def tables_from_rows(data: Dict[str, List[dict]]) -> Tables:
     """Columnarize ``workloads.tpch.generate()`` output."""
     return {name: ColumnTable.from_rows(rows)
             for name, rows in data.items() if rows}
+
+
+# ------------------------------------------------------- fused suite
+_SUITE_CORES: Dict[str, Tuple[Callable, Callable]] = {
+    "q01": (_q01_core, _args_q01), "q02": (_q02_core, _args_q02),
+    "q03": (_q03_core, _args_q03), "q04": (_q04_core, _args_q04),
+    "q06": (_q06_core, _args_q06), "q12": (_q12_core, _args_q12),
+    "q13": (_q13_core, _args_q13), "q14": (_q14_core, _args_q14),
+    "q17": (_q17_core, _args_q17), "q22": (_q22_core, _args_q22),
+}
+
+_SLOT = object()  # placeholder for a device array in an args template
+
+
+def compile_suite(tables: Tables) -> Callable[[], Dict[str, object]]:
+    """Fuse the ENTIRE ten-query suite into one jitted program.
+
+    The reference must execute each query as its own distributed job
+    with materialized intermediates; here the per-query cores are
+    inlined into a single XLA program, so the whole benchmark suite
+    costs ONE controller round-trip + one device schedule. Returns a
+    zero-argument callable producing ``{name: raw core output}`` (the
+    same arrays each ``cqNN`` wrapper formats); call it repeatedly —
+    the compiled program is cached on the callable.
+    """
+    templates: Dict[str, list] = {}
+    arrays: Dict[str, list] = {}
+    for name, (_core, args_fn) in _SUITE_CORES.items():
+        t, arr = [], []
+        for a in args_fn(tables):
+            if isinstance(a, (jnp.ndarray, jax.Array)):
+                t.append(_SLOT)
+                arr.append(a)
+            else:
+                t.append(a)
+        templates[name] = t
+        arrays[name] = arr
+
+    @jax.jit
+    def mega(arrs: Dict[str, list]):
+        out = {}
+        for name, t in templates.items():
+            it = iter(arrs[name])
+            rebuilt = [next(it) if x is _SLOT else x for x in t]
+            out[name] = _SUITE_CORES[name][0](*rebuilt)
+        return out
+
+    def runner():
+        return mega(arrays)
+
+    runner.jitted = mega  # exposed so tests can assert one compilation
+    return runner

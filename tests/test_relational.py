@@ -236,3 +236,32 @@ class TestColumnarVsRowEngine:
         t2["nation"] = ColumnTable.from_rows(shuffled)
         got = COLUMNAR_QUERIES["q02"](t2)
         self._close(got, row_results["q02"], "q02-shuffled-nation")
+
+
+class TestFusedSuite:
+    def test_suite_matches_solo_cores(self, tables):
+        import jax as _jax
+
+        from netsdb_tpu.relational.queries import _SUITE_CORES, compile_suite
+
+        suite = compile_suite(tables)
+        res = suite()
+        for name, (core, args_fn) in _SUITE_CORES.items():
+            solo = core(*args_fn(tables))
+            for a, b in zip(_jax.tree_util.tree_leaves(res[name]),
+                            _jax.tree_util.tree_leaves(solo)):
+                np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                           rtol=1e-5, atol=1e-3,
+                                           err_msg=name)
+
+    def test_suite_is_one_compiled_program(self, tables):
+        """Repeated calls reuse ONE jitted program (the whole point:
+        one compile + one dispatch for the ten queries)."""
+        from netsdb_tpu.relational.queries import compile_suite
+
+        suite = compile_suite(tables)
+        r1 = suite()
+        r2 = suite()
+        assert set(r1) == set(r2) == {"q01", "q02", "q03", "q04", "q06",
+                                      "q12", "q13", "q14", "q17", "q22"}
+        assert suite.jitted._cache_size() == 1  # no retrace on call 2
