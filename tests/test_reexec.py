@@ -1,0 +1,83 @@
+"""Re-exec bootstrap guard logic (netsdb_tpu/_reexec.py) — the actual
+exec path is exercised end-to-end by running the CLI under the bare
+interpreter; these tests pin the guard conditions that must NOT exec.
+
+``_reexec.VENV`` is patched to the running interpreter so the guards
+are exercised (not short-circuited by the venv-missing check) on any
+machine, and execv is always stubbed so a guard regression cannot
+replace the test process.
+"""
+
+import os
+import sys
+
+import pytest
+
+from netsdb_tpu import _reexec
+
+
+@pytest.fixture()
+def execv_calls(monkeypatch):
+    """Stub os.execv, point VENV at a path that exists, and return the
+    capture list."""
+    calls = []
+    monkeypatch.setattr(os, "execv", lambda *a: calls.append(a))
+    monkeypatch.setattr(_reexec, "VENV", sys.executable)
+    return calls
+
+
+def test_noop_when_flag_set(execv_calls, monkeypatch):
+    monkeypatch.setenv("X_REEXEC_FLAG", "1")
+    _reexec.maybe_reexec("X_REEXEC_FLAG")
+    assert not execv_calls
+
+
+def test_noop_when_venv_missing(execv_calls, monkeypatch):
+    monkeypatch.setattr(_reexec, "VENV", "/nonexistent/python")
+    monkeypatch.delenv("X_REEXEC_FLAG2", raising=False)
+    _reexec.maybe_reexec("X_REEXEC_FLAG2")
+    assert not execv_calls
+
+
+def test_module_prefix_guard_rejects_script_argument(execv_calls,
+                                                     monkeypatch):
+    """`python my_tool.py -m netsdb_tpu` must NOT re-exec: the -m there
+    is the script's argument, not the interpreter's option."""
+    monkeypatch.setattr(_reexec, "_original_argv",
+                        lambda: ["python", "my_tool.py", "-m", "netsdb_tpu"])
+    monkeypatch.delenv("X_REEXEC_FLAG3", raising=False)
+    _reexec.maybe_reexec("X_REEXEC_FLAG3",
+                         require_module_prefix="netsdb_tpu")
+    assert not execv_calls
+
+
+def test_module_prefix_guard_rejects_other_modules(execv_calls,
+                                                   monkeypatch):
+    monkeypatch.setattr(_reexec, "_original_argv",
+                        lambda: ["python", "-m", "otherpkg", "x"])
+    monkeypatch.delenv("X_REEXEC_FLAG4", raising=False)
+    _reexec.maybe_reexec("X_REEXEC_FLAG4",
+                         require_module_prefix="netsdb_tpu")
+    assert not execv_calls
+
+
+def test_module_prefix_guard_accepts_package_and_submodule(execv_calls,
+                                                           monkeypatch):
+    for mod in ("netsdb_tpu", "netsdb_tpu.workloads.tpch"):
+        monkeypatch.setattr(_reexec, "_original_argv",
+                            lambda mod=mod: ["python", "-m", mod, "a", "b"])
+        # setenv-then-delenv so monkeypatch records the ORIGINAL absent
+        # state; maybe_reexec sets the flag via os.environ directly
+        monkeypatch.setenv("X_REEXEC_OK", "0")
+        monkeypatch.delenv("X_REEXEC_OK")
+        execv_calls.clear()
+        _reexec.maybe_reexec("X_REEXEC_OK",
+                             require_module_prefix="netsdb_tpu")
+        assert execv_calls and execv_calls[0][1] == [
+            _reexec.VENV, "-m", mod, "a", "b"]
+
+
+def test_original_argv_reads_proc():
+    args = _reexec._original_argv()
+    # on linux this is our own pytest invocation
+    assert args and "python" in args[0]
