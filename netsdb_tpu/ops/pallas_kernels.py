@@ -87,7 +87,8 @@ def _flash_kernel(q_ref, k_ref, v_ref, o_ref, m_ref, l_ref, acc_ref, *,
 
 def flash_attention(q: jax.Array, k: jax.Array, v: jax.Array,
                     causal: bool = True, scale: Optional[float] = None,
-                    block_q: int = 1024, block_k: int = 1024,
+                    block_q: Optional[int] = None,
+                    block_k: Optional[int] = None,
                     interpret: Optional[bool] = None,
                     out_vma=None) -> jax.Array:
     """Fused attention: q/k/v (B, H, S, D) → (B, H, S, D). Numerically
@@ -100,11 +101,25 @@ def flash_attention(q: jax.Array, k: jax.Array, v: jax.Array,
     import math
 
     b, h, s, d = q.shape
-    # shrink requested blocks to divisors of s (gcd keeps the largest
+    # shrink defaulted blocks to divisors of s (gcd keeps the largest
     # power-of-two factor, so e.g. s=2560 with the 1024 default runs
-    # 512-blocks instead of raising)
-    block_q = math.gcd(min(block_q, s), s)
-    block_k = math.gcd(min(block_k, s), s)
+    # 512-blocks); an explicitly tuned block that does not divide s is
+    # a caller mistake — warn rather than silently run a slower tile
+    explicit_q, explicit_k = block_q is not None, block_k is not None
+    block_q = block_q if explicit_q else 1024
+    block_k = block_k if explicit_k else 1024
+    gq, gk = math.gcd(min(block_q, s), s), math.gcd(min(block_k, s), s)
+    changed = [f"block_q {block_q}->{gq}"] if explicit_q and gq != block_q else []
+    if explicit_k and gk != block_k:
+        changed.append(f"block_k {block_k}->{gk}")
+    if changed:
+        import warnings
+
+        warnings.warn(
+            f"flash_attention: explicitly requested block size does not "
+            f"divide seq {s}; falling back ({', '.join(changed)})",
+            stacklevel=2)
+    block_q, block_k = gq, gk
     if block_q < 8 or block_k < 8:
         raise ValueError(
             f"seq {s} shares no usable block size with requested blocks "

@@ -68,9 +68,8 @@ def shard_fact_columns(cols: Dict[str, jnp.ndarray], n_shards: int,
 def sharded_query(local_kernel: Callable[..., jax.Array], mesh: Mesh,
                   axis: str, fact: Dict[str, jnp.ndarray],
                   replicated: Sequence[jax.Array] = (),
-                  scalars: Sequence = (),
                   combine: Optional[Callable] = None) -> jax.Array:
-    """Run ``local_kernel(valid, fact_cols..., replicated..., scalars...)``
+    """Run ``local_kernel(valid, fact_cols..., replicated...)``
     per shard and combine its fixed-shape partial aggregate over
     ``axis`` (default ``psum``; pass ``jax.lax.pmin``/``pmax`` for
     min/max merges — the reference's AggregationProcessor runs the
@@ -89,7 +88,7 @@ def sharded_query(local_kernel: Callable[..., jax.Array], mesh: Mesh,
         k = len(names)
         cols = dict(zip(names, args[:k]))
         rep = args[k:k + len(replicated)]
-        partial = local_kernel(valid_s, cols, *rep, *scalars)
+        partial = local_kernel(valid_s, cols, *rep)
         return jax.tree_util.tree_map(lambda x: combine(x, axis), partial)
 
     fn = jax.shard_map(

@@ -18,6 +18,7 @@ unavailable.
 from __future__ import annotations
 
 import os
+import threading
 from typing import Dict, Iterator, Optional, Tuple
 
 import numpy as np
@@ -82,8 +83,12 @@ class PagedTensorStore:
         self._ids: Dict[str, int] = {}
         # live prefetch reader threads: must be joined before the
         # backend is destroyed (a reader mid-read_page on a freed C++
-        # arena is a use-after-free)
+        # arena is a use-after-free); mutations happen under _readers_lock
+        # so concurrent streams can't interleave the prune/append and
+        # drop a tracked reader
         self._readers: list = []
+        self._readers_lock = threading.Lock()
+        self._closed = False
         if force_python:
             self.backend = _PyPageBackend()
             self.native = False
@@ -153,7 +158,6 @@ class PagedTensorStore:
             return
 
         import queue
-        import threading
 
         q: "queue.Queue" = queue.Queue(maxsize=prefetch)
         SENTINEL = object()
@@ -179,9 +183,12 @@ class PagedTensorStore:
             put((SENTINEL, None))
 
         t = threading.Thread(target=reader, daemon=True)
-        self._readers = [(rt, rs) for rt, rs in self._readers
-                         if rt.is_alive()]
-        self._readers.append((t, stop))
+        with self._readers_lock:
+            if self._closed:  # backend may already be freed
+                raise RuntimeError("PagedTensorStore is closed")
+            self._readers[:] = [(rt, rs) for rt, rs in self._readers
+                                if rt.is_alive()]
+            self._readers.append((t, stop))
         t.start()
         try:
             while True:
@@ -247,12 +254,15 @@ class PagedTensorStore:
     def close(self):
         # stop + join any live prefetch readers BEFORE freeing the
         # native arena they may be reading from
-        for t, stop in self._readers:
+        with self._readers_lock:
+            self._closed = True  # no new readers may register after this
+            readers = list(self._readers)
+            self._readers.clear()
+        for t, stop in readers:
             stop.set()
-        for t, stop in self._readers:
+        for t, stop in readers:
             t.join(timeout=30)
-        still_alive = [t for t, _ in self._readers if t.is_alive()]
-        self._readers.clear()
+        still_alive = [t for t, _ in readers if t.is_alive()]
         if still_alive or getattr(self, "_leaked", False):
             # a reader wedged inside read_page (hung IO): destroying the
             # arena under it is a use-after-free — leak the backend
