@@ -330,6 +330,29 @@ def _cmd_micro_bench(args) -> int:
     return 0
 
 
+def _cmd_serve(args) -> int:
+    if getattr(args, "platform", None):
+        import jax
+
+        jax.config.update("jax_platforms", args.platform)
+    from netsdb_tpu.config import Configuration, DEFAULT_CONFIG
+    from netsdb_tpu.serve.server import run_daemon
+
+    config = Configuration(root_dir=args.root) if args.root else DEFAULT_CONFIG
+    return run_daemon(config, host=args.host, port=args.port,
+                      token=args.token, max_jobs=args.max_jobs)
+
+
+def _cmd_serve_bench(args) -> int:
+    from netsdb_tpu.workloads.serve_bench import run_serve_bench
+
+    out = run_serve_bench(clients=args.clients, jobs_per_client=args.jobs,
+                          batch=args.batch, port=args.port,
+                          platform=args.platform)
+    print(json.dumps(out, indent=2))
+    return 0
+
+
 def main(argv=None) -> int:
     parser = argparse.ArgumentParser(prog="netsdb_tpu")
     sub = parser.add_subparsers(dest="cmd", required=True)
@@ -402,8 +425,35 @@ def main(argv=None) -> int:
                    help="TPC-H scale factor (lineitem ≈ 6M rows at sf=1)")
     p.add_argument("--iters", type=int, default=10)
 
+    p = sub.add_parser("serve", help="run the resident controller daemon "
+                       "(ref MasterMain: the server that owns the device "
+                       "and keeps model sets loaded across clients)")
+    p.add_argument("--host", default="127.0.0.1")
+    p.add_argument("--port", type=int, default=8108)
+    p.add_argument("--root", default=None, help="database root dir")
+    p.add_argument("--token", default=None, help="shared auth token")
+    p.add_argument("--max-jobs", type=int, default=None,
+                   help="concurrent job admission cap (default num_threads)")
+    p.add_argument("--platform", default=None,
+                   help="force a jax platform (e.g. cpu) — env overrides "
+                   "are ignored by the ambient plugin, only jax.config "
+                   "works, so the daemon must set it itself")
+
+    p = sub.add_parser("serve-bench",
+                       help="FF inference throughput over the RPC hop, "
+                       "concurrent client processes against one daemon")
+    p.add_argument("--clients", type=int, default=2)
+    p.add_argument("--jobs", type=int, default=8,
+                   help="inference jobs per client")
+    p.add_argument("--batch", type=int, default=16384)
+    p.add_argument("--port", type=int, default=0,
+                   help="0 = spawn a private daemon on an ephemeral port")
+    p.add_argument("--platform", default=None,
+                   help="jax platform for the spawned daemon (e.g. cpu)")
+
     args = parser.parse_args(argv)
     return {"info": _cmd_info, "bench": _cmd_bench, "pdml": _cmd_pdml,
+            "serve": _cmd_serve, "serve-bench": _cmd_serve_bench,
             "demo-ff": _cmd_demo_ff, "tpch": _cmd_tpch,
             "micro-bench": _cmd_micro_bench, "tpch-bench": _cmd_tpch_bench,
             "model-bench": _cmd_model_bench,

@@ -46,8 +46,25 @@ class Client:
     ===========================  =======================================
     """
 
+    def __new__(cls, config: Configuration = DEFAULT_CONFIG,
+                catalog_path: Optional[str] = None,
+                address: Optional[str] = None,
+                token: Optional[str] = None):
+        if address is not None:
+            # thin RPC mode — talk to a resident daemon instead of
+            # owning the store (reference: PDBClient always works this
+            # way; here the in-process library is the default and
+            # ``Client(address="host:port")`` is the served form)
+            from netsdb_tpu.serve.client import RemoteClient
+
+            return RemoteClient(address, token=token)
+        return super().__new__(cls)
+
     def __init__(self, config: Configuration = DEFAULT_CONFIG,
-                 catalog_path: Optional[str] = None):
+                 catalog_path: Optional[str] = None,
+                 address: Optional[str] = None,
+                 token: Optional[str] = None):
+        del address, token  # consumed by __new__ (RemoteClient path)
         self.config = config
         config.ensure_dirs()
         self.catalog = Catalog(catalog_path or ":memory:")

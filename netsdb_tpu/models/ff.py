@@ -91,10 +91,17 @@ class FFModel:
 
     # --- inference (ref ff::inference_unit, SimpleFF.cc:331-424) ------
     def build_inference_dag(self, dropout_rate: float = 0.0,
-                            key: Optional[jax.Array] = None) -> WriteSet:
-        """Computation DAG with the reference's relational shape."""
+                            key: Optional[jax.Array] = None,
+                            input_set: str = "inputs",
+                            output_set: str = "output") -> WriteSet:
+        """Computation DAG with the reference's relational shape.
+
+        ``input_set``/``output_set`` let concurrent clients share the
+        resident weight sets while scanning/writing private sets — the
+        served-inference pattern (many QueryClients, one loaded model,
+        reference ``QueryClient.h:160-224``)."""
         cd = self.compute_dtype
-        inputs = ScanSet(self.db, "inputs")
+        inputs = ScanSet(self.db, input_set)
         w1 = ScanSet(self.db, "w1")
         b1 = ScanSet(self.db, "b1")
         wo = ScanSet(self.db, "wo")
@@ -114,7 +121,7 @@ class FFModel:
         out = Join(yo_lin, bo,
                    fn=lambda y, b: nn_ops.ff_output_layer(y, b, axis=0),
                    label="FFOutputLayer")
-        return WriteSet(out, self.db, "output")
+        return WriteSet(out, self.db, output_set)
 
     def inference(self, client: Client, dropout_rate: float = 0.0,
                   key: Optional[jax.Array] = None) -> BlockedTensor:

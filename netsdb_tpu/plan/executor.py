@@ -39,9 +39,13 @@ from netsdb_tpu.storage.store import SetIdentifier
 # analogue, QuerySchedulerServer.cc:1242-1264). LRU-bounded: a serving
 # loop rebuilding DAGs must not grow this without bound.
 from collections import OrderedDict
+import threading
 
 _COMPILED_CACHE_CAP = 64
 _compiled_cache: "OrderedDict[str, Any]" = OrderedDict()
+# serve-layer jobs run on concurrent handler threads; the LRU
+# reorder/insert/evict sequence must not interleave
+_cache_lock = threading.Lock()
 
 
 def _is_traceable(node: Computation) -> bool:
@@ -101,9 +105,11 @@ def execute_computations(
         cacheable = len(tensor_scans) == num_scans
         cache_key = f"{job_name}::{plan.cache_key()}"
         fn = None
-        if cacheable and cache_key in _compiled_cache:
-            fn = _compiled_cache[cache_key]
-            _compiled_cache.move_to_end(cache_key)
+        if cacheable:
+            with _cache_lock:
+                if cache_key in _compiled_cache:
+                    fn = _compiled_cache[cache_key]
+                    _compiled_cache.move_to_end(cache_key)
         if fn is None:
             # canonical arg keys (topo position) so independently built
             # DAGs of the same shape hit one traced signature; host-object
@@ -123,9 +129,18 @@ def execute_computations(
 
             fn = jax.jit(run)
             if cacheable:
-                _compiled_cache[cache_key] = fn
-                while len(_compiled_cache) > _COMPILED_CACHE_CAP:
-                    _compiled_cache.popitem(last=False)
+                # publish the wrapper BEFORE the first call: concurrent
+                # serve-layer threads racing the same cold plan then all
+                # call ONE jitted wrapper (jax dedups the trace/compile
+                # internally) instead of compiling N identical programs
+                with _cache_lock:
+                    if cache_key in _compiled_cache:
+                        fn = _compiled_cache[cache_key]  # lost the race
+                        _compiled_cache.move_to_end(cache_key)
+                    else:
+                        _compiled_cache[cache_key] = fn
+                        while len(_compiled_cache) > _COMPILED_CACHE_CAP:
+                            _compiled_cache.popitem(last=False)
         topo_pos = {n.node_id: i for i, n in enumerate(plan.topo)}
         canon_args = {topo_pos[n.node_id]: scan_values[n.node_id]
                       for n in tensor_scans}
@@ -163,4 +178,5 @@ def execute_computations(
 
 
 def clear_compiled_cache() -> None:
-    _compiled_cache.clear()
+    with _cache_lock:
+        _compiled_cache.clear()

@@ -1,0 +1,21 @@
+"""Resident service layer — the PDBServer/PDBClient pair, TPU-shaped.
+
+The reference is a long-running shared service: ``PDBServer`` listens on
+ports dispatching typed-object frames to registered handlers
+(``src/pdbServer/headers/PDBServer.h:39-152``), ``PDBClient`` talks to it
+over TCP (``src/mainClient/headers/PDBClient.h:28-295``), the master runs
+forever (``src/mainServer/source/MasterMain.cc:64-96``) and model weight
+sets stay loaded while many clients run queries.
+
+Here one daemon process is the single JAX controller owning the TPU: it
+holds the :class:`~netsdb_tpu.storage.store.SetStore` (device-resident
+weight tensors), the catalog, and the compiled-plan cache, and serves
+concurrent clients over a typed-frame TCP protocol
+(:mod:`netsdb_tpu.serve.protocol`). Clients are thin — they never touch
+JAX; tensors cross the wire as raw dense buffers.
+"""
+
+from netsdb_tpu.serve.client import RemoteClient, RemoteError, RemoteTensor
+from netsdb_tpu.serve.server import ServeController
+
+__all__ = ["RemoteClient", "RemoteError", "RemoteTensor", "ServeController"]

@@ -1,0 +1,281 @@
+"""Thin RPC client — the PDBClient facade over the wire.
+
+Mirrors :class:`netsdb_tpu.client.Client` method-for-method but sends
+typed frames to a resident :class:`~netsdb_tpu.serve.server.ServeController`
+instead of owning a store, the way ``PDBClient`` aggregates catalog/
+dispatcher/storage/query clients all speaking ``simpleRequest`` RPCs to
+the master (``src/mainClient/headers/PDBClient.h:28-295``).
+
+Deliberately JAX-free: a client process never initializes a device
+backend (the daemon owns the TPU). Tensors come back as numpy-backed
+:class:`RemoteTensor` values whose ``to_dense()`` matches
+``BlockedTensor.to_dense()``, so model drivers (``FFModel`` etc.) run
+unchanged against either client.
+"""
+
+from __future__ import annotations
+
+import socket
+import threading
+from typing import Any, Dict, Iterator, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from netsdb_tpu.serve.protocol import (
+    CODEC_MSGPACK,
+    CODEC_PICKLE,
+    MsgType,
+    recv_frame,
+    send_frame,
+    tensor_to_wire,
+)
+
+
+class RemoteError(RuntimeError):
+    """A server-side handler raised; carries the remote traceback."""
+
+    def __init__(self, kind: str, message: str, remote_traceback: str = ""):
+        super().__init__(f"{kind}: {message}")
+        self.kind = kind
+        self.remote_traceback = remote_traceback
+
+
+class RemoteTensor:
+    """Dense result fetched from the daemon — quacks like BlockedTensor
+    for the read side (``to_dense``/``shape``/``dtype``)."""
+
+    def __init__(self, dense: np.ndarray, block_shape=None):
+        self._dense = dense
+        self.block_shape = tuple(block_shape) if block_shape else None
+
+    @property
+    def shape(self) -> Tuple[int, ...]:
+        return tuple(self._dense.shape)
+
+    @property
+    def dtype(self):
+        return self._dense.dtype
+
+    def to_dense(self) -> np.ndarray:
+        return self._dense
+
+    def __repr__(self) -> str:
+        return f"RemoteTensor(shape={self.shape}, dtype={self.dtype})"
+
+
+class RemoteIdent(Tuple[str, str]):
+    """(db, set) result key, printable like SetIdentifier."""
+
+    def __new__(cls, db: str, set_: str):
+        return super().__new__(cls, (db, set_))
+
+    @property
+    def db(self) -> str:
+        return self[0]
+
+    @property
+    def set(self) -> str:
+        return self[1]
+
+    def __str__(self) -> str:
+        return f"{self[0]}:{self[1]}"
+
+
+class RemoteClient:
+    """``Client(address="host:port")`` returns one of these."""
+
+    def __init__(self, address: str, token: Optional[str] = None,
+                 timeout: Optional[float] = None):
+        host, _, port = address.rpartition(":")
+        self.host = host or "127.0.0.1"
+        self.port = int(port)
+        self.token = token
+        self._lock = threading.Lock()  # one in-flight request per conn
+        self._sock: Optional[socket.socket] = None
+        self._timeout = timeout
+        self._connect()
+
+    # --- transport ----------------------------------------------------
+    def _connect(self) -> None:
+        s = socket.create_connection((self.host, self.port),
+                                     timeout=self._timeout)
+        s.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+        send_frame(s, MsgType.HELLO, {"token": self.token})
+        typ, reply = recv_frame(s, allow_pickle=False)
+        if typ == MsgType.ERR:
+            s.close()
+            raise RemoteError(reply.get("error", "Error"),
+                              reply.get("message", "handshake refused"))
+        self._sock = s
+
+    def _request(self, msg_type: MsgType, payload: Any,
+                 codec: int = CODEC_MSGPACK) -> Any:
+        with self._lock:
+            if self._sock is None:
+                self._connect()
+            try:
+                send_frame(self._sock, msg_type, payload, codec)
+                # replies may carry host objects (SCAN_SET) → pickle
+                # allowed on this side: the client already trusts the
+                # server it chose to connect to
+                typ, reply = recv_frame(self._sock, allow_pickle=True)
+            except Exception:
+                # a mid-request failure (timeout, reset) leaves the
+                # stream desynced — a later request would read THIS
+                # request's late reply as its own. Drop the connection;
+                # the next request reconnects fresh.
+                try:
+                    self._sock.close()
+                finally:
+                    self._sock = None
+                raise
+        if typ == MsgType.ERR:
+            raise RemoteError(reply.get("error", "Error"),
+                              reply.get("message", ""),
+                              reply.get("traceback", ""))
+        return reply
+
+    def close(self) -> None:
+        with self._lock:
+            if self._sock is not None:
+                self._sock.close()
+                self._sock = None
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()
+
+    # --- session ------------------------------------------------------
+    def ping(self) -> Dict[str, Any]:
+        return self._request(MsgType.PING, {})
+
+    def shutdown_server(self) -> None:
+        with self._lock:
+            if self._sock is None:
+                self._connect()
+            try:
+                send_frame(self._sock, MsgType.SHUTDOWN, {})
+                recv_frame(self._sock, allow_pickle=False)
+            finally:
+                self._sock.close()
+                self._sock = None
+
+    # --- DDL (same facade as Client) ----------------------------------
+    def create_database(self, db: str) -> None:
+        self._request(MsgType.CREATE_DATABASE, {"db": db})
+
+    def create_set(self, db: str, set_name: str, type_name: str = "tensor",
+                   persistence: str = "transient", eviction: str = "lru",
+                   partition_lambda: Optional[str] = None):
+        self._request(MsgType.CREATE_SET, {
+            "db": db, "set": set_name, "type_name": type_name,
+            "persistence": persistence, "eviction": eviction,
+            "partition_lambda": partition_lambda})
+        return RemoteIdent(db, set_name)
+
+    def remove_set(self, db: str, set_name: str) -> None:
+        self._request(MsgType.REMOVE_SET, {"db": db, "set": set_name})
+
+    def clear_set(self, db: str, set_name: str) -> None:
+        self._request(MsgType.CLEAR_SET, {"db": db, "set": set_name})
+
+    def set_exists(self, db: str, set_name: str) -> bool:
+        return self._request(MsgType.SET_EXISTS,
+                             {"db": db, "set": set_name})["exists"]
+
+    def list_sets(self) -> List[Tuple[str, str]]:
+        return [tuple(s) for s in
+                self._request(MsgType.LIST_SETS, {})["sets"]]
+
+    def register_type(self, type_name: str, entry_point: str) -> None:
+        self._request(MsgType.REGISTER_TYPE,
+                      {"type_name": type_name, "entry_point": entry_point})
+
+    # --- data path ----------------------------------------------------
+    def send_data(self, db: str, set_name: str, items: Sequence[Any]) -> None:
+        self._request(MsgType.SEND_DATA,
+                      {"db": db, "set": set_name, "items": list(items)},
+                      codec=CODEC_PICKLE)
+
+    def send_matrix(self, db: str, set_name: str, dense, block_shape=None,
+                    dtype=None) -> RemoteTensor:
+        dense = np.asarray(dense, dtype=dtype)
+        reply = self._request(MsgType.SEND_MATRIX, {
+            "db": db, "set": set_name,
+            "tensor": tensor_to_wire(dense, block_shape)})
+        return RemoteTensor(dense, reply.get("block_shape"))
+
+    def get_tensor(self, db: str, set_name: str) -> RemoteTensor:
+        reply = self._request(MsgType.GET_TENSOR, {"db": db, "set": set_name})
+        return RemoteTensor(reply["data"], reply.get("block_shape"))
+
+    def get_set_iterator(self, db: str, set_name: str) -> Iterator[Any]:
+        reply = self._request(MsgType.SCAN_SET, {"db": db, "set": set_name})
+        return iter(reply["items"])
+
+    def add_shared_mapping(self, private_db: str, private_set: str,
+                           shared_db: str, shared_set: str,
+                           mapping: Optional[Dict] = None) -> None:
+        self._request(MsgType.ADD_SHARED_MAPPING, {
+            "private_db": private_db, "private_set": private_set,
+            "shared_db": shared_db, "shared_set": shared_set,
+            "mapping": mapping})
+
+    def flush_data(self) -> None:
+        self._request(MsgType.FLUSH_DATA, {})
+
+    def load_set(self, db: str, set_name: str) -> None:
+        self._request(MsgType.LOAD_SET, {"db": db, "set": set_name})
+
+    # --- query execution ----------------------------------------------
+    def execute_computations(self, *sinks, job_name: str = "remote-job",
+                             materialize: bool = True,
+                             fetch_results: bool = True):
+        """Ship the Computation DAG (cloudpickle — the analogue of
+        shipping serialized Computations + registered UDF code) and run
+        it on the daemon. Returns {ident: value} like the library
+        client; ``fetch_results=False`` skips pulling result payloads
+        (they stay resident server-side, the common serving pattern)."""
+        reply = self._request(
+            MsgType.EXECUTE_COMPUTATIONS,
+            {"sinks": list(sinks), "job_name": job_name,
+             "materialize": materialize},
+            codec=CODEC_PICKLE)
+        return self._collect_results(reply["results"], fetch_results)
+
+    def execute_plan(self, plan_text: str, registry: Dict[str, Any],
+                     job_name: str = "remote-plan", materialize: bool = True,
+                     fetch_results: bool = True):
+        """Pickle-free execution: ship plan text + label→entry-point
+        registry; the daemon rebinds labels to registered types
+        (``ParsedPlan.to_computations``). The TCAP path."""
+        reply = self._request(
+            MsgType.EXECUTE_PLAN,
+            {"plan": plan_text, "registry": registry, "job_name": job_name,
+             "materialize": materialize})
+        return self._collect_results(reply["results"], fetch_results)
+
+    def _collect_results(self, summaries: Dict[str, Any],
+                         fetch: bool) -> Dict[RemoteIdent, Any]:
+        out: Dict[RemoteIdent, Any] = {}
+        for key, summary in summaries.items():
+            db, _, set_name = key.partition(":")
+            ident = RemoteIdent(db, set_name)
+            if not fetch:
+                out[ident] = summary
+            elif summary.get("kind") == "tensor":
+                out[ident] = self.get_tensor(db, set_name)
+            else:
+                items = list(self.get_set_iterator(db, set_name))
+                out[ident] = dict(items) if summary.get("kind") == "map" \
+                    else items
+        return out
+
+    def list_jobs(self) -> List[Dict[str, Any]]:
+        return self._request(MsgType.LIST_JOBS, {})["jobs"]
+
+    # --- stats --------------------------------------------------------
+    def collect_stats(self) -> Dict[str, Any]:
+        return self._request(MsgType.COLLECT_STATS, {})

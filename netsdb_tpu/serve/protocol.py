@@ -1,0 +1,183 @@
+"""Typed-frame wire protocol — the PDBCommunicator role.
+
+The reference frames every message as ``8-byte size + TYPEID-tagged
+Record<T> bytes`` over blocking TCP/Unix sockets and validates the
+TYPEID on receive (``src/communication/headers/PDBCommunicator.h:27-80``).
+Here a frame is::
+
+    !HBIQ  header = magic(u16) | codec(u8) | msg_type(u32) | body_len(u64)
+
+followed by ``body_len`` body bytes. Control bodies are msgpack (codec
+0); computation DAGs — which carry Python callables, the analogue of the
+reference shipping serialized Computation objects whose code lives in
+registered .so files — are cloudpickle (codec 1). Dense tensor payloads
+ride inside msgpack ``bin`` fields (raw buffer + dtype/shape header), so
+bulk data never round-trips through pickle.
+
+Security note: codec 1 executes code on deserialization, exactly like
+the reference's ``registerType`` shipping .so binaries that the server
+``dlopen``s. The serve layer is a trusted-cluster control plane; an
+optional shared token (HELLO handshake) gates connections.
+"""
+
+from __future__ import annotations
+
+import socket
+import struct
+from enum import IntEnum
+from typing import Any, Tuple
+
+import msgpack
+import numpy as np
+
+MAGIC = 0x4E54  # "NT"
+_HEADER = struct.Struct("!HBIQ")
+MAX_FRAME_BYTES = 1 << 34  # 16 GiB sanity cap on a single frame
+
+CODEC_MSGPACK = 0
+CODEC_PICKLE = 1
+
+
+class MsgType(IntEnum):
+    """Frame type ids — the reference's handler-map TYPEIDs
+    (``PDBServer::registerHandler``). Grouped like its message families
+    (Cat*, Storage*, DistributedStorage*, ExecuteComputation, ...)."""
+
+    # session
+    HELLO = 1
+    OK = 2
+    ERR = 3
+    PING = 4
+    SHUTDOWN = 5
+    # catalog / DDL (ref Cat* + DistributedStorageAddSet family)
+    CREATE_DATABASE = 10
+    CREATE_SET = 11
+    REMOVE_SET = 12
+    CLEAR_SET = 13
+    SET_EXISTS = 14
+    LIST_SETS = 15
+    REGISTER_TYPE = 16
+    # data path (ref DispatcherAddData / StorageAddData / SetScan)
+    SEND_DATA = 20
+    SEND_MATRIX = 21
+    GET_TENSOR = 22
+    SCAN_SET = 23
+    ADD_SHARED_MAPPING = 24
+    FLUSH_DATA = 25
+    LOAD_SET = 26
+    # query execution (ref ExecuteComputation)
+    EXECUTE_COMPUTATIONS = 30
+    EXECUTE_PLAN = 31
+    LIST_JOBS = 32
+    # stats (ref StorageCollectStats)
+    COLLECT_STATS = 40
+
+
+class ProtocolError(ConnectionError):
+    pass
+
+
+def _pack_default(obj: Any):
+    """msgpack hook: numpy arrays ride as raw buffers."""
+    if isinstance(obj, np.ndarray):
+        a = np.ascontiguousarray(obj)
+        return {"__nd__": True, "d": a.dtype.str, "s": list(a.shape),
+                "b": a.tobytes()}
+    if isinstance(obj, (np.integer,)):
+        return int(obj)
+    if isinstance(obj, (np.floating,)):
+        return float(obj)
+    raise TypeError(f"cannot serialize {type(obj)!r} over the wire; "
+                    f"wrap host objects in a pickled job instead")
+
+
+def _unpack_hook(obj):
+    if isinstance(obj, dict) and obj.get("__nd__"):
+        return np.frombuffer(obj["b"], dtype=np.dtype(obj["d"])).reshape(
+            obj["s"])
+    return obj
+
+
+def encode_body(payload: Any, codec: int = CODEC_MSGPACK) -> bytes:
+    if codec == CODEC_MSGPACK:
+        return msgpack.packb(payload, use_bin_type=True,
+                             default=_pack_default)
+    if codec == CODEC_PICKLE:
+        import cloudpickle
+
+        return cloudpickle.dumps(payload)
+    raise ProtocolError(f"unknown codec {codec}")
+
+
+def decode_body(body: bytes, codec: int, allow_pickle: bool) -> Any:
+    if codec == CODEC_MSGPACK:
+        return msgpack.unpackb(body, raw=False, object_hook=_unpack_hook,
+                               strict_map_key=False)
+    if codec == CODEC_PICKLE:
+        if not allow_pickle:
+            raise ProtocolError(
+                "pickled frame refused: this endpoint has allow_pickle "
+                "off (enable it only on trusted-cluster control planes)")
+        import pickle
+
+        return pickle.loads(body)
+    raise ProtocolError(f"unknown codec {codec}")
+
+
+def send_frame(sock: socket.socket, msg_type: int, payload: Any,
+               codec: int = CODEC_MSGPACK) -> None:
+    body = encode_body(payload, codec)
+    sock.sendall(_HEADER.pack(MAGIC, codec, int(msg_type), len(body)))
+    sock.sendall(body)
+
+
+def _recv_exact(sock: socket.socket, n: int) -> memoryview:
+    buf = bytearray(n)
+    view = memoryview(buf)
+    got = 0
+    while got < n:
+        r = sock.recv_into(view[got:], n - got)
+        if r == 0:
+            raise ProtocolError("peer closed mid-frame")
+        got += r
+    return memoryview(buf)
+
+
+def recv_frame_raw(sock: socket.socket) -> Tuple[MsgType, int, bytes]:
+    """Receive one frame without decoding — servers decode separately so
+    a refused codec becomes an ERR reply, not a dropped connection."""
+    header = _recv_exact(sock, _HEADER.size)
+    magic, codec, msg_type, body_len = _HEADER.unpack(header)
+    if magic != MAGIC:
+        raise ProtocolError(f"bad frame magic {magic:#x}")
+    if body_len > MAX_FRAME_BYTES:
+        raise ProtocolError(f"frame of {body_len} bytes exceeds cap")
+    body = _recv_exact(sock, body_len)
+    try:
+        typ = MsgType(msg_type)
+    except ValueError:
+        # unknown type ids stay raw ints: the server answers them with a
+        # "no handler" ERR instead of dropping the connection
+        typ = msg_type
+    return typ, codec, bytes(body)
+
+
+def recv_frame(sock: socket.socket,
+               allow_pickle: bool = False) -> Tuple[MsgType, Any]:
+    msg_type, codec, body = recv_frame_raw(sock)
+    return msg_type, decode_body(body, codec, allow_pickle)
+
+
+# --- tensor wire form -------------------------------------------------
+
+def tensor_to_wire(dense: np.ndarray, block_shape=None) -> dict:
+    """Dense tensor → wire dict. The device-side blocking/placement is
+    the server's job; the wire carries the raw dense buffer once."""
+    return {"data": np.ascontiguousarray(dense),
+            "block_shape": list(block_shape) if block_shape else None}
+
+
+def tensor_from_wire(obj: dict) -> Tuple[np.ndarray, Any]:
+    data = obj["data"]
+    bs = obj.get("block_shape")
+    return data, (tuple(bs) if bs else None)

@@ -1,0 +1,431 @@
+"""Resident controller daemon — PDBServer + master functionalities.
+
+One process plays the reference's master *and* worker roles: it owns the
+TPU (single-controller JAX), the SetStore with device-resident weight
+tensors, the catalog, and the compiled-plan cache — all of which stay
+live across client sessions, the way netsDB's master runs forever with
+model weight sets loaded while many clients run queries
+(``src/mainServer/source/MasterMain.cc:64-96``,
+``src/queries/headers/QueryClient.h:160-224``).
+
+Structure mirrors ``PDBServer``: a listener thread accepts connections
+and hands each to a worker thread; a handler map keyed by frame type
+dispatches messages (``src/pdbServer/headers/PDBServer.h:39-152``, where
+handlers are registered per object TYPEID). Query jobs additionally pass
+through a bounded admission semaphore — the job-queue role of
+``QuerySchedulerServer`` — so N clients can run concurrently without
+overcommitting the controller.
+"""
+
+from __future__ import annotations
+
+import importlib
+import itertools
+import socket
+import threading
+import time
+import traceback
+from typing import Any, Callable, Dict, Optional, Tuple
+
+import numpy as np
+
+from netsdb_tpu.client import Client
+from netsdb_tpu.config import Configuration, DEFAULT_CONFIG
+from netsdb_tpu.serve.protocol import (
+    CODEC_MSGPACK,
+    MsgType,
+    ProtocolError,
+    decode_body,
+    recv_frame,
+    recv_frame_raw,
+    send_frame,
+    tensor_from_wire,
+)
+from netsdb_tpu.storage.store import SetIdentifier
+
+
+def resolve_entry_point(entry: str) -> Any:
+    """'pkg.mod:attr' → live object — the analogue of the reference
+    loading a registered UDF .so and fixing up its vtable
+    (``src/objectModel/headers/VTableMap.h:36-80``)."""
+    mod_name, _, attr = entry.partition(":")
+    obj = importlib.import_module(mod_name)
+    for part in attr.split(".") if attr else []:
+        obj = getattr(obj, part)
+    return obj
+
+
+class ServeController:
+    """The daemon. ``start()`` runs the listener on a background thread
+    (tests); ``serve_forever()`` blocks (the CLI ``serve`` command)."""
+
+    def __init__(self, config: Configuration = DEFAULT_CONFIG,
+                 host: str = "127.0.0.1", port: int = 8108,
+                 token: Optional[str] = None,
+                 max_jobs: Optional[int] = None,
+                 allow_pickle: bool = True):
+        self.config = config
+        self.host = host
+        self.port = port
+        self.token = token
+        self.allow_pickle = allow_pickle
+        self.library = Client(config)  # the resident state
+        self._jobs_sem = threading.Semaphore(max_jobs or config.num_threads)
+        self._job_seq = itertools.count(1)
+        self._jobs: Dict[int, Dict[str, Any]] = {}
+        self._jobs_lock = threading.Lock()
+        self._started = time.time()
+        self._stop = threading.Event()
+        self._listener: Optional[socket.socket] = None
+        self._threads: list = []
+        # handler map keyed by frame type — PDBServer::registerHandler
+        self.handlers: Dict[MsgType, Callable[[Any], Tuple[MsgType, Any]]] = {
+            MsgType.PING: self._on_ping,
+            MsgType.CREATE_DATABASE: self._on_create_database,
+            MsgType.CREATE_SET: self._on_create_set,
+            MsgType.REMOVE_SET: self._on_remove_set,
+            MsgType.CLEAR_SET: self._on_clear_set,
+            MsgType.SET_EXISTS: self._on_set_exists,
+            MsgType.LIST_SETS: self._on_list_sets,
+            MsgType.REGISTER_TYPE: self._on_register_type,
+            MsgType.SEND_DATA: self._on_send_data,
+            MsgType.SEND_MATRIX: self._on_send_matrix,
+            MsgType.GET_TENSOR: self._on_get_tensor,
+            MsgType.SCAN_SET: self._on_scan_set,
+            MsgType.ADD_SHARED_MAPPING: self._on_add_shared_mapping,
+            MsgType.FLUSH_DATA: self._on_flush_data,
+            MsgType.LOAD_SET: self._on_load_set,
+            MsgType.EXECUTE_COMPUTATIONS: self._on_execute_computations,
+            MsgType.EXECUTE_PLAN: self._on_execute_plan,
+            MsgType.LIST_JOBS: self._on_list_jobs,
+            MsgType.COLLECT_STATS: self._on_collect_stats,
+        }
+
+    # --- lifecycle ----------------------------------------------------
+    def start(self) -> int:
+        """Bind + start the listener thread; returns the bound port
+        (``port=0`` picks an ephemeral one)."""
+        self._listener = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+        self._listener.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        self._listener.bind((self.host, self.port))
+        self._listener.listen(128)
+        self.port = self._listener.getsockname()[1]
+        t = threading.Thread(target=self._accept_loop, daemon=True,
+                             name="netsdb-serve-accept")
+        t.start()
+        self._threads.append(t)
+        return self.port
+
+    def serve_forever(self) -> None:
+        if self._listener is None:
+            self.start()
+        try:
+            while not self._stop.wait(0.5):
+                pass
+        except KeyboardInterrupt:
+            pass
+        finally:
+            self.shutdown()
+
+    def shutdown(self) -> None:
+        self._stop.set()
+        if self._listener is not None:
+            try:
+                self._listener.close()
+            except OSError:
+                pass
+            self._listener = None
+
+    # --- connection handling ------------------------------------------
+    def _accept_loop(self) -> None:
+        while not self._stop.is_set():
+            try:
+                conn, addr = self._listener.accept()
+            except OSError:
+                return  # listener closed
+            t = threading.Thread(target=self._serve_connection,
+                                 args=(conn, addr), daemon=True)
+            t.start()
+
+    def _serve_connection(self, conn: socket.socket, addr) -> None:
+        with conn:
+            conn.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+            try:
+                typ, hello = recv_frame(conn, allow_pickle=False)
+                if typ != MsgType.HELLO:
+                    raise ProtocolError("expected HELLO")
+                if self.token and hello.get("token") != self.token:
+                    send_frame(conn, MsgType.ERR,
+                               {"error": "AuthError", "message": "bad token"})
+                    return
+                send_frame(conn, MsgType.OK, {"server": "netsdb_tpu",
+                                              "version": 2})
+            except (ProtocolError, ConnectionError, OSError):
+                return
+            while not self._stop.is_set():
+                try:
+                    typ, codec_in, raw = recv_frame_raw(conn)
+                except (ProtocolError, ConnectionError, OSError):
+                    return
+                try:
+                    payload = decode_body(raw, codec_in, self.allow_pickle)
+                except Exception as e:  # refused codec / corrupt body
+                    try:
+                        send_frame(conn, MsgType.ERR, {
+                            "error": type(e).__name__, "message": str(e)})
+                        continue
+                    except OSError:
+                        return
+                if typ == MsgType.SHUTDOWN:
+                    send_frame(conn, MsgType.OK, {})
+                    self.shutdown()
+                    return
+                handler = self.handlers.get(typ)
+                try:
+                    if handler is None:
+                        raise ProtocolError(f"no handler for {typ!r}")
+                    out = handler(payload)
+                    if len(out) == 3:  # handler picked the reply codec
+                        reply_type, reply, codec = out
+                    else:
+                        reply_type, reply = out
+                        codec = CODEC_MSGPACK
+                    send_frame(conn, reply_type, reply, codec)
+                except BrokenPipeError:
+                    return
+                except Exception as e:  # handler errors go back as ERR
+                    try:
+                        send_frame(conn, MsgType.ERR, {
+                            "error": type(e).__name__,
+                            "message": str(e),
+                            "traceback": traceback.format_exc(limit=20),
+                        })
+                    except OSError:
+                        return
+
+    # --- job bookkeeping ----------------------------------------------
+    def _run_job(self, job_name: str, fn: Callable[[], Any]) -> Any:
+        job_id = next(self._job_seq)
+        rec = {"id": job_id, "name": job_name, "status": "queued",
+               "submitted": time.time(), "elapsed": None}
+        with self._jobs_lock:
+            self._jobs[job_id] = rec
+            # bounded history so a long-lived daemon cannot grow this
+            while len(self._jobs) > 1024:
+                self._jobs.pop(next(iter(self._jobs)))
+        with self._jobs_sem:
+            rec["status"] = "running"
+            t0 = time.perf_counter()
+            try:
+                out = fn()
+                rec["status"] = "done"
+                return out
+            except Exception:
+                rec["status"] = "failed"
+                raise
+            finally:
+                rec["elapsed"] = time.perf_counter() - t0
+
+    # --- handlers -----------------------------------------------------
+    def _on_ping(self, p) -> Tuple[MsgType, Any]:
+        with self._jobs_lock:
+            done = sum(1 for j in self._jobs.values() if j["status"] == "done")
+        return MsgType.OK, {"uptime": time.time() - self._started,
+                            "jobs_done": done,
+                            "sets": len(self.library.store.list_sets())}
+
+    def _on_create_database(self, p):
+        self.library.create_database(p["db"])
+        return MsgType.OK, {}
+
+    def _on_create_set(self, p):
+        self.library.create_set(
+            p["db"], p["set"], type_name=p.get("type_name", "tensor"),
+            persistence=p.get("persistence", "transient"),
+            eviction=p.get("eviction", "lru"),
+            partition_lambda=p.get("partition_lambda"))
+        return MsgType.OK, {}
+
+    def _on_remove_set(self, p):
+        self.library.remove_set(p["db"], p["set"])
+        return MsgType.OK, {}
+
+    def _on_clear_set(self, p):
+        self.library.clear_set(p["db"], p["set"])
+        return MsgType.OK, {}
+
+    def _on_set_exists(self, p):
+        return MsgType.OK, {"exists": self.library.set_exists(p["db"], p["set"])}
+
+    def _on_list_sets(self, p):
+        return MsgType.OK, {"sets": [list(i) for i in self.library.store.list_sets()]}
+
+    def _on_register_type(self, p):
+        self.library.register_type(p["type_name"], p["entry_point"])
+        return MsgType.OK, {}
+
+    def _on_send_data(self, p):
+        # objects arrive via the pickle codec (whole payload is a dict)
+        self.library.send_data(p["db"], p["set"], p["items"])
+        return MsgType.OK, {"count": len(p["items"])}
+
+    def _on_send_matrix(self, p):
+        dense, block_shape = tensor_from_wire(p["tensor"])
+        t = self.library.send_matrix(p["db"], p["set"], dense, block_shape)
+        return MsgType.OK, {"shape": list(t.shape), "dtype": str(t.dtype),
+                            "block_shape": list(t.meta.block_shape)}
+
+    def _on_get_tensor(self, p):
+        t = self.library.get_tensor(p["db"], p["set"])
+        dense = np.asarray(t.to_dense())
+        return MsgType.OK, {"data": dense,
+                            "block_shape": list(t.meta.block_shape)}
+
+    def _on_scan_set(self, p):
+        from netsdb_tpu.serve.protocol import CODEC_PICKLE
+
+        items = list(self.library.get_set_iterator(p["db"], p["set"]))
+        # host objects are arbitrary Python → pickle codec on the reply
+        return MsgType.OK, {"items": items}, CODEC_PICKLE
+
+    def _on_add_shared_mapping(self, p):
+        self.library.add_shared_mapping(
+            p["private_db"], p["private_set"], p["shared_db"], p["shared_set"],
+            p.get("mapping"))
+        return MsgType.OK, {}
+
+    def _on_flush_data(self, p):
+        self.library.flush_data()
+        return MsgType.OK, {}
+
+    def _on_load_set(self, p):
+        self.library.store.load_set(SetIdentifier(p["db"], p["set"]))
+        return MsgType.OK, {}
+
+    @staticmethod
+    def _sync_results(results: Dict[SetIdentifier, Any]) -> None:
+        """Barrier on tensor results: the OK reply must mean the value
+        exists, not that XLA enqueued it. A scalar reduce+pull is the
+        only sync that holds over the controller↔device tunnel
+        (block_until_ready returns early there)."""
+        import jax.numpy as jnp
+
+        from netsdb_tpu.core.blocked import BlockedTensor
+
+        for val in results.values():
+            if isinstance(val, BlockedTensor):
+                float(jnp.sum(val.data))
+
+    def _result_summaries(self, results: Dict[SetIdentifier, Any]) -> dict:
+        from netsdb_tpu.core.blocked import BlockedTensor
+
+        out = {}
+        for ident, val in results.items():
+            if isinstance(val, BlockedTensor):
+                out[str(ident)] = {"kind": "tensor", "shape": list(val.shape),
+                                   "dtype": str(val.dtype)}
+            elif isinstance(val, dict):
+                out[str(ident)] = {"kind": "map", "count": len(val)}
+            else:
+                out[str(ident)] = {"kind": "objects",
+                                   "count": len(list(val))}
+        return out
+
+    def _on_execute_computations(self, p):
+        """Body (pickle codec): {sinks: [WriteSet...], job_name}. The
+        DAG's callables were cloudpickled by the client — the analogue of
+        ``executeComputations`` shipping serialized Computation objects
+        whose code the worker loads from registered .so files."""
+        sinks = p["sinks"]
+        job_name = p.get("job_name", "remote-job")
+
+        def run():
+            results = self.library.execute_computations(
+                *sinks, job_name=job_name,
+                materialize=p.get("materialize", True))
+            if p.get("sync", True):
+                self._sync_results(results)
+            return results
+
+        results = self._run_job(job_name, run)
+        return MsgType.OK, {"results": self._result_summaries(results)}
+
+    def _on_execute_plan(self, p):
+        """Body (msgpack): {plan: text, registry: {label: entry_point or
+        {kwargs..., fn: entry_point}}, job_name}. Pickle-free remote
+        execution: labels rebind to *registered* entry points, the
+        TCAP-text path (``ComputePlan.cc:20-56`` reparsing TCAP at the
+        worker and binding against registered types)."""
+        from netsdb_tpu.plan.parser import parse_plan
+
+        registry: Dict[str, Any] = {}
+        for label, spec in (p.get("registry") or {}).items():
+            if isinstance(spec, str):
+                entry = self.library.catalog.get_type(spec) or spec
+                registry[label] = resolve_entry_point(entry)
+            elif isinstance(spec, dict):
+                kw = dict(spec)
+                for k, v in list(kw.items()):
+                    if isinstance(v, str) and ":" in v:
+                        entry = self.library.catalog.get_type(v) or v
+                        kw[k] = resolve_entry_point(entry)
+                registry[label] = kw
+            else:
+                raise ProtocolError(
+                    f"registry entry for {label!r} must be an entry-point "
+                    f"string or kwargs dict")
+        sinks = parse_plan(p["plan"]).to_computations(registry)
+        job_name = p.get("job_name", "remote-plan")
+
+        def run():
+            results = self.library.execute_computations(
+                *sinks, job_name=job_name,
+                materialize=p.get("materialize", True))
+            if p.get("sync", True):
+                self._sync_results(results)
+            return results
+
+        results = self._run_job(job_name, run)
+        return MsgType.OK, {"results": self._result_summaries(results)}
+
+    def _on_list_jobs(self, p):
+        with self._jobs_lock:
+            return MsgType.OK, {"jobs": [dict(j) for j in self._jobs.values()]}
+
+    def _on_collect_stats(self, p):
+        return MsgType.OK, {"sets": self.library.collect_stats(),
+                            "cache": self.library.store.stats.as_dict()}
+
+
+def run_daemon(config: Configuration, host: str = "127.0.0.1",
+               port: int = 8108, token: Optional[str] = None,
+               max_jobs: Optional[int] = None) -> int:
+    """Start a daemon and block until shutdown — shared by the CLI
+    ``serve`` subcommand and :func:`main`."""
+    ctl = ServeController(config, host=host, port=port, token=token,
+                          max_jobs=max_jobs)
+    bound = ctl.start()
+    print(f"netsdb_tpu serving on {host}:{bound}", flush=True)
+    ctl.serve_forever()
+    return 0
+
+
+def main(argv=None) -> int:
+    """``python -m netsdb_tpu.serve.server`` — standalone daemon entry
+    (the CLI's ``serve`` subcommand wraps this)."""
+    import argparse
+
+    ap = argparse.ArgumentParser(prog="netsdb-tpu-serve")
+    ap.add_argument("--host", default="127.0.0.1")
+    ap.add_argument("--port", type=int, default=8108)
+    ap.add_argument("--root", default=None, help="database root dir")
+    ap.add_argument("--token", default=None, help="shared auth token")
+    ap.add_argument("--max-jobs", type=int, default=None)
+    args = ap.parse_args(argv)
+    config = Configuration(root_dir=args.root) if args.root else DEFAULT_CONFIG
+    return run_daemon(config, host=args.host, port=args.port,
+                      token=args.token, max_jobs=args.max_jobs)
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

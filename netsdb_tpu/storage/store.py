@@ -21,10 +21,12 @@ mirrors ``LocalitySet`` {LRU, MRU, Random}
 from __future__ import annotations
 
 import dataclasses
+import functools
 import io
 import os
 import pickle
 import random
+import threading
 import time
 from collections import OrderedDict
 from typing import Any, Dict, Iterator, List, NamedTuple, Optional
@@ -84,6 +86,17 @@ def _item_nbytes(item: Any) -> int:
     return 256  # rough per-object estimate for host records
 
 
+def _locked(method):
+    """Run a public store method under the store's reentrant lock."""
+
+    @functools.wraps(method)
+    def wrapper(self, *args, **kwargs):
+        with self._lock:
+            return method(self, *args, **kwargs)
+
+    return wrapper
+
+
 class SetStore:
     """All sets of all databases on this host.
 
@@ -99,8 +112,13 @@ class SetStore:
         self._sets: "OrderedDict[SetIdentifier, _StoredSet]" = OrderedDict()
         self.stats = CacheStats()
         self.max_host_bytes = max_host_bytes or config.shared_mem_bytes
+        # serve-layer handler threads mutate sets concurrently (the
+        # reference guards Pangea's set maps with pthread mutexes);
+        # reentrant because e.g. add_data -> _maybe_evict -> flush
+        self._lock = threading.RLock()
 
     # --- set lifecycle ------------------------------------------------
+    @_locked
     def create_set(
         self,
         ident: SetIdentifier,
@@ -116,22 +134,26 @@ class SetStore:
     def exists(self, ident: SetIdentifier) -> bool:
         return ident in self._sets or os.path.exists(self._spill_path(ident))
 
+    @_locked
     def remove_set(self, ident: SetIdentifier) -> None:
         self._sets.pop(ident, None)
         path = self._spill_path(ident)
         if os.path.exists(path):
             os.remove(path)
 
+    @_locked
     def clear_set(self, ident: SetIdentifier) -> None:
         s = self._sets.get(ident)
         if s is not None:
             s.items = []
             s.nbytes = 0
 
+    @_locked
     def list_sets(self) -> List[SetIdentifier]:
         return list(self._sets.keys())
 
     # --- data path (ref: StorageAddData / UserSet::addObject) ---------
+    @_locked
     def add_data(self, ident: SetIdentifier, items: List[Any]) -> None:
         s = self._require(ident)
         if s.alias_of is not None:
@@ -143,6 +165,7 @@ class SetStore:
         s.last_access = time.time()
         self._maybe_evict(exclude=ident)
 
+    @_locked
     def put_tensor(self, ident: SetIdentifier, tensor: BlockedTensor) -> None:
         """Replace a set's contents with one tensor — the dominant pattern
         for model-weight sets (each netsDB weight set is exactly one
@@ -164,6 +187,7 @@ class SetStore:
             )
         return tensors[0]
 
+    @_locked
     def get_items(self, ident: SetIdentifier) -> List[Any]:
         s = self._require(ident)
         if s.alias_of is not None:
@@ -182,6 +206,7 @@ class SetStore:
         (``src/queries/headers/SetIterator.h``)."""
         yield from self.get_items(ident)
 
+    @_locked
     def add_shared_mapping(
         self, private: SetIdentifier, shared: SetIdentifier, mapping: Optional[Dict] = None
     ) -> None:
@@ -199,6 +224,7 @@ class SetStore:
         safe = f"{ident.db}__{ident.set}".replace("/", "_")
         return os.path.join(self.config.data_dir, f"{safe}.pdbset")
 
+    @_locked
     def flush(self, ident: SetIdentifier) -> str:
         """Write a set durably to disk (keeps it in RAM)."""
         s = self._require(ident)
@@ -296,6 +322,7 @@ class SetStore:
         self.stats.misses += 1
         self.stats.loads += 1
 
+    @_locked
     def load_set(self, ident: SetIdentifier) -> None:
         """Recover a persisted set after restart (ref: sets survive soft
         reboot, README.md:101-113)."""
@@ -341,6 +368,7 @@ class SetStore:
         return self._sets[ident]
 
     # --- stats (ref: StorageCollectStats → Statistics) ----------------
+    @_locked
     def set_stats(self, ident: SetIdentifier) -> Dict[str, Any]:
         s = self._require(ident)
         items = s.items if s.items is not None else []

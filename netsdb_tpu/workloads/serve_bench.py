@@ -1,0 +1,245 @@
+"""Served-inference benchmark — FF throughput through the RPC hop.
+
+The reference's serving story: the master loads model weight sets once
+and many ``PDBClient`` processes run inference queries against them
+concurrently (``src/mainServer/source/MasterMain.cc:64-96``,
+``src/queries/headers/QueryClient.h:160-224``). This benchmark measures
+the same shape here: one resident daemon (owning the device + weight
+sets + compiled-plan cache), N separate *client processes*, each sending
+its private input set once and then running M inference jobs whose only
+per-job wire traffic is the plan.
+
+Reported: aggregate rows/s across clients (wall), per-job latency
+percentiles, and the daemon's view (jobs done, cache stats). On the lab
+rig the controller↔device tunnel adds ~65-200 ms per job (the sync
+barrier is a scalar pull); on directly-attached TPU hosts per-job
+overhead is the localhost RPC + dispatch only.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import socket
+import subprocess
+import sys
+import time
+from typing import Any, Dict, List, Optional
+
+BATCH = 16384
+FEATURES = 1024
+HIDDEN = 4096
+LABELS = 1024
+BLOCK = (512, 512)
+
+
+def _python() -> str:
+    venv = "/opt/venv/bin/python"
+    return venv if os.path.exists(venv) else sys.executable
+
+
+def _wait_port(host: str, port: int, timeout: float = 120.0) -> None:
+    t0 = time.time()
+    while time.time() - t0 < timeout:
+        try:
+            with socket.create_connection((host, port), timeout=1):
+                return
+        except OSError:
+            time.sleep(0.2)
+    raise TimeoutError(f"daemon on {host}:{port} did not come up")
+
+
+def _free_port() -> int:
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]
+
+
+def load_model(address: str, db: str = "ffserve", seed: int = 0,
+               features: int = FEATURES, hidden: int = HIDDEN,
+               labels: int = LABELS) -> None:
+    """Load the FF weight sets into the daemon ONCE (ref ff::setup +
+    loadMatrix). Runs in whatever process calls it — only thin-client
+    RPC, no device work here."""
+    import numpy as np
+
+    from netsdb_tpu.serve.client import RemoteClient
+
+    c = RemoteClient(address)
+    rng = np.random.default_rng(seed)
+    c.create_database(db)
+    for s in ("w1", "b1", "wo", "bo"):
+        c.create_set(db, s)
+    c.send_matrix(db, "w1",
+                  rng.standard_normal((hidden, features)).astype(np.float32)
+                  * np.sqrt(2.0 / features), BLOCK)
+    c.send_matrix(db, "b1",
+                  (rng.standard_normal((hidden, 1)) * 0.01).astype(np.float32),
+                  (BLOCK[0], 1))
+    c.send_matrix(db, "wo",
+                  rng.standard_normal((labels, hidden)).astype(np.float32)
+                  * np.sqrt(2.0 / hidden), BLOCK)
+    c.send_matrix(db, "bo",
+                  (rng.standard_normal((labels, 1)) * 0.01).astype(np.float32),
+                  (BLOCK[0], 1))
+    c.close()
+
+
+def run_client_worker(address: str, client_id: int, jobs: int,
+                      batch: int = BATCH, db: str = "ffserve",
+                      features: int = FEATURES) -> Dict[str, Any]:
+    """One client process: send a private input set once, then run
+    ``jobs`` inference jobs against the RESIDENT weights. Returns
+    timing; also printed as JSON when run via --worker."""
+    import numpy as np
+
+    from netsdb_tpu.models.ff import FFModel
+    from netsdb_tpu.serve.client import RemoteClient
+
+    c = RemoteClient(address)
+    inp = f"inputs_c{client_id}"
+    out = f"output_c{client_id}"
+    rng = np.random.default_rng(client_id)
+    c.create_set(db, inp)
+    c.create_set(db, out)
+    t_load0 = time.perf_counter()
+    c.send_matrix(db, inp,
+                  rng.standard_normal((batch, features)).astype(np.float32),
+                  BLOCK)
+    load_s = time.perf_counter() - t_load0
+
+    model = FFModel(db=db, block=BLOCK)
+    sink = model.build_inference_dag(input_set=inp, output_set=out)
+    # warmup: first job compiles (cached thereafter — and shared across
+    # clients, since the canonical plan signature is identical)
+    c.execute_computations(sink, job_name="ff-serve",
+                           fetch_results=False)
+    lat: List[float] = []
+    t_start = time.time()  # epoch: lets the parent compute the union
+    t0 = time.perf_counter()
+    for _ in range(jobs):
+        t1 = time.perf_counter()
+        c.execute_computations(sink, job_name="ff-serve",
+                               fetch_results=False)
+        lat.append(time.perf_counter() - t1)
+    wall = time.perf_counter() - t0
+    c.close()
+    lat.sort()
+    return {
+        "client_id": client_id, "jobs": jobs, "batch": batch,
+        "wall_s": wall, "input_load_s": load_s,
+        "t_start": t_start, "t_end": t_start + wall,
+        "job_p50_s": lat[len(lat) // 2],
+        "job_p90_s": lat[int(len(lat) * 0.9)],
+        "rows_per_sec": jobs * batch / wall,
+    }
+
+
+def run_serve_bench(clients: int = 2, jobs_per_client: int = 8,
+                    batch: int = BATCH, port: int = 0,
+                    platform: Optional[str] = None,
+                    daemon_env: Optional[Dict[str, str]] = None,
+                    ) -> Dict[str, Any]:
+    """Spawn (or reuse) a daemon, load weights once, run N concurrent
+    client PROCESSES, aggregate."""
+    host = "127.0.0.1"
+    daemon: Optional[subprocess.Popen] = None
+    if port == 0:
+        port = _free_port()
+        env = dict(os.environ)
+        env.update(daemon_env or {})
+        argv = [_python(), "-m", "netsdb_tpu", "serve", "--port", str(port),
+                "--root", f"/tmp/netsdb_serve_bench_{port}"]
+        if platform:
+            argv += ["--platform", platform]
+        daemon = subprocess.Popen(
+            argv, env=env,
+            stdout=subprocess.DEVNULL, stderr=subprocess.DEVNULL,
+            cwd=os.path.dirname(os.path.dirname(os.path.dirname(
+                os.path.abspath(__file__)))),
+        )
+    address = f"{host}:{port}"
+    try:
+        _wait_port(host, port)
+        load_model(address)
+
+        procs = []
+        t0 = time.perf_counter()
+        for i in range(clients):
+            procs.append(subprocess.Popen(
+                [_python(), "-m", "netsdb_tpu.workloads.serve_bench",
+                 "--worker", "--address", address, "--client-id", str(i),
+                 "--jobs", str(jobs_per_client), "--batch", str(batch)],
+                stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True,
+                cwd=os.path.dirname(os.path.dirname(os.path.dirname(
+                    os.path.abspath(__file__)))),
+            ))
+        results = []
+        for p in procs:
+            out_text, err_text = p.communicate(timeout=1800)
+            if p.returncode != 0:
+                raise RuntimeError(
+                    f"client worker failed rc={p.returncode}:\n{err_text[-4000:]}")
+            results.append(json.loads(out_text.strip().splitlines()[-1]))
+        wall = time.perf_counter() - t0
+
+        from netsdb_tpu.serve.client import RemoteClient
+
+        c = RemoteClient(address)
+        stats = c.collect_stats()
+        server_jobs = [j for j in c.list_jobs() if j["name"] == "ff-serve"]
+        elapsed = sorted(j["elapsed"] for j in server_jobs
+                         if j["elapsed"] is not None)
+        c.close()
+        total_rows = sum(r["jobs"] * r["batch"] for r in results)
+        # measurement window = union of the clients' job loops (spawn +
+        # import + warmup-compile time excluded: steady-state serving)
+        window = max(r["t_end"] for r in results) - min(
+            r["t_start"] for r in results)
+        return {
+            "clients": clients, "jobs_per_client": jobs_per_client,
+            "batch": batch,
+            "aggregate_rows_per_sec": total_rows / window,
+            "measurement_window_s": window,
+            "wall_s_incl_spawn": wall,
+            "per_client": results,
+            "server_jobs_done": sum(j["status"] == "done"
+                                    for j in server_jobs),
+            "server_job_elapsed_p50":
+                elapsed[len(elapsed) // 2] if elapsed else None,
+            "cache_stats": stats.get("cache"),
+        }
+    finally:
+        if daemon is not None:
+            daemon.terminate()
+            try:
+                daemon.wait(timeout=10)
+            except subprocess.TimeoutExpired:
+                daemon.kill()
+
+
+def main(argv=None) -> int:
+    import argparse
+
+    ap = argparse.ArgumentParser(prog="serve_bench")
+    ap.add_argument("--worker", action="store_true")
+    ap.add_argument("--address", default="127.0.0.1:8108")
+    ap.add_argument("--client-id", type=int, default=0)
+    ap.add_argument("--jobs", type=int, default=8)
+    ap.add_argument("--batch", type=int, default=BATCH)
+    ap.add_argument("--clients", type=int, default=2)
+    ap.add_argument("--port", type=int, default=0)
+    args = ap.parse_args(argv)
+    if args.worker:
+        out = run_client_worker(args.address, args.client_id, args.jobs,
+                                args.batch)
+    else:
+        out = run_serve_bench(clients=args.clients,
+                              jobs_per_client=args.jobs, batch=args.batch,
+                              port=args.port)
+    print(json.dumps(out))
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())
