@@ -119,6 +119,17 @@ def _cmd_tpch_bench(args) -> int:
     return 0
 
 
+def _cmd_autotune(args) -> int:
+    """Measure the physical-strategy crossovers on the live backend and
+    persist them per device kind (the planner reads them back;
+    ``netsdb_tpu.relational.tuning``)."""
+    from netsdb_tpu.relational import tuning
+
+    measured = tuning.autotune(persist=not args.no_persist)
+    print(json.dumps({"device_kind": tuning.device_kind(), **measured}))
+    return 0
+
+
 def _cmd_selftest(args) -> int:
     """Scripted integration sequence — the reference's
     ``scripts/integratedTests.py:72-240`` (boot pseudo-cluster, then run
@@ -451,8 +462,15 @@ def main(argv=None) -> int:
     p.add_argument("--platform", default=None,
                    help="jax platform for the spawned daemon (e.g. cpu)")
 
+    p = sub.add_parser("autotune",
+                       help="measure physical-strategy crossovers "
+                       "(dense-vs-scatter segments, LUT-vs-sort joins) on "
+                       "the live backend and persist per device kind")
+    p.add_argument("--no-persist", action="store_true")
+
     args = parser.parse_args(argv)
     return {"info": _cmd_info, "bench": _cmd_bench, "pdml": _cmd_pdml,
+            "autotune": _cmd_autotune,
             "serve": _cmd_serve, "serve-bench": _cmd_serve_bench,
             "demo-ff": _cmd_demo_ff, "tpch": _cmd_tpch,
             "micro-bench": _cmd_micro_bench, "tpch-bench": _cmd_tpch_bench,

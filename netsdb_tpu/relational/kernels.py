@@ -51,13 +51,23 @@ def _in_range(segment_ids: jnp.ndarray, num_segments: int,
     return ok if mask is None else (ok & mask)
 
 
-# Below this group count, a broadcast-compare + column reduce beats the
-# scatter-add: TPU scatters with millions of colliding updates
-# serialize, while the dense form is one fused streaming pass (measured
-# on Q01 @ SF1, 12 groups: 52.6 ms scatter → ~2 ms dense). Above it the
-# O(N*G) dense work loses; large-G queries (Q13's per-customer counts)
-# keep the scatter.
-_DENSE_SEGMENT_LIMIT = 64
+def _use_dense(num_segments: int, method: Optional[str]) -> bool:
+    """Dense (broadcast-compare + column reduce) vs scatter dispatch.
+
+    Below the crossover a dense pass beats the scatter-add: TPU
+    scatters with millions of colliding updates serialize, while the
+    dense form is one fused streaming pass (measured on Q01 @ SF1, 12
+    groups: 52.6 ms scatter → ~2 ms dense). Above it the O(N*G) dense
+    work loses; large-G queries (Q13's per-customer counts) keep the
+    scatter. The crossover is measured per device kind
+    (:mod:`netsdb_tpu.relational.tuning`), not frozen; ``method``
+    ("dense"/"scatter") forces a strategy (tests, autotune probes).
+    """
+    if method is not None:
+        return method == "dense"
+    from netsdb_tpu.relational import planner
+
+    return planner.segment_method(num_segments) == "dense"
 
 
 def _dense_segment_reduce(v: jnp.ndarray, segment_ids: jnp.ndarray,
@@ -72,10 +82,11 @@ def _dense_segment_reduce(v: jnp.ndarray, segment_ids: jnp.ndarray,
 
 def segment_sum(values: jnp.ndarray, segment_ids: jnp.ndarray,
                 num_segments: int,
-                mask: Optional[jnp.ndarray] = None) -> jnp.ndarray:
+                mask: Optional[jnp.ndarray] = None,
+                method: Optional[str] = None) -> jnp.ndarray:
     """Per-segment sum; masked and out-of-range rows contribute 0."""
     v = _masked(values, _in_range(segment_ids, num_segments, mask), 0)
-    if num_segments <= _DENSE_SEGMENT_LIMIT:
+    if _use_dense(num_segments, method):
         return _dense_segment_reduce(v, segment_ids, num_segments, 0,
                                      lambda m: m.sum(axis=0))
     ids = jnp.clip(segment_ids, 0, num_segments - 1)
@@ -83,18 +94,20 @@ def segment_sum(values: jnp.ndarray, segment_ids: jnp.ndarray,
 
 
 def segment_count(segment_ids: jnp.ndarray, num_segments: int,
-                  mask: Optional[jnp.ndarray] = None) -> jnp.ndarray:
+                  mask: Optional[jnp.ndarray] = None,
+                  method: Optional[str] = None) -> jnp.ndarray:
     ones = jnp.ones(segment_ids.shape, jnp.int32)
-    return segment_sum(ones, segment_ids, num_segments, mask)
+    return segment_sum(ones, segment_ids, num_segments, mask, method)
 
 
 def segment_min(values: jnp.ndarray, segment_ids: jnp.ndarray,
                 num_segments: int,
-                mask: Optional[jnp.ndarray] = None) -> jnp.ndarray:
+                mask: Optional[jnp.ndarray] = None,
+                method: Optional[str] = None) -> jnp.ndarray:
     """Per-segment min; empty segments hold +inf (f32) / max (i32)."""
     big = jnp.inf if values.dtype.kind == "f" else jnp.iinfo(values.dtype).max
     v = _masked(values, _in_range(segment_ids, num_segments, mask), big)
-    if num_segments <= _DENSE_SEGMENT_LIMIT:
+    if _use_dense(num_segments, method):
         return _dense_segment_reduce(v, segment_ids, num_segments, big,
                                      lambda m: m.min(axis=0))
     ids = jnp.clip(segment_ids, 0, num_segments - 1)
@@ -104,11 +117,12 @@ def segment_min(values: jnp.ndarray, segment_ids: jnp.ndarray,
 
 def segment_max(values: jnp.ndarray, segment_ids: jnp.ndarray,
                 num_segments: int,
-                mask: Optional[jnp.ndarray] = None) -> jnp.ndarray:
+                mask: Optional[jnp.ndarray] = None,
+                method: Optional[str] = None) -> jnp.ndarray:
     small = (-jnp.inf if values.dtype.kind == "f"
              else jnp.iinfo(values.dtype).min)
     v = _masked(values, _in_range(segment_ids, num_segments, mask), small)
-    if num_segments <= _DENSE_SEGMENT_LIMIT:
+    if _use_dense(num_segments, method):
         return _dense_segment_reduce(v, segment_ids, num_segments, small,
                                      lambda m: m.max(axis=0))
     ids = jnp.clip(segment_ids, 0, num_segments - 1)
@@ -118,18 +132,20 @@ def segment_max(values: jnp.ndarray, segment_ids: jnp.ndarray,
 
 def segment_mean(values: jnp.ndarray, segment_ids: jnp.ndarray,
                  num_segments: int,
-                 mask: Optional[jnp.ndarray] = None) -> jnp.ndarray:
+                 mask: Optional[jnp.ndarray] = None,
+                 method: Optional[str] = None) -> jnp.ndarray:
     """Per-segment mean; empty segments yield 0."""
     s = segment_sum(values.astype(jnp.float32), segment_ids, num_segments,
-                    mask)
-    c = segment_count(segment_ids, num_segments, mask)
+                    mask, method)
+    c = segment_count(segment_ids, num_segments, mask, method)
     return s / jnp.maximum(c, 1).astype(jnp.float32)
 
 
 def bincount_masked(values: jnp.ndarray, length: int,
-                    mask: Optional[jnp.ndarray] = None) -> jnp.ndarray:
+                    mask: Optional[jnp.ndarray] = None,
+                    method: Optional[str] = None) -> jnp.ndarray:
     """Histogram of small non-negative ints (Q13's count-of-counts)."""
-    return segment_count(values, length, mask)
+    return segment_count(values, length, mask, method)
 
 
 # --- joins ------------------------------------------------------------
@@ -144,6 +160,7 @@ def pk_fk_join(pk_keys: jnp.ndarray, fk_keys: jnp.ndarray,
                pk_mask: Optional[jnp.ndarray] = None,
                fk_mask: Optional[jnp.ndarray] = None,
                key_space: Optional[int] = None,
+               plan=None,
                ) -> Tuple[jnp.ndarray, jnp.ndarray]:
     """Equi-join a unique-key (primary) side into a foreign-key side.
 
@@ -153,15 +170,24 @@ def pk_fk_join(pk_keys: jnp.ndarray, fk_keys: jnp.ndarray,
     brought over with ``jnp.take(col, gather_idx)`` — the vectorized
     JoinMap probe.
 
-    With ``key_space`` (a static bound: all keys in [0, key_space) —
-    the host-side table metadata every ColumnTable already tracks), the
-    join is a dense lookup table: one scatter to build, one gather to
-    probe. Measured ~19x faster than sort+binary-search at SF-1 TPC-H
-    scale (49 ms vs 947 ms for 6M probes into 1.5M build rows) — TPU
-    binary search serializes, gathers stream. Without it, falls back
-    to sort + ``searchsorted(method="sort")`` (TPU's while-loop "scan"
-    method is another ~8x slower).
+    ``plan`` (a :class:`netsdb_tpu.relational.planner.JoinPlan`,
+    produced from ingest-time column statistics) selects the physical
+    strategy; it is the stats-driven replacement for the round-1
+    caller-supplied ``key_space=`` (still accepted: it forces the LUT
+    path, which the autotune probes and legacy callers use).
+
+    LUT strategy — dense lookup table over [0, key_space): one scatter
+    to build, one gather to probe. Measured ~19x faster than
+    sort+binary-search at SF-1 TPC-H scale (49 ms vs 947 ms for 6M
+    probes into 1.5M build rows) — TPU binary search serializes,
+    gathers stream. Sort strategy — argsort +
+    ``searchsorted(method="sort")``; wins when the key space is sparse
+    enough that the LUT is mostly padding (TPU's while-loop "scan"
+    searchsorted is another ~8x slower, so "sort" here always means the
+    vectorized sort-based probe).
     """
+    if plan is not None:
+        key_space = plan.key_space if plan.strategy == "lut" else None
     if key_space is not None:
         p = pk_keys.shape[0]
         valid_pk = (pk_keys >= 0) & (pk_keys < key_space)
@@ -194,14 +220,15 @@ def pk_fk_join(pk_keys: jnp.ndarray, fk_keys: jnp.ndarray,
 def member(build_keys: jnp.ndarray, probe_keys: jnp.ndarray,
            build_mask: Optional[jnp.ndarray] = None,
            probe_mask: Optional[jnp.ndarray] = None,
-           key_space: Optional[int] = None) -> jnp.ndarray:
+           key_space: Optional[int] = None,
+           plan=None) -> jnp.ndarray:
     """Semi-join membership: for each probe row, does any valid build
     row share its key? (Q04 EXISTS, Q22 NOT EXISTS.) Build keys need
     not be unique."""
     _, hit = pk_fk_join(
         # duplicates are fine for membership: any representative row
         # (leftmost via searchsorted, last-writer via the LUT) works
-        build_keys, probe_keys, build_mask, probe_mask, key_space)
+        build_keys, probe_keys, build_mask, probe_mask, key_space, plan)
     return hit
 
 

@@ -43,21 +43,18 @@ import jax.numpy as jnp
 import numpy as np
 
 from netsdb_tpu.relational import kernels as K
+from netsdb_tpu.relational import planner as P
+from netsdb_tpu.relational.stats import analyze_table, key_space
 from netsdb_tpu.relational.table import ColumnTable, date_to_int, int_to_date
 
 Tables = Dict[str, ColumnTable]
 
-
-def key_space(t: ColumnTable, col: str) -> int:
-    """Static key-space bound for segment ops: max key + 1 (host-side,
-    cached on the table)."""
-    cache = getattr(t, "_key_space", None)
-    if cache is None:
-        cache = {}
-        object.__setattr__(t, "_key_space", cache)
-    if col not in cache:
-        cache[col] = int(np.asarray(t[col]).max()) + 1 if t.num_rows else 1
-    return cache[col]
+# Join strategies are chosen by the statistics-driven planner
+# (`P.plan_join` reading ingest-time column stats), not by per-call
+# `key_space=` arguments as in round 1 — the choice follows the data.
+# The resulting JoinPlan is a hashable static argument, so each
+# (strategy, key_space) pair compiles once and is cached like any other
+# static shape.
 
 
 def _lut(dictionary: List[str], pred: Callable[[str], bool]) -> jnp.ndarray:
@@ -116,23 +113,24 @@ def cq01(tables: Tables, delta_date: str = "1998-09-02"):
 
 # ---------------------------------------------------------------- Q02
 @functools.partial(jax.jit, static_argnums=(0, 1, 2, 3))
-def _q02_core(n_part, n_sup, n_nat, n_reg_ks,
+def _q02_core(jp_part, jp_sup, jp_nat, jp_reg,
               p_key, p_size, p_type, ps_part, ps_supp, ps_cost,
               s_key, s_nat, r_key, r_name, n_key, n_reg,
               type_ok, size, region_code):
+    n_part = jp_part.key_space
     part_ok = (p_size == size) & jnp.take(type_ok, p_type)
     # partsupp ⋈ part (restrict to qualifying parts)
-    _, phit = K.pk_fk_join(p_key, ps_part, part_ok, key_space=n_part)
+    _, phit = K.pk_fk_join(p_key, ps_part, part_ok, plan=jp_part)
     # supplier ⋈ nation ⋈ region chain, evaluated on the supplier side;
     # nation columns come through the join's row index (keys need not
     # equal row positions)
-    nidx, nhit = K.pk_fk_join(n_key, s_nat, key_space=n_nat)
+    nidx, nhit = K.pk_fk_join(n_key, s_nat, plan=jp_nat)
     sup_region = jnp.take(n_reg, nidx)
-    ridx, rhit = K.pk_fk_join(r_key, sup_region, key_space=n_reg_ks)
+    ridx, rhit = K.pk_fk_join(r_key, sup_region, plan=jp_reg)
     in_region = nhit & rhit & (jnp.take(r_name, ridx) == region_code)
     sup_ok = in_region
     # partsupp ⋈ supplier
-    sidx, shit = K.pk_fk_join(s_key, ps_supp, sup_ok, key_space=n_sup)
+    sidx, shit = K.pk_fk_join(s_key, ps_supp, sup_ok, plan=jp_sup)
     valid = phit & shit
     # min cost per part, then the first row achieving it (the row
     # engine's combine keeps the earlier row on ties)
@@ -153,8 +151,10 @@ def _args_q02(tables: Tables, size: int = 15, type_suffix: str = "BRUSHED",
     part, ps = tables["part"], tables["partsupp"]
     sup, nat, reg = tables["supplier"], tables["nation"], tables["region"]
     type_ok = _lut(part.dicts["p_type"], lambda s: s.endswith(type_suffix))
-    return (key_space(ps, "ps_partkey"), key_space(sup, "s_suppkey"),
-            key_space(nat, "n_nationkey"), key_space(reg, "r_regionkey"),
+    return (P.plan_join(part, "p_partkey", ps, "ps_partkey"),
+            P.plan_join(sup, "s_suppkey", ps, "ps_suppkey"),
+            P.plan_join(nat, "n_nationkey", sup, "s_nationkey"),
+            P.plan_join(reg, "r_regionkey", nat, "n_regionkey"),
             part["p_partkey"], part["p_size"], part["p_type"],
             ps["ps_partkey"], ps["ps_suppkey"], ps["ps_supplycost"],
             sup["s_suppkey"], sup["s_nationkey"],
@@ -184,12 +184,13 @@ def cq02(tables: Tables, size: int = 15, type_suffix: str = "BRUSHED",
 
 # ---------------------------------------------------------------- Q03
 @functools.partial(jax.jit, static_argnums=(0, 1, 2))
-def _q03_core(n_orders, k, n_cust, c_key, c_seg, o_key, o_cust, o_date,
+def _q03_core(jp_orders, k, jp_cust, c_key, c_seg, o_key, o_cust, o_date,
               l_okey, l_ship, l_price, l_disc, seg_code, d):
+    n_orders = jp_orders.key_space
     cust_ok = c_seg == seg_code
-    _, chit = K.pk_fk_join(c_key, o_cust, cust_ok, key_space=n_cust)
+    _, chit = K.pk_fk_join(c_key, o_cust, cust_ok, plan=jp_cust)
     order_ok = chit & (o_date < d)
-    oidx, ohit = K.pk_fk_join(o_key, l_okey, order_ok, key_space=n_orders)
+    oidx, ohit = K.pk_fk_join(o_key, l_okey, order_ok, plan=jp_orders)
     li_ok = ohit & (l_ship > d)
     rev = K.segment_sum(l_price * (1.0 - l_disc), l_okey, n_orders, li_ok)
     odate_per_order = K.segment_min(
@@ -204,7 +205,8 @@ def _args_q03(tables: Tables, segment: str = "BUILDING",
               date: str = "1995-03-15", k: int = 10):
     cust, orders, li = (tables["customer"], tables["orders"],
                         tables["lineitem"])
-    return (key_space(li, "l_orderkey"), k, key_space(cust, "c_custkey"),
+    return (P.plan_join(orders, "o_orderkey", li, "l_orderkey"), k,
+            P.plan_join(cust, "c_custkey", orders, "o_custkey"),
             cust["c_custkey"],
             cust["c_mktsegment"], orders["o_orderkey"], orders["o_custkey"],
             orders["o_orderdate"], li["l_orderkey"], li["l_shipdate"],
@@ -226,10 +228,10 @@ def cq03(tables: Tables, segment: str = "BUILDING",
 
 # ---------------------------------------------------------------- Q04
 @functools.partial(jax.jit, static_argnums=(0, 1))
-def _q04_core(n_pri, n_okey, o_key, o_date, o_pri, l_okey, l_commit,
+def _q04_core(n_pri, jp_li, o_key, o_date, o_pri, l_okey, l_commit,
               l_receipt, a, b):
     late = l_commit < l_receipt
-    has_late = K.member(l_okey, o_key, late, key_space=n_okey)
+    has_late = K.member(l_okey, o_key, late, plan=jp_li)
     in_q = (o_date >= a) & (o_date < b)
     return K.segment_count(o_pri, n_pri, has_late & in_q)
 
@@ -238,7 +240,7 @@ def _args_q04(tables: Tables, d0: str = "1993-07-01",
               d1: str = "1993-10-01"):
     orders, li = tables["orders"], tables["lineitem"]
     n_pri = len(orders.dicts["o_orderpriority"])
-    return (n_pri, key_space(li, "l_orderkey"),
+    return (n_pri, P.plan_join(li, "l_orderkey", orders, "o_orderkey"),
             orders["o_orderkey"], orders["o_orderdate"],
             orders["o_orderpriority"], li["l_orderkey"], li["l_commitdate"],
             li["l_receiptdate"], date_to_int(d0), date_to_int(d1))
@@ -281,12 +283,12 @@ def cq06(tables: Tables, d0: str = "1994-01-01", d1: str = "1995-01-01",
 
 # ---------------------------------------------------------------- Q12
 @functools.partial(jax.jit, static_argnums=(0, 1))
-def _q12_core(n_modes, n_okey, o_key, o_pri, l_okey, l_mode, l_ship,
+def _q12_core(n_modes, jp_orders, o_key, o_pri, l_okey, l_mode, l_ship,
               l_commit, l_receipt, hi_lut, m1, m2, a, b):
     mask = (((l_mode == m1) | (l_mode == m2))
             & (l_commit < l_receipt) & (l_ship < l_commit)
             & (l_receipt >= a) & (l_receipt < b))
-    oidx, ohit = K.pk_fk_join(o_key, l_okey, key_space=n_okey)
+    oidx, ohit = K.pk_fk_join(o_key, l_okey, plan=jp_orders)
     mask = mask & ohit
     high = jnp.take(hi_lut, jnp.take(o_pri, oidx))
     return jnp.stack([K.segment_count(l_mode, n_modes, mask & high),
@@ -300,7 +302,7 @@ def _args_q12(tables: Tables, mode1: str = "MAIL", mode2: str = "SHIP",
     m1, m2 = li.code("l_shipmode", mode1), li.code("l_shipmode", mode2)
     hi = _lut(orders.dicts["o_orderpriority"],
               lambda s: s in ("1-URGENT", "2-HIGH"))
-    return (n_modes, key_space(li, "l_orderkey"),
+    return (n_modes, P.plan_join(orders, "o_orderkey", li, "l_orderkey"),
             orders["o_orderkey"], orders["o_orderpriority"],
             li["l_orderkey"], li["l_shipmode"], li["l_shipdate"],
             li["l_commitdate"], li["l_receiptdate"], hi, m1, m2,
@@ -380,10 +382,10 @@ def cq13(tables: Tables, word1: str = "special", word2: str = "requests"):
 
 # ---------------------------------------------------------------- Q14
 @functools.partial(jax.jit, static_argnums=(0,))
-def _q14_core(n_pkey, p_key, p_type, l_part, l_ship, l_price, l_disc,
+def _q14_core(jp_part, p_key, p_type, l_part, l_ship, l_price, l_disc,
               promo_lut, a, b):
     mask = (l_ship >= a) & (l_ship < b)
-    pidx, phit = K.pk_fk_join(p_key, l_part, key_space=n_pkey)
+    pidx, phit = K.pk_fk_join(p_key, l_part, plan=jp_part)
     mask = mask & phit
     rev = jnp.where(mask, l_price * (1.0 - l_disc), 0.0)
     is_promo = jnp.take(promo_lut, jnp.take(p_type, pidx))
@@ -394,7 +396,7 @@ def _args_q14(tables: Tables, d0: str = "1995-09-01",
               d1: str = "1995-10-01"):
     li, part = tables["lineitem"], tables["part"]
     promo = _lut(part.dicts["p_type"], lambda s: s.startswith("PROMO"))
-    return (key_space(li, "l_partkey"),
+    return (P.plan_join(part, "p_partkey", li, "l_partkey"),
             part["p_partkey"], part["p_type"], li["l_partkey"],
             li["l_shipdate"], li["l_extendedprice"], li["l_discount"],
             promo, date_to_int(d0), date_to_int(d1))
@@ -409,12 +411,12 @@ def cq14(tables: Tables, d0: str = "1995-09-01", d1: str = "1995-10-01"):
 
 # ---------------------------------------------------------------- Q17
 @functools.partial(jax.jit, static_argnums=(0,))
-def _q17_core(n_part, p_key, p_brand, p_cont, l_part, l_qty, l_price,
+def _q17_core(jp_part, p_key, p_brand, p_cont, l_part, l_qty, l_price,
               brand_code, cont_code):
     part_ok = (p_brand == brand_code) & (p_cont == cont_code)
-    _, phit = K.pk_fk_join(p_key, l_part, part_ok, key_space=n_part)
+    _, phit = K.pk_fk_join(p_key, l_part, part_ok, plan=jp_part)
     qty = l_qty.astype(jnp.float32)
-    avg = K.segment_mean(qty, l_part, n_part, phit)
+    avg = K.segment_mean(qty, l_part, jp_part.key_space, phit)
     small = phit & (qty < 0.2 * jnp.take(avg, l_part))
     return jnp.sum(jnp.where(small, l_price, 0.0)) / 7.0
 
@@ -422,7 +424,8 @@ def _q17_core(n_part, p_key, p_brand, p_cont, l_part, l_qty, l_price,
 def _args_q17(tables: Tables, brand: str = "Brand#23",
               container: str = "MED BOX"):
     li, part = tables["lineitem"], tables["part"]
-    return (key_space(li, "l_partkey"), part["p_partkey"],
+    return (P.plan_join(part, "p_partkey", li, "l_partkey"),
+            part["p_partkey"],
             part["p_brand"], part["p_container"], li["l_partkey"],
             li["l_quantity"], li["l_extendedprice"],
             part.code("p_brand", brand),
@@ -437,14 +440,14 @@ def cq17(tables: Tables, brand: str = "Brand#23", container: str = "MED BOX"):
 
 # ---------------------------------------------------------------- Q22
 @functools.partial(jax.jit, static_argnums=(0, 1))
-def _q22_core(n_pref, n_ckey, c_key, c_phone, c_bal, o_cust, code_lut):
+def _q22_core(n_pref, jp_cust, c_key, c_phone, c_bal, o_cust, code_lut):
     pref = jnp.take(code_lut, c_phone)
     in_pref = pref >= 0
     pos = in_pref & (c_bal > 0)
     avg = (jnp.sum(jnp.where(pos, c_bal, 0.0))
            / jnp.maximum(jnp.sum(pos.astype(jnp.int32)), 1))
     rich = in_pref & (c_bal > avg)
-    has_orders = K.member(o_cust, c_key, key_space=n_ckey)
+    has_orders = K.member(o_cust, c_key, plan=jp_cust)
     sel = rich & ~has_orders
     seg = jnp.clip(pref, 0, n_pref - 1)
     return jnp.stack([K.segment_count(seg, n_pref, sel).astype(jnp.float32),
@@ -469,7 +472,8 @@ def _args_q22(tables: Tables,
                                          "18", "17")):
     cust, orders = tables["customer"], tables["orders"]
     pref_list, code_lut = q22_code_lut(cust.dicts["c_phone"], prefixes)
-    return (len(pref_list), key_space(orders, "o_custkey"),
+    return (len(pref_list),
+            P.plan_join(orders, "o_custkey", cust, "c_custkey"),
             cust["c_custkey"], cust["c_phone"],
             cust["c_acctbal"], orders["o_custkey"], code_lut)
 
@@ -492,9 +496,15 @@ COLUMNAR_QUERIES: Dict[str, Callable] = {
 
 
 def tables_from_rows(data: Dict[str, List[dict]]) -> Tables:
-    """Columnarize ``workloads.tpch.generate()`` output."""
-    return {name: ColumnTable.from_rows(rows)
-            for name, rows in data.items() if rows}
+    """Columnarize ``workloads.tpch.generate()`` output and collect
+    planner statistics at ingest (the reference's StorageCollectStats
+    moment)."""
+    out = {}
+    for name, rows in data.items():
+        if rows:
+            out[name] = ColumnTable.from_rows(rows)
+            analyze_table(out[name])
+    return out
 
 
 # ------------------------------------------------------- fused suite

@@ -44,8 +44,9 @@ from jax.sharding import Mesh, PartitionSpec as P
 from netsdb_tpu.relational import kernels as K
 import re
 
-from netsdb_tpu.relational.queries import (Tables, _lut, key_space,
-                                           q22_code_lut)
+from netsdb_tpu.relational import planner as PLN
+from netsdb_tpu.relational.queries import Tables, _lut, q22_code_lut
+from netsdb_tpu.relational.stats import key_space
 from netsdb_tpu.relational.table import date_to_int
 
 
@@ -235,7 +236,7 @@ def sharded_q12(tables: Tables, mesh: Mesh, axis: str = "data",
     broadcast-join side feeding the priority lookup)."""
     li, orders = tables["lineitem"], tables["orders"]
     n_modes = len(li.dicts["l_shipmode"])
-    n_okey = key_space(li, "l_orderkey")
+    jp_orders = PLN.plan_join(orders, "o_orderkey", li, "l_orderkey")
     m1, m2 = li.code("l_shipmode", mode1), li.code("l_shipmode", mode2)
     hi = _lut(orders.dicts["o_orderpriority"],
               lambda s: s in ("1-URGENT", "2-HIGH"))
@@ -246,7 +247,7 @@ def sharded_q12(tables: Tables, mesh: Mesh, axis: str = "data",
                 & (c["l_commitdate"] < c["l_receiptdate"])
                 & (c["l_shipdate"] < c["l_commitdate"])
                 & (c["l_receiptdate"] >= a) & (c["l_receiptdate"] < b))
-        oidx, ohit = K.pk_fk_join(o_key, c["l_orderkey"], key_space=n_okey)
+        oidx, ohit = K.pk_fk_join(o_key, c["l_orderkey"], plan=jp_orders)
         mask = mask & ohit
         high = jnp.take(hi_lut, jnp.take(o_pri, oidx))
         return jnp.stack([
@@ -292,13 +293,13 @@ def sharded_q14(tables: Tables, mesh: Mesh, axis: str = "data",
                 d1: str = "1995-10-01") -> jax.Array:
     """(promo_revenue, total_revenue): lineitem sharded, part replicated."""
     li, part = tables["lineitem"], tables["part"]
-    n_pkey = key_space(li, "l_partkey")
+    jp_part = PLN.plan_join(part, "p_partkey", li, "l_partkey")
     promo = _lut(part.dicts["p_type"], lambda s: s.startswith("PROMO"))
     a, b = date_to_int(d0), date_to_int(d1)
 
     def local(valid, c, p_key, p_type, promo_lut):
         mask = valid & (c["l_shipdate"] >= a) & (c["l_shipdate"] < b)
-        pidx, phit = K.pk_fk_join(p_key, c["l_partkey"], key_space=n_pkey)
+        pidx, phit = K.pk_fk_join(p_key, c["l_partkey"], plan=jp_part)
         mask = mask & phit
         rev = jnp.where(mask, c["l_extendedprice"] * (1.0 - c["l_discount"]),
                         0.0)
@@ -321,7 +322,8 @@ def sharded_q17(tables: Tables, mesh: Mesh, axis: str = "data",
     psum (the global avg needs every shard's rows), (2) the avg table
     replicated back and the below-avg revenue summed per shard."""
     li, part = tables["lineitem"], tables["part"]
-    n_part = key_space(li, "l_partkey")
+    jp_part = PLN.plan_join(part, "p_partkey", li, "l_partkey")
+    n_part = jp_part.key_space
     brand_code = part.code("p_brand", brand)
     cont_code = part.code("p_container", container)
     li_cols = {k: li.cols[k] for k in ("l_partkey", "l_quantity",
@@ -330,7 +332,7 @@ def sharded_q17(tables: Tables, mesh: Mesh, axis: str = "data",
     def phase1(valid, c, p_key, p_brand, p_cont):
         part_ok = (p_brand == brand_code) & (p_cont == cont_code)
         _, phit = K.pk_fk_join(p_key, c["l_partkey"], part_ok,
-                               key_space=n_part)
+                               plan=jp_part)
         phit = phit & valid
         qty = c["l_quantity"].astype(jnp.float32)
         return (K.segment_sum(qty, c["l_partkey"], n_part, phit),
@@ -345,7 +347,7 @@ def sharded_q17(tables: Tables, mesh: Mesh, axis: str = "data",
     def phase2(valid, c, p_key, p_brand, p_cont, avg_rep):
         part_ok = (p_brand == brand_code) & (p_cont == cont_code)
         _, phit = K.pk_fk_join(p_key, c["l_partkey"], part_ok,
-                               key_space=n_part)
+                               plan=jp_part)
         phit = phit & valid
         qty = c["l_quantity"].astype(jnp.float32)
         small = phit & (qty < 0.2 * jnp.take(avg_rep, c["l_partkey"]))
@@ -407,17 +409,18 @@ def sharded_q03(tables: Tables, mesh: Mesh, axis: str = "data",
     replicated; per-order revenue psum-merged, top-k on the merged
     vector (small) outside the map."""
     cust, orders, li = tables["customer"], tables["orders"], tables["lineitem"]
-    n_orders = key_space(li, "l_orderkey")
-    n_cust = key_space(cust, "c_custkey")
+    jp_orders = PLN.plan_join(orders, "o_orderkey", li, "l_orderkey")
+    jp_cust = PLN.plan_join(cust, "c_custkey", orders, "o_custkey")
+    n_orders = jp_orders.key_space
     seg_code = cust.code("c_mktsegment", segment)
     d = date_to_int(date)
 
     def local(valid, c, c_key, c_seg, o_key, o_cust, o_date):
         cust_ok = c_seg == seg_code
-        _, chit = K.pk_fk_join(c_key, o_cust, cust_ok, key_space=n_cust)
+        _, chit = K.pk_fk_join(c_key, o_cust, cust_ok, plan=jp_cust)
         order_ok = chit & (o_date < d)
         oidx, ohit = K.pk_fk_join(o_key, c["l_orderkey"], order_ok,
-                                  key_space=n_orders)
+                                  plan=jp_orders)
         li_ok = valid & ohit & (c["l_shipdate"] > d)
         rev = c["l_extendedprice"] * (1.0 - c["l_discount"])
         return K.segment_sum(rev, c["l_orderkey"], n_orders, li_ok)
@@ -433,7 +436,7 @@ def sharded_q03(tables: Tables, mesh: Mesh, axis: str = "data",
     # order date lookup for the winners — the same guarded LUT probe as
     # every other join in this module
     oidx, ohit = K.pk_fk_join(orders["o_orderkey"], top_idx,
-                              key_space=n_orders)
+                              plan=jp_orders)
     odate = jnp.where(ohit, jnp.take(orders["o_orderdate"], oidx), 0)
     return top_idx, top_ok, odate, jnp.take(rev, top_idx)
 
@@ -448,10 +451,11 @@ def sharded_q02(tables: Tables, mesh: Mesh, axis: str = "data",
     combine), then a second pmin pass picks the global winner row."""
     part, ps = tables["part"], tables["partsupp"]
     sup, nat, reg = tables["supplier"], tables["nation"], tables["region"]
-    n_part = key_space(ps, "ps_partkey")
-    n_sup = key_space(sup, "s_suppkey")
-    n_nat = key_space(nat, "n_nationkey")
-    n_reg = key_space(reg, "r_regionkey")
+    jp_part = PLN.plan_join(part, "p_partkey", ps, "ps_partkey")
+    jp_sup = PLN.plan_join(sup, "s_suppkey", ps, "ps_suppkey")
+    jp_nat = PLN.plan_join(nat, "n_nationkey", sup, "s_nationkey")
+    jp_reg = PLN.plan_join(reg, "r_regionkey", nat, "n_regionkey")
+    n_part = jp_part.key_space
     type_ok = _lut(part.dicts["p_type"], lambda s: s.endswith(type_suffix))
     region_code = reg.code("r_name", region)
     ps_cols = {q: ps.cols[q] for q in ("ps_partkey", "ps_suppkey",
@@ -465,13 +469,13 @@ def sharded_q02(tables: Tables, mesh: Mesh, axis: str = "data",
                    n_regk, r_key, r_name, tok):
         part_ok = (p_size == size) & jnp.take(tok, p_type)
         _, phit = K.pk_fk_join(p_key, c["ps_partkey"], part_ok,
-                               key_space=n_part)
-        nidx, nhit = K.pk_fk_join(n_key, s_nat, key_space=n_nat)
+                               plan=jp_part)
+        nidx, nhit = K.pk_fk_join(n_key, s_nat, plan=jp_nat)
         sup_region = jnp.take(n_regk, nidx)
-        ridx, rhit = K.pk_fk_join(r_key, sup_region, key_space=n_reg)
+        ridx, rhit = K.pk_fk_join(r_key, sup_region, plan=jp_reg)
         in_region = nhit & rhit & (jnp.take(r_name, ridx) == region_code)
         _, shit = K.pk_fk_join(s_key, c["ps_suppkey"], in_region,
-                               key_space=n_sup)
+                               plan=jp_sup)
         return valid & phit & shit
 
     def phase1(valid, c, *dims_r):

@@ -1,0 +1,110 @@
+"""Per-table / per-column statistics — the planner's input.
+
+The reference collects per-set statistics (page/byte/tuple counts) on
+demand and feeds them to its greedy physical planner
+(``src/queryPlanning/headers/TCAPAnalyzer.h:20-40``; ``Statistics``
+populated via ``StorageCollectStats`` in
+``src/serverFunctionalities/source/QuerySchedulerServer.cc:1332-1420``).
+Here the analogous facts are column-level — row count, key min/max,
+distinct count — because the physical choices they drive are different:
+LUT-vs-sort joins, dense-vs-scatter segment reductions, and
+broadcast-vs-repartition distribution (see
+:mod:`netsdb_tpu.relational.planner`).
+
+Stats are computed host-side in one numpy pass per column and cached on
+the :class:`~netsdb_tpu.relational.table.ColumnTable` instance, so the
+cost is paid once at ingest (loaders call :func:`analyze_table`) and
+every subsequent plan decision is a dict lookup.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Dict, Iterable, Optional
+
+import numpy as np
+
+from netsdb_tpu.relational.table import ColumnTable
+
+_CACHE_ATTR = "_column_stats"
+
+
+@dataclasses.dataclass(frozen=True)
+class ColumnStats:
+    """Host-side facts about one integer column (keys, codes, dates).
+
+    ``n_distinct`` is -1 until someone asks for it: the distinct count
+    needs an O(N log N) sort that no current plan decision consumes, so
+    ingest pays only the O(N) min/max pass
+    (``column_stats(..., distinct=True)`` fills it in).
+    """
+
+    n_rows: int
+    min_val: int
+    max_val: int
+    n_distinct: int = -1
+
+    @property
+    def key_space(self) -> int:
+        """Static dense-key bound: every value lies in
+        ``[0, key_space)``. Clamped to >= 1 so downstream static shapes
+        stay positive for empty or all-negative columns — and so a
+        merged record whose ``max_val`` was widened past its own rows
+        (planner.plan_join covering the probe column) keeps the widened
+        bound."""
+        return max(self.max_val + 1, 1)
+
+    @property
+    def density(self) -> float:
+        """Fraction of the key space actually occupied — the signal that
+        separates dense surrogate keys (dbgen: ~1.0) from sparse ids
+        where a LUT would be mostly padding. Requires the distinct
+        count to have been computed."""
+        if self.n_distinct < 0:
+            raise ValueError("distinct count not computed; use "
+                             "column_stats(table, col, distinct=True)")
+        return self.n_distinct / max(self.key_space, 1)
+
+
+def analyze_array(arr, distinct: bool = False) -> ColumnStats:
+    """Min/max in one O(N) host pass; the sort-based distinct count
+    only when asked for."""
+    a = np.asarray(arr)
+    if a.size == 0:
+        return ColumnStats(0, 0, -1, 0 if distinct else -1)
+    if a.dtype.kind == "b":
+        a = a.astype(np.int32)
+    nd = int(np.unique(a).size) if distinct else -1
+    return ColumnStats(int(a.size), int(a.min()), int(a.max()), nd)
+
+
+def column_stats(table: ColumnTable, col: str,
+                 distinct: bool = False) -> ColumnStats:
+    """Stats for ``table.cols[col]``, cached on the table instance (the
+    same idiom the old per-query ``key_space`` helper used, widened to
+    the full stats record)."""
+    cache: Optional[Dict[str, ColumnStats]] = getattr(table, _CACHE_ATTR,
+                                                      None)
+    if cache is None:
+        cache = {}
+        object.__setattr__(table, _CACHE_ATTR, cache)
+    if col not in cache or (distinct and cache[col].n_distinct < 0):
+        cache[col] = analyze_array(table[col], distinct)
+    return cache[col]
+
+
+def key_space(table: ColumnTable, col: str) -> int:
+    """Static key-space bound (max key + 1) — the group-cardinality
+    metadata every segment reduction needs."""
+    return column_stats(table, col).key_space
+
+
+def analyze_table(table: ColumnTable,
+                  cols: Optional[Iterable[str]] = None) -> Dict[str, ColumnStats]:
+    """Warm the stats cache at ingest. ``cols`` defaults to every
+    integer column (keys, dictionary codes, dates); float measure
+    columns carry no planning signal and are skipped."""
+    if cols is None:
+        cols = [n for n, c in table.cols.items()
+                if np.asarray(c).dtype.kind in "ib"]
+    return {c: column_stats(table, c) for c in cols}

@@ -1,0 +1,210 @@
+"""Measured physical-strategy thresholds, keyed on device kind.
+
+Round-1 froze two crossovers as constants measured once on TPU v5e
+(`_DENSE_SEGMENT_LIMIT = 64`, LUT-always joins). This module makes the
+thresholds a three-level lookup:
+
+1. a persisted autotune file (``$NETSDB_TPU_HOME/autotune.json``),
+   written by :func:`autotune` after actually measuring the crossovers
+   on the live backend;
+2. a built-in table of measured values per device kind;
+3. conservative defaults.
+
+The reference's analogue is the compile-time ``-D`` knobs in
+``SConstruct:67-100`` (batch sizes, join ratios) that its authors
+measured on their cluster and froze; here the same numbers re-measure
+themselves per device generation.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import time
+from typing import Dict, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+# Measured tables. "segment_dense_limit": largest group count where the
+# broadcast-compare dense segment reduce still beats the scatter-add
+# (measured on Q01-shaped data: 6M rows). "join_lut_factor": LUT join
+# wins while key_space <= factor * (build_rows + probe_rows); beyond it
+# the LUT is mostly padding and the sort path's N log N beats the
+# key_space-sized init+scatter. "join_lut_max_bytes": absolute LUT size
+# cap so a pathological key range cannot OOM HBM.
+_MEASURED: Dict[str, Dict[str, float]] = {
+    # v5e, measured via `python -m netsdb_tpu autotune` on the live
+    # chip: scatter serializes on colliding updates (52.6 ms vs ~2 ms at
+    # 12 groups, BASELINE.md); dense loses past G=64 at 1M rows. The
+    # LUT join keeps winning through a 128x-sparse key space (gathers
+    # stream; sort+searchsorted serializes), so only the byte cap
+    # retires it.
+    "TPU v5 lite": {"segment_dense_limit": 64, "join_lut_factor": 128.0,
+                    "join_lut_max_bytes": 1 << 28},
+    # CPU (tests, virtual mesh): XLA's CPU scatter is cheap and the
+    # dense O(N*G) pass loses earlier.
+    "cpu": {"segment_dense_limit": 32, "join_lut_factor": 16.0,
+            "join_lut_max_bytes": 1 << 27},
+}
+
+_DEFAULTS: Dict[str, float] = {
+    "segment_dense_limit": 64,
+    "join_lut_factor": 32.0,
+    "join_lut_max_bytes": 1 << 28,
+}
+
+_cache: Dict[str, Dict[str, float]] = {}
+
+
+def _tuning_path() -> str:
+    root = os.environ.get("NETSDB_TPU_HOME", "/tmp/netsdb_tpu")
+    return os.path.join(root, "autotune.json")
+
+
+def device_kind() -> str:
+    try:
+        return jax.devices()[0].device_kind
+    except Exception:  # no backend yet (import-time use)
+        return "cpu"
+
+
+def _load(kind: str) -> Dict[str, float]:
+    if kind in _cache:
+        return _cache[kind]
+    table = dict(_DEFAULTS)
+    table.update(_MEASURED.get(kind, {}))
+    try:
+        with open(_tuning_path()) as f:
+            persisted = json.load(f)
+        table.update(persisted.get(kind, {}))
+    except (OSError, ValueError):
+        pass
+    _cache[kind] = table
+    return table
+
+
+def get(name: str, kind: Optional[str] = None) -> float:
+    """Threshold ``name`` for ``kind`` (default: the live backend)."""
+    return _load(kind or device_kind())[name]
+
+
+def set_override(name: str, value: float,
+                 kind: Optional[str] = None) -> None:
+    """In-process override (tests force strategies through this).
+
+    Thresholds are read at TRACE time, so already-compiled programs
+    have the old choice baked in — clear jit caches so the next call
+    re-traces under the new threshold.
+    """
+    kind = kind or device_kind()
+    _load(kind)[name] = value
+    jax.clear_caches()
+
+
+def clear_overrides() -> None:
+    _cache.clear()
+    jax.clear_caches()
+
+
+# --------------------------------------------------------------- autotune
+
+def _time_fn(fn, *args, reps: int = 5) -> float:
+    jax.block_until_ready(fn(*args))  # compile + warm
+    t0 = time.perf_counter()
+    for _ in range(reps):
+        out = fn(*args)
+    jax.block_until_ready(out)
+    return (time.perf_counter() - t0) / reps
+
+
+def measure_segment_crossover(n_rows: int = 1 << 20,
+                              candidates=(8, 16, 32, 64, 128, 256, 512),
+                              ) -> int:
+    """Measure the dense-vs-scatter segment-sum crossover on the live
+    backend: the largest G where dense still wins."""
+    from netsdb_tpu.relational import kernels as K
+
+    rng = np.random.default_rng(0)
+    vals = jnp.asarray(rng.standard_normal(n_rows).astype(np.float32))
+    best = 0
+    for g in candidates:
+        seg = jnp.asarray(rng.integers(0, g, n_rows).astype(np.int32))
+
+        def dense(v, s, g=g):
+            return K.segment_sum(v, s, g, method="dense")
+
+        def scatter(v, s, g=g):
+            return K.segment_sum(v, s, g, method="scatter")
+
+        td = _time_fn(jax.jit(dense), vals, seg)
+        ts = _time_fn(jax.jit(scatter), vals, seg)
+        if td <= ts:
+            best = g
+        else:
+            break
+    # best == 0 ⇒ dense lost even at the smallest G: record "never"
+    return best
+
+
+def measure_join_crossover(n_build: int = 1 << 17, n_probe: int = 1 << 19,
+                           factors=(2, 4, 8, 16, 32, 64, 128),
+                           ) -> float:
+    """Measure the LUT-vs-sort join crossover: the largest
+    ``key_space / (build + probe)`` ratio where the LUT still wins."""
+    from netsdb_tpu.relational import kernels as K
+    from netsdb_tpu.relational.planner import JoinPlan
+
+    rng = np.random.default_rng(0)
+    # never probe a LUT bigger than the byte cap the planner enforces —
+    # the probe itself must not OOM measuring the guard
+    cap = _load(device_kind())["join_lut_max_bytes"]
+    factors = [f for f in factors
+               if f * (n_build + n_probe) * 4 <= cap] or [factors[0]]
+    best = float(factors[0])
+    for f in factors:
+        ks = int(f * (n_build + n_probe))
+        pk = jnp.asarray(rng.choice(ks, n_build, replace=False)
+                         .astype(np.int32))
+        fk = jnp.asarray(rng.integers(0, ks, n_probe).astype(np.int32))
+
+        def lut(p, q, ks=ks):
+            return K.pk_fk_join(p, q, plan=JoinPlan("lut", ks))
+
+        def srt(p, q, ks=ks):
+            return K.pk_fk_join(p, q, plan=JoinPlan("sort", ks))
+
+        tl = _time_fn(jax.jit(lut), pk, fk)
+        tsort = _time_fn(jax.jit(srt), pk, fk)
+        if tl <= tsort:
+            best = float(f)
+        else:
+            break
+    return best
+
+
+def autotune(persist: bool = True) -> Dict[str, float]:
+    """Measure both crossovers on the live backend and (optionally)
+    persist them for this device kind. Run via
+    ``python -m netsdb_tpu autotune``."""
+    kind = device_kind()
+    measured = {
+        "segment_dense_limit": float(measure_segment_crossover()),
+        "join_lut_factor": measure_join_crossover(),
+        "join_lut_max_bytes": float(_load(kind)["join_lut_max_bytes"]),
+    }
+    _load(kind).update(measured)
+    jax.clear_caches()  # compiled programs have the old thresholds baked in
+    if persist:
+        path = _tuning_path()
+        os.makedirs(os.path.dirname(path), exist_ok=True)
+        try:
+            with open(path) as f:
+                data = json.load(f)
+        except (OSError, ValueError):
+            data = {}
+        data[kind] = measured
+        with open(path, "w") as f:
+            json.dump(data, f, indent=2, sort_keys=True)
+    return measured

@@ -51,14 +51,7 @@ def test_sharded_q06_matches_local(tables, mesh):
 
 
 def test_sharded_q04_matches_local(tables, mesh):
-    orders, li = tables["orders"], tables["lineitem"]
-    n_pri = len(orders.dicts["o_orderpriority"])
-    expect = np.asarray(Q._q04_core(
-        n_pri, Q.key_space(li, "l_orderkey"),
-        orders["o_orderkey"], orders["o_orderdate"],
-        orders["o_orderpriority"], li["l_orderkey"], li["l_commitdate"],
-        li["l_receiptdate"], Q.date_to_int("1993-07-01"),
-        Q.date_to_int("1993-10-01")))
+    expect = np.asarray(Q._q04_core(*Q._args_q04(tables)))
     got = np.asarray(sharded_q04(tables, mesh))
     np.testing.assert_array_equal(got, expect)
 
@@ -94,18 +87,7 @@ def test_sharded_mesh_shape_invariance(tables, qname):
 
 def test_sharded_q12_matches_local(tables, mesh):
     from netsdb_tpu.relational.sharded import sharded_q12
-    li, orders = tables["lineitem"], tables["orders"]
-    from netsdb_tpu.relational.queries import _lut
-    n_modes = len(li.dicts["l_shipmode"])
-    m1, m2 = li.code("l_shipmode", "MAIL"), li.code("l_shipmode", "SHIP")
-    hi = _lut(orders.dicts["o_orderpriority"],
-              lambda s: s in ("1-URGENT", "2-HIGH"))
-    expect = np.asarray(Q._q12_core(
-        n_modes, Q.key_space(li, "l_orderkey"),
-        orders["o_orderkey"], orders["o_orderpriority"], li["l_orderkey"],
-        li["l_shipmode"], li["l_shipdate"], li["l_commitdate"],
-        li["l_receiptdate"], hi, m1, m2,
-        Q.date_to_int("1994-01-01"), Q.date_to_int("1995-01-01")))
+    expect = np.asarray(Q._q12_core(*Q._args_q12(tables)))
     got = np.asarray(sharded_q12(tables, mesh))
     np.testing.assert_array_equal(got, expect)
 
@@ -134,57 +116,34 @@ def test_sharded_q13_matches_local(tables, mesh):
 
 def test_sharded_q14_matches_local(tables, mesh):
     from netsdb_tpu.relational.sharded import sharded_q14
-    from netsdb_tpu.relational.queries import _lut
-    li, part = tables["lineitem"], tables["part"]
-    promo = _lut(part.dicts["p_type"], lambda s: s.startswith("PROMO"))
-    expect = np.asarray(Q._q14_core(
-        Q.key_space(li, "l_partkey"), part["p_partkey"], part["p_type"],
-        li["l_partkey"], li["l_shipdate"], li["l_extendedprice"],
-        li["l_discount"], promo, Q.date_to_int("1995-09-01"),
-        Q.date_to_int("1995-10-01")))
+    expect = np.asarray(Q._q14_core(*Q._args_q14(tables)))
     got = np.asarray(sharded_q14(tables, mesh))
     np.testing.assert_allclose(got, expect, rtol=1e-5, atol=1e-3)
 
 
 def test_sharded_q17_matches_local(tables, mesh):
     from netsdb_tpu.relational.sharded import sharded_q17
-    li, part = tables["lineitem"], tables["part"]
+    part = tables["part"]
     brand = part.dicts["p_brand"][0]
     cont = part.dicts["p_container"][0]
-    expect = float(Q._q17_core(
-        Q.key_space(li, "l_partkey"), part["p_partkey"], part["p_brand"],
-        part["p_container"], li["l_partkey"], li["l_quantity"],
-        li["l_extendedprice"], part.code("p_brand", brand),
-        part.code("p_container", cont)))
+    expect = float(Q._q17_core(*Q._args_q17(tables, brand, cont)))
     got = float(sharded_q17(tables, mesh, brand=brand, container=cont))
     assert got == pytest.approx(expect, rel=1e-5, abs=1e-3)
 
 
 def test_sharded_q22_matches_local(tables, mesh):
-    from netsdb_tpu.relational.queries import q22_code_lut
     from netsdb_tpu.relational.sharded import sharded_q22
-    cust, orders = tables["customer"], tables["orders"]
     prefixes = ("13", "31", "23", "29", "30", "18", "17")
-    pref_list, code_lut = q22_code_lut(cust.dicts["c_phone"], prefixes)
-    expect = np.asarray(Q._q22_core(
-        len(pref_list), Q.key_space(orders, "o_custkey"),
-        cust["c_custkey"], cust["c_phone"], cust["c_acctbal"],
-        orders["o_custkey"], code_lut))
+    expect = np.asarray(Q._q22_core(*Q._args_q22(tables, prefixes)))
     got = np.asarray(sharded_q22(tables, mesh, prefixes=prefixes))
     np.testing.assert_allclose(got, expect, rtol=1e-5, atol=1e-2)
 
 
 def test_sharded_q03_matches_local(tables, mesh):
     from netsdb_tpu.relational.sharded import sharded_q03
-    cust, orders, li = (tables["customer"], tables["orders"],
-                        tables["lineitem"])
+    cust = tables["customer"]
     seg = cust.dicts["c_mktsegment"][0]
-    ints, rev = Q._q03_core(
-        Q.key_space(li, "l_orderkey"), 10, Q.key_space(cust, "c_custkey"),
-        cust["c_custkey"], cust["c_mktsegment"], orders["o_orderkey"],
-        orders["o_custkey"], orders["o_orderdate"], li["l_orderkey"],
-        li["l_shipdate"], li["l_extendedprice"], li["l_discount"],
-        cust.code("c_mktsegment", seg), Q.date_to_int("1995-03-15"))
+    ints, rev = Q._q03_core(*Q._args_q03(tables, segment=seg))
     ints, rev = np.asarray(ints), np.asarray(rev)
     top_idx, top_ok, odate, grev = sharded_q03(tables, mesh, segment=seg)
     np.testing.assert_array_equal(np.asarray(top_idx), ints[0])
@@ -199,21 +158,12 @@ def test_sharded_q02_matches_local(tables, mesh):
     from netsdb_tpu.relational.sharded import sharded_q02
     from netsdb_tpu.relational.queries import _lut
     part, ps = tables["part"], tables["partsupp"]
-    sup, nat, reg = (tables["supplier"], tables["nation"],
-                     tables["region"])
+    reg = tables["region"]
     size = int(np.asarray(part["p_size"])[0])
     suffix = part.dicts["p_type"][0].split()[-1]
     region = reg.dicts["r_name"][0]
-    n_part = Q.key_space(ps, "ps_partkey")
-    type_ok = _lut(part.dicts["p_type"], lambda s: s.endswith(suffix))
-    ints, cost_min = Q._q02_core(
-        n_part, Q.key_space(sup, "s_suppkey"),
-        Q.key_space(nat, "n_nationkey"), Q.key_space(reg, "r_regionkey"),
-        part["p_partkey"], part["p_size"], part["p_type"],
-        ps["ps_partkey"], ps["ps_suppkey"], ps["ps_supplycost"],
-        sup["s_suppkey"], sup["s_nationkey"], reg["r_regionkey"],
-        reg["r_name"], nat["n_nationkey"], nat["n_regionkey"],
-        type_ok, size, reg.code("r_name", region))
+    ints, cost_min = Q._q02_core(*Q._args_q02(
+        tables, size=size, type_suffix=suffix, region=region))
     ints = np.asarray(ints)
     winner, g_cost = sharded_q02(tables, mesh, size=size,
                                  type_suffix=suffix, region=region)

@@ -1,0 +1,232 @@
+"""Distributed row-output joins (VERDICT round-1 item 3).
+
+The hash-repartition shuffle must yield a *sharded result table* a
+downstream stage can consume — cross-checked against the local columnar
+engine on the virtual 8-device CPU mesh, and invariant to the partition
+count (the reference's pseudo-cluster invariant across serverlist
+sizes).
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from jax.sharding import Mesh
+from jax.experimental.mesh_utils import create_device_mesh
+
+from netsdb_tpu.relational import kernels as K
+from netsdb_tpu.relational import shuffle as S
+from netsdb_tpu.relational.queries import cq03, tables_from_rows
+from netsdb_tpu.workloads import tpch
+
+
+def make_mesh(n):
+    dev = np.array(jax.devices()[:n]).reshape(n)
+    return Mesh(dev, ("data",))
+
+
+@pytest.fixture(scope="module")
+def tables():
+    return tables_from_rows(tpch.generate(scale=2, seed=5))
+
+
+# ----------------------------------------------------- repartition
+def test_hash_repartition_preserves_and_colocates():
+    rng = np.random.default_rng(0)
+    n = 1000
+    keys = rng.integers(0, 400, n).astype(np.int32)
+    vals = rng.standard_normal(n).astype(np.float32)
+    mesh = make_mesh(8)
+    t = S.hash_repartition(mesh, "data",
+                           {"k": jnp.asarray(keys), "v": jnp.asarray(vals)},
+                           "k")
+    S.check_overflow(t)
+    valid = np.asarray(t.valid)
+    k_out = np.asarray(t.cols["k"])[valid]
+    v_out = np.asarray(t.cols["v"])[valid]
+    # every row survived, with its own payload
+    assert k_out.shape[0] == n
+    got = sorted(zip(k_out.tolist(), np.round(v_out, 5).tolist()))
+    want = sorted(zip(keys.tolist(), np.round(vals, 5).tolist()))
+    assert got == want
+    # co-location: shard s only holds keys ≡ s (mod 8)
+    per = t.valid.shape[0] // 8
+    for s in range(8):
+        sl = slice(s * per, (s + 1) * per)
+        ks = np.asarray(t.cols["k"])[sl][np.asarray(t.valid)[sl]]
+        assert np.all(ks % 8 == s)
+
+
+def test_hash_repartition_overflow_detected():
+    # all rows share one key -> one bucket must overflow at slack 1
+    keys = np.zeros(512, np.int32)
+    mesh = make_mesh(8)
+    t = S.hash_repartition(mesh, "data", {"k": jnp.asarray(keys)}, "k",
+                           slack=1.0)
+    assert int(t.overflow) > 0
+    with pytest.raises(ValueError):
+        S.check_overflow(t)
+
+
+# ----------------------------------------------------------- join
+def _oracle_join(bk, bv, pk, bmask):
+    lut = {}
+    for i, key in enumerate(bk):
+        if bmask[i]:
+            lut[int(key)] = bv[i]
+    return [(int(k), lut.get(int(k))) for k in pk]
+
+
+def test_hash_join_matches_oracle():
+    rng = np.random.default_rng(1)
+    nb, npr, ks = 300, 2000, 500
+    bk = rng.permutation(ks)[:nb].astype(np.int32)
+    bv = rng.integers(0, 1000, nb).astype(np.int32)
+    bflag = rng.random(nb) > 0.25
+    pk = rng.integers(0, ks, npr).astype(np.int32)
+    pv = rng.standard_normal(npr).astype(np.float32)
+    mesh = make_mesh(8)
+    t = S.hash_join(mesh, "data",
+                    build={"bk": jnp.asarray(bk), "bv": jnp.asarray(bv),
+                           "bflag": jnp.asarray(bflag)},
+                    build_key="bk",
+                    probe={"pk": jnp.asarray(pk), "pv": jnp.asarray(pv)},
+                    probe_key="pk", key_space=ks,
+                    build_mask_fn=lambda c: c["bflag"])
+    S.check_overflow(t)
+    valid = np.asarray(t.valid)
+    got = sorted(zip(np.asarray(t.cols["pk"])[valid].tolist(),
+                     np.asarray(t.cols["bv"])[valid].tolist(),
+                     [round(float(x), 5)
+                      for x in np.asarray(t.cols["pv"])[valid]]))
+    oracle = _oracle_join(bk, bv, pk, bflag)
+    want = sorted((k, v, round(float(pv[i]), 5))
+                  for i, (k, v) in enumerate(oracle) if v is not None)
+    assert got == want
+
+
+def test_hash_join_downstream_local_aggregate():
+    """The joined sharded table feeds a purely local segment sum whose
+    merged result equals the single-device aggregate — proving the
+    rows really are co-located by key."""
+    rng = np.random.default_rng(2)
+    ks, npr = 64, 4096
+    bk = np.arange(ks, dtype=np.int32)
+    bw = rng.standard_normal(ks).astype(np.float32)
+    pk = rng.integers(0, ks, npr).astype(np.int32)
+    pv = rng.standard_normal(npr).astype(np.float32)
+    mesh = make_mesh(8)
+    t = S.hash_join(mesh, "data",
+                    build={"bk": jnp.asarray(bk), "bw": jnp.asarray(bw)},
+                    build_key="bk",
+                    probe={"pk": jnp.asarray(pk), "pv": jnp.asarray(pv)},
+                    probe_key="pk", key_space=ks)
+    S.check_overflow(t)
+    # local per-shard sums of pv*bw by key (no collective), then
+    # reassemble on host
+    sums = S.segment_sum_by_key(
+        S.ShardedRows({**t.cols,
+                       "prod": t.cols["pv"] * t.cols["bw"]},
+                      t.valid, t.mesh, t.axis, t.overflow),
+        "pk", "prod", ks)
+    local_ks = S.compressed_key_space(ks, 8)
+    sums = np.asarray(sums)
+    got = np.zeros(ks, np.float32)
+    for key in range(ks):
+        got[key] = sums[(key % 8) * local_ks + key // 8]
+    want = np.zeros(ks, np.float32)
+    for i in range(npr):
+        want[pk[i]] += pv[i] * bw[pk[i]]
+    np.testing.assert_allclose(got, want, rtol=1e-4, atol=1e-4)
+
+
+# ---------------------------------------------------------- Q03 rows
+def test_shuffle_q03_matches_local(tables):
+    seg = tables["customer"].dicts["c_mktsegment"][0]
+    want = cq03(tables, segment=seg)
+    got = S.shuffle_q03(tables, make_mesh(8), segment=seg)
+    assert len(got) == len(want)
+    for g, w in zip(got, want):
+        assert g["okey"] == w["okey"]
+        assert g["odate"] == w["odate"]
+        assert g["revenue"] == pytest.approx(w["revenue"], rel=1e-5)
+
+
+def test_shuffle_q03_partition_branch_matches(tables, monkeypatch):
+    """Force the planner's repartition choice for the customer side —
+    the three-way all-shuffle plan must agree with the broadcast plan
+    and the local engine."""
+    from netsdb_tpu.relational import planner as PLN
+
+    seg = tables["customer"].dicts["c_mktsegment"][0]
+    want = cq03(tables, segment=seg)
+    monkeypatch.setattr(
+        PLN, "plan_distribution",
+        lambda *a, **k: PLN.DistPlan("partition"))
+    got = S.shuffle_q03(tables, make_mesh(8), segment=seg)
+    assert len(got) == len(want)
+    for g, w in zip(got, want):
+        assert (g["okey"], g["odate"]) == (w["okey"], w["odate"])
+        assert g["revenue"] == pytest.approx(w["revenue"], rel=1e-5)
+
+
+def test_hash_join_rejects_column_collision():
+    mesh = make_mesh(4)
+    with pytest.raises(ValueError, match="collision"):
+        S.hash_join(mesh, "data",
+                    build={"k": jnp.zeros(8, jnp.int32),
+                           "x": jnp.zeros(8, jnp.int32)},
+                    build_key="k",
+                    probe={"pk": jnp.zeros(8, jnp.int32),
+                           "x": jnp.zeros(8, jnp.int32)},
+                    probe_key="pk", key_space=8)
+
+
+def test_shuffle_q03_partition_count_invariant(tables):
+    seg = tables["customer"].dicts["c_mktsegment"][0]
+    r4 = S.shuffle_q03(tables, make_mesh(4), segment=seg)
+    r8 = S.shuffle_q03(tables, make_mesh(8), segment=seg)
+    assert [r["okey"] for r in r4] == [r["okey"] for r in r8]
+    for a, b in zip(r4, r8):
+        assert a["revenue"] == pytest.approx(b["revenue"], rel=1e-5)
+
+
+def test_distributed_top_k_clamps_small_vectors():
+    # 8 shards x 2 local rows but k=10: must return 10 slots, the 16
+    # real rows first, padding -inf after
+    scores = np.arange(16, dtype=np.float32)
+    mesh = make_mesh(8)
+    vals, keys, ok = S.distributed_top_k(mesh, "data",
+                                         jnp.asarray(scores), 10)
+    assert vals.shape == (10,)
+    assert np.all(np.asarray(ok))  # 16 real rows available
+    assert float(vals[0]) == 15.0
+
+
+def test_programs_are_cached():
+    rng = np.random.default_rng(4)
+    mesh = make_mesh(8)
+    cols = {"k": jnp.asarray(rng.integers(0, 64, 256).astype(np.int32))}
+    S.hash_repartition(mesh, "data", cols, "k")
+    before = S._repartition_prog.cache_info().hits
+    S.hash_repartition(mesh, "data", cols, "k")
+    assert S._repartition_prog.cache_info().hits == before + 1
+
+
+def test_distributed_top_k():
+    rng = np.random.default_rng(3)
+    n = 512  # global positions encode key = local_idx * 8 + shard
+    scores = rng.standard_normal(n).astype(np.float32)
+    mesh = make_mesh(8)
+    vals, keys, ok = S.distributed_top_k(mesh, "data",
+                                         jnp.asarray(scores), 5)
+    per = n // 8
+    decoded = np.empty(n, np.float32)
+    for g in range(n):
+        shard, local = g % 8, g // 8
+        decoded[g] = scores[shard * per + local]
+    order = np.argsort(-decoded)[:5]
+    np.testing.assert_allclose(np.asarray(vals), decoded[order],
+                               rtol=1e-6)
+    np.testing.assert_array_equal(np.asarray(keys), order)
