@@ -1,0 +1,289 @@
+"""Out-of-core relational execution: TPC-H through the paged store.
+
+The reference's PageScanner streams sets bigger than RAM through every
+pipeline — 64 MB pages pinned one at a time, fed to the pipeline
+threads, evicted behind them (``src/storage/headers/PageScanner.h``,
+``PageCircularBuffer.h``). Round 1 wired that streaming to matmul only;
+this module runs the COLUMNAR QUERY ENGINE the same way: fact-table
+columns live as row-chunk pages in the native page store (whose arena
+cap forces spill-to-disk for cold pages), and a query is one compiled
+chunk-step folded over the stream.
+
+The chunk step IS the distributed engine's combiner: a masked partial
+aggregate with a fixed-shape output (``sharded.py`` runs the same
+kernels over shards in SPACE and merges with psum; here the "shards"
+arrive in TIME and merge by accumulation — one compiled program either
+way, so out-of-core answers are bit-comparable to in-memory ones).
+
+Chunks are padded to the fixed page row count, so every chunk reuses
+ONE compiled XLA program (static shapes; the ragged tail rides the
+validity mask like everywhere else in this framework).
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Dict, Iterator, List, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from netsdb_tpu.relational.table import ColumnTable, date_to_int
+from netsdb_tpu.storage.paged import PagedTensorStore
+
+_INT_KINDS = "ib"
+
+
+class PagedColumns:
+    """A relation's columns paged as row-chunks in a PagedTensorStore.
+
+    Integer and float columns pack into two page matrices with a SHARED
+    row blocking, so one stream step yields every column for the same
+    row range (the reference's page layout holds whole objects per page
+    for the same reason). Dictionaries and host metadata stay resident
+    — only bulk column data pages."""
+
+    def __init__(self, store: PagedTensorStore, name: str,
+                 int_names: List[str], float_names: List[str],
+                 num_rows: int, row_block: int,
+                 dicts: Optional[Dict[str, List[str]]] = None):
+        self.store = store
+        self.name = name
+        self.int_names = int_names
+        self.float_names = float_names
+        self.num_rows = num_rows
+        self.row_block = row_block
+        self.dicts = dicts or {}
+
+    # ------------------------------------------------------------ ingest
+    @staticmethod
+    def ingest(store: PagedTensorStore, name: str,
+               cols: Dict[str, np.ndarray],
+               row_block: Optional[int] = None,
+               dicts: Optional[Dict[str, List[str]]] = None,
+               ) -> "PagedColumns":
+        """Page a dict of host columns. ``row_block`` defaults so that
+        one int-matrix page is ~the configured page size."""
+        int_names = sorted(n for n, c in cols.items()
+                           if np.asarray(c).dtype.kind in _INT_KINDS)
+        float_names = sorted(n for n, c in cols.items()
+                             if n not in int_names)
+        lengths = {n: len(np.asarray(c)) for n, c in cols.items()}
+        if len(set(lengths.values())) > 1:
+            raise ValueError(f"ragged columns cannot page together: "
+                             f"{lengths}")
+        num_rows = next(iter(lengths.values()))
+        if row_block is None:
+            width = max(len(int_names) + len(float_names), 1)
+            row_block = max(store.config.page_size_bytes // (4 * width),
+                            1024)
+        row_block = min(row_block, num_rows)
+        if int_names:
+            imat = np.stack([np.asarray(cols[n]).astype(np.int32)
+                             for n in int_names], axis=1)
+            store.put(f"{name}.int", imat, row_block=row_block)
+        if float_names:
+            fmat = np.stack([np.asarray(cols[n]).astype(np.float32)
+                             for n in float_names], axis=1)
+            store.put(f"{name}.float", fmat, row_block=row_block)
+        return PagedColumns(store, name, int_names, float_names,
+                            num_rows, row_block, dicts)
+
+    @staticmethod
+    def from_table(store: PagedTensorStore, name: str, table: ColumnTable,
+                   columns: List[str],
+                   row_block: Optional[int] = None) -> "PagedColumns":
+        cols = {n: np.asarray(table[n]) for n in columns}
+        return PagedColumns.ingest(store, name, cols, row_block,
+                                   dicts={n: d for n, d in
+                                          table.dicts.items()
+                                          if n in columns})
+
+    # ------------------------------------------------------------ stream
+    def stream(self, prefetch: int = 2
+               ) -> Iterator[Tuple[Dict[str, jnp.ndarray], jnp.ndarray]]:
+        """Yield (cols, valid) per chunk, every chunk padded to
+        ``row_block`` rows — the PageScanner loop feeding the compiled
+        chunk step. Ragged tails are masked, never reshaped."""
+        streams = []
+        if self.int_names:
+            streams.append((self.int_names,
+                            self.store.stream_blocks(f"{self.name}.int",
+                                                     prefetch)))
+        if self.float_names:
+            streams.append((self.float_names,
+                            self.store.stream_blocks(
+                                f"{self.name}.float", prefetch)))
+        while True:
+            chunk: Dict[str, np.ndarray] = {}
+            start = n = None
+            done = False
+            for names, it in streams:
+                try:
+                    s0, block = next(it)
+                except StopIteration:
+                    done = True
+                    break
+                if start is None:
+                    start, n = s0, block.shape[0]
+                elif s0 != start or block.shape[0] != n:
+                    raise RuntimeError(
+                        "int/float page streams desynchronized "
+                        f"({s0},{block.shape[0]}) vs ({start},{n})")
+                for j, name in enumerate(names):
+                    chunk[name] = block[:, j]
+            if done:
+                return
+            pad = self.row_block - n
+            if pad:
+                chunk = {k: np.pad(v, (0, pad)) for k, v in chunk.items()}
+            valid = np.arange(self.row_block) < n
+            yield ({k: jnp.asarray(v) for k, v in chunk.items()},
+                   jnp.asarray(valid))
+
+
+# ---------------------------------------------------------------- Q01
+@functools.partial(jax.jit, static_argnums=(0, 1))
+def _q01_fold(n_groups: int, n_ls: int, sums, counts, valid, ship, rf,
+              ls, qty, price, disc, tax, delta):
+    """One page of Q01: the same combiner as ``sharded._q01_local``,
+    accumulated instead of psum'd."""
+    from netsdb_tpu.relational import kernels as K
+
+    mask = valid & (ship <= delta)
+    seg = rf * n_ls + ls
+    qty = qty.astype(jnp.float32)
+    disc_price = price * (1.0 - disc)
+    charge = disc_price * (1.0 + tax)
+    rows = [K.segment_sum(v, seg, n_groups, mask)
+            for v in (qty, price, disc_price, charge, disc)]
+    return sums + jnp.stack(rows), counts + K.segment_count(seg, n_groups,
+                                                            mask)
+
+
+def ooc_q01(pc: PagedColumns, delta_date: str = "1998-09-02"):
+    """Q01 over a paged lineitem — same result structure as
+    ``queries.cq01``. One compiled fold per page; accumulator shape
+    (5, groups) + (groups,) regardless of table size."""
+    n_ls = len(pc.dicts["l_linestatus"])
+    n_groups = len(pc.dicts["l_returnflag"]) * n_ls
+    delta = date_to_int(delta_date)
+    sums = jnp.zeros((5, n_groups), jnp.float32)
+    counts = jnp.zeros((n_groups,), jnp.int32)
+    for cols, valid in pc.stream():
+        sums, counts = _q01_fold(
+            n_groups, n_ls, sums, counts, valid, cols["l_shipdate"],
+            cols["l_returnflag"], cols["l_linestatus"],
+            cols["l_quantity"], cols["l_extendedprice"],
+            cols["l_discount"], cols["l_tax"], delta)
+    sums, counts = jax.device_get((sums, counts))
+    names = ("sum_qty", "sum_base_price", "sum_disc_price", "sum_charge",
+             "sum_disc")
+    out = []
+    for g in range(n_groups):
+        cnt = int(counts[g])
+        if cnt == 0:
+            continue
+        key = (pc.dicts["l_returnflag"][g // n_ls],
+               pc.dicts["l_linestatus"][g % n_ls])
+        v = {names[i]: float(sums[i, g]) for i in range(5)}
+        v["count"] = cnt
+        v["avg_qty"] = v["sum_qty"] / cnt
+        v["avg_price"] = v["sum_base_price"] / cnt
+        v["avg_disc"] = v["sum_disc"] / cnt
+        out.append((key, v))
+    out.sort(key=lambda kv: kv[0])
+    return out
+
+
+# ---------------------------------------------------------------- Q06
+@jax.jit
+def _q06_fold(acc, valid, ship, discount, quantity, price, a, b, disc,
+              qty):
+    mask = (valid & (ship >= a) & (ship < b)
+            & (discount >= disc - 0.011) & (discount <= disc + 0.011)
+            & (quantity < qty))
+    return acc + jnp.sum(jnp.where(mask, price * discount, 0.0))
+
+
+def ooc_q06(pc: PagedColumns, d0: str = "1994-01-01",
+            d1: str = "1995-01-01", disc: float = 0.06, qty: int = 24):
+    """Q06 over a paged lineitem — same result as ``queries.cq06``."""
+    acc = jnp.zeros((), jnp.float32)
+    a, b = date_to_int(d0), date_to_int(d1)
+    for cols, valid in pc.stream():
+        acc = _q06_fold(acc, valid, cols["l_shipdate"],
+                        cols["l_discount"], cols["l_quantity"],
+                        cols["l_extendedprice"], a, b, disc, qty)
+    return [("revenue", float(acc))]
+
+
+Q01_COLUMNS = ["l_shipdate", "l_returnflag", "l_linestatus",
+               "l_quantity", "l_extendedprice", "l_discount", "l_tax"]
+Q06_COLUMNS = ["l_shipdate", "l_discount", "l_quantity",
+               "l_extendedprice"]
+
+
+def bench_out_of_core(rows: int = 60_000_000,
+                      pool_bytes: int = 1 << 30,
+                      row_block: Optional[int] = None,
+                      seed: int = 0) -> Dict[str, object]:
+    """SF10-scale synthetic lineitem (60M rows ≈ SF10's 59.99M) through
+    q01+q06 under a pool cap far smaller than the table — the
+    PageScanner larger-than-memory proof, measured. Verifies against an
+    in-memory numpy oracle on the same data."""
+    import time
+
+    from netsdb_tpu.config import Configuration
+
+    rng = np.random.default_rng(seed)
+    cols = {
+        "l_shipdate": rng.integers(19920101, 19981231, rows,
+                                   dtype=np.int32),
+        "l_returnflag": rng.integers(0, 3, rows, dtype=np.int32),
+        "l_linestatus": rng.integers(0, 2, rows, dtype=np.int32),
+        "l_quantity": rng.integers(1, 51, rows,
+                                   dtype=np.int32).astype(np.float32),
+        "l_extendedprice": rng.uniform(1000, 100000,
+                                       rows).astype(np.float32),
+        "l_discount": rng.uniform(0, 0.1, rows).astype(np.float32),
+        "l_tax": rng.uniform(0, 0.08, rows).astype(np.float32),
+    }
+    table_bytes = sum(c.nbytes for c in cols.values())
+    import tempfile
+
+    cfg = Configuration(root_dir=tempfile.mkdtemp(prefix="ooc_bench_"))
+    store = PagedTensorStore(cfg, pool_bytes=pool_bytes)
+    t0 = time.perf_counter()
+    pc = PagedColumns.ingest(store, "lineitem", cols, row_block=row_block,
+                             dicts={"l_returnflag": ["A", "N", "R"],
+                                    "l_linestatus": ["F", "O"]})
+    ingest_s = time.perf_counter() - t0
+
+    t0 = time.perf_counter()
+    r01 = ooc_q01(pc)
+    q01_s = time.perf_counter() - t0
+    t0 = time.perf_counter()
+    r06 = ooc_q06(pc)
+    q06_s = time.perf_counter() - t0
+
+    # spot-verify q06 against a numpy oracle on the same host columns
+    a, b = date_to_int("1994-01-01"), date_to_int("1995-01-01")
+    m = ((cols["l_shipdate"] >= a) & (cols["l_shipdate"] < b)
+         & (cols["l_discount"] >= 0.06 - 0.011)
+         & (cols["l_discount"] <= 0.06 + 0.011)
+         & (cols["l_quantity"] < 24))
+    oracle = float((cols["l_extendedprice"][m]
+                    * cols["l_discount"][m]).sum(dtype=np.float64))
+    rel_err = abs(r06[0][1] - oracle) / max(abs(oracle), 1e-9)
+
+    out = {"rows": rows, "table_bytes": table_bytes,
+           "pool_bytes": pool_bytes,
+           "pool_fraction": round(pool_bytes / table_bytes, 3),
+           "ingest_s": round(ingest_s, 2),
+           "q01_s": round(q01_s, 2), "q06_s": round(q06_s, 2),
+           "q01_groups": len(r01), "q06_rel_err": rel_err,
+           "store_stats": store.stats(), "native": store.native}
+    store.close()
+    return out

@@ -110,17 +110,32 @@ class DistPlan(NamedTuple):
 # is chosen for sides that fit one SharedHashSet,
 # src/serverFunctionalities/source/HermesExecutionServer.cc:172-369).
 _BROADCAST_HBM_FRACTION = 0.10
-_DEFAULT_DEVICE_BYTES = 16 * 1024**3  # v5e HBM
+
+
+def device_memory_bytes() -> int:
+    """Per-device memory for distribution planning: the live backend's
+    own number when it reports one, else the per-device-kind table."""
+    try:
+        import jax
+
+        stats = jax.local_devices()[0].memory_stats()
+        if stats and stats.get("bytes_limit"):
+            return int(stats["bytes_limit"])
+    except Exception:
+        pass
+    return int(tuning.get("device_hbm_bytes"))
 
 
 def plan_distribution(build_bytes: int, n_devices: int,
-                      device_bytes: int = _DEFAULT_DEVICE_BYTES,
+                      device_bytes: Optional[int] = None,
                       ) -> DistPlan:
     """Broadcast-vs-repartition: replicating costs ``build_bytes`` on
     EVERY device plus one all-gather; repartitioning moves each row once
     but needs the all-to-all machinery. Broadcast wins while the build
     side is small relative to HBM (dimension tables); repartition when
     both sides are fact-scale."""
+    if device_bytes is None:
+        device_bytes = device_memory_bytes()
     if build_bytes <= _BROADCAST_HBM_FRACTION * device_bytes:
         return DistPlan("broadcast")
     return DistPlan("partition")

@@ -122,6 +122,9 @@ def hash_repartition(mesh: Mesh, axis: str,
     re-shuffled — its padding rows must not travel, or their sentinel
     keys pile into one bucket).
     """
+    if "__valid__" in cols:
+        raise ValueError("column name '__valid__' is reserved by "
+                         "hash_repartition (internal validity mask)")
     n_shards = mesh.shape[axis]
     payload = dict(cols)
     if valid is not None:
@@ -201,12 +204,15 @@ def hash_join(mesh: Mesh, axis: str,
     b = hash_repartition(mesh, axis, build, build_key, slack, build_valid)
     p = hash_repartition(mesh, axis, probe, probe_key, slack, probe_valid)
     local_ks = compressed_key_space(key_space, n_shards)
-    # honor the planner's LUT byte cap per shard: a sparse/giant key
-    # space falls back to the sort-based probe instead of OOMing HBM
-    if local_ks * 4 <= tuning.get("join_lut_max_bytes"):
-        jp = JoinPlan("lut", local_ks)
-    else:
-        jp = JoinPlan("sort", local_ks)
+    # the per-shard join strategy comes from the SAME cost model as the
+    # single-chip planner (tuned LUT density factor + byte cap), fed
+    # per-shard row counts and the compressed key space
+    from netsdb_tpu.relational.planner import plan_join_from_stats
+    from netsdb_tpu.relational.stats import ColumnStats
+
+    local_build = ColumnStats(b.rows_per_shard, 0, local_ks - 1, -1)
+    jp = plan_join_from_stats(local_build, p.rows_per_shard)
+    jp = JoinPlan(jp.strategy, local_ks)
     fn = _join_prog(mesh, axis, tuple(sorted(b.cols)),
                     tuple(sorted(p.cols)), build_key, probe_key, jp,
                     n_shards, build_mask_fn)

@@ -46,13 +46,18 @@ _MEASURED: Dict[str, Dict[str, float]] = {
     # CPU (tests, virtual mesh): XLA's CPU scatter is cheap and the
     # dense O(N*G) pass loses earlier.
     "cpu": {"segment_dense_limit": 32, "join_lut_factor": 16.0,
-            "join_lut_max_bytes": 1 << 27},
+            "join_lut_max_bytes": 1 << 27,
+            "device_hbm_bytes": 4 * 1024**3},
 }
 
 _DEFAULTS: Dict[str, float] = {
     "segment_dense_limit": 64,
     "join_lut_factor": 32.0,
     "join_lut_max_bytes": 1 << 28,
+    # fallback per-device memory for broadcast-vs-repartition planning
+    # when the backend reports no bytes_limit (v5e HBM; the cpu entry
+    # models a test-mesh host share)
+    "device_hbm_bytes": 16 * 1024**3,
 }
 
 _cache: Dict[str, Dict[str, float]] = {}
@@ -161,8 +166,10 @@ def measure_join_crossover(n_build: int = 1 << 17, n_probe: int = 1 << 19,
     # the probe itself must not OOM measuring the guard
     cap = _load(device_kind())["join_lut_max_bytes"]
     factors = [f for f in factors
-               if f * (n_build + n_probe) * 4 <= cap] or [factors[0]]
-    best = float(factors[0])
+               if f * (n_build + n_probe) * 4 <= cap]
+    if not factors:  # every probe would breach the cap: LUT never legal
+        return 0.0
+    best = 0.0  # stays 0 if the LUT never wins, recording "sort always"
     for f in factors:
         ks = int(f * (n_build + n_probe))
         pk = jnp.asarray(rng.choice(ks, n_build, replace=False)

@@ -70,3 +70,51 @@ def bench_attention(seq_lens: Sequence[int] = (1024, 2048, 4096, 8192),
             entry["flash_speedup"] = round(n_ms / f_ms, 2)
         out[f"seq_{s}"] = entry
     return out
+
+
+def bench_ring_fold(n_chunks: int = 8, s_local: int = 1024,
+                    batch: int = 2, heads: int = 8, head_dim: int = 128,
+                    seed: int = 0) -> Dict[str, object]:
+    """Per-device ring-attention compute: chain ``n_chunks`` flash-carry
+    folds (``ops.pallas_kernels.flash_attention_step``) — the causal
+    worst-case device's work at S = n_chunks * s_local over n_chunks
+    shards, minus the ICI rotation (unmeasurable single-chip). Reports
+    actual (un-halved) FLOP throughput, comparable against the flash
+    single-chip number times (live_blocks/total_halved_blocks)."""
+    from netsdb_tpu.ops.pallas_kernels import NEG_INF, flash_attention_step
+
+    rng = np.random.default_rng(seed)
+    bh = batch * heads
+    q = jnp.asarray(rng.standard_normal((bh, s_local, head_dim)),
+                    jnp.bfloat16)
+    ks = jnp.asarray(rng.standard_normal((bh, n_chunks * s_local,
+                                          head_dim)), jnp.bfloat16)
+    vs = jnp.asarray(rng.standard_normal((bh, n_chunks * s_local,
+                                          head_dim)), jnp.bfloat16)
+
+    @jax.jit
+    def folded(q, ks, vs):
+        acc = jnp.zeros(q.shape, jnp.float32)
+        l = jnp.zeros((bh, s_local, 128), jnp.float32)
+        m = jnp.full((bh, s_local, 128), NEG_INF, jnp.float32)
+        for i in range(n_chunks):
+            acc, l, m = flash_attention_step(
+                q, ks[:, i * s_local:(i + 1) * s_local],
+                vs[:, i * s_local:(i + 1) * s_local], acc, l, m,
+                q_offset=(n_chunks - 1) * s_local, k_offset=i * s_local)
+        return (acc / jnp.maximum(l[:, :, :1], 1e-30)).astype(q.dtype)
+
+    @partial(jax.jit, static_argnums=1)
+    def loop(qq, n):
+        def step(c, _):
+            o = folded(qq + c, ks, vs)
+            return (jnp.sum(o) * 1e-20).astype(qq.dtype), None
+        c, _ = jax.lax.scan(step, jnp.zeros((), qq.dtype), None, length=n)
+        return c
+
+    res = scan_slope_seconds(lambda n: float(loop(q, n)), lo=4, hi=16)
+    flops = n_chunks * 2 * 2 * bh * s_local * s_local * head_dim
+    dt = res["seconds_per_iter"]
+    return {"n_chunks": n_chunks, "s_local": s_local,
+            "ms": round(dt * 1e3, 3),
+            "tflops_actual": round(flops / dt / 1e12, 1)}

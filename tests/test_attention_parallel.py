@@ -69,6 +69,25 @@ class TestRingAttention:
         # output keeps the sequence sharding
         assert out.sharding.spec == P(None, None, "sp", None)
 
+    @pytest.mark.parametrize("causal", [True, False])
+    def test_flash_fold_matches_naive(self, seq_mesh, causal):
+        """The pallas flash-carry ring (interpret mode on CPU) must
+        agree with both the naive ring fold and single-device
+        attention — lane-aligned shapes so the real-TPU path shape
+        constraints are honored."""
+        q, k, v = qkv(b=1, h=2, s=8 * 128, d=128)
+        expect = attention(q, k, v, causal=causal)
+        spec = NamedSharding(seq_mesh, P(None, None, "sp", None))
+        qs, ks, vs = (jax.device_put(t, spec) for t in (q, k, v))
+        out = ring_attention(qs, ks, vs, seq_mesh, axis="sp",
+                             causal=causal, impl="flash")
+        np.testing.assert_allclose(np.asarray(out), np.asarray(expect),
+                                   rtol=1e-4, atol=1e-4)
+        naive = ring_attention(qs, ks, vs, seq_mesh, axis="sp",
+                               causal=causal, impl="naive")
+        np.testing.assert_allclose(np.asarray(out), np.asarray(naive),
+                                   rtol=1e-4, atol=1e-4)
+
     def test_long_sequence_jit_end_to_end(self, seq_mesh):
         """jit(ring_attention) over a longer sequence — the compile path
         the dryrun exercises."""

@@ -71,3 +71,38 @@ def test_dispatch_explicit_impls_agree():
                                rtol=1e-5, atol=1e-5)
     with pytest.raises(ValueError, match="unknown attention impl"):
         attention_dispatch(q, k, v, impl="bogus")
+
+
+@pytest.mark.parametrize("causal", [True, False])
+def test_carry_step_chain_matches_flash(causal):
+    """Folding a sequence chunk-by-chunk through flash_attention_step
+    must reproduce flash_attention over the whole sequence — the two
+    kernels share _fold_block, and this pins them together."""
+    from netsdb_tpu.ops.pallas_kernels import NEG_INF, flash_attention_step
+
+    rng = np.random.default_rng(5)
+    bh, n_chunks, sl, d = 4, 4, 128, 128
+    s = n_chunks * sl
+    q = jnp.asarray(rng.standard_normal((bh, s, d)), jnp.float32)
+    k = jnp.asarray(rng.standard_normal((bh, s, d)), jnp.float32)
+    v = jnp.asarray(rng.standard_normal((bh, s, d)), jnp.float32)
+
+    whole = flash_attention(q.reshape(1, bh, s, d), k.reshape(1, bh, s, d),
+                            v.reshape(1, bh, s, d),
+                            causal=causal).reshape(bh, s, d)
+
+    outs = []
+    for qi in range(n_chunks):  # each device's queries in the ring
+        qc = q[:, qi * sl:(qi + 1) * sl]
+        acc = jnp.zeros(qc.shape, jnp.float32)
+        l = jnp.zeros((bh, sl, 128), jnp.float32)
+        m = jnp.full((bh, sl, 128), NEG_INF, jnp.float32)
+        for ki in range(n_chunks):  # arriving k/v chunks
+            acc, l, m = flash_attention_step(
+                qc, k[:, ki * sl:(ki + 1) * sl],
+                v[:, ki * sl:(ki + 1) * sl], acc, l, m,
+                q_offset=qi * sl, k_offset=ki * sl, causal=causal)
+        outs.append(acc / jnp.maximum(l[:, :, :1], 1e-30))
+    got = jnp.concatenate(outs, axis=1)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(whole),
+                               rtol=1e-5, atol=1e-5)
