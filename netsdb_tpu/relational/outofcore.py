@@ -286,4 +286,7 @@ def bench_out_of_core(rows: int = 60_000_000,
            "q01_groups": len(r01), "q06_rel_err": rel_err,
            "store_stats": store.stats(), "native": store.native}
     store.close()
+    import shutil
+
+    shutil.rmtree(cfg.root_dir, ignore_errors=True)  # spilled pages
     return out

@@ -206,12 +206,15 @@ def hash_join(mesh: Mesh, axis: str,
     local_ks = compressed_key_space(key_space, n_shards)
     # the per-shard join strategy comes from the SAME cost model as the
     # single-chip planner (tuned LUT density factor + byte cap), fed
-    # per-shard row counts and the compressed key space
+    # REAL per-shard row counts (from the pre-shuffle inputs — the
+    # post-shuffle buckets are slack-padded) and the compressed key space
     from netsdb_tpu.relational.planner import plan_join_from_stats
     from netsdb_tpu.relational.stats import ColumnStats
 
-    local_build = ColumnStats(b.rows_per_shard, 0, local_ks - 1, -1)
-    jp = plan_join_from_stats(local_build, p.rows_per_shard)
+    nb = next(iter(build.values())).shape[0] // n_shards + 1
+    npr = next(iter(probe.values())).shape[0] // n_shards + 1
+    local_build = ColumnStats(nb, 0, local_ks - 1, -1)
+    jp = plan_join_from_stats(local_build, npr)
     jp = JoinPlan(jp.strategy, local_ks)
     fn = _join_prog(mesh, axis, tuple(sorted(b.cols)),
                     tuple(sorted(p.cols)), build_key, probe_key, jp,

@@ -155,6 +155,20 @@ def _time_features(utc: int) -> List[float]:
     ]
 
 
+def body_hash_counts(body: str,
+                     hash_dim: int = DEFAULT_HASH_FEATURES) -> np.ndarray:
+    """Body text → term-count buckets (hash_dim - 9 wide; 9 slots are
+    taken by the second time-feature set). crc32, not hash(): per-
+    process salting would make features differ across runs and break
+    stored-set reproducibility. Shared by the scalar path here and the
+    columnar ingest (``reddit_columnar.columnarize``) so the two
+    feature pipelines cannot drift."""
+    counts = np.zeros(hash_dim - 9, np.float32)
+    for w in body.split():
+        counts[zlib.crc32(w.encode()) % (hash_dim - 9)] += 1.0
+    return counts
+
+
 def comment_features(c: Comment,
                      hash_dim: int = DEFAULT_HASH_FEATURES) -> np.ndarray:
     """Comment → dense feature vector. The reference emits author-time
@@ -174,11 +188,7 @@ def comment_features(c: Comment,
         float(c.stickied),
         math.tanh(len(c.body) / 256.0),
     ]
-    body = np.zeros(hash_dim - 9, np.float32)  # 9 slots used by 2nd time set
-    for w in c.body.split():
-        # crc32, not hash(): per-process salting would make features
-        # differ across runs and break stored-set reproducibility
-        body[zlib.crc32(w.encode()) % (hash_dim - 9)] += 1.0
+    body = body_hash_counts(c.body, hash_dim)
     vec = np.concatenate([
         np.asarray(feats, np.float32),
         np.asarray(numeric, np.float32),
