@@ -365,6 +365,9 @@ def _cmd_serve_bench(args) -> int:
 
 
 def main(argv=None) -> int:
+    from netsdb_tpu.config import enable_compilation_cache
+
+    enable_compilation_cache()  # every CLI path shares the plan cache
     parser = argparse.ArgumentParser(prog="netsdb_tpu")
     sub = parser.add_subparsers(dest="cmd", required=True)
 

@@ -67,9 +67,30 @@ class Client:
         del address, token  # consumed by __new__ (RemoteClient path)
         self.config = config
         config.ensure_dirs()
+        from netsdb_tpu.config import enable_compilation_cache
+
+        enable_compilation_cache(config)  # PreCompiledWorkload analogue
         self.catalog = Catalog(catalog_path or ":memory:")
         self.store = SetStore(config)
         self._mesh = None  # set by parallel helpers when distributed
+        self._advisor = None  # Lachesis-lite (set_placement_advisor)
+        self._advisor_key = "default"
+        self._advisor_arm = None  # arm applied by this session's DDL
+
+    # --- self-learning placement (Lachesis) ---------------------------
+    def set_placement_advisor(self, advisor, key: str = "default") -> None:
+        """Install a :class:`~netsdb_tpu.learning.advisor.PlacementAdvisor`
+        the DDL and query paths consult — the reference's self-learning
+        hooks at set creation and scheduling
+        (``QuerySchedulerServer.cc:246-330``, dispatcher placement
+        optimizers). ``key`` names the workload whose measured history
+        drives set placement: ``create_set`` picks block shape from the
+        best-known arm for ``key``, and ``execute_computations`` runs
+        each job under the advisor's choice for that job, recording
+        elapsed time back to the history DB — the reference's
+        first-run-slow, later-runs-fast loop (documentation.md:5-10)."""
+        self._advisor = advisor
+        self._advisor_key = key
 
     # --- DDL ----------------------------------------------------------
     def create_database(self, db: str) -> None:
@@ -92,6 +113,21 @@ class Client:
         meta: Dict[str, Any] = {}
         if partition_lambda:
             meta["partition_lambda"] = partition_lambda
+        if self._advisor is not None and type_name == "tensor":
+            # live Lachesis decision: the chosen placement (block shape
+            # = the reference's page-size knob) lands in the catalog and
+            # the history DB, and send_matrix defaults to it. Decision
+            # rows live under "<key>:decisions" so they audit the live
+            # choices without polluting the reward means.
+            cand = self._advisor.choose(self._advisor_key)
+            meta["placement"] = cand.label
+            if "block" in cand.specs:
+                meta["block_shape"] = list(cand.specs["block"])
+            self._advisor_arm = cand  # the placement actually in force
+            self._advisor.db.record(f"{self._advisor_key}:decisions",
+                                    plan_key=f"set:{db}.{set_name}",
+                                    elapsed_s=0.0,
+                                    config_label=cand.label)
         self.catalog.create_set(db, set_name, type_name, meta, persistence)
         ident = _ident(db, set_name)
         self.store.create_set(ident, persistence=persistence, eviction=eviction)
@@ -128,7 +164,16 @@ class Client:
     ) -> BlockedTensor:
         """Load a dense matrix as one blocked tensor into a set — the
         analogue of ``FFMatrixUtil::load_matrix`` generating a
-        ``Vector<Handle<FFMatrixBlock>>`` and sendData'ing it."""
+        ``Vector<Handle<FFMatrixBlock>>`` and sendData'ing it.
+
+        Block shape resolution: explicit argument > the set's
+        advisor-chosen placement (catalog meta, written by
+        ``create_set`` under a PlacementAdvisor) > config default."""
+        if block_shape is None:
+            info = self.catalog.get_set(db, set_name)
+            placed = (info or {}).get("meta", {}).get("block_shape")
+            if placed:
+                block_shape = tuple(placed)
         block_shape = block_shape or self.config.default_block_shape
         t = BlockedTensor.from_dense(dense, block_shape, dtype=dtype)
         ident = _ident(db, set_name)
@@ -171,9 +216,26 @@ class Client:
         """Plan + run a Computation DAG — ``QueryClient::executeComputations``
         (reference ``src/queries/headers/QueryClient.h:160-224``) without the
         client→master RPC hop. ``sinks`` are Write computations from
-        :mod:`netsdb_tpu.plan.computations`."""
+        :mod:`netsdb_tpu.plan.computations`.
+
+        With a placement advisor installed, the job's elapsed time is
+        recorded against the arm whose placement this session's DDL
+        actually APPLIED (``create_set`` stashes it) — never against an
+        arm that was merely chosen, so per-arm means measure real
+        physical configurations (the scheduler-side self-learning hook,
+        ``QuerySchedulerServer.cc:246-330``)."""
         from netsdb_tpu.plan.executor import execute_computations
 
+        if self._advisor is not None and self._advisor_arm is not None:
+            from netsdb_tpu.learning.history import set_config_label
+
+            set_config_label(self._advisor_arm.label)
+            try:
+                return execute_computations(self, list(sinks),
+                                            job_name=job_name,
+                                            materialize=materialize)
+            finally:
+                set_config_label("")  # no stale-arm tagging
         return execute_computations(self, list(sinks), job_name=job_name,
                                     materialize=materialize)
 

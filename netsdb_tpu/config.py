@@ -49,6 +49,18 @@ class Configuration:
     enable_compression: bool = True  # host spill compression (ref -DENABLE_COMPRESSION)
     log_level: str = "WARNING"
 
+    # --- persistent XLA compilation cache (reference: the master's
+    # PreCompiledWorkload plan cache, src/queryPlanning/headers/
+    # PreCompiledWorkload.h — here the cache holds compiled XLA
+    # executables keyed by HLO hash, shared across processes, so a
+    # fresh process reaches steady state without a cold compile) ---
+    # "auto" = <root_dir>/compile_cache; None/"" disables. The env var
+    # NETSDB_TPU_COMPILE_CACHE seeds this default (an explicitly passed
+    # value wins over it, like every other dataclass field).
+    compilation_cache_dir: Optional[str] = dataclasses.field(
+        default_factory=lambda: os.environ.get(
+            "NETSDB_TPU_COMPILE_CACHE", "auto"))
+
     @property
     def catalog_path(self) -> str:
         return os.path.join(self.root_dir, "catalog.sqlite")
@@ -59,6 +71,38 @@ class Configuration:
 
     def ensure_dirs(self) -> None:
         os.makedirs(self.data_dir, exist_ok=True)
+
+
+_cache_path: Optional[str] = None
+
+
+def enable_compilation_cache(config: "Configuration" = None) -> Optional[str]:
+    """Point jax at the persistent compilation cache. Re-entrant: a
+    later call with a DIFFERENT resolved directory (e.g. a Client built
+    with an explicit root after the CLI enabled the default) re-points
+    jax's global cache there; ``compilation_cache_dir=None`` disables.
+    Returns the active directory or None."""
+    global _cache_path
+    cfg = config or DEFAULT_CONFIG
+    path = cfg.compilation_cache_dir
+    if path == "auto":
+        path = os.path.join(cfg.root_dir, "compile_cache")
+    if path == _cache_path:
+        return path
+    import jax
+
+    if not path:
+        jax.config.update("jax_compilation_cache_dir", None)
+        _cache_path = None
+        return None
+    os.makedirs(path, exist_ok=True)
+    jax.config.update("jax_compilation_cache_dir", path)
+    # cache everything: the queries this framework compiles are
+    # worth persisting even when individually quick to build
+    jax.config.update("jax_persistent_cache_min_compile_time_secs", 0)
+    jax.config.update("jax_persistent_cache_min_entry_size_bytes", 0)
+    _cache_path = path
+    return path
 
 
 DEFAULT_CONFIG = Configuration()

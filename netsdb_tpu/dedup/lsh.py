@@ -1,0 +1,233 @@
+"""LSH index over weight-block signatures — sub-quadratic near-dup
+detection across a model zoo.
+
+The reference's offline dedup tooling builds an LSH index so
+near-duplicate block discovery across N models is not O(N²) pairwise
+(``model-inference/deduplication/indexing/deduplicator.py``,
+``indexer.py``). Round 1 shipped exact + quantized fingerprints only
+(``dedup/detector.py``) — right for two models, wrong shape for a zoo.
+
+TPU-native design: signatures are random-hyperplane bits (SimHash) —
+``sign(blocks @ R)`` — computed for EVERY block of a model in ONE
+device matmul (the MXU does the hashing), then banded on the host:
+b bands of r bits each; two blocks collide if any band matches, so for
+similarity s the detection probability is 1-(1-s^r)^b (the standard
+S-curve). Candidate pairs are then verified by signature Hamming
+distance (and can be confirmed bit-exactly via detector fingerprints).
+"""
+
+from __future__ import annotations
+
+import collections
+from typing import Dict, Iterable, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from netsdb_tpu.core.blocked import BlockedTensor
+
+BlockRef = Tuple[str, tuple]  # (model name, block index)
+
+
+def _projection(n_features: int, n_bits: int, seed: int) -> np.ndarray:
+    rng = np.random.default_rng(seed)
+    return rng.standard_normal((n_features, n_bits)).astype(np.float32)
+
+
+_proj_cache: Dict[Tuple[int, int, int], object] = {}
+
+
+def _device_projection(n_features: int, n_bits: int, seed: int):
+    """The projection matrix is tens of MB at weight-block sizes;
+    cache it ON DEVICE so indexing N models uploads it once, not N
+    times (over a tunnel that upload dominates everything else)."""
+    key = (n_features, n_bits, seed)
+    if key not in _proj_cache:
+        import jax.numpy as jnp
+
+        _proj_cache[key] = jnp.asarray(_projection(n_features, n_bits,
+                                                   seed))
+    return _proj_cache[key]
+
+
+def _sign_bits(f, p):
+    import jax
+    import jax.numpy as jnp
+
+    return (jax.lax.dot_general(
+        f, p, (((1,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32) >= 0)
+
+
+_sign_bits_jit = None
+
+
+def block_signatures(tensor: BlockedTensor, n_bits: int = 128,
+                     seed: int = 0) -> Tuple[List[tuple], np.ndarray]:
+    """All block signatures of one tensor in one device matmul:
+    (block indices, (n_blocks, n_bits) uint8 bit matrix). The jitted
+    kernel is module-level so indexing N same-shaped models compiles
+    once, not N times."""
+    global _sign_bits_jit
+    import jax
+    import jax.numpy as jnp
+
+    if _sign_bits_jit is None:
+        _sign_bits_jit = jax.jit(_sign_bits)
+    idxs, blocks = zip(*list(tensor.blocks()))
+    flat = jnp.stack([b.reshape(-1) for b in blocks])  # (n, elems)
+    proj = _device_projection(flat.shape[1], n_bits, seed)
+    bits = _sign_bits_jit(flat, proj)
+    return list(idxs), np.asarray(bits).astype(np.uint8)
+
+
+class LSHIndex:
+    """Banded SimHash index over block signatures.
+
+    ``n_bits`` must equal ``bands * rows_per_band``. Defaults (128 bits,
+    16 bands of 8) put the S-curve knee near cosine ~0.95 — fine-tuned
+    weight drift collides, unrelated weights don't."""
+
+    def __init__(self, n_bits: int = 128, bands: int = 16, seed: int = 0):
+        if n_bits % bands:
+            raise ValueError(f"bands {bands} must divide n_bits {n_bits}")
+        self.n_bits = n_bits
+        self.bands = bands
+        self.rows = n_bits // bands
+        self.seed = seed
+        self._buckets: Dict[Tuple[int, bytes], List[BlockRef]] = \
+            collections.defaultdict(list)
+        self._sigs: Dict[BlockRef, np.ndarray] = {}
+
+    # --------------------------------------------------------- build
+    def _band_keys(self, sig: np.ndarray) -> Iterable[Tuple[int, bytes]]:
+        for b in range(self.bands):
+            yield b, sig[b * self.rows:(b + 1) * self.rows].tobytes()
+
+    def add_model(self, name: str, tensor: BlockedTensor) -> int:
+        """Index every block; returns the number of blocks added."""
+        idxs, sigs = block_signatures(tensor, self.n_bits, self.seed)
+        for idx, sig in zip(idxs, sigs):
+            ref = (name, idx)
+            self._sigs[ref] = sig
+            for key in self._band_keys(sig):
+                self._buckets[key].append(ref)
+        return len(idxs)
+
+    # --------------------------------------------------------- query
+    def candidates(self, ref: BlockRef) -> List[BlockRef]:
+        """Blocks sharing >=1 band with ``ref`` (excluding itself) —
+        the sub-quadratic candidate set."""
+        sig = self._sigs[ref]
+        out = []
+        seen = {ref}
+        for key in self._band_keys(sig):
+            for other in self._buckets.get(key, ()):
+                if other not in seen:
+                    seen.add(other)
+                    out.append(other)
+        return out
+
+    def hamming(self, a: BlockRef, b: BlockRef) -> int:
+        return int(np.count_nonzero(self._sigs[a] != self._sigs[b]))
+
+    def near_duplicate_groups(self, max_hamming: Optional[int] = None
+                              ) -> List[List[BlockRef]]:
+        """Union-find over verified candidate pairs → groups of
+        near-duplicate blocks across all indexed models. Work is
+        O(candidate pairs), not O(n²)."""
+        if max_hamming is None:
+            max_hamming = self.rows  # one band's worth of disagreement
+        parent: Dict[BlockRef, BlockRef] = {r: r for r in self._sigs}
+
+        def find(x):
+            while parent[x] != x:
+                parent[x] = parent[parent[x]]
+                x = parent[x]
+            return x
+
+        self.verified_pairs = 0
+        for refs in self._buckets.values():
+            if len(refs) < 2:
+                continue
+            anchor = refs[0]
+            for other in refs[1:]:
+                self.verified_pairs += 1
+                if self.hamming(anchor, other) <= max_hamming:
+                    ra, rb = find(anchor), find(other)
+                    if ra != rb:
+                        parent[rb] = ra
+        groups = collections.defaultdict(list)
+        for r in self._sigs:
+            groups[find(r)].append(r)
+        return [sorted(g) for g in groups.values() if len(g) > 1]
+
+    def stats(self) -> Dict[str, int]:
+        sizes = [len(v) for v in self._buckets.values()]
+        return {"blocks": len(self._sigs),
+                "buckets": len(self._buckets),
+                "max_bucket": max(sizes, default=0)}
+
+
+def dedup_model_zoo(models: Dict[str, BlockedTensor],
+                    n_bits: int = 128, bands: int = 16,
+                    max_hamming: Optional[int] = None,
+                    seed: int = 0) -> Dict[str, object]:
+    """Index a whole zoo and return near-duplicate block groups plus
+    the pairwise-work saving — the reference's offline deduplicator
+    run, sub-quadratic."""
+    index = LSHIndex(n_bits, bands, seed)
+    for name, t in models.items():
+        index.add_model(name, t)
+    groups = index.near_duplicate_groups(max_hamming)
+    n = len(index._sigs)
+    total_pairs = n * (n - 1) // 2
+    return {"groups": groups, "index_stats": index.stats(),
+            "verified_pairs": index.verified_pairs,
+            "all_pairs": total_pairs,
+            "pair_work_fraction": (index.verified_pairs / total_pairs
+                                   if total_pairs else 0.0)}
+
+
+def bench_lsh_zoo(n_models: int = 100, blocks_per_model: int = 8,
+                  block: int = 256, n_families: int = 10,
+                  noise: float = 1e-4, seed: int = 0
+                  ) -> Dict[str, object]:
+    """100 synthetic model variants (n_families base models, each with
+    near-duplicate fine-tuned copies) indexed + grouped, with measured
+    build and probe time — the model-zoo scale test."""
+    import time
+
+    rng = np.random.default_rng(seed)
+    bases = [rng.standard_normal((blocks_per_model * block, block)
+                                 ).astype(np.float32)
+             for _ in range(n_families)]
+    models = {}
+    truth = {}
+    for i in range(n_models):
+        fam = i % n_families
+        dense = bases[fam] + noise * rng.standard_normal(
+            bases[fam].shape).astype(np.float32)
+        models[f"model{i}"] = BlockedTensor.from_dense(dense,
+                                                       (block, block))
+        truth[f"model{i}"] = fam
+
+    t0 = time.perf_counter()
+    index = LSHIndex()
+    for name, t in models.items():
+        index.add_model(name, t)
+    build_s = time.perf_counter() - t0
+
+    t0 = time.perf_counter()
+    groups = index.near_duplicate_groups()
+    probe_s = time.perf_counter() - t0
+
+    # grading: every group must be family-pure, and each (family, block
+    # position) should unite all its variants
+    pure = all(len({truth[name] for name, _ in g}) == 1 for g in groups)
+    n = len(index._sigs)
+    return {"models": n_models, "blocks": n,
+            "build_s": round(build_s, 3), "probe_s": round(probe_s, 3),
+            "groups": len(groups), "groups_family_pure": pure,
+            "verified_pairs": index.verified_pairs,
+            "all_pairs": n * (n - 1) // 2,
+            "index_stats": index.stats()}

@@ -64,6 +64,17 @@ class FFModel:
         for s in self.SETS:
             client.create_set(self.db, s)
         client.register_type("FFMatrixBlock", "netsdb_tpu.core.blocked:BlockedTensor")
+        # a live placement advisor (client.set_placement_advisor) may
+        # have chosen the block shape at create_set — adopt it so the
+        # whole model blocks consistently with its sets' placement.
+        # (RemoteClient has no local catalog; placement is decided
+        # daemon-side there.)
+        catalog = getattr(client, "catalog", None)
+        if catalog is not None:
+            placed = (catalog.get_set(self.db, "w1") or {}).get(
+                "meta", {}).get("block_shape")
+            if placed:
+                self.block = tuple(placed)
 
     def load_weights(self, client: Client, w1, b1, wo, bo) -> None:
         br = self.block[0]

@@ -556,4 +556,5 @@ def compile_suite(tables: Tables) -> Callable[[], Dict[str, object]]:
         return mega(arrays)
 
     runner.jitted = mega  # exposed so tests can assert one compilation
+    runner.arrays = arrays  # exposed for AOT export (plan/aot.py)
     return runner

@@ -86,10 +86,12 @@ def columnarize(comments: Sequence[Comment], authors: Sequence[Author],
         "author_created_utc": jnp.asarray(np.fromiter(
             (c.author_created_utc for c in comments), np.int32, n)),
         "body_len": jnp.asarray(body_len),
+        # hashed-body buckets as FIRST-CLASS columns so every table
+        # operation (filter/select/with_column) carries them along
+        **{f"body_h{j}": jnp.asarray(body_counts[:, j])
+           for j in range(hash_dim - 9)},
     }, dicts={"author_id": [a.author for a in authors],
               "sub_id": [s.id for s in subs]})
-    # bulk matrix rides alongside the table (not a scalar column)
-    object.__setattr__(ct, "body_counts", jnp.asarray(body_counts))
 
     at = ColumnTable({
         "author_id": jnp.asarray(np.fromiter(
@@ -156,11 +158,14 @@ def batch_features(comments_t: ColumnTable) -> jnp.ndarray:
     """(N, feature_dim) feature matrix in one device pass — replaces N
     calls of the per-record ``comment_features``."""
     c = comments_t
+    hash_cols = sorted((n for n in c.cols if n.startswith("body_h")),
+                       key=lambda n: int(n[6:]))
+    body_counts = jnp.stack([c[n] for n in hash_cols], axis=1)
     return _features_core(c["author_created_utc"], c["created_utc"],
                           c["score"], c["gilded"],
                           c["controversiality"], c["archived"],
                           c["stickied"], c["body_len"],
-                          getattr(c, "body_counts"))
+                          body_counts)
 
 
 # ------------------------------------------------- three-way join
