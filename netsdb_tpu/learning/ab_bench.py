@@ -56,11 +56,19 @@ def bench_placement_ab(width: int = 1100, batch: int = 4096,
     bo = rng.standard_normal((labels,)).astype(np.float32) * 0.01
     x = rng.standard_normal((batch, width)).astype(np.float32)
 
+    # one STABLE compile-cache dir for all rounds: the per-round roots
+    # are deleted below, and the jax cache pointer is process-global —
+    # pointing it at a to-be-deleted dir would leave it dangling (and
+    # the warm cache also makes later rounds measure steady state)
+    import os
+
+    cache_dir = os.path.join(tempfile.gettempdir(), "netsdb_ab_cache")
     chosen = []
     for _ in range(rounds):
         root = tempfile.mkdtemp(prefix="ab_bench_")
         try:
-            client = Client(Configuration(root_dir=root))
+            client = Client(Configuration(
+                root_dir=root, compilation_cache_dir=cache_dir))
             client.set_placement_advisor(advisor, key=job)
             model = FFModel(db="ab")
             model.setup(client)  # create_set consults the advisor HERE

@@ -97,28 +97,67 @@ def load_exported(path_or_blob) -> Callable:
 
 # ------------------------------------------------ suite-level wrappers
 
+def _suite_statics_digest(templates: Dict[str, list]) -> str:
+    """Stable digest of the suite's NON-array (compile-time) arguments
+    — dictionary codes, key spaces, join plans. The exported program
+    baked these in, so a load against tables whose statics differ would
+    silently compute wrong answers."""
+    import hashlib
+
+    canon = {name: [repr(a) for a in t if a is not _SLOT_SENTINEL()]
+             for name, t in templates.items()}
+    return hashlib.sha256(json.dumps(canon, sort_keys=True).encode()
+                          ).hexdigest()
+
+
+def _SLOT_SENTINEL():
+    from netsdb_tpu.relational.queries import _SLOT
+
+    return _SLOT
+
+
 def export_tpch_suite(tables, path: str) -> str:
     """AOT-compile the ENTIRE fused ten-query TPC-H program
     (``relational.queries.compile_suite``) and serialize it — the whole
-    benchmark suite as one shippable executable."""
-    from netsdb_tpu.relational.queries import compile_suite
+    benchmark suite as one shippable executable. A sidecar
+    ``<path>.meta`` records the digest of the baked-in statics so the
+    loader can refuse incompatible tables."""
+    from netsdb_tpu.relational.queries import (compile_suite,
+                                               suite_args_split)
 
     runner = compile_suite(tables)
+    templates, _ = suite_args_split(tables)
+    with open(path + ".meta", "w") as f:
+        json.dump({"statics_digest": _suite_statics_digest(templates)},
+                  f)
     return save_exported(path, runner.jitted, runner.arrays)
 
 
 def load_tpch_suite(path: str, tables) -> Callable[[], Dict]:
-    """Load a serialized suite; re-binds the CURRENT tables' arrays (the
-    artifact fixes shapes/dtypes, not data — same contract as the
-    reference re-running a precompiled plan against refreshed sets)."""
-    from netsdb_tpu.relational.queries import _SUITE_CORES
-    import jax.numpy as jnp
+    """Load a serialized suite and re-bind the CURRENT tables' arrays.
+
+    The artifact fixes shapes/dtypes AND the data-dependent statics
+    (dictionary codes, key spaces, planner join plans) that were baked
+    at export; the loader recomputes them from ``tables`` and REFUSES
+    tables whose statics differ — refreshed data must be
+    statics-compatible, same as the reference re-running a precompiled
+    plan against reloaded sets of the same schema."""
+    from netsdb_tpu.relational.queries import suite_args_split
 
     call = load_exported(path)
-    arrays: Dict[str, list] = {}
-    for name, (_core, args_fn) in _SUITE_CORES.items():
-        arrays[name] = [a for a in args_fn(tables)
-                        if isinstance(a, (jnp.ndarray, jax.Array))]
+    templates, arrays = suite_args_split(tables)
+    try:
+        with open(path + ".meta") as f:
+            want = json.load(f)["statics_digest"]
+    except (OSError, ValueError, KeyError):
+        want = None
+    if want is not None:
+        got = _suite_statics_digest(templates)
+        if got != want:
+            raise ValueError(
+                "exported suite was compiled against different static "
+                "arguments (dictionary codes / key spaces / join plans) "
+                "than these tables produce; re-export for this data")
     return lambda: call(arrays)
 
 

@@ -519,17 +519,11 @@ _SUITE_CORES: Dict[str, Tuple[Callable, Callable]] = {
 _SLOT = object()  # placeholder for a device array in an args template
 
 
-def compile_suite(tables: Tables) -> Callable[[], Dict[str, object]]:
-    """Fuse the ENTIRE ten-query suite into one jitted program.
-
-    The reference must execute each query as its own distributed job
-    with materialized intermediates; here the per-query cores are
-    inlined into a single XLA program, so the whole benchmark suite
-    costs ONE controller round-trip + one device schedule. Returns a
-    zero-argument callable producing ``{name: raw core output}`` (the
-    same arrays each ``cqNN`` wrapper formats); call it repeatedly —
-    the compiled program is cached on the callable.
-    """
+def suite_args_split(tables: Tables):
+    """Split every query core's arguments into (templates, arrays):
+    the single source of truth for which suite arguments are traced
+    device arrays (slots) vs compile-time statics — shared by
+    ``compile_suite`` and the AOT loader so they cannot diverge."""
     templates: Dict[str, list] = {}
     arrays: Dict[str, list] = {}
     for name, (_core, args_fn) in _SUITE_CORES.items():
@@ -542,6 +536,21 @@ def compile_suite(tables: Tables) -> Callable[[], Dict[str, object]]:
                 t.append(a)
         templates[name] = t
         arrays[name] = arr
+    return templates, arrays
+
+
+def compile_suite(tables: Tables) -> Callable[[], Dict[str, object]]:
+    """Fuse the ENTIRE ten-query suite into one jitted program.
+
+    The reference must execute each query as its own distributed job
+    with materialized intermediates; here the per-query cores are
+    inlined into a single XLA program, so the whole benchmark suite
+    costs ONE controller round-trip + one device schedule. Returns a
+    zero-argument callable producing ``{name: raw core output}`` (the
+    same arrays each ``cqNN`` wrapper formats); call it repeatedly —
+    the compiled program is cached on the callable.
+    """
+    templates, arrays = suite_args_split(tables)
 
     @jax.jit
     def mega(arrs: Dict[str, list]):

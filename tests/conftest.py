@@ -42,3 +42,9 @@ def client(config):
     from netsdb_tpu.client import Client
 
     return Client(config)
+
+
+def pytest_configure(config):
+    config.addinivalue_line(
+        "markers", "slow: long-running integration tests (multi-process "
+        "bring-up etc.)")

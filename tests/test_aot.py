@@ -49,6 +49,17 @@ def test_tpch_suite_export_and_reload(tables, tmp_path):
                                    rtol=1e-5, atol=1e-4)
 
 
+def test_suite_load_refuses_incompatible_tables(tables, tmp_path):
+    """The exported program bakes data-dependent statics (dict codes,
+    key spaces, join plans); loading against tables with different
+    statics must fail loudly, not silently compute wrong answers."""
+    path = str(tmp_path / "suite.bin")
+    aot.export_tpch_suite(tables, path)
+    other = tables_from_rows(tpch.generate(scale=3, seed=99))
+    with pytest.raises(ValueError, match="different static"):
+        aot.load_tpch_suite(path, other)
+
+
 def test_ff_export_round_trip(tmp_path):
     import jax
 

