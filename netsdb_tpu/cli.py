@@ -119,6 +119,36 @@ def _cmd_tpch_bench(args) -> int:
     return 0
 
 
+def _cmd_reddit_bench(args) -> int:
+    from netsdb_tpu.workloads.reddit_columnar import bench_label_propagation
+
+    print(json.dumps(bench_label_propagation(rows=args.rows,
+                                             n_authors=args.authors)))
+    return 0
+
+
+def _cmd_ooc_bench(args) -> int:
+    from netsdb_tpu.relational.outofcore import bench_out_of_core
+
+    print(json.dumps(bench_out_of_core(rows=args.rows,
+                                       pool_bytes=args.pool_mb << 20)))
+    return 0
+
+
+def _cmd_lsh_bench(args) -> int:
+    from netsdb_tpu.dedup.lsh import bench_lsh_zoo
+
+    print(json.dumps(bench_lsh_zoo(n_models=args.models)))
+    return 0
+
+
+def _cmd_ab_bench(args) -> int:
+    from netsdb_tpu.learning.ab_bench import bench_placement_ab
+
+    print(json.dumps(bench_placement_ab(rounds=args.rounds)))
+    return 0
+
+
 def _cmd_autotune(args) -> int:
     """Measure the physical-strategy crossovers on the live backend and
     persist them per device kind (the planner reads them back;
@@ -267,10 +297,64 @@ def _cmd_selftest(args) -> int:
         fps = block_fingerprints(bt)
         check(len(fps) == 4, "dedup fingerprints one per block")
 
+    def planner_stats():  # stats-driven join choice (round 2)
+        from netsdb_tpu.relational import planner as PLN
+        from netsdb_tpu.relational.table import ColumnTable
+        import jax.numpy as jnp
+
+        dense = ColumnTable({"k": jnp.arange(512, dtype=jnp.int32)})
+        probe = ColumnTable({"fk": jnp.arange(512, dtype=jnp.int32)})
+        sparse = ColumnTable({"k": jnp.asarray(
+            np.linspace(0, 4e8, 64).astype(np.int32))})
+        check(PLN.plan_join(dense, "k", probe, "fk").strategy == "lut",
+              "planner picks LUT for dense keys")
+        check(PLN.plan_join(sparse, "k", probe, "fk").strategy == "sort",
+              "planner picks sort for sparse keys")
+
+    def outofcore():  # paged q06 vs in-memory (round 2)
+        import shutil
+        import tempfile
+
+        from netsdb_tpu.relational import outofcore as O
+        from netsdb_tpu.relational.queries import cq06, tables_from_rows
+        from netsdb_tpu.storage.paged import PagedTensorStore
+        from netsdb_tpu.workloads import tpch as row_engine
+
+        data = row_engine.generate(scale=1, seed=6)
+        tabs = tables_from_rows(data)
+        root = tempfile.mkdtemp(prefix="selftest_ooc_")
+        try:
+            store = PagedTensorStore(Configuration(
+                root_dir=root, page_size_bytes=1 << 14))
+            pc = O.PagedColumns.from_table(store, "li",
+                                           tabs["lineitem"],
+                                           O.Q06_COLUMNS)
+            got = O.ooc_q06(pc)[0][1]
+            want = cq06(tabs)[0][1]
+            store.close()
+        finally:
+            shutil.rmtree(root, ignore_errors=True)
+        check(abs(got - want) <= max(1e-4 * abs(want), 1e-2),
+              "out-of-core q06 equals in-memory")
+
+    def reddit_columnar():  # device label propagation (round 2)
+        from netsdb_tpu.workloads import reddit as R
+        from netsdb_tpu.workloads import reddit_columnar as RC
+
+        cm, au, su = R.generate(num_comments=150, num_authors=12,
+                                num_subs=4, seed=2)
+        tabs = RC.columnarize(cm, au, su)
+        prop = np.asarray(RC.propagate_labels(tabs["comments"]))
+        pos = {c.author for c in cm if c.label == 1}
+        want = np.array([1 if c.author in pos else 0 for c in cm])
+        check(bool((prop == want).all()), "reddit propagation oracle")
+
     steps = [("selection", selection), ("aggregation", aggregation),
              ("lda", lda), ("ff", ff), ("lstm", lstm), ("conv", conv),
              ("tpch-columnar", tpch_columnar), ("pdml", pdml),
-             ("dedup", dedup)]
+             ("dedup", dedup), ("planner-stats", planner_stats),
+             ("out-of-core", outofcore),
+             ("reddit-columnar", reddit_columnar)]
     for name, fn in steps:
         step(name, fn)
     print(f"{len(steps) - len(failures)}/{len(steps)} passed")
@@ -471,9 +555,31 @@ def main(argv=None) -> int:
                        "the live backend and persist per device kind")
     p.add_argument("--no-persist", action="store_true")
 
+    p = sub.add_parser("reddit-bench",
+                       help="columnar reddit label propagation at scale")
+    p.add_argument("--rows", type=int, default=1_000_000)
+    p.add_argument("--authors", type=int, default=50_000)
+
+    p = sub.add_parser("ooc-bench",
+                       help="out-of-core TPC-H q01/q06 through the paged "
+                       "store under a pool cap")
+    p.add_argument("--rows", type=int, default=60_000_000)
+    p.add_argument("--pool-mb", type=int, default=1024)
+
+    p = sub.add_parser("lsh-bench",
+                       help="LSH dedup index over a synthetic model zoo")
+    p.add_argument("--models", type=int, default=100)
+
+    p = sub.add_parser("ab-bench",
+                       help="live placement-advisor A/B (Lachesis loop)")
+    p.add_argument("--rounds", type=int, default=4)
+
     args = parser.parse_args(argv)
     return {"info": _cmd_info, "bench": _cmd_bench, "pdml": _cmd_pdml,
             "autotune": _cmd_autotune,
+            "reddit-bench": _cmd_reddit_bench,
+            "ooc-bench": _cmd_ooc_bench, "lsh-bench": _cmd_lsh_bench,
+            "ab-bench": _cmd_ab_bench,
             "serve": _cmd_serve, "serve-bench": _cmd_serve_bench,
             "demo-ff": _cmd_demo_ff, "tpch": _cmd_tpch,
             "micro-bench": _cmd_micro_bench, "tpch-bench": _cmd_tpch_bench,

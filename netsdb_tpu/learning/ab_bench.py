@@ -59,10 +59,15 @@ def bench_placement_ab(width: int = 1100, batch: int = 4096,
     # one STABLE compile-cache dir for all rounds: the per-round roots
     # are deleted below, and the jax cache pointer is process-global —
     # pointing it at a to-be-deleted dir would leave it dangling (and
-    # the warm cache also makes later rounds measure steady state)
+    # the warm cache also makes later rounds measure steady state).
+    # uid-suffixed so shared machines don't collide on ownership; the
+    # pointer intentionally survives the bench (enable_compilation_cache
+    # is re-entrant — the next Client repoints it).
     import os
 
-    cache_dir = os.path.join(tempfile.gettempdir(), "netsdb_ab_cache")
+    uid = os.getuid() if hasattr(os, "getuid") else "u"
+    cache_dir = os.path.join(tempfile.gettempdir(),
+                             f"netsdb_ab_cache_{uid}")
     chosen = []
     for _ in range(rounds):
         root = tempfile.mkdtemp(prefix="ab_bench_")

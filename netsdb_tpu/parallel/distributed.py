@@ -61,18 +61,21 @@ def hybrid_mesh(ici_shape: Sequence[int],
         # devices would route model/sequence collectives over DCN
         from jax.experimental import mesh_utils
 
-        dcn_shape = (n_hosts,) + (1,) * (len(ici_shape) - 1)
-        # non-TPU multi-process (e.g. the two-process CPU smoke test):
-        # devices carry no distinct slice_index, so the DCN dimension
-        # groups by process. Decide UP FRONT from the device topology —
-        # a blanket exception fallback would mask genuine shape errors
-        # (and could build an ICI-spans-DCN mesh on a real pod).
+        # The DCN granule is the slice on real pods (devices carry
+        # distinct slice_index) and the process otherwise (e.g. the
+        # two-process CPU smoke test). Decide UP FRONT from the device
+        # topology — a blanket exception fallback would mask genuine
+        # shape errors — and size the DCN dimension by GRANULE count
+        # (on a 2-slice pod with 2 hosts/slice that is 2, not 4).
         n_slices = len({getattr(d, "slice_index", None)
                         for d in jax.devices()})
+        by_process = n_slices <= 1
+        n_granules = n_hosts if by_process else n_slices
+        dcn_shape = (n_granules,) + (1,) * (len(ici_shape) - 1)
         devs = mesh_utils.create_hybrid_device_mesh(
             mesh_shape=tuple(ici_shape), dcn_mesh_shape=dcn_shape,
-            process_is_granule=(n_slices <= 1))
-        return Mesh(devs.reshape((n_hosts,) + tuple(ici_shape)),
+            process_is_granule=by_process)
+        return Mesh(devs.reshape((n_granules,) + tuple(ici_shape)),
                     (dcn_axis,) + tuple(ici_axes))
     devices = jax.devices()
     total = int(np.prod(ici_shape))

@@ -104,32 +104,26 @@ def _suite_statics_digest(templates: Dict[str, list]) -> str:
     silently compute wrong answers."""
     import hashlib
 
-    canon = {name: [repr(a) for a in t if a is not _SLOT_SENTINEL()]
+    from netsdb_tpu.relational.queries import _SLOT
+
+    canon = {name: [repr(a) for a in t if a is not _SLOT]
              for name, t in templates.items()}
     return hashlib.sha256(json.dumps(canon, sort_keys=True).encode()
                           ).hexdigest()
-
-
-def _SLOT_SENTINEL():
-    from netsdb_tpu.relational.queries import _SLOT
-
-    return _SLOT
 
 
 def export_tpch_suite(tables, path: str) -> str:
     """AOT-compile the ENTIRE fused ten-query TPC-H program
     (``relational.queries.compile_suite``) and serialize it — the whole
     benchmark suite as one shippable executable. A sidecar
-    ``<path>.meta`` records the digest of the baked-in statics so the
-    loader can refuse incompatible tables."""
-    from netsdb_tpu.relational.queries import (compile_suite,
-                                               suite_args_split)
+    ``<path>.meta`` records the digest of the baked-in statics; the
+    loader REQUIRES it, so ship both files together."""
+    from netsdb_tpu.relational.queries import compile_suite
 
     runner = compile_suite(tables)
-    templates, _ = suite_args_split(tables)
     with open(path + ".meta", "w") as f:
-        json.dump({"statics_digest": _suite_statics_digest(templates)},
-                  f)
+        json.dump({"statics_digest":
+                   _suite_statics_digest(runner.templates)}, f)
     return save_exported(path, runner.jitted, runner.arrays)
 
 
@@ -141,7 +135,10 @@ def load_tpch_suite(path: str, tables) -> Callable[[], Dict]:
     at export; the loader recomputes them from ``tables`` and REFUSES
     tables whose statics differ — refreshed data must be
     statics-compatible, same as the reference re-running a precompiled
-    plan against reloaded sets of the same schema."""
+    plan against reloaded sets of the same schema. Fails CLOSED when
+    the ``<path>.meta`` sidecar is missing or unreadable (without it
+    compatibility cannot be proven, and a silent mismatch computes
+    wrong answers)."""
     from netsdb_tpu.relational.queries import suite_args_split
 
     call = load_exported(path)
@@ -149,15 +146,16 @@ def load_tpch_suite(path: str, tables) -> Callable[[], Dict]:
     try:
         with open(path + ".meta") as f:
             want = json.load(f)["statics_digest"]
-    except (OSError, ValueError, KeyError):
-        want = None
-    if want is not None:
-        got = _suite_statics_digest(templates)
-        if got != want:
-            raise ValueError(
-                "exported suite was compiled against different static "
-                "arguments (dictionary codes / key spaces / join plans) "
-                "than these tables produce; re-export for this data")
+    except (OSError, ValueError, KeyError) as e:
+        raise ValueError(
+            f"missing or unreadable statics sidecar {path + '.meta'} "
+            "(exported suites must travel with it; re-export if lost)"
+        ) from e
+    if _suite_statics_digest(templates) != want:
+        raise ValueError(
+            "exported suite was compiled against different static "
+            "arguments (dictionary codes / key spaces / join plans) "
+            "than these tables produce; re-export for this data")
     return lambda: call(arrays)
 
 

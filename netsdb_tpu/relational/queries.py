@@ -566,4 +566,5 @@ def compile_suite(tables: Tables) -> Callable[[], Dict[str, object]]:
 
     runner.jitted = mega  # exposed so tests can assert one compilation
     runner.arrays = arrays  # exposed for AOT export (plan/aot.py)
+    runner.templates = templates  # the matching statics, same split
     return runner
