@@ -119,6 +119,15 @@ def _cmd_tpch_bench(args) -> int:
     return 0
 
 
+def _cmd_transformer_bench(args) -> int:
+    from netsdb_tpu.workloads.transformer_bench import bench_transformer_layer
+
+    print(json.dumps(bench_transformer_layer(
+        seq_lens=tuple(args.seq), batch=args.batch, embed=args.embed,
+        heads=args.heads)))
+    return 0
+
+
 def _cmd_reddit_bench(args) -> int:
     from netsdb_tpu.workloads.reddit_columnar import bench_label_propagation
 
@@ -555,6 +564,14 @@ def main(argv=None) -> int:
                        "the live backend and persist per device kind")
     p.add_argument("--no-persist", action="store_true")
 
+    p = sub.add_parser("transformer-bench",
+                       help="set-backed long-context transformer layer "
+                       "forward (flash attention), tokens/s + TFLOP/s")
+    p.add_argument("--seq", type=int, nargs="+", default=[4096, 8192])
+    p.add_argument("--batch", type=int, default=2)
+    p.add_argument("--embed", type=int, default=1024)
+    p.add_argument("--heads", type=int, default=8)
+
     p = sub.add_parser("reddit-bench",
                        help="columnar reddit label propagation at scale")
     p.add_argument("--rows", type=int, default=1_000_000)
@@ -577,6 +594,7 @@ def main(argv=None) -> int:
     args = parser.parse_args(argv)
     return {"info": _cmd_info, "bench": _cmd_bench, "pdml": _cmd_pdml,
             "autotune": _cmd_autotune,
+            "transformer-bench": _cmd_transformer_bench,
             "reddit-bench": _cmd_reddit_bench,
             "ooc-bench": _cmd_ooc_bench, "lsh-bench": _cmd_lsh_bench,
             "ab-bench": _cmd_ab_bench,

@@ -74,3 +74,17 @@ def test_graft_entry_dryrun_all_sizes():
     assert out.shape == (8, 16)
     for n in (1, 2, 4, 8):
         g.dryrun_multichip(n)
+
+
+def test_transformer_bench_smoke():
+    from netsdb_tpu.workloads.transformer_bench import (
+        bench_transformer_layer, layer_flops)
+
+    # flops model sanity: attention halves under causal, MLP dominates
+    # at short seq
+    assert layer_flops(1, 128, 256, 4) > 0
+    assert layer_flops(1, 128, 256, 4, causal=True) < \
+        layer_flops(1, 128, 256, 4, causal=False)
+    res = bench_transformer_layer(seq_lens=(256,), batch=1, embed=128,
+                                  heads=4)
+    assert "seq_256" in res
