@@ -173,9 +173,16 @@ class PageStore {
     uint64_t cap = 0;
     uint8_t* buf = arena_.alloc(size, &cap);
     if (buf == nullptr) {
-      // evict cold pages, then retry once (reference PageCache evicts
+      // evict cold pages, then retry (reference PageCache evicts
       // under memory pressure before failing the pin)
       evict_locked(size);
+      buf = arena_.alloc(size, &cap);
+    }
+    if (buf == nullptr) {
+      // byte-count eviction can free enough TOTAL space yet leave no
+      // contiguous run (fragmented small pools): clear every unpinned
+      // page so the free blocks coalesce, then retry once more
+      evict_locked(UINT64_MAX);
       buf = arena_.alloc(size, &cap);
       if (buf == nullptr) return -2;
     }
@@ -306,6 +313,12 @@ class PageStore {
     uint8_t* buf = arena_.alloc(p->size, &cap);
     if (buf == nullptr) {
       evict_locked(p->size);
+      buf = arena_.alloc(p->size, &cap);
+    }
+    if (buf == nullptr) {
+      // same fragmentation fallback as alloc_page: coalesce by
+      // evicting everything unpinned, then retry once more
+      evict_locked(UINT64_MAX);
       buf = arena_.alloc(p->size, &cap);
       if (buf == nullptr) return false;
     }

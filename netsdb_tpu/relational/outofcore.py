@@ -255,6 +255,13 @@ def bench_out_of_core(rows: int = 60_000_000,
 
     cfg = Configuration(root_dir=tempfile.mkdtemp(prefix="ooc_bench_"))
     store = PagedTensorStore(cfg, pool_bytes=pool_bytes)
+    if row_block is None:
+        # one page must be far smaller than the pool or ingest cannot
+        # even allocate (several pages stay pinned concurrently): cap a
+        # page at pool/8, floor at 4k rows
+        width = len(cols)
+        row_block = max(min(cfg.page_size_bytes // (4 * width),
+                            pool_bytes // (8 * 4 * width)), 4096)
     t0 = time.perf_counter()
     pc = PagedColumns.ingest(store, "lineitem", cols, row_block=row_block,
                              dicts={"l_returnflag": ["A", "N", "R"],
