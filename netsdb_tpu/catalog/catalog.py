@@ -68,6 +68,12 @@ class Catalog:
         self._lock = threading.Lock()
         with self._lock:
             self._conn.executescript(_SCHEMA)
+            # migration for catalogs created before round 3: types grew
+            # a source column (shipped UDF code, the .so-bytes analogue)
+            try:
+                self._conn.execute("ALTER TABLE types ADD COLUMN source TEXT")
+            except sqlite3.OperationalError:
+                pass  # column already exists
             self._conn.commit()
 
     # --- databases (ref: PDBCatalog::registerDatabase) ----------------
@@ -175,11 +181,19 @@ class Catalog:
             self._conn.commit()
 
     # --- types (ref: PDBCatalog registered user types / .so files) ----
-    def register_type(self, type_name: str, entry_point: str) -> None:
+    def register_type(self, type_name: str, entry_point: str,
+                      source: Optional[str] = None) -> None:
+        """``source`` (optional Python module text) is the analogue of
+        the reference catalog storing and replicating user-type .so
+        binaries so workers can execute types they have never imported
+        (``src/catalog/headers/PDBCatalog.h:45-50``): the serve daemon
+        loads it when the entry point's module is not installed."""
         with self._lock:
             self._conn.execute(
-                "INSERT OR REPLACE INTO types VALUES (?, ?, ?)",
-                (type_name, entry_point, time.time()),
+                "INSERT OR REPLACE INTO types "
+                "(type_name, entry_point, registered_at, source) "
+                "VALUES (?, ?, ?, ?)",
+                (type_name, entry_point, time.time(), source),
             )
             self._conn.commit()
 
@@ -187,6 +201,15 @@ class Catalog:
         with self._lock:
             cur = self._conn.execute(
                 "SELECT entry_point FROM types WHERE type_name = ?", (type_name,)
+            )
+            row = cur.fetchone()
+        return row[0] if row else None
+
+    def get_type_source(self, type_name: str) -> Optional[str]:
+        """Shipped module source for a registered type, if any."""
+        with self._lock:
+            cur = self._conn.execute(
+                "SELECT source FROM types WHERE type_name = ?", (type_name,)
             )
             row = cur.fetchone()
         return row[0] if row else None
@@ -225,3 +248,18 @@ class Catalog:
     def close(self) -> None:
         with self._lock:
             self._conn.close()
+
+
+def read_module_source(entry_point: str) -> str:
+    """Read the source text of an entry point's locally-importable
+    module — the client-side half of UDF code shipping
+    (``register_type(ship_module=True)``; the reference reads the .so
+    bytes off disk to replicate them, ``PDBCatalog.h:45-50``)."""
+    import importlib.util
+
+    spec = importlib.util.find_spec(entry_point.partition(":")[0])
+    if spec is None or spec.origin is None:
+        raise ImportError(
+            f"ship_module: cannot locate source for {entry_point!r}")
+    with open(spec.origin, "r") as f:
+        return f.read()

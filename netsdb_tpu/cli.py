@@ -154,7 +154,8 @@ def _cmd_lsh_bench(args) -> int:
 def _cmd_ab_bench(args) -> int:
     from netsdb_tpu.learning.ab_bench import bench_placement_ab
 
-    print(json.dumps(bench_placement_ab(rounds=args.rounds)))
+    print(json.dumps(bench_placement_ab(rounds=args.rounds,
+                                        advisor_kind=args.advisor)))
     return 0
 
 
@@ -590,6 +591,8 @@ def main(argv=None) -> int:
     p = sub.add_parser("ab-bench",
                        help="live placement-advisor A/B (Lachesis loop)")
     p.add_argument("--rounds", type=int, default=4)
+    p.add_argument("--advisor", choices=["rule", "drl"], default="rule",
+                   help="rule-based bandit or live actor-critic (DRL)")
 
     args = parser.parse_args(argv)
     return {"info": _cmd_info, "bench": _cmd_bench, "pdml": _cmd_pdml,

@@ -72,10 +72,19 @@ class Client:
         enable_compilation_cache(config)  # PreCompiledWorkload analogue
         self.catalog = Catalog(catalog_path or ":memory:")
         self.store = SetStore(config)
-        self._mesh = None  # set by parallel helpers when distributed
+        # mesh of the most recent placement applied via create_set — the
+        # cluster this controller is currently distributing over (the
+        # reference's ResourceManager serverlist role)
+        self._mesh = None
         self._advisor = None  # Lachesis-lite (set_placement_advisor)
         self._advisor_key = "default"
         self._advisor_arm = None  # arm applied by this session's DDL
+
+    @property
+    def mesh(self):
+        """The device mesh of the last placement-carrying ``create_set``
+        (None while every set is single-device)."""
+        return self._mesh
 
     # --- self-learning placement (Lachesis) ---------------------------
     def set_placement_advisor(self, advisor, key: str = "default") -> None:
@@ -104,33 +113,67 @@ class Client:
         persistence: str = "transient",
         eviction: str = "lru",
         partition_lambda: Optional[str] = None,
+        placement=None,
     ) -> SetIdentifier:
         """``partition_lambda`` mirrors createSet-with-dispatch-computation
         (reference ``PDBClient.h:79-103``): a named key function the
-        dispatcher/placement layer may use to route data."""
+        dispatcher/placement layer may use to route data.
+
+        ``placement`` (:class:`~netsdb_tpu.parallel.placement.Placement`
+        or its ``to_meta`` dict) declares the set's mesh sharding — the
+        createSet-time PartitionPolicy (``PartitionPolicy.h:27-50``):
+        every tensor/table ingested into the set is placed with it, and
+        query jits over the set inherit the sharding, so XLA distributes
+        the job the way the reference scheduler broadcast stages to all
+        workers."""
         if not self.catalog.database_exists(db):
             raise KeyError(f"database {db!r} does not exist; create_database first")
+        from netsdb_tpu.parallel.placement import Placement
+
+        if isinstance(placement, dict):
+            placement = Placement.from_meta(placement)
         meta: Dict[str, Any] = {}
         if partition_lambda:
             meta["partition_lambda"] = partition_lambda
-        if self._advisor is not None and type_name == "tensor":
+        arm = (self._advisor.choose(self._advisor_key)
+               if self._advisor is not None else None)
+        if placement is None and arm is not None:
+            # an advisor arm may carry a sharding decision (the DRL /
+            # rule-based optimizers choose *distribution*, not just
+            # page size — Lachesis' decision variable on TPU): specs
+            # values may be Placement objects keyed by set role
+            spec = arm.specs.get("placement") or arm.specs.get(set_name)
+            if isinstance(spec, Placement):
+                placement = spec
+        if placement is not None:
+            meta["sharding"] = placement.to_meta()
+            self._mesh = placement.mesh()
+            # placement-history row: the sharding actually applied by
+            # DDL, auditable by the advisor/judge (the reference logs
+            # its placement decisions to the self-learning DB)
+            from netsdb_tpu.learning.history import get_history_db
+
+            get_history_db().record(
+                f"{db}.{set_name}:placement", plan_key=f"set:{db}.{set_name}",
+                elapsed_s=0.0, config_label=placement.label())
+        if arm is not None and type_name == "tensor":
             # live Lachesis decision: the chosen placement (block shape
             # = the reference's page-size knob) lands in the catalog and
             # the history DB, and send_matrix defaults to it. Decision
             # rows live under "<key>:decisions" so they audit the live
             # choices without polluting the reward means.
-            cand = self._advisor.choose(self._advisor_key)
-            meta["placement"] = cand.label
-            if "block" in cand.specs:
-                meta["block_shape"] = list(cand.specs["block"])
-            self._advisor_arm = cand  # the placement actually in force
+            meta["placement"] = arm.label
+            if "block" in arm.specs:
+                meta["block_shape"] = list(arm.specs["block"])
+            self._advisor_arm = arm  # the placement actually in force
             self._advisor.db.record(f"{self._advisor_key}:decisions",
                                     plan_key=f"set:{db}.{set_name}",
                                     elapsed_s=0.0,
-                                    config_label=cand.label)
+                                    config_label=arm.label)
         self.catalog.create_set(db, set_name, type_name, meta, persistence)
         ident = _ident(db, set_name)
-        self.store.create_set(ident, persistence=persistence, eviction=eviction)
+        self.store.create_set(ident, persistence=persistence, eviction=eviction,
+                              placement=placement)
         return ident
 
     def remove_set(self, db: str, set_name: str) -> None:
@@ -144,11 +187,23 @@ class Client:
         return self.catalog.set_exists(db, set_name)
 
     # --- types --------------------------------------------------------
-    def register_type(self, type_name: str, entry_point: str) -> None:
-        """Register an op/model implementation by dotted import path —
-        replaces shipping UDF .so files (ref registerType / VTableMap
-        dynamic loading, ``src/objectModel/headers/VTableMap.h:36-80``)."""
-        self.catalog.register_type(type_name, entry_point)
+    def register_type(self, type_name: str, entry_point: str,
+                      source: Optional[str] = None,
+                      ship_module: bool = False) -> None:
+        """Register an op/model implementation by dotted import path
+        (ref registerType / VTableMap dynamic loading,
+        ``src/objectModel/headers/VTableMap.h:36-80``).
+
+        ``source`` ships the module's code through the catalog so a
+        daemon that has never installed it can still execute the type —
+        the reference replicating user-type .so binaries
+        (``PDBCatalog.h:45-50``). ``ship_module=True`` reads the source
+        off the locally-importable module instead."""
+        if ship_module and source is None:
+            from netsdb_tpu.catalog.catalog import read_module_source
+
+            source = read_module_source(entry_point)
+        self.catalog.register_type(type_name, entry_point, source=source)
 
     # --- data path ----------------------------------------------------
     def send_data(self, db: str, set_name: str, items: Sequence[Any]) -> None:
@@ -187,6 +242,38 @@ class Client:
             self.catalog.update_set_meta(db, set_name, cat["meta"])
         return t
 
+    def send_table(self, db: str, set_name: str, rows_or_table,
+                   date_cols: Sequence[str] = ()) -> "Any":
+        """Ingest a relation as ONE ColumnTable (dictionary-encoding
+        string columns on the way in — weak-typed rows become device
+        columns, the reference's dispatcher page-building role). If the
+        set carries a placement, the store shards the table's rows over
+        the mesh (PartitionPolicy applied at ingest,
+        ``src/dispatcher/headers/PartitionPolicy.h:27-50``)."""
+        from netsdb_tpu.relational.table import ColumnTable
+
+        table = (rows_or_table if isinstance(rows_or_table, ColumnTable)
+                 else ColumnTable.from_rows(list(rows_or_table), date_cols))
+        ident = _ident(db, set_name)
+        self.store.clear_set(ident)
+        self.store.add_data(ident, [table])
+        cat = self.catalog.get_set(db, set_name)
+        if cat is not None:
+            cat["meta"].update(num_rows=table.num_rows,
+                               columns=sorted(table.cols))
+            self.catalog.update_set_meta(db, set_name, cat["meta"])
+        return table
+
+    def get_table(self, db: str, set_name: str):
+        from netsdb_tpu.relational.table import ColumnTable
+
+        items = self.store.get_items(_ident(db, set_name))
+        tables = [i for i in items if isinstance(i, ColumnTable)]
+        if len(tables) != 1:
+            raise ValueError(
+                f"set {db}:{set_name} holds {len(tables)} tables; expected 1")
+        return tables[0]
+
     def get_tensor(self, db: str, set_name: str) -> BlockedTensor:
         return self.store.get_tensor(_ident(db, set_name))
 
@@ -200,6 +287,46 @@ class Client:
             info = self.catalog.get_set(ident.db, ident.set)
             if info and info.get("persistence") == "persistent":
                 self.store.flush(ident)
+
+    def dedup_resident(self, sets: Sequence[Tuple[str, str]],
+                       bands: int = 16, seed: int = 0) -> Dict[str, Any]:
+        """Dedup device-resident model weight sets at block level: LSH
+        groups near-duplicate blocks across the sets, byte-identical
+        group members collapse into one shared device pool, and each
+        set keeps a slot grid (``dedup/pool.py``) — fine-tuned variants
+        share HBM the way the reference's models share physical pages
+        (``SharedTensorBlockSet.h:25``, ``PDBClient.h:113-138``).
+        Inference is bit-unchanged; returns the pooling report. Sets
+        are partitioned by (block_shape, dtype) class; classes with one
+        member still pool (dedup within a single model's repeated
+        blocks)."""
+        from netsdb_tpu.dedup.pool import pool_models
+
+        tensors: Dict[str, BlockedTensor] = {}
+        for db, set_name in sets:
+            tensors[f"{db}:{set_name}"] = self.get_tensor(db, set_name)
+        by_class: Dict[Any, Dict[str, BlockedTensor]] = {}
+        for name, t in tensors.items():
+            by_class.setdefault((t.meta.block_shape, str(t.dtype)),
+                                {})[name] = t
+        total: Dict[str, Any] = {"classes": len(by_class), "models": 0,
+                                 "total_blocks": 0, "unique_blocks": 0,
+                                 "shared_block_refs": 0,
+                                 "hbm_bytes_before": 0,
+                                 "hbm_bytes_pooled": 0}
+        for cls, group in by_class.items():
+            pooled, report = pool_models(group, bands=bands, seed=seed)
+            for name, pt in pooled.items():
+                db, set_name = name.split(":", 1)
+                self.store.set_pooled(_ident(db, set_name), pt)
+            for k in ("models", "total_blocks", "unique_blocks",
+                      "shared_block_refs", "hbm_bytes_before",
+                      "hbm_bytes_pooled"):
+                total[k] += report[k]
+        total["hbm_savings_pct"] = round(
+            100 * (1 - total["hbm_bytes_pooled"]
+                   / max(total["hbm_bytes_before"], 1)), 1)
+        return total
 
     # --- dedup (ref PDBClient::addSharedPage/addSharedMapping) --------
     def add_shared_mapping(

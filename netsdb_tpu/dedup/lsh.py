@@ -130,11 +130,22 @@ class LSHIndex:
     def hamming(self, a: BlockRef, b: BlockRef) -> int:
         return int(np.count_nonzero(self._sigs[a] != self._sigs[b]))
 
+    # buckets up to this size are verified all-pairs; above it, each
+    # member is checked against the bucket anchor only. The anchor
+    # heuristic can miss a true pair whose bucket is anchored by an
+    # unrelated hash collision — recovered only if the pair shares
+    # another band's bucket — so small buckets (the common case, and
+    # where a single collision distorts most) pay the exact quadratic
+    # price, bounded at C(8,2)=28 checks.
+    _EXACT_BUCKET_MAX = 8
+
     def near_duplicate_groups(self, max_hamming: Optional[int] = None
                               ) -> List[List[BlockRef]]:
         """Union-find over verified candidate pairs → groups of
         near-duplicate blocks across all indexed models. Work is
-        O(candidate pairs), not O(n²)."""
+        O(candidate pairs), not O(n²): all-pairs inside small buckets,
+        anchor-vs-rest in large ones (see ``_EXACT_BUCKET_MAX`` for the
+        recall tradeoff of the anchor heuristic)."""
         if max_hamming is None:
             max_hamming = self.rows  # one band's worth of disagreement
         parent: Dict[BlockRef, BlockRef] = {r: r for r in self._sigs}
@@ -146,14 +157,27 @@ class LSHIndex:
             return x
 
         self.verified_pairs = 0
+        checked = set()  # each candidate pair verified once, however
+        # many band buckets it shares (the reference deduplicator's
+        # candidate-pair semantics)
         for refs in self._buckets.values():
             if len(refs) < 2:
                 continue
-            anchor = refs[0]
-            for other in refs[1:]:
+            if len(refs) <= self._EXACT_BUCKET_MAX:
+                pairs = ((refs[i], refs[j])
+                         for i in range(len(refs))
+                         for j in range(i + 1, len(refs)))
+            else:
+                anchor = refs[0]
+                pairs = ((anchor, other) for other in refs[1:])
+            for a, b in pairs:
+                key = (a, b) if a <= b else (b, a)
+                if key in checked:
+                    continue
+                checked.add(key)
                 self.verified_pairs += 1
-                if self.hamming(anchor, other) <= max_hamming:
-                    ra, rb = find(anchor), find(other)
+                if self.hamming(a, b) <= max_hamming:
+                    ra, rb = find(a), find(b)
                     if ra != rb:
                         parent[rb] = ra
         groups = collections.defaultdict(list)

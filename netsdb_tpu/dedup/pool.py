@@ -1,0 +1,196 @@
+"""Device-resident shared block pool — model dedup in HBM.
+
+The reference's serve-time dedup stores one physical copy of pages that
+several model sets share (``src/deduplication/headers/
+SharedTensorBlockSet.h:25``) and points private sets at them via
+``addSharedPage``/``addSharedMapping`` (``src/mainClient/headers/
+PDBClient.h:113-138``). Round 2 covered full-set aliasing
+(``detector.dedup_weight_sets``); this module covers the finer and more
+common case — two *fine-tuned variants* share MOST blocks — at the
+HBM level:
+
+- The LSH index (:mod:`netsdb_tpu.dedup.lsh`) groups near-duplicate
+  blocks across all candidate models sub-quadratically; only blocks
+  inside a group are byte-compared (LSH's job: blocks in no group are
+  unique without any exact hashing).
+- Exactly-equal blocks collapse to ONE slot in a stacked device pool
+  array ``(P, bh, bw)``; each model keeps an int32 slot grid.
+- A :class:`PooledTensor` stored in a set assembles back to its
+  ``BlockedTensor`` on access (one eager device gather + reshape): the
+  dense copy is a TRANSIENT that lives only while the consuming job
+  holds it — steady-state HBM is the pool once plus slot grids, not one
+  dense copy per model, which is what the reference's shared pages buy.
+  (Peak HBM during a job = pool + the dense copies of the models that
+  job reads; re-reads re-pay the gather. The alternative — tracing
+  pool+slots into every consumer jit — would save the transient but
+  couple every consumer's signature to pooling; not done.)
+
+Only bit-identical blocks share a slot: assembly is exact, so inference
+for every pooled model is unchanged to the bit.
+"""
+
+from __future__ import annotations
+
+import hashlib
+from typing import Dict, List, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from netsdb_tpu.core.blocked import BlockedTensor, BlockMeta
+
+
+class BlockPool:
+    """Unique blocks of one (block_shape, dtype) class, stacked on
+    device — the SharedTensorBlockSet."""
+
+    def __init__(self, blocks: jax.Array, num_refs: int,
+                 total_blocks: int):
+        self.blocks = blocks  # (P, bh, bw)
+        self.num_refs = num_refs
+        self.total_blocks = total_blocks
+
+    @property
+    def nbytes(self) -> int:
+        return int(self.blocks.nbytes)
+
+
+class PooledTensor:
+    """A model tensor materialized as slots into a shared BlockPool.
+
+    Stored in a SetStore set in place of its BlockedTensor; the store
+    assembles on access (``SetStore.get_items``), so every consumer —
+    executor scans, serve handlers, checkpoints — sees an ordinary
+    BlockedTensor while resident HBM holds only the pool + slot grid."""
+
+    def __init__(self, pool: BlockPool, slots: np.ndarray, meta: BlockMeta,
+                 owns_pool: bool = False):
+        self.pool = pool
+        self.slots = np.asarray(slots, np.int32)  # (gh, gw)
+        self.meta = meta
+        # exactly one PooledTensor per pool carries the pool's bytes in
+        # its accounting (store eviction math must see the pool ONCE,
+        # not zero times and not once per model)
+        self.owns_pool = owns_pool
+
+    def assemble(self) -> BlockedTensor:
+        gh, gw = self.slots.shape
+        bh, bw = self.meta.block_shape
+        picked = jnp.take(self.pool.blocks,
+                          jnp.asarray(self.slots.reshape(-1)), axis=0)
+        dense = picked.reshape(gh, gw, bh, bw).transpose(0, 2, 1, 3
+                                                        ).reshape(gh * bh,
+                                                                  gw * bw)
+        return BlockedTensor(dense, self.meta)
+
+    @property
+    def nbytes_resident(self) -> int:
+        """Bytes this tensor pins: its slot grid, plus the shared pool
+        if it is the pool's accounting owner."""
+        own = self.pool.nbytes if self.owns_pool else 0
+        return int(self.slots.nbytes) + own
+
+    def __reduce__(self):
+        # spill/checkpoint: persist as the full tensor (dedup is an
+        # HBM-residency optimization, not a wire/disk format)
+        t = self.assemble()
+        return (_rebuild_blocked, (np.asarray(t.data), t.meta.shape,
+                                   t.meta.block_shape))
+
+
+def _rebuild_blocked(data, shape, block_shape):
+    return BlockedTensor(jnp.asarray(data), BlockMeta(tuple(shape),
+                                                      tuple(block_shape)))
+
+
+def pool_models(tensors: Dict[str, BlockedTensor],
+                bands: int = 16, n_bits: int = 128,
+                seed: int = 0) -> Tuple[Dict[str, PooledTensor], Dict]:
+    """Build one shared pool over the given model tensors.
+
+    LSH groups candidate near-duplicate blocks; byte-exact members of a
+    group share a slot. Returns ({name: PooledTensor}, report). All
+    tensors must share block_shape and dtype (one pool class — the
+    caller partitions by class)."""
+    from netsdb_tpu.dedup.lsh import LSHIndex
+
+    metas = {n: t.meta for n, t in tensors.items()}
+    shapes = {(m.block_shape, str(tensors[n].dtype))
+              for n, m in metas.items()}
+    if len(shapes) > 1:
+        raise ValueError(f"pool_models needs one block class; got {shapes}")
+
+    index = LSHIndex(n_bits=n_bits, bands=bands, seed=seed)
+    for name, t in tensors.items():
+        index.add_model(name, t)
+    groups = index.near_duplicate_groups()
+    grouped_refs = {r for g in groups for r in g}
+    group_of = {}
+    for gi, g in enumerate(groups):
+        for r in g:
+            group_of[r] = gi
+
+    # host copies once per model for hashing/stacking
+    host: Dict[str, np.ndarray] = {}
+    for name, t in tensors.items():
+        gh, gw = t.meta.grid
+        bh, bw = t.meta.block_shape
+        host[name] = np.asarray(t.data).reshape(gh, bh, gw, bw
+                                                ).transpose(0, 2, 1, 3)
+
+    slot_of: Dict[object, int] = {}  # hash key → slot
+    stacked: List[np.ndarray] = []
+    slots: Dict[str, np.ndarray] = {}
+    shared_hits = 0
+    total = 0
+    unique_seq = 0  # distinct key per ungrouped block (never shared)
+    for name, t in tensors.items():
+        gh, gw = t.meta.grid
+        grid = np.zeros((gh, gw), np.int32)
+        for i in range(gh):
+            for j in range(gw):
+                total += 1
+                blk = host[name][i, j]
+                ref = (name, (i, j))  # LSHIndex BlockRef convention
+                if ref in grouped_refs:
+                    # candidate near-dup: byte-exact key within its LSH
+                    # group decides sharing
+                    key = (group_of[ref],
+                           hashlib.blake2b(blk.tobytes(),
+                                           digest_size=16).digest())
+                else:
+                    key = ("u", unique_seq)  # unique, never shared
+                    unique_seq += 1
+                slot = slot_of.get(key)
+                if slot is None:
+                    slot = len(stacked)
+                    stacked.append(blk)
+                    slot_of[key] = slot
+                else:
+                    shared_hits += 1
+                grid[i, j] = slot
+        slots[name] = grid
+
+    pool = BlockPool(jnp.asarray(np.stack(stacked)), num_refs=total,
+                     total_blocks=total)
+    names = list(tensors)
+    pooled = {name: PooledTensor(pool, slots[name], metas[name],
+                                 owns_pool=(name == names[0]))
+              for name in names}
+    bytes_before = sum(int(np.prod(m.padded_shape))
+                       * tensors[n].data.dtype.itemsize
+                       for n, m in metas.items())
+    report = {
+        "models": len(tensors),
+        "total_blocks": total,
+        "unique_blocks": len(stacked),
+        "shared_block_refs": shared_hits,
+        "lsh_groups": len(groups),
+        "verified_pairs": index.verified_pairs,
+        "hbm_bytes_before": bytes_before,
+        "hbm_bytes_pooled": pool.nbytes,
+        "hbm_savings_pct": round(100 * (1 - pool.nbytes
+                                        / max(bytes_before, 1)), 1),
+    }
+    return pooled, report

@@ -40,14 +40,34 @@ DEFAULT_CANDIDATES = (
 def bench_placement_ab(width: int = 1100, batch: int = 4096,
                        labels: int = 16, rounds: int = 4,
                        history_path: str = ":memory:",
-                       seed: int = 0) -> Dict[str, object]:
+                       seed: int = 0,
+                       advisor_kind: str = "rule") -> Dict[str, object]:
     """Run ``rounds`` live FF-inference jobs under the advisor.
 
-    Round 1..n_arms explore (one run per arm); later rounds exploit the
-    measured winner. Returns per-arm mean wall seconds, the decisions
-    audit trail, and the exploit-phase speedup of learned-vs-worst."""
+    ``advisor_kind="rule"``: explore each arm once, then exploit the
+    measured winner (the frequency/rule-based optimizer).
+    ``advisor_kind="drl"``: the actor-critic
+    :class:`~netsdb_tpu.learning.rl.DRLPlacementAdvisor` makes the live
+    choices and learns from the measured on-chip rewards — the
+    reference's RLClient wired into live scheduling
+    (``src/selfLearning/headers/RLClient.h:18-38``), not just replay
+    training. Both speak the same choose/record surface, so the live
+    loop is identical; the returned dict adds ``converged`` (greedy
+    post-training choice == measured-mean winner) for the DRL arm.
+
+    Returns per-arm mean wall seconds, the decisions audit trail, and
+    the exploit-phase speedup of learned-vs-worst."""
     hdb = HistoryDB(history_path)
-    advisor = PlacementAdvisor(list(DEFAULT_CANDIDATES), hdb)
+    if advisor_kind == "drl":
+        from netsdb_tpu.learning.rl import DRLPlacementAdvisor
+
+        advisor = DRLPlacementAdvisor(list(DEFAULT_CANDIDATES), hdb,
+                                      seed=seed)
+    elif advisor_kind == "rule":
+        advisor = PlacementAdvisor(list(DEFAULT_CANDIDATES), hdb)
+    else:
+        raise ValueError(f"advisor_kind must be 'rule' or 'drl', "
+                         f"got {advisor_kind!r}")
     job = "ab-inference"
     rng = np.random.default_rng(seed)
     w1 = rng.standard_normal((width, width)).astype(np.float32) * 0.02
@@ -92,10 +112,18 @@ def bench_placement_ab(width: int = 1100, batch: int = 4096,
 
     means = {c.label: hdb.mean_elapsed(job, c.label)
              for c in advisor.candidates}
-    winner = advisor.choose(job).label
+    if advisor_kind == "drl":
+        winner = advisor.choose(job, explore=False).label
+    else:
+        winner = advisor.choose(job).label
     decisions = hdb.runs(f"{job}:decisions")
     worst = max(v for v in means.values() if v is not None)
     best = min(v for v in means.values() if v is not None)
-    return {"rounds": chosen, "mean_s": means, "winner": winner,
-            "decisions_recorded": len(decisions),
-            "learned_speedup": round(worst / best, 2) if best else None}
+    out = {"advisor": advisor_kind, "rounds": chosen, "mean_s": means,
+           "winner": winner, "decisions_recorded": len(decisions),
+           "learned_speedup": round(worst / best, 2) if best else None}
+    if advisor_kind == "drl":
+        by_mean = min((v, k) for k, v in means.items()
+                      if v is not None)[1]
+        out["converged"] = winner == by_mean
+    return out

@@ -26,11 +26,18 @@ from netsdb_tpu.learning.history import HistoryDB, get_history_db
 
 @dataclasses.dataclass(frozen=True)
 class PlacementCandidate:
-    """One sharding configuration, e.g. mesh (4,2) with batch on data."""
+    """One sharding configuration, e.g. mesh (4,2) with batch on data.
+
+    ``specs`` maps a set role to its decision value: a block-shape
+    tuple under ``"block"``, and/or a
+    :class:`~netsdb_tpu.parallel.placement.Placement` under
+    ``"placement"`` (or a specific set name) — ``Client.create_set``
+    applies the latter as the set's mesh sharding, making distribution
+    itself an arm of the bandit."""
 
     label: str
     mesh_shape: tuple
-    specs: Dict[str, tuple]  # set-role → PartitionSpec as tuple
+    specs: Dict[str, object]  # set-role → block tuple or Placement
 
 
 class PlacementAdvisor:

@@ -59,10 +59,19 @@ class FFModel:
         self.compute_dtype = compute_dtype
 
     # --- setup (ref ff::setup + createSet, SimpleFF.cc:60-82) ---------
-    def setup(self, client: Client) -> None:
+    def setup(self, client: Client,
+              placements: Optional[Dict[str, object]] = None) -> None:
+        """``placements`` maps set name → Placement: declare at createSet
+        how each model set shards over the mesh (inputs/activations on
+        ``data``, weight rows/cols on ``model``, biases replicated) —
+        the reference's per-set PartitionPolicy, upgraded from "which
+        worker" to "which mesh axis". Execution then distributes with no
+        further client involvement: the executor's jit sees the stored
+        shardings."""
         client.create_database(self.db)
         for s in self.SETS:
-            client.create_set(self.db, s)
+            client.create_set(self.db, s,
+                              placement=(placements or {}).get(s))
         client.register_type("FFMatrixBlock", "netsdb_tpu.core.blocked:BlockedTensor")
         # a live placement advisor (client.set_placement_advisor) may
         # have chosen the block shape at create_set — adopt it so the

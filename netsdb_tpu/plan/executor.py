@@ -83,13 +83,22 @@ def execute_computations(
     plan = plan_from_sinks(sinks)
     t0 = time.perf_counter()
 
+    from netsdb_tpu.relational.table import ColumnTable
+
     scan_values: Dict[int, Any] = {}
     tensor_scans: List[ScanSet] = []
     for node in plan.topo:
         if isinstance(node, ScanSet):
             ident = SetIdentifier(node.db, node.set_name)
             items = client.store.get_items(ident)
-            if len(items) == 1 and isinstance(items[0], BlockedTensor):
+            # single-tensor and single-table sets become traced jit
+            # arguments; when their arrays carry a NamedSharding from
+            # the set's placement, XLA partitions the whole stage and
+            # inserts the cross-device collectives (the reference's
+            # per-stage shuffle/broadcast threads,
+            # QuerySchedulerServer.cc:216-330)
+            if len(items) == 1 and isinstance(items[0],
+                                              (BlockedTensor, ColumnTable)):
                 scan_values[node.node_id] = items[0]
                 tensor_scans.append(node)
             else:
@@ -116,7 +125,7 @@ def execute_computations(
             # scan values are closed over (non-cacheable jobs only)
             canon = {n.node_id: i for i, n in enumerate(plan.topo)}
             host_values = {k: v for k, v in scan_values.items()
-                           if not isinstance(v, BlockedTensor)}
+                           if not isinstance(v, (BlockedTensor, ColumnTable))}
 
             def run(tensor_args: Dict[int, BlockedTensor],
                     _plan=plan, _canon=canon, _host=host_values):
@@ -159,6 +168,9 @@ def execute_computations(
             client.store.create_set(ident)
             if isinstance(out, BlockedTensor):
                 client.store.put_tensor(ident, out)
+            elif isinstance(out, ColumnTable):
+                client.store.clear_set(ident)
+                client.store.add_data(ident, [out])
             elif isinstance(out, dict):
                 client.store.clear_set(ident)
                 client.store.add_data(ident, list(out.items()))

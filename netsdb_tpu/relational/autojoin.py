@@ -1,0 +1,104 @@
+"""Automatic device equi-joins for string-keyed host data.
+
+Round 2's rule: host-object workloads with non-integer join keys ran on
+the interpreter path unless someone hand-built a columnar twin
+(``workloads/reddit_columnar.py``'s author→id maps). This module makes
+the device LUT-join path automatic:
+
+- :func:`table_from_objects` ingests arbitrary record objects
+  (dataclasses, namedtuples, plain attribute objects) through
+  ``ColumnTable.from_rows`` — string columns dictionary-encode exactly
+  as TPC-H columns do (``relational/table.py`` design rules).
+- :func:`equijoin` joins two tables on a (possibly string) key: the
+  two tables' dictionaries are UNIFIED host-side — the right table's
+  codes are remapped into the left's code space in O(|dict|), the same
+  division of labor as the LIKE-predicate LUTs — and the join itself
+  is one ``kernels.pk_fk_join`` gather on device.
+
+This is the reference's per-tuple hash join on ``String`` keys
+(``src/builtInPDBObjects/headers/JoinPairArray.h:122`` probing hashed
+``Handle<String>``) re-priced: strings hash once at ingest into dense
+codes, every probe is an int gather on the MXU-fed LUT path.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Dict, List, Optional, Sequence, Tuple
+
+import jax.numpy as jnp
+import numpy as np
+
+from netsdb_tpu.relational import kernels as K
+from netsdb_tpu.relational.table import ColumnTable
+
+
+def _record_to_row(obj: Any) -> Dict[str, Any]:
+    if isinstance(obj, dict):
+        return obj
+    if dataclasses.is_dataclass(obj):
+        return dataclasses.asdict(obj)
+    if hasattr(obj, "_asdict"):  # namedtuple
+        return obj._asdict()
+    return {k: v for k, v in vars(obj).items() if not k.startswith("_")}
+
+
+def table_from_objects(objs: Sequence[Any],
+                       date_cols: Sequence[str] = ()) -> ColumnTable:
+    """Host records → ColumnTable, strings dictionary-encoded at
+    ingest. The automatic columnarizer for object sets."""
+    return ColumnTable.from_rows([_record_to_row(o) for o in objs],
+                                 date_cols)
+
+
+def unify_key_codes(left: ColumnTable, left_key: str,
+                    right: ColumnTable, right_key: str
+                    ) -> Tuple[jnp.ndarray, jnp.ndarray, int]:
+    """Key columns of both tables in ONE integer code space.
+
+    Plain int keys pass through. Dictionary-encoded keys are unified
+    host-side: the merged dictionary extends the left table's, and the
+    right table's codes remap through an O(|dict|) LUT gather on
+    device. Returns (left_codes, right_codes, key_space)."""
+    l_dict = left.dicts.get(left_key)
+    r_dict = right.dicts.get(right_key)
+    if (l_dict is None) != (r_dict is None):
+        raise ValueError(
+            f"join key type mismatch: {left_key!r} "
+            f"{'string' if l_dict else 'int'} vs {right_key!r} "
+            f"{'string' if r_dict else 'int'}")
+    lc, rc = left[left_key], right[right_key]
+    if l_dict is None:
+        space = int(max(int(jnp.max(lc)) if lc.shape[0] else 0,
+                        int(jnp.max(rc)) if rc.shape[0] else 0)) + 1
+        return lc, rc, space
+    merged = {s: i for i, s in enumerate(l_dict)}
+    remap = np.empty(len(r_dict), np.int32)
+    for code, s in enumerate(r_dict):
+        if s not in merged:
+            merged[s] = len(merged)
+        remap[code] = merged[s]
+    rc = jnp.take(jnp.asarray(remap), rc)
+    return lc, rc, len(merged)
+
+
+def equijoin(left: ColumnTable, left_key: str,
+             right: ColumnTable, right_key: str,
+             take: Optional[Sequence[str]] = None,
+             prefix: str = "r_") -> ColumnTable:
+    """Inner PK-FK equi-join on device: ``right`` is the build side
+    (unique keys — dimension table), ``left`` the probe. Returns the
+    left table extended with ``take`` columns gathered from the right
+    (named ``prefix+col`` on collision), validity ANDed with the hit
+    mask. String keys ride automatically via dictionary unification."""
+    lc, rc, space = unify_key_codes(left, left_key, right, right_key)
+    ridx, hit = K.pk_fk_join(rc, lc, pk_mask=right.valid,
+                             fk_mask=left.valid, key_space=space)
+    out = left.filter(hit)
+    for col in (take if take is not None else right.cols):
+        if col == right_key:
+            continue
+        name = col if col not in out.cols else prefix + col
+        out = out.with_column(name, jnp.take(right[col], ridx),
+                              right.dicts.get(col))
+    return out

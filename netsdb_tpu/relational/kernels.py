@@ -93,11 +93,74 @@ def segment_sum(values: jnp.ndarray, segment_ids: jnp.ndarray,
     return jnp.zeros((num_segments,), v.dtype).at[ids].add(v)
 
 
+# Above the dense crossover but below this, the GRID formulation of a
+# count (one-hot int8 MXU matmul over an (H, 128) key grid — see
+# `count_grid`) beats the scatter-add: measured v5e @1M rows — 0.67 ms
+# grid vs 6.9 ms scatter at G=50k, crossing back over near H≈4600
+# (grid cost is linear in H = G/128; 17.8 ms at G=1.5M). 256k is the
+# conservative cap.
+_GRID_COUNT_MAX = 1 << 18
+
+
 def segment_count(segment_ids: jnp.ndarray, num_segments: int,
                   mask: Optional[jnp.ndarray] = None,
                   method: Optional[str] = None) -> jnp.ndarray:
+    if method == "grid" or (method is None
+                            and not _use_dense(num_segments, None)
+                            and num_segments <= _GRID_COUNT_MAX):
+        return count_grid(segment_ids, num_segments, mask)
     ones = jnp.ones(segment_ids.shape, jnp.int32)
     return segment_sum(ones, segment_ids, num_segments, mask, method)
+
+
+def _grid_reduce(folded_ids: jnp.ndarray, key_space: int,
+                 block: int, chunk: int) -> jnp.ndarray:
+    """Shared core of the grid kernels: per-key occurrence counts of
+    ``folded_ids`` (already masked: dropped rows hold -1) as one-hot
+    int8 matmuls over an (H, block) key grid — the MXU accumulates, no
+    scatter. ``folded_ids`` must already be padded to a multiple of
+    ``chunk``. Returns the (H, block) int32 count grid."""
+    H = (key_space + block - 1) // block
+    hi, lo = folded_ids // block, folded_ids % block
+
+    def step(acc, xs):
+        h, l = xs
+        m2 = (h[None, :] == jnp.arange(H, dtype=jnp.int32)[:, None]
+              ).astype(jnp.int8)
+        m1 = (l[:, None] == jnp.arange(block, dtype=jnp.int32)[None, :]
+              ).astype(jnp.int8)
+        return acc + jax.lax.dot(m2, m1,
+                                 preferred_element_type=jnp.int32), None
+
+    # carry init derives from the data so it inherits its varying manual
+    # axes under shard_map (a plain zeros const is unvarying and fails
+    # the scan carry typecheck there; no-op elsewhere)
+    init = jnp.zeros((H, block), jnp.int32) + folded_ids.sum() * 0
+    grid, _ = jax.lax.scan(step, init,
+                           (hi.reshape(-1, chunk), lo.reshape(-1, chunk)))
+    return grid
+
+
+def _pad_to(x: jnp.ndarray, chunk: int, fill) -> jnp.ndarray:
+    pad = (-x.shape[0]) % chunk
+    if not pad:
+        return x
+    return jnp.concatenate([x, jnp.full((pad,), fill, x.dtype)])
+
+
+def count_grid(segment_ids: jnp.ndarray, num_segments: int,
+               mask: Optional[jnp.ndarray] = None,
+               block: int = 128, chunk: int = 4096) -> jnp.ndarray:
+    """Exact per-segment counts on the grid path (`_grid_reduce`).
+    Masked and out-of-range rows fold into the index (-1 matches no
+    cell)."""
+    a = _pad_to(segment_ids, chunk, -1)
+    ok = (a >= 0) & (a < num_segments)
+    if mask is not None:
+        ok = ok & _pad_to(mask, chunk, False)
+    am = jnp.where(ok, a, jnp.int32(-1))
+    grid = _grid_reduce(am, num_segments, block, chunk)
+    return grid.reshape(-1)[:num_segments]
 
 
 def segment_min(values: jnp.ndarray, segment_ids: jnp.ndarray,
@@ -230,6 +293,64 @@ def member(build_keys: jnp.ndarray, probe_keys: jnp.ndarray,
         # (leftmost via searchsorted, last-writer via the LUT) works
         build_keys, probe_keys, build_mask, probe_mask, key_space, plan)
     return hit
+
+
+def any_by_key(keys: jnp.ndarray, flag: jnp.ndarray, key_space: int,
+               block: int = 128, chunk: int = 4096) -> jnp.ndarray:
+    """Per row: does ANY row sharing its key have ``flag`` set?
+    (Self-semi-join — reddit label propagation,
+    ref ``src/reddit/headers/RedditCommentLabelJoin.h``.)
+
+    Scatter-free formulation, measured on v5e at 1M rows / 50k keys
+    (2026-07, netsdb bench harness):
+
+    - the naive scatter-max + flat gather costs 13.6 ms — colliding
+      scatter updates serialize on TPU (see ``_use_dense``), and a flat
+      1M-row gather from a 50k table alone costs 6.7 ms;
+    - this kernel reshapes the key space into an (H, block) grid.
+      REDUCE: flagged keys become (hi, lo) one-hot int8 matrices whose
+      product accumulates the mark grid on the MXU (~0.7 ms — flag
+      folded into the index, so unflagged rows match no grid cell).
+      GATHER: per-row lookup = a row gather on ``hi`` (vectorized,
+      lane-wide) + a one-hot lane select on ``lo`` (~2.7 ms vs 6.7 for
+      the flat gather).
+    - total 3.45 ms = 3.9× over the scatter form. ``block=128`` (one
+      lane register) measured best; larger blocks only move cost from
+      rows to lanes.
+
+    Out-of-range keys return 0 and contribute nothing (orphan-key rule
+    of `_in_range`). Rows are padded to ``chunk`` internally.
+    """
+    n = keys.shape[0]
+    a = _pad_to(keys, chunk, -1)
+    f = _pad_to(flag, chunk, 0)
+    # flag folds into the index: unflagged rows match no grid cell
+    am = jnp.where((f != 0) & (a >= 0) & (a < key_space), a, jnp.int32(-1))
+    grid = _grid_reduce(am, key_space, block, chunk)
+    gridb = (grid > 0).astype(jnp.int8)  # marks, not counts
+    # gather phase chunked too: the (rows, block) select intermediate
+    # must stay VMEM-sized — unchunked it is N*block bytes (25 GB at
+    # 50M rows, an HBM OOM)
+    kin = (a >= 0) & (a < key_space)
+    kc = jnp.clip(a, 0, key_space - 1)
+    gchunk = 65536
+    gpad = (-kc.shape[0]) % gchunk
+    if gpad:
+        kc = jnp.concatenate([kc, jnp.zeros((gpad,), jnp.int32)])
+        kin = jnp.concatenate([kin, jnp.zeros((gpad,), jnp.bool_)])
+
+    def gstep(carry, xs):
+        k, k_ok = xs
+        rows = jnp.take(gridb, k // block, axis=0)
+        oneh = ((k % block)[:, None]
+                == jnp.arange(block, dtype=jnp.int32)[None, :])
+        got = jnp.where(oneh, rows, 0).sum(axis=1)
+        return carry, ((got > 0) & k_ok).astype(jnp.int32)
+
+    _, out = jax.lax.scan(gstep, jnp.zeros((), jnp.int32) + am.sum() * 0,
+                          (kc.reshape(-1, gchunk),
+                           kin.reshape(-1, gchunk)))
+    return out.reshape(-1)[:n]
 
 
 def top_k_masked(scores: jnp.ndarray, k: int,

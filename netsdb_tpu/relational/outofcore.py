@@ -29,7 +29,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-from netsdb_tpu.relational.table import ColumnTable, date_to_int
+from netsdb_tpu.relational.table import ColumnTable, date_to_int, int_to_date
 from netsdb_tpu.storage.paged import PagedTensorStore
 
 _INT_KINDS = "ib"
@@ -118,13 +118,14 @@ class PagedColumns:
         while True:
             chunk: Dict[str, np.ndarray] = {}
             start = n = None
-            done = False
+            exhausted, yielded = [], []
             for names, it in streams:
                 try:
                     s0, block = next(it)
                 except StopIteration:
-                    done = True
-                    break
+                    exhausted.append(names)
+                    continue
+                yielded.append(names)
                 if start is None:
                     start, n = s0, block.shape[0]
                 elif s0 != start or block.shape[0] != n:
@@ -133,7 +134,15 @@ class PagedColumns:
                         f"({s0},{block.shape[0]}) vs ({start},{n})")
                 for j, name in enumerate(names):
                     chunk[name] = block[:, j]
-            if done:
+            if exhausted:
+                # both streams must end on the same round — one ending
+                # early would otherwise silently truncate the other's
+                # remaining rows out of the query result
+                if yielded:
+                    raise RuntimeError(
+                        "int/float page streams desynchronized: "
+                        f"{exhausted} ended while {yielded} still had "
+                        f"blocks")
                 return
             pad = self.row_block - n
             if pad:
@@ -219,10 +228,104 @@ def ooc_q06(pc: PagedColumns, d0: str = "1994-01-01",
     return [("revenue", float(acc))]
 
 
+# ---------------------------------------------- Q03: out-of-core JOIN
+# The reference joins out of core by making the hash table itself a
+# partitioned, spillable object: build stages write a PartitionedHashSet
+# through HashSetManager, probe stages stream pages against it
+# (``src/queryExecution/headers/HashSetManager.h``,
+# ``HermesExecutionServer.cc:901``). The columnar equivalent here:
+#
+# - BUILD: customer ⋈ orders collapses to a dense per-orderkey LUT
+#   [qualifies, o_orderdate, o_shippriority], paged into the SAME
+#   spillable store as the data (row_block = partition size, so
+#   partition p is exactly block p — resident only while probed).
+# - PROBE: lineitem streams once per key-range partition; rows outside
+#   the partition are masked (grace-hash discipline: join state is
+#   bounded by the partition size, never by the key space). The probe
+#   fold is one compiled program reused across pages AND partitions.
+# - MERGE: per-partition top-k candidates merge on the host (tiny).
+
+@functools.partial(jax.jit, static_argnums=(0,))
+def _q03_probe_fold(cap: int, acc, start, qual, valid, okey, ship,
+                    price, disc, date):
+    from netsdb_tpu.relational import kernels as K
+
+    rel = okey - start
+    in_part = (rel >= 0) & (rel < cap)
+    relc = jnp.clip(rel, 0, cap - 1)
+    m = valid & in_part & (ship > date) & (jnp.take(qual, relc) > 0)
+    return acc + K.segment_sum(price * (1.0 - disc), relc, cap, m)
+
+
+def build_q03_side(store: PagedTensorStore,
+                   orders: Dict[str, np.ndarray],
+                   customer: Dict[str, np.ndarray],
+                   segment_code: int, date_int: int,
+                   key_cap: int, name: str = "q03.build") -> int:
+    """Build the resident side of the Q03 join: filter customers by
+    segment, join to orders (host-side build, the small tables), and
+    page the per-orderkey LUT into ``store`` partitioned by key range.
+    Returns the number of partitions."""
+    c_key = np.asarray(customer["c_custkey"])
+    c_ok = np.asarray(customer["c_mktsegment"]) == segment_code
+    cust_lut = np.zeros(int(c_key.max()) + 1, np.bool_)
+    cust_lut[c_key] = c_ok
+
+    o_key = np.asarray(orders["o_orderkey"])
+    o_cust = np.asarray(orders["o_custkey"])
+    o_date = np.asarray(orders["o_orderdate"])
+    o_prio = np.asarray(orders["o_shippriority"])
+    o_ok = (o_date < date_int) & cust_lut[o_cust]
+
+    n_keys = int(o_key.max()) + 1
+    build = np.zeros((n_keys, 3), np.int32)
+    build[o_key, 0] = o_ok
+    build[o_key, 1] = o_date
+    build[o_key, 2] = o_prio
+    store.put(name, build, row_block=key_cap)
+    return store.num_blocks(name)
+
+
+def ooc_q03(pc: PagedColumns, store: PagedTensorStore,
+            date: str = "1995-03-15", k: int = 10,
+            build_name: str = "q03.build") -> List[Dict[str, object]]:
+    """Q03 with lineitem streamed from pages and the join LUT loaded one
+    partition at a time — same result structure as ``queries.cq03``.
+    Peak device state: one partition's LUT column + one ``(cap,)``
+    revenue accumulator + one page of probe columns, independent of
+    table or key-space size."""
+    date_i = date_to_int(date)
+    num_parts = store.num_blocks(build_name)
+    cand: List[Dict[str, object]] = []
+    for p in range(num_parts):
+        start, bmat = store.read_block(build_name, p)
+        # static cap = this partition's row count; all full partitions
+        # share one compiled fold, the ragged tail compiles once more
+        cap = bmat.shape[0]
+        qual = jnp.asarray(bmat[:, 0])
+        acc = jnp.zeros((cap,), jnp.float32)
+        for cols, valid in pc.stream():
+            acc = _q03_probe_fold(cap, acc, start, qual, valid,
+                                  cols["l_orderkey"], cols["l_shipdate"],
+                                  cols["l_extendedprice"],
+                                  cols["l_discount"], date_i)
+        acc_h = np.asarray(acc)
+        top = np.argsort(-acc_h)[:k]
+        for i in top:
+            if acc_h[i] > 0:
+                cand.append({"okey": start + int(i),
+                             "odate": int_to_date(int(bmat[i, 1])),
+                             "revenue": float(acc_h[i])})
+    cand.sort(key=lambda r: (-r["revenue"], r["odate"]))
+    return cand[:k]
+
+
 Q01_COLUMNS = ["l_shipdate", "l_returnflag", "l_linestatus",
                "l_quantity", "l_extendedprice", "l_discount", "l_tax"]
 Q06_COLUMNS = ["l_shipdate", "l_discount", "l_quantity",
                "l_extendedprice"]
+Q03_COLUMNS = ["l_orderkey", "l_shipdate", "l_extendedprice",
+               "l_discount"]
 
 
 def bench_out_of_core(rows: int = 60_000_000,

@@ -63,10 +63,21 @@ def _lut(dictionary: List[str], pred: Callable[[str], bool]) -> jnp.ndarray:
                                    np.bool_, len(dictionary)))
 
 
+def _ct(tables: Tables, name: str) -> ColumnTable:
+    """Fetch a table for the direct columnar path, compacting away any
+    validity mask first (placement row-padding, applied filters): the
+    jitted cores below predate table masks and assume every row is real.
+    ``compact()`` is identity for mask-free tables, so the common path
+    costs one dict lookup. The set-API DAG path (relational/dag.py)
+    instead keeps the mask and ANDs it — static shapes for jit."""
+    return tables[name].compact()
+
+
 # ---------------------------------------------------------------- Q01
-@functools.partial(jax.jit, static_argnums=(0, 1))
-def _q01_core(n_groups, n_ls, ship, rf, ls, qty, price, disc, tax, delta):
-    mask = ship <= delta
+def _q01_fold(n_groups, n_ls, rf, ls, qty, price, disc, tax, mask):
+    """Shared Q01 reduction body — used by the direct columnar path
+    (`_q01_core`) and by the set-API DAG (`relational.dag.q01_sink`),
+    which ANDs the table validity mask in (placement row-padding)."""
     seg = rf * n_ls + ls
     qty = qty.astype(jnp.float32)
     disc_price = price * (1.0 - disc)
@@ -77,8 +88,14 @@ def _q01_core(n_groups, n_ls, ship, rf, ls, qty, price, disc, tax, delta):
     return jnp.stack(rows), K.segment_count(seg, n_groups, mask)
 
 
+@functools.partial(jax.jit, static_argnums=(0, 1))
+def _q01_core(n_groups, n_ls, ship, rf, ls, qty, price, disc, tax, delta):
+    return _q01_fold(n_groups, n_ls, rf, ls, qty, price, disc, tax,
+                     ship <= delta)
+
+
 def _args_q01(tables: Tables, delta_date: str = "1998-09-02"):
-    li = tables["lineitem"]
+    li = _ct(tables, "lineitem")
     n_ls = len(li.dicts["l_linestatus"])
     n_groups = len(li.dicts["l_returnflag"]) * n_ls
     return (n_groups, n_ls, li["l_shipdate"], li["l_returnflag"],
@@ -88,7 +105,7 @@ def _args_q01(tables: Tables, delta_date: str = "1998-09-02"):
 
 def cq01(tables: Tables, delta_date: str = "1998-09-02"):
     """Pricing summary report. One segment-reduction pass over lineitem."""
-    li = tables["lineitem"]
+    li = _ct(tables, "lineitem")
     n_ls = len(li.dicts["l_linestatus"])
     n_groups = len(li.dicts["l_returnflag"]) * n_ls
     sums, counts = jax.device_get(_q01_core(*_args_q01(tables, delta_date)))
@@ -148,8 +165,8 @@ def _q02_core(jp_part, jp_sup, jp_nat, jp_reg,
 
 def _args_q02(tables: Tables, size: int = 15, type_suffix: str = "BRUSHED",
               region: str = "EUROPE"):
-    part, ps = tables["part"], tables["partsupp"]
-    sup, nat, reg = tables["supplier"], tables["nation"], tables["region"]
+    part, ps = _ct(tables, "part"), _ct(tables, "partsupp")
+    sup, nat, reg = _ct(tables, "supplier"), _ct(tables, "nation"), _ct(tables, "region")
     type_ok = _lut(part.dicts["p_type"], lambda s: s.endswith(type_suffix))
     return (P.plan_join(part, "p_partkey", ps, "ps_partkey"),
             P.plan_join(sup, "s_suppkey", ps, "ps_suppkey"),
@@ -166,7 +183,7 @@ def _args_q02(tables: Tables, size: int = 15, type_suffix: str = "BRUSHED",
 def cq02(tables: Tables, size: int = 15, type_suffix: str = "BRUSHED",
          region: str = "EUROPE"):
     """Minimum-cost supplier per qualifying part."""
-    sup, nat = tables["supplier"], tables["nation"]
+    sup, nat = _ct(tables, "supplier"), _ct(tables, "nation")
     ints, cost_min = _q02_core(*_args_q02(tables, size, type_suffix, region))
     ints, cost_min = np.asarray(ints), np.asarray(cost_min)
     s_names = np.asarray(sup["s_name"])
@@ -203,8 +220,8 @@ def _q03_core(jp_orders, k, jp_cust, c_key, c_seg, o_key, o_cust, o_date,
 
 def _args_q03(tables: Tables, segment: str = "BUILDING",
               date: str = "1995-03-15", k: int = 10):
-    cust, orders, li = (tables["customer"], tables["orders"],
-                        tables["lineitem"])
+    cust, orders, li = (_ct(tables, "customer"), _ct(tables, "orders"),
+                        _ct(tables, "lineitem"))
     return (P.plan_join(orders, "o_orderkey", li, "l_orderkey"), k,
             P.plan_join(cust, "c_custkey", orders, "o_custkey"),
             cust["c_custkey"],
@@ -238,7 +255,7 @@ def _q04_core(n_pri, jp_li, o_key, o_date, o_pri, l_okey, l_commit,
 
 def _args_q04(tables: Tables, d0: str = "1993-07-01",
               d1: str = "1993-10-01"):
-    orders, li = tables["orders"], tables["lineitem"]
+    orders, li = _ct(tables, "orders"), _ct(tables, "lineitem")
     n_pri = len(orders.dicts["o_orderpriority"])
     return (n_pri, P.plan_join(li, "l_orderkey", orders, "o_orderkey"),
             orders["o_orderkey"], orders["o_orderdate"],
@@ -248,7 +265,7 @@ def _args_q04(tables: Tables, d0: str = "1993-07-01",
 
 def cq04(tables: Tables, d0: str = "1993-07-01", d1: str = "1993-10-01"):
     """Orders with ≥1 late lineitem, counted per priority."""
-    orders = tables["orders"]
+    orders = _ct(tables, "orders")
     n_pri = len(orders.dicts["o_orderpriority"])
     counts = np.asarray(_q04_core(*_args_q04(tables, d0, d1)))
     out = [(orders.decode("o_orderpriority", i), int(counts[i]))
@@ -268,7 +285,7 @@ def _q06_core(ship, discount, quantity, price, a, b, disc, qty):
 
 def _args_q06(tables: Tables, d0: str = "1994-01-01",
               d1: str = "1995-01-01", disc: float = 0.06, qty: int = 24):
-    li = tables["lineitem"]
+    li = _ct(tables, "lineitem")
     return (li["l_shipdate"], li["l_discount"],
             li["l_quantity"], li["l_extendedprice"],
             date_to_int(d0), date_to_int(d1), disc, qty)
@@ -297,7 +314,7 @@ def _q12_core(n_modes, jp_orders, o_key, o_pri, l_okey, l_mode, l_ship,
 
 def _args_q12(tables: Tables, mode1: str = "MAIL", mode2: str = "SHIP",
               d0: str = "1994-01-01", d1: str = "1995-01-01"):
-    orders, li = tables["orders"], tables["lineitem"]
+    orders, li = _ct(tables, "orders"), _ct(tables, "lineitem")
     n_modes = len(li.dicts["l_shipmode"])
     m1, m2 = li.code("l_shipmode", mode1), li.code("l_shipmode", mode2)
     hi = _lut(orders.dicts["o_orderpriority"],
@@ -312,7 +329,7 @@ def _args_q12(tables: Tables, mode1: str = "MAIL", mode2: str = "SHIP",
 def cq12(tables: Tables, mode1: str = "MAIL", mode2: str = "SHIP",
          d0: str = "1994-01-01", d1: str = "1995-01-01"):
     """High/low-priority lineitems per ship mode."""
-    li = tables["lineitem"]
+    li = _ct(tables, "lineitem")
     m1, m2 = li.code("l_shipmode", mode1), li.code("l_shipmode", mode2)
     packed = np.asarray(_q12_core(*_args_q12(tables, mode1, mode2, d0, d1)))
     out = [(li.decode("l_shipmode", m),
@@ -348,7 +365,7 @@ def _q13_per_cust(n_cust, o_cust, keep, c_key):
 def _q13_keep(tables: Tables, word1: str, word2: str) -> jnp.ndarray:
     import re
 
-    orders = tables["orders"]
+    orders = _ct(tables, "orders")
     if "o_comment" in orders.dicts:
         pat = re.compile(f"{re.escape(word1)}.*{re.escape(word2)}")
         keep_lut = _lut(orders.dicts["o_comment"],
@@ -359,7 +376,7 @@ def _q13_keep(tables: Tables, word1: str, word2: str) -> jnp.ndarray:
 
 def _args_q13(tables: Tables, word1: str = "special",
               word2: str = "requests"):
-    cust, orders = tables["customer"], tables["orders"]
+    cust, orders = _ct(tables, "customer"), _ct(tables, "orders")
     return (key_space(cust, "c_custkey"), _Q13_CAP, orders["o_custkey"],
             _q13_keep(tables, word1, word2), cust["c_custkey"])
 
@@ -367,7 +384,7 @@ def _args_q13(tables: Tables, word1: str = "special",
 def cq13(tables: Tables, word1: str = "special", word2: str = "requests"):
     """Histogram of per-customer order counts (zero included — the
     left-outer-join semantics)."""
-    cust, orders = tables["customer"], tables["orders"]
+    cust, orders = _ct(tables, "customer"), _ct(tables, "orders")
     n_cust = key_space(cust, "c_custkey")
     args = _args_q13(tables, word1, word2)
     keep = args[3]  # reused by the over-cap exact fallback below
@@ -394,7 +411,7 @@ def _q14_core(jp_part, p_key, p_type, l_part, l_ship, l_price, l_disc,
 
 def _args_q14(tables: Tables, d0: str = "1995-09-01",
               d1: str = "1995-10-01"):
-    li, part = tables["lineitem"], tables["part"]
+    li, part = _ct(tables, "lineitem"), _ct(tables, "part")
     promo = _lut(part.dicts["p_type"], lambda s: s.startswith("PROMO"))
     return (P.plan_join(part, "p_partkey", li, "l_partkey"),
             part["p_partkey"], part["p_type"], li["l_partkey"],
@@ -423,7 +440,7 @@ def _q17_core(jp_part, p_key, p_brand, p_cont, l_part, l_qty, l_price,
 
 def _args_q17(tables: Tables, brand: str = "Brand#23",
               container: str = "MED BOX"):
-    li, part = tables["lineitem"], tables["part"]
+    li, part = _ct(tables, "lineitem"), _ct(tables, "part")
     return (P.plan_join(part, "p_partkey", li, "l_partkey"),
             part["p_partkey"],
             part["p_brand"], part["p_container"], li["l_partkey"],
@@ -470,7 +487,7 @@ def q22_code_lut(phone_dict: List[str], prefixes: Sequence[str]
 def _args_q22(tables: Tables,
               prefixes: Sequence[str] = ("13", "31", "23", "29", "30",
                                          "18", "17")):
-    cust, orders = tables["customer"], tables["orders"]
+    cust, orders = _ct(tables, "customer"), _ct(tables, "orders")
     pref_list, code_lut = q22_code_lut(cust.dicts["c_phone"], prefixes)
     return (len(pref_list),
             P.plan_join(orders, "o_custkey", cust, "c_custkey"),

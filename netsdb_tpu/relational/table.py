@@ -31,6 +31,8 @@ from typing import Any, Dict, List, Optional, Sequence
 import jax.numpy as jnp
 import numpy as np
 
+import jax.tree_util
+
 _DATE_RE = re.compile(r"^(\d{4})-(\d{2})-(\d{2})$")
 
 
@@ -61,6 +63,29 @@ def _encode_strings(values: List[str], is_date: bool):
     code = {s: i for i, s in enumerate(uniq)}
     return jnp.asarray(np.fromiter((code[v] for v in values),
                                    np.int32, len(values))), uniq
+
+
+class _TableAuxKey:
+    """Hashable static metadata of a ColumnTable (column names + string
+    dictionaries) with the hash precomputed once — jit cache lookups on
+    table arguments stay O(1) after the first (identity fast path), not
+    O(total dictionary bytes) per call."""
+
+    __slots__ = ("names", "dicts", "_hash")
+
+    def __init__(self, names, dicts):
+        self.names = names
+        self.dicts = dicts
+        self._hash = hash((names, dicts))
+
+    def __hash__(self):
+        return self._hash
+
+    def __eq__(self, other):
+        if self is other:
+            return True
+        return (isinstance(other, _TableAuxKey) and self._hash == other._hash
+                and self.names == other.names and self.dicts == other.dicts)
 
 
 @dataclasses.dataclass
@@ -164,6 +189,32 @@ class ColumnTable:
     def decode(self, name: str, code: int) -> str:
         return self.dicts[name][int(code)]
 
+    def compact(self) -> "ColumnTable":
+        """Materialize validity: drop invalid rows (placement padding,
+        applied filters) and return a mask-free table. Host-side dynamic
+        shape — call OUTSIDE jit; traced code uses the mask algebra
+        instead. This is the bridge from a placement-padded stored table
+        back to the direct columnar query path, which assumes every row
+        is real."""
+        if self.valid is None:
+            return self
+        cached = self.__dict__.get("_compacted")
+        if cached is not None:
+            return cached
+        keep = np.asarray(self.valid)
+        if bool(keep.all()):
+            out = ColumnTable(self.cols, self.dicts, None)
+        else:
+            idx = jnp.asarray(np.flatnonzero(keep))
+            out = ColumnTable({n: jnp.take(c, idx, axis=0)
+                               for n, c in self.cols.items()},
+                              self.dicts, None)
+        # memoized: repeated direct-path queries over one stored table
+        # must not re-gather per call (and downstream per-table caches —
+        # column stats, join plans — key on the compacted instance)
+        self.__dict__["_compacted"] = out
+        return out
+
     # --- relational verbs (mask algebra) ------------------------------
     def filter(self, mask: jnp.ndarray) -> "ColumnTable":
         """AND a predicate mask into validity. Shapes unchanged."""
@@ -199,6 +250,39 @@ class ColumnTable:
         v = state["valid"]
         self.valid = None if v is None else jnp.asarray(v)
 
+    # --- pytree protocol ----------------------------------------------
+    # Registered below: a ColumnTable is a jit-traceable value (columns
+    # and validity are leaves; names and string dictionaries are static
+    # metadata). This is what lets a table stored in a set become a
+    # *traced argument* of a compiled query plan — and, when its columns
+    # carry a NamedSharding from a set placement, what lets XLA
+    # partition the whole query and insert the collectives
+    # (netsdb_tpu.parallel.placement).
+    def tree_flatten(self):
+        names = tuple(sorted(self.cols))
+        children = tuple(self.cols[n] for n in names) + (self.valid,)
+        # Dictionaries can be huge (e.g. a comment column ≈ one string
+        # per row); a query executes on every call but the dict content
+        # never changes after construction, so the aux key — tuple copy
+        # AND its hash — is built once per table, not per flatten
+        # (protects the executor's compiled-plan fast path).
+        key = self.__dict__.get("_aux_key")
+        if key is None or key.names != names:
+            key = _TableAuxKey(
+                names, tuple((k, tuple(v))
+                             for k, v in sorted(self.dicts.items())))
+            self.__dict__["_aux_key"] = key
+        return children, key
+
+    @classmethod
+    def tree_unflatten(cls, aux, children):
+        obj = cls.__new__(cls)
+        obj.cols = dict(zip(aux.names, children[:-1]))
+        obj.dicts = {k: list(v) for k, v in aux.dicts}
+        obj.valid = children[-1]
+        obj.__dict__["_aux_key"] = aux
+        return obj
+
     # --- host materialization ----------------------------------------
     def to_rows(self, date_cols: Sequence[str] = ()) -> List[Dict[str, Any]]:
         """Decode to row dicts (drops invalid rows). Host-side; for
@@ -224,3 +308,10 @@ class ColumnTable:
                     row[n] = int(v)
             out.append(row)
         return out
+
+
+jax.tree_util.register_pytree_node(
+    ColumnTable,
+    ColumnTable.tree_flatten,
+    ColumnTable.tree_unflatten,
+)

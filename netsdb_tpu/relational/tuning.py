@@ -172,8 +172,16 @@ def measure_join_crossover(n_build: int = 1 << 17, n_probe: int = 1 << 19,
     best = 0.0  # stays 0 if the LUT never wins, recording "sort always"
     for f in factors:
         ks = int(f * (n_build + n_probe))
-        pk = jnp.asarray(rng.choice(ks, n_build, replace=False)
-                         .astype(np.int32))
+        # unique build keys WITHOUT materializing a ks-sized permutation
+        # (Generator.choice(replace=False) builds one — ~670 MB at the
+        # largest factor): oversample with replacement, dedup, trim.
+        # Only uniqueness among the n_build keys matters.
+        draw = rng.integers(0, ks, int(n_build * 1.3) + 16)
+        pk_u = np.unique(draw)[:n_build]
+        while len(pk_u) < n_build:  # sparse-collision retry, ~never loops
+            extra = rng.integers(0, ks, n_build)
+            pk_u = np.unique(np.concatenate([pk_u, extra]))[:n_build]
+        pk = jnp.asarray(rng.permutation(pk_u).astype(np.int32))
         fk = jnp.asarray(rng.integers(0, ks, n_probe).astype(np.int32))
 
         def lut(p, q, ks=ks):

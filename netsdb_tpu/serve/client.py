@@ -25,6 +25,7 @@ from netsdb_tpu.serve.protocol import (
     CODEC_MSGPACK,
     CODEC_PICKLE,
     MsgType,
+    ProtocolError,
     recv_frame,
     send_frame,
     tensor_to_wire,
@@ -38,6 +39,17 @@ class RemoteError(RuntimeError):
         super().__init__(f"{kind}: {message}")
         self.kind = kind
         self.remote_traceback = remote_traceback
+
+
+class RemoteTableInfo:
+    """Summary of a daemon-side table ingest (``send_table`` reply)."""
+
+    def __init__(self, num_rows: int, columns: list):
+        self.num_rows = num_rows
+        self.columns = columns
+
+    def __repr__(self):
+        return f"RemoteTableInfo(rows={self.num_rows}, cols={self.columns})"
 
 
 class RemoteTensor:
@@ -93,6 +105,11 @@ class RemoteClient:
         self._lock = threading.Lock()  # one in-flight request per conn
         self._sock: Optional[socket.socket] = None
         self._timeout = timeout
+        # thread id that currently drives a streaming reply (scan_stream
+        # / chunked pulls) — a nested request from that thread must NOT
+        # wait on the lock (self-deadlock) nor write to the streaming
+        # socket (frame corruption); it gets a one-shot side connection
+        self._stream_owner: Optional[int] = None
         self._connect()
 
     # --- transport ----------------------------------------------------
@@ -108,8 +125,35 @@ class RemoteClient:
                               reply.get("message", "handshake refused"))
         self._sock = s
 
+    def _oneshot_request(self, msg_type: MsgType, payload: Any,
+                         codec: int) -> Any:
+        """Issue one request over a throwaway connection — used when the
+        caller's thread is mid-stream on the main connection (e.g.
+        ``for item in c.scan_stream(...): c.send_data(...)``), which
+        must neither block on the held lock nor interleave frames."""
+        s = socket.create_connection((self.host, self.port),
+                                     timeout=self._timeout)
+        try:
+            s.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+            send_frame(s, MsgType.HELLO, {"token": self.token})
+            typ, reply = recv_frame(s, allow_pickle=False)
+            if typ == MsgType.ERR:
+                raise RemoteError(reply.get("error", "Error"),
+                                  reply.get("message", "handshake refused"))
+            send_frame(s, msg_type, payload, codec)
+            typ, reply = recv_frame(s, allow_pickle=True)
+        finally:
+            s.close()
+        if typ == MsgType.ERR:
+            raise RemoteError(reply.get("error", "Error"),
+                              reply.get("message", ""),
+                              reply.get("traceback", ""))
+        return reply
+
     def _request(self, msg_type: MsgType, payload: Any,
                  codec: int = CODEC_MSGPACK) -> Any:
+        if self._stream_owner == threading.get_ident():
+            return self._oneshot_request(msg_type, payload, codec)
         with self._lock:
             if self._sock is None:
                 self._connect()
@@ -168,11 +212,19 @@ class RemoteClient:
 
     def create_set(self, db: str, set_name: str, type_name: str = "tensor",
                    persistence: str = "transient", eviction: str = "lru",
-                   partition_lambda: Optional[str] = None):
+                   partition_lambda: Optional[str] = None,
+                   placement=None):
+        """``placement`` may be a Placement (serialized via ``to_meta``)
+        or its meta dict; the daemon applies it to all ingest into the
+        set (distribution declared at createSet, as in the reference's
+        PartitionPolicy)."""
+        if placement is not None and hasattr(placement, "to_meta"):
+            placement = placement.to_meta()
         self._request(MsgType.CREATE_SET, {
             "db": db, "set": set_name, "type_name": type_name,
             "persistence": persistence, "eviction": eviction,
-            "partition_lambda": partition_lambda})
+            "partition_lambda": partition_lambda,
+            "placement": placement})
         return RemoteIdent(db, set_name)
 
     def remove_set(self, db: str, set_name: str) -> None:
@@ -189,15 +241,58 @@ class RemoteClient:
         return [tuple(s) for s in
                 self._request(MsgType.LIST_SETS, {})["sets"]]
 
-    def register_type(self, type_name: str, entry_point: str) -> None:
+    def register_type(self, type_name: str, entry_point: str,
+                      source: Optional[str] = None,
+                      ship_module: bool = False) -> None:
+        """``source``/``ship_module`` ship the UDF module's code to the
+        daemon (the reference's .so replication on registerType) so
+        EXECUTE_PLAN can bind types the server never installed. Shipped
+        source is code the daemon executes — same trust boundary as the
+        pickle codec (serve/protocol.py security note)."""
+        if ship_module and source is None:
+            from netsdb_tpu.catalog.catalog import read_module_source
+
+            source = read_module_source(entry_point)
         self._request(MsgType.REGISTER_TYPE,
-                      {"type_name": type_name, "entry_point": entry_point})
+                      {"type_name": type_name, "entry_point": entry_point,
+                       "source": source})
 
     # --- data path ----------------------------------------------------
     def send_data(self, db: str, set_name: str, items: Sequence[Any]) -> None:
         self._request(MsgType.SEND_DATA,
                       {"db": db, "set": set_name, "items": list(items)},
                       codec=CODEC_PICKLE)
+
+    def send_table(self, db: str, set_name: str, rows_or_table,
+                   date_cols: Sequence[str] = ()) -> "RemoteTableInfo":
+        """Ship rows (or a pre-built ColumnTable) for daemon-side
+        columnar ingest — dictionary encoding + the set's placement
+        happen server-side, where the devices are. Returns a
+        :class:`RemoteTableInfo` quacking like the ingested table's
+        summary (``num_rows``/``columns``), mirroring the in-process
+        facade without pulling the whole table back."""
+        from netsdb_tpu.relational.table import ColumnTable
+
+        items = (rows_or_table if isinstance(rows_or_table, ColumnTable)
+                 else list(rows_or_table))
+        reply = self._request(
+            MsgType.SEND_DATA,
+            {"db": db, "set": set_name, "items": items,
+             "as_table": True, "date_cols": list(date_cols)},
+            codec=CODEC_PICKLE)
+        return RemoteTableInfo(reply["count"], list(reply["columns"]))
+
+    def get_table(self, db: str, set_name: str):
+        """Fetch a table set as a host-side ColumnTable (pickled via its
+        numpy ``__getstate__``)."""
+        items = list(self.get_set_iterator(db, set_name))
+        from netsdb_tpu.relational.table import ColumnTable
+
+        tables = [i for i in items if isinstance(i, ColumnTable)]
+        if len(tables) != 1:
+            raise ValueError(
+                f"set {db}:{set_name} holds {len(tables)} tables; expected 1")
+        return tables[0]
 
     def send_matrix(self, db: str, set_name: str, dense, block_shape=None,
                     dtype=None) -> RemoteTensor:
@@ -211,9 +306,96 @@ class RemoteClient:
         reply = self._request(MsgType.GET_TENSOR, {"db": db, "set": set_name})
         return RemoteTensor(reply["data"], reply.get("block_shape"))
 
+    def get_tensor_chunked(self, db: str, set_name: str,
+                           chunk_bytes: int = 8 << 20) -> RemoteTensor:
+        """Pull a tensor as a chunked stream: client holds the result
+        array plus ONE chunk (vs. array + full frame for GET_TENSOR) —
+        the page-streamed model transfer path for big weight sets."""
+        meta = None
+        buf = None
+        off = 0
+        for frame in self._stream(MsgType.GET_TENSOR_CHUNKED,
+                                  {"db": db, "set": set_name,
+                                   "chunk_bytes": int(chunk_bytes)}):
+            if meta is None:
+                meta = frame["meta"]
+                buf = bytearray(meta["nbytes"])
+            else:
+                b = frame["b"]
+                buf[off:off + len(b)] = b
+                off += len(b)
+        if meta is None:
+            raise ProtocolError("empty chunked-tensor stream")
+        dense = np.frombuffer(bytes(buf), dtype=np.dtype(meta["dtype"])
+                              ).reshape(meta["shape"])
+        return RemoteTensor(dense, meta.get("block_shape"))
+
     def get_set_iterator(self, db: str, set_name: str) -> Iterator[Any]:
         reply = self._request(MsgType.SCAN_SET, {"db": db, "set": set_name})
         return iter(reply["items"])
+
+    def scan_stream(self, db: str, set_name: str,
+                    max_frame_bytes: int = 4 << 20) -> Iterator[Any]:
+        """Stream a set's items with bounded buffering on both ends:
+        the server packs ≤ ``max_frame_bytes`` of pickled items per
+        frame; this generator holds one frame at a time. The streamed
+        ``getSetIterator`` (ref FrontendQueryTestServer.cc:785-890).
+
+        The connection is held for the duration of the iteration (one
+        in-flight request per connection, as in the reference's
+        PDBCommunicator); abandoning the iterator early closes the
+        socket so the next request reconnects cleanly."""
+        import pickle
+
+        for frame in self._stream(MsgType.SCAN_SET_STREAM,
+                                  {"db": db, "set": set_name,
+                                   "max_frame_bytes": int(max_frame_bytes)}):
+            for blob in frame["blobs"]:
+                yield pickle.loads(blob)
+
+    def _stream(self, msg_type: MsgType, payload: Any) -> Iterator[Any]:
+        """Issue a streaming request; yield each STREAM_ITEM payload
+        until STREAM_END. ERR aborts with RemoteError. If the consumer
+        abandons the generator mid-stream, the socket is dropped (a
+        half-read stream cannot be resynchronized)."""
+        self._lock.acquire()
+        self._stream_owner = threading.get_ident()
+        done = False
+        try:
+            if self._sock is None:
+                self._connect()
+            send_frame(self._sock, msg_type, payload)
+            while True:
+                typ, reply = recv_frame(self._sock, allow_pickle=True)
+                if typ == MsgType.STREAM_END:
+                    done = True
+                    return
+                if typ == MsgType.ERR:
+                    done = True  # ERR terminates the stream; conn is sync'd
+                    raise RemoteError(reply.get("error", "Error"),
+                                      reply.get("message", ""),
+                                      reply.get("traceback", ""))
+                yield reply
+        except (ConnectionError, OSError):
+            done = False
+            raise
+        finally:
+            self._stream_owner = None
+            if not done and self._sock is not None:
+                try:
+                    self._sock.close()
+                finally:
+                    self._sock = None
+            self._lock.release()
+
+    def dedup_resident(self, sets: Sequence[Tuple[str, str]],
+                       bands: int = 16, seed: int = 0) -> Dict[str, Any]:
+        """Daemon-side block-level model dedup: shared blocks across the
+        given weight sets materialize once in HBM (see
+        ``Client.dedup_resident``). Returns the pooling report."""
+        return self._request(MsgType.DEDUP_RESIDENT,
+                             {"sets": [list(s) for s in sets],
+                              "bands": bands, "seed": seed})
 
     def add_shared_mapping(self, private_db: str, private_set: str,
                            shared_db: str, shared_set: str,

@@ -16,8 +16,11 @@ bulk data never round-trips through pickle.
 
 Security note: codec 1 executes code on deserialization, exactly like
 the reference's ``registerType`` shipping .so binaries that the server
-``dlopen``s. The serve layer is a trusted-cluster control plane; an
-optional shared token (HELLO handshake) gates connections.
+``dlopen``s. The same boundary applies to REGISTER_TYPE frames carrying
+module ``source`` (the .so-bytes analogue, executed daemon-side on
+first EXECUTE_PLAN bind — ``server.resolve_entry_point``). The serve
+layer is a trusted-cluster control plane; an optional shared token
+(HELLO handshake) gates connections.
 """
 
 from __future__ import annotations
@@ -49,6 +52,12 @@ class MsgType(IntEnum):
     ERR = 3
     PING = 4
     SHUTDOWN = 5
+    # streamed replies (ref: FrontendQueryTestServer paging results back
+    # page-by-page, FrontendQueryTestServer.cc:785-890): a streaming
+    # request is answered by N STREAM_ITEM frames then one STREAM_END;
+    # an ERR frame aborts the stream
+    STREAM_ITEM = 6
+    STREAM_END = 7
     # catalog / DDL (ref Cat* + DistributedStorageAddSet family)
     CREATE_DATABASE = 10
     CREATE_SET = 11
@@ -65,6 +74,11 @@ class MsgType(IntEnum):
     ADD_SHARED_MAPPING = 24
     FLUSH_DATA = 25
     LOAD_SET = 26
+    # streamed data path: bounded-memory scan / chunked tensor pull
+    SCAN_SET_STREAM = 27
+    GET_TENSOR_CHUNKED = 28
+    # serve-time model dedup: pool shared blocks across resident models
+    DEDUP_RESIDENT = 29
     # query execution (ref ExecuteComputation)
     EXECUTE_COMPUTATIONS = 30
     EXECUTE_PLAN = 31

@@ -20,6 +20,8 @@ overcommitting the controller.
 from __future__ import annotations
 
 import importlib
+import importlib.util
+import inspect
 import itertools
 import socket
 import threading
@@ -44,12 +46,33 @@ from netsdb_tpu.serve.protocol import (
 from netsdb_tpu.storage.store import SetIdentifier
 
 
-def resolve_entry_point(entry: str) -> Any:
+def resolve_entry_point(entry: str, source: Optional[str] = None) -> Any:
     """'pkg.mod:attr' → live object — the analogue of the reference
     loading a registered UDF .so and fixing up its vtable
-    (``src/objectModel/headers/VTableMap.h:36-80``)."""
+    (``src/objectModel/headers/VTableMap.h:36-80``).
+
+    ``source``: shipped module text from the catalog. If the module is
+    not importable here, it is exec'd into a fresh module under the
+    shipped name (the daemon-side ``dlopen`` of a replicated .so,
+    ``PDBCatalog.h:45-50``). TRUST BOUNDARY: executing shipped source
+    is code execution by design, exactly like the pickle codec
+    (serve/protocol.py security note) and the reference's .so shipping
+    — the serve layer is a trusted-cluster control plane behind the
+    HELLO token."""
     mod_name, _, attr = entry.partition(":")
-    obj = importlib.import_module(mod_name)
+    try:
+        obj = importlib.import_module(mod_name)
+    except ModuleNotFoundError:
+        if source is None:
+            raise
+        import sys
+
+        spec = importlib.util.spec_from_loader(mod_name, loader=None)
+        mod = importlib.util.module_from_spec(spec)
+        exec(compile(source, f"<registered:{mod_name}>", "exec"),
+             mod.__dict__)
+        sys.modules[mod_name] = mod  # later imports see the shipped code
+        obj = mod
     for part in attr.split(".") if attr else []:
         obj = getattr(obj, part)
     return obj
@@ -92,7 +115,10 @@ class ServeController:
             MsgType.SEND_MATRIX: self._on_send_matrix,
             MsgType.GET_TENSOR: self._on_get_tensor,
             MsgType.SCAN_SET: self._on_scan_set,
+            MsgType.SCAN_SET_STREAM: self._on_scan_set_stream,
+            MsgType.GET_TENSOR_CHUNKED: self._on_get_tensor_chunked,
             MsgType.ADD_SHARED_MAPPING: self._on_add_shared_mapping,
+            MsgType.DEDUP_RESIDENT: self._on_dedup_resident,
             MsgType.FLUSH_DATA: self._on_flush_data,
             MsgType.LOAD_SET: self._on_load_set,
             MsgType.EXECUTE_COMPUTATIONS: self._on_execute_computations,
@@ -185,6 +211,22 @@ class ServeController:
                     if handler is None:
                         raise ProtocolError(f"no handler for {typ!r}")
                     out = handler(payload)
+                    if inspect.isgenerator(out):
+                        # streaming handler: each yielded (type, payload
+                        # [, codec]) goes out as its own frame; TCP
+                        # backpressure bounds server buffering to ONE
+                        # frame (the reference's page-by-page result
+                        # streaming, FrontendQueryTestServer.cc:785-890).
+                        # The contract: ends with STREAM_END, or ERR on
+                        # a mid-stream failure — either way the
+                        # connection stays frame-synchronized.
+                        for frame in out:
+                            if len(frame) == 3:
+                                f_type, f_payload, f_codec = frame
+                            else:
+                                (f_type, f_payload), f_codec = frame, CODEC_MSGPACK
+                            send_frame(conn, f_type, f_payload, f_codec)
+                        continue
                     if len(out) == 3:  # handler picked the reply codec
                         reply_type, reply, codec = out
                     else:
@@ -243,7 +285,8 @@ class ServeController:
             p["db"], p["set"], type_name=p.get("type_name", "tensor"),
             persistence=p.get("persistence", "transient"),
             eviction=p.get("eviction", "lru"),
-            partition_lambda=p.get("partition_lambda"))
+            partition_lambda=p.get("partition_lambda"),
+            placement=p.get("placement"))  # Placement.to_meta dict
         return MsgType.OK, {}
 
     def _on_remove_set(self, p):
@@ -261,11 +304,29 @@ class ServeController:
         return MsgType.OK, {"sets": [list(i) for i in self.library.store.list_sets()]}
 
     def _on_register_type(self, p):
-        self.library.register_type(p["type_name"], p["entry_point"])
+        self.library.register_type(p["type_name"], p["entry_point"],
+                                   source=p.get("source"))
         return MsgType.OK, {}
+
+    def _resolve_registered(self, name_or_entry: str) -> Any:
+        """Resolve a registry value: a registered type name goes through
+        the catalog (picking up shipped source for modules the daemon
+        doesn't have installed); anything else is a raw entry point."""
+        entry = self.library.catalog.get_type(name_or_entry)
+        if entry is not None:
+            return resolve_entry_point(
+                entry, self.library.catalog.get_type_source(name_or_entry))
+        return resolve_entry_point(name_or_entry)
 
     def _on_send_data(self, p):
         # objects arrive via the pickle codec (whole payload is a dict)
+        if p.get("as_table"):
+            # rows → one dictionary-encoded ColumnTable, sharded by the
+            # set's placement (dispatcher page-building + partitioning)
+            t = self.library.send_table(p["db"], p["set"], p["items"],
+                                        date_cols=p.get("date_cols", ()))
+            return MsgType.OK, {"count": t.num_rows,
+                                "columns": sorted(t.cols)}
         self.library.send_data(p["db"], p["set"], p["items"])
         return MsgType.OK, {"count": len(p["items"])}
 
@@ -287,6 +348,76 @@ class ServeController:
         items = list(self.library.get_set_iterator(p["db"], p["set"]))
         # host objects are arbitrary Python → pickle codec on the reply
         return MsgType.OK, {"items": items}, CODEC_PICKLE
+
+    def _on_scan_set_stream(self, p):
+        """Streamed scan: items go out in frames of ≤ ``max_frame_bytes``
+        of pickled payload each — the server never materializes the
+        whole set's wire form, and TCP backpressure holds buffering to
+        one frame (ref FrontendQueryTestServer.cc:785-890 paging results
+        to the client page by page).
+
+        Each item is pickled once; a frame carries a list of those
+        blobs (msgpack bin), so budget accounting is exact."""
+        import pickle
+
+        budget = int(p.get("max_frame_bytes") or (4 << 20))
+
+        def stream():
+            seq = 0
+            total = 0
+            blobs, size = [], 0
+            for item in self.library.get_set_iterator(p["db"], p["set"]):
+                b = pickle.dumps(item, protocol=pickle.HIGHEST_PROTOCOL)
+                if blobs and size + len(b) > budget:
+                    yield MsgType.STREAM_ITEM, {"seq": seq, "blobs": blobs}
+                    seq += 1
+                    blobs, size = [], 0
+                blobs.append(b)
+                size += len(b)
+                total += 1
+            if blobs:
+                yield MsgType.STREAM_ITEM, {"seq": seq, "blobs": blobs}
+                seq += 1
+            yield MsgType.STREAM_END, {"frames": seq, "items": total}
+
+        return stream()
+
+    def _on_get_tensor_chunked(self, p):
+        """Chunked tensor pull: one meta frame, then the dense buffer in
+        ``chunk_bytes`` slices, then STREAM_END. Bounds the *transfer*
+        buffering to one chunk on each side (vs. a single frame holding
+        the full payload twice); the dense host materialization itself
+        is one copy, as in `_on_get_tensor`."""
+        t = self.library.get_tensor(p["db"], p["set"])
+        dense = np.ascontiguousarray(np.asarray(t.to_dense()))
+        chunk = int(p.get("chunk_bytes") or (8 << 20))
+        view = memoryview(dense).cast("B")
+        nbytes = view.nbytes
+
+        def stream():
+            yield MsgType.STREAM_ITEM, {
+                "seq": 0, "meta": {
+                    "shape": list(dense.shape), "dtype": dense.dtype.str,
+                    "block_shape": list(t.meta.block_shape),
+                    "nbytes": nbytes,
+                    "nchunks": max(1, -(-nbytes // chunk))}}
+            seq = 1
+            for off in range(0, max(nbytes, 1), chunk):
+                yield MsgType.STREAM_ITEM, {
+                    "seq": seq, "b": bytes(view[off:off + chunk])}
+                seq += 1
+            yield MsgType.STREAM_END, {"frames": seq}
+
+        return stream()
+
+    def _on_dedup_resident(self, p):
+        """Pool shared blocks across resident model weight sets so
+        fine-tuned variants share HBM (``Client.dedup_resident``) — the
+        serve-time dedup flow (``SharedTensorBlockSet.h:25``)."""
+        report = self.library.dedup_resident(
+            [tuple(s) for s in p["sets"]], bands=int(p.get("bands", 16)),
+            seed=int(p.get("seed", 0)))
+        return MsgType.OK, report
 
     def _on_add_shared_mapping(self, p):
         self.library.add_shared_mapping(
@@ -311,19 +442,27 @@ class ServeController:
         import jax.numpy as jnp
 
         from netsdb_tpu.core.blocked import BlockedTensor
+        from netsdb_tpu.relational.table import ColumnTable
 
         for val in results.values():
             if isinstance(val, BlockedTensor):
                 float(jnp.sum(val.data))
+            elif isinstance(val, ColumnTable):
+                float(jnp.sum(next(iter(val.cols.values()))
+                              .astype(jnp.float32)))
 
     def _result_summaries(self, results: Dict[SetIdentifier, Any]) -> dict:
         from netsdb_tpu.core.blocked import BlockedTensor
+        from netsdb_tpu.relational.table import ColumnTable
 
         out = {}
         for ident, val in results.items():
             if isinstance(val, BlockedTensor):
                 out[str(ident)] = {"kind": "tensor", "shape": list(val.shape),
                                    "dtype": str(val.dtype)}
+            elif isinstance(val, ColumnTable):
+                out[str(ident)] = {"kind": "table", "rows": val.num_rows,
+                                   "columns": sorted(val.cols)}
             elif isinstance(val, dict):
                 out[str(ident)] = {"kind": "map", "count": len(val)}
             else:
@@ -361,14 +500,12 @@ class ServeController:
         registry: Dict[str, Any] = {}
         for label, spec in (p.get("registry") or {}).items():
             if isinstance(spec, str):
-                entry = self.library.catalog.get_type(spec) or spec
-                registry[label] = resolve_entry_point(entry)
+                registry[label] = self._resolve_registered(spec)
             elif isinstance(spec, dict):
                 kw = dict(spec)
                 for k, v in list(kw.items()):
                     if isinstance(v, str) and ":" in v:
-                        entry = self.library.catalog.get_type(v) or v
-                        kw[k] = resolve_entry_point(entry)
+                        kw[k] = self._resolve_registered(v)
                 registry[label] = kw
             else:
                 raise ProtocolError(

@@ -130,6 +130,27 @@ class PagedTensorStore:
             self.backend.write_page(sid, dense[r0:r0 + row_block])
         self._meta[sid] = ((rows, cols), (row_block, cols), dense.dtype)
 
+    def read_block(self, name: str, index: int) -> Tuple[int, np.ndarray]:
+        """Random access to one row-block: (start_row, block). The
+        pin-one-partition access pattern of a partitioned hash table
+        (ref ``src/queryExecution/headers/HashSetManager.h`` /
+        PartitionedHashSet) — a build side stored with
+        ``row_block=partition_rows`` makes partition *p* exactly block
+        *p*, resident only while probed, spillable in between."""
+        sid = self._ids[name]
+        (rows, cols), (rb, _), dtype = self._meta[sid]
+        pids = self.backend.set_pages(sid)
+        if not 0 <= index < len(pids):
+            raise IndexError(f"block {index} out of range "
+                             f"({len(pids)} blocks in {name!r})")
+        start = index * rb
+        n = min(rb, rows - start)
+        raw = self.backend.read_page(pids[index])
+        return start, np.frombuffer(raw, dtype=dtype).reshape(n, cols)
+
+    def num_blocks(self, name: str) -> int:
+        return len(self.backend.set_pages(self._ids[name]))
+
     def stream_blocks(self, name: str,
                       prefetch: int = 2) -> Iterator[Tuple[int, np.ndarray]]:
         """Yield (start_row, block) in order — the PageScanner loop.

@@ -76,6 +76,10 @@ class _StoredSet:
     # (ref SharedTensorBlockSet, src/deduplication/headers/SharedTensorBlockSet.h:25)
     alias_of: Optional[SetIdentifier] = None
     shared_mapping: Optional[Dict] = None
+    # declarative sharding applied by the data path (ref: the
+    # PartitionPolicy chosen at createSet — distribution is a property
+    # of the set, netsdb_tpu.parallel.placement)
+    placement: Optional[Any] = None
 
 
 def _item_nbytes(item: Any) -> int:
@@ -83,6 +87,9 @@ def _item_nbytes(item: Any) -> int:
         return int(np.prod(item.meta.padded_shape)) * item.data.dtype.itemsize
     if isinstance(item, (np.ndarray, jax.Array)):
         return int(item.nbytes)
+    resident = getattr(item, "nbytes_resident", None)  # PooledTensor:
+    if resident is not None:  # counts only its slot grid; the shared
+        return int(resident)  # pool is accounted once, by its owner
     return 256  # rough per-object estimate for host records
 
 
@@ -124,12 +131,22 @@ class SetStore:
         ident: SetIdentifier,
         persistence: str = "transient",
         eviction: str = "lru",
+        placement: Optional[Any] = None,
     ) -> None:
         if ident not in self._sets:
             self._sets[ident] = _StoredSet(
                 ident=ident, items=[], persistence=persistence, eviction=eviction,
-                last_access=time.time(),
+                last_access=time.time(), placement=placement,
             )
+        elif placement is not None:
+            s = self._sets[ident]
+            s.placement = placement
+            if s.items:  # re-place already-stored data under the new policy
+                s.items = [placement.apply(i) for i in s.items]
+
+    def placement_of(self, ident: SetIdentifier) -> Optional[Any]:
+        s = self._sets.get(ident)
+        return s.placement if s is not None else None
 
     def exists(self, ident: SetIdentifier) -> bool:
         return ident in self._sets or os.path.exists(self._spill_path(ident))
@@ -160,6 +177,8 @@ class SetStore:
             raise ValueError(f"set {ident} aliases {s.alias_of}; it is read-only")
         if s.items is None:  # evicted to disk: reload before appending
             self._load_from_spill(s)
+        if s.placement is not None:
+            items = [s.placement.apply(i) for i in items]
         s.items.extend(items)
         s.nbytes += sum(_item_nbytes(i) for i in items)
         s.last_access = time.time()
@@ -173,6 +192,8 @@ class SetStore:
         s = self._require(ident)
         if s.alias_of is not None:
             raise ValueError(f"set {ident} aliases {s.alias_of}; it is read-only")
+        if s.placement is not None:
+            tensor = s.placement.apply(tensor)
         s.items = [tensor]
         s.nbytes = _item_nbytes(tensor)
         s.last_access = time.time()
@@ -199,6 +220,18 @@ class SetStore:
         else:
             self.stats.hits += 1
         s.last_access = time.time()
+        from netsdb_tpu.dedup.pool import PooledTensor
+
+        if any(isinstance(i, PooledTensor) for i in s.items):
+            # dedup'd model set: resident HBM holds the shared pool +
+            # slot grid; consumers get an eagerly-assembled TRANSIENT
+            # BlockedTensor (freed when the consuming job drops it) —
+            # the shared-page read path (SharedTensorBlockSet.h:25).
+            # Per-read gather cost and the transient's peak-HBM are the
+            # price of keeping consumers pooling-agnostic (dedup/pool.py
+            # module docstring).
+            return [i.assemble() if isinstance(i, PooledTensor) else i
+                    for i in s.items]
         return s.items
 
     def scan(self, ident: SetIdentifier) -> Iterator[Any]:
@@ -218,6 +251,15 @@ class SetStore:
         s.shared_mapping = mapping or {}
         s.items = []
         s.nbytes = 0
+
+    @_locked
+    def set_pooled(self, ident: SetIdentifier, pooled: Any) -> None:
+        """Swap a weight set's dense tensor for its pooled form (the
+        shared-block dedup flow, ``dedup/pool.py``) — the original
+        device buffer is released once no set references it."""
+        s = self._require(ident)
+        s.items = [pooled]
+        s.nbytes = _item_nbytes(pooled)
 
     # --- persistence (ref: flush threads → PartitionedFile) -----------
     def _spill_path(self, ident: SetIdentifier) -> str:
@@ -317,6 +359,10 @@ class SetStore:
                 items.append(BlockedTensor(jnp.asarray(data), meta))
             else:
                 items.append(data)
+        if s.placement is not None:
+            # distribution is a property of the set: an eviction round-trip
+            # must not silently demote a placed set to single-device
+            items = [s.placement.apply(i) for i in items]
         s.items = items
         s.nbytes = sum(_item_nbytes(i) for i in items)
         self.stats.misses += 1
@@ -379,4 +425,5 @@ class SetStore:
             "in_memory": s.items is not None,
             "persistence": s.persistence,
             "alias_of": str(s.alias_of) if s.alias_of else None,
+            "placement": s.placement.label() if s.placement is not None else None,
         }

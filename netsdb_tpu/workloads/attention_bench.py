@@ -26,10 +26,43 @@ from netsdb_tpu.ops.pallas_kernels import flash_attention
 from netsdb_tpu.utils.timing import scan_slope_seconds
 
 
+def _jax_reference_kernel():
+    """jax's own TPU flash kernel — the independent yardstick for the
+    'structural ceiling' claim at ``ops/pallas_kernels.py`` (~57% MFU
+    at 8k causal is the hardware's, not this kernel's). None when the
+    module is unavailable (CPU tests, jax version drift)."""
+    try:
+        from jax.experimental.pallas.ops.tpu.flash_attention import (
+            BlockSizes, flash_attention as jref)
+    except Exception:
+        return None
+
+    def run(q, k, v, causal):
+        s = q.shape[2]
+        bq = bk = min(1024, s)  # same tuned blocks as our kernel —
+        # jref's defaults (128×128) leave it ~7× under its own best
+        bs = BlockSizes(block_q=bq, block_k_major=bk, block_k=bk,
+                        block_b=1)
+        return jref(q, k, v, causal=causal,
+                    sm_scale=1.0 / float(q.shape[3]) ** 0.5,
+                    block_sizes=bs)
+
+    return run
+
+
+# the guarded claim: our flash must stay within this fraction of jax's
+# reference kernel wall time at the headline shape (VERDICT r2 weak #7)
+CEILING_RATIO = 0.92
+CEILING_SEQ = 8192
+
+
 def bench_attention(seq_lens: Sequence[int] = (1024, 2048, 4096, 8192),
                     batch: int = 2, heads: int = 8, head_dim: int = 128,
-                    seed: int = 0) -> Dict[str, Dict]:
+                    seed: int = 0,
+                    assert_ceiling: bool = True) -> Dict[str, Dict]:
     rng = np.random.default_rng(seed)
+    jref = _jax_reference_kernel() if jax.devices()[0].platform == "tpu" \
+        else None
     out: Dict[str, Dict] = {}
     for s in seq_lens:
         q, k, v = (jnp.asarray(rng.standard_normal((batch, heads, s, head_dim)),
@@ -39,7 +72,10 @@ def bench_attention(seq_lens: Sequence[int] = (1024, 2048, 4096, 8192),
         # causal: half the S^2 logits are live; 2 matmuls (QK^T, PV)
         flops = 2 * 2 * batch * heads * s * s * head_dim / 2
 
-        for name, fn in (("naive", attention), ("flash", flash_attention)):
+        kernels = [("naive", attention), ("flash", flash_attention)]
+        if jref is not None:
+            kernels.append(("jax_ref", jref))
+        for name, fn in kernels:
             @partial(jax.jit, static_argnums=3)
             def loop(qq, kk, vv, n, fn=fn):
                 def step(carry, _):
@@ -68,7 +104,25 @@ def bench_attention(seq_lens: Sequence[int] = (1024, 2048, 4096, 8192),
         f_ms = entry.get("flash", {}).get("ms")
         if n_ms and f_ms:
             entry["flash_speedup"] = round(n_ms / f_ms, 2)
+        r_ms = entry.get("jax_ref", {}).get("ms")
+        if r_ms and f_ms:
+            # >1 means our kernel is FASTER than jax's reference
+            entry["flash_vs_jax_ref"] = round(r_ms / f_ms, 3)
         out[f"seq_{s}"] = entry
+
+    # the asserted ceiling guard: if our flash regresses below
+    # CEILING_RATIO of jax's reference kernel at the headline shape,
+    # the BASELINE "structural ceiling" claim is no longer earned —
+    # fail loudly instead of silently re-printing the stale claim
+    if assert_ceiling and jref is not None:
+        key = f"seq_{CEILING_SEQ}"
+        ratio = out.get(key, {}).get("flash_vs_jax_ref")
+        if ratio is not None and ratio < CEILING_RATIO:
+            raise AssertionError(
+                f"flash kernel at seq={CEILING_SEQ} runs at {ratio:.3f}× "
+                f"of jax's reference kernel (< {CEILING_RATIO}); the "
+                f"attention-ceiling claim in BASELINE.md/"
+                f"ops/pallas_kernels.py must be re-validated")
     return out
 
 

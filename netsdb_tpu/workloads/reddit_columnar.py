@@ -244,12 +244,12 @@ def sharded_three_way(tables: Dict[str, ColumnTable], mesh, axis="data",
 # --------------------------------------------- label propagation
 @functools.partial(jax.jit, static_argnums=(0,))
 def _propagate_core(n_authors: int, author_id, label):
-    """Per-author positive marks (segment max) + per-comment gather —
-    the whole RedditCommentLabelJoin as two kernels."""
-    pos = (label == 1).astype(jnp.int32)
-    marks = K.segment_max(pos, author_id, n_authors)
-    has_pos = jnp.maximum(marks, 0)  # empty segments hold INT_MIN
-    return jnp.take(has_pos, jnp.clip(author_id, 0, n_authors - 1))
+    """The whole RedditCommentLabelJoin as one scatter-free self-semi-
+    join: grid-blocked one-hot MXU reduce + two-level gather
+    (``kernels.any_by_key``). Round 2's segment-max + flat-gather form
+    was scatter-serialized at 13.6 ms/1M rows; this is 3.45 ms on v5e."""
+    return K.any_by_key(author_id, (label == 1).astype(jnp.int32),
+                        n_authors)
 
 
 def propagate_labels(comments_t: ColumnTable,

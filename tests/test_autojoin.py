@@ -1,0 +1,83 @@
+"""Automatic string-key device joins (round-3 item 9): host records
+columnarize with dictionary encoding at ingest, and a string-keyed
+equi-join runs on the device LUT path — oracle-matched against the
+host-object join, with no hand-built columnar twin."""
+
+import numpy as np
+
+from netsdb_tpu.relational.autojoin import (equijoin, table_from_objects,
+                                            unify_key_codes)
+from netsdb_tpu.workloads import reddit as R
+
+
+def _data():
+    return R.generate(num_comments=300, num_authors=25, num_subs=6, seed=7)
+
+
+def test_reddit_string_join_matches_host_oracle():
+    comments, authors, subs = _data()
+    ct = table_from_objects(comments)
+    at = table_from_objects(authors)
+    assert "author" in ct.dicts and "author" in at.dicts  # auto-encoded
+
+    joined = equijoin(ct, "author", at, "author",
+                      take=["author_id", "karma"])
+    rows = joined.to_rows()
+
+    # host-object oracle: hash join comment.author == author.author
+    by_name = {a.author: a for a in authors}
+    want = [(c.id, by_name[c.author].author_id, by_name[c.author].karma)
+            for c in comments if c.author in by_name]
+    got = [(r["id"], r["author_id"], r["karma"]) for r in rows]
+    assert sorted(got) == sorted(want)
+    assert len(got) == len(comments)  # every comment's author exists
+
+
+def test_string_join_with_missing_keys_drops_rows():
+    comments, authors, subs = _data()
+    ct = table_from_objects(comments)
+    at = table_from_objects(authors[:10])  # drop 15 authors
+    joined = equijoin(ct, "author", at, "author", take=["author_id"])
+    keep = {a.author for a in authors[:10]}
+    want = sorted(c.id for c in comments if c.author in keep)
+    got = sorted(r["id"] for r in joined.to_rows())
+    assert got == want and 0 < len(got) < len(comments)
+
+
+def test_unify_key_codes_int_passthrough():
+    comments, authors, subs = _data()
+    ct = table_from_objects(comments)
+    at = table_from_objects(authors)
+    lc, rc, space = unify_key_codes(at, "author_id", ct, "label")
+    assert space > int(np.asarray(lc).max())
+
+
+def test_string_sub_join():
+    comments, authors, subs = _data()
+    ct = table_from_objects(comments)
+    st = table_from_objects(subs)
+    joined = equijoin(ct, "subreddit_id", st, "id", take=["subscribers"])
+    by_id = {s.id: s.subscribers for s in subs}
+    rows = joined.to_rows()
+    assert len(rows) == len(comments)
+    for r in rows[:50]:
+        assert r["subscribers"] == by_id[r["subreddit_id"]]
+
+
+def test_three_way_string_join_chain():
+    """comment ⋈ author (string) ⋈ sub (string) — the RedditThreeWayJoin
+    shape (``src/reddit/headers/RedditThreeWayJoin.h:12-30``) through
+    the automatic path, vs the host-object pipeline."""
+    comments, authors, subs = _data()
+    ct = table_from_objects(comments)
+    j1 = equijoin(ct, "author", table_from_objects(authors), "author",
+                  take=["author_id", "karma"])
+    j2 = equijoin(j1, "subreddit_id", table_from_objects(subs), "id",
+                  take=["subscribers"])
+    rows = j2.to_rows()
+    by_name = {a.author: a for a in authors}
+    by_sub = {s.id: s for s in subs}
+    want = sorted((c.id, by_name[c.author].karma,
+                   by_sub[c.subreddit_id].subscribers) for c in comments)
+    got = sorted((r["id"], r["karma"], r["subscribers"]) for r in rows)
+    assert got == want

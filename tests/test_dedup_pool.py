@@ -1,0 +1,129 @@
+"""Serve-time block-level model dedup (round-3 item 8): two fine-tuned
+variants share HBM — LSH groups near-duplicate blocks, byte-identical
+members collapse into one device pool, inference is bit-unchanged.
+Reference: SharedTensorBlockSet.h:25 + PDBClient.h:113-138."""
+
+import numpy as np
+import pytest
+
+from netsdb_tpu.client import Client
+from netsdb_tpu.config import Configuration
+from netsdb_tpu.core.blocked import BlockedTensor
+from netsdb_tpu.dedup.pool import pool_models
+
+
+BLOCK = (32, 32)
+
+
+def _variant_pair(seed=0, rows=128, cols=128, changed_blocks=1):
+    """Base model + fine-tuned variant differing in ``changed_blocks``
+    blocks (the classic fine-tune pattern: most layers frozen)."""
+    rng = np.random.default_rng(seed)
+    base = rng.standard_normal((rows, cols)).astype(np.float32)
+    variant = base.copy()
+    variant[:BLOCK[0], :BLOCK[1]] += 0.5  # first block(s) retrained
+    for b in range(1, changed_blocks):
+        variant[b * BLOCK[0]:(b + 1) * BLOCK[0], :BLOCK[1]] -= 0.25
+    return (BlockedTensor.from_dense(base, BLOCK),
+            BlockedTensor.from_dense(variant, BLOCK))
+
+
+def test_pool_models_shares_identical_blocks():
+    a, b = _variant_pair()
+    pooled, report = pool_models({"m:a": a, "m:b": b})
+    grid_blocks = int(np.prod(a.meta.grid))
+    assert report["total_blocks"] == 2 * grid_blocks
+    # all but the retrained block are shared between the two variants
+    assert report["unique_blocks"] == grid_blocks + 1
+    assert report["shared_block_refs"] == grid_blocks - 1
+    assert report["hbm_bytes_pooled"] < report["hbm_bytes_before"]
+    # assembly is exact for BOTH models
+    np.testing.assert_array_equal(np.asarray(pooled["m:a"].assemble().data),
+                                  np.asarray(a.data))
+    np.testing.assert_array_equal(np.asarray(pooled["m:b"].assemble().data),
+                                  np.asarray(b.data))
+    # LSH did its job: only grouped candidates were byte-compared
+    assert report["verified_pairs"] < report["total_blocks"] ** 2 / 4
+
+
+def test_client_dedup_resident_and_inference_unchanged(config):
+    client = Client(config)
+    client.create_database("zoo")
+    a, b = _variant_pair(seed=3)
+    client.create_set("zoo", "w_a")
+    client.create_set("zoo", "w_b")
+    client.store.put_tensor(client.store.list_sets()[0], a)
+    client.store.put_tensor(client.store.list_sets()[1], b)
+
+    x = np.random.default_rng(1).standard_normal((16, 128)).astype(np.float32)
+    before_a = np.asarray(client.get_tensor("zoo", "w_a").to_dense()) @ x.T
+    before_b = np.asarray(client.get_tensor("zoo", "w_b").to_dense()) @ x.T
+
+    report = client.dedup_resident([("zoo", "w_a"), ("zoo", "w_b")])
+    assert report["shared_block_refs"] > 0
+    assert report["hbm_bytes_pooled"] < report["hbm_bytes_before"]
+
+    # reads assemble transparently; results bit-match pre-dedup
+    after_a = np.asarray(client.get_tensor("zoo", "w_a").to_dense()) @ x.T
+    after_b = np.asarray(client.get_tensor("zoo", "w_b").to_dense()) @ x.T
+    np.testing.assert_array_equal(before_a, after_a)
+    np.testing.assert_array_equal(before_b, after_b)
+
+    # HBM accounting: exactly ONE set carries the shared pool's bytes
+    # (the accounting owner); the other pins only its slot grid — total
+    # equals pool + grids, strictly below the pre-dedup footprint
+    stats = client.collect_stats()
+    sizes = sorted(s["nbytes"] for k, s in stats.items()
+                   if k.startswith("zoo:"))
+    assert sizes[0] < 4096  # non-owner: slot grid only
+    assert sizes[1] >= report["hbm_bytes_pooled"]  # owner carries pool
+    assert sum(sizes) < report["hbm_bytes_before"]
+
+
+def test_dedup_through_daemon_inference_correct(config):
+    from netsdb_tpu.models.ff import FFModel
+    from netsdb_tpu.serve.client import RemoteClient
+    from netsdb_tpu.serve.server import ServeController
+
+    ctl = ServeController(config, port=0)
+    port = ctl.start()
+    try:
+        rc = RemoteClient(f"127.0.0.1:{port}")
+        rng = np.random.default_rng(5)
+        # two FF models: variant differs from base only in wo
+        base = FFModel(db="ffa", block=(32, 32))
+        var = FFModel(db="ffb", block=(32, 32))
+        w1 = rng.standard_normal((64, 32)).astype(np.float32) * 0.1
+        b1 = np.zeros(64, np.float32)
+        wo_a = rng.standard_normal((8, 64)).astype(np.float32) * 0.1
+        wo_b = wo_a + 0.1  # retrained head
+        bo = np.zeros(8, np.float32)
+        for m, wo in ((base, wo_a), (var, wo_b)):
+            m.setup(rc)
+            m.load_weights(rc, w1, b1, wo, bo)
+        x = rng.standard_normal((16, 32)).astype(np.float32)
+        base.load_inputs(rc, x)
+        var.load_inputs(rc, x)
+        out_a0 = np.asarray(rc.execute_computations(
+            base.build_inference_dag(), job_name="a0")[("ffa", "output")
+                                                       ].to_dense())
+
+        report = rc.dedup_resident(
+            [("ffa", "w1"), ("ffb", "w1"), ("ffa", "wo"), ("ffb", "wo")])
+        # identical w1s share every block; the two wo heads share none
+        assert report["shared_block_refs"] >= 2
+        assert report["hbm_bytes_pooled"] < report["hbm_bytes_before"]
+
+        out_a1 = np.asarray(rc.execute_computations(
+            base.build_inference_dag(), job_name="a1")[("ffa", "output")
+                                                       ].to_dense())
+        out_b1 = np.asarray(rc.execute_computations(
+            var.build_inference_dag(), job_name="b1")[("ffb", "output")
+                                                      ].to_dense())
+        np.testing.assert_array_equal(out_a0, out_a1)
+        # variant result differs from base (its head was retrained) but
+        # is a valid softmax — dedup kept the models distinct
+        assert not np.array_equal(out_a1, out_b1)
+        np.testing.assert_allclose(out_b1.sum(axis=0), 1.0, rtol=1e-5)
+    finally:
+        ctl.shutdown()

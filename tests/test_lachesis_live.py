@@ -80,3 +80,35 @@ def test_ab_loop_learns_the_faster_block():
     assert res["decisions_recorded"] > 0
     # at width 300 the 1024-block pads 3.4x: the advisor must learn 128
     assert res["winner"] == "block128"
+
+
+def test_drl_live_loop_converges(tmp_path):
+    """VERDICT r2 item 6: the DRL advisor IS the live arm — the
+    actor-critic chooses placements for real FF jobs, learns from the
+    measured rewards, and its greedy post-training choice matches the
+    measured-mean winner, all recorded in the history DB."""
+    res = bench_placement_ab(width=300, batch=256, labels=8, rounds=8,
+                             advisor_kind="drl", seed=1)
+    assert res["advisor"] == "drl"
+    assert res["converged"], res
+    # every live round recorded a measured run for its arm
+    assert len(res["rounds"]) == 8
+    assert res["decisions_recorded"] >= 8  # create_set audit rows
+    assert res["winner"] in res["mean_s"]
+    assert all(v is not None for v in res["mean_s"].values())
+
+
+def test_drl_advisor_pluggable_into_client(tmp_path):
+    from netsdb_tpu.learning.rl import DRLPlacementAdvisor
+
+    adv = DRLPlacementAdvisor(
+        [PlacementCandidate("b256", (1,), {"block": (256, 256)}),
+         PlacementCandidate("b64", (1,), {"block": (64, 64)})],
+        HistoryDB(), seed=0)
+    client = Client(Configuration(root_dir=str(tmp_path)))
+    client.set_placement_advisor(adv, key="drl-job")
+    client.create_database("d")
+    client.create_set("d", "weights")
+    meta = client.catalog.get_set("d", "weights")["meta"]
+    assert meta["placement"] in ("b256", "b64")
+    assert adv.db.runs("drl-job:decisions")

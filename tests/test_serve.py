@@ -282,3 +282,42 @@ def test_two_process_integration(tmp_path):
     assert len(out["per_client"]) == 2
     for r in out["per_client"]:
         assert r["jobs"] == 2
+
+
+def test_execute_plan_with_shipped_udf_source(tmp_path):
+    """Code shipping on registerType (round-3 item 7): the plan's UDF
+    module does NOT exist on the server's import path — its source
+    rides the catalog (the reference replicating user-type .so files,
+    PDBCatalog.h:45-50) and the daemon execs it at bind time."""
+    import sys
+
+    mod_name = "udf_shipped_square_xyz"
+    assert mod_name not in sys.modules  # genuinely not installed
+    src = "\n".join([
+        "import jax.numpy as jnp",
+        "def square(t):",
+        "    return t.with_data(t.data * t.data)",
+    ])
+    config = Configuration(root_dir=str(tmp_path / "ship"))
+    ctl = ServeController(config, port=0, allow_pickle=False)
+    port = ctl.start()
+    try:
+        c = RemoteClient(f"127.0.0.1:{port}")
+        c.create_database("db")
+        c.create_set("db", "m")
+        a = np.arange(8, dtype=np.float32).reshape(2, 4)
+        c.send_matrix("db", "m", a, (2, 2))
+        c.register_type("SquareOp", f"{mod_name}:square", source=src)
+        plan = "\n".join([
+            "in <= SCAN('db', 'm')",
+            "sq <= APPLY(in, 'square')",
+            "out <= OUTPUT(sq, 'db', 'sq')",
+        ])
+        results = c.execute_plan(plan, {"square": "SquareOp"},
+                                 job_name="shipped-udf")
+        got = next(iter(results.values())).to_dense()
+        np.testing.assert_allclose(got, a * a)
+        c.close()
+    finally:
+        ctl.shutdown()
+        sys.modules.pop(mod_name, None)
