@@ -359,12 +359,99 @@ def _cmd_selftest(args) -> int:
         want = np.array([1 if c.author in pos else 0 for c in cm])
         check(bool((prop == want).all()), "reddit propagation oracle")
 
+    def placement_api():  # distribution through the set API (round 3)
+        from netsdb_tpu.parallel.placement import Placement
+        from netsdb_tpu.relational import dag as rdag
+        from netsdb_tpu.relational.queries import cq01, tables_from_rows
+        from netsdb_tpu.workloads import tpch as row_engine
+
+        data = row_engine.generate(scale=1, seed=8)
+        client.create_database("stp")
+        client.create_set("stp", "lineitem", type_name="table",
+                          placement=Placement.data_parallel(ndim=1))
+        client.send_table("stp", "lineitem", data["lineitem"])
+        got = rdag.run_query(
+            client, rdag.q01_sink("stp", output_set="q01o")).to_rows()
+        want = cq01(tables_from_rows(data))
+        check(len(got) == len(want) and all(
+            g["count"] == v["count"] for g, (_, v) in zip(got, want)),
+            "placement-set q01 equals columnar engine")
+
+    def ooc_join():  # streamed-probe join (round 3)
+        import shutil
+        import tempfile
+
+        from netsdb_tpu.relational import outofcore as O
+        from netsdb_tpu.relational.queries import cq03, tables_from_rows
+        from netsdb_tpu.relational.table import date_to_int
+        from netsdb_tpu.storage.paged import PagedTensorStore
+        from netsdb_tpu.workloads import tpch as row_engine
+
+        data = row_engine.generate(scale=1, seed=9)
+        tabs = tables_from_rows(data)
+        root = tempfile.mkdtemp(prefix="selftest_oocj_")
+        try:
+            store = PagedTensorStore(Configuration(
+                root_dir=root, page_size_bytes=1 << 14))
+            pc = O.PagedColumns.from_table(store, "li", tabs["lineitem"],
+                                           O.Q03_COLUMNS)
+            orders = {n: np.asarray(tabs["orders"][n]) for n in
+                      ("o_orderkey", "o_custkey", "o_orderdate",
+                       "o_shippriority")}
+            cust = {n: np.asarray(tabs["customer"][n]) for n in
+                    ("c_custkey", "c_mktsegment")}
+            n_keys = int(orders["o_orderkey"].max()) + 1
+            O.build_q03_side(store, orders, cust,
+                             tabs["customer"].code("c_mktsegment",
+                                                   "BUILDING"),
+                             date_to_int("1995-03-15"),
+                             max(1, n_keys // 3))
+            got = O.ooc_q03(pc, store)
+            store.close()
+        finally:
+            shutil.rmtree(root, ignore_errors=True)
+        want = cq03(tabs)
+        check([r["okey"] for r in got] == [r["okey"] for r in want],
+              "out-of-core q03 join equals in-memory")
+
+    def autojoin():  # automatic string-key device join (round 3)
+        from netsdb_tpu.relational.autojoin import (equijoin,
+                                                    table_from_objects)
+        from netsdb_tpu.workloads import reddit as R
+
+        cm, au, _su = R.generate(num_comments=120, num_authors=10,
+                                 num_subs=3, seed=3)
+        j = equijoin(table_from_objects(cm), "author",
+                     table_from_objects(au), "author",
+                     take=["author_id"])
+        by = {a.author: a.author_id for a in au}
+        got = sorted((r["id"], r["author_id"]) for r in j.to_rows())
+        check(got == sorted((c.id, by[c.author]) for c in cm),
+              "autojoin equals host hash join")
+
+    def dedup_pool():  # serve-time HBM dedup (round 3)
+        from netsdb_tpu.core.blocked import BlockedTensor
+        from netsdb_tpu.dedup.pool import pool_models
+
+        base = rng.standard_normal((64, 64)).astype(np.float32)
+        variant = base.copy()
+        variant[:16, :16] += 1.0
+        pooled, rep = pool_models(
+            {"a": BlockedTensor.from_dense(base, (16, 16)),
+             "b": BlockedTensor.from_dense(variant, (16, 16))})
+        check(rep["shared_block_refs"] == 15
+              and bool(np.array_equal(
+                  np.asarray(pooled["b"].assemble().data), variant)),
+              "dedup pool shares identical blocks, assembly exact")
+
     steps = [("selection", selection), ("aggregation", aggregation),
              ("lda", lda), ("ff", ff), ("lstm", lstm), ("conv", conv),
              ("tpch-columnar", tpch_columnar), ("pdml", pdml),
              ("dedup", dedup), ("planner-stats", planner_stats),
              ("out-of-core", outofcore),
-             ("reddit-columnar", reddit_columnar)]
+             ("reddit-columnar", reddit_columnar),
+             ("placement-api", placement_api), ("ooc-join", ooc_join),
+             ("autojoin", autojoin), ("dedup-pool", dedup_pool)]
     for name, fn in steps:
         step(name, fn)
     print(f"{len(steps) - len(failures)}/{len(steps)} passed")

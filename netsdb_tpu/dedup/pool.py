@@ -64,15 +64,10 @@ class PooledTensor:
     executor scans, serve handlers, checkpoints — sees an ordinary
     BlockedTensor while resident HBM holds only the pool + slot grid."""
 
-    def __init__(self, pool: BlockPool, slots: np.ndarray, meta: BlockMeta,
-                 owns_pool: bool = False):
+    def __init__(self, pool: BlockPool, slots: np.ndarray, meta: BlockMeta):
         self.pool = pool
         self.slots = np.asarray(slots, np.int32)  # (gh, gw)
         self.meta = meta
-        # exactly one PooledTensor per pool carries the pool's bytes in
-        # its accounting (store eviction math must see the pool ONCE,
-        # not zero times and not once per model)
-        self.owns_pool = owns_pool
 
     def assemble(self) -> BlockedTensor:
         gh, gw = self.slots.shape
@@ -86,10 +81,11 @@ class PooledTensor:
 
     @property
     def nbytes_resident(self) -> int:
-        """Bytes this tensor pins: its slot grid, plus the shared pool
-        if it is the pool's accounting owner."""
-        own = self.pool.nbytes if self.owns_pool else 0
-        return int(self.slots.nbytes) + own
+        """Bytes this tensor alone pins (its slot grid). The shared
+        pool's bytes are accounted at the STORE level — once per live
+        pool, however many sets reference it, robust to any one set
+        being removed/overwritten/spilled (``SetStore.live_pool_bytes``)."""
+        return int(self.slots.nbytes)
 
     def __reduce__(self):
         # spill/checkpoint: persist as the full tensor (dedup is an
@@ -174,10 +170,8 @@ def pool_models(tensors: Dict[str, BlockedTensor],
 
     pool = BlockPool(jnp.asarray(np.stack(stacked)), num_refs=total,
                      total_blocks=total)
-    names = list(tensors)
-    pooled = {name: PooledTensor(pool, slots[name], metas[name],
-                                 owns_pool=(name == names[0]))
-              for name in names}
+    pooled = {name: PooledTensor(pool, slots[name], metas[name])
+              for name in tensors}
     bytes_before = sum(int(np.prod(m.padded_shape))
                        * tensors[n].data.dtype.itemsize
                        for n, m in metas.items())

@@ -100,6 +100,100 @@ def q06_sink(db: str, lineitem_set: str = "lineitem",
                     db, output_set)
 
 
+def q03_sink(db: str, n_orders: int, n_customers: int, segment_code: int,
+             date: str = "1995-03-15", k: int = 10,
+             lineitem_set: str = "lineitem", orders_set: str = "orders",
+             customer_set: str = "customer",
+             output_set: str = "q03_out") -> WriteSet:
+    """Top-unshipped-orders DAG over THREE stored sets:
+    SCAN(orders) ⋈ SCAN(customer) → SCAN(lineitem) ⋈ · → OUTPUT.
+
+    The join strategy is the LUT probe (`kernels.pk_fk_join`); with the
+    fact set placement-sharded and the dimension sets replicated
+    (broadcast join), XLA keeps LUT builds local and inserts one psum
+    for the per-order revenue segments — the reference's
+    broadcast-join + shuffle-aggregation plan chosen declaratively by
+    set placement. Statics (key spaces, segment code) come from the
+    caller; use :func:`q03_sink_for` to derive them from stored tables.
+    Result: a k-row relation {okey, odate, revenue} masked to real
+    hits, ordered by (-revenue, odate)."""
+    from netsdb_tpu.plan.computations import Join
+    from netsdb_tpu.relational.planner import JoinPlan
+
+    d = date_to_int(date)
+    jp_cust = JoinPlan("lut", n_customers)
+    jp_orders = JoinPlan("lut", n_orders)
+
+    def filter_orders(orders: ColumnTable, cust: ColumnTable) -> ColumnTable:
+        from netsdb_tpu.relational import kernels as K
+
+        cust_ok = (cust["c_mktsegment"] == segment_code) & cust.mask()
+        _, chit = K.pk_fk_join(cust["c_custkey"], orders["o_custkey"],
+                               cust_ok, plan=jp_cust)
+        return orders.filter(chit & (orders["o_orderdate"] < d))
+
+    def join_lineitem(li: ColumnTable, orders: ColumnTable) -> ColumnTable:
+        import jax.numpy as jnp
+
+        from netsdb_tpu.relational import kernels as K
+
+        l_okey = li["l_orderkey"]
+        oidx, ohit = K.pk_fk_join(orders["o_orderkey"], l_okey,
+                                  orders.mask(), plan=jp_orders)
+        li_ok = ohit & (li["l_shipdate"] > d) & li.mask()
+        rev = K.segment_sum(li["l_extendedprice"] * (1.0 - li["l_discount"]),
+                            l_okey, n_orders, li_ok)
+        odate = K.segment_min(jnp.take(orders["o_orderdate"], oidx),
+                              l_okey, n_orders, li_ok)
+        top_idx, top_ok = K.top_k_masked(rev, k, rev > 0)
+        return ColumnTable(
+            cols={"okey": top_idx,
+                  "odate": jnp.take(odate, top_idx),
+                  "revenue": jnp.take(rev, top_idx)},
+            valid=top_ok)
+
+    filtered = Join(ScanSet(db, orders_set), ScanSet(db, customer_set),
+                    fn=filter_orders,
+                    label=f"q03filter:{segment_code}:{d}:{n_customers}")
+    joined = Join(ScanSet(db, lineitem_set), filtered, fn=join_lineitem,
+                  label=f"q03join:{d}:{k}:{n_orders}")
+    return WriteSet(joined, db, output_set)
+
+
+def q03_sink_for(client, db: str, segment: str = "BUILDING",
+                 date: str = "1995-03-15", k: int = 10) -> WriteSet:
+    """Derive q03's static parameters (key spaces, segment code) from
+    the stored tables — the planner's statistics role — then build the
+    sink."""
+    import jax.numpy as jnp
+
+    orders = client.get_table(db, "orders")
+    cust = client.get_table(db, "customer")
+    return q03_sink(
+        db,
+        n_orders=int(jnp.max(orders["o_orderkey"])) + 1,
+        n_customers=int(jnp.max(cust["c_custkey"])) + 1,
+        segment_code=cust.code("c_mktsegment", segment),
+        date=date, k=k)
+
+
+def q03_rows(result: ColumnTable) -> list:
+    """Decode a q03 result relation to the row-engine's output shape."""
+    import numpy as np
+
+    ok = np.asarray(result.mask())
+    okey = np.asarray(result["okey"])
+    odate = np.asarray(result["odate"])
+    rev = np.asarray(result["revenue"])
+    from netsdb_tpu.relational.table import int_to_date
+
+    rows = [{"okey": int(okey[j]), "odate": int_to_date(int(odate[j])),
+             "revenue": float(rev[j])}
+            for j in range(len(ok)) if ok[j]]
+    rows.sort(key=lambda r: (-r["revenue"], r["odate"]))
+    return rows
+
+
 def run_query(client, sink: WriteSet, job_name: Optional[str] = None):
     """Execute one columnar-DAG sink and return the result ColumnTable
     (also materialized into the sink's output set)."""

@@ -350,32 +350,60 @@ class RemoteClient:
         for frame in self._stream(MsgType.SCAN_SET_STREAM,
                                   {"db": db, "set": set_name,
                                    "max_frame_bytes": int(max_frame_bytes)}):
-            for blob in frame["blobs"]:
-                yield pickle.loads(blob)
+            yield from pickle.loads(frame["batch"])
+
+    @staticmethod
+    def _stream_frames(sock: socket.socket, msg_type: MsgType,
+                       payload: Any) -> Iterator[Any]:
+        """Frame loop of one streaming request over ``sock``: yield each
+        STREAM_ITEM payload until STREAM_END; ERR raises (the stream
+        ends, the connection stays frame-synchronized)."""
+        send_frame(sock, msg_type, payload)
+        while True:
+            typ, reply = recv_frame(sock, allow_pickle=True)
+            if typ == MsgType.STREAM_END:
+                return
+            if typ == MsgType.ERR:
+                raise RemoteError(reply.get("error", "Error"),
+                                  reply.get("message", ""),
+                                  reply.get("traceback", ""))
+            yield reply
 
     def _stream(self, msg_type: MsgType, payload: Any) -> Iterator[Any]:
         """Issue a streaming request; yield each STREAM_ITEM payload
         until STREAM_END. ERR aborts with RemoteError. If the consumer
         abandons the generator mid-stream, the socket is dropped (a
-        half-read stream cannot be resynchronized)."""
+        half-read stream cannot be resynchronized). A stream opened
+        from a thread ALREADY mid-stream (nested iteration) runs over
+        its own dedicated connection — like nested plain requests
+        (`_oneshot_request`), it must neither wait on the held lock nor
+        interleave frames on the streaming socket."""
+        if self._stream_owner == threading.get_ident():
+            s = socket.create_connection((self.host, self.port),
+                                         timeout=self._timeout)
+            try:
+                s.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+                send_frame(s, MsgType.HELLO, {"token": self.token})
+                typ, reply = recv_frame(s, allow_pickle=False)
+                if typ == MsgType.ERR:
+                    raise RemoteError(reply.get("error", "Error"),
+                                      reply.get("message",
+                                                "handshake refused"))
+                yield from self._stream_frames(s, msg_type, payload)
+            finally:
+                s.close()
+            return
         self._lock.acquire()
         self._stream_owner = threading.get_ident()
         done = False
         try:
             if self._sock is None:
                 self._connect()
-            send_frame(self._sock, msg_type, payload)
-            while True:
-                typ, reply = recv_frame(self._sock, allow_pickle=True)
-                if typ == MsgType.STREAM_END:
-                    done = True
-                    return
-                if typ == MsgType.ERR:
-                    done = True  # ERR terminates the stream; conn is sync'd
-                    raise RemoteError(reply.get("error", "Error"),
-                                      reply.get("message", ""),
-                                      reply.get("traceback", ""))
-                yield reply
+            yield from self._stream_frames(self._sock, msg_type, payload)
+            done = True
+        except RemoteError:
+            done = True  # ERR terminates the stream; conn is sync'd
+            raise
         except (ConnectionError, OSError):
             done = False
             raise

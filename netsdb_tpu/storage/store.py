@@ -377,9 +377,23 @@ class SetStore:
                                            persistence="persistent")
         self.get_items(ident)
 
+    def live_pool_bytes(self) -> int:
+        """Bytes of every distinct shared block pool referenced by at
+        least one resident set (``dedup/pool.py``) — counted ONCE per
+        pool regardless of how many sets share it, and dropping out
+        automatically when the last referencing set goes away."""
+        seen: Dict[int, int] = {}
+        for s in self._sets.values():
+            for item in (s.items or []):
+                p = getattr(item, "pool", None)
+                if p is not None and hasattr(p, "nbytes"):
+                    seen[id(p)] = int(p.nbytes)
+        return sum(seen.values())
+
     # --- eviction (ref: PageCache::evict + LocalitySet policies) ------
     def _maybe_evict(self, exclude: Optional[SetIdentifier] = None) -> None:
         total = sum(s.nbytes for s in self._sets.values() if s.items is not None)
+        total += self.live_pool_bytes()
         if total <= self.max_host_bytes:
             return
         candidates = [

@@ -218,6 +218,75 @@ def run_serve_bench(clients: int = 2, jobs_per_client: int = 8,
                 daemon.kill()
 
 
+def run_stream_bench(rows: int = 50_000, row_bytes: int = 2000,
+                     tensor_mb: int = 128) -> Dict[str, Any]:
+    """Transfer-path comparison on loopback: single-frame SCAN_SET /
+    GET_TENSOR (whole payload held twice on each end) vs the round-3
+    streamed forms (bounded continuation frames). Throughput should be
+    comparable — the point of streaming is the MEMORY bound, reported
+    here as the largest single frame each path holds."""
+    import tempfile
+
+    import numpy as np
+
+    from netsdb_tpu.config import Configuration
+    from netsdb_tpu.serve.client import RemoteClient
+    from netsdb_tpu.serve.server import ServeController
+
+    ctl = ServeController(Configuration(root_dir=tempfile.mkdtemp(
+        prefix="stream_bench_")), port=0)
+    port = ctl.start()
+    out: Dict[str, Any] = {}
+    try:
+        c = RemoteClient(f"127.0.0.1:{port}")
+        c.create_database("b")
+        c.create_set("b", "objs", type_name="object")
+        pad = "x" * row_bytes
+        c.send_data("b", "objs", [{"i": i, "p": pad} for i in range(rows)])
+        obj_bytes = rows * (row_bytes + 50)
+
+        t0 = time.perf_counter()
+        n1 = len(list(c.get_set_iterator("b", "objs")))
+        t_single = time.perf_counter() - t0
+        t0 = time.perf_counter()
+        n2 = sum(1 for _ in c.scan_stream("b", "objs",
+                                          max_frame_bytes=4 << 20))
+        t_stream = time.perf_counter() - t0
+        assert n1 == n2 == rows
+        out["scan"] = {
+            "payload_mb": round(obj_bytes / 2**20, 1),
+            "single_frame_s": round(t_single, 3),
+            "streamed_s": round(t_stream, 3),
+            "single_peak_frame_mb": round(obj_bytes / 2**20, 1),
+            "streamed_peak_frame_mb": 4,
+            "streamed_mb_per_s": round(obj_bytes / 2**20 / t_stream, 1),
+        }
+
+        side = int((tensor_mb * 2**20 / 4) ** 0.5) // 128 * 128
+        dense = np.random.default_rng(0).standard_normal(
+            (side, side)).astype(np.float32)
+        c.create_set("b", "w")
+        c.send_matrix("b", "w", dense, (512, 512))
+        t0 = time.perf_counter()
+        a1 = c.get_tensor("b", "w").to_dense()
+        t_one = time.perf_counter() - t0
+        t0 = time.perf_counter()
+        a2 = c.get_tensor_chunked("b", "w", chunk_bytes=8 << 20).to_dense()
+        t_chunk = time.perf_counter() - t0
+        assert np.array_equal(a1, a2)
+        out["tensor"] = {
+            "payload_mb": round(dense.nbytes / 2**20, 1),
+            "single_frame_s": round(t_one, 3),
+            "chunked_s": round(t_chunk, 3),
+            "chunked_peak_frame_mb": 8,
+            "chunked_mb_per_s": round(dense.nbytes / 2**20 / t_chunk, 1),
+        }
+        c.close()
+    finally:
+        ctl.shutdown()
+    return out
+
+
 def main(argv=None) -> int:
     import argparse
 
@@ -229,10 +298,15 @@ def main(argv=None) -> int:
     ap.add_argument("--batch", type=int, default=BATCH)
     ap.add_argument("--clients", type=int, default=2)
     ap.add_argument("--port", type=int, default=0)
+    ap.add_argument("--stream", action="store_true",
+                    help="transfer-path comparison: single-frame vs "
+                         "streamed scan / chunked tensor")
     args = ap.parse_args(argv)
     if args.worker:
         out = run_client_worker(args.address, args.client_id, args.jobs,
                                 args.batch)
+    elif args.stream:
+        out = run_stream_bench()
     else:
         out = run_serve_bench(clients=args.clients,
                               jobs_per_client=args.jobs, batch=args.batch,

@@ -38,9 +38,10 @@ def test_scan_stream_splits_frames_and_roundtrips(daemon):
                               "max_frame_bytes": budget}))
     assert len(frames) > 1, "large set must span multiple frames"
     for f in frames:
-        # bounded buffering: pickled payload per frame stays within the
-        # budget (a single item may exceed it alone; none does here)
-        assert sum(len(b) for b in f["blobs"]) <= budget
+        # bounded buffering: each frame's pickled batch stays near the
+        # budget (items here are uniform, so the adaptive batch size
+        # converges; growth is capped at 4x/frame either way)
+        assert len(f["batch"]) <= 4 * budget
     got = list(rc.scan_stream("d", "objs", max_frame_bytes=budget))
     assert got == items
 
@@ -113,3 +114,35 @@ def test_nested_request_during_stream_does_not_deadlock(daemon):
     assert copied == 100
     assert len(list(rc.scan_stream("d", "dst"))) == 100
     assert rc.ping()["sets"] == 2  # main connection still healthy
+
+
+def test_nested_stream_during_stream_does_not_deadlock(daemon):
+    """A stream opened while the same thread is consuming another
+    stream rides a dedicated connection (deadlock regression)."""
+    ctl, rc = daemon
+    rc.create_database("d")
+    rc.create_set("d", "a", type_name="object")
+    rc.create_set("d", "b", type_name="object")
+    rc.send_data("d", "a", [{"i": i, "p": "q" * 700} for i in range(60)])
+    rc.send_data("d", "b", list(range(10)))
+    pairs = 0
+    for item in rc.scan_stream("d", "a", max_frame_bytes=4 << 10):
+        inner = list(rc.scan_stream("d", "b"))  # nested stream
+        assert inner == list(range(10))
+        pairs += 1
+    assert pairs == 60
+    assert rc.ping()["sets"] == 2
+
+
+def test_first_frame_bounded_for_large_items(daemon):
+    """The first frame must not pack an unmeasured batch: with ~1 MB
+    items and a 64 KiB budget every frame holds exactly one item."""
+    ctl, rc = daemon
+    rc.create_database("d")
+    rc.create_set("d", "big", type_name="object")
+    rc.send_data("d", "big", [bytes(1 << 20) for _ in range(4)])
+    frames = list(rc._stream(MsgType.SCAN_SET_STREAM,
+                             {"db": "d", "set": "big",
+                              "max_frame_bytes": 64 << 10}))
+    assert len(frames) == 4  # one item per frame, nothing batched blind
+    assert all(len(f["batch"]) < (1 << 20) + 4096 for f in frames)
