@@ -194,6 +194,106 @@ def q03_rows(result: ColumnTable) -> list:
     return rows
 
 
+# ------------------------------------------- whole suite via the set API
+# Which stored sets each query core scans, in its args order.
+_QUERY_TABLES = {
+    "q01": ("lineitem",),
+    "q02": ("part", "partsupp", "supplier", "nation", "region"),
+    "q03": ("customer", "orders", "lineitem"),
+    "q04": ("orders", "lineitem"),
+    "q06": ("lineitem",),
+    "q12": ("orders", "lineitem"),
+    "q13": ("customer", "orders"),
+    "q14": ("lineitem", "part"),
+    "q17": ("lineitem", "part"),
+    "q22": ("customer", "orders"),
+}
+
+# The recommended placements for a distributed TPC-H database: fact
+# tables row-sharded, dimension tables replicated (broadcast join) —
+# padding-inertness of every core was audited under this convention
+# (fact padding rows carry -1 keys after the mask fold below, which the
+# orphan-key rule drops everywhere).
+FACT_TABLES = ("lineitem", "orders")
+
+
+def _fold_mask(t: ColumnTable) -> ColumnTable:
+    """Fold validity INTO the columns (trace-safe, no compaction):
+    invalid rows get -1 in int/code columns — dropped everywhere by the
+    kernels' orphan-key/in-range rule — and 0 in measures. The returned
+    table carries the original's aux key so warmed planner stats stay
+    visible (stats.py)."""
+    if t.valid is None:
+        return t
+    m = t.valid
+    cols = {}
+    for name, c in t.cols.items():
+        if c.dtype.kind == "b":
+            cols[name] = jnp.where(m, c, False)  # -1 would cast to True
+        elif c.dtype.kind == "i":
+            cols[name] = jnp.where(m, c, jnp.asarray(-1, c.dtype))
+        else:
+            cols[name] = jnp.where(m, c, jnp.asarray(0, c.dtype))
+    return ColumnTable(cols, t.dicts, None)
+
+
+def suite_sink_for(client, db: str, qname: str,
+                   output_set: Optional[str] = None, **params) -> WriteSet:
+    """ANY of the ten TPC-H query cores as a Computation DAG over
+    stored (placement-sharded) sets — the whole columnar suite
+    distributed through the database API with zero per-query DAG code.
+
+    Build time: planner statistics are computed host-side from the
+    stored tables and CLOSED OVER by the traced body (plain data, so
+    the DAG ships to a daemon intact). Trace time: each scanned table's
+    validity folds into its columns (`_fold_mask`), the captured stats
+    are injected into the traced clones (`stats.inject_stats` — traced
+    arrays cannot be analyzed), then the SAME core the single-device
+    engine runs (`queries._SUITE_CORES`) executes over the sharded
+    columns; XLA inserts the collectives. Output: the core's raw
+    arrays, bit-comparable to the single-device core.
+
+    Building from a RemoteClient works but pulls each scanned table
+    once to compute its stats — build sinks with an in-process client
+    (or cache them) when the tables are large."""
+    from netsdb_tpu.plan.computations import Join
+    from netsdb_tpu.relational.queries import _SUITE_CORES
+    from netsdb_tpu.relational.stats import analyze_table, inject_stats
+
+    if qname not in _QUERY_TABLES:
+        raise KeyError(f"unknown suite query {qname!r}; "
+                       f"have {sorted(_QUERY_TABLES)}")
+    names = _QUERY_TABLES[qname]
+    core, args_fn = _SUITE_CORES[qname]
+    captured = {n: dict(analyze_table(client.get_table(db, n)))
+                for n in names}
+
+    def run_core(*tabs) -> tuple:
+        tables = {n: inject_stats(_fold_mask(t), captured[n])
+                  for n, t in zip(names, tabs)}
+        out = core(*args_fn(tables, **params))
+        return out if isinstance(out, tuple) else (out,)
+
+    # chain the scans into one traced N-ary application via
+    # tuple-passing binary Joins (the reference compiles multi-way
+    # joins into binary stages the same way)
+    node = ScanSet(db, names[0])
+    if len(names) == 1:
+        node = Apply(node, lambda t: run_core(t),
+                     label=f"suite:{qname}:{params}")
+    else:
+        for n in names[1:-1]:
+            node = Join(node, ScanSet(db, n),
+                        fn=lambda a, b: (a + (b,) if isinstance(a, tuple)
+                                         else (a, b)),
+                        label=f"gather:{n}")
+        node = Join(node, ScanSet(db, names[-1]),
+                    fn=lambda a, b: run_core(*(a + (b,) if isinstance(a, tuple)
+                                               else (a, b))),
+                    label=f"suite:{qname}:{params}")
+    return WriteSet(node, db, output_set or f"{qname}_out")
+
+
 def run_query(client, sink: WriteSet, job_name: Optional[str] = None):
     """Execute one columnar-DAG sink and return the result ColumnTable
     (also materialized into the sink's output set)."""

@@ -11,10 +11,17 @@ LUT-vs-sort joins, dense-vs-scatter segment reductions, and
 broadcast-vs-repartition distribution (see
 :mod:`netsdb_tpu.relational.planner`).
 
-Stats are computed host-side in one numpy pass per column and cached on
-the :class:`~netsdb_tpu.relational.table.ColumnTable` instance, so the
-cost is paid once at ingest (loaders call :func:`analyze_table`) and
-every subsequent plan decision is a dict lookup.
+Stats are computed host-side in one numpy pass per column and cached
+PER TABLE INSTANCE, so the cost is paid once at ingest (loaders call
+:func:`analyze_table`) and every subsequent plan decision is a dict
+lookup. Instance keying is load-bearing: anything shared by schema
+equality (e.g. the pytree aux key) aliases across DISTINCT same-schema
+tables — jax reuses output treedefs, so one table's key_space would
+silently apply to another's data. Traced clones therefore start with
+EMPTY caches; code that needs stats inside a jit trace must inject
+host-computed stats explicitly (`inject_stats`, used by the set-API DAG
+builders in relational/dag.py — a cold cache under trace would need a
+host read of a traced array and raise).
 """
 
 from __future__ import annotations
@@ -26,7 +33,7 @@ import numpy as np
 
 from netsdb_tpu.relational.table import ColumnTable
 
-_CACHE_ATTR = "_column_stats"
+
 
 
 @dataclasses.dataclass(frozen=True)
@@ -78,16 +85,33 @@ def analyze_array(arr, distinct: bool = False) -> ColumnStats:
     return ColumnStats(int(a.size), int(a.min()), int(a.max()), nd)
 
 
+_CACHE_ATTR = "_column_stats"
+
+
+def _stats_cache(table: ColumnTable) -> Dict[str, ColumnStats]:
+    cache = table.__dict__.get(_CACHE_ATTR)
+    if cache is None:
+        cache = {}
+        table.__dict__[_CACHE_ATTR] = cache
+    return cache
+
+
+def inject_stats(table: ColumnTable,
+                 stats: Dict[str, ColumnStats]) -> ColumnTable:
+    """Seed ``table``'s per-instance cache with host-precomputed stats —
+    the bridge that lets planner decisions run inside a jit trace (where
+    computing stats from traced arrays is impossible). Returns the same
+    table."""
+    _stats_cache(table).update(stats)
+    return table
+
+
 def column_stats(table: ColumnTable, col: str,
                  distinct: bool = False) -> ColumnStats:
     """Stats for ``table.cols[col]``, cached on the table instance (the
     same idiom the old per-query ``key_space`` helper used, widened to
     the full stats record)."""
-    cache: Optional[Dict[str, ColumnStats]] = getattr(table, _CACHE_ATTR,
-                                                      None)
-    if cache is None:
-        cache = {}
-        object.__setattr__(table, _CACHE_ATTR, cache)
+    cache = _stats_cache(table)
     if col not in cache or (distinct and cache[col].n_distinct < 0):
         cache[col] = analyze_array(table[col], distinct)
     return cache[col]

@@ -69,7 +69,12 @@ class _TableAuxKey:
     """Hashable static metadata of a ColumnTable (column names + string
     dictionaries) with the hash precomputed once — jit cache lookups on
     table arguments stay O(1) after the first (identity fast path), not
-    O(total dictionary bytes) per call."""
+    O(total dictionary bytes) per call.
+
+    Deliberately carries NOTHING derived from column DATA: jax reuses
+    treedefs (and thus aux objects) across equal-schema tables, so any
+    per-data payload here would alias between distinct tables — see
+    relational/stats.py for why the stats cache is per-instance."""
 
     __slots__ = ("names", "dicts", "_hash")
 

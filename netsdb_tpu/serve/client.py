@@ -113,24 +113,10 @@ class RemoteClient:
         self._connect()
 
     # --- transport ----------------------------------------------------
-    def _connect(self) -> None:
-        s = socket.create_connection((self.host, self.port),
-                                     timeout=self._timeout)
-        s.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
-        send_frame(s, MsgType.HELLO, {"token": self.token})
-        typ, reply = recv_frame(s, allow_pickle=False)
-        if typ == MsgType.ERR:
-            s.close()
-            raise RemoteError(reply.get("error", "Error"),
-                              reply.get("message", "handshake refused"))
-        self._sock = s
-
-    def _oneshot_request(self, msg_type: MsgType, payload: Any,
-                         codec: int) -> Any:
-        """Issue one request over a throwaway connection — used when the
-        caller's thread is mid-stream on the main connection (e.g.
-        ``for item in c.scan_stream(...): c.send_data(...)``), which
-        must neither block on the held lock nor interleave frames."""
+    def _dial(self) -> socket.socket:
+        """Open + handshake one connection (the single copy of the
+        dial sequence — main connection, one-shot side requests and
+        nested streams all come through here)."""
         s = socket.create_connection((self.host, self.port),
                                      timeout=self._timeout)
         try:
@@ -140,6 +126,22 @@ class RemoteClient:
             if typ == MsgType.ERR:
                 raise RemoteError(reply.get("error", "Error"),
                                   reply.get("message", "handshake refused"))
+        except BaseException:
+            s.close()
+            raise
+        return s
+
+    def _connect(self) -> None:
+        self._sock = self._dial()
+
+    def _oneshot_request(self, msg_type: MsgType, payload: Any,
+                         codec: int) -> Any:
+        """Issue one request over a throwaway connection — used when the
+        caller's thread is mid-stream on the main connection (e.g.
+        ``for item in c.scan_stream(...): c.send_data(...)``), which
+        must neither block on the held lock nor interleave frames."""
+        s = self._dial()
+        try:
             send_frame(s, msg_type, payload, codec)
             typ, reply = recv_frame(s, allow_pickle=True)
         finally:
@@ -379,16 +381,8 @@ class RemoteClient:
         (`_oneshot_request`), it must neither wait on the held lock nor
         interleave frames on the streaming socket."""
         if self._stream_owner == threading.get_ident():
-            s = socket.create_connection((self.host, self.port),
-                                         timeout=self._timeout)
+            s = self._dial()
             try:
-                s.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
-                send_frame(s, MsgType.HELLO, {"token": self.token})
-                typ, reply = recv_frame(s, allow_pickle=False)
-                if typ == MsgType.ERR:
-                    raise RemoteError(reply.get("error", "Error"),
-                                      reply.get("message",
-                                                "handshake refused"))
                 yield from self._stream_frames(s, msg_type, payload)
             finally:
                 s.close()

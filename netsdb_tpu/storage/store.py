@@ -123,6 +123,9 @@ class SetStore:
         # reference guards Pangea's set maps with pthread mutexes);
         # reentrant because e.g. add_data -> _maybe_evict -> flush
         self._lock = threading.RLock()
+        # sets whose items include a shared-pool tensor (dedup/pool.py)
+        # — keeps pool-bytes accounting O(pooled sets)
+        self._pooled: set = set()
 
     # --- set lifecycle ------------------------------------------------
     @_locked
@@ -260,6 +263,7 @@ class SetStore:
         s = self._require(ident)
         s.items = [pooled]
         s.nbytes = _item_nbytes(pooled)
+        self._pooled.add(ident)  # pool-bytes accounting registry
 
     # --- persistence (ref: flush threads → PartitionedFile) -----------
     def _spill_path(self, ident: SetIdentifier) -> str:
@@ -377,23 +381,36 @@ class SetStore:
                                            persistence="persistent")
         self.get_items(ident)
 
+    @_locked
     def live_pool_bytes(self) -> int:
         """Bytes of every distinct shared block pool referenced by at
         least one resident set (``dedup/pool.py``) — counted ONCE per
         pool regardless of how many sets share it, and dropping out
-        automatically when the last referencing set goes away."""
+        automatically when the last referencing set goes away. Scans
+        only the sets registered by ``set_pooled`` (O(pooled sets), not
+        O(all items))."""
+        return self._live_pool_bytes()
+
+    def _live_pool_bytes(self) -> int:
         seen: Dict[int, int] = {}
-        for s in self._sets.values():
+        dead = []
+        for ident in self._pooled:
+            s = self._sets.get(ident)
+            if s is None:
+                dead.append(ident)
+                continue
             for item in (s.items or []):
                 p = getattr(item, "pool", None)
                 if p is not None and hasattr(p, "nbytes"):
                     seen[id(p)] = int(p.nbytes)
+        for ident in dead:
+            self._pooled.discard(ident)
         return sum(seen.values())
 
     # --- eviction (ref: PageCache::evict + LocalitySet policies) ------
     def _maybe_evict(self, exclude: Optional[SetIdentifier] = None) -> None:
         total = sum(s.nbytes for s in self._sets.values() if s.items is not None)
-        total += self.live_pool_bytes()
+        total += self._live_pool_bytes()
         if total <= self.max_host_bytes:
             return
         candidates = [
@@ -409,6 +426,7 @@ class SetStore:
                 return random.random()
             return s.last_access  # lru
 
+        pool_before = self._live_pool_bytes()
         for s in sorted(candidates, key=key):
             if total <= self.max_host_bytes:
                 break
@@ -417,6 +435,13 @@ class SetStore:
             s.items = None
             s.nbytes = 0
             self.stats.evictions += 1
+            if s.ident in self._pooled:
+                # evicting a pooled set may release its shared pool
+                # (when it was the last referencing set) — credit the
+                # released bytes or the loop over-evicts everyone else
+                pool_now = self._live_pool_bytes()
+                total -= pool_before - pool_now
+                pool_before = pool_now
 
     def _require(self, ident: SetIdentifier) -> _StoredSet:
         if ident not in self._sets:

@@ -329,3 +329,34 @@ def test_distributed_job_through_serve_daemon(config, tpch_rows):
         np.testing.assert_allclose(probs.sum(axis=0), 1.0, rtol=1e-4)
     finally:
         ctl.shutdown()
+
+
+def test_whole_suite_distributed_via_set_api(client, tpch_rows):
+    """ALL TEN TPC-H query cores run as DAGs over placement-sharded
+    stored sets (facts sharded, dims replicated) with raw outputs
+    matching the single-device cores — the full columnar suite
+    distributed through the database API."""
+    import jax
+
+    from netsdb_tpu.relational.queries import _SUITE_CORES
+
+    client.create_database("tpch")
+    for name in tpch_rows:
+        pl = (Placement.data_parallel(ndim=1)
+              if name in rdag.FACT_TABLES else Placement.replicated(ndim=1))
+        client.create_set("tpch", name, type_name="table", placement=pl)
+        client.send_table("tpch", name, tpch_rows[name])
+
+    solo_tables = tables_from_rows(tpch_rows)
+    for qname, (core, args_fn) in _SUITE_CORES.items():
+        got = rdag.run_query(client,
+                             rdag.suite_sink_for(client, "tpch", qname),
+                             job_name=f"suite-{qname}")
+        want = core(*args_fn(solo_tables))
+        g_leaves = jax.tree_util.tree_leaves(got)
+        w_leaves = jax.tree_util.tree_leaves(want)
+        assert len(g_leaves) == len(w_leaves), qname
+        for a, b in zip(g_leaves, w_leaves):
+            np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                       rtol=1e-5, atol=1e-3,
+                                       err_msg=qname)

@@ -175,3 +175,31 @@ def test_queries_agree_under_forced_sort(monkeypatch):
     t2 = tables_from_rows(data)
     for name, q in COLUMNAR_QUERIES.items():
         assert q(t2) == baseline[name], name
+
+
+def test_stats_never_alias_across_equal_schema_tables():
+    """Regression (r3 review): jax reuses output treedefs across
+    equal-schema tables, so a stats cache keyed on shared schema
+    objects would let one table's key_space apply to another's data.
+    Stats must be per-instance."""
+    import jax
+    import jax.numpy as jnp
+
+    from netsdb_tpu.relational.table import ColumnTable
+
+    f = jax.jit(lambda t: t.filter(t["k"] >= 0))
+    a = f(ColumnTable({"k": jnp.arange(10, dtype=jnp.int32)}))
+    b = f(ColumnTable({"k": jnp.arange(0, 9010, 10, dtype=jnp.int32)}))
+    assert key_space(a, "k") == 10
+    assert key_space(b, "k") == 9001  # NOT a's 10
+
+
+def test_inject_stats_seeds_trace_visible_cache():
+    import jax.numpy as jnp
+
+    from netsdb_tpu.relational.stats import ColumnStats, inject_stats
+    from netsdb_tpu.relational.table import ColumnTable
+
+    t = ColumnTable({"k": jnp.arange(5, dtype=jnp.int32)})
+    inject_stats(t, {"k": ColumnStats(5, 0, 99)})
+    assert key_space(t, "k") == 100  # injected, not recomputed
