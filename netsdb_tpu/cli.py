@@ -531,8 +531,11 @@ def _cmd_serve(args) -> int:
     from netsdb_tpu.serve.server import run_daemon
 
     config = Configuration(root_dir=args.root) if args.root else DEFAULT_CONFIG
+    followers = ([a.strip() for a in args.followers.split(",") if a.strip()]
+                 if getattr(args, "followers", None) else None)
     return run_daemon(config, host=args.host, port=args.port,
-                      token=args.token, max_jobs=args.max_jobs)
+                      token=args.token, max_jobs=args.max_jobs,
+                      followers=followers)
 
 
 def _cmd_serve_bench(args) -> int:
@@ -629,6 +632,10 @@ def main(argv=None) -> int:
     p.add_argument("--token", default=None, help="shared auth token")
     p.add_argument("--max-jobs", type=int, default=None,
                    help="concurrent job admission cap (default num_threads)")
+    p.add_argument("--followers", default=None,
+                   help="comma-separated worker daemon addresses: fan "
+                        "every mutating/job frame out for multi-host "
+                        "SPMD (init jax.distributed in every process)")
     p.add_argument("--platform", default=None,
                    help="force a jax platform (e.g. cpu) — env overrides "
                    "are ignored by the ambient plugin, only jax.config "

@@ -44,10 +44,15 @@ class TransformerLayerModel:
         self.db = db
         self.num_heads = num_heads
 
-    def setup(self, client: Client) -> None:
+    def setup(self, client: Client, placements=None) -> None:
+        """``placements`` maps set name → Placement (weights typically
+        replicated; the activation set sharded on the sequence axis) —
+        the long-context model declared distributed the same way the
+        relational sets are (round 3)."""
         client.create_database(self.db)
         for s in self.SETS:
-            client.create_set(self.db, s)
+            client.create_set(self.db, s,
+                              placement=(placements or {}).get(s))
 
     def load_random_weights(self, client: Client, embed: int,
                             seed: int = 0) -> None:
@@ -99,6 +104,94 @@ class TransformerLayerModel:
         out = ring_attention(q, k, v, mesh, axis=axis, causal=causal)
         x = x + merge_project(out, p.w_out)
         return x + self._mlp(self._ln(x), p)
+
+    # --- set-API serving (round 3) ------------------------------------
+    def load_inputs(self, client: Client, x: np.ndarray,
+                    input_set: str = "x", placement=None) -> None:
+        """Store an activation batch (batch, seq, embed) as a raw-array
+        set through the public data path (works with the in-process
+        client AND the RemoteClient — and therefore fans out to
+        follower daemons in multi-host mode). With a placement whose
+        spec shards dim 1 (the sequence axis), ingest shards the
+        sequence over the mesh — long-context inputs live distributed
+        in the database like any other set. Unplaced inputs get a
+        trivial replicated placement so the stored item is a device
+        array either way (the executor's traced-scan path takes
+        jax.Arrays; bare numpy items stay host objects by design)."""
+        from netsdb_tpu.parallel.placement import Placement
+
+        if placement is None:
+            placement = Placement((("data", 1),),
+                                  (None,) * np.asarray(x).ndim)
+        client.create_set(self.db, input_set, placement=placement)
+        client.clear_set(self.db, input_set)
+        client.send_data(self.db, input_set,
+                         [np.asarray(x, np.float32)])
+
+    def build_forward_dag(self, client: Client, input_set: str = "x",
+                          output_set: str = "y", causal: bool = True,
+                          placement=None):
+        """SCAN(x) ⋈ SCAN(weights...) → forward → OUTPUT. When the
+        input set's placement shards the sequence axis, the traced body
+        runs the ring-attention sequence-parallel forward over that
+        placement's mesh; unplaced sets run the single-chip forward —
+        the SAME DAG, distribution decided by how the sets were created
+        (netsdb_tpu round-3 rule).
+
+        ``placement``: the input set's placement. Defaults to looking
+        it up in the client's store; a RemoteClient has no store, so
+        remote callers pass the placement they created the set with."""
+        from netsdb_tpu.plan.computations import Join, ScanSet, WriteSet
+        from netsdb_tpu.storage.store import SetIdentifier
+
+        if placement is None and hasattr(client, "store"):
+            placement = client.store.placement_of(
+                SetIdentifier(self.db, input_set))
+        mesh = axis = None
+        if placement is not None:
+            sharded_axes = [a for a in placement.spec if a is not None]
+            if sharded_axes:
+                mesh = placement.mesh()
+                ax = sharded_axes[0]
+                axis = ax[0] if isinstance(ax, tuple) else ax
+                if mesh.shape[axis] == 1:
+                    mesh = axis = None  # degraded single-device mesh
+
+        def fwd(gathered, w_down_bt):
+            x, wq, wo, wu = gathered
+            p = TransformerLayerParams(
+                w_qkv=wq.to_dense(), w_out=wo.to_dense(),
+                w_up=wu.to_dense(), w_down=w_down_bt.to_dense())
+            if mesh is not None:
+                return self.forward_sp(p, x, mesh, axis, causal=causal)
+            return self.forward(p, x, causal=causal)
+
+        g1 = Join(ScanSet(self.db, input_set), ScanSet(self.db, "w_qkv"),
+                  fn=lambda a, b: (a, b), label="gather:w_qkv")
+        g2 = Join(g1, ScanSet(self.db, "w_out"),
+                  fn=lambda a, b: a + (b,), label="gather:w_out")
+        g3 = Join(g2, ScanSet(self.db, "w_up"),
+                  fn=lambda a, b: a + (b,), label="gather:w_up")
+        # the traced body CLOSES OVER the mesh, so the compiled-plan
+        # cache key (built from labels) must pin the mesh identity —
+        # axis names, shape AND device ids — or a same-shaped DAG built
+        # for a different/reinitialized mesh would reuse a stale closure
+        mesh_tag = (None if mesh is None else
+                    (tuple(mesh.shape.items()),
+                     tuple(d.id for d in mesh.devices.flat)))
+        out = Join(g3, ScanSet(self.db, "w_down"), fn=fwd,
+                   label=f"transformer-fwd:{self.num_heads}:{causal}:"
+                         f"{axis}:{mesh_tag}")
+        return WriteSet(out, self.db, output_set)
+
+    def serve_forward(self, client: Client, input_set: str = "x",
+                      output_set: str = "y", causal: bool = True,
+                      placement=None) -> jax.Array:
+        sink = self.build_forward_dag(client, input_set, output_set,
+                                      causal, placement=placement)
+        results = client.execute_computations(
+            sink, job_name=f"{self.db}-forward")
+        return next(iter(results.values()))
 
     def loss(self, p: TransformerLayerParams, x: jax.Array,
              targets: jax.Array) -> jax.Array:

@@ -91,14 +91,18 @@ def execute_computations(
         if isinstance(node, ScanSet):
             ident = SetIdentifier(node.db, node.set_name)
             items = client.store.get_items(ident)
-            # single-tensor and single-table sets become traced jit
-            # arguments; when their arrays carry a NamedSharding from
-            # the set's placement, XLA partitions the whole stage and
-            # inserts the cross-device collectives (the reference's
-            # per-stage shuffle/broadcast threads,
+            # single-tensor, single-table and single-array sets become
+            # traced jit arguments; when their arrays carry a
+            # NamedSharding from the set's placement, XLA partitions
+            # the whole stage and inserts the cross-device collectives
+            # (the reference's per-stage shuffle/broadcast threads,
             # QuerySchedulerServer.cc:216-330)
+            # NOTE: np.ndarray single items deliberately stay on the
+            # host-object path — conv staged pipelines store numpy
+            # images/patches as object items and iterate them
             if len(items) == 1 and isinstance(items[0],
-                                              (BlockedTensor, ColumnTable)):
+                                              (BlockedTensor, ColumnTable,
+                                               jax.Array)):
                 scan_values[node.node_id] = items[0]
                 tensor_scans.append(node)
             else:
@@ -125,7 +129,8 @@ def execute_computations(
             # scan values are closed over (non-cacheable jobs only)
             canon = {n.node_id: i for i, n in enumerate(plan.topo)}
             host_values = {k: v for k, v in scan_values.items()
-                           if not isinstance(v, (BlockedTensor, ColumnTable))}
+                           if not isinstance(v, (BlockedTensor, ColumnTable,
+                                                 jax.Array))}
 
             def run(tensor_args: Dict[int, BlockedTensor],
                     _plan=plan, _canon=canon, _host=host_values):
@@ -168,7 +173,9 @@ def execute_computations(
             client.store.create_set(ident)
             if isinstance(out, BlockedTensor):
                 client.store.put_tensor(ident, out)
-            elif isinstance(out, ColumnTable):
+            elif isinstance(out, (ColumnTable, jax.Array)):
+                # one relation / one raw array IS the set's content
+                # (iterating a jax.Array into rows would be wrong)
                 client.store.clear_set(ident)
                 client.store.add_data(ident, [out])
             elif isinstance(out, dict):

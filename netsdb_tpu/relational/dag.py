@@ -267,6 +267,19 @@ def suite_sink_for(client, db: str, qname: str,
     core, args_fn = _SUITE_CORES[qname]
     captured = {n: dict(analyze_table(client.get_table(db, n)))
                 for n in names}
+    # the captured stats are DATA-dependent state closed over by the
+    # traced body; they must be part of the compiled-plan cache key
+    # (via the label) or re-ingesting different data would silently
+    # reuse a stale closure (e.g. an old key_space shrinking a LUT join
+    # and dropping rows) — same hazard class as the transformer DAG's
+    # mesh identity
+    import hashlib
+
+    stats_tag = hashlib.blake2s(
+        repr(sorted((n, sorted((c, s.n_rows, s.min_val, s.max_val)
+                               for c, s in cs.items()))
+                    for n, cs in captured.items())).encode()
+    ).hexdigest()[:12]
 
     def run_core(*tabs) -> tuple:
         tables = {n: inject_stats(_fold_mask(t), captured[n])
@@ -280,7 +293,7 @@ def suite_sink_for(client, db: str, qname: str,
     node = ScanSet(db, names[0])
     if len(names) == 1:
         node = Apply(node, lambda t: run_core(t),
-                     label=f"suite:{qname}:{params}")
+                     label=f"suite:{qname}:{params}:{stats_tag}")
     else:
         for n in names[1:-1]:
             node = Join(node, ScanSet(db, n),
@@ -290,7 +303,7 @@ def suite_sink_for(client, db: str, qname: str,
         node = Join(node, ScanSet(db, names[-1]),
                     fn=lambda a, b: run_core(*(a + (b,) if isinstance(a, tuple)
                                                else (a, b))),
-                    label=f"suite:{qname}:{params}")
+                    label=f"suite:{qname}:{params}:{stats_tag}")
     return WriteSet(node, db, output_set or f"{qname}_out")
 
 

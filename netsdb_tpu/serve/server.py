@@ -82,17 +82,47 @@ class ServeController:
     """The daemon. ``start()`` runs the listener on a background thread
     (tests); ``serve_forever()`` blocks (the CLI ``serve`` command)."""
 
+    #: frame types every worker must replay for SPMD consistency — the
+    #: reference's DDL fan-out + job broadcast (DistributedStorageManager
+    #: / HermesExecutionServer.cc:1225-1274). Reads stay master-local.
+    MIRRORED = frozenset({
+        MsgType.CREATE_DATABASE, MsgType.CREATE_SET, MsgType.REMOVE_SET,
+        MsgType.CLEAR_SET, MsgType.REGISTER_TYPE, MsgType.SEND_DATA,
+        MsgType.SEND_MATRIX, MsgType.ADD_SHARED_MAPPING,
+        MsgType.FLUSH_DATA, MsgType.LOAD_SET,
+        MsgType.EXECUTE_COMPUTATIONS, MsgType.EXECUTE_PLAN,
+        MsgType.DEDUP_RESIDENT,
+    })
+
     def __init__(self, config: Configuration = DEFAULT_CONFIG,
                  host: str = "127.0.0.1", port: int = 8108,
                  token: Optional[str] = None,
                  max_jobs: Optional[int] = None,
-                 allow_pickle: bool = True):
+                 allow_pickle: bool = True,
+                 followers: Optional[list] = None):
+        """``followers``: addresses of worker daemons (one per other
+        jax.distributed process). Every state-mutating/job frame this
+        master handles is forwarded to them CONCURRENTLY with local
+        execution — all processes then run the same SPMD program in the
+        same order, which is what XLA's multi-controller collectives
+        require (compilation is a rendezvous; sequential forwarding
+        would deadlock it). The reference's master→worker job flow."""
         self.config = config
         self.host = host
         self.port = port
         self.token = token
         self.allow_pickle = allow_pickle
+        # followers dial LAZILY (with retry) on the first mirrored
+        # frame: a master may legitimately start before its workers
+        # bind, and eager dialing would kill it with a raw
+        # ConnectionRefusedError at startup
+        self._follower_addrs: list = list(followers or [])
+        self._followers: list = []
         self.library = Client(config)  # the resident state
+        # multi-host mode serializes MIRRORED frames: every process must
+        # observe the same mutation/job ORDER or the SPMD rendezvous
+        # deadlocks (single-host daemons never take this path)
+        self._mirror_lock = threading.Lock()
         self._jobs_sem = threading.Semaphore(max_jobs or config.num_threads)
         self._job_seq = itertools.count(1)
         self._jobs: Dict[int, Dict[str, Any]] = {}
@@ -210,7 +240,11 @@ class ServeController:
                 try:
                     if handler is None:
                         raise ProtocolError(f"no handler for {typ!r}")
-                    out = handler(payload)
+                    if self._follower_addrs and typ in self.MIRRORED:
+                        out = self._run_mirrored(typ, payload, codec_in,
+                                                 handler)
+                    else:
+                        out = handler(payload)
                     if inspect.isgenerator(out):
                         # streaming handler: each yielded (type, payload
                         # [, codec]) goes out as its own frame; TCP
@@ -244,6 +278,64 @@ class ServeController:
                         })
                     except OSError:
                         return
+
+    # --- multi-host mirroring (master → workers) ----------------------
+    def _ensure_followers(self, timeout_s: float = 30.0) -> None:
+        """Dial any not-yet-connected follower, retrying while it comes
+        up (bring-up order between master and workers is free)."""
+        if len(self._followers) == len(self._follower_addrs):
+            return
+        from netsdb_tpu.serve.client import RemoteClient
+
+        for addr in self._follower_addrs[len(self._followers):]:
+            deadline = time.time() + timeout_s
+            while True:
+                try:
+                    self._followers.append(RemoteClient(addr,
+                                                        token=self.token))
+                    break
+                except OSError as e:
+                    if time.time() >= deadline:
+                        raise ConnectionError(
+                            f"follower daemon {addr} unreachable after "
+                            f"{timeout_s:.0f}s: {e}") from e
+                    time.sleep(0.3)
+
+    def _run_mirrored(self, typ, payload, codec, handler):
+        """Execute one mutating/job frame on EVERY process: forward to
+        each follower daemon on its own thread while the local handler
+        runs — the processes rendezvous inside XLA (collective compile/
+        execute), so forwarding must be concurrent with, not after,
+        local execution. A follower failure after local success is
+        raised as a split-brain error: the cluster's stores have
+        diverged and the operator must recover (the reference aborts
+        the job the same way on worker failure)."""
+        with self._mirror_lock:
+            self._ensure_followers()
+            errors: list = []
+
+            def forward(fc):
+                try:
+                    fc._request(typ, payload, codec)
+                except Exception as e:  # noqa: BLE001 — reported below
+                    errors.append(f"{fc.host}:{fc.port}: "
+                                  f"{type(e).__name__}: {e}")
+
+            threads = [threading.Thread(target=forward, args=(fc,),
+                                        daemon=True)
+                       for fc in self._followers]
+            for t in threads:
+                t.start()
+            try:
+                out = handler(payload)
+            finally:
+                for t in threads:
+                    t.join()
+            if errors:
+                raise RuntimeError(
+                    "follower(s) failed; stores may have diverged: "
+                    + "; ".join(errors))
+            return out
 
     # --- job bookkeeping ----------------------------------------------
     def _run_job(self, job_name: str, fn: Callable[[], Any]) -> Any:
@@ -553,11 +645,14 @@ class ServeController:
 
 def run_daemon(config: Configuration, host: str = "127.0.0.1",
                port: int = 8108, token: Optional[str] = None,
-               max_jobs: Optional[int] = None) -> int:
+               max_jobs: Optional[int] = None,
+               followers: Optional[list] = None) -> int:
     """Start a daemon and block until shutdown — shared by the CLI
-    ``serve`` subcommand and :func:`main`."""
+    ``serve`` subcommand and :func:`main`. ``followers``: worker-daemon
+    addresses for multi-host fan-out (one per other jax.distributed
+    process; call ``parallel.distributed.initialize_cluster`` first)."""
     ctl = ServeController(config, host=host, port=port, token=token,
-                          max_jobs=max_jobs)
+                          max_jobs=max_jobs, followers=followers)
     bound = ctl.start()
     print(f"netsdb_tpu serving on {host}:{bound}", flush=True)
     ctl.serve_forever()
@@ -575,10 +670,17 @@ def main(argv=None) -> int:
     ap.add_argument("--root", default=None, help="database root dir")
     ap.add_argument("--token", default=None, help="shared auth token")
     ap.add_argument("--max-jobs", type=int, default=None)
+    ap.add_argument("--followers", default=None,
+                    help="comma-separated worker daemon addresses for "
+                         "multi-host fan-out (jax.distributed must be "
+                         "initialized in every process)")
     args = ap.parse_args(argv)
     config = Configuration(root_dir=args.root) if args.root else DEFAULT_CONFIG
+    followers = ([a.strip() for a in args.followers.split(",") if a.strip()]
+                 if args.followers else None)
     return run_daemon(config, host=args.host, port=args.port,
-                      token=args.token, max_jobs=args.max_jobs)
+                      token=args.token, max_jobs=args.max_jobs,
+                      followers=followers)
 
 
 if __name__ == "__main__":

@@ -200,3 +200,127 @@ def test_two_process_job_through_client_api(tmp_path):
             return
         last = "\n---\n".join(outs)
     pytest.fail(f"two-process client-API job failed twice:\n{last}")
+
+
+_DAEMON_WORKER = textwrap.dedent("""
+    import os, sys, tempfile, time
+    sys.path.insert(0, {repo!r})
+    import jax
+    jax.config.update("jax_platforms", "cpu")
+    from netsdb_tpu.parallel.distributed import initialize_cluster
+
+    pid = int(sys.argv[1])
+    p0_port, p1_port = int(sys.argv[2]), int(sys.argv[3])
+    ok = initialize_cluster(coordinator_address={addr!r},
+                            num_processes=2, process_id=pid)
+    assert ok and jax.device_count() == 8
+
+    from netsdb_tpu.config import Configuration
+    from netsdb_tpu.serve.server import ServeController
+
+    cfg = Configuration(root_dir=tempfile.mkdtemp(prefix=f"mhd{{pid}}_"))
+    if pid == 1:
+        # worker daemon: replays every mirrored frame the master
+        # forwards (HermesExecutionServer role)
+        ctl = ServeController(cfg, port=p1_port)
+        ctl.start()
+        ctl.serve_forever()  # until the master sends SHUTDOWN
+        print("JOBWORKER 1 OK")
+        sys.exit(0)
+
+    # master: wait for the worker daemon, then attach it as follower
+    import socket as _s
+    for _ in range(600):
+        try:
+            _s.create_connection(("127.0.0.1", p1_port), timeout=1).close()
+            break
+        except OSError:
+            time.sleep(0.2)
+    ctl = ServeController(cfg, port=p0_port,
+                          followers=[f"127.0.0.1:{{p1_port}}"])
+    ctl.start()
+
+    # the CLIENT talks only to the master; DDL/ingest/job fan out
+    from netsdb_tpu.serve.client import RemoteClient
+    from netsdb_tpu.parallel.placement import Placement
+    from netsdb_tpu.relational import dag as rdag
+    from netsdb_tpu.workloads import tpch
+
+    rows = tpch.generate(scale=1, seed=6)
+    c = RemoteClient(f"127.0.0.1:{{p0_port}}")
+    c.create_database("tpch")
+    c.create_set("tpch", "lineitem", type_name="table",
+                 placement=Placement((("data", 8),), ("data",)))
+    c.send_table("tpch", "lineitem", rows["lineitem"])
+
+    held = ctl.library.get_table("tpch", "lineitem")
+    col = next(iter(held.cols.values()))
+    assert len(col.sharding.device_set) == 8
+    assert not col.is_fully_addressable  # spans both processes
+
+    c.execute_computations(rdag.q01_sink("tpch"), job_name="mh-q01",
+                           fetch_results=False)
+    got = {{}}
+    import numpy as np
+    res = ctl.library.get_table("tpch", "q01_out")
+    counts = np.asarray(jax.device_get(res["count"]))
+    rf, ls = res.dicts["l_returnflag"], res.dicts["l_linestatus"]
+    rfc = np.asarray(jax.device_get(res["l_returnflag"]))
+    lsc = np.asarray(jax.device_get(res["l_linestatus"]))
+    for i in range(len(counts)):
+        if counts[i]:
+            got[(rf[int(rfc[i])], ls[int(lsc[i])])] = int(counts[i])
+    import collections
+    want = collections.Counter()
+    for r in rows["lineitem"]:
+        if r["l_shipdate"] <= "1998-09-02":
+            want[(r["l_returnflag"], r["l_linestatus"])] += 1
+    assert got == dict(want), (got, dict(want))
+
+    RemoteClient(f"127.0.0.1:{{p1_port}}").shutdown_server()
+    c.close(); ctl.shutdown()
+    print("JOBWORKER 0 OK")
+""")
+
+
+@pytest.mark.slow
+def test_two_process_job_through_daemon(tmp_path):
+    """Round-3: the master→worker job flow THROUGH the serve layer —
+    a client's DDL/ingest/job frames to the master daemon fan out to a
+    follower daemon on the second jax.distributed process, and a
+    sharded q01 executes collectively (HermesExecutionServer.cc:
+    1225-1274)."""
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    env = dict(os.environ,
+               JAX_PLATFORMS="cpu",
+               XLA_FLAGS="--xla_force_host_platform_device_count=4")
+    last = ""
+    for attempt in range(2):
+        addr = f"127.0.0.1:{_free_port()}"
+        p0, p1 = _free_port(), _free_port()
+        script = tmp_path / f"daemonworker{attempt}.py"
+        script.write_text(_DAEMON_WORKER.format(repo=repo, addr=addr))
+        procs = [subprocess.Popen(
+            [sys.executable, str(script), str(pid), str(p0), str(p1)],
+            env=env, stdout=subprocess.PIPE, stderr=subprocess.STDOUT,
+            text=True) for pid in (0, 1)]
+        outs = []
+        hung = False
+        for p in procs:
+            try:
+                out, _ = p.communicate(timeout=300)
+                outs.append(out)
+            except subprocess.TimeoutExpired:
+                for q in procs:
+                    q.kill()
+                hung = True
+                break
+        if hung:
+            last = "daemon fan-out hung"
+            continue
+        if all(p.returncode == 0 for p in procs) and all(
+                f"JOBWORKER {pid} OK" in out
+                for pid, out in enumerate(outs)):
+            return
+        last = "\n---\n".join(outs)
+    pytest.fail(f"two-process daemon job failed twice:\n{last}")
