@@ -29,14 +29,28 @@ _LOG2E = 1.4426950408889634  # softmax runs in the exp2 domain: one VPU
 # exp2 replaces exp (which lowers to exp2 * extra multiply per element)
 
 
+def _prescale_q(q, scale):
+    """Fold (softmax_scale * log2 e) into q ONCE per element — outside
+    the kernel, where XLA fuses it into the producing op. The fold in
+    `_fold_block` then emits logits directly in the exp2 domain with no
+    per-logit multiply (s^2/2 VPU ops saved; +4.6 TFLOP/s at 8k causal
+    bf16 on v5e). Numerics: f32 inputs scale exactly as before (one f32
+    multiply, just hoisted). bf16 inputs pay ONE extra bf16 rounding of
+    the scaled q per element (~2^-9 relative) that the old post-dot f32
+    multiply did not have — within the bf16 path's existing oracle
+    tolerances, traded for the per-logit multiply."""
+    return (q.astype(jnp.float32) * (scale * _LOG2E)).astype(q.dtype)
+
+
 def _fold_block(q_ref, k_ref, v_ref, m_ref, l_ref, acc_ref,
                 q_start, k_start, block_q: int, block_k: int,
-                causal: bool, scale: float):
+                causal: bool):
     """The shared online-softmax fold: combine one (q-block, k-block)
     pair into the VMEM accumulators (m, l, acc) — used verbatim by both
     the single-chip kernel and the ring-step carry kernel so their
     numerics cannot diverge. ``q_start``/``k_start`` are GLOBAL
-    positions (ints or traced scalars)."""
+    positions (ints or traced scalars). q must arrive PRE-SCALED by
+    (softmax_scale * log2 e) — see `_prescale_q`."""
 
     def _compute(masked: bool):
         # dtype policy matches ops.common.mxu_dot: f32 inputs run the MXU
@@ -48,12 +62,15 @@ def _fold_block(q_ref, k_ref, v_ref, m_ref, l_ref, acc_ref,
         q = q_ref[0]
         k = k_ref[0]
         v = v_ref[0]
-        # logits carried in the exp2 domain (pre-scaled by log2 e): one
-        # VPU exp2 per element instead of exp's exp2+multiply
+        # logits arrive directly in the exp2 domain: the WRAPPERS
+        # pre-multiply q by (scale * log2 e) once per q element, so the
+        # per-logit scalar multiply that used to follow this dot is
+        # gone — s^2/2 VPU multiplies saved, measured +4.6 TFLOP/s at
+        # 8k causal bf16 on v5e (112.2 -> 116.8)
         logits = jax.lax.dot_general(
             q, k, (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32,
-            precision=precision) * (scale * _LOG2E)
+            precision=precision)
         if masked:
             q_pos = q_start + jax.lax.broadcasted_iota(
                 jnp.int32, (block_q, block_k), 0)
@@ -95,7 +112,7 @@ def _fold_block(q_ref, k_ref, v_ref, m_ref, l_ref, acc_ref,
 
 
 def _flash_kernel(q_ref, k_ref, v_ref, o_ref, m_ref, l_ref, acc_ref, *,
-                  block_q: int, block_k: int, causal: bool, scale: float,
+                  block_q: int, block_k: int, causal: bool,
                   num_k_blocks: int):
     qi = pl.program_id(1)
     ki = pl.program_id(2)
@@ -107,8 +124,7 @@ def _flash_kernel(q_ref, k_ref, v_ref, o_ref, m_ref, l_ref, acc_ref, *,
         acc_ref[:] = jnp.zeros_like(acc_ref)
 
     _fold_block(q_ref, k_ref, v_ref, m_ref, l_ref, acc_ref,
-                qi * block_q, ki * block_k, block_q, block_k, causal,
-                scale)
+                qi * block_q, ki * block_k, block_q, block_k, causal)
 
     @pl.when(ki == num_k_blocks - 1)
     def _finalize():
@@ -126,17 +142,21 @@ def flash_attention(q: jax.Array, k: jax.Array, v: jax.Array,
     equivalent to ``ops.attention.attention``; never materializes the
     (S, S) score matrix in HBM.
 
-    Default 1024x1024 blocks measured fastest on v5e at D=128: 112.6
+    Default 1024x1024 blocks measured fastest on v5e at D=128: 116.4
     TFLOP/s useful (causal-halved) @8k bf16 after the exp2-domain
-    softmax, native-bf16 P·V pass, and diagonal-only masking — a full
-    sweep of other block shapes all measured slower (512x1024: 97.7,
-    2048x512: 65.2; 2048-square exceeds VMEM). jax's own reference TPU
-    flash kernel measures 116.3 at the same shapes, so this is the
-    structural ceiling of the rectangular-grid formulation on v5e: per
-    k-step the VPU softmax chain (~2 us) cannot overlap the two MXU
-    passes (~2.7 us), capping useful MFU near 60%. A triangular-grid
-    variant that schedules only lower-triangle blocks measured the
-    same (108.9) — dead blocks were already free — and was removed."""
+    softmax with q PRE-SCALED by (scale*log2e) outside the kernel
+    (r3: kills the per-logit scalar multiply, +4.6 TFLOP/s),
+    native-bf16 P·V pass, and diagonal-only masking — a full sweep of
+    other block shapes all measured slower (512x1024: 97.7, 2048x512:
+    65.2; 2048-square exceeds VMEM). jax's own reference TPU flash
+    kernel (TUNED BlockSizes — its defaults are ~7x slower) measures
+    119.6 at 8k and 99.6 at 4k, where this kernel now reads 100.6 —
+    parity to +1%: the remaining 8k gap (~3%) and the ~60% MFU cap are
+    the v5e VPU softmax chain that cannot overlap the two MXU passes.
+    A triangular-grid variant that schedules only lower-triangle
+    blocks measured the same — dead blocks were already free — and was
+    removed. The `attention-bench` guard asserts flash >= 0.92x of the
+    tuned jax kernel at 8k so these claims stay earned."""
     import math
 
     b, h, s, d = q.shape
@@ -169,7 +189,7 @@ def flash_attention(q: jax.Array, k: jax.Array, v: jax.Array,
         interpret = not on_tpu()
     scale = scale if scale is not None else d ** -0.5
     bh = b * h
-    qf = q.reshape(bh, s, d)
+    qf = _prescale_q(q.reshape(bh, s, d), scale)
     kf = k.reshape(bh, s, d)
     vf = v.reshape(bh, s, d)
     num_q = s // block_q
@@ -177,7 +197,7 @@ def flash_attention(q: jax.Array, k: jax.Array, v: jax.Array,
 
     kernel = functools.partial(
         _flash_kernel, block_q=block_q, block_k=block_k, causal=causal,
-        scale=scale, num_k_blocks=num_k)
+        num_k_blocks=num_k)
 
     out_shape = (jax.ShapeDtypeStruct((bh, s, d), q.dtype,
                                       vma=frozenset(out_vma))
@@ -230,7 +250,7 @@ def _flash_carry_kernel(off_ref, q_ref, k_ref, v_ref,
                         acc_out_ref, l_out_ref, m_out_ref,
                         m_s, l_s, acc_s, *,
                         block_q: int, block_k: int, causal: bool,
-                        scale: float, num_k_blocks: int):
+                        num_k_blocks: int):
     """One ring-attention step: fold a rotating k/v chunk into the
     online-softmax carry (acc, l, m), all in VMEM across this chunk's
     k-blocks. Positions are GLOBAL: ``off_ref`` holds (q_offset,
@@ -254,7 +274,7 @@ def _flash_carry_kernel(off_ref, q_ref, k_ref, v_ref,
 
     _fold_block(q_ref, k_ref, v_ref, m_s, l_s, acc_s,
                 q_off + qi * block_q, k_off + ki * block_k,
-                block_q, block_k, causal, scale)
+                block_q, block_k, causal)
 
     @pl.when(ki == num_k_blocks - 1)
     def _write():
@@ -289,6 +309,7 @@ def flash_attention_step(q: jax.Array, k: jax.Array, v: jax.Array,
 
         interpret = not on_tpu()
     scale = scale if scale is not None else d ** -0.5
+    q = _prescale_q(q, scale)
     block_q = math.gcd(1024, s_q)
     block_k = math.gcd(1024, s_k)
     num_q = s_q // block_q
@@ -298,7 +319,7 @@ def flash_attention_step(q: jax.Array, k: jax.Array, v: jax.Array,
 
     kernel = functools.partial(
         _flash_carry_kernel, block_q=block_q, block_k=block_k,
-        causal=causal, scale=scale, num_k_blocks=num_k)
+        causal=causal, num_k_blocks=num_k)
 
     def shp(arr):
         if out_vma:
