@@ -360,3 +360,50 @@ def test_whole_suite_distributed_via_set_api(client, tpch_rows):
             np.testing.assert_allclose(np.asarray(a), np.asarray(b),
                                        rtol=1e-5, atol=1e-3,
                                        err_msg=qname)
+
+
+def test_suite_sink_reingest_does_not_reuse_stale_stats(client):
+    """Regression (r3 review): the suite DAG closes over build-time
+    planner stats; re-ingesting data with a LARGER key space must not
+    hit the old compiled closure (whose smaller LUT would silently
+    drop join rows). The stats fingerprint in the node label forces a
+    fresh compile."""
+    import jax
+
+    from netsdb_tpu.relational.queries import _SUITE_CORES
+
+    def load(c, stride, n_orders):
+        rows = tpch.generate(scale=1, seed=21)
+        # remap orderkeys onto a stride so the key SPACE genuinely
+        # changes between ingests (scale-1 keys are 0..~150; a plain
+        # modulo above that would be a no-op)
+        for r in rows["orders"]:
+            r["o_orderkey"] = (r["o_orderkey"] * stride) % n_orders
+        for r in rows["lineitem"]:
+            r["l_orderkey"] = (r["l_orderkey"] * stride) % n_orders
+        for name in ("customer", "orders", "lineitem"):
+            if not c.set_exists("tpch", name):
+                c.create_set("tpch", name, type_name="table",
+                             placement=(Placement.data_parallel(ndim=1)
+                                        if name in rdag.FACT_TABLES else
+                                        Placement.replicated(ndim=1)))
+            c.send_table("tpch", name, rows[name])
+        return rows
+
+    client.create_database("tpch")
+    core, args_fn = _SUITE_CORES["q03"]
+
+    load(client, stride=1, n_orders=128)  # small key space first
+    rdag.run_query(client, rdag.suite_sink_for(client, "tpch", "q03"))
+
+    # stride-31 remap: max key ~ 150*31 % 4096 → key space ~32× larger
+    rows2 = load(client, stride=31, n_orders=4096)
+    got = rdag.run_query(client,
+                         rdag.suite_sink_for(client, "tpch", "q03"))
+    want = core(*args_fn(tables_from_rows(rows2)))
+    g_leaves = jax.tree_util.tree_leaves(got)
+    w_leaves = jax.tree_util.tree_leaves(want)
+    assert len(g_leaves) == len(w_leaves)
+    for a, b in zip(g_leaves, w_leaves):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=1e-5, atol=1e-3)
