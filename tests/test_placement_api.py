@@ -407,3 +407,33 @@ def test_suite_sink_reingest_does_not_reuse_stale_stats(client):
     for a, b in zip(g_leaves, w_leaves):
         np.testing.assert_allclose(np.asarray(a), np.asarray(b),
                                    rtol=1e-5, atol=1e-3)
+
+
+def test_kmeans_on_placed_set_matches_single_device(client, config):
+    """The classic ML workloads distribute through the set API too:
+    kmeans over a placed points set (rows sharded over the mesh) runs
+    the same jitted Lloyd's loop with XLA inserting the psums, matching
+    the single-device result."""
+    from netsdb_tpu.client import Client
+    from netsdb_tpu.workloads.kmeans import kmeans_on_set
+
+    rng = np.random.default_rng(11)
+    pts = (rng.standard_normal((512, 16)) +
+           (rng.integers(0, 4, (512, 1)) * 8)).astype(np.float32)
+
+    def run(c, placement):
+        c.create_database("ml")
+        c.create_set("ml", "points", placement=placement)
+        c.send_matrix("ml", "points", pts, (8, 8))
+        cents, assign = kmeans_on_set(c, "ml", "points", k=4, iters=8,
+                                      seed=3)
+        return np.asarray(cents), np.asarray(assign)
+
+    dist_c, dist_a = run(client, Placement.data_parallel(ndim=2))
+    t = client.get_tensor("ml", "points")
+    assert _num_shards(t.data) == 8
+    solo_c, solo_a = run(Client(config), None)
+    np.testing.assert_allclose(dist_c, solo_c, rtol=1e-4, atol=1e-4)
+    # distributed float-reduce ordering can flip points on decision
+    # boundaries: admit a handful of tie flips over the 512 points
+    assert (dist_a == solo_a).mean() >= 0.99
