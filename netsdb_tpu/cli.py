@@ -163,7 +163,13 @@ def _cmd_autotune(args) -> int:
     """Measure the physical-strategy crossovers on the live backend and
     persist them per device kind (the planner reads them back;
     ``netsdb_tpu.relational.tuning``)."""
+    from netsdb_tpu.config import DEFAULT_CONFIG, enable_compilation_cache
     from netsdb_tpu.relational import tuning
+
+    # dozens of (strategy, size) probe programs: without the persistent
+    # compile cache each one cold-compiles over the tunnel (~10 s each)
+    # and the sweep takes tens of minutes instead of a few
+    enable_compilation_cache(DEFAULT_CONFIG)
 
     measured = tuning.autotune(persist=not args.no_persist)
     print(json.dumps({"device_kind": tuning.device_kind(), **measured}))

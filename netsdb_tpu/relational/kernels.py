@@ -93,21 +93,20 @@ def segment_sum(values: jnp.ndarray, segment_ids: jnp.ndarray,
     return jnp.zeros((num_segments,), v.dtype).at[ids].add(v)
 
 
-# Above the dense crossover but below this, the GRID formulation of a
-# count (one-hot int8 MXU matmul over an (H, 128) key grid — see
-# `count_grid`) beats the scatter-add: measured v5e @1M rows — 0.67 ms
-# grid vs 6.9 ms scatter at G=50k, crossing back over near H≈4600
-# (grid cost is linear in H = G/128; 17.8 ms at G=1.5M). 256k is the
-# conservative cap.
-_GRID_COUNT_MAX = 1 << 18
-
-
 def segment_count(segment_ids: jnp.ndarray, num_segments: int,
                   mask: Optional[jnp.ndarray] = None,
                   method: Optional[str] = None) -> jnp.ndarray:
-    if method == "grid" or (method is None
-                            and not _use_dense(num_segments, None)
-                            and num_segments <= _GRID_COUNT_MAX):
+    """Per-segment counts. Three strategies, chosen by the planner's
+    measured thresholds when ``method`` is None: "dense" (tiny G),
+    "grid" (mid-range G — one-hot int8 MXU matmuls, measured 0.67 ms vs
+    6.9 ms scatter at G=50k/1M rows on v5e; linear in G/128, losing to
+    scatter again near G~590k — `tuning` key ``count_grid_limit``),
+    "scatter" (large G)."""
+    if method is None:
+        from netsdb_tpu.relational import planner
+
+        method = planner.count_method(num_segments)
+    if method == "grid":
         return count_grid(segment_ids, num_segments, mask)
     ones = jnp.ones(segment_ids.shape, jnp.int32)
     return segment_sum(ones, segment_ids, num_segments, mask, method)

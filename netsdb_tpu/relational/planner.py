@@ -97,6 +97,18 @@ def segment_method(num_segments: int, kind: Optional[str] = None) -> str:
     return "dense" if num_segments <= limit else "scatter"
 
 
+def count_method(num_segments: int, kind: Optional[str] = None) -> str:
+    """Strategy for a pure COUNT reduction, which has a third option:
+    the grid one-hot int8 MXU formulation (`kernels.count_grid`) — exact
+    for counts, and measured faster than the scatter-add through
+    mid-range cardinalities (`count_grid_limit`, autotuned)."""
+    if num_segments <= tuning.get("segment_dense_limit", kind):
+        return "dense"
+    if num_segments <= tuning.get("count_grid_limit", kind):
+        return "grid"
+    return "scatter"
+
+
 class DistPlan(NamedTuple):
     """Distributed join-side placement: replicate the build side to all
     shards (``"broadcast"``) or hash-repartition both sides

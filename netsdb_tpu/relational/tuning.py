@@ -36,22 +36,29 @@ import numpy as np
 # cap so a pathological key range cannot OOM HBM.
 _MEASURED: Dict[str, Dict[str, float]] = {
     # v5e, measured via `python -m netsdb_tpu autotune` on the live
-    # chip: scatter serializes on colliding updates (52.6 ms vs ~2 ms at
-    # 12 groups, BASELINE.md); dense loses past G=64 at 1M rows. The
-    # LUT join keeps winning through a 128x-sparse key space (gathers
-    # stream; sort+searchsorted serializes), so only the byte cap
-    # retires it.
-    "TPU v5 lite": {"segment_dense_limit": 64, "join_lut_factor": 128.0,
-                    "join_lut_max_bytes": 1 << 28},
+    # chip with SCAN-SLOPE timing (r3 — the r2 values 64/128 were
+    # per-dispatch walls, which the ~65 ms controller RTT reduced to
+    # noise): scatter serializes on colliding updates (55.7 ms vs
+    # below-noise dense at 12 groups / 6M rows), and dense keeps
+    # winning through the whole measured range (G<=512 @1M rows). The
+    # LUT join wins through a 64x-sparse key space (gathers stream;
+    # sort+searchsorted serializes); the byte cap retires it beyond.
+    "TPU v5 lite": {"segment_dense_limit": 512, "join_lut_factor": 64.0,
+                    "join_lut_max_bytes": 1 << 28,
+                    # grid one-hot count beats scatter up to 256k groups
+                    # (0.67 vs 6.9 ms at 50k; linear in G/128 — kernels.py)
+                    "count_grid_limit": float(1 << 18)},
     # CPU (tests, virtual mesh): XLA's CPU scatter is cheap and the
     # dense O(N*G) pass loses earlier.
     "cpu": {"segment_dense_limit": 32, "join_lut_factor": 16.0,
             "join_lut_max_bytes": 1 << 27,
+            "count_grid_limit": float(1 << 18),
             "device_hbm_bytes": 4 * 1024**3},
 }
 
 _DEFAULTS: Dict[str, float] = {
     "segment_dense_limit": 64,
+    "count_grid_limit": float(1 << 18),
     "join_lut_factor": 32.0,
     "join_lut_max_bytes": 1 << 28,
     # fallback per-device memory for broadcast-vs-repartition planning
@@ -115,20 +122,59 @@ def clear_overrides() -> None:
 
 # --------------------------------------------------------------- autotune
 
-def _time_fn(fn, *args, reps: int = 5) -> float:
-    jax.block_until_ready(fn(*args))  # compile + warm
-    t0 = time.perf_counter()
-    for _ in range(reps):
-        out = fn(*args)
-    jax.block_until_ready(out)
-    return (time.perf_counter() - t0) / reps
+def _scan_time(step_fn, lo: int = 8, hi: int = 64) -> Optional[float]:
+    """Seconds/iteration of ``step_fn(carry) -> carry`` folded inside
+    ONE jitted lax.scan — the tunnel-safe timing protocol every bench
+    in this repo uses (`utils.timing.scan_slope_seconds`): loop lengths
+    escalate until the delta clears controller noise. ``step_fn`` must
+    thread a live int32 carry through the computation so XLA can
+    neither hoist nor DCE the body. Returns None when the kernel is
+    below timing noise even after escalation."""
+    import functools
+
+    from netsdb_tpu.utils.timing import device_seconds
+
+    @functools.partial(jax.jit, static_argnums=(0,))
+    def loop(n):
+        def step(c, _):
+            return step_fn(c), None
+
+        c, _ = jax.lax.scan(step, jnp.zeros((), jnp.int32), None, length=n)
+        return c
+
+    # autotune sweeps dozens of (strategy, size) points and each
+    # escalation recompiles two loop lengths — cap the retries and
+    # accept a coarser (but still RTT-immune) delta than the benches use.
+    # NEVER time per-dispatch walls here: over the axon tunnel each
+    # dispatch pays ~65 ms RTT and the r2 autotune recorded pure noise.
+    return device_seconds(lambda n: float(loop(n)), lo=lo, hi=hi,
+                          repeats=2, max_escalations=2,
+                          min_delta_seconds=0.1)
+
+
+def _faster(ta: Optional[float], tb: Optional[float]) -> Optional[bool]:
+    """Compare two `_scan_time` results where None means BELOW NOISE —
+    i.e. faster than the measurement floor, which must count as a WIN,
+    not a failure (treating it as undecidable once made autotune record
+    'dense never wins' for the strategy that was too fast to time).
+    Returns None only when both sides are below noise (undecidable)."""
+    if ta is None and tb is None:
+        return None
+    if ta is None:
+        return True
+    if tb is None:
+        return False
+    return ta <= tb
 
 
 def measure_segment_crossover(n_rows: int = 1 << 20,
                               candidates=(8, 16, 32, 64, 128, 256, 512),
-                              ) -> int:
+                              ) -> Optional[int]:
     """Measure the dense-vs-scatter segment-sum crossover on the live
-    backend: the largest G where dense still wins."""
+    backend: the largest G where dense still wins. 0 means dense LOST
+    at the smallest candidate; None means nothing was decidable (both
+    strategies below timing noise everywhere) — callers must keep their
+    prior threshold rather than record "never wins"."""
     from netsdb_tpu.relational import kernels as K
 
     rng = np.random.default_rng(0)
@@ -137,25 +183,64 @@ def measure_segment_crossover(n_rows: int = 1 << 20,
     for g in candidates:
         seg = jnp.asarray(rng.integers(0, g, n_rows).astype(np.int32))
 
-        def dense(v, s, g=g):
-            return K.segment_sum(v, s, g, method="dense")
+        def step(method):
+            def run(c):
+                s_ = (seg + c) % g  # carry-coupled: no hoisting
+                out = K.segment_sum(vals, s_, g, method=method)
+                return (c + out[0].astype(jnp.int32)) % 127
 
-        def scatter(v, s, g=g):
-            return K.segment_sum(v, s, g, method="scatter")
+            return run
 
-        td = _time_fn(jax.jit(dense), vals, seg)
-        ts = _time_fn(jax.jit(scatter), vals, seg)
-        if td <= ts:
+        win = _faster(_scan_time(step("dense")), _scan_time(step("scatter")))
+        if win is None:
+            if best == 0:
+                return None  # nothing decidable: caller keeps prior value
+            break  # keep the last decidable crossover
+        if win:
             best = g
         else:
             break
-    # best == 0 ⇒ dense lost even at the smallest G: record "never"
+    # best == 0 ⇒ dense LOST at the smallest G (decided): record "never"
+    return best
+
+
+def measure_count_grid_crossover(n_rows: int = 1 << 20,
+                                 candidates=(1 << 12, 1 << 14, 1 << 16,
+                                             1 << 18, 1 << 20),
+                                 ) -> Optional[int]:
+    """Measure the grid-vs-scatter segment-count crossover: the largest
+    group count where the one-hot int8 MXU grid formulation still beats
+    the scatter-add (`kernels.count_grid`)."""
+    from netsdb_tpu.relational import kernels as K
+
+    rng = np.random.default_rng(0)
+    best = 0
+    for g in candidates:
+        seg = jnp.asarray(rng.integers(0, g, n_rows).astype(np.int32))
+
+        def step(method):
+            def run(c):
+                s_ = (seg + c) % g  # carry-coupled: no hoisting
+                out = K.segment_count(s_, g, method=method)
+                return (c + out[0]) % 127
+
+            return run
+
+        win = _faster(_scan_time(step("grid")), _scan_time(step("scatter")))
+        if win is None:
+            if best == 0:
+                return None  # undecidable ≠ "grid never wins"
+            break
+        if win:
+            best = g
+        else:
+            break
     return best
 
 
 def measure_join_crossover(n_build: int = 1 << 17, n_probe: int = 1 << 19,
                            factors=(2, 4, 8, 16, 32, 64, 128),
-                           ) -> float:
+                           ) -> Optional[float]:
     """Measure the LUT-vs-sort join crossover: the largest
     ``key_space / (build + probe)`` ratio where the LUT still wins."""
     from netsdb_tpu.relational import kernels as K
@@ -184,15 +269,22 @@ def measure_join_crossover(n_build: int = 1 << 17, n_probe: int = 1 << 19,
         pk = jnp.asarray(rng.permutation(pk_u).astype(np.int32))
         fk = jnp.asarray(rng.integers(0, ks, n_probe).astype(np.int32))
 
-        def lut(p, q, ks=ks):
-            return K.pk_fk_join(p, q, plan=JoinPlan("lut", ks))
+        def step(strategy, ks=ks, pk=pk, fk=fk):
+            def run(c):
+                probe = (fk + c) % ks  # perturb the probe side only:
+                # build keys must stay unique
+                idx, hit = K.pk_fk_join(pk, probe,
+                                        plan=JoinPlan(strategy, ks))
+                return (c + idx[0] + hit[0].astype(jnp.int32)) % 127
 
-        def srt(p, q, ks=ks):
-            return K.pk_fk_join(p, q, plan=JoinPlan("sort", ks))
+            return run
 
-        tl = _time_fn(jax.jit(lut), pk, fk)
-        tsort = _time_fn(jax.jit(srt), pk, fk)
-        if tl <= tsort:
+        win = _faster(_scan_time(step("lut")), _scan_time(step("sort")))
+        if win is None:
+            if best == 0.0:
+                return None  # undecidable ≠ "LUT never wins"
+            break
+        if win:
             best = float(f)
         else:
             break
@@ -204,11 +296,15 @@ def autotune(persist: bool = True) -> Dict[str, float]:
     persist them for this device kind. Run via
     ``python -m netsdb_tpu autotune``."""
     kind = device_kind()
-    measured = {
-        "segment_dense_limit": float(measure_segment_crossover()),
+    raw = {
+        "segment_dense_limit": measure_segment_crossover(),
+        "count_grid_limit": measure_count_grid_crossover(),
         "join_lut_factor": measure_join_crossover(),
-        "join_lut_max_bytes": float(_load(kind)["join_lut_max_bytes"]),
     }
+    # None = the sweep was undecidable (everything below timing noise):
+    # keep the existing threshold instead of persisting "never wins"
+    measured = {k: float(v) for k, v in raw.items() if v is not None}
+    measured["join_lut_max_bytes"] = float(_load(kind)["join_lut_max_bytes"])
     _load(kind).update(measured)
     jax.clear_caches()  # compiled programs have the old thresholds baked in
     if persist:
