@@ -243,6 +243,10 @@ class PageStore {
     Page* p = it->second;
     if (p->pins > 0) return -2;
     drop_buffer_locked(p);
+    // the page's capacity leaves the live ledger entirely (allocated
+    // tracks LIVE pages, resident or spilled — not cumulative allocs);
+    // without this, freed sets would count against the pool forever
+    stats_.bytes_allocated -= p->cap;
     auto& vec = sets_[p->set_id].pages;
     vec.erase(std::remove(vec.begin(), vec.end(), page_id), vec.end());
     delete p;

@@ -114,10 +114,19 @@ class Client:
         eviction: str = "lru",
         partition_lambda: Optional[str] = None,
         placement=None,
+        storage: str = "memory",
     ) -> SetIdentifier:
         """``partition_lambda`` mirrors createSet-with-dispatch-computation
         (reference ``PDBClient.h:79-103``): a named key function the
         dispatcher/placement layer may use to route data.
+
+        ``storage="paged"`` backs the set with the shared page arena
+        instead of RAM: ingest pages the relation in row-chunks, and
+        Computation DAGs over the set run STREAMED — the executor folds
+        each fold-bearing stage over the page stream under the arena's
+        pool cap (the reference's PageScanner-fed out-of-core execution,
+        ``src/storage/headers/PageScanner.h:25-34``). Composes with
+        ``placement``: streamed chunks are mesh-sharded per chunk.
 
         ``placement`` (:class:`~netsdb_tpu.parallel.placement.Placement`
         or its ``to_meta`` dict) declares the set's mesh sharding — the
@@ -128,6 +137,11 @@ class Client:
         workers."""
         if not self.catalog.database_exists(db):
             raise KeyError(f"database {db!r} does not exist; create_database first")
+        if storage not in ("memory", "paged"):
+            # validate BEFORE the catalog write — a late store-side
+            # rejection would leave a dangling catalog row
+            raise ValueError(f"storage must be 'memory' or 'paged', "
+                             f"got {storage!r}")
         from netsdb_tpu.parallel.placement import Placement
 
         if isinstance(placement, dict):
@@ -170,10 +184,12 @@ class Client:
                                     plan_key=f"set:{db}.{set_name}",
                                     elapsed_s=0.0,
                                     config_label=arm.label)
+        if storage != "memory":
+            meta["storage"] = storage
         self.catalog.create_set(db, set_name, type_name, meta, persistence)
         ident = _ident(db, set_name)
         self.store.create_set(ident, persistence=persistence, eviction=eviction,
-                              placement=placement)
+                              placement=placement, storage=storage)
         return ident
 
     def remove_set(self, db: str, set_name: str) -> None:
@@ -207,7 +223,31 @@ class Client:
 
     # --- data path ----------------------------------------------------
     def send_data(self, db: str, set_name: str, items: Sequence[Any]) -> None:
-        self.store.add_data(_ident(db, set_name), list(items))
+        """Sets created with ``type_name="objects"`` columnarize at
+        ingest: records flow through ``autojoin.table_from_objects``
+        into ONE dictionary-encoded ColumnTable (string keys become
+        device codes), so ``Join(on=...)`` DAGs over the set run on the
+        device engine — the reference's dispatcher building typed pages
+        from raw records (``JoinPairArray.h:122`` re-priced). All other
+        sets store items as-is (the host-record path)."""
+        ident = _ident(db, set_name)
+        info = self.catalog.get_set(db, set_name)
+        if info is not None and info.get("type") == "objects":
+            if not items:
+                return  # empty batch: same no-op as the object path
+            from netsdb_tpu.relational.autojoin import (concat_tables,
+                                                        table_from_objects)
+            from netsdb_tpu.relational.table import ColumnTable
+
+            new = table_from_objects(list(items))
+            existing = [i for i in self.store.get_items(ident)
+                        if isinstance(i, ColumnTable)]
+            if existing:  # append: device concat + dictionary remap
+                new = concat_tables(existing[0], new)
+            self.store.clear_set(ident)
+            self.store.add_data(ident, [new])
+            return
+        self.store.add_data(ident, list(items))
 
     def send_matrix(
         self,
@@ -265,14 +305,42 @@ class Client:
         return table
 
     def get_table(self, db: str, set_name: str):
+        from netsdb_tpu.relational.outofcore import PagedColumns
         from netsdb_tpu.relational.table import ColumnTable
 
         items = self.store.get_items(_ident(db, set_name))
         tables = [i for i in items if isinstance(i, ColumnTable)]
+        if not tables:
+            paged = [i for i in items if isinstance(i, PagedColumns)]
+            if len(paged) == 1:
+                # compatibility materialization — streams every page
+                # back into one resident table; queries should go
+                # through the DAG path, which folds over the stream
+                return paged[0].to_table()
         if len(tables) != 1:
             raise ValueError(
                 f"set {db}:{set_name} holds {len(tables)} tables; expected 1")
         return tables[0]
+
+    def analyze_set(self, db: str, set_name: str) -> Dict[str, Any]:
+        """Planner statistics for a stored relation WITHOUT
+        materializing it: resident tables analyze in place (cached);
+        paged sets return their ingest-time stats. This is the
+        reference's collect-stats-where-the-data-lives surface
+        (``StorageCollectStats``, ``PangeaStorageServer.h:48``) — the
+        DAG builders consume these summaries instead of pulling tables
+        (``relational/dag.py``)."""
+        from netsdb_tpu.relational.outofcore import PagedColumns
+        from netsdb_tpu.relational.stats import analyze_table
+
+        items = self.store.get_items(_ident(db, set_name))
+        if len(items) == 1 and isinstance(items[0], PagedColumns):
+            pc = items[0]
+            return {"stats": dict(pc.stats), "dicts": dict(pc.dicts),
+                    "num_rows": pc.num_rows}
+        t = self.get_table(db, set_name)
+        return {"stats": dict(analyze_table(t)), "dicts": dict(t.dicts),
+                "num_rows": t.num_rows}
 
     def get_tensor(self, db: str, set_name: str) -> BlockedTensor:
         return self.store.get_tensor(_ident(db, set_name))
@@ -282,8 +350,12 @@ class Client:
 
     def flush_data(self) -> None:
         """Durably flush all persistent sets (ref flushData →
-        StorageCleanup broadcast, ``PDBClient.h:141``)."""
+        StorageCleanup broadcast, ``PDBClient.h:141``). Paged sets are
+        skipped: their pages already persist through the arena's own
+        spill files (``.pdbset`` flush does not apply to them)."""
         for ident in self.store.list_sets():
+            if self.store.storage_of(ident) == "paged":
+                continue
             info = self.catalog.get_set(ident.db, ident.set)
             if info and info.get("persistence") == "persistent":
                 self.store.flush(ident)

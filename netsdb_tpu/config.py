@@ -37,6 +37,10 @@ class Configuration:
     # --- host page store (native runtime) ---
     page_size_bytes: int = 64 * 1024 * 1024
     shared_mem_bytes: int = 4 * 1024 * 1024 * 1024
+    # arena cap for PAGED sets (create_set(storage="paged")); None =
+    # shared_mem_bytes. Separate knob because tests cap the page pool
+    # tightly (forcing spills) while host sets stay uncapped.
+    page_pool_bytes: Optional[int] = None
     # --- directories (reference: Configuration rootDir/catalog dirs) ---
     root_dir: str = dataclasses.field(
         default_factory=lambda: os.environ.get("NETSDB_TPU_HOME", "/tmp/netsdb_tpu")

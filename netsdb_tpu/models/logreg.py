@@ -40,10 +40,14 @@ class LogRegModel:
         self.block = block
         self.compute_dtype = compute_dtype
 
-    def setup(self, client: Client) -> None:
+    def setup(self, client: Client, placements=None) -> None:
+        """``placements``: set name → Placement; ``inputs`` column-
+        (batch-)sharded on ``data`` distributes the whole inference DAG
+        (weights are a single row — replicate them)."""
         client.create_database(self.db)
         for s in self.SETS:
-            client.create_set(self.db, s)
+            client.create_set(self.db, s,
+                              placement=(placements or {}).get(s))
 
     def load_weights(self, client: Client, w: np.ndarray, b: float) -> None:
         client.send_matrix(self.db, "w", np.asarray(w).reshape(1, -1),

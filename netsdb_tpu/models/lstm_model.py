@@ -35,10 +35,17 @@ class LSTMModel:
         return ([f"w_{g}" for g in _GATES] + [f"u_{g}" for g in _GATES]
                 + [f"b_{g}" for g in _GATES])
 
-    def setup(self, client: Client) -> None:
+    def setup(self, client: Client, placements=None) -> None:
+        """``placements``: set name → Placement (createSet-time
+        PartitionPolicy). Typical mesh layout: gate weights ``w_*``
+        row-sharded on ``model``, state ``h``/``c`` batch-sharded on
+        ``data``, biases replicated — the stored shardings make
+        ``step``/``run_sequence`` distribute through XLA with no code
+        change."""
         client.create_database(self.db)
         for s in self.weight_sets + ["x", "h", "c", "h_out", "c_out"]:
-            client.create_set(self.db, s)
+            client.create_set(self.db, s,
+                              placement=(placements or {}).get(s))
 
     def load_weights(self, client: Client, weights: dict) -> None:
         """``weights``: {'w_i': (hidden x input), ..., 'b_i': (hidden,)}."""

@@ -30,10 +30,17 @@ class Word2VecModel:
         self.block = block
         self.compute_dtype = compute_dtype
 
-    def setup(self, client: Client) -> None:
+    def setup(self, client: Client, placements=None) -> None:
+        """``placements`` maps set name → Placement (the createSet-time
+        PartitionPolicy): with ``weights`` row- or column-sharded and
+        ``inputs`` batch-sharded, the SAME inference DAG and gather
+        paths run distributed — the executor's jit sees the stored
+        shardings and XLA inserts the collectives
+        (``QuerySchedulerServer.cc:216-330``)."""
         client.create_database(self.db)
         for s in self.SETS:
-            client.create_set(self.db, s)
+            client.create_set(self.db, s,
+                              placement=(placements or {}).get(s))
 
     def load_embeddings(self, client: Client, table: np.ndarray) -> None:
         """``table``: (vocab x dim)."""

@@ -99,11 +99,20 @@ class Placement:
         n = n_devices if n_devices is not None else len(jax.devices())
         fixed = int(np.prod([s for _, s in self.axes if s > 0] or [1]))
         free = sum(1 for _, s in self.axes if s == 0)
+        if free > 1:
+            # "all remaining devices" on two axes is ambiguous — there is
+            # no canonical factorization of the remainder. The reference
+            # dispatcher has the same rule: a set either names its
+            # partition counts or takes the single DEFAULT policy
+            # (PartitionPolicy.h:29); it never guesses a 2-d split.
+            raise ValueError(
+                f"placement axes {self.axes}: at most one axis may have "
+                f"size 0 (= all remaining devices); {free} do")
         remaining = n // fixed if fixed <= n else 0
         out = []
         for name, size in self.axes:
             if size == 0:
-                size = max(1, remaining if free == 1 else 1)
+                size = max(1, remaining)
             out.append((name, size))
         if int(np.prod([s for _, s in out])) > n:
             return tuple((name, 1) for name, _ in self.axes)

@@ -67,12 +67,23 @@ class Apply(Computation):
 
     op_kind = "Apply"
 
-    def __init__(self, input_: Computation, fn: Callable[[Any], Any],
-                 label: str = "", traceable: bool = True):
+    def __init__(self, input_: Computation, fn: Optional[Callable[[Any], Any]] = None,
+                 label: str = "", traceable: bool = True, fold=None):
         """``traceable=False`` marks a host-side projection (numpy / Python
         object work) that must run eagerly outside jit — the reference
-        analogue is a C++ lambda that touches non-tensor state."""
+        analogue is a C++ lambda that touches non-tensor state.
+
+        ``fold`` (:class:`netsdb_tpu.plan.fold.FoldSpec`) gives the node
+        a streamable decomposition; when the scanned set is paged, the
+        executor folds the node over the page stream instead of calling
+        ``fn``. With ``fn=None`` the whole-table path is derived from
+        the fold, so the two cannot diverge."""
         super().__init__([input_])
+        self.fold = fold
+        if fn is None:
+            if fold is None:
+                raise ValueError("Apply needs fn or fold")
+            fn = fold.whole
         self.fn = fn
         self.traceable = traceable
         self.label = label or getattr(fn, "__name__", "fn")
@@ -143,8 +154,37 @@ class Join(Computation):
                  left_key: Optional[Callable] = None,
                  right_key: Optional[Callable] = None,
                  project: Optional[Callable[[Any, Any], Any]] = None,
-                 label: str = ""):
+                 label: str = "", fold=None, fold_src: int = 0,
+                 on: Optional[tuple] = None,
+                 take: Optional[Sequence[str]] = None):
+        """``fold`` + ``fold_src``: streamable decomposition (see
+        :class:`netsdb_tpu.plan.fold.FoldSpec`); ``fold_src`` says which
+        input (0=left, 1=right) is the probe/fact side the page stream
+        replaces — the other input's value is passed to the fold as
+        resident state (gather-chain tuples flattened).
+
+        ``on=(left_col, right_col)`` declares the equi-join key by
+        COLUMN NAME (the reference's attribute-naming join lambdas,
+        ``JoinComp::getKeySelection``) and lowers evaluation to the
+        device LUT/sort join (``relational.autojoin.equijoin``):
+        object-record inputs columnarize automatically, string keys
+        ride dictionary unification, and the probe is one device
+        gather — the automatic form of what round 3 exposed only as
+        hand calls. ``take`` limits which right columns are gathered.
+        Callable ``left_key``/``right_key`` stay the interpreter
+        fallback for keys no column expresses."""
         super().__init__([left, right])
+        self.fold = fold
+        self.fold_src = fold_src
+        self.on = tuple(on) if on else None
+        self.take = take
+        if fn is None and fold is not None and left_key is None:
+            from netsdb_tpu.plan.fold import flatten_resident
+
+            if fold_src == 0:
+                fn = lambda a, b: fold.whole(a, *flatten_resident((b,)))
+            else:
+                fn = lambda a, b: fold.whole(b, *flatten_resident((a,)))
         self.fn = fn
         self.left_key = left_key
         self.right_key = right_key
@@ -154,6 +194,18 @@ class Join(Computation):
     def evaluate(self, left, right):
         if self.fn is not None:
             return self.fn(left, right)
+        if self.on is not None:
+            # device path: columnarize records if needed, then one
+            # LUT/sort equi-join gather (string keys unify host-side)
+            from netsdb_tpu.relational.autojoin import (equijoin,
+                                                        table_from_objects)
+            from netsdb_tpu.relational.table import ColumnTable
+
+            lt = (left if isinstance(left, ColumnTable)
+                  else table_from_objects(left))
+            rt = (right if isinstance(right, ColumnTable)
+                  else table_from_objects(right))
+            return equijoin(lt, self.on[0], rt, self.on[1], take=self.take)
         # host-side hash equi-join (reference broadcast join: build small
         # side hash table, probe the large side)
         table = {}
@@ -220,18 +272,46 @@ class Partition(Computation):
 
     op_kind = "Partition"
 
-    def __init__(self, input_: Computation, key_fn: Callable[[Any], Any],
-                 num_partitions: int, label: str = ""):
+    def __init__(self, input_: Computation, key_fn,
+                 num_partitions: int, label: str = "",
+                 slack: float = 2.0):
+        """``key_fn`` may be a callable (host-object routing) or a
+        COLUMN NAME string: over a placed ColumnTable input, the node
+        then lowers to the device all_to_all row shuffle
+        (``relational.shuffle.hash_repartition``) on the mesh the
+        set's placement put the columns on — the reference's
+        partition stage shipping rows to their owning workers
+        (``PipelineStage.cc:1652-1728``), output a ShardedRows a
+        downstream ``local_join``/aggregate stage consumes."""
         super().__init__([input_])
         if num_partitions < 1:
             raise ValueError(f"num_partitions must be >= 1, got "
                              f"{num_partitions}")
         self.key_fn = key_fn
         self.num_partitions = num_partitions
-        self.traceable = False  # host-object routing, never under jit
-        self.label = label or getattr(key_fn, "__name__", "partition")
+        self.slack = slack
+        self.traceable = False  # host routing / shard_map progs run eager
+        self.label = label or (key_fn if isinstance(key_fn, str)
+                               else getattr(key_fn, "__name__", "partition"))
 
     def evaluate(self, items):
+        if isinstance(self.key_fn, str):
+            from netsdb_tpu.relational.shuffle import hash_repartition
+            from netsdb_tpu.relational.table import ColumnTable
+
+            if not isinstance(items, ColumnTable):
+                raise TypeError(
+                    f"Partition on column {self.key_fn!r} needs a "
+                    f"ColumnTable input; got {type(items).__name__}")
+            mesh, axis = _mesh_of_table(items)
+            if mesh.shape[axis] != self.num_partitions:
+                raise ValueError(
+                    f"Partition declared {self.num_partitions} "
+                    f"partitions but the set's placement meshes "
+                    f"{mesh.shape[axis]} shards on {axis!r}")
+            return hash_repartition(mesh, axis, dict(items.cols),
+                                    self.key_fn, self.slack,
+                                    valid=items.valid)
         from netsdb_tpu.storage.dispatcher import HashPolicy
 
         # same routing as the dispatcher by construction (the
@@ -243,6 +323,23 @@ class Partition(Computation):
     def plan_atom(self) -> str:
         return (f"{self.output_name} <= PARTITION("
                 f"{self.inputs[0].output_name}, '{self.label}')")
+
+
+def _mesh_of_table(table):
+    """(mesh, axis) a placed ColumnTable's columns live on — read off
+    the arrays' NamedSharding, so DAG nodes never take a hand mesh."""
+    import jax
+
+    for col in table.cols.values():
+        sh = getattr(col, "sharding", None)
+        if sh is not None and hasattr(sh, "mesh") and sh.spec:
+            for entry in sh.spec:
+                if entry is not None:
+                    ax = entry if isinstance(entry, str) else entry[0]
+                    return sh.mesh, ax
+    raise ValueError(
+        "device Partition needs a placed (mesh-sharded) input set — "
+        "create the set with a row-sharding Placement")
 
 
 class WriteSet(Computation):

@@ -72,6 +72,117 @@ def _evaluate(plan: LogicalPlan, scan_values: Dict[int, Any]) -> Dict[int, Any]:
     return values
 
 
+def _run_fold_once(fold, pc, resident, placement, step_jit):
+    """One (possibly multi-pass) fold of a node over a page stream —
+    the PageScanner loop: every pass re-streams the source, each chunk
+    runs through ONE compiled step (static shapes; the chunk validity
+    mask carries the ragged tail), and ``placement`` mesh-shards every
+    chunk before the step so the fold executes distributed per chunk
+    (ref ``PipelineStage.cc:228-265`` — workers stream local
+    partitions through the same pipeline)."""
+    state = None
+    for pidx, (init, step) in enumerate(fold.passes):
+        jstep = step_jit(pidx, step)
+        state = init(state, pc, *resident)
+        for chunk in pc.stream_tables(placement=placement):
+            state = jstep(state, chunk, *resident)
+    return fold.finalize(state, pc, *resident)
+
+
+def _run_fold(node, fold, pc, resident, placement, step_jit):
+    """Dispatch a fold, handling a paged BUILD side: when one resident
+    input is itself paged and the fold declares ``merge``, the join
+    runs grace-hash style — outer loop over the build's key-range
+    blocks (each resident only while probed; ref partitioned hash sets,
+    ``src/queryExecution/headers/HashSetManager.h``), inner stream over
+    the probe, per-partition outputs merged."""
+    from netsdb_tpu.relational.outofcore import PagedColumns
+
+    builds = [i for i, v in enumerate(resident)
+              if isinstance(v, PagedColumns)]
+    if len(builds) == 1 and fold.merge is not None:
+        bi = builds[0]
+        rest = [v.to_table() if isinstance(v, PagedColumns) and i != bi
+                else v for i, v in enumerate(resident)]
+        out = None
+        for btab in resident[bi].stream_tables(prefetch=0):
+            part_res = list(rest)
+            part_res[bi] = btab
+            part = _run_fold_once(fold, pc, tuple(part_res), placement,
+                                  step_jit)
+            out = part if out is None else fold.merge(out, part)
+        return out
+    if builds:  # no merge rule: the build side must be resident
+        resident = tuple(v.to_table() if isinstance(v, PagedColumns)
+                         else v for v in resident)
+    return _run_fold_once(fold, pc, resident, placement, step_jit)
+
+
+def _execute_streamed(client, plan: LogicalPlan, scan_values: Dict[int, Any],
+                      job_name: str) -> Dict[int, Any]:
+    """Topo-evaluate a plan with paged scans: fold-bearing consumers of
+    a paged set stream it page-by-page (``_run_fold``); everything else
+    evaluates eagerly on resident values. Fold-less consumers of a
+    paged set materialize it (correct, not streamed — the documented
+    fallback, like the reference pinning a set that fits RAM)."""
+    from netsdb_tpu.plan.fold import flatten_resident
+    from netsdb_tpu.relational.outofcore import PagedColumns
+
+    placements = {
+        n.node_id: client.store.placement_of(
+            SetIdentifier(n.db, n.set_name))
+        for n in plan.topo if isinstance(n, ScanSet)
+        and isinstance(scan_values.get(n.node_id), PagedColumns)
+    }
+    plan_key = plan.cache_key()
+
+    def step_jit_for(node):
+        def step_jit(pidx, step):
+            key = f"fold::{job_name}::{plan_key}::{node.label}::{pidx}"
+            with _cache_lock:
+                fn = _compiled_cache.get(key)
+                if fn is not None:
+                    _compiled_cache.move_to_end(key)
+                    return fn
+            fn = jax.jit(step)
+            with _cache_lock:
+                fn = _compiled_cache.setdefault(key, fn)
+                while len(_compiled_cache) > _COMPILED_CACHE_CAP:
+                    _compiled_cache.popitem(last=False)
+            return fn
+        return step_jit
+
+    values: Dict[int, Any] = dict(scan_values)
+    materialized: Dict[int, Any] = {}  # per-scan to_table memo: N
+    # fold-less consumers of one paged set must not stream it N times
+
+    def table_of(nid: int, pc: PagedColumns):
+        if nid not in materialized:
+            materialized[nid] = pc.to_table()
+        return materialized[nid]
+
+    for node in plan.topo:
+        if node.node_id in values:
+            continue
+        in_vals = [values[i.node_id] for i in node.inputs]
+        fold = getattr(node, "fold", None)
+        src = getattr(node, "fold_src", 0)
+        if (fold is not None and len(in_vals) > src
+                and isinstance(in_vals[src], PagedColumns)):
+            resident = flatten_resident(
+                tuple(v for i, v in enumerate(in_vals) if i != src))
+            placement = placements.get(node.inputs[src].node_id)
+            values[node.node_id] = _run_fold(
+                node, fold, in_vals[src], resident, placement,
+                step_jit_for(node))
+            continue
+        in_vals = [table_of(node.inputs[i].node_id, v)
+                   if isinstance(v, PagedColumns) else v
+                   for i, v in enumerate(in_vals)]
+        values[node.node_id] = node.evaluate(*in_vals)
+    return values
+
+
 def execute_computations(
     client,
     sinks: List[WriteSet],
@@ -83,6 +194,7 @@ def execute_computations(
     plan = plan_from_sinks(sinks)
     t0 = time.perf_counter()
 
+    from netsdb_tpu.relational.outofcore import PagedColumns
     from netsdb_tpu.relational.table import ColumnTable
 
     scan_values: Dict[int, Any] = {}
@@ -105,14 +217,24 @@ def execute_computations(
                                                jax.Array)):
                 scan_values[node.node_id] = items[0]
                 tensor_scans.append(node)
+            elif len(items) == 1 and isinstance(items[0], PagedColumns):
+                # paged set: the value IS the page stream handle; the
+                # streamed evaluator folds consumers over it
+                scan_values[node.node_id] = items[0]
             else:
                 scan_values[node.node_id] = items
 
+    any_paged = any(isinstance(v, PagedColumns)
+                    for v in scan_values.values())
     all_traceable = all(_is_traceable(n) for n in plan.topo)
 
     num_scans = sum(isinstance(n, ScanSet) for n in plan.topo)
 
-    if all_traceable and tensor_scans:
+    if any_paged:
+        values = _execute_streamed(client, plan, scan_values, job_name)
+        sink_vals = {s.node_id: values[s.inputs[0].node_id]
+                     for s in plan.sinks}
+    elif all_traceable and tensor_scans:
         # Cache only pure-tensor jobs: host-object scan values are traced
         # as constants, so a cached callable would pin stale data.
         cacheable = len(tensor_scans) == num_scans

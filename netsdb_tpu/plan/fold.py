@@ -1,0 +1,89 @@
+"""Decomposed (streamable) form of a Computation — the PageScanner
+contract, TPU-shaped.
+
+In the reference, *every* pipeline stage can consume its source set
+page-by-page: the backend pins one page at a time and feeds it through
+``PageCircularBuffer`` to the pipeline threads, with a combiner merging
+per-page partial aggregation state
+(``src/storage/headers/PageScanner.h:25-34``,
+``src/serverFunctionalities/source/HermesExecutionServer.cc:49-93``).
+That works because the stage's logic is expressed as
+(init, per-page step, finalize) rather than as a whole-set function.
+
+A :class:`FoldSpec` is that decomposition for a traced Computation
+node.  A node carrying one can run three ways with the SAME math:
+
+- **whole-table** (resident sets): ``finalize(step(init(), table))`` —
+  composed into the plan jit exactly like a plain ``fn``;
+- **streamed** (paged sets): the executor folds ``step`` over the page
+  stream — one compiled XLA program per pass, reused across chunks
+  (static shapes; ragged tails ride the chunk validity mask);
+- **streamed-sharded** (paged AND placed sets): each chunk is placed
+  with the set's mesh sharding before the step, so XLA inserts the
+  cross-device collectives per chunk — every "worker" streams its
+  shard of every page, the reference's workers-stream-local-partitions
+  model (``src/queryExecution/source/PipelineStage.cc:228-265``).
+
+Multi-pass folds (``passes`` with more than one (init, step) pair)
+re-stream the source once per pass, threading the previous pass's
+state into the next ``init`` — the reference's aggregate-then-probe
+stage sequences (e.g. Q17's per-key average before the small-quantity
+probe) map onto this.
+
+Signatures (``src`` is any object with ``.dicts`` — the chunk schema;
+``resident`` are the node's other, non-paged input values, tuples
+flattened):
+
+- ``init(prev_state, src, *resident) -> state``  (prev_state None for
+  the first pass)
+- ``step(state, chunk, *resident) -> state``  (chunk: ColumnTable with
+  validity mask and a ``_rowid`` global-row-index column)
+- ``finalize(state, src, *resident) -> output``
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Callable, Optional, Tuple
+
+
+@dataclasses.dataclass(frozen=True)
+class FoldSpec:
+    """(init, step)* + finalize decomposition of a Computation node."""
+
+    passes: Tuple[Tuple[Callable, Callable], ...]
+    finalize: Callable
+    # merge(out_a, out_b) -> out: combines the outputs of independent
+    # key-range partitions when the BUILD side of a join is itself
+    # paged (grace-hash: outer loop over build blocks, inner stream
+    # over the probe — ref ``src/queryExecution/headers/
+    # HashSetManager.h`` partitioned hash sets). None = the node does
+    # not support a partitioned build.
+    merge: Optional[Callable] = None
+
+    def whole(self, table: Any, *resident: Any) -> Any:
+        """Whole-table evaluation — the resident-set path. Runs the
+        same init/step/finalize chain over the full table as one
+        'chunk', so the streamed path cannot diverge semantically."""
+        state = None
+        for init, step in self.passes:
+            state = step(init(state, table, *resident), table, *resident)
+        return self.finalize(state, table, *resident)
+
+
+def single_pass(init: Callable, step: Callable,
+                finalize: Callable, merge: Optional[Callable] = None
+                ) -> FoldSpec:
+    return FoldSpec(((init, step),), finalize, merge)
+
+
+def flatten_resident(values: Tuple[Any, ...]) -> Tuple[Any, ...]:
+    """Gather-chain tuples (relational/dag.py's tuple-passing binary
+    Joins) flatten so fold callables see tables positionally."""
+    out = []
+    for v in values:
+        if isinstance(v, tuple):
+            out.extend(v)
+        else:
+            out.append(v)
+    return tuple(out)

@@ -51,6 +51,22 @@ def table_from_objects(objs: Sequence[Any],
                                  date_cols)
 
 
+def merge_dicts(base: Sequence[str], other: Sequence[str]
+                ) -> Tuple[List[str], np.ndarray]:
+    """Merge two column dictionaries: ``other``'s entries extend
+    ``base``'s, and the returned remap LUT (len(other) int32) carries
+    each ``other`` code into the merged space. The ONE place append
+    (``concat_tables``) and join (``unify_key_codes``) agree on merge
+    semantics."""
+    merged = {s: i for i, s in enumerate(base)}
+    remap = np.empty(len(other), np.int32)
+    for code, s in enumerate(other):
+        if s not in merged:
+            merged[s] = len(merged)
+        remap[code] = merged[s]
+    return list(merged), remap
+
+
 def unify_key_codes(left: ColumnTable, left_key: str,
                     right: ColumnTable, right_key: str
                     ) -> Tuple[jnp.ndarray, jnp.ndarray, int]:
@@ -72,14 +88,37 @@ def unify_key_codes(left: ColumnTable, left_key: str,
         space = int(max(int(jnp.max(lc)) if lc.shape[0] else 0,
                         int(jnp.max(rc)) if rc.shape[0] else 0)) + 1
         return lc, rc, space
-    merged = {s: i for i, s in enumerate(l_dict)}
-    remap = np.empty(len(r_dict), np.int32)
-    for code, s in enumerate(r_dict):
-        if s not in merged:
-            merged[s] = len(merged)
-        remap[code] = merged[s]
+    merged, remap = merge_dicts(l_dict, r_dict)
     rc = jnp.take(jnp.asarray(remap), rc)
     return lc, rc, len(merged)
+
+
+def concat_tables(a: ColumnTable, b: ColumnTable) -> ColumnTable:
+    """Row-append two same-schema tables on device: ``b``'s dictionary
+    codes remap into ``a``'s merged dictionaries (the same O(|dict|)
+    host unification as :func:`unify_key_codes`), columns concatenate,
+    validity masks concatenate. The append path for ``objects`` sets —
+    O(batch + copy), no row re-encoding."""
+    if set(a.cols) != set(b.cols):
+        raise ValueError(f"schema mismatch: {sorted(a.cols)} vs "
+                         f"{sorted(b.cols)}")
+    cols: Dict[str, jnp.ndarray] = {}
+    dicts: Dict[str, List[str]] = {}
+    for name in a.cols:
+        ca, cb = a[name], b[name]
+        da, db = a.dicts.get(name), b.dicts.get(name)
+        if (da is None) != (db is None):
+            raise ValueError(f"column {name!r}: dictionary-encoded on "
+                             f"one side only")
+        if da is not None:
+            merged, remap = merge_dicts(da, db)
+            cb = jnp.take(jnp.asarray(remap), cb)
+            dicts[name] = merged
+        cols[name] = jnp.concatenate([ca, cb])
+    valid = None
+    if a.valid is not None or b.valid is not None:
+        valid = jnp.concatenate([a.mask(), b.mask()])
+    return ColumnTable(cols, dicts, valid)
 
 
 def equijoin(left: ColumnTable, left_key: str,

@@ -45,17 +45,24 @@ def q01_sink(db: str, lineitem_set: str = "lineitem",
     code columns carry the group keys (with the input's dictionaries,
     so ``to_rows`` decodes them), aggregates ride as float columns,
     and ``valid`` masks out empty groups.
-    """
-    delta = date_to_int(delta_date)
 
-    def q01(t: ColumnTable) -> ColumnTable:
-        n_ls = len(t.dicts["l_linestatus"])
-        n_groups = len(t.dicts["l_returnflag"]) * n_ls
-        mask = (t["l_shipdate"] <= delta) & t.mask()
-        sums, counts = _q01_fold(
-            n_groups, n_ls, t["l_returnflag"], t["l_linestatus"],
-            t["l_quantity"], t["l_extendedprice"], t["l_discount"],
-            t["l_tax"], mask)
+    The node carries a :class:`~netsdb_tpu.plan.fold.FoldSpec` and
+    derives its whole-table path from it, so the same sink runs
+    resident (one jitted body), streamed over a paged lineitem (the
+    executor folds the step over the page stream), or streamed-sharded
+    when the set is paged AND placed — out-of-core is a property of
+    the set, not of the query (ref ``PageScanner.h:25-34``).
+    """
+    from netsdb_tpu.plan.fold import FoldSpec
+    from netsdb_tpu.relational.folds import fold_q01
+
+    delta = date_to_int(delta_date)
+    base = fold_q01({}, {}, {}, delta_date=delta_date)
+
+    def fin(state, src) -> ColumnTable:
+        sums, counts = state
+        n_ls = len(src.dicts["l_linestatus"])
+        n_groups = len(src.dicts["l_returnflag"]) * n_ls
         gid = jnp.arange(n_groups, dtype=jnp.int32)
         cnt_f = jnp.maximum(counts, 1).astype(jnp.float32)
         return ColumnTable(
@@ -69,11 +76,12 @@ def q01_sink(db: str, lineitem_set: str = "lineitem",
                 "avg_price": sums[1] / cnt_f,
                 "avg_disc": sums[4] / cnt_f,
             },
-            dicts={"l_returnflag": t.dicts["l_returnflag"],
-                   "l_linestatus": t.dicts["l_linestatus"]},
+            dicts={"l_returnflag": src.dicts["l_returnflag"],
+                   "l_linestatus": src.dicts["l_linestatus"]},
             valid=counts > 0)
 
-    return WriteSet(Apply(ScanSet(db, lineitem_set), q01,
+    return WriteSet(Apply(ScanSet(db, lineitem_set),
+                          fold=FoldSpec(base.passes, fin),
                           label=f"cq01:{delta}"),
                     db, output_set)
 
@@ -84,18 +92,17 @@ def q06_sink(db: str, lineitem_set: str = "lineitem",
              output_set: str = "q06_out") -> WriteSet:
     """Revenue-forecast DAG: one fused filtered reduction; the result
     is a 1-row relation {revenue}."""
+    from netsdb_tpu.plan.fold import FoldSpec
+    from netsdb_tpu.relational.folds import fold_q06
+
     a, b = date_to_int(d0), date_to_int(d1)
+    base = fold_q06({}, {}, {}, d0=d0, d1=d1, disc=disc, qty=qty)
 
-    def q06(t: ColumnTable) -> ColumnTable:
-        mask = ((t["l_shipdate"] >= a) & (t["l_shipdate"] < b)
-                & (t["l_discount"] >= disc - 0.011)
-                & (t["l_discount"] <= disc + 0.011)
-                & (t["l_quantity"] < qty) & t.mask())
-        rev = jnp.sum(jnp.where(mask, t["l_extendedprice"] * t["l_discount"],
-                                0.0))
-        return ColumnTable(cols={"revenue": rev[None]})
+    def fin(state, src) -> ColumnTable:
+        return ColumnTable(cols={"revenue": state[None]})
 
-    return WriteSet(Apply(ScanSet(db, lineitem_set), q06,
+    return WriteSet(Apply(ScanSet(db, lineitem_set),
+                          fold=FoldSpec(base.passes, fin),
                           label=f"cq06:{a}:{b}:{disc}:{qty}"),
                     db, output_set)
 
@@ -116,7 +123,15 @@ def q03_sink(db: str, n_orders: int, n_customers: int, segment_code: int,
     set placement. Statics (key spaces, segment code) come from the
     caller; use :func:`q03_sink_for` to derive them from stored tables.
     Result: a k-row relation {okey, odate, revenue} masked to real
-    hits, ordered by (-revenue, odate)."""
+    hits, ordered by (-revenue, odate).
+
+    The probe side is a :class:`~netsdb_tpu.plan.fold.FoldSpec` whose
+    revenue accumulator lives in the BUILD side's *row* space (not the
+    key space), with a ``merge`` rule re-top-k'ing partition outputs —
+    so when the build side arrives as a paged set the executor runs the
+    grace-hash discipline (outer loop over build blocks, inner stream
+    over lineitem, state bounded by the block size; ref partitioned
+    hash sets, ``src/queryExecution/headers/HashSetManager.h``)."""
     from netsdb_tpu.plan.computations import Join
     from netsdb_tpu.relational.planner import JoinPlan
 
@@ -132,48 +147,140 @@ def q03_sink(db: str, n_orders: int, n_customers: int, segment_code: int,
                                cust_ok, plan=jp_cust)
         return orders.filter(chit & (orders["o_orderdate"] < d))
 
-    def join_lineitem(li: ColumnTable, orders: ColumnTable) -> ColumnTable:
-        import jax.numpy as jnp
-
-        from netsdb_tpu.relational import kernels as K
-
-        l_okey = li["l_orderkey"]
-        oidx, ohit = K.pk_fk_join(orders["o_orderkey"], l_okey,
-                                  orders.mask(), plan=jp_orders)
-        li_ok = ohit & (li["l_shipdate"] > d) & li.mask()
-        rev = K.segment_sum(li["l_extendedprice"] * (1.0 - li["l_discount"]),
-                            l_okey, n_orders, li_ok)
-        odate = K.segment_min(jnp.take(orders["o_orderdate"], oidx),
-                              l_okey, n_orders, li_ok)
-        top_idx, top_ok = K.top_k_masked(rev, k, rev > 0)
-        return ColumnTable(
-            cols={"okey": top_idx,
-                  "odate": jnp.take(odate, top_idx),
-                  "revenue": jnp.take(rev, top_idx)},
-            valid=top_ok)
-
     filtered = Join(ScanSet(db, orders_set), ScanSet(db, customer_set),
                     fn=filter_orders,
                     label=f"q03filter:{segment_code}:{d}:{n_customers}")
-    joined = Join(ScanSet(db, lineitem_set), filtered, fn=join_lineitem,
+    joined = Join(ScanSet(db, lineitem_set), filtered,
+                  fold=q03_probe_fold(d, k, jp_orders),
                   label=f"q03join:{d}:{k}:{n_orders}")
     return WriteSet(joined, db, output_set)
+
+
+def q03_build_sink(db: str, n_customers: int, segment_code: int,
+                   date: str = "1995-03-15",
+                   orders_set: str = "orders",
+                   customer_set: str = "customer",
+                   output_set: str = "q03_build") -> WriteSet:
+    """Stage 1 of the out-of-core Q03: materialize the filtered build
+    side (customer-qualified, date-qualified orders) into its own
+    output set. Created with ``storage="paged"``, that set becomes a
+    block-partitioned spillable hash side; stage 2
+    (:func:`q03_sink` with ``prebuilt_set=``) then probes it
+    grace-hash style — the reference's build-stage/probe-stage split
+    (``HermesExecutionServer.cc:901``, partitioned hash sets)."""
+    from netsdb_tpu.plan.computations import Join
+    from netsdb_tpu.relational.planner import JoinPlan
+
+    d = date_to_int(date)
+    jp_cust = JoinPlan("lut", n_customers)
+
+    def filter_orders(orders: ColumnTable, cust: ColumnTable) -> ColumnTable:
+        from netsdb_tpu.relational import kernels as K
+
+        cust_ok = (cust["c_mktsegment"] == segment_code) & cust.mask()
+        _, chit = K.pk_fk_join(cust["c_custkey"], orders["o_custkey"],
+                               cust_ok, plan=jp_cust)
+        return orders.filter(chit & (orders["o_orderdate"] < d))
+
+    node = Join(ScanSet(db, orders_set), ScanSet(db, customer_set),
+                fn=filter_orders,
+                label=f"q03filter:{segment_code}:{d}:{n_customers}")
+    return WriteSet(node, db, output_set)
+
+
+def q03_probe_sink(db: str, n_orders: int, date: str = "1995-03-15",
+                   k: int = 10, lineitem_set: str = "lineitem",
+                   build_set: str = "q03_build",
+                   output_set: str = "q03_out") -> WriteSet:
+    """Stage 2 of the out-of-core Q03: probe a PRE-BUILT (possibly
+    paged) build set with the lineitem stream. With both sets paged the
+    executor runs the full grace-hash discipline — outer loop over the
+    build's blocks, inner fold over the probe stream, partition top-ks
+    merged (``plan/executor.py::_run_fold``)."""
+    from netsdb_tpu.plan.computations import Join
+    from netsdb_tpu.relational.planner import JoinPlan
+
+    d = date_to_int(date)
+    joined = Join(ScanSet(db, lineitem_set), ScanSet(db, build_set),
+                  fold=q03_probe_fold(d, k, JoinPlan("lut", n_orders)),
+                  label=f"q03probe:{d}:{k}:{n_orders}")
+    return WriteSet(joined, db, output_set)
+
+
+def q03_probe_fold(d: int, k: int, jp_orders):
+    """Lineitem-stream fold against a (possibly block-partitioned)
+    orders build side; see :func:`q03_sink`.
+
+    The join plan is re-derived per build block from the block's OWN
+    row count (trace-time static): a small dense block keeps the LUT
+    gather, a block dwarfed by the key space takes the sort join — so
+    per-chunk device state stays bounded by the partition, never by
+    the key space (the grace-hash discipline; ``jp_orders`` supplies
+    only the key-space bound)."""
+    from netsdb_tpu.plan.fold import single_pass
+    from netsdb_tpu.relational import kernels as K
+    from netsdb_tpu.relational.planner import plan_join_from_stats
+    from netsdb_tpu.relational.stats import ColumnStats
+
+    def _block_plan(orders: ColumnTable, n_probe: int):
+        ks = jp_orders.key_space
+        return plan_join_from_stats(
+            ColumnStats(orders.num_rows, 0, ks - 1, -1), n_probe)
+
+    def init(prev, src, orders):
+        return jnp.zeros((orders.num_rows,), jnp.float32)
+
+    def step(rev_acc, li: ColumnTable, orders: ColumnTable):
+        li, orders = _fold_mask(li), _fold_mask(orders)
+        oidx, ohit = K.pk_fk_join(orders["o_orderkey"], li["l_orderkey"],
+                                  orders["o_orderkey"] >= 0,
+                                  plan=_block_plan(orders, li.num_rows))
+        li_ok = ohit & (li["l_shipdate"] > d)
+        return rev_acc + K.segment_sum(
+            li["l_extendedprice"] * (1.0 - li["l_discount"]), oidx,
+            orders.num_rows, li_ok)
+
+    def fin(rev_acc, src, orders: ColumnTable) -> ColumnTable:
+        orders = _fold_mask(orders)
+        top_idx, top_ok = K.top_k_masked(rev_acc,
+                                         min(k, rev_acc.shape[0]),
+                                         rev_acc > 0)
+        return ColumnTable(
+            cols={"okey": jnp.take(orders["o_orderkey"], top_idx),
+                  "odate": jnp.take(orders["o_orderdate"], top_idx),
+                  "revenue": jnp.take(rev_acc, top_idx)},
+            valid=top_ok)
+
+    def merge(a: ColumnTable, b: ColumnTable) -> ColumnTable:
+        rev = jnp.concatenate([a["revenue"], b["revenue"]])
+        valid = jnp.concatenate([a.mask(), b.mask()])
+        idx, ok = K.top_k_masked(rev, min(k, rev.shape[0]),
+                                 valid & (rev > 0))
+        cat = lambda c: jnp.take(jnp.concatenate([a[c], b[c]]), idx)
+        return ColumnTable(cols={"okey": cat("okey"), "odate": cat("odate"),
+                                 "revenue": jnp.take(rev, idx)},
+                           valid=ok)
+
+    return single_pass(init, step, fin, merge)
 
 
 def q03_sink_for(client, db: str, segment: str = "BUILDING",
                  date: str = "1995-03-15", k: int = 10) -> WriteSet:
     """Derive q03's static parameters (key spaces, segment code) from
-    the stored tables — the planner's statistics role — then build the
-    sink."""
-    import jax.numpy as jnp
-
-    orders = client.get_table(db, "orders")
-    cust = client.get_table(db, "customer")
+    stored-set statistics (``analyze_set`` summaries, never the tables
+    themselves — the planner's StorageCollectStats role), then build
+    the sink."""
+    orders = client.analyze_set(db, "orders")
+    cust = client.analyze_set(db, "customer")
+    seg_dict = cust["dicts"]["c_mktsegment"]
     return q03_sink(
         db,
-        n_orders=int(jnp.max(orders["o_orderkey"])) + 1,
-        n_customers=int(jnp.max(cust["c_custkey"])) + 1,
-        segment_code=cust.code("c_mktsegment", segment),
+        n_orders=orders["stats"]["o_orderkey"].key_space,
+        n_customers=cust["stats"]["c_custkey"].key_space,
+        # -1 for an unknown segment → matches nothing → empty result
+        # (ColumnTable.code semantics), never a build-time crash
+        segment_code=(seg_dict.index(segment) if segment in seg_dict
+                      else -1),
         date=date, k=k)
 
 
@@ -253,20 +360,30 @@ def suite_sink_for(client, db: str, qname: str,
     columns; XLA inserts the collectives. Output: the core's raw
     arrays, bit-comparable to the single-device core.
 
-    Building from a RemoteClient works but pulls each scanned table
-    once to compute its stats — build sinks with an in-process client
-    (or cache them) when the tables are large."""
+    Statistics come from ``client.analyze_set`` — collected where the
+    data lives (ingest-time for paged sets, daemon-side for a
+    RemoteClient) and shipped as summaries, never as tables (ref
+    ``StorageCollectStats``, ``PangeaStorageServer.h:48``).
+
+    When the query's fact set was created with ``storage="paged"``,
+    the sink carries the query's streamable fold
+    (:mod:`netsdb_tpu.relational.folds`) and the executor runs it
+    page-by-page under the arena's pool cap — same DAG, out-of-core
+    decided by the set."""
     from netsdb_tpu.plan.computations import Join
+    from netsdb_tpu.relational.folds import SUITE_FOLDS
     from netsdb_tpu.relational.queries import _SUITE_CORES
-    from netsdb_tpu.relational.stats import analyze_table, inject_stats
+    from netsdb_tpu.relational.stats import inject_stats
 
     if qname not in _QUERY_TABLES:
         raise KeyError(f"unknown suite query {qname!r}; "
                        f"have {sorted(_QUERY_TABLES)}")
     names = _QUERY_TABLES[qname]
     core, args_fn = _SUITE_CORES[qname]
-    captured = {n: dict(analyze_table(client.get_table(db, n)))
-                for n in names}
+    info = {n: client.analyze_set(db, n) for n in names}
+    captured = {n: dict(info[n]["stats"]) for n in names}
+    dicts_map = {n: info[n]["dicts"] for n in names}
+    nrows = {n: info[n]["num_rows"] for n in names}
     # the captured stats are DATA-dependent state closed over by the
     # traced body; they must be part of the compiled-plan cache key
     # (via the label) or re-ingesting different data would silently
@@ -287,23 +404,40 @@ def suite_sink_for(client, db: str, qname: str,
         out = core(*args_fn(tables, **params))
         return out if isinstance(out, tuple) else (out,)
 
+    # the query's streamable fold, attached when its fact table is a
+    # direct input of the final node (always true for the ten cores:
+    # the fact is first or last in _QUERY_TABLES) — used by the
+    # executor only when that set is actually paged
+    fold = None
+    fact = None
+    if qname in SUITE_FOLDS:
+        fact, builder = SUITE_FOLDS[qname]
+        fold = builder(captured, dicts_map, nrows, **params)
+
     # chain the scans into one traced N-ary application via
     # tuple-passing binary Joins (the reference compiles multi-way
     # joins into binary stages the same way)
     node = ScanSet(db, names[0])
     if len(names) == 1:
         node = Apply(node, lambda t: run_core(t),
-                     label=f"suite:{qname}:{params}:{stats_tag}")
+                     label=f"suite:{qname}:{params}:{stats_tag}",
+                     fold=fold)
     else:
         for n in names[1:-1]:
             node = Join(node, ScanSet(db, n),
                         fn=lambda a, b: (a + (b,) if isinstance(a, tuple)
                                          else (a, b)),
                         label=f"gather:{n}")
+        # the fold's stream side must be a DIRECT input of this node:
+        # the last scan (fold_src=1) or, for 2-table queries, the first
+        direct = (fact == names[-1]
+                  or (fact == names[0] and len(names) == 2))
         node = Join(node, ScanSet(db, names[-1]),
                     fn=lambda a, b: run_core(*(a + (b,) if isinstance(a, tuple)
                                                else (a, b))),
-                    label=f"suite:{qname}:{params}:{stats_tag}")
+                    label=f"suite:{qname}:{params}:{stats_tag}",
+                    fold=fold if direct else None,
+                    fold_src=1 if fact == names[-1] else 0)
     return WriteSet(node, db, output_set or f"{qname}_out")
 
 

@@ -1,0 +1,391 @@
+"""Streamable decompositions of the TPC-H suite cores.
+
+The reference's pipelines are *born* decomposed: every stage consumes
+its source page-by-page and merges per-page partial state through a
+combiner (``src/storage/headers/PageScanner.h:25-34``,
+``HermesExecutionServer.cc:49-93``), so out-of-core execution is not a
+special mode — it is the only mode. The round-3 engine here had the
+opposite shape: whole-table jitted cores (``relational/queries.py``)
+with three bespoke out-of-core drivers bolted on. This module closes
+that gap: each suite query gets a :class:`~netsdb_tpu.plan.fold.FoldSpec`
+— init / per-chunk step / finalize — over its FACT table stream, with
+the dimension tables resident, so the SAME ``suite_sink_for`` DAG runs
+whole-table or streamed depending only on how the fact set was created
+(``create_set(storage="paged")``).
+
+Semantics discipline: every step first folds validity into columns with
+``relational.dag._fold_mask`` (invalid rows → -1 keys / 0 measures,
+dropped everywhere by the kernels' orphan-key rule) and then runs the
+SAME expressions as the whole-table core, accumulating instead of
+reducing once — so streamed results match the resident engine to float
+summation order. Join plans come from ingest-time statistics
+(:func:`plan_from_captured`), never from streamed arrays: the planner
+consumes summaries collected where the data lives
+(``client.analyze_set``; ref ``StorageCollectStats``,
+``PangeaStorageServer.h:48``).
+
+Multi-pass note: Q17 needs the per-part average *before* it can price
+small-quantity rows, so its fold has two passes (aggregate pass, probe
+pass) — the stream is read twice, the reference's
+aggregate-stage-then-probe-stage sequence.
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Dict, Tuple
+
+import jax.numpy as jnp
+import numpy as np
+
+from netsdb_tpu.plan.fold import FoldSpec, single_pass
+from netsdb_tpu.relational import kernels as K
+from netsdb_tpu.relational.planner import JoinPlan, plan_join_from_stats
+from netsdb_tpu.relational.stats import ColumnStats
+from netsdb_tpu.relational.table import date_to_int
+
+Captured = Dict[str, Dict[str, ColumnStats]]
+
+
+def plan_from_captured(cap: Captured, nrows: Dict[str, int],
+                       build_tab: str, build_col: str,
+                       probe_tab: str, probe_col: str) -> JoinPlan:
+    """`planner.plan_join` computed from captured summaries instead of
+    live tables — same widening rule (the plan's key_space bounds both
+    columns, so orphan foreign keys stay in range)."""
+    bs = cap[build_tab][build_col]
+    ks = max(bs.key_space, cap[probe_tab][probe_col].key_space)
+    merged = ColumnStats(bs.n_rows, bs.min_val, max(bs.max_val, ks - 1),
+                         bs.n_distinct)
+    return plan_join_from_stats(merged, nrows[probe_tab])
+
+
+def _fm(t):
+    from netsdb_tpu.relational.dag import _fold_mask
+
+    return _fold_mask(t)
+
+
+def _lut(dictionary, pred) -> jnp.ndarray:
+    return jnp.asarray(np.fromiter((pred(s) for s in dictionary),
+                                   np.bool_, len(dictionary)))
+
+
+# ---------------------------------------------------------------- Q01
+def fold_q01(cap: Captured, dicts, nrows, *, delta_date: str = "1998-09-02"
+             ) -> FoldSpec:
+    from netsdb_tpu.relational.queries import _q01_fold
+
+    delta = date_to_int(delta_date)
+
+    def shape(src):
+        n_ls = len(src.dicts["l_linestatus"])
+        return n_ls, len(src.dicts["l_returnflag"]) * n_ls
+
+    def init(prev, src):
+        n_ls, g = shape(src)
+        return (jnp.zeros((5, g), jnp.float32), jnp.zeros((g,), jnp.int32))
+
+    def step(st, t):
+        t = _fm(t)
+        n_ls, g = shape(t)
+        s, c = _q01_fold(g, n_ls, t["l_returnflag"], t["l_linestatus"],
+                         t["l_quantity"], t["l_extendedprice"],
+                         t["l_discount"], t["l_tax"],
+                         t["l_shipdate"] <= delta)
+        return (st[0] + s, st[1] + c)
+
+    return single_pass(init, step, lambda st, src: (st[0], st[1]))
+
+
+# ---------------------------------------------------------------- Q06
+def fold_q06(cap: Captured, dicts, nrows, *, d0: str = "1994-01-01",
+             d1: str = "1995-01-01", disc: float = 0.06, qty: int = 24
+             ) -> FoldSpec:
+    a, b = date_to_int(d0), date_to_int(d1)
+
+    def step(st, t):
+        t = _fm(t)
+        ship, discount = t["l_shipdate"], t["l_discount"]
+        mask = ((ship >= a) & (ship < b)
+                & (discount >= disc - 0.011) & (discount <= disc + 0.011)
+                & (t["l_quantity"] < qty))
+        return st + jnp.sum(jnp.where(mask, t["l_extendedprice"] * discount,
+                                      0.0))
+
+    return single_pass(lambda prev, src: jnp.zeros((), jnp.float32),
+                       step, lambda st, src: (st,))
+
+
+# ---------------------------------------------------------------- Q03
+def fold_q03(cap: Captured, dicts, nrows, *, segment: str = "BUILDING",
+             date: str = "1995-03-15", k: int = 10) -> FoldSpec:
+    """Streamed lineitem against resident customer/orders; state is the
+    core's own (key_space,) revenue/odate accumulators, so finalize's
+    top-k packs the identical raw output."""
+    d = date_to_int(date)
+    jp_cust = plan_from_captured(cap, nrows, "customer", "c_custkey",
+                                 "orders", "o_custkey")
+    jp_orders = plan_from_captured(cap, nrows, "orders", "o_orderkey",
+                                   "lineitem", "l_orderkey")
+    n_orders = jp_orders.key_space
+
+    def init(prev, src, cust, orders):
+        # the customer⋈orders qualification is loop-invariant: compute
+        # it ONCE here and carry it in the fold state, instead of
+        # rebuilding the customer LUT inside every chunk's step
+        cust, orders = _fm(cust), _fm(orders)
+        cust_ok = cust["c_mktsegment"] == cust.code("c_mktsegment",
+                                                    segment)
+        _, chit = K.pk_fk_join(cust["c_custkey"], orders["o_custkey"],
+                               cust_ok, plan=jp_cust)
+        order_ok = chit & (orders["o_orderdate"] < d)
+        return (jnp.zeros((n_orders,), jnp.float32),
+                jnp.full((n_orders,), jnp.iinfo(jnp.int32).max, jnp.int32),
+                order_ok)
+
+    def step(st, t, cust, orders):
+        t, orders = _fm(t), _fm(orders)
+        rev_acc, od_acc, order_ok = st
+        l_okey = t["l_orderkey"]
+        oidx, ohit = K.pk_fk_join(orders["o_orderkey"], l_okey,
+                                  order_ok, plan=jp_orders)
+        li_ok = ohit & (t["l_shipdate"] > d)
+        rev_acc = rev_acc + K.segment_sum(
+            t["l_extendedprice"] * (1.0 - t["l_discount"]), l_okey,
+            n_orders, li_ok)
+        od_acc = jnp.minimum(od_acc, K.segment_min(
+            jnp.take(orders["o_orderdate"], oidx), l_okey, n_orders, li_ok))
+        return (rev_acc, od_acc, order_ok)
+
+    def fin(st, src, cust, orders):
+        rev, odate = st[0], st[1]
+        top_idx, top_ok = K.top_k_masked(rev, k, rev > 0)
+        ints = jnp.stack([top_idx, top_ok.astype(jnp.int32),
+                          jnp.take(odate, top_idx)])
+        return (ints, jnp.take(rev, top_idx))
+
+    return single_pass(init, step, fin)
+
+
+# ---------------------------------------------------------------- Q04
+def fold_q04(cap: Captured, dicts, nrows, *, d0: str = "1993-07-01",
+             d1: str = "1993-10-01") -> FoldSpec:
+    a, b = date_to_int(d0), date_to_int(d1)
+    jp_li = plan_from_captured(cap, nrows, "lineitem", "l_orderkey",
+                               "orders", "o_orderkey")
+
+    def init(prev, src, orders):
+        return jnp.zeros((nrows["orders"],), jnp.bool_)
+
+    def step(st, t, orders):
+        t, orders = _fm(t), _fm(orders)
+        late = t["l_commitdate"] < t["l_receiptdate"]
+        return st | K.member(t["l_orderkey"], orders["o_orderkey"], late,
+                             plan=jp_li).astype(jnp.bool_)
+
+    def fin(st, src, orders):
+        orders = _fm(orders)
+        n_pri = len(orders.dicts["o_orderpriority"])
+        o_date = orders["o_orderdate"]
+        in_q = (o_date >= a) & (o_date < b)
+        return (K.segment_count(orders["o_orderpriority"], n_pri,
+                                st & in_q),)
+
+    return single_pass(init, step, fin)
+
+
+# ---------------------------------------------------------------- Q12
+def fold_q12(cap: Captured, dicts, nrows, *, mode1: str = "MAIL",
+             mode2: str = "SHIP", d0: str = "1994-01-01",
+             d1: str = "1995-01-01") -> FoldSpec:
+    a, b = date_to_int(d0), date_to_int(d1)
+    jp_orders = plan_from_captured(cap, nrows, "orders", "o_orderkey",
+                                   "lineitem", "l_orderkey")
+    li_dicts = dicts["lineitem"]
+    n_modes = len(li_dicts["l_shipmode"])
+    m1 = li_dicts["l_shipmode"].index(mode1)
+    m2 = li_dicts["l_shipmode"].index(mode2)
+
+    def init(prev, src, orders):
+        return jnp.zeros((2, n_modes), jnp.int32)
+
+    def step(st, t, orders):
+        t, orders = _fm(t), _fm(orders)
+        l_mode = t["l_shipmode"]
+        mask = (((l_mode == m1) | (l_mode == m2))
+                & (t["l_commitdate"] < t["l_receiptdate"])
+                & (t["l_shipdate"] < t["l_commitdate"])
+                & (t["l_receiptdate"] >= a) & (t["l_receiptdate"] < b))
+        oidx, ohit = K.pk_fk_join(orders["o_orderkey"], t["l_orderkey"],
+                                  plan=jp_orders)
+        mask = mask & ohit
+        hi = _lut(orders.dicts["o_orderpriority"],
+                  lambda s: s in ("1-URGENT", "2-HIGH"))
+        high = jnp.take(hi, jnp.take(orders["o_orderpriority"], oidx))
+        return st + jnp.stack(
+            [K.segment_count(l_mode, n_modes, mask & high),
+             K.segment_count(l_mode, n_modes, mask & ~high)])
+
+    return single_pass(init, step, lambda st, src, orders: (st,))
+
+
+# ---------------------------------------------------------------- Q13
+_Q13_CAP = 256  # mirrors queries._Q13_CAP (orders/customer is spec-fixed)
+
+
+def fold_q13(cap: Captured, dicts, nrows, *, word1: str = "special",
+             word2: str = "requests") -> FoldSpec:
+    import re
+
+    n_cust = cap["customer"]["c_custkey"].key_space
+    pat = re.compile(f"{re.escape(word1)}.*{re.escape(word2)}")
+
+    def init(prev, src, cust):
+        return jnp.zeros((n_cust,), jnp.int32)
+
+    def step(st, t, cust):
+        t = _fm(t)
+        if "o_comment" in t.dicts:
+            keep = jnp.take(_lut(t.dicts["o_comment"],
+                                 lambda s: not pat.search(s)),
+                            t["o_comment"])
+        else:
+            keep = t["o_custkey"] >= 0
+        return st + K.segment_count(t["o_custkey"], n_cust, keep)
+
+    def fin(st, src, cust):
+        cust = _fm(cust)
+        per_cust = jnp.take(st, cust["c_custkey"])
+        hist = K.bincount_masked(jnp.minimum(per_cust, _Q13_CAP - 1),
+                                 _Q13_CAP)
+        return (hist, jnp.max(per_cust, initial=0))
+
+    return single_pass(init, step, fin)
+
+
+# ---------------------------------------------------------------- Q14
+def fold_q14(cap: Captured, dicts, nrows, *, d0: str = "1995-09-01",
+             d1: str = "1995-10-01") -> FoldSpec:
+    a, b = date_to_int(d0), date_to_int(d1)
+    jp_part = plan_from_captured(cap, nrows, "part", "p_partkey",
+                                 "lineitem", "l_partkey")
+
+    def init(prev, src, part):
+        return jnp.zeros((2,), jnp.float32)
+
+    def step(st, t, part):
+        t, part = _fm(t), _fm(part)
+        mask = (t["l_shipdate"] >= a) & (t["l_shipdate"] < b)
+        pidx, phit = K.pk_fk_join(part["p_partkey"], t["l_partkey"],
+                                  plan=jp_part)
+        mask = mask & phit
+        rev = jnp.where(mask, t["l_extendedprice"] * (1.0 - t["l_discount"]),
+                        0.0)
+        promo = _lut(part.dicts["p_type"], lambda s: s.startswith("PROMO"))
+        is_promo = jnp.take(promo, jnp.take(part["p_type"], pidx))
+        return st + jnp.stack([jnp.sum(jnp.where(is_promo, rev, 0.0)),
+                               jnp.sum(rev)])
+
+    return single_pass(init, step, lambda st, src, part: (st,))
+
+
+# ---------------------------------------------------------------- Q17
+def fold_q17(cap: Captured, dicts, nrows, *, brand: str = "Brand#23",
+             container: str = "MED BOX") -> FoldSpec:
+    jp_part = plan_from_captured(cap, nrows, "part", "p_partkey",
+                                 "lineitem", "l_partkey")
+    ks = jp_part.key_space
+
+    def part_hit(t, part):
+        part_ok = ((part["p_brand"] == part.code("p_brand", brand))
+                   & (part["p_container"] == part.code("p_container",
+                                                       container)))
+        _, phit = K.pk_fk_join(part["p_partkey"], t["l_partkey"], part_ok,
+                               plan=jp_part)
+        return phit
+
+    # pass 1: per-part quantity sum/count over qualifying rows
+    def init1(prev, src, part):
+        return (jnp.zeros((ks,), jnp.float32), jnp.zeros((ks,), jnp.int32))
+
+    def step1(st, t, part):
+        t, part = _fm(t), _fm(part)
+        phit = part_hit(t, part)
+        qty = t["l_quantity"].astype(jnp.float32)
+        return (st[0] + K.segment_sum(qty, t["l_partkey"], ks, phit),
+                st[1] + K.segment_count(t["l_partkey"], ks, phit))
+
+    # pass 2: price rows below 0.2 * the pass-1 average
+    def init2(prev, src, part):
+        s, c = prev
+        avg = s / jnp.maximum(c, 1).astype(jnp.float32)
+        return (avg, jnp.zeros((), jnp.float32))
+
+    def step2(st, t, part):
+        t, part = _fm(t), _fm(part)
+        avg, acc = st
+        phit = part_hit(t, part)
+        qty = t["l_quantity"].astype(jnp.float32)
+        small = phit & (qty < 0.2 * jnp.take(avg, t["l_partkey"]))
+        return (avg, acc + jnp.sum(jnp.where(small, t["l_extendedprice"],
+                                             0.0)))
+
+    def fin(st, src, part):
+        return (st[1] / 7.0,)
+
+    return FoldSpec(((init1, step1), (init2, step2)), fin)
+
+
+# ---------------------------------------------------------------- Q22
+def fold_q22(cap: Captured, dicts, nrows,
+             *, prefixes: Tuple[str, ...] = ("13", "31", "23", "29", "30",
+                                             "18", "17")) -> FoldSpec:
+    from netsdb_tpu.relational.queries import q22_code_lut
+
+    jp_cust = plan_from_captured(cap, nrows, "orders", "o_custkey",
+                                 "customer", "c_custkey")
+    n_pref = len(sorted(set(prefixes)))
+
+    def init(prev, src, cust):
+        return jnp.zeros((nrows["customer"],), jnp.bool_)
+
+    def step(st, t, cust):
+        t, cust = _fm(t), _fm(cust)
+        return st | K.member(t["o_custkey"], cust["c_custkey"],
+                             t["o_custkey"] >= 0,
+                             plan=jp_cust).astype(jnp.bool_)
+
+    def fin(st, src, cust):
+        cust = _fm(cust)
+        _, code_lut = q22_code_lut(cust.dicts["c_phone"], prefixes)
+        pref = jnp.take(code_lut, cust["c_phone"])
+        in_pref = pref >= 0
+        c_bal = cust["c_acctbal"]
+        pos = in_pref & (c_bal > 0)
+        avg = (jnp.sum(jnp.where(pos, c_bal, 0.0))
+               / jnp.maximum(jnp.sum(pos.astype(jnp.int32)), 1))
+        sel = in_pref & (c_bal > avg) & ~st
+        seg = jnp.clip(pref, 0, n_pref - 1)
+        return (jnp.stack(
+            [K.segment_count(seg, n_pref, sel).astype(jnp.float32),
+             K.segment_sum(c_bal, seg, n_pref, sel)]),)
+
+    return single_pass(init, step, fin)
+
+
+# ---------------------------------------------------- registry
+# qname -> (fact set name streamed when paged, fold builder). q02 has
+# no fold: its min-cost-supplier winner needs global row arbitration
+# that doesn't decompose cleanly; a paged partsupp falls back to the
+# executor's materialize path (documented in plan/executor.py).
+SUITE_FOLDS: Dict[str, Tuple[str, Callable[..., FoldSpec]]] = {
+    "q01": ("lineitem", fold_q01),
+    "q03": ("lineitem", fold_q03),
+    "q04": ("lineitem", fold_q04),
+    "q06": ("lineitem", fold_q06),
+    "q12": ("lineitem", fold_q12),
+    "q13": ("orders", fold_q13),
+    "q14": ("lineitem", fold_q14),
+    "q17": ("lineitem", fold_q17),
+    "q22": ("orders", fold_q22),
+}

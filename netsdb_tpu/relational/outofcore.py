@@ -22,7 +22,6 @@ validity mask like everywhere else in this framework).
 
 from __future__ import annotations
 
-import functools
 from typing import Dict, Iterator, List, Optional, Tuple
 
 import jax
@@ -47,7 +46,8 @@ class PagedColumns:
     def __init__(self, store: PagedTensorStore, name: str,
                  int_names: List[str], float_names: List[str],
                  num_rows: int, row_block: int,
-                 dicts: Optional[Dict[str, List[str]]] = None):
+                 dicts: Optional[Dict[str, List[str]]] = None,
+                 stats: Optional[Dict[str, object]] = None):
         self.store = store
         self.name = name
         self.int_names = int_names
@@ -55,6 +55,11 @@ class PagedColumns:
         self.num_rows = num_rows
         self.row_block = row_block
         self.dicts = dicts or {}
+        # ingest-time ColumnStats per int column — collected in the one
+        # pass that already touches every row, so the planner never has
+        # to re-stream the set (the reference's StorageCollectStats
+        # moment, ``PangeaStorageServer.h:48``)
+        self.stats = stats or {}
 
     # ------------------------------------------------------------ ingest
     @staticmethod
@@ -79,16 +84,21 @@ class PagedColumns:
             row_block = max(store.config.page_size_bytes // (4 * width),
                             1024)
         row_block = min(row_block, num_rows)
+        from netsdb_tpu.relational.stats import analyze_array
+
+        stats = {}
         if int_names:
             imat = np.stack([np.asarray(cols[n]).astype(np.int32)
                              for n in int_names], axis=1)
+            stats = {n: analyze_array(imat[:, j])
+                     for j, n in enumerate(int_names)}
             store.put(f"{name}.int", imat, row_block=row_block)
         if float_names:
             fmat = np.stack([np.asarray(cols[n]).astype(np.float32)
                              for n in float_names], axis=1)
             store.put(f"{name}.float", fmat, row_block=row_block)
         return PagedColumns(store, name, int_names, float_names,
-                            num_rows, row_block, dicts)
+                            num_rows, row_block, dicts, stats)
 
     @staticmethod
     def from_table(store: PagedTensorStore, name: str, table: ColumnTable,
@@ -151,42 +161,87 @@ class PagedColumns:
             yield ({k: jnp.asarray(v) for k, v in chunk.items()},
                    jnp.asarray(valid))
 
+    def drop(self) -> None:
+        """Free this relation's pages from the shared arena (both the
+        int and float matrices). After this the PagedColumns is dead."""
+        for suffix in (".int", ".float"):
+            self.store.drop(self.name + suffix)
+
+    def stream_tables(self, prefetch: int = 2,
+                      placement=None) -> Iterator[ColumnTable]:
+        """The PageScanner feed for the set/DAG API: yield each chunk as
+        a ColumnTable (validity-masked, plus a ``_rowid`` global-row-
+        index column so key-range folds can recover absolute rows).
+
+        ``placement`` mesh-shards every chunk's rows before yielding —
+        the streamed-pages-onto-mesh-shards path (each device folds its
+        shard of every page; XLA inserts the per-chunk collectives the
+        reference's workers-stream-local-partitions model implies,
+        ``PipelineStage.cc:228-265``). Ingest rounds ``row_block`` to
+        the shard granularity, so placed chunks shard without a second
+        padding round."""
+        start = 0
+        base_rowid = jnp.arange(self.row_block, dtype=jnp.int32)
+        for cols, valid in self.stream(prefetch):
+            cols = dict(cols)
+            cols["_rowid"] = base_rowid + start
+            t = ColumnTable(cols, self.dicts, valid)
+            if placement is not None:
+                from netsdb_tpu.parallel.placement import shard_table
+
+                t = shard_table(t, placement)
+            yield t
+            # blocks are contiguous equal row ranges (only the tail is
+            # short), so the next chunk starts one full block later
+            start += self.row_block
+
+    def to_table(self) -> ColumnTable:
+        """Materialize the whole relation as one resident ColumnTable —
+        the compatibility escape hatch (``get_table`` on a paged set,
+        fold-less query fallback). Defeats paging by construction; the
+        streamed path is ``stream_tables``."""
+        parts: Dict[str, List[np.ndarray]] = {}
+        n_done = 0
+        for cols, valid in self.stream():
+            n = int(np.asarray(valid).sum())
+            for k, v in cols.items():
+                parts.setdefault(k, []).append(np.asarray(v)[:n])
+            n_done += n
+        if n_done != self.num_rows:
+            raise RuntimeError(f"paged set {self.name!r}: streamed "
+                               f"{n_done} rows, expected {self.num_rows}")
+        from netsdb_tpu.relational.stats import inject_stats
+
+        out = ColumnTable({k: jnp.asarray(np.concatenate(v))
+                           for k, v in parts.items()}, self.dicts, None)
+        return inject_stats(out, self.stats)
+
+
+# --------------------------------------------------------- fold runner
+def run_fold(fold, pc: PagedColumns, *resident, placement=None):
+    """Thin standalone driver for a FoldSpec over one paged relation —
+    delegates to the SAME loop the plan executor runs for paged
+    ScanSets, exposed for direct/bench use without a Client. One jit
+    per pass per call; call-site loops should go through the executor,
+    whose compiled-step cache amortizes across jobs."""
+    from netsdb_tpu.plan.executor import _run_fold_once
+
+    return _run_fold_once(fold, pc, resident, placement,
+                          lambda pidx, step: jax.jit(step))
+
 
 # ---------------------------------------------------------------- Q01
-@functools.partial(jax.jit, static_argnums=(0, 1))
-def _q01_fold(n_groups: int, n_ls: int, sums, counts, valid, ship, rf,
-              ls, qty, price, disc, tax, delta):
-    """One page of Q01: the same combiner as ``sharded._q01_local``,
-    accumulated instead of psum'd."""
-    from netsdb_tpu.relational import kernels as K
-
-    mask = valid & (ship <= delta)
-    seg = rf * n_ls + ls
-    qty = qty.astype(jnp.float32)
-    disc_price = price * (1.0 - disc)
-    charge = disc_price * (1.0 + tax)
-    rows = [K.segment_sum(v, seg, n_groups, mask)
-            for v in (qty, price, disc_price, charge, disc)]
-    return sums + jnp.stack(rows), counts + K.segment_count(seg, n_groups,
-                                                            mask)
-
-
 def ooc_q01(pc: PagedColumns, delta_date: str = "1998-09-02"):
     """Q01 over a paged lineitem — same result structure as
-    ``queries.cq01``. One compiled fold per page; accumulator shape
-    (5, groups) + (groups,) regardless of table size."""
+    ``queries.cq01``. Thin wrapper: the math lives in
+    ``relational.folds.fold_q01`` (the SAME fold the set-API DAG
+    streams); only the host-side row decoding is local."""
+    from netsdb_tpu.relational.folds import fold_q01
+
     n_ls = len(pc.dicts["l_linestatus"])
     n_groups = len(pc.dicts["l_returnflag"]) * n_ls
-    delta = date_to_int(delta_date)
-    sums = jnp.zeros((5, n_groups), jnp.float32)
-    counts = jnp.zeros((n_groups,), jnp.int32)
-    for cols, valid in pc.stream():
-        sums, counts = _q01_fold(
-            n_groups, n_ls, sums, counts, valid, cols["l_shipdate"],
-            cols["l_returnflag"], cols["l_linestatus"],
-            cols["l_quantity"], cols["l_extendedprice"],
-            cols["l_discount"], cols["l_tax"], delta)
-    sums, counts = jax.device_get((sums, counts))
+    sums, counts = jax.device_get(
+        run_fold(fold_q01({}, {}, {}, delta_date=delta_date), pc))
     names = ("sum_qty", "sum_base_price", "sum_disc_price", "sum_charge",
              "sum_disc")
     out = []
@@ -207,24 +262,14 @@ def ooc_q01(pc: PagedColumns, delta_date: str = "1998-09-02"):
 
 
 # ---------------------------------------------------------------- Q06
-@jax.jit
-def _q06_fold(acc, valid, ship, discount, quantity, price, a, b, disc,
-              qty):
-    mask = (valid & (ship >= a) & (ship < b)
-            & (discount >= disc - 0.011) & (discount <= disc + 0.011)
-            & (quantity < qty))
-    return acc + jnp.sum(jnp.where(mask, price * discount, 0.0))
-
-
 def ooc_q06(pc: PagedColumns, d0: str = "1994-01-01",
             d1: str = "1995-01-01", disc: float = 0.06, qty: int = 24):
-    """Q06 over a paged lineitem — same result as ``queries.cq06``."""
-    acc = jnp.zeros((), jnp.float32)
-    a, b = date_to_int(d0), date_to_int(d1)
-    for cols, valid in pc.stream():
-        acc = _q06_fold(acc, valid, cols["l_shipdate"],
-                        cols["l_discount"], cols["l_quantity"],
-                        cols["l_extendedprice"], a, b, disc, qty)
+    """Q06 over a paged lineitem — same result as ``queries.cq06``.
+    Thin wrapper over ``relational.folds.fold_q06``."""
+    from netsdb_tpu.relational.folds import fold_q06
+
+    (acc,) = run_fold(fold_q06({}, {}, {}, d0=d0, d1=d1, disc=disc,
+                               qty=qty), pc)
     return [("revenue", float(acc))]
 
 
@@ -239,23 +284,10 @@ def ooc_q06(pc: PagedColumns, d0: str = "1994-01-01",
 #   [qualifies, o_orderdate, o_shippriority], paged into the SAME
 #   spillable store as the data (row_block = partition size, so
 #   partition p is exactly block p — resident only while probed).
-# - PROBE: lineitem streams once per key-range partition; rows outside
-#   the partition are masked (grace-hash discipline: join state is
-#   bounded by the partition size, never by the key space). The probe
-#   fold is one compiled program reused across pages AND partitions.
-# - MERGE: per-partition top-k candidates merge on the host (tiny).
-
-@functools.partial(jax.jit, static_argnums=(0,))
-def _q03_probe_fold(cap: int, acc, start, qual, valid, okey, ship,
-                    price, disc, date):
-    from netsdb_tpu.relational import kernels as K
-
-    rel = okey - start
-    in_part = (rel >= 0) & (rel < cap)
-    relc = jnp.clip(rel, 0, cap - 1)
-    m = valid & in_part & (ship > date) & (jnp.take(qual, relc) > 0)
-    return acc + K.segment_sum(price * (1.0 - disc), relc, cap, m)
-
+# - PROBE/MERGE: ``ooc_q03`` is now a thin wrapper over the SAME
+#   grace-hash machinery the set-API DAG uses for a paged build side
+#   (``relational.dag.q03_probe_fold`` — outer loop over build blocks,
+#   inner fold over the lineitem stream, per-partition top-k merged).
 
 def build_q03_side(store: PagedTensorStore,
                    orders: Dict[str, np.ndarray],
@@ -291,33 +323,39 @@ def ooc_q03(pc: PagedColumns, store: PagedTensorStore,
             build_name: str = "q03.build") -> List[Dict[str, object]]:
     """Q03 with lineitem streamed from pages and the join LUT loaded one
     partition at a time — same result structure as ``queries.cq03``.
-    Peak device state: one partition's LUT column + one ``(cap,)``
+    Peak device state: one partition's build columns + one per-row
     revenue accumulator + one page of probe columns, independent of
-    table or key-space size."""
-    date_i = date_to_int(date)
-    num_parts = store.num_blocks(build_name)
-    cand: List[Dict[str, object]] = []
-    for p in range(num_parts):
+    table or key-space size.
+
+    Thin wrapper: each LUT block becomes a build-side ColumnTable
+    (non-qualifying keys → -1, dropped by the orphan-key rule) and the
+    grace-hash loop runs the SAME fold + merge the set-API DAG uses for
+    a paged build side (``relational.dag.q03_probe_fold``)."""
+    from netsdb_tpu.relational.dag import q03_probe_fold, q03_rows
+    from netsdb_tpu.relational.planner import JoinPlan
+
+    if "l_orderkey" not in pc.stats:
+        raise KeyError(
+            "ooc_q03 needs ingest-time stats for 'l_orderkey' (the join "
+            "key-space bound); this PagedColumns has none — re-ingest "
+            "via PagedColumns.ingest/from_table")
+    ks = pc.stats["l_orderkey"].key_space
+    fold = q03_probe_fold(date_to_int(date), k, JoinPlan("lut", max(ks, 1)))
+    jstep = jax.jit(fold.passes[0][1])
+    out = None
+    for p in range(store.num_blocks(build_name)):
         start, bmat = store.read_block(build_name, p)
-        # static cap = this partition's row count; all full partitions
-        # share one compiled fold, the ragged tail compiles once more
-        cap = bmat.shape[0]
-        qual = jnp.asarray(bmat[:, 0])
-        acc = jnp.zeros((cap,), jnp.float32)
-        for cols, valid in pc.stream():
-            acc = _q03_probe_fold(cap, acc, start, qual, valid,
-                                  cols["l_orderkey"], cols["l_shipdate"],
-                                  cols["l_extendedprice"],
-                                  cols["l_discount"], date_i)
-        acc_h = np.asarray(acc)
-        top = np.argsort(-acc_h)[:k]
-        for i in top:
-            if acc_h[i] > 0:
-                cand.append({"okey": start + int(i),
-                             "odate": int_to_date(int(bmat[i, 1])),
-                             "revenue": float(acc_h[i])})
-    cand.sort(key=lambda r: (-r["revenue"], r["odate"]))
-    return cand[:k]
+        keys = np.where(bmat[:, 0] > 0,
+                        np.arange(bmat.shape[0], dtype=np.int32) + start,
+                        -1).astype(np.int32)
+        btab = ColumnTable({"o_orderkey": jnp.asarray(keys),
+                            "o_orderdate": jnp.asarray(bmat[:, 1])})
+        state = fold.passes[0][0](None, pc, btab)
+        for chunk in pc.stream_tables():
+            state = jstep(state, chunk, btab)
+        part = fold.finalize(state, pc, btab)
+        out = part if out is None else fold.merge(out, part)
+    return q03_rows(out) if out is not None else []
 
 
 Q01_COLUMNS = ["l_shipdate", "l_returnflag", "l_linestatus",

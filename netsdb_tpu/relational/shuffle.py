@@ -200,9 +200,27 @@ def hash_join(mesh: Mesh, axis: str,
         raise ValueError(
             f"hash_join column name collision {sorted(clash)}: rename a "
             "side's columns (build columns would silently shadow probe)")
-    n_shards = mesh.shape[axis]
     b = hash_repartition(mesh, axis, build, build_key, slack, build_valid)
     p = hash_repartition(mesh, axis, probe, probe_key, slack, probe_valid)
+    nb = next(iter(build.values())).shape[0]
+    npr = next(iter(probe.values())).shape[0]
+    return local_join(b, p, build_key, probe_key, key_space, nb, npr,
+                      build_mask_fn)
+
+
+def local_join(b: ShardedRows, p: ShardedRows, build_key: str,
+               probe_key: str, key_space: int, build_rows: int,
+               probe_rows: int,
+               build_mask_fn: Optional[Callable] = None) -> ShardedRows:
+    """Per-shard LUT/sort join of two ALREADY co-partitioned row sets
+    (both repartitioned on the same key, e.g. by ``hash_repartition``
+    or a ``Partition`` Computation node) over the compressed key space.
+    This is the local half of :func:`hash_join`, exposed so a
+    Partition-node DAG can compose shuffle and join as separate stages
+    — the reference's partition-stage → join-stage pipeline
+    (``PipelineStage.cc:1652-1728``)."""
+    mesh, axis = b.mesh, b.axis
+    n_shards = mesh.shape[axis]
     local_ks = compressed_key_space(key_space, n_shards)
     # the per-shard join strategy comes from the SAME cost model as the
     # single-chip planner (tuned LUT density factor + byte cap), fed
@@ -211,10 +229,9 @@ def hash_join(mesh: Mesh, axis: str,
     from netsdb_tpu.relational.planner import plan_join_from_stats
     from netsdb_tpu.relational.stats import ColumnStats
 
-    nb = next(iter(build.values())).shape[0] // n_shards + 1
-    npr = next(iter(probe.values())).shape[0] // n_shards + 1
-    local_build = ColumnStats(nb, 0, local_ks - 1, -1)
-    jp = plan_join_from_stats(local_build, npr)
+    local_build = ColumnStats(build_rows // n_shards + 1, 0,
+                              local_ks - 1, -1)
+    jp = plan_join_from_stats(local_build, probe_rows // n_shards + 1)
     jp = JoinPlan(jp.strategy, local_ks)
     fn = _join_prog(mesh, axis, tuple(sorted(b.cols)),
                     tuple(sorted(p.cols)), build_key, probe_key, jp,
@@ -432,10 +449,19 @@ def shuffle_q03(tables, mesh: Mesh, axis: str = "data",
         build_valid=None if orders is not None else j1.valid)
     check_overflow(joined)
 
-    # phase 3: local per-order aggregate over the sharded joined rows —
-    # the generic downstream primitive over a ShardedRows (the ship-date
-    # filter and revenue product are elementwise on the sharded global
-    # arrays, so they fuse ahead of the cached segment program)
+    return q03_finish(joined, gks, d, k)
+
+
+def q03_finish(joined: ShardedRows, gks: int, d: int, k: int):
+    """Phases 3–4 of the row-output Q03 over an already-joined
+    ShardedRows: local per-order aggregate (no collective — the
+    repartition bought co-location), distributed top-k, host decode.
+    Shared by the hand-mesh driver (:func:`shuffle_q03`) and the
+    Partition-node DAG (:func:`q03_row_sink_for`)."""
+    from netsdb_tpu.relational.table import int_to_date
+
+    mesh, axis = joined.mesh, joined.axis
+    n_shards = mesh.shape[axis]
     local_ks = compressed_key_space(gks, n_shards)
     agg_in = ShardedRows(
         {"l_orderkey": joined.cols["l_orderkey"],
@@ -447,7 +473,6 @@ def shuffle_q03(tables, mesh: Mesh, axis: str = "data",
     rev_sh, od_sh = segment_sum_by_key(agg_in, "l_orderkey", "rev", gks,
                                        extra_min_col="o_orderdate")
 
-    # phase 4: distributed top-k, then decode the k winners on the host
     vals, gkeys, _ = distributed_top_k(mesh, axis, rev_sh, k,
                                        mask=rev_sh > 0)
     import numpy as np
@@ -464,3 +489,71 @@ def shuffle_q03(tables, mesh: Mesh, axis: str = "data",
                      "revenue": float(vals[j])})
     rows.sort(key=lambda r: (-r["revenue"], r["odate"]))
     return rows
+
+
+def q03_row_sink_for(client, db: str, segment: str = "BUILDING",
+                     date: str = "1995-03-15", k: int = 10,
+                     slack: float = 2.0):
+    """The row-output shuffle Q03 as a PARTITION-NODE DAG over placed
+    sets — no hand mesh anywhere: the mesh comes off the stored
+    columns' placement shardings, statistics come from
+    ``client.analyze_set`` summaries, and the plan is
+    SCAN→JOIN(filter)→PARTITION ×2 →JOIN(local)→OUTPUT, the reference's
+    partition-stage → join-stage pipeline shape
+    (``PipelineStage.cc:1652-1728``) expressed in Computation nodes.
+    Retires ``shuffle_q03(tables, mesh)``'s hand-mesh surface from
+    client code paths."""
+    from netsdb_tpu.plan.computations import (Apply, Join, Partition,
+                                              ScanSet, WriteSet)
+    from netsdb_tpu.relational.dag import _fold_mask
+    from netsdb_tpu.relational.table import ColumnTable, date_to_int
+    from netsdb_tpu.storage.store import SetIdentifier
+
+    info = {n: client.analyze_set(db, n)
+            for n in ("customer", "orders", "lineitem")}
+    gks = max(info["orders"]["stats"]["o_orderkey"].key_space,
+              info["lineitem"]["stats"]["l_orderkey"].key_space)
+    cust_ks = max(info["customer"]["stats"]["c_custkey"].key_space,
+                  info["orders"]["stats"]["o_custkey"].key_space)
+    seg_dict = info["customer"]["dicts"]["c_mktsegment"]
+    # -1 for an unknown segment → empty result, not a build-time crash
+    seg_code = seg_dict.index(segment) if segment in seg_dict else -1
+    d = date_to_int(date)
+    pl = client.store.placement_of(SetIdentifier(db, "lineitem"))
+    if pl is None:
+        raise ValueError("q03_row_sink_for needs a placed lineitem set "
+                         "(the Partition nodes shuffle on its mesh)")
+    n_parts = pl.axis_size()
+    jp_cust = JoinPlan("lut", cust_ks)
+
+    def filter_orders(orders: ColumnTable, cust: ColumnTable) -> ColumnTable:
+        orders, cust = _fold_mask(orders), _fold_mask(cust)
+        cust_ok = cust["c_mktsegment"] == seg_code
+        _, chit = K.pk_fk_join(cust["c_custkey"], orders["o_custkey"],
+                               cust_ok, plan=jp_cust)
+        return ColumnTable({"o_orderkey": orders["o_orderkey"],
+                            "o_orderdate": orders["o_orderdate"],
+                            "o_ok": chit & (orders["o_orderdate"] < d)})
+
+    def project_li(t: ColumnTable) -> ColumnTable:
+        return t.select(["l_orderkey", "l_shipdate", "l_extendedprice",
+                         "l_discount"])
+
+    build = Join(ScanSet(db, "orders"), ScanSet(db, "customer"),
+                 fn=filter_orders, label=f"q03rows-filter:{seg_code}:{d}")
+    probe = Apply(ScanSet(db, "lineitem"), project_li,
+                  label="q03rows-project", traceable=False)
+    pb = Partition(build, "o_orderkey", n_parts, label="part-orders")
+    pp = Partition(probe, "l_orderkey", n_parts, label="part-lineitem")
+
+    def join_and_finish(p: ShardedRows, b: ShardedRows):
+        j = local_join(b, p, "o_orderkey", "l_orderkey", gks,
+                       build_rows=info["orders"]["num_rows"],
+                       probe_rows=info["lineitem"]["num_rows"],
+                       build_mask_fn=_mask_o_ok)
+        check_overflow(j)
+        return q03_finish(j, gks, d, k)
+
+    out = Join(pp, pb, fn=join_and_finish,
+               label=f"q03rows-join:{gks}:{d}:{k}")
+    return WriteSet(out, db, "q03_rows_out")

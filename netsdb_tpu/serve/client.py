@@ -215,18 +215,19 @@ class RemoteClient:
     def create_set(self, db: str, set_name: str, type_name: str = "tensor",
                    persistence: str = "transient", eviction: str = "lru",
                    partition_lambda: Optional[str] = None,
-                   placement=None):
+                   placement=None, storage: str = "memory"):
         """``placement`` may be a Placement (serialized via ``to_meta``)
         or its meta dict; the daemon applies it to all ingest into the
         set (distribution declared at createSet, as in the reference's
-        PartitionPolicy)."""
+        PartitionPolicy). ``storage="paged"`` backs the set with the
+        daemon's page arena (out-of-core as a set property)."""
         if placement is not None and hasattr(placement, "to_meta"):
             placement = placement.to_meta()
         self._request(MsgType.CREATE_SET, {
             "db": db, "set": set_name, "type_name": type_name,
             "persistence": persistence, "eviction": eviction,
             "partition_lambda": partition_lambda,
-            "placement": placement})
+            "placement": placement, "storage": storage})
         return RemoteIdent(db, set_name)
 
     def remove_set(self, db: str, set_name: str) -> None:
@@ -283,6 +284,21 @@ class RemoteClient:
              "as_table": True, "date_cols": list(date_cols)},
             codec=CODEC_PICKLE)
         return RemoteTableInfo(reply["count"], list(reply["columns"]))
+
+    def analyze_set(self, db: str, set_name: str) -> Dict[str, Any]:
+        """Planner statistics computed DAEMON-side; only the summaries
+        cross the wire (ref StorageCollectStats,
+        ``PangeaStorageServer.h:48``). This is what lets
+        ``relational.dag.suite_sink_for`` build all ten suite sinks
+        over a daemon without pulling a single table."""
+        from netsdb_tpu.relational.stats import ColumnStats
+
+        reply = self._request(MsgType.ANALYZE_SET,
+                              {"db": db, "set": set_name})
+        return {"num_rows": reply["num_rows"],
+                "dicts": {k: list(v) for k, v in reply["dicts"].items()},
+                "stats": {k: ColumnStats(*v)
+                          for k, v in reply["stats"].items()}}
 
     def get_table(self, db: str, set_name: str):
         """Fetch a table set as a host-side ColumnTable (pickled via its

@@ -85,6 +85,11 @@ class MsgType(IntEnum):
     LIST_JOBS = 32
     # stats (ref StorageCollectStats)
     COLLECT_STATS = 40
+    # planner statistics computed where the data lives: per-column
+    # summaries + dictionaries of one stored relation, so DAG builders
+    # (suite_sink_for) never pull tables from a daemon (ref
+    # StorageCollectStats → Statistics, PangeaStorageServer.h:48)
+    ANALYZE_SET = 41
 
 
 class ProtocolError(ConnectionError):

@@ -155,6 +155,7 @@ class ServeController:
             MsgType.EXECUTE_PLAN: self._on_execute_plan,
             MsgType.LIST_JOBS: self._on_list_jobs,
             MsgType.COLLECT_STATS: self._on_collect_stats,
+            MsgType.ANALYZE_SET: self._on_analyze_set,
         }
 
     # --- lifecycle ----------------------------------------------------
@@ -378,7 +379,8 @@ class ServeController:
             persistence=p.get("persistence", "transient"),
             eviction=p.get("eviction", "lru"),
             partition_lambda=p.get("partition_lambda"),
-            placement=p.get("placement"))  # Placement.to_meta dict
+            placement=p.get("placement"),  # Placement.to_meta dict
+            storage=p.get("storage", "memory"))
         return MsgType.OK, {}
 
     def _on_remove_set(self, p):
@@ -434,10 +436,20 @@ class ServeController:
         return MsgType.OK, {"data": dense,
                             "block_shape": list(t.meta.block_shape)}
 
+    def _scan_items(self, db: str, set_name: str):
+        """Set scan for the wire: a paged set's PagedColumns handle is
+        process-local (it wraps the native arena), so it ships as its
+        materialized table — clients wanting summaries only should use
+        ANALYZE_SET instead."""
+        from netsdb_tpu.relational.outofcore import PagedColumns
+
+        for item in self.library.get_set_iterator(db, set_name):
+            yield item.to_table() if isinstance(item, PagedColumns) else item
+
     def _on_scan_set(self, p):
         from netsdb_tpu.serve.protocol import CODEC_PICKLE
 
-        items = list(self.library.get_set_iterator(p["db"], p["set"]))
+        items = list(self._scan_items(p["db"], p["set"]))
         # host objects are arbitrary Python → pickle codec on the reply
         return MsgType.OK, {"items": items}, CODEC_PICKLE
 
@@ -468,7 +480,7 @@ class ServeController:
             # handful of frames
             target = 1
             batch: list = []
-            for item in self.library.get_set_iterator(p["db"], p["set"]):
+            for item in self._scan_items(p["db"], p["set"]):
                 batch.append(item)
                 if len(batch) < target:
                     continue
@@ -641,6 +653,18 @@ class ServeController:
     def _on_collect_stats(self, p):
         return MsgType.OK, {"sets": self.library.collect_stats(),
                             "cache": self.library.store.stats.as_dict()}
+
+    def _on_analyze_set(self, p):
+        """Planner statistics computed where the data lives — the
+        summaries ship, the table stays (ref StorageCollectStats,
+        ``PangeaStorageServer.h:48``). ColumnStats flatten to 4-int
+        rows; dictionaries are lists of strings (msgpack-safe)."""
+        info = self.library.analyze_set(p["db"], p["set"])
+        return MsgType.OK, {
+            "num_rows": int(info["num_rows"]),
+            "dicts": {k: list(v) for k, v in info["dicts"].items()},
+            "stats": {k: [s.n_rows, s.min_val, s.max_val, s.n_distinct]
+                      for k, s in info["stats"].items()}}
 
 
 def run_daemon(config: Configuration, host: str = "127.0.0.1",

@@ -269,6 +269,17 @@ class PagedTensorStore:
             outs.append(np.asarray(block_mm(jax.device_put(block), rhs_dev)))
         return np.concatenate(outs, axis=0)
 
+    def drop(self, name: str) -> None:
+        """Free a matrix's pages from the arena (and its spill files) —
+        the page-reclaim hook ``SetStore.remove_set`` uses so dropping
+        a paged set returns its space to the shared capped pool."""
+        sid = self._ids.pop(name, None)
+        if sid is None:
+            return
+        for pid in self.backend.set_pages(sid):
+            self.backend.free_page(pid)
+        self._meta.pop(sid, None)
+
     def stats(self) -> dict:
         return self.backend.stats()
 

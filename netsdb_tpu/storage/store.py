@@ -80,6 +80,10 @@ class _StoredSet:
     # PartitionPolicy chosen at createSet — distribution is a property
     # of the set, netsdb_tpu.parallel.placement)
     placement: Optional[Any] = None
+    # "memory" (resident items) or "paged" (relation lives as row-chunk
+    # pages in the shared PagedTensorStore; queries stream it — the
+    # reference's PageScanner-fed sets, ``PageScanner.h:25-34``)
+    storage: str = "memory"
 
 
 def _item_nbytes(item: Any) -> int:
@@ -126,6 +130,23 @@ class SetStore:
         # sets whose items include a shared-pool tensor (dedup/pool.py)
         # — keeps pool-bytes accounting O(pooled sets)
         self._pooled: set = set()
+        # ONE shared page arena for every paged set (the reference has
+        # one shared-memory pool per worker); lazy — most processes
+        # never create a paged set
+        self._page_store = None
+
+    def page_store(self):
+        """The shared :class:`PagedTensorStore` backing every
+        ``storage="paged"`` set, created on first use with the
+        configured pool cap (``config.page_pool_bytes``)."""
+        with self._lock:
+            if self._page_store is None:
+                from netsdb_tpu.storage.paged import PagedTensorStore
+
+                self._page_store = PagedTensorStore(
+                    self.config,
+                    pool_bytes=self.config.page_pool_bytes)
+            return self._page_store
 
     # --- set lifecycle ------------------------------------------------
     @_locked
@@ -135,11 +156,15 @@ class SetStore:
         persistence: str = "transient",
         eviction: str = "lru",
         placement: Optional[Any] = None,
+        storage: str = "memory",
     ) -> None:
+        if storage not in ("memory", "paged"):
+            raise ValueError(f"storage must be 'memory' or 'paged', "
+                             f"got {storage!r}")
         if ident not in self._sets:
             self._sets[ident] = _StoredSet(
                 ident=ident, items=[], persistence=persistence, eviction=eviction,
-                last_access=time.time(), placement=placement,
+                last_access=time.time(), placement=placement, storage=storage,
             )
         elif placement is not None:
             s = self._sets[ident]
@@ -151,12 +176,17 @@ class SetStore:
         s = self._sets.get(ident)
         return s.placement if s is not None else None
 
+    def storage_of(self, ident: SetIdentifier) -> str:
+        s = self._sets.get(ident)
+        return s.storage if s is not None else "memory"
+
     def exists(self, ident: SetIdentifier) -> bool:
         return ident in self._sets or os.path.exists(self._spill_path(ident))
 
     @_locked
     def remove_set(self, ident: SetIdentifier) -> None:
-        self._sets.pop(ident, None)
+        s = self._sets.pop(ident, None)
+        self._drop_paged_items(s)
         path = self._spill_path(ident)
         if os.path.exists(path):
             os.remove(path)
@@ -165,8 +195,22 @@ class SetStore:
     def clear_set(self, ident: SetIdentifier) -> None:
         s = self._sets.get(ident)
         if s is not None:
+            self._drop_paged_items(s)
             s.items = []
             s.nbytes = 0
+
+    @staticmethod
+    def _drop_paged_items(s: Optional[_StoredSet]) -> None:
+        """Return a dropped paged relation's pages to the shared capped
+        arena — without this, remove/clear of paged sets would leak
+        dead pages against ``page_pool_bytes`` until process restart."""
+        if s is None or not s.items:
+            return
+        from netsdb_tpu.relational.outofcore import PagedColumns
+
+        for item in s.items:
+            if isinstance(item, PagedColumns):
+                item.drop()
 
     @_locked
     def list_sets(self) -> List[SetIdentifier]:
@@ -178,6 +222,9 @@ class SetStore:
         s = self._require(ident)
         if s.alias_of is not None:
             raise ValueError(f"set {ident} aliases {s.alias_of}; it is read-only")
+        if s.storage == "paged":
+            self._ingest_paged(s, items)
+            return
         if s.items is None:  # evicted to disk: reload before appending
             self._load_from_spill(s)
         if s.placement is not None:
@@ -186,6 +233,44 @@ class SetStore:
         s.nbytes += sum(_item_nbytes(i) for i in items)
         s.last_access = time.time()
         self._maybe_evict(exclude=ident)
+
+    def _ingest_paged(self, s: _StoredSet, items: List[Any]) -> None:
+        """Route a relation into the page arena instead of RAM — the set
+        property the reference expresses by EVERY set living in pages
+        (``PangeaStorageServer.h:31-52``); here only sets that opt into
+        streaming pay the page granularity. One relation per paged set
+        (matching ``send_table`` semantics); re-ingest replaces."""
+        from netsdb_tpu.relational.outofcore import PagedColumns
+        from netsdb_tpu.relational.table import ColumnTable
+
+        if len(items) != 1:
+            raise ValueError(f"paged set {s.ident} holds exactly one "
+                             f"relation; got {len(items)} items")
+        item = items[0]
+        if isinstance(item, PagedColumns):
+            s.items = [item]
+            return
+        if not isinstance(item, ColumnTable):
+            raise TypeError(f"paged set {s.ident} ingests ColumnTables; "
+                            f"got {type(item).__name__}")
+        # page row count sized to the configured page bytes (floor 64 so
+        # tiny test pages still hold whole rows); for placed sets,
+        # rounded to the shard granularity so streamed chunks mesh-shard
+        # with no second padding round
+        width = max(len(item.cols), 1)
+        row_block = max(self.config.page_size_bytes // (4 * width), 64)
+        if s.placement is not None:
+            div = s.placement.axis_size()
+            row_block = -(-row_block // div) * div
+        cols = {n: np.asarray(item[n]) for n in item.cols if n != "_rowid"}
+        if item.valid is not None:
+            keep = np.asarray(item.mask())
+            cols = {n: c[keep] for n, c in cols.items()}
+        pc = PagedColumns.ingest(self.page_store(), str(s.ident), cols,
+                                 row_block=row_block, dicts=dict(item.dicts))
+        s.items = [pc]
+        s.nbytes = 0  # pages are accounted (and capped) by the arena
+        s.last_access = time.time()
 
     @_locked
     def put_tensor(self, ident: SetIdentifier, tensor: BlockedTensor) -> None:
@@ -274,6 +359,12 @@ class SetStore:
     def flush(self, ident: SetIdentifier) -> str:
         """Write a set durably to disk (keeps it in RAM)."""
         s = self._require(ident)
+        if s.storage == "paged":
+            # pages already persist through the arena's own spill files
+            # (native/pagestore.cpp); the .pdbset path would pickle a
+            # live store handle
+            raise ValueError(f"set {ident} is paged; its pages persist "
+                             f"via the page store, not .pdbset flush")
         items = self.get_items(ident)
         path = self._spill_path(ident)
         payload = []
@@ -416,7 +507,7 @@ class SetStore:
         candidates = [
             s for s in self._sets.values()
             if s.items is not None and s.ident != exclude and s.nbytes > 0
-            and s.alias_of is None
+            and s.alias_of is None and s.storage != "paged"
         ]
         # Policy per set; mixed policies resolved by sorting key.
         def key(s: _StoredSet):
@@ -465,4 +556,5 @@ class SetStore:
             "persistence": s.persistence,
             "alias_of": str(s.alias_of) if s.alias_of else None,
             "placement": s.placement.label() if s.placement is not None else None,
+            "storage": s.storage,
         }

@@ -107,10 +107,17 @@ class ConvFusionPipeline:
 
     # -- setup / load ---------------------------------------------------
 
-    def setup(self, client: Client) -> None:
+    def setup(self, client: Client, placements=None) -> None:
+        """``placements``: set name → Placement. The compute-heavy sets
+        are ``image_flat`` (windows × flatwidth — row-shard on ``data``)
+        and ``kernel_flat`` (replicate: it is the broadcast join side);
+        the record sets (``images``/``kernels``) are host objects and
+        ignore placement, exactly like the reference's pre-flatten
+        stages running on the scan threads."""
         client.create_database(self.db)
         for s in self.SETS:
-            client.create_set(self.db, s)
+            client.create_set(self.db, s,
+                              placement=(placements or {}).get(s))
 
     def load(self, client: Client, images: np.ndarray, kernels: np.ndarray,
              bias: Optional[np.ndarray] = None) -> None:

@@ -63,3 +63,30 @@ def gmm_em(points: jax.Array, k: int, iters: int = 20,
 def gmm_log_likelihood(points: jax.Array, state: GMMState) -> jax.Array:
     return jnp.mean(jax.scipy.special.logsumexp(_log_prob(points, state),
                                                 axis=1))
+
+
+def gmm_on_set(client, db: str, set_name: str, k: int, iters: int = 20,
+               out_set: str = "gmm_state", seed: int = 0
+               ) -> Tuple[GMMState, jax.Array]:
+    """Set-oriented driver: points come from a stored tensor set, so a
+    ``create_set(placement=...)``-sharded points set runs the whole EM
+    distributed — jit sees the stored sharding and XLA psums the
+    responsibilities (the reference runs every workload against
+    partitioned sets by construction, ``QuerySchedulerServer.cc:216-330``).
+    Means/variances/weights are written back stacked as one tensor set."""
+    import numpy as np
+
+    from netsdb_tpu.core.blocked import BlockedTensor
+    from netsdb_tpu.storage.store import SetIdentifier
+
+    pts = client.get_tensor(db, set_name)
+    state, resp = jax.jit(
+        lambda p: gmm_em(p, k, iters, seed=seed))(pts.to_dense())
+    if not client.set_exists(db, out_set):
+        client.create_set(db, out_set)
+    packed = jnp.concatenate(
+        [state.means, state.variances, state.weights[:, None]], axis=1)
+    client.store.put_tensor(
+        SetIdentifier(db, out_set),
+        BlockedTensor.from_dense(np.asarray(packed), pts.meta.block_shape))
+    return state, resp

@@ -53,3 +53,27 @@ def lda_perplexity(counts: jax.Array, state: LDAState) -> jax.Array:
     probs = jnp.maximum(state.doc_topic @ state.topic_word, 1e-12)
     ll = jnp.sum(counts * jnp.log(probs))
     return jnp.exp(-ll / jnp.maximum(counts.sum(), 1.0))
+
+
+def lda_on_set(client, db: str, set_name: str, k: int, iters: int = 50,
+               alpha: float = 0.1, beta: float = 0.01,
+               out_set: str = "lda_topics", seed: int = 0) -> LDAState:
+    """Set-oriented driver: the (docs × vocab) count matrix comes from a
+    stored tensor set; a row-sharded placement distributes the EM (the
+    matmuls against φ/θ contract over the sharded axis — XLA inserts
+    the psums). φ (topic-word) is written back as the output set."""
+    import numpy as np
+
+    from netsdb_tpu.core.blocked import BlockedTensor
+    from netsdb_tpu.storage.store import SetIdentifier
+
+    counts = client.get_tensor(db, set_name)
+    state = jax.jit(lambda c: lda_em(c, k, iters, alpha, beta,
+                                     seed=seed))(counts.to_dense())
+    if not client.set_exists(db, out_set):
+        client.create_set(db, out_set)
+    client.store.put_tensor(
+        SetIdentifier(db, out_set),
+        BlockedTensor.from_dense(np.asarray(state.topic_word),
+                                 counts.meta.block_shape))
+    return state

@@ -243,6 +243,26 @@ def build_three_way_join(db: str = "reddit") -> WriteSet:
     return WriteSet(cas, db, "full_features")
 
 
+def build_three_way_join_device(db: str = "reddit") -> WriteSet:
+    """The SAME three-way Comment⋈Author⋈Sub as a device-engine DAG:
+    sets created with ``type_name="objects"`` columnarize at ingest
+    (string keys dictionary-encode), and ``Join(on=...)`` lowers each
+    string-key equi-join to one device LUT gather
+    (``relational.autojoin.equijoin``) — the automatic routing round 3
+    only offered as hand calls. Output: one ColumnTable extending
+    comments with the gathered author/sub columns (reference
+    ``RedditThreeWayJoin.h:12-30``; per-tuple String hash probes
+    ``JoinPairArray.h:122`` re-priced as code gathers)."""
+    comments = ScanSet(db, "comments")
+    ca = Join(comments, ScanSet(db, "authors"),
+              on=("author", "author"), take=("author_id", "karma"),
+              label="comment_author_dev")
+    cas = Join(ca, ScanSet(db, "subs"),
+               on=("subreddit_id", "id"), take=("subscribers",),
+               label="three_way_dev")
+    return WriteSet(cas, db, "full_features_table")
+
+
 def label_selection(db: str, positive: bool) -> WriteSet:
     """Reference ``RedditPositiveLabelSelection`` /
     ``RedditNegativeLabelSelection`` — filter comments by label."""

@@ -187,6 +187,46 @@ def three_way_join(tables: Dict[str, ColumnTable]
     return out, batch_features(ct)
 
 
+def three_way_sink_for(client, db: str = "redditc",
+                       output_set: str = "full_features"):
+    """The three-way Comment⋈Author⋈Sub as a Computation DAG over
+    STORED sets — the placed-set replacement for
+    ``sharded_three_way(tables, mesh)``'s hand-mesh surface: create
+    ``comments`` with a row-sharding Placement and ``authors``/``subs``
+    replicated (or unplaced), and the SAME DAG runs distributed —
+    statistics come from ``analyze_set`` summaries, shardings from the
+    sets, collectives from XLA (``QuerySchedulerServer.cc:216-330``).
+    Output: the joined relation (comment cols + karma + subscribers)."""
+    import hashlib
+
+    from netsdb_tpu.plan.computations import Join, ScanSet, WriteSet
+    from netsdb_tpu.relational.dag import _fold_mask
+    from netsdb_tpu.relational.stats import inject_stats
+
+    names = ("comments", "authors", "subs")
+    captured = {n: client.analyze_set(db, n)["stats"] for n in names}
+    stats_tag = hashlib.blake2s(repr(sorted(
+        (n, sorted((c, s.n_rows, s.min_val, s.max_val)
+                   for c, s in cs.items()))
+        for n, cs in captured.items())).encode()).hexdigest()[:12]
+
+    def run(pair, st: ColumnTable) -> ColumnTable:
+        ct, at = pair
+        tabs = {"comments": inject_stats(_fold_mask(ct),
+                                         captured["comments"]),
+                "authors": inject_stats(_fold_mask(at),
+                                        captured["authors"]),
+                "subs": inject_stats(_fold_mask(st), captured["subs"])}
+        out, _ = three_way_join(tabs)
+        return out
+
+    node = Join(Join(ScanSet(db, "comments"), ScanSet(db, "authors"),
+                     fn=lambda a, b: (a, b), label="gather:authors"),
+                ScanSet(db, "subs"), fn=run,
+                label=f"reddit3way:{stats_tag}")
+    return WriteSet(node, db, output_set)
+
+
 def sharded_three_way(tables: Dict[str, ColumnTable], mesh, axis="data",
                       slack: float = 2.0):
     """The distributed form: comments fact-sharded; each dimension side

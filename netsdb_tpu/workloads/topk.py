@@ -34,3 +34,29 @@ def top_k_on_set(client, db: str, set_name: str, k: int,
     client.clear_set(db, out_set)
     client.send_data(db, out_set, winners)
     return winners
+
+
+def top_k_on_table_set(client, db: str, set_name: str, score_col: str,
+                       k: int, out_set: str = "topk_table"):
+    """Placed-set driver: scores live in a stored ColumnTable column,
+    so a sharded set top-ks on device (one `top_k_masked` over the
+    sharded column — XLA all-gathers the k winners, not the set). The
+    result is a k-row relation {row, score} like the reference's TopK
+    output set."""
+    import jax.numpy as jnp
+    import numpy as np
+
+    from netsdb_tpu.relational import kernels as K
+    from netsdb_tpu.relational.table import ColumnTable
+
+    t = client.get_table(db, set_name)
+    scores = t[score_col]
+    kk = min(k, scores.shape[0])
+    idx, ok = K.top_k_masked(scores, kk, t.mask())
+    out = ColumnTable({"row": idx, "score": jnp.take(scores, idx)},
+                      valid=ok)
+    if not client.set_exists(db, out_set):
+        client.create_set(db, out_set, type_name="table")
+    client.clear_set(db, out_set)
+    client.send_data(db, out_set, [out])
+    return out

@@ -212,3 +212,31 @@ def bench_tpch_bench(n_customers: int = 100_000, max_orders: int = 4,
             "parts": n_parts,
             "jaccard_ms": None if dt is None else round(dt * 1e3, 3),
             "below_noise": dt is None}
+
+
+def queries_on_sets(client, db: str = "tpchbench", threshold: int = 0,
+                    segment: str = "BUILDING",
+                    query_parts: Sequence[int] = (0,), k: int = 5):
+    """Placed-set entry point: the whole micro-family against STORED
+    sets — with ``customers``/``triples`` created under a row-sharding
+    Placement the same kernels run distributed (XLA inserts the
+    segment-psums; placement padding folds to -1 keys and drops by the
+    orphan rule). Returns {selections, pair_counts, per_supplier,
+    count, top_jaccard} — the shapes the benchmark's checks consume."""
+    from netsdb_tpu.relational.dag import _fold_mask
+    from netsdb_tpu.relational.stats import analyze_table, inject_stats
+
+    raw = {n: client.get_table(db, n) for n in ("customers", "triples")}
+    cust_mask = raw["customers"].mask()
+    tables = {n: inject_stats(_fold_mask(t), analyze_table(t))
+              for n, t in raw.items()}
+    sels = tuple(m & cust_mask
+                 for m in selections(tables, threshold, segment))
+    pair, per = group_by_supplier(tables)
+    return {
+        "selections": sels,
+        "pair_counts": pair,
+        "per_supplier": per,
+        "count": int(jnp.sum(cust_mask)),
+        "top_jaccard": top_jaccard(tables, list(query_parts), k),
+    }

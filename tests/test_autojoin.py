@@ -81,3 +81,49 @@ def test_three_way_string_join_chain():
                    by_sub[c.subreddit_id].subscribers) for c in comments)
     got = sorted((r["id"], r["karma"], r["subscribers"]) for r in rows)
     assert got == want
+
+
+# ------------------------------------------- round-4: wired into the plan
+def test_three_way_join_device_dag_matches_host(tmp_path):
+    """The reddit string-key Computation DAG (not a hand call) runs on
+    the device engine: objects-typed sets columnarize at ingest, the
+    Join nodes carry `on=` column keys, and the result matches the
+    host-object plan path row for row."""
+    from netsdb_tpu.client import Client
+    from netsdb_tpu.config import Configuration
+
+    comments, authors, subs = _data()
+
+    # host-object oracle through the interpreter plan path
+    host = Client(Configuration(root_dir=str(tmp_path / "host")))
+    host.create_database("reddit")
+    for name, items in (("comments", comments), ("authors", authors),
+                        ("subs", subs)):
+        host.create_set("reddit", name, type_name="host")
+        host.send_data("reddit", name, items)
+    host_rows = next(iter(host.execute_computations(
+        R.build_three_way_join("reddit")).values()))
+    want = sorted((f.index, f.author_id, f.sub_id) for f in host_rows)
+
+    # device DAG over objects-typed (auto-columnarized) sets
+    dev = Client(Configuration(root_dir=str(tmp_path / "dev")))
+    dev.create_database("reddit")
+    for name, items in (("comments", comments), ("authors", authors),
+                        ("subs", subs)):
+        dev.create_set("reddit", name, type_name="objects")
+        dev.send_data("reddit", name, items)
+    # ingest columnarized: the stored set holds ONE dictionary-encoded table
+    stored = dev.get_table("reddit", "comments")
+    assert "author" in stored.dicts
+    out = next(iter(dev.execute_computations(
+        R.build_three_way_join_device("reddit")).values()))
+    rows = out.to_rows()
+    got = sorted((r["index"], r["author_id"], r["subreddit_id"])
+                 for r in rows)
+    assert got == want
+    # gathered columns came from the right tables
+    karma = {a.author_id: a.karma for a in authors}
+    subscribers = {s.id: s.subscribers for s in subs}
+    for r in rows:
+        assert r["karma"] == karma[r["author_id"]]
+        assert r["subscribers"] == subscribers[r["subreddit_id"]]

@@ -1,0 +1,112 @@
+"""Server-side ANALYZE — round-4 item 5 (plus paged sets over the wire).
+
+The reference collects statistics where the data lives and ships only
+the summaries to the planner (``StorageCollectStats``,
+``src/serverFunctionalities/headers/PangeaStorageServer.h:48``). These
+tests pin the TPU-native equivalent: ``ANALYZE_SET`` computes
+daemon-side; building ALL TEN suite sinks through a RemoteClient sends
+only ANALYZE_SET frames (no table pulls); and a paged set behind the
+daemon streams its queries server-side.
+"""
+
+import numpy as np
+import pytest
+
+import jax
+
+from netsdb_tpu.client import Client
+from netsdb_tpu.config import Configuration
+from netsdb_tpu.relational import dag as rdag
+from netsdb_tpu.relational.queries import tables_from_rows
+from netsdb_tpu.relational.stats import analyze_table
+from netsdb_tpu.serve.client import RemoteClient
+from netsdb_tpu.serve.protocol import MsgType
+from netsdb_tpu.serve.server import ServeController
+from netsdb_tpu.workloads import tpch
+
+
+@pytest.fixture(scope="module")
+def tables():
+    return tables_from_rows(tpch.generate(scale=4, seed=9))
+
+
+@pytest.fixture()
+def served(tmp_path, tables):
+    config = Configuration(root_dir=str(tmp_path / "served"),
+                           page_size_bytes=4096, page_pool_bytes=16384)
+    ctl = ServeController(config, port=0)
+    port = ctl.start()
+    c = RemoteClient(f"127.0.0.1:{port}")
+    c.create_database("d")
+    for name, t in tables.items():
+        c.create_set("d", name, type_name="table",
+                     storage="paged" if name == "lineitem" else "memory")
+        c.send_table("d", name, t)
+    yield ctl, c
+    c.close()
+    ctl.shutdown()
+
+
+def test_analyze_set_matches_local(served, tables):
+    _, c = served
+    info = c.analyze_set("d", "orders")
+    local = analyze_table(tables["orders"])
+    assert info["num_rows"] == tables["orders"].num_rows
+    for col, s in local.items():
+        assert info["stats"][col].key_space == s.key_space
+        assert info["stats"][col].min_val == s.min_val
+    assert info["dicts"]["o_orderpriority"] == \
+        tables["orders"].dicts["o_orderpriority"]
+
+
+def test_suite_sinks_build_with_stats_only(served, monkeypatch):
+    """Building every suite sink over the daemon transfers ONLY
+    ANALYZE_SET request frames — the tables never cross the wire."""
+    _, c = served
+    sent = []
+    orig = RemoteClient._request
+
+    def spy(self, msg_type, payload, codec=0, **kw):
+        sent.append(MsgType(msg_type))
+        return orig(self, msg_type, payload, codec=codec, **kw)
+
+    monkeypatch.setattr(RemoteClient, "_request", spy)
+    for qname in ("q01", "q02", "q03", "q04", "q06", "q12", "q13",
+                  "q14", "q17", "q22"):
+        rdag.suite_sink_for(c, "d", qname)
+    assert sent and set(sent) == {MsgType.ANALYZE_SET}, set(sent)
+
+
+def test_suite_sink_executes_remotely_with_paged_fact(served, tables,
+                                                      tmp_path):
+    """The stats-built sink ships to the daemon and runs there — with
+    the fact set paged, the daemon streams it through the fold."""
+    ctl, c = served
+    # local oracle
+    cfg = Configuration(root_dir=str(tmp_path / "local"))
+    lc = Client(cfg)
+    lc.create_database("d")
+    for name, t in tables.items():
+        lc.create_set("d", name, type_name="table")
+        lc.send_table("d", name, t)
+    for qname in ("q01", "q14"):
+        ref = jax.device_get(rdag.run_query(
+            lc, rdag.suite_sink_for(lc, "d", qname)))
+        c.execute_computations(rdag.suite_sink_for(c, "d", qname),
+                               job_name=f"remote-{qname}")
+        got = [np.asarray(x) if not hasattr(x, "cols") else x
+               for x in c.get_set_iterator("d", f"{qname}_out")]
+        assert len(got) == len(ref)
+        for a, b in zip(got, ref):
+            np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                       rtol=1e-4, atol=1e-3)
+    st = ctl.library.store.page_store().stats()
+    assert st["spills"] > 0  # the daemon really ran out-of-core
+
+
+def test_remote_get_table_materializes_paged(served, tables):
+    _, c = served
+    t = c.get_table("d", "lineitem")
+    np.testing.assert_array_equal(
+        np.sort(np.asarray(t["l_orderkey"])),
+        np.sort(np.asarray(tables["lineitem"]["l_orderkey"])))
