@@ -247,6 +247,12 @@ class PageStore {
     // tracks LIVE pages, resident or spilled — not cumulative allocs);
     // without this, freed sets would count against the pool forever
     stats_.bytes_allocated -= p->cap;
+    if (p->on_disk) {
+      // page ids are never reused (next_page_ is monotonic), so a
+      // freed page's spill file would otherwise leak until the disk
+      // fills under create/stream/remove churn
+      ::remove(spill_path(p).c_str());
+    }
     auto& vec = sets_[p->set_id].pages;
     vec.erase(std::remove(vec.begin(), vec.end(), page_id), vec.end());
     delete p;

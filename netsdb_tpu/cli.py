@@ -450,6 +450,38 @@ def _cmd_selftest(args) -> int:
                   np.asarray(pooled["b"].assemble().data), variant)),
               "dedup pool shares identical blocks, assembly exact")
 
+    def paged_set_api():  # round 4: out-of-core as a SET property
+        import tempfile
+
+        from netsdb_tpu.relational import dag as rdag
+        from netsdb_tpu.relational.queries import cq06, tables_from_rows
+        from netsdb_tpu.workloads import tpch
+
+        tabs = tables_from_rows(tpch.generate(scale=4, seed=2))
+        pc = Client(Configuration(
+            root_dir=tempfile.mkdtemp(prefix="st_paged_"),
+            page_size_bytes=4096, page_pool_bytes=16384))
+        pc.create_database("d")
+        for n, t in tabs.items():
+            pc.create_set("d", n, type_name="table",
+                          storage="paged" if n == "lineitem" else "memory")
+            pc.send_table("d", n, t)
+        out = rdag.run_query(pc, rdag.q06_sink("d"))
+        ref = dict(cq06(tabs))["revenue"]
+        st = pc.store.page_store().stats()
+        check(abs(float(np.asarray(out["revenue"])[0]) - ref)
+              <= 1e-5 * max(abs(ref), 1) and st["spills"] > 0,
+              "paged q06 streams (spills>0) and matches resident")
+
+    def placement_arm():  # round 4: the advisor decides SHARDING
+        from netsdb_tpu.learning.ab_bench import bench_distribution_ab
+
+        out = bench_distribution_ab(scale=4, rounds=2,
+                                    advisor_kind="rule")
+        check(len(out["applied"]) == 2
+              and all(v is not None for v in out["mean_s"].values()),
+              "placement arms applied by create_set and measured")
+
     steps = [("selection", selection), ("aggregation", aggregation),
              ("lda", lda), ("ff", ff), ("lstm", lstm), ("conv", conv),
              ("tpch-columnar", tpch_columnar), ("pdml", pdml),
@@ -457,7 +489,9 @@ def _cmd_selftest(args) -> int:
              ("out-of-core", outofcore),
              ("reddit-columnar", reddit_columnar),
              ("placement-api", placement_api), ("ooc-join", ooc_join),
-             ("autojoin", autojoin), ("dedup-pool", dedup_pool)]
+             ("autojoin", autojoin), ("dedup-pool", dedup_pool),
+             ("paged-set-api", paged_set_api),
+             ("placement-arm", placement_arm)]
     for name, fn in steps:
         step(name, fn)
     print(f"{len(steps) - len(failures)}/{len(steps)} passed")

@@ -26,6 +26,16 @@ def _ident(db: str, set_name: str) -> SetIdentifier:
     return SetIdentifier(db, set_name)
 
 
+def table_info(table) -> Dict[str, Any]:
+    """The analyze-set summary for one resident ColumnTable — the ONE
+    place its shape is defined (Client.analyze_set and the daemon's
+    ANALYZE_SET handler both build it here, so they cannot diverge)."""
+    from netsdb_tpu.relational.stats import analyze_table
+
+    return {"stats": dict(analyze_table(table)),
+            "dicts": dict(table.dicts), "num_rows": table.num_rows}
+
+
 class Client:
     """Facade over catalog + storage + execution.
 
@@ -159,6 +169,15 @@ class Client:
             spec = arm.specs.get("placement") or arm.specs.get(set_name)
             if isinstance(spec, Placement):
                 placement = spec
+                # the arm's placement is the configuration actually in
+                # force for this DDL — stash it so job timings record
+                # against it (same discipline as the block-shape arms
+                # below) and audit the decision
+                self._advisor_arm = arm
+                self._advisor.db.record(
+                    f"{self._advisor_key}:decisions",
+                    plan_key=f"set:{db}.{set_name}", elapsed_s=0.0,
+                    config_label=arm.label)
         if placement is not None:
             meta["sharding"] = placement.to_meta()
             self._mesh = placement.mesh()
@@ -240,12 +259,16 @@ class Client:
             from netsdb_tpu.relational.table import ColumnTable
 
             new = table_from_objects(list(items))
-            existing = [i for i in self.store.get_items(ident)
-                        if isinstance(i, ColumnTable)]
-            if existing:  # append: device concat + dictionary remap
-                new = concat_tables(existing[0], new)
-            self.store.clear_set(ident)
-            self.store.add_data(ident, [new])
+
+            def append(existing_items):
+                tables = [i for i in existing_items
+                          if isinstance(i, ColumnTable)]
+                # append = device concat + dictionary remap; runs
+                # atomically under the store lock (update_set), so
+                # concurrent senders cannot lose each other's batch
+                return [concat_tables(tables[0], new) if tables else new]
+
+            self.store.update_set(ident, append)
             return
         self.store.add_data(ident, list(items))
 
@@ -331,16 +354,13 @@ class Client:
         DAG builders consume these summaries instead of pulling tables
         (``relational/dag.py``)."""
         from netsdb_tpu.relational.outofcore import PagedColumns
-        from netsdb_tpu.relational.stats import analyze_table
 
         items = self.store.get_items(_ident(db, set_name))
         if len(items) == 1 and isinstance(items[0], PagedColumns):
             pc = items[0]
             return {"stats": dict(pc.stats), "dicts": dict(pc.dicts),
                     "num_rows": pc.num_rows}
-        t = self.get_table(db, set_name)
-        return {"stats": dict(analyze_table(t)), "dicts": dict(t.dicts),
-                "num_rows": t.num_rows}
+        return table_info(self.get_table(db, set_name))
 
     def get_tensor(self, db: str, set_name: str) -> BlockedTensor:
         return self.store.get_tensor(_ident(db, set_name))

@@ -16,14 +16,17 @@ HBM level:
 - Exactly-equal blocks collapse to ONE slot in a stacked device pool
   array ``(P, bh, bw)``; each model keeps an int32 slot grid.
 - A :class:`PooledTensor` stored in a set assembles back to its
-  ``BlockedTensor`` on access (one eager device gather + reshape): the
-  dense copy is a TRANSIENT that lives only while the consuming job
-  holds it — steady-state HBM is the pool once plus slot grids, not one
-  dense copy per model, which is what the reference's shared pages buy.
-  (Peak HBM during a job = pool + the dense copies of the models that
-  job reads; re-reads re-pay the gather. The alternative — tracing
-  pool+slots into every consumer jit — would save the transient but
-  couple every consumer's signature to pooling; not done.)
+  ``BlockedTensor`` on access (one device gather + reshape), and the
+  assembly is CACHED on the PooledTensor: consecutive jobs reading the
+  same pooled model reuse one dense copy instead of re-gathering
+  (``assembly_count`` pins this in tests). The cache is dropped under
+  store memory pressure (``SetStore._maybe_evict`` calls
+  ``drop_pool_caches`` before spilling anything) and by ``drop_cache``
+  — steady-state HBM then returns to the pool once plus slot grids,
+  which is what the reference's shared pages buy. (The alternative —
+  tracing pool+slots into every consumer jit — would avoid the dense
+  copy entirely but couple every consumer's signature to pooling; not
+  done.)
 
 Only bit-identical blocks share a slot: assembly is exact, so inference
 for every pooled model is unchanged to the bit.
@@ -68,8 +71,14 @@ class PooledTensor:
         self.pool = pool
         self.slots = np.asarray(slots, np.int32)  # (gh, gw)
         self.meta = meta
+        self._cache: Optional[BlockedTensor] = None
+        self.assembly_count = 0  # gathers actually performed (tests pin
+        # that consecutive reads don't re-gather)
 
     def assemble(self) -> BlockedTensor:
+        if self._cache is not None:
+            return self._cache
+        self.assembly_count += 1
         gh, gw = self.slots.shape
         bh, bw = self.meta.block_shape
         picked = jnp.take(self.pool.blocks,
@@ -77,7 +86,17 @@ class PooledTensor:
         dense = picked.reshape(gh, gw, bh, bw).transpose(0, 2, 1, 3
                                                         ).reshape(gh * bh,
                                                                   gw * bw)
-        return BlockedTensor(dense, self.meta)
+        self._cache = BlockedTensor(dense, self.meta)
+        return self._cache
+
+    def drop_cache(self) -> int:
+        """Release the cached assembly (memory-pressure hook); returns
+        the bytes released. Steady-state HBM falls back to pool+slots."""
+        if self._cache is None:
+            return 0
+        released = int(self._cache.data.nbytes)
+        self._cache = None
+        return released
 
     @property
     def nbytes_resident(self) -> int:

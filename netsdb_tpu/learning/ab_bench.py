@@ -21,7 +21,7 @@ from __future__ import annotations
 import shutil
 import tempfile
 import time
-from typing import Dict
+from typing import Dict, Optional
 
 import numpy as np
 
@@ -126,4 +126,147 @@ def bench_placement_ab(width: int = 1100, batch: int = 4096,
         by_mean = min((v, k) for k, v in means.items()
                       if v is not None)[1]
         out["converged"] = winner == by_mean
+    return out
+
+
+def _converged(winner: str, means: Dict[str, Optional[float]],
+               noise_frac: float = 0.25) -> bool:
+    """DRL convergence check under the measurement-noise discipline
+    (r2 lesson, utils.timing): the greedy choice must match the
+    measured-mean winner UNLESS the arm means are within ``noise_frac``
+    of each other — statistically indistinguishable arms make either
+    choice correct (both-below-noise = undecidable, not a failure)."""
+    vals = {k: v for k, v in means.items() if v is not None}
+    if winner not in vals:
+        return False  # greedy picked an arm that was never measured
+    by_mean = min(vals, key=vals.get)
+    if winner == by_mean:
+        return True
+    lo = vals[by_mean]
+    return vals[winner] <= lo * (1.0 + noise_frac)
+
+
+# --------------------------------------------- distribution A/B (arms
+# carrying Placements — Lachesis choosing SHARDING, the interesting
+# decision variable on a TPU mesh)
+def distribution_candidates():
+    """Replicated vs row-sharded dimension table over all devices —
+    the broadcast-join-vs-repartition decision as advisor arms
+    (``arm.specs["placement"]`` consumed by ``Client.create_set``)."""
+    from netsdb_tpu.parallel.placement import Placement
+
+    return (
+        PlacementCandidate("dim_replicated", (1,),
+                           {"placement": Placement((("data", 0),),
+                                                   (None,))}),
+        PlacementCandidate("dim_rowsharded", (1,),
+                           {"placement": Placement((("data", 0),),
+                                                   ("data",))}),
+    )
+
+
+def bench_distribution_ab(scale: int = 16, rounds: int = 4,
+                          history_path: str = ":memory:",
+                          seed: int = 0,
+                          advisor_kind: str = "rule") -> Dict[str, object]:
+    """Live A/B where the advisor decides a SET'S PLACEMENT: each round
+    creates the TPC-H ``orders`` set with NO explicit placement — the
+    installed advisor's arm supplies one (replicated = broadcast join,
+    or row-sharded = repartitioned build) — then runs the q12 suite
+    DAG distributed over the placed sets and records the measured wall
+    time against the arm that was actually applied (the reference's
+    RLClient driving live scheduling, ``RLClient.h:18-38``).
+
+    Needs a multi-device mesh to have signal (on one chip every
+    placement degrades to the trivial mesh); the test suite runs it on
+    the virtual 8-device CPU mesh."""
+    from netsdb_tpu.parallel.placement import Placement
+    from netsdb_tpu.relational import dag as rdag
+    from netsdb_tpu.relational.queries import tables_from_rows
+    from netsdb_tpu.storage.store import SetIdentifier
+    from netsdb_tpu.workloads import tpch
+
+    hdb = HistoryDB(history_path)
+    cands = list(distribution_candidates())
+    if advisor_kind == "drl":
+        from netsdb_tpu.learning.rl import DRLPlacementAdvisor
+
+        advisor = DRLPlacementAdvisor(cands, hdb, seed=seed)
+    elif advisor_kind == "rule":
+        advisor = PlacementAdvisor(cands, hdb)
+    else:
+        raise ValueError(f"advisor_kind must be 'rule' or 'drl', "
+                         f"got {advisor_kind!r}")
+    job = "ab-distribution"
+    tables = tables_from_rows(tpch.generate(scale=scale, seed=seed))
+    chosen = []
+    applied_labels = []
+    # one STABLE compile cache across rounds (same discipline as
+    # bench_placement_ab): without it the explore rounds measure cold
+    # compiles, not placements — the r2 autotune noise trap
+    import os
+
+    uid = os.getuid() if hasattr(os, "getuid") else "u"
+    cache_dir = os.path.join(tempfile.gettempdir(),
+                             f"netsdb_ab_cache_{uid}")
+
+    def one_round(placement_override=None):
+        """One job under either an explicit placement (warmup) or the
+        advisor's choice (measured). The fact placement is EXPLICIT
+        (always row-sharded); the advisor only decides the dimension
+        set. Returns (applied arm, placement label, elapsed)."""
+        from netsdb_tpu.parallel.placement import Placement as _P
+
+        root = tempfile.mkdtemp(prefix="ab_dist_")
+        try:
+            client = Client(Configuration(
+                root_dir=root, compilation_cache_dir=cache_dir))
+            if placement_override is None:
+                client.set_placement_advisor(advisor, key=job)
+            client.create_database("d")
+            client.create_set("d", "lineitem", type_name="table",
+                              placement=_P.data_parallel(ndim=1))
+            client.create_set("d", "orders", type_name="table",
+                              placement=placement_override)
+            arm = getattr(client, "_advisor_arm", None)
+            pl = client.store.placement_of(SetIdentifier("d", "orders"))
+            for n in ("lineitem", "orders"):
+                client.send_table("d", n, tables[n])
+            sink = rdag.suite_sink_for(client, "d", "q12")
+            t0 = time.perf_counter()
+            out = client.execute_computations(sink, job_name=job)
+            import jax
+
+            jax.block_until_ready(next(iter(out.values())))
+            return (arm, pl.label() if pl is not None else None,
+                    time.perf_counter() - t0)
+        finally:
+            shutil.rmtree(root, ignore_errors=True)
+
+    # warm every arm's compiled program once, UNRECORDED: the measured
+    # rounds must compare placements, not first compiles (the r2
+    # autotune lesson — cold-compile walls are pure noise)
+    for cand in cands:
+        one_round(placement_override=cand.specs["placement"])
+    for _ in range(rounds):
+        arm, pl_label, elapsed = one_round()
+        assert arm is not None, "advisor arm was not applied"
+        applied_labels.append((arm.label, pl_label))
+        advisor.record(job, arm, elapsed)
+        chosen.append((arm.label, round(elapsed, 4)))
+
+    means = {c.label: hdb.mean_elapsed(job, c.label)
+             for c in advisor.candidates}
+    if advisor_kind == "drl":
+        winner = advisor.choose(job, explore=False).label
+    else:
+        winner = advisor.choose(job).label
+    worst = max(v for v in means.values() if v is not None)
+    best = min(v for v in means.values() if v is not None)
+    out = {"advisor": advisor_kind, "rounds": chosen, "mean_s": means,
+           "winner": winner, "applied": applied_labels,
+           "decisions_recorded": len(hdb.runs(f"{job}:decisions")),
+           "learned_speedup": round(worst / best, 2) if best else None}
+    if advisor_kind == "drl":
+        out["converged"] = _converged(winner, means)
     return out

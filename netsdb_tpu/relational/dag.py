@@ -36,6 +36,25 @@ from netsdb_tpu.relational.queries import _q01_fold
 from netsdb_tpu.relational.table import ColumnTable, date_to_int
 
 
+def _q03_filter_node(db: str, segment_code: int, d: int, jp_cust,
+                     orders_set: str, customer_set: str):
+    """The shared customer-qualified, date-qualified orders stage —
+    ONE builder for q03_sink's inline build side and q03_build_sink's
+    materialized build stage, so the two cannot diverge."""
+    from netsdb_tpu.plan.computations import Join
+    from netsdb_tpu.relational import kernels as K
+
+    def filter_orders(orders: ColumnTable, cust: ColumnTable) -> ColumnTable:
+        cust_ok = (cust["c_mktsegment"] == segment_code) & cust.mask()
+        _, chit = K.pk_fk_join(cust["c_custkey"], orders["o_custkey"],
+                               cust_ok, plan=jp_cust)
+        return orders.filter(chit & (orders["o_orderdate"] < d))
+
+    return Join(ScanSet(db, orders_set), ScanSet(db, customer_set),
+                fn=filter_orders,
+                label=f"q03filter:{segment_code}:{d}:{jp_cust.key_space}")
+
+
 def q01_sink(db: str, lineitem_set: str = "lineitem",
              delta_date: str = "1998-09-02",
              output_set: str = "q01_out") -> WriteSet:
@@ -139,17 +158,8 @@ def q03_sink(db: str, n_orders: int, n_customers: int, segment_code: int,
     jp_cust = JoinPlan("lut", n_customers)
     jp_orders = JoinPlan("lut", n_orders)
 
-    def filter_orders(orders: ColumnTable, cust: ColumnTable) -> ColumnTable:
-        from netsdb_tpu.relational import kernels as K
-
-        cust_ok = (cust["c_mktsegment"] == segment_code) & cust.mask()
-        _, chit = K.pk_fk_join(cust["c_custkey"], orders["o_custkey"],
-                               cust_ok, plan=jp_cust)
-        return orders.filter(chit & (orders["o_orderdate"] < d))
-
-    filtered = Join(ScanSet(db, orders_set), ScanSet(db, customer_set),
-                    fn=filter_orders,
-                    label=f"q03filter:{segment_code}:{d}:{n_customers}")
+    filtered = _q03_filter_node(db, segment_code, d, jp_cust,
+                                orders_set, customer_set)
     joined = Join(ScanSet(db, lineitem_set), filtered,
                   fold=q03_probe_fold(d, k, jp_orders),
                   label=f"q03join:{d}:{k}:{n_orders}")
@@ -168,23 +178,11 @@ def q03_build_sink(db: str, n_customers: int, segment_code: int,
     (:func:`q03_sink` with ``prebuilt_set=``) then probes it
     grace-hash style — the reference's build-stage/probe-stage split
     (``HermesExecutionServer.cc:901``, partitioned hash sets)."""
-    from netsdb_tpu.plan.computations import Join
     from netsdb_tpu.relational.planner import JoinPlan
 
-    d = date_to_int(date)
-    jp_cust = JoinPlan("lut", n_customers)
-
-    def filter_orders(orders: ColumnTable, cust: ColumnTable) -> ColumnTable:
-        from netsdb_tpu.relational import kernels as K
-
-        cust_ok = (cust["c_mktsegment"] == segment_code) & cust.mask()
-        _, chit = K.pk_fk_join(cust["c_custkey"], orders["o_custkey"],
-                               cust_ok, plan=jp_cust)
-        return orders.filter(chit & (orders["o_orderdate"] < d))
-
-    node = Join(ScanSet(db, orders_set), ScanSet(db, customer_set),
-                fn=filter_orders,
-                label=f"q03filter:{segment_code}:{d}:{n_customers}")
+    node = _q03_filter_node(db, segment_code, date_to_int(date),
+                            JoinPlan("lut", n_customers),
+                            orders_set, customer_set)
     return WriteSet(node, db, output_set)
 
 
@@ -305,7 +303,10 @@ def q03_rows(result: ColumnTable) -> list:
 # Which stored sets each query core scans, in its args order.
 _QUERY_TABLES = {
     "q01": ("lineitem",),
-    "q02": ("part", "partsupp", "supplier", "nation", "region"),
+    # partsupp LAST: the fact table sits at the fold node's direct
+    # input so a paged partsupp streams (suite cores read tables by
+    # NAME, so scan order is free)
+    "q02": ("part", "supplier", "nation", "region", "partsupp"),
     "q03": ("customer", "orders", "lineitem"),
     "q04": ("orders", "lineitem"),
     "q06": ("lineitem",),

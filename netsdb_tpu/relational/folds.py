@@ -116,6 +116,83 @@ def fold_q06(cap: Captured, dicts, nrows, *, d0: str = "1994-01-01",
                        step, lambda st, src: (st,))
 
 
+# ---------------------------------------------------------------- Q02
+def fold_q02(cap: Captured, dicts, nrows, *, size: int = 15,
+             type_suffix: str = "BRUSHED", region: str = "EUROPE"
+             ) -> FoldSpec:
+    """Min-cost supplier per part over a STREAMED partsupp. The
+    cross-chunk arbitration is lexicographic on (cost, global row id):
+    the chunk winner's ``_rowid`` breaks cost ties exactly like the
+    whole-table core's first-row-wins ``segment_min`` over row
+    indices, so streamed and resident outputs match array-for-array.
+    The supplier-side region chain is loop-invariant — computed once
+    in init and carried in state."""
+    jp_part = plan_from_captured(cap, nrows, "part", "p_partkey",
+                                 "partsupp", "ps_partkey")
+    jp_sup = plan_from_captured(cap, nrows, "supplier", "s_suppkey",
+                                "partsupp", "ps_suppkey")
+    jp_nat = plan_from_captured(cap, nrows, "nation", "n_nationkey",
+                                "supplier", "s_nationkey")
+    jp_reg = plan_from_captured(cap, nrows, "region", "r_regionkey",
+                                "nation", "n_regionkey")
+    n_part = jp_part.key_space
+    IMAX = jnp.iinfo(jnp.int32).max
+
+    def init(prev, src, part, sup, nat, reg):
+        part, sup, nat, reg = _fm(part), _fm(sup), _fm(nat), _fm(reg)
+        type_ok = _lut(part.dicts["p_type"],
+                       lambda s: s.endswith(type_suffix))
+        part_ok = ((part["p_size"] == size)
+                   & jnp.take(type_ok, part["p_type"]))
+        nidx, nhit = K.pk_fk_join(nat["n_nationkey"], sup["s_nationkey"],
+                                  plan=jp_nat)
+        sup_region = jnp.take(nat["n_regionkey"], nidx)
+        ridx, rhit = K.pk_fk_join(reg["r_regionkey"], sup_region,
+                                  plan=jp_reg)
+        sup_ok = (nhit & rhit
+                  & (jnp.take(reg["r_name"], ridx)
+                     == reg.code("r_name", region)))
+        return {"has": jnp.zeros((n_part,), jnp.bool_),
+                "cmin": jnp.full((n_part,), jnp.inf, jnp.float32),
+                "rowid": jnp.full((n_part,), IMAX, jnp.int32),
+                "sup_row": jnp.zeros((n_part,), jnp.int32),
+                "part_ok": part_ok, "sup_ok": sup_ok, "nidx": nidx}
+
+    def step(st, t, part, sup, nat, reg):
+        t, part, sup = _fm(t), _fm(part), _fm(sup)
+        ps_part, ps_cost = t["ps_partkey"], t["ps_supplycost"]
+        _, phit = K.pk_fk_join(part["p_partkey"], ps_part,
+                               st["part_ok"], plan=jp_part)
+        sidx, shit = K.pk_fk_join(sup["s_suppkey"], t["ps_suppkey"],
+                                  st["sup_ok"], plan=jp_sup)
+        valid = phit & shit
+        cmin_c = K.segment_min(ps_cost, ps_part, n_part, valid)
+        at_min = valid & (ps_cost == jnp.take(cmin_c, ps_part))
+        local = jnp.arange(ps_part.shape[0], dtype=jnp.int32)
+        win_local = K.segment_min(local, ps_part, n_part, at_min)
+        has_c = win_local < IMAX
+        wl = jnp.clip(win_local, 0, ps_part.shape[0] - 1)
+        rowid_c = jnp.where(has_c, jnp.take(t["_rowid"], wl), IMAX)
+        sup_row_c = jnp.where(has_c, jnp.take(sidx, wl), 0)
+        better = has_c & (~st["has"] | (cmin_c < st["cmin"])
+                          | ((cmin_c == st["cmin"])
+                             & (rowid_c < st["rowid"])))
+        return {"has": st["has"] | has_c,
+                "cmin": jnp.where(better, cmin_c, st["cmin"]),
+                "rowid": jnp.where(better, rowid_c, st["rowid"]),
+                "sup_row": jnp.where(better, sup_row_c, st["sup_row"]),
+                "part_ok": st["part_ok"], "sup_ok": st["sup_ok"],
+                "nidx": st["nidx"]}
+
+    def fin(st, src, part, sup, nat, reg):
+        has = st["has"]
+        nat_row = jnp.where(has, jnp.take(st["nidx"], st["sup_row"]), 0)
+        ints = jnp.stack([has.astype(jnp.int32), st["sup_row"], nat_row])
+        return (ints, st["cmin"])
+
+    return single_pass(init, step, fin)
+
+
 # ---------------------------------------------------------------- Q03
 def fold_q03(cap: Captured, dicts, nrows, *, segment: str = "BUILDING",
              date: str = "1995-03-15", k: int = 10) -> FoldSpec:
@@ -374,12 +451,12 @@ def fold_q22(cap: Captured, dicts, nrows,
 
 
 # ---------------------------------------------------- registry
-# qname -> (fact set name streamed when paged, fold builder). q02 has
-# no fold: its min-cost-supplier winner needs global row arbitration
-# that doesn't decompose cleanly; a paged partsupp falls back to the
-# executor's materialize path (documented in plan/executor.py).
+# qname -> (fact set name streamed when paged, fold builder). All ten
+# suite queries decompose; fold-less consumers of a paged set (host
+# DAGs, custom nodes) take the executor's materialize fallback.
 SUITE_FOLDS: Dict[str, Tuple[str, Callable[..., FoldSpec]]] = {
     "q01": ("lineitem", fold_q01),
+    "q02": ("partsupp", fold_q02),
     "q03": ("lineitem", fold_q03),
     "q04": ("lineitem", fold_q04),
     "q06": ("lineitem", fold_q06),

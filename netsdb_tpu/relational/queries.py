@@ -157,8 +157,11 @@ def _q02_core(jp_part, jp_sup, jp_nat, jp_reg,
     winner = K.segment_min(rows, ps_part, n_part, at_min)
     has = winner < jnp.iinfo(jnp.int32).max
     winner_c = jnp.clip(winner, 0, ps_part.shape[0] - 1)
-    sup_row = jnp.take(sidx, winner_c)
-    nat_row = jnp.take(nidx, sup_row)
+    # non-qualifying parts hold deterministic zeros (not clip garbage):
+    # the streamed fold produces the same, so whole-table and paged
+    # outputs compare array-for-array
+    sup_row = jnp.where(has, jnp.take(sidx, winner_c), 0)
+    nat_row = jnp.where(has, jnp.take(nidx, sup_row), 0)
     ints = jnp.stack([has.astype(jnp.int32), sup_row, nat_row])
     return ints, cost_min
 

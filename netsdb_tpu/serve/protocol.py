@@ -90,6 +90,12 @@ class MsgType(IntEnum):
     # (suite_sink_for) never pull tables from a daemon (ref
     # StorageCollectStats → Statistics, PangeaStorageServer.h:48)
     ANALYZE_SET = 41
+    # multi-host reads: a master assembling a mesh-spanning array asks
+    # each follower for ITS addressable shards (index ranges + bytes) —
+    # the reference streaming each node's local pages to the frontend
+    # (FrontendQueryTestServer.cc:785-890); reads never enter the SPMD
+    # program, so no collective/ordering hazards
+    LOCAL_SHARDS = 42
 
 
 class ProtocolError(ConnectionError):

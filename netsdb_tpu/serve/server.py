@@ -78,6 +78,94 @@ def resolve_entry_point(entry: str, source: Optional[str] = None) -> Any:
     return obj
 
 
+class _RWOrder:
+    """Tiny readers-writer lock for mirrored-frame ordering: SET-scoped
+    frames hold it shared (plus their per-set lock), global frames
+    (jobs, flush, DDL without a set target) hold it exclusively — so
+    frames on DIFFERENT sets run concurrently while anything that can
+    observe multiple sets serializes against all of them."""
+
+    def __init__(self):
+        self._mu = threading.Lock()
+        self._readers = 0
+        self._no_readers = threading.Condition(self._mu)
+        self._writer = threading.Lock()
+
+    def acquire_read(self):
+        self._writer.acquire()  # barrier: writers exclude new readers
+        with self._mu:
+            self._readers += 1
+        self._writer.release()
+
+    def release_read(self):
+        with self._mu:
+            self._readers -= 1
+            if self._readers == 0:
+                self._no_readers.notify_all()
+
+    def acquire_write(self):
+        self._writer.acquire()
+        with self._mu:
+            while self._readers:
+                self._no_readers.wait()
+
+    def release_write(self):
+        self._writer.release()
+
+
+class _FollowerLink:
+    """One follower daemon's ordered frame pipe: a FIFO queue drained by
+    a dedicated sender thread, so the follower receives mirrored frames
+    in exactly the enqueue order while the enqueuer (and the master's
+    handler) runs on. ``submit`` returns a record whose ``done`` event
+    fires when the follower acked (or errored)."""
+
+    def __init__(self, client):
+        import queue
+
+        self.client = client
+        self.q: "queue.Queue" = queue.Queue()
+        # submit/close are atomic under this lock, so every real item
+        # precedes the close sentinel in the queue — nothing can be
+        # enqueued behind it and wait forever on its "done" event
+        self._lk = threading.Lock()
+        self._closed = False
+        self.thread = threading.Thread(target=self._drain, daemon=True)
+        self.thread.start()
+
+    def submit(self, typ, payload, codec) -> Dict[str, Any]:
+        rec: Dict[str, Any] = {"done": threading.Event()}
+        with self._lk:
+            if self._closed:
+                rec["error"] = (f"{self.client.host}:{self.client.port}: "
+                                f"follower link closed (daemon shutdown)")
+                rec["done"].set()
+                return rec
+            self.q.put((typ, payload, codec, rec))
+        return rec
+
+    def close(self) -> None:
+        with self._lk:
+            if self._closed:
+                return
+            self._closed = True
+            self.q.put(None)
+
+    def _drain(self) -> None:
+        while True:
+            item = self.q.get()
+            if item is None:
+                return
+            typ, payload, codec, rec = item
+            try:
+                rec["reply"] = self.client._request(typ, payload, codec)
+            except Exception as e:  # noqa: BLE001 — surfaced by caller
+                rec["error"] = (f"{self.client.host}:{self.client.port}: "
+                                f"{type(e).__name__}: {e}")
+            finally:
+                rec["done"].set()
+
+
 class ServeController:
     """The daemon. ``start()`` runs the listener on a background thread
     (tests); ``serve_forever()`` blocks (the CLI ``serve`` command)."""
@@ -118,11 +206,36 @@ class ServeController:
         # ConnectionRefusedError at startup
         self._follower_addrs: list = list(followers or [])
         self._followers: list = []
+        self._links: list = []  # per-follower ordered sender queues
         self.library = Client(config)  # the resident state
-        # multi-host mode serializes MIRRORED frames: every process must
-        # observe the same mutation/job ORDER or the SPMD rendezvous
-        # deadlocks (single-host daemons never take this path)
+        # ORDERING MODEL for mirrored frames (the SPMD argument):
+        # - _mirror_lock is held only long enough to ENQUEUE a frame
+        #   onto every follower's FIFO sender queue; the enqueue always
+        #   happens while the frame's ORDERING lock (below) is held, so
+        #   for any two frames that conflict, the master's local
+        #   execution order equals every follower's receipt order —
+        #   stores cannot silently diverge.
+        # - jax.process_count() > 1 (true SPMD over the followers):
+        #   EVERY mirrored frame serializes under _collective_lock
+        #   across enqueue + local handler. Multi-controller XLA
+        #   requires all processes to launch collective programs in one
+        #   order, and any mutation can change what a later jitted job
+        #   observes, so the only sound order is a total one — the same
+        #   per-worker-connection serialization the reference's job
+        #   flow has (PDBServer.h:39-152: concurrent handlers, but one
+        #   socket per worker orders that worker's stream).
+        # - process_count() == 1 (replicated-daemon topology, no
+        #   cross-process collectives): SET-scoped frames serialize
+        #   per (db,set) and hold _order shared; multi-set frames
+        #   (jobs, flush) hold _order exclusively. Frames on different
+        #   sets — the common ingest pattern — run concurrently, which
+        #   is the round-4 concurrency win; reads never block on any
+        #   of this.
         self._mirror_lock = threading.Lock()
+        self._collective_lock = threading.Lock()
+        self._order = _RWOrder()
+        self._set_locks: Dict[Tuple[str, str], threading.Lock] = {}
+        self._set_locks_mu = threading.Lock()
         self._jobs_sem = threading.Semaphore(max_jobs or config.num_threads)
         self._job_seq = itertools.count(1)
         self._jobs: Dict[int, Dict[str, Any]] = {}
@@ -156,6 +269,7 @@ class ServeController:
             MsgType.LIST_JOBS: self._on_list_jobs,
             MsgType.COLLECT_STATS: self._on_collect_stats,
             MsgType.ANALYZE_SET: self._on_analyze_set,
+            MsgType.LOCAL_SHARDS: self._on_local_shards,
         }
 
     # --- lifecycle ----------------------------------------------------
@@ -186,6 +300,8 @@ class ServeController:
 
     def shutdown(self) -> None:
         self._stop.set()
+        for link in self._links:
+            link.close()
         if self._listener is not None:
             try:
                 self._listener.close()
@@ -283,7 +399,9 @@ class ServeController:
     # --- multi-host mirroring (master → workers) ----------------------
     def _ensure_followers(self, timeout_s: float = 30.0) -> None:
         """Dial any not-yet-connected follower, retrying while it comes
-        up (bring-up order between master and workers is free)."""
+        up (bring-up order between master and workers is free). Each
+        follower gets a :class:`_FollowerLink` — a FIFO sender thread
+        whose queue order IS the follower's frame order."""
         if len(self._followers) == len(self._follower_addrs):
             return
         from netsdb_tpu.serve.client import RemoteClient
@@ -292,8 +410,9 @@ class ServeController:
             deadline = time.time() + timeout_s
             while True:
                 try:
-                    self._followers.append(RemoteClient(addr,
-                                                        token=self.token))
+                    fc = RemoteClient(addr, token=self.token)
+                    self._followers.append(fc)
+                    self._links.append(_FollowerLink(fc))
                     break
                 except OSError as e:
                     if time.time() >= deadline:
@@ -302,41 +421,67 @@ class ServeController:
                             f"{timeout_s:.0f}s: {e}") from e
                     time.sleep(0.3)
 
+    #: mirrored frames scoped to ONE (db, set) target — these serialize
+    #: per set (and hold the RW order shared) in replicated-daemon
+    #: topologies; everything else mirrored is multi-set and holds the
+    #: RW order exclusively (ordering model in ``__init__``)
+    SET_SCOPED_FRAMES = frozenset({
+        MsgType.CREATE_SET, MsgType.REMOVE_SET, MsgType.CLEAR_SET,
+        MsgType.SEND_DATA, MsgType.SEND_MATRIX, MsgType.LOAD_SET,
+    })
+
+    def _set_lock(self, db: str, set_name: str) -> threading.Lock:
+        with self._set_locks_mu:
+            return self._set_locks.setdefault((db, set_name),
+                                              threading.Lock())
+
     def _run_mirrored(self, typ, payload, codec, handler):
-        """Execute one mutating/job frame on EVERY process: forward to
-        each follower daemon on its own thread while the local handler
-        runs — the processes rendezvous inside XLA (collective compile/
-        execute), so forwarding must be concurrent with, not after,
-        local execution. A follower failure after local success is
-        raised as a split-brain error: the cluster's stores have
-        diverged and the operator must recover (the reference aborts
-        the job the same way on worker failure)."""
-        with self._mirror_lock:
-            self._ensure_followers()
-            errors: list = []
+        """Execute one mutating/job frame on EVERY process, holding the
+        frame's ORDERING lock across both the follower enqueue and the
+        local handler (see the ordering model in ``__init__`` — the
+        lock choice is what keeps master execution order equal to
+        follower receipt order for conflicting frames). Forwarding
+        itself still overlaps local execution (the processes rendezvous
+        inside XLA). A follower failure after local success is raised
+        as a split-brain error: the cluster's stores have diverged and
+        the operator must recover (the reference aborts the job the
+        same way on worker failure)."""
+        import jax
 
-            def forward(fc):
-                try:
-                    fc._request(typ, payload, codec)
-                except Exception as e:  # noqa: BLE001 — reported below
-                    errors.append(f"{fc.host}:{fc.port}: "
-                                  f"{type(e).__name__}: {e}")
-
-            threads = [threading.Thread(target=forward, args=(fc,),
-                                        daemon=True)
-                       for fc in self._followers]
-            for t in threads:
-                t.start()
+        if jax.process_count() > 1:
+            # true SPMD: one total order for everything mirrored
+            with self._collective_lock:
+                return self._mirror_once(typ, payload, codec, handler)
+        if typ in self.SET_SCOPED_FRAMES and "db" in payload \
+                and "set" in payload:
+            self._order.acquire_read()
             try:
-                out = handler(payload)
+                with self._set_lock(payload["db"], payload["set"]):
+                    return self._mirror_once(typ, payload, codec, handler)
             finally:
-                for t in threads:
-                    t.join()
-            if errors:
-                raise RuntimeError(
-                    "follower(s) failed; stores may have diverged: "
-                    + "; ".join(errors))
-            return out
+                self._order.release_read()
+        self._order.acquire_write()
+        try:
+            return self._mirror_once(typ, payload, codec, handler)
+        finally:
+            self._order.release_write()
+
+    def _mirror_once(self, typ, payload, codec, handler):
+        with self._mirror_lock:  # short: dial + ordered enqueue only
+            self._ensure_followers()
+            pending = [link.submit(typ, payload, codec)
+                       for link in self._links]
+        try:
+            out = handler(payload)
+        finally:
+            for p in pending:
+                p["done"].wait()
+        errors = [p["error"] for p in pending if p.get("error")]
+        if errors:
+            raise RuntimeError(
+                "follower(s) failed; stores may have diverged: "
+                + "; ".join(errors))
+        return out
 
     # --- job bookkeeping ----------------------------------------------
     def _run_job(self, job_name: str, fn: Callable[[], Any]) -> Any:
@@ -432,19 +577,162 @@ class ServeController:
 
     def _on_get_tensor(self, p):
         t = self.library.get_tensor(p["db"], p["set"])
+        # mesh-spanning placed tensors assemble via follower shards
+        t = self._fetch_global(p["db"], p["set"], t)
         dense = np.asarray(t.to_dense())
         return MsgType.OK, {"data": dense,
                             "block_shape": list(t.meta.block_shape)}
 
+    # --- multi-host reads of placed sets -----------------------------
+    # A mesh-spanning jax.Array cannot be np.asarray'd on one process.
+    # Reads therefore assemble the GLOBAL value host-side: the master
+    # fills from its own addressable shards and asks each follower
+    # daemon for its local shards over the serve protocol (LOCAL_SHARDS
+    # frames) — the reference streaming each node's local pages to the
+    # frontend (FrontendQueryTestServer.cc:785-890). Reads never enter
+    # the SPMD program: no collectives, no frame-ordering constraints.
+
+    @staticmethod
+    def _item_leaves(item) -> Optional[Dict[str, Any]]:
+        """Named jax.Array leaves of a stored item (None = host object)."""
+        import jax
+
+        from netsdb_tpu.core.blocked import BlockedTensor
+        from netsdb_tpu.relational.table import ColumnTable
+
+        if isinstance(item, ColumnTable):
+            leaves = dict(item.cols)
+            if item.valid is not None:
+                leaves["__valid__"] = item.valid
+            return leaves
+        if isinstance(item, BlockedTensor):
+            return {"data": item.data}
+        if isinstance(item, jax.Array):
+            return {"value": item}
+        return None
+
+    @staticmethod
+    def _rebuild_item(item, arrays: Dict[str, np.ndarray]):
+        from netsdb_tpu.core.blocked import BlockedTensor
+        from netsdb_tpu.relational.table import ColumnTable
+
+        if isinstance(item, ColumnTable):
+            valid = arrays.pop("__valid__", None)
+            return ColumnTable(arrays, dict(item.dicts), valid)
+        if isinstance(item, BlockedTensor):
+            return BlockedTensor(arrays["data"], item.meta)
+        return arrays["value"]
+
+    @staticmethod
+    def _shard_ranges(shard, shape):
+        return [[s.start or 0, s.stop if s.stop is not None else dim]
+                for s, dim in zip(shard.index, shape)]
+
+    def _on_local_shards(self, p):
+        """Follower side: this process's addressable shards of one
+        stored item's arrays, as (index ranges, raw buffer) pairs."""
+        item = self._single_item(p["db"], p["set"])
+        leaves = self._item_leaves(item)
+        if leaves is None:
+            return MsgType.OK, {"leaves": None}
+        out = {}
+        for name, arr in leaves.items():
+            out[name] = [
+                {"idx": self._shard_ranges(s, arr.shape),
+                 "data": np.asarray(s.data)}
+                for s in arr.addressable_shards]
+        return MsgType.OK, {"leaves": out,
+                            "shapes": {n: list(a.shape)
+                                       for n, a in leaves.items()}}
+
+    def _single_item(self, db: str, set_name: str):
+        items = self.library.store.get_items(SetIdentifier(db, set_name))
+        if len(items) != 1:
+            raise ValueError(f"set {db}:{set_name} holds {len(items)} "
+                             f"items; shard assembly expects 1")
+        return items[0]
+
+    def _fetch_global(self, db: str, set_name: str, item):
+        """Item with every mesh-spanning array replaced by its full
+        host value (local shards + follower LOCAL_SHARDS)."""
+        import jax
+
+        leaves = self._item_leaves(item)
+        if leaves is None or all(
+                (not isinstance(a, jax.Array)) or a.is_fully_addressable
+                for a in leaves.values()):
+            return item
+        if self._single_item(db, set_name) is not item:
+            raise ValueError(
+                f"set {db}:{set_name}: shard assembly of mesh-spanning "
+                f"arrays supports single-item sets only")
+        from netsdb_tpu.serve.protocol import CODEC_MSGPACK
+
+        # the WHOLE assembly — master-local shard copy AND follower
+        # fetches — runs under the collective lock, which every
+        # spanning mutation (EXECUTE_*/SEND_* in multi-process mode)
+        # also holds: without it, a concurrent replacement could tear
+        # the result into pre-mutation master halves + post-mutation
+        # follower halves
+        with self._collective_lock:
+            # re-read under the lock: the set may have been replaced
+            # while we waited
+            item = self._single_item(db, set_name)
+            leaves = self._item_leaves(item)
+            out: Dict[str, np.ndarray] = {}
+            covered: Dict[str, np.ndarray] = {}
+            for name, arr in leaves.items():
+                buf = np.empty(arr.shape, arr.dtype)
+                cov = np.zeros(arr.shape, np.bool_)
+                for s in arr.addressable_shards:
+                    idx = tuple(slice(a, b) for a, b
+                                in self._shard_ranges(s, arr.shape))
+                    buf[idx] = np.asarray(s.data)
+                    cov[idx] = True
+                out[name] = buf
+                covered[name] = cov
+            with self._mirror_lock:
+                self._ensure_followers()
+                recs = [link.submit(MsgType.LOCAL_SHARDS,
+                                    {"db": db, "set": set_name},
+                                    CODEC_MSGPACK)
+                        for link in self._links]
+            for rec in recs:
+                rec["done"].wait()
+                if rec.get("error"):
+                    raise RuntimeError(f"follower shard fetch failed: "
+                                       f"{rec['error']}")
+                for name, shards in (rec["reply"]["leaves"] or {}).items():
+                    for sh in shards:
+                        idx = tuple(slice(a, b) for a, b in sh["idx"])
+                        out[name][idx] = sh["data"]
+                        covered[name][idx] = True
+            missing = [n for n, c in covered.items() if not c.all()]
+            if missing:
+                # e.g. a client reading through a WORKER daemon (no
+                # follower links): returning np.empty garbage would be
+                # silent corruption — reads of spanning sets must go to
+                # the daemon that knows every holder
+                raise RuntimeError(
+                    f"set {db}:{set_name}: cannot assemble mesh-spanning "
+                    f"columns {missing} — this daemon's local + follower "
+                    f"shards do not cover the arrays (read through the "
+                    f"master daemon)")
+        return self._rebuild_item(item, out)
+
     def _scan_items(self, db: str, set_name: str):
         """Set scan for the wire: a paged set's PagedColumns handle is
         process-local (it wraps the native arena), so it ships as its
-        materialized table — clients wanting summaries only should use
-        ANALYZE_SET instead."""
+        materialized table, and mesh-spanning placed items assemble
+        their global value first (``_fetch_global``) — clients wanting
+        summaries only should use ANALYZE_SET instead."""
         from netsdb_tpu.relational.outofcore import PagedColumns
 
         for item in self.library.get_set_iterator(db, set_name):
-            yield item.to_table() if isinstance(item, PagedColumns) else item
+            if isinstance(item, PagedColumns):
+                yield item.to_table()
+            else:
+                yield self._fetch_global(db, set_name, item)
 
     def _on_scan_set(self, p):
         from netsdb_tpu.serve.protocol import CODEC_PICKLE
@@ -510,6 +798,7 @@ class ServeController:
         the full payload twice); the dense host materialization itself
         is one copy, as in `_on_get_tensor`."""
         t = self.library.get_tensor(p["db"], p["set"])
+        t = self._fetch_global(p["db"], p["set"], t)
         dense = np.ascontiguousarray(np.asarray(t.to_dense()))
         chunk = int(p.get("chunk_bytes") or (8 << 20))
         view = memoryview(dense).cast("B")
@@ -658,8 +947,19 @@ class ServeController:
         """Planner statistics computed where the data lives — the
         summaries ship, the table stays (ref StorageCollectStats,
         ``PangeaStorageServer.h:48``). ColumnStats flatten to 4-int
-        rows; dictionaries are lists of strings (msgpack-safe)."""
-        info = self.library.analyze_set(p["db"], p["set"])
+        rows; dictionaries are lists of strings (msgpack-safe). A
+        mesh-spanning placed table assembles its global columns first
+        (stats need every host's rows)."""
+        from netsdb_tpu.client import table_info
+        from netsdb_tpu.relational.table import ColumnTable
+
+        items = self.library.store.get_items(
+            SetIdentifier(p["db"], p["set"]))
+        if len(items) == 1 and isinstance(items[0], ColumnTable):
+            info = table_info(
+                self._fetch_global(p["db"], p["set"], items[0]))
+        else:
+            info = self.library.analyze_set(p["db"], p["set"])
         return MsgType.OK, {
             "num_rows": int(info["num_rows"]),
             "dicts": {k: list(v) for k, v in info["dicts"].items()},

@@ -273,6 +273,26 @@ class SetStore:
         s.last_access = time.time()
 
     @_locked
+    def update_set(self, ident: SetIdentifier, fn) -> None:
+        """Atomic read-modify-write of a set's items: ``fn(items) ->
+        new_items`` runs UNDER the store lock, so concurrent updaters
+        (e.g. two daemon handlers appending to one objects set) cannot
+        interleave their read-concat-replace sequences and lose
+        batches. Placement applies to the result like any ingest."""
+        s = self._require(ident)
+        if s.alias_of is not None:
+            raise ValueError(f"set {ident} aliases {s.alias_of}; it is read-only")
+        if s.items is None:
+            self._load_from_spill(s)
+        items = fn(list(s.items))
+        if s.placement is not None:
+            items = [s.placement.apply(i) for i in items]
+        s.items = items
+        s.nbytes = sum(_item_nbytes(i) for i in items)
+        s.last_access = time.time()
+        self._maybe_evict(exclude=ident)
+
+    @_locked
     def put_tensor(self, ident: SetIdentifier, tensor: BlockedTensor) -> None:
         """Replace a set's contents with one tensor — the dominant pattern
         for model-weight sets (each netsDB weight set is exactly one
@@ -498,10 +518,45 @@ class SetStore:
             self._pooled.discard(ident)
         return sum(seen.values())
 
+    @_locked
+    def drop_pool_caches(self) -> int:
+        """Release every pooled set's cached assembly (dedup/pool.py) —
+        the cheapest memory to give back under pressure (re-creatable
+        by one gather). Returns bytes released."""
+        from netsdb_tpu.dedup.pool import PooledTensor
+
+        released = 0
+        for ident in list(self._pooled):
+            s = self._sets.get(ident)
+            for item in (s.items or []) if s is not None else []:
+                if isinstance(item, PooledTensor):
+                    released += item.drop_cache()
+        return released
+
+    def _live_pool_cache_bytes(self) -> int:
+        """Bytes currently held by pooled sets' cached assemblies —
+        counted into the pressure total (the caches themselves can BE
+        the pressure; invisible bytes would defeat the cap)."""
+        from netsdb_tpu.dedup.pool import PooledTensor
+
+        total = 0
+        for ident in self._pooled:
+            s = self._sets.get(ident)
+            for item in (s.items or []) if s is not None else []:
+                if isinstance(item, PooledTensor) and item._cache is not None:
+                    total += int(item._cache.data.nbytes)
+        return total
+
     # --- eviction (ref: PageCache::evict + LocalitySet policies) ------
     def _maybe_evict(self, exclude: Optional[SetIdentifier] = None) -> None:
         total = sum(s.nbytes for s in self._sets.values() if s.items is not None)
         total += self._live_pool_bytes()
+        total += self._live_pool_cache_bytes()
+        if total <= self.max_host_bytes:
+            return
+        # pressure: cached pool assemblies go first — dropping them is
+        # free (one gather re-creates), spilling a set is not
+        total -= self.drop_pool_caches()
         if total <= self.max_host_bytes:
             return
         candidates = [

@@ -132,3 +132,53 @@ def test_dedup_through_daemon_inference_correct(config):
         np.testing.assert_allclose(out_b1.sum(axis=0), 1.0, rtol=1e-5)
     finally:
         ctl.shutdown()
+
+
+# --------------------------- round-4: the steady-state HBM claim, pinned
+def test_consecutive_reads_do_not_regather(client):
+    """Two consecutive jobs over a pooled model reuse ONE assembled
+    copy (assembly_count pins it); dropping caches under pressure
+    restores pool-only residency and the next read re-gathers the
+    identical tensor."""
+    from netsdb_tpu.dedup.pool import PooledTensor
+
+    rng = np.random.default_rng(3)
+    dense = rng.standard_normal((32, 32)).astype(np.float32)
+    client.create_database("dp")
+    for name in ("m1", "m2"):
+        client.create_set("dp", name)
+        client.send_matrix("dp", name, dense, (8, 8))
+    client.dedup_resident([("dp", "m1"), ("dp", "m2")])
+
+    from netsdb_tpu.storage.store import SetIdentifier
+    item = client.store._sets[SetIdentifier("dp", "m1")].items[0]
+    assert isinstance(item, PooledTensor)
+    t1 = client.get_tensor("dp", "m1")
+    t2 = client.get_tensor("dp", "m1")  # second consecutive read
+    assert item.assembly_count == 1
+    assert t1 is t2  # the cached assembly, not a re-gather
+    np.testing.assert_array_equal(np.asarray(t1.to_dense()), dense)
+
+    released = client.store.drop_pool_caches()
+    assert released > 0
+    t3 = client.get_tensor("dp", "m1")
+    assert item.assembly_count == 2  # re-gathered exactly once more
+    np.testing.assert_array_equal(np.asarray(t3.to_dense()), dense)
+
+
+def test_live_pool_bytes_across_set_removal(client):
+    """Store-level pool accounting: counted once while ANY referencing
+    set lives, and released when the last one goes."""
+    rng = np.random.default_rng(4)
+    dense = rng.standard_normal((32, 32)).astype(np.float32)
+    client.create_database("dp")
+    for name in ("p1", "p2"):
+        client.create_set("dp", name)
+        client.send_matrix("dp", name, dense, (8, 8))
+    rep = client.dedup_resident([("dp", "p1"), ("dp", "p2")])
+    live = client.store.live_pool_bytes()
+    assert live == rep["hbm_bytes_pooled"] > 0
+    client.remove_set("dp", "p1")
+    assert client.store.live_pool_bytes() == live  # pool still shared
+    client.remove_set("dp", "p2")
+    assert client.store.live_pool_bytes() == 0

@@ -112,3 +112,32 @@ def test_drl_advisor_pluggable_into_client(tmp_path):
     meta = client.catalog.get_set("d", "weights")["meta"]
     assert meta["placement"] in ("b256", "b64")
     assert adv.db.runs("drl-job:decisions")
+
+
+# ------------------------------- round-4: arms carrying PLACEMENTS
+def test_distribution_ab_rule_applies_placement_arms():
+    """`arm.specs["placement"]` end-to-end: create_set applies the
+    advisor-chosen sharding (replicated vs row-sharded dim table on
+    the 8-device mesh), the job runs distributed under it, and the
+    measured reward lands against the APPLIED arm."""
+    from netsdb_tpu.learning.ab_bench import bench_distribution_ab
+
+    out = bench_distribution_ab(scale=8, rounds=3, advisor_kind="rule")
+    # every round's applied placement matches its arm's declaration
+    for arm_label, pl_label in out["applied"]:
+        if arm_label == "dim_replicated":
+            assert "P(None)" in pl_label, (arm_label, pl_label)
+        else:
+            assert "P(data)" in pl_label, (arm_label, pl_label)
+    # both arms were explored and have measured means
+    assert all(v is not None and v > 0 for v in out["mean_s"].values())
+    assert out["winner"] in out["mean_s"]
+    assert out["decisions_recorded"] >= 3
+
+
+def test_distribution_ab_drl_converges():
+    from netsdb_tpu.learning.ab_bench import bench_distribution_ab
+
+    out = bench_distribution_ab(scale=8, rounds=4, advisor_kind="drl")
+    assert out["converged"], out
+    assert all(v is not None for v in out["mean_s"].values())

@@ -28,7 +28,7 @@ from netsdb_tpu.storage.store import SetIdentifier
 from netsdb_tpu.workloads import tpch
 
 SCALE = 8
-PAGED_FACTS = ("lineitem", "orders")
+PAGED_FACTS = ("lineitem", "orders", "partsupp")
 
 
 @pytest.fixture(scope="module")
@@ -115,9 +115,10 @@ def test_q03_sink_unchanged_runs_paged(paged_client, tables):
 
 @pytest.mark.parametrize("qname", sorted(COLUMNAR_QUERIES))
 def test_suite_sink_runs_paged(qname, paged_client, resident_client):
-    """Every suite query over paged fact sets matches its resident run
-    — nine stream through their folds; q02 exercises the documented
-    materialize fallback (fold-less consumer of a paged set)."""
+    """Every one of the TEN suite queries over paged fact sets matches
+    its resident run, streaming through its fold (q02's min-cost
+    winner arbitrates across chunks lexicographically on
+    (cost, global row id))."""
     rm = jax.device_get(rdag.run_query(
         resident_client, rdag.suite_sink_for(resident_client, "d", qname)))
     rp = jax.device_get(rdag.run_query(
@@ -126,8 +127,7 @@ def test_suite_sink_runs_paged(qname, paged_client, resident_client):
     for a, b in zip(rm, rp):
         np.testing.assert_allclose(np.asarray(a), np.asarray(b),
                                    rtol=1e-4, atol=1e-3)
-    if qname != "q02":
-        _assert_spilled(paged_client)
+    _assert_spilled(paged_client)
 
 
 # -------------------------------------------- paged composes with placed
@@ -271,3 +271,33 @@ def test_objects_set_empty_batch_and_append(tmp_path):
     rows = sorted((r["k"], r["v"]) for r in t.to_rows())
     assert rows == [("a", 1), ("a", 4), ("b", 2), ("c", 3)]
     assert t.dicts["k"] == ["a", "b", "c"]  # dictionary merged, stable
+
+
+def test_foldless_consumer_materialize_fallback(paged_client, tables,
+                                                monkeypatch):
+    """A fold-less node over a paged set takes the documented
+    materialize fallback — correct, and memoized per scan (two
+    consumers in one job stream the relation ONCE)."""
+    from netsdb_tpu.plan.computations import Apply, ScanSet, WriteSet
+    from netsdb_tpu.relational.outofcore import PagedColumns
+
+    calls = {"n": 0}
+    orig = PagedColumns.to_table
+
+    def counting(self):
+        calls["n"] += 1
+        return orig(self)
+
+    monkeypatch.setattr(PagedColumns, "to_table", counting)
+    scan = ScanSet("d", "lineitem")
+    s1 = WriteSet(Apply(scan, lambda t: t.select(["l_orderkey"]),
+                        traceable=False, label="proj_a"), "d", "out_a")
+    s2 = WriteSet(Apply(scan, lambda t: t.select(["l_quantity"]),
+                        traceable=False, label="proj_b"), "d", "out_b")
+    res = paged_client.execute_computations(s1, s2, job_name="fallback")
+    vals = {i.set: v for i, v in res.items()}
+    assert calls["n"] == 1  # one materialization, two consumers
+    np.testing.assert_array_equal(
+        np.sort(np.asarray(vals["out_a"]["l_orderkey"])),
+        np.sort(np.asarray(tables["lineitem"]["l_orderkey"])))
+    assert vals["out_b"].num_rows == tables["lineitem"].num_rows
