@@ -26,6 +26,15 @@ the single-chip query cores' logic so the distributed answers are
 bit-comparable to the local engine (tests cross-check both on the
 virtual 8-device CPU mesh).
 
+LAYERING (round 4): this module is the shard_map KERNEL layer. The
+user-facing distribution surface is the SET API — create the sets with
+a Placement and run ``relational.dag.suite_sink_for`` (aggregate form)
+or ``relational.shuffle.q03_row_sink_for`` (row-output form); those
+DAGs reach the same physics with the mesh taken from the stored
+columns' shardings. Call these functions directly only when you hold
+raw arrays and a mesh (benchmarks, library composition) — application
+code should not hand-shard.
+
 Row padding: a sharded axis must divide the device count, so fact
 columns are padded and a validity mask rides along (the mask approach
 every tensor op in this framework uses).

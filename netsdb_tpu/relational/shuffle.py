@@ -371,7 +371,13 @@ def _mask_c_ok(c):
 def shuffle_q03(tables, mesh: Mesh, axis: str = "data",
                 segment: str = "BUILDING", date: str = "1995-03-15",
                 k: int = 10, slack: float = 2.0):
-    """Q03 through the ROW-OUTPUT distributed plan — the reference's
+    """Hand-mesh form of the row-output Q03 — kept as the kernel-layer
+    driver and benchmark; APPLICATION code should use
+    :func:`q03_row_sink_for`, the same plan as a Partition-node DAG
+    over PLACED sets with no mesh argument (round 4 retired this
+    surface from the dryrun/client paths).
+
+    Q03 through the ROW-OUTPUT distributed plan — the reference's
     actual shape for this query (partitioned join materializing row
     sets, then aggregation, then top-k) rather than round 1's
     replicate-the-dimensions shortcut:
