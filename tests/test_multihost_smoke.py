@@ -62,28 +62,36 @@ def _free_port() -> int:
     return port
 
 
-@pytest.mark.slow
-def test_two_process_cluster_bringup(tmp_path):
+def _run_two_process(tmp_path, template, marker, timeout_s,
+                     extra_args=()):
+    """Shared two-process harness: format the worker template, launch
+    both pids, kill-all on hang, check per-pid OK markers, retry once
+    (the free-port claim can race on a loaded machine). ``extra_args``
+    may be a callable, re-evaluated per attempt (fresh ports)."""
     repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
     env = dict(os.environ,
                JAX_PLATFORMS="cpu",
                XLA_FLAGS="--xla_force_host_platform_device_count=4")
     last = ""
-    # one retry: the free-port claim can race other processes on a
-    # loaded machine between bind-probe and the coordinator's bind
     for attempt in range(2):
         addr = f"127.0.0.1:{_free_port()}"
-        script = tmp_path / f"worker{attempt}.py"
-        script.write_text(_WORKER.format(repo=repo, addr=addr))
+        script = tmp_path / f"worker_{marker}_{attempt}.py"
+        script.write_text(template.format(repo=repo, addr=addr))
+        # per-attempt scratch dir: a SIGKILLed attempt 0 must not share
+        # sqlite catalogs / spill dirs with attempt 1
+        scratch = tmp_path / f"data_{marker}_{attempt}"
+        scratch.mkdir(exist_ok=True)
+        extra = extra_args() if callable(extra_args) else extra_args
         procs = [subprocess.Popen(
-            [sys.executable, str(script), str(pid)], env=env,
+            [sys.executable, str(script), str(pid), str(scratch),
+             *map(str, extra)], env=env,
             stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True)
             for pid in (0, 1)]
         outs = []
         hung = False
         for p in procs:
             try:
-                out, _ = p.communicate(timeout=180)
+                out, _ = p.communicate(timeout=timeout_s)
                 outs.append(out)
             except subprocess.TimeoutExpired:
                 for q in procs:
@@ -91,14 +99,19 @@ def test_two_process_cluster_bringup(tmp_path):
                 hung = True
                 break
         if hung:
-            last = "bring-up hung"
+            last = f"{marker} run hung"
             continue
         if all(p.returncode == 0 for p in procs) and all(
-                f"WORKER {pid} OK" in out
+                f"{marker} {pid} OK" in out
                 for pid, out in enumerate(outs)):
-            return  # success
+            return
         last = "\n---\n".join(outs)
-    pytest.fail(f"two-process bring-up failed twice:\n{last}")
+    pytest.fail(f"two-process {marker} failed twice:\n{last}")
+
+
+@pytest.mark.slow
+def test_two_process_cluster_bringup(tmp_path):
+    _run_two_process(tmp_path, _WORKER, "WORKER", 180)
 
 
 _JOB_WORKER = textwrap.dedent("""
@@ -127,8 +140,7 @@ _JOB_WORKER = textwrap.dedent("""
     from netsdb_tpu.workloads import tpch
 
     client = Client(Configuration(
-        root_dir=os.path.join(tempfile.gettempdir(),
-                              f"mh_job_{{pid}}")))
+        root_dir=os.path.join(sys.argv[2], f"mh_job_{{pid}}")))
     client.create_database("tpch")
     client.create_set("tpch", "lineitem", type_name="table",
                       placement=Placement((("data", 8),), ("data",)))
@@ -167,39 +179,7 @@ def test_two_process_job_through_client_api(tmp_path):
     """Round-3 item 4: a REAL job — sharded q01 via
     create_set(placement)/send_table/execute_computations — runs across
     two jax.distributed processes, result verified on process 0."""
-    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
-    env = dict(os.environ,
-               JAX_PLATFORMS="cpu",
-               XLA_FLAGS="--xla_force_host_platform_device_count=4")
-    last = ""
-    for attempt in range(2):
-        addr = f"127.0.0.1:{_free_port()}"
-        script = tmp_path / f"jobworker{attempt}.py"
-        script.write_text(_JOB_WORKER.format(repo=repo, addr=addr))
-        procs = [subprocess.Popen(
-            [sys.executable, str(script), str(pid)], env=env,
-            stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True)
-            for pid in (0, 1)]
-        outs = []
-        hung = False
-        for p in procs:
-            try:
-                out, _ = p.communicate(timeout=240)
-                outs.append(out)
-            except subprocess.TimeoutExpired:
-                for q in procs:
-                    q.kill()
-                hung = True
-                break
-        if hung:
-            last = "job run hung"
-            continue
-        if all(p.returncode == 0 for p in procs) and all(
-                f"JOBWORKER {pid} OK" in out
-                for pid, out in enumerate(outs)):
-            return
-        last = "\n---\n".join(outs)
-    pytest.fail(f"two-process client-API job failed twice:\n{last}")
+    _run_two_process(tmp_path, _JOB_WORKER, "JOBWORKER", 240)
 
 
 _DAEMON_WORKER = textwrap.dedent("""
@@ -210,7 +190,7 @@ _DAEMON_WORKER = textwrap.dedent("""
     from netsdb_tpu.parallel.distributed import initialize_cluster
 
     pid = int(sys.argv[1])
-    p0_port, p1_port = int(sys.argv[2]), int(sys.argv[3])
+    p0_port, p1_port = int(sys.argv[3]), int(sys.argv[4])
     ok = initialize_cluster(coordinator_address={addr!r},
                             num_processes=2, process_id=pid)
     assert ok and jax.device_count() == 8
@@ -361,37 +341,78 @@ def test_two_process_job_through_daemon(tmp_path):
     follower daemon on the second jax.distributed process, and a
     sharded q01 executes collectively (HermesExecutionServer.cc:
     1225-1274)."""
-    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
-    env = dict(os.environ,
-               JAX_PLATFORMS="cpu",
-               XLA_FLAGS="--xla_force_host_platform_device_count=4")
-    last = ""
-    for attempt in range(2):
-        addr = f"127.0.0.1:{_free_port()}"
-        p0, p1 = _free_port(), _free_port()
-        script = tmp_path / f"daemonworker{attempt}.py"
-        script.write_text(_DAEMON_WORKER.format(repo=repo, addr=addr))
-        procs = [subprocess.Popen(
-            [sys.executable, str(script), str(pid), str(p0), str(p1)],
-            env=env, stdout=subprocess.PIPE, stderr=subprocess.STDOUT,
-            text=True) for pid in (0, 1)]
-        outs = []
-        hung = False
-        for p in procs:
-            try:
-                out, _ = p.communicate(timeout=300)
-                outs.append(out)
-            except subprocess.TimeoutExpired:
-                for q in procs:
-                    q.kill()
-                hung = True
-                break
-        if hung:
-            last = "daemon fan-out hung"
-            continue
-        if all(p.returncode == 0 for p in procs) and all(
-                f"JOBWORKER {pid} OK" in out
-                for pid, out in enumerate(outs)):
-            return
-        last = "\n---\n".join(outs)
-    pytest.fail(f"two-process daemon job failed twice:\n{last}")
+    _run_two_process(tmp_path, _DAEMON_WORKER, "JOBWORKER", 300,
+                     extra_args=lambda: (_free_port(), _free_port()))
+
+
+_PAGED_WORKER = textwrap.dedent("""
+    import os, sys, tempfile
+    sys.path.insert(0, {repo!r})
+    import jax
+    jax.config.update("jax_platforms", "cpu")
+    from netsdb_tpu.parallel.distributed import initialize_cluster
+
+    pid = int(sys.argv[1])
+    ok = initialize_cluster(coordinator_address={addr!r},
+                            num_processes=2, process_id=pid)
+    assert ok and jax.device_count() == 8
+
+    # the FULL reference composition (round 4): out-of-core x placed x
+    # multi-host — every process streams its local pages chunk-by-chunk
+    # onto the GLOBAL 8-device mesh (each chunk's device_put is the
+    # same collective on both processes, SPMD) and the fold's segment
+    # sums psum across hosts: PageScanner x scheduler,
+    # PipelineStage.cc:228-265 + QuerySchedulerServer.cc:216-330.
+    import numpy as np
+    from netsdb_tpu.client import Client
+    from netsdb_tpu.config import Configuration
+    from netsdb_tpu.parallel.placement import Placement
+    from netsdb_tpu.relational import dag as rdag
+    from netsdb_tpu.relational.queries import cq01, tables_from_rows
+    from netsdb_tpu.workloads import tpch
+
+    client = Client(Configuration(
+        root_dir=os.path.join(sys.argv[2], f"mhp_{{pid}}"),
+        page_size_bytes=4096, page_pool_bytes=16384))
+    client.create_database("tpch")
+    client.create_set("tpch", "lineitem", type_name="table",
+                      storage="paged",
+                      placement=Placement((("data", 8),), ("data",)))
+    tables = tables_from_rows(tpch.generate(scale=4, seed=9))
+    client.send_table("tpch", "lineitem", tables["lineitem"])
+
+    if not client.store.page_store().native:
+        # the spill assertion is native-only (the Python fallback
+        # backend never spills) — mirror test_outofcore's skip
+        print("PAGEDWORKER", pid, "OK (skipped: no native page store)")
+        sys.exit(0)
+    result = rdag.run_query(client, rdag.q01_sink("tpch"))
+    st = client.store.page_store().stats()
+    assert st["spills"] > 0 and st["loads"] > 0, st  # really out-of-core
+
+    if pid == 0:
+        counts = np.asarray(jax.device_get(result["count"]))
+        rfc = np.asarray(jax.device_get(result["l_returnflag"]))
+        lsc = np.asarray(jax.device_get(result["l_linestatus"]))
+        charge = np.asarray(jax.device_get(result["sum_charge"]))
+        rf = result.dicts["l_returnflag"]
+        ls = result.dicts["l_linestatus"]
+        got = {{(rf[int(rfc[i])], ls[int(lsc[i])]):
+               (int(counts[i]), float(charge[i]))
+               for i in range(len(counts)) if counts[i]}}
+        ref = {{k: (v["count"], v["sum_charge"]) for k, v in cq01(tables)}}
+        assert set(got) == set(ref), (set(got), set(ref))
+        for k in ref:
+            assert got[k][0] == ref[k][0], (k, got[k], ref[k])
+            assert abs(got[k][1] - ref[k][1]) <= 1e-4 * abs(ref[k][1])
+    print("PAGEDWORKER", pid, "OK")
+""")
+
+
+@pytest.mark.slow
+def test_two_process_paged_and_placed_fold(tmp_path):
+    """Round 4: out-of-core COMPOSES with multi-host distribution —
+    a paged AND placed lineitem streams per-process pages onto the
+    cross-process 8-device mesh through the unchanged q01 sink, with
+    spills on every process and results matching the in-memory engine."""
+    _run_two_process(tmp_path, _PAGED_WORKER, "PAGEDWORKER", 240)
