@@ -137,6 +137,9 @@ class Client:
         pool cap (the reference's PageScanner-fed out-of-core execution,
         ``src/storage/headers/PageScanner.h:25-34``). Composes with
         ``placement``: streamed chunks are mesh-sharded per chunk.
+        Paged sets are PROCESS-LIFETIME: the arena spills cold pages to
+        disk for capacity, not durability — persistence belongs to
+        ``storage="memory"`` sets (``.pdbset`` flush/load).
 
         ``placement`` (:class:`~netsdb_tpu.parallel.placement.Placement`
         or its ``to_meta`` dict) declares the set's mesh sharding — the

@@ -12,8 +12,8 @@ chunk-step folded over the stream.
 The chunk step IS the distributed engine's combiner: a masked partial
 aggregate with a fixed-shape output (``sharded.py`` runs the same
 kernels over shards in SPACE and merges with psum; here the "shards"
-arrive in TIME and merge by accumulation — one compiled program either
-way, so out-of-core answers are bit-comparable to in-memory ones).
+arrive in TIME and merge by accumulation — the same math either way,
+so out-of-core answers match in-memory ones to float summation order).
 
 Chunks are padded to the fixed page row count, so every chunk reuses
 ONE compiled XLA program (static shapes; the ragged tail rides the

@@ -380,11 +380,16 @@ class SetStore:
         """Write a set durably to disk (keeps it in RAM)."""
         s = self._require(ident)
         if s.storage == "paged":
-            # pages already persist through the arena's own spill files
-            # (native/pagestore.cpp); the .pdbset path would pickle a
-            # live store handle
-            raise ValueError(f"set {ident} is paged; its pages persist "
-                             f"via the page store, not .pdbset flush")
+            # the .pdbset path would pickle a live store handle; note
+            # that paged sets are PROCESS-LIFETIME — the arena spills
+            # cold pages to disk for capacity, but its page table and
+            # the set's column metadata are in-memory only, so a paged
+            # set does not survive restart (re-ingest it; the reference
+            # durability story maps to "memory" sets + .pdbset)
+            raise ValueError(f"set {ident} is paged; paged sets are "
+                             f"process-lifetime (arena spill files are "
+                             f"capacity, not durability) — use "
+                             f"storage='memory' for persistent sets")
         items = self.get_items(ident)
         path = self._spill_path(ident)
         payload = []
