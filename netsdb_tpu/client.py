@@ -137,9 +137,10 @@ class Client:
         pool cap (the reference's PageScanner-fed out-of-core execution,
         ``src/storage/headers/PageScanner.h:25-34``). Composes with
         ``placement``: streamed chunks are mesh-sharded per chunk.
-        Paged sets are PROCESS-LIFETIME: the arena spills cold pages to
-        disk for capacity, not durability — persistence belongs to
-        ``storage="memory"`` sets (``.pdbset`` flush/load).
+        Durability: the arena's spill files are capacity, not
+        durability — a paged set persists via ``flush``/``flush_data``
+        (snapshot of the materialized relation; reload re-ingests into
+        the arena, coming back paged).
 
         ``placement`` (:class:`~netsdb_tpu.parallel.placement.Placement`
         or its ``to_meta`` dict) declares the set's mesh sharding — the
@@ -373,12 +374,10 @@ class Client:
 
     def flush_data(self) -> None:
         """Durably flush all persistent sets (ref flushData →
-        StorageCleanup broadcast, ``PDBClient.h:141``). Paged sets are
-        skipped: their pages already persist through the arena's own
-        spill files (``.pdbset`` flush does not apply to them)."""
+        StorageCleanup broadcast, ``PDBClient.h:141``). Paged sets
+        snapshot as their materialized relation and re-ingest into the
+        arena on reload (``SetStore.flush``)."""
         for ident in self.store.list_sets():
-            if self.store.storage_of(ident) == "paged":
-                continue
             info = self.catalog.get_set(ident.db, ident.set)
             if info and info.get("persistence") == "persistent":
                 self.store.flush(ident)

@@ -195,11 +195,11 @@ class PagedColumns:
             # short), so the next chunk starts one full block later
             start += self.row_block
 
-    def to_table(self) -> ColumnTable:
-        """Materialize the whole relation as one resident ColumnTable —
-        the compatibility escape hatch (``get_table`` on a paged set,
-        fold-less query fallback). Defeats paging by construction; the
-        streamed path is ``stream_tables``."""
+    def to_host_table(self) -> ColumnTable:
+        """Materialize the relation as one HOST-resident ColumnTable
+        (numpy columns, nothing touches the device) — the snapshot path
+        (``SetStore.flush``): device memory stays bounded no matter how
+        large the paged relation is."""
         parts: Dict[str, List[np.ndarray]] = {}
         n_done = 0
         for cols, valid in self.stream():
@@ -212,8 +212,20 @@ class PagedColumns:
                                f"{n_done} rows, expected {self.num_rows}")
         from netsdb_tpu.relational.stats import inject_stats
 
-        out = ColumnTable({k: jnp.asarray(np.concatenate(v))
+        out = ColumnTable({k: np.concatenate(v)
                            for k, v in parts.items()}, self.dicts, None)
+        return inject_stats(out, self.stats)
+
+    def to_table(self) -> ColumnTable:
+        """Materialize the whole relation as one DEVICE-resident
+        ColumnTable — the compatibility escape hatch (``get_table`` on
+        a paged set, fold-less query fallback). Defeats paging by
+        construction; the streamed path is ``stream_tables``."""
+        host = self.to_host_table()
+        from netsdb_tpu.relational.stats import inject_stats
+
+        out = ColumnTable({k: jnp.asarray(v) for k, v in host.cols.items()},
+                          host.dicts, None)
         return inject_stats(out, self.stats)
 
 

@@ -377,19 +377,16 @@ class SetStore:
 
     @_locked
     def flush(self, ident: SetIdentifier) -> str:
-        """Write a set durably to disk (keeps it in RAM)."""
+        """Write a set durably to disk (keeps it in RAM). A PAGED set
+        snapshots as its materialized relation tagged ``paged`` — on
+        reload it re-ingests into the arena, so paged sets survive
+        restart like any other (the reference's PartitionedFile +
+        soft-reboot story; the snapshot holds the full relation on
+        host once, the same peak as the original ingest). The arena's
+        own spill files remain capacity, not durability."""
+        from netsdb_tpu.relational.outofcore import PagedColumns
+
         s = self._require(ident)
-        if s.storage == "paged":
-            # the .pdbset path would pickle a live store handle; note
-            # that paged sets are PROCESS-LIFETIME — the arena spills
-            # cold pages to disk for capacity, but its page table and
-            # the set's column metadata are in-memory only, so a paged
-            # set does not survive restart (re-ingest it; the reference
-            # durability story maps to "memory" sets + .pdbset)
-            raise ValueError(f"set {ident} is paged; paged sets are "
-                             f"process-lifetime (arena spill files are "
-                             f"capacity, not durability) — use "
-                             f"storage='memory' for persistent sets")
         items = self.get_items(ident)
         path = self._spill_path(ident)
         payload = []
@@ -399,9 +396,16 @@ class SetStore:
                     ("tensor", np.asarray(item.data), item.meta.shape,
                      item.meta.block_shape)
                 )
+            elif isinstance(item, PagedColumns):
+                # HOST-side snapshot (numpy columns): the flush path
+                # must never materialize the relation in device memory
+                payload.append(("paged", item.to_host_table(), None, None))
             else:
                 payload.append(("object", item, None, None))
         record = {"ident": tuple(s.ident), "persistence": s.persistence,
+                  "storage": s.storage,
+                  "placement": (s.placement.to_meta()
+                                if s.placement is not None else None),
                   "items": payload}
         with open(path, "wb") as f:
             if self.config.enable_compression:
@@ -470,6 +474,32 @@ class SetStore:
             else:
                 f.seek(0)
                 blob = pickle.load(f)
+        # restore the set-level attributes the record carries: a fresh
+        # load_set builds a bare _StoredSet, and paged-ness/placement
+        # must come back BEFORE ingest (placement rounds the page row
+        # count to the shard granularity)
+        if blob.get("storage"):
+            s.storage = blob["storage"]
+        if s.placement is None and blob.get("placement"):
+            from netsdb_tpu.parallel.placement import Placement
+
+            s.placement = Placement.from_meta(blob["placement"])
+        paged_tables = [data for kind, data, _, _ in blob["items"]
+                        if kind == "paged"]
+        if paged_tables:
+            # snapshot of a paged set: re-ingest the relation into the
+            # arena — the set comes back PAGED, placement and all
+            self._ingest_paged(s, paged_tables)
+            self.stats.misses += 1
+            self.stats.loads += 1
+            return
+        if s.storage == "paged":
+            # empty paged snapshot: nothing to ingest, but the set must
+            # NOT silently demote to resident storage
+            s.items = []
+            s.nbytes = 0
+            self.stats.loads += 1
+            return
         items: List[Any] = []
         for kind, data, shape, block_shape in blob["items"]:
             if kind == "tensor":

@@ -215,12 +215,41 @@ def test_paged_set_analyze_and_get_table(paged_client, tables):
                                   np.asarray(li["l_orderkey"]))
 
 
-def test_paged_set_rejects_flush_and_survives_eviction_pressure(
-        paged_client, tables):
+def test_paged_set_flush_reload_roundtrip_comes_back_paged(
+        tmp_path, tables):
+    """The reference's soft-reboot durability for paged sets: flush
+    snapshots the relation; a FRESH client over the same root re-loads
+    it and the set comes back PAGED (re-ingested into the arena), with
+    content and queryability intact."""
+    from netsdb_tpu.relational.outofcore import PagedColumns
+
+    c = _paged_client(tmp_path, tables,
+                      placement=Placement.data_parallel(ndim=1))
     ident = SetIdentifier("d", "lineitem")
-    with pytest.raises(ValueError, match="paged"):
-        paged_client.store.flush(ident)
-    assert paged_client.store.set_stats(ident)["storage"] == "paged"
+    c.store.flush(ident)
+    assert c.store.set_stats(ident)["storage"] == "paged"
+
+    c2 = Client(Configuration(root_dir=str(tmp_path / "paged"),
+                              page_size_bytes=4096, page_pool_bytes=16384))
+    c2.store.load_set(ident)
+    items = c2.store.get_items(ident)
+    assert len(items) == 1 and isinstance(items[0], PagedColumns)
+    assert c2.store.set_stats(ident)["storage"] == "paged"
+    # placement came back with the snapshot (chunks still mesh-shard)
+    pl = c2.store.placement_of(ident)
+    assert pl is not None and pl.axis_size() == len(jax.devices())
+    assert items[0].row_block % pl.axis_size() == 0
+    t = c2.get_table("d", "lineitem")
+    np.testing.assert_array_equal(
+        np.sort(np.asarray(t["l_orderkey"])),
+        np.sort(np.asarray(tables["lineitem"]["l_orderkey"])))
+    # and the reloaded paged set still streams through the DAG
+    c2.create_database("d")
+    c2.catalog.create_set("d", "lineitem", "table", {}, "transient")
+    out = rdag.run_query(c2, rdag.q06_sink("d"))
+    ref = dict(cq06(tables))["revenue"]
+    np.testing.assert_allclose(
+        float(np.asarray(out["revenue"])[0]), ref, rtol=1e-5)
 
 
 # ------------------------------------------------ review-fix regressions
@@ -235,7 +264,7 @@ def test_remove_paged_set_frees_arena_pages(tmp_path, tables):
     assert store.stats()["bytes_allocated"] < used_before // 4
 
 
-def test_flush_data_skips_persistent_paged_sets(tmp_path, tables):
+def test_flush_data_snapshots_persistent_paged_sets(tmp_path, tables):
     c = _paged_client(tmp_path, tables, facts=())
     c.create_set("d", "paged_persist", type_name="table", storage="paged",
                  persistence="persistent")
@@ -243,13 +272,12 @@ def test_flush_data_skips_persistent_paged_sets(tmp_path, tables):
     c.create_set("d", "plain_persist", type_name="table",
                  persistence="persistent")
     c.send_table("d", "plain_persist", tables["orders"])
-    c.flush_data()  # must not raise on the paged set
-    # the plain persistent set actually flushed
-    from netsdb_tpu.storage.store import SetIdentifier
+    c.flush_data()  # snapshots BOTH, paged included
     import os
 
-    assert os.path.exists(
-        c.store._spill_path(SetIdentifier("d", "plain_persist")))
+    for name in ("paged_persist", "plain_persist"):
+        assert os.path.exists(
+            c.store._spill_path(SetIdentifier("d", name)))
 
 
 def test_q03_sink_for_unknown_segment_returns_empty(paged_client):
@@ -301,3 +329,21 @@ def test_foldless_consumer_materialize_fallback(paged_client, tables,
         np.sort(np.asarray(vals["out_a"]["l_orderkey"])),
         np.sort(np.asarray(tables["lineitem"]["l_orderkey"])))
     assert vals["out_b"].num_rows == tables["lineitem"].num_rows
+
+
+def test_empty_paged_set_snapshot_keeps_storage(tmp_path):
+    """An empty paged set's snapshot must not demote it to resident
+    storage on reload (the arena opt-in survives)."""
+    cfg = Configuration(root_dir=str(tmp_path / "ep"),
+                        page_size_bytes=4096, page_pool_bytes=16384)
+    c = Client(cfg)
+    c.create_database("d")
+    c.create_set("d", "empty_paged", type_name="table", storage="paged",
+                 persistence="persistent")
+    ident = SetIdentifier("d", "empty_paged")
+    c.store.flush(ident)
+    c2 = Client(Configuration(root_dir=str(tmp_path / "ep"),
+                              page_size_bytes=4096,
+                              page_pool_bytes=16384))
+    c2.store.load_set(ident)
+    assert c2.store.set_stats(ident)["storage"] == "paged"
