@@ -289,6 +289,15 @@ class PageStore {
     return static_cast<int64_t>(it->second.pages[index]);
   }
 
+  // payload bytes of one page WITHOUT touching its data (no pin, no
+  // reload) — per-page row counts for ragged (appended) block streams
+  int64_t page_size(uint64_t page_id) {
+    std::lock_guard<std::mutex> g(mu_);
+    auto it = pages_.find(page_id);
+    if (it == pages_.end()) return -1;
+    return static_cast<int64_t>(it->second->size);
+  }
+
   void get_stats(uint64_t* out) {  // 7 slots
     out[0] = stats_.hits;
     out[1] = stats_.misses;
@@ -474,6 +483,9 @@ int64_t ps_set_page_count(void* h, uint64_t set_id) {
 }
 int64_t ps_set_page_id(void* h, uint64_t set_id, uint64_t index) {
   return static_cast<PageStore*>(h)->set_page_id(set_id, index);
+}
+int64_t ps_page_size(void* h, uint64_t page_id) {
+  return static_cast<PageStore*>(h)->page_size(page_id);
 }
 void ps_stats(void* h, uint64_t* out7) {
   static_cast<PageStore*>(h)->get_stats(out7);

@@ -310,18 +310,34 @@ class Client:
         return t
 
     def send_table(self, db: str, set_name: str, rows_or_table,
-                   date_cols: Sequence[str] = ()) -> "Any":
+                   date_cols: Sequence[str] = (),
+                   append: bool = False) -> "Any":
         """Ingest a relation as ONE ColumnTable (dictionary-encoding
         string columns on the way in — weak-typed rows become device
         columns, the reference's dispatcher page-building role). If the
         set carries a placement, the store shards the table's rows over
         the mesh (PartitionPolicy applied at ingest,
-        ``src/dispatcher/headers/PartitionPolicy.h:27-50``)."""
+        ``src/dispatcher/headers/PartitionPolicy.h:27-50``).
+
+        ``append=True`` adds the batch to the stored relation instead
+        of replacing it — the reference's addData continuously
+        appending pages (``StorageAddData``): paged sets write
+        additional arena pages, memory sets concat with dictionary
+        remap; both atomic under the store lock."""
         from netsdb_tpu.relational.table import ColumnTable
 
         table = (rows_or_table if isinstance(rows_or_table, ColumnTable)
                  else ColumnTable.from_rows(list(rows_or_table), date_cols))
         ident = _ident(db, set_name)
+        if append:
+            self.store.append_table(ident, table)
+            cat = self.catalog.get_set(db, set_name)
+            if cat is not None:  # catalog reflects the TOTAL after append
+                info = self.analyze_set(db, set_name)
+                cat["meta"].update(num_rows=info["num_rows"],
+                                   columns=sorted(table.cols))
+                self.catalog.update_set_meta(db, set_name, cat["meta"])
+            return table
         self.store.clear_set(ident)
         self.store.add_data(ident, [table])
         cat = self.catalog.get_set(db, set_name)

@@ -55,6 +55,8 @@ def _load():
     lib.ps_set_page_id.restype = ctypes.c_int64
     lib.ps_set_page_id.argtypes = [ctypes.c_void_p, ctypes.c_uint64,
                                    ctypes.c_uint64]
+    lib.ps_page_size.restype = ctypes.c_int64
+    lib.ps_page_size.argtypes = [ctypes.c_void_p, ctypes.c_uint64]
     lib.ps_stats.argtypes = [ctypes.c_void_p,
                              ctypes.POINTER(ctypes.c_uint64)]
     _lib = lib
@@ -145,6 +147,13 @@ class NativePageStore:
             raise KeyError(f"unknown set {set_id}")
         return [int(self._lib.ps_set_page_id(self._h, set_id, i))
                 for i in range(n)]
+
+    def page_size(self, page_id: int) -> int:
+        """Payload bytes of one page, metadata-only (no pin/reload)."""
+        n = self._lib.ps_page_size(self._h, page_id)
+        if n < 0:
+            raise KeyError(f"unknown page {page_id}")
+        return int(n)
 
     def stats(self) -> dict:
         arr = (ctypes.c_uint64 * 7)()

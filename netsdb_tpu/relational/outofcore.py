@@ -110,12 +110,47 @@ class PagedColumns:
                                           table.dicts.items()
                                           if n in columns})
 
+    # ------------------------------------------------------------ append
+    def append(self, cols: Dict[str, np.ndarray]) -> None:
+        """Append a batch of rows as ADDITIONAL pages (the reference's
+        addData continuously appending to a set) — no rewrite of
+        existing pages; ingest-time stats merge with the batch's."""
+        from netsdb_tpu.relational.stats import ColumnStats, analyze_array
+
+        lengths = {n: len(np.asarray(c)) for n, c in cols.items()}
+        if set(cols) != set(self.int_names) | set(self.float_names):
+            raise ValueError(
+                f"append schema mismatch: have "
+                f"{sorted(set(self.int_names) | set(self.float_names))}, "
+                f"got {sorted(cols)}")
+        if len(set(lengths.values())) > 1:
+            raise ValueError(f"ragged columns cannot page together: "
+                             f"{lengths}")
+        n_new = next(iter(lengths.values()))
+        if self.int_names:
+            imat = np.stack([np.asarray(cols[n]).astype(np.int32)
+                             for n in self.int_names], axis=1)
+            for j, name in enumerate(self.int_names):
+                new = analyze_array(imat[:, j])
+                old = self.stats.get(name)
+                self.stats[name] = (new if old is None else ColumnStats(
+                    old.n_rows + new.n_rows, min(old.min_val, new.min_val),
+                    max(old.max_val, new.max_val), -1))
+            self.store.put(f"{self.name}.int", imat, append=True)
+        if self.float_names:
+            fmat = np.stack([np.asarray(cols[n]).astype(np.float32)
+                             for n in self.float_names], axis=1)
+            self.store.put(f"{self.name}.float", fmat, append=True)
+        self.num_rows += n_new
+
     # ------------------------------------------------------------ stream
     def stream(self, prefetch: int = 2
-               ) -> Iterator[Tuple[Dict[str, jnp.ndarray], jnp.ndarray]]:
-        """Yield (cols, valid) per chunk, every chunk padded to
-        ``row_block`` rows — the PageScanner loop feeding the compiled
-        chunk step. Ragged tails are masked, never reshaped."""
+               ) -> Iterator[Tuple[Dict[str, jnp.ndarray], jnp.ndarray, int]]:
+        """Yield (cols, valid, start_row) per chunk, every chunk padded
+        to ``row_block`` rows — the PageScanner loop feeding the
+        compiled chunk step. Ragged blocks (appended batches' tails)
+        are masked, never reshaped; ``start_row`` is the chunk's global
+        row offset (exact even for ragged streams)."""
         streams = []
         if self.int_names:
             streams.append((self.int_names,
@@ -159,7 +194,7 @@ class PagedColumns:
                 chunk = {k: np.pad(v, (0, pad)) for k, v in chunk.items()}
             valid = np.arange(self.row_block) < n
             yield ({k: jnp.asarray(v) for k, v in chunk.items()},
-                   jnp.asarray(valid))
+                   jnp.asarray(valid), start)
 
     def drop(self) -> None:
         """Free this relation's pages from the shared arena (both the
@@ -180,10 +215,12 @@ class PagedColumns:
         ``PipelineStage.cc:228-265``). Ingest rounds ``row_block`` to
         the shard granularity, so placed chunks shard without a second
         padding round."""
-        start = 0
         base_rowid = jnp.arange(self.row_block, dtype=jnp.int32)
-        for cols, valid in self.stream(prefetch):
+        for cols, valid, start in self.stream(prefetch):
             cols = dict(cols)
+            # the stream's own start is exact even for ragged
+            # (appended) block sequences; invalid tail rows get bogus
+            # ids, masked like everything else
             cols["_rowid"] = base_rowid + start
             t = ColumnTable(cols, self.dicts, valid)
             if placement is not None:
@@ -191,9 +228,6 @@ class PagedColumns:
 
                 t = shard_table(t, placement)
             yield t
-            # blocks are contiguous equal row ranges (only the tail is
-            # short), so the next chunk starts one full block later
-            start += self.row_block
 
     def to_host_table(self) -> ColumnTable:
         """Materialize the relation as one HOST-resident ColumnTable
@@ -202,7 +236,7 @@ class PagedColumns:
         large the paged relation is."""
         parts: Dict[str, List[np.ndarray]] = {}
         n_done = 0
-        for cols, valid in self.stream():
+        for cols, valid, _start in self.stream():
             n = int(np.asarray(valid).sum())
             for k, v in cols.items():
                 parts.setdefault(k, []).append(np.asarray(v)[:n])

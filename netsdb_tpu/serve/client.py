@@ -267,7 +267,8 @@ class RemoteClient:
                       codec=CODEC_PICKLE)
 
     def send_table(self, db: str, set_name: str, rows_or_table,
-                   date_cols: Sequence[str] = ()) -> "RemoteTableInfo":
+                   date_cols: Sequence[str] = (),
+                   append: bool = False) -> "RemoteTableInfo":
         """Ship rows (or a pre-built ColumnTable) for daemon-side
         columnar ingest — dictionary encoding + the set's placement
         happen server-side, where the devices are. Returns a
@@ -281,7 +282,8 @@ class RemoteClient:
         reply = self._request(
             MsgType.SEND_DATA,
             {"db": db, "set": set_name, "items": items,
-             "as_table": True, "date_cols": list(date_cols)},
+             "as_table": True, "date_cols": list(date_cols),
+             "append": append},
             codec=CODEC_PICKLE)
         return RemoteTableInfo(reply["count"], list(reply["columns"]))
 

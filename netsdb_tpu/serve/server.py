@@ -561,9 +561,11 @@ class ServeController:
         # objects arrive via the pickle codec (whole payload is a dict)
         if p.get("as_table"):
             # rows → one dictionary-encoded ColumnTable, sharded by the
-            # set's placement (dispatcher page-building + partitioning)
+            # set's placement (dispatcher page-building + partitioning);
+            # append=True adds the batch instead of replacing
             t = self.library.send_table(p["db"], p["set"], p["items"],
-                                        date_cols=p.get("date_cols", ()))
+                                        date_cols=p.get("date_cols", ()),
+                                        append=bool(p.get("append")))
             return MsgType.OK, {"count": t.num_rows,
                                 "columns": sorted(t.cols)}
         self.library.send_data(p["db"], p["set"], p["items"])

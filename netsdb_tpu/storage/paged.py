@@ -58,6 +58,9 @@ class _PyPageBackend:
     def set_pages(self, set_id):
         return list(self._sets[set_id])
 
+    def page_size(self, page_id) -> int:
+        return len(self._pages[page_id])
+
     def flush_set(self, set_id):
         pass
 
@@ -81,6 +84,10 @@ class PagedTensorStore:
         config.ensure_dirs()
         self._meta: Dict[int, Tuple[Tuple[int, int], Tuple[int, int], np.dtype]] = {}
         self._ids: Dict[str, int] = {}
+        # per-set (block_rows, block_starts) cache — derived from page
+        # sizes once and reused, so read_block/stream starts stay O(1)
+        # per call instead of O(pages); invalidated on put/append/drop
+        self._layout: Dict[int, Tuple[list, list]] = {}
         # live prefetch reader threads: must be joined before the
         # backend is destroyed (a reader mid-read_page on a freed C++
         # arena is a use-after-free); mutations happen under _readers_lock
@@ -111,13 +118,31 @@ class PagedTensorStore:
         return self._ids[name]
 
     def put(self, name: str, dense: np.ndarray,
-            row_block: Optional[int] = None) -> None:
-        """Page a matrix in as contiguous row-blocks."""
+            row_block: Optional[int] = None,
+            append: bool = False) -> None:
+        """Page a matrix in as contiguous row-blocks. ``append=True``
+        writes the batch as ADDITIONAL pages after the existing ones
+        (the reference's addData appending pages to a set): blocks may
+        then be ragged mid-stream (each batch's tail is short), which
+        every reader handles by deriving per-page row counts from the
+        actual page sizes (``_block_rows``)."""
         dense = np.ascontiguousarray(dense)
         if dense.ndim != 2:
             raise ValueError(f"paged store holds matrices; got rank-{dense.ndim} "
                              f"array of shape {dense.shape}")
         rows, cols = dense.shape
+        if append and name in self._ids:
+            sid = self._ids[name]
+            (orows, ocols), (rb, _), dtype = self._meta[sid]
+            if ocols != cols or dtype != dense.dtype:
+                raise ValueError(
+                    f"append to {name!r}: schema mismatch "
+                    f"({ocols} cols/{dtype} vs {cols} cols/{dense.dtype})")
+            for r0 in range(0, rows, rb):
+                self.backend.write_page(sid, dense[r0:r0 + rb])
+            self._meta[sid] = ((orows + rows, cols), (rb, cols), dtype)
+            self._layout.pop(sid, None)
+            return
         row_block = row_block or max(
             1, self.config.page_size_bytes // max(dense.dtype.itemsize * cols, 1))
         replacing = name in self._ids
@@ -129,6 +154,25 @@ class PagedTensorStore:
         for r0 in range(0, rows, row_block):
             self.backend.write_page(sid, dense[r0:r0 + row_block])
         self._meta[sid] = ((rows, cols), (row_block, cols), dense.dtype)
+        self._layout.pop(sid, None)
+
+    def _block_layout(self, sid: int) -> Tuple[list, list]:
+        """(per-page row counts, per-page start rows), derived from
+        ACTUAL page sizes (metadata-only backend calls) — correct for
+        ragged appended streams, where start = index * row_block would
+        lie. Cached per set (O(pages) once, O(1) per access)."""
+        cached = self._layout.get(sid)
+        if cached is not None:
+            return cached
+        import itertools
+
+        (rows, cols), _, dtype = self._meta[sid]
+        width = max(dtype.itemsize * cols, 1)
+        ns = [self.backend.page_size(pid) // width
+              for pid in self.backend.set_pages(sid)]
+        starts = list(itertools.accumulate([0] + ns[:-1]))
+        self._layout[sid] = (ns, starts)
+        return ns, starts
 
     def read_block(self, name: str, index: int) -> Tuple[int, np.ndarray]:
         """Random access to one row-block: (start_row, block). The
@@ -138,15 +182,15 @@ class PagedTensorStore:
         ``row_block=partition_rows`` makes partition *p* exactly block
         *p*, resident only while probed, spillable in between."""
         sid = self._ids[name]
-        (rows, cols), (rb, _), dtype = self._meta[sid]
+        (rows, cols), _, dtype = self._meta[sid]
         pids = self.backend.set_pages(sid)
         if not 0 <= index < len(pids):
             raise IndexError(f"block {index} out of range "
                              f"({len(pids)} blocks in {name!r})")
-        start = index * rb
-        n = min(rb, rows - start)
+        ns, starts = self._block_layout(sid)
         raw = self.backend.read_page(pids[index])
-        return start, np.frombuffer(raw, dtype=dtype).reshape(n, cols)
+        return starts[index], np.frombuffer(raw, dtype=dtype).reshape(
+            ns[index], cols)
 
     def num_blocks(self, name: str) -> int:
         return len(self.backend.set_pages(self._ids[name]))
@@ -161,16 +205,12 @@ class PagedTensorStore:
         so disk/arena reads overlap the consumer's compute; 0 disables.
         """
         sid = self._ids[name]
-        (rows, cols), (rb, _), dtype = self._meta[sid]
+        (rows, cols), _, dtype = self._meta[sid]
         pids = self.backend.set_pages(sid)
-        starts = []
-        r0 = 0
-        for _ in pids:
-            starts.append(r0)
-            r0 += min(rb, rows - r0)
+        _, starts = self._block_layout(sid)
 
         def view(raw, start):
-            n = min(rb, rows - start)
+            n = len(raw) // max(dtype.itemsize * cols, 1)
             return np.frombuffer(raw, dtype=dtype).reshape(n, cols)
 
         if prefetch <= 0 or len(pids) <= 1:
@@ -279,6 +319,7 @@ class PagedTensorStore:
         for pid in self.backend.set_pages(sid):
             self.backend.free_page(pid)
         self._meta.pop(sid, None)
+        self._layout.pop(sid, None)
 
     def stats(self) -> dict:
         return self.backend.stats()

@@ -234,12 +234,16 @@ class SetStore:
         s.last_access = time.time()
         self._maybe_evict(exclude=ident)
 
-    def _ingest_paged(self, s: _StoredSet, items: List[Any]) -> None:
+    def _ingest_paged(self, s: _StoredSet, items: List[Any],
+                      append: bool = False) -> None:
         """Route a relation into the page arena instead of RAM — the set
         property the reference expresses by EVERY set living in pages
         (``PangeaStorageServer.h:31-52``); here only sets that opt into
         streaming pay the page granularity. One relation per paged set
-        (matching ``send_table`` semantics); re-ingest replaces."""
+        (matching ``send_table`` semantics); re-ingest replaces, or
+        APPENDS new pages when asked (the reference's addData flow) —
+        dictionary-encoded batch columns remap into the stored
+        dictionaries first."""
         from netsdb_tpu.relational.outofcore import PagedColumns
         from netsdb_tpu.relational.table import ColumnTable
 
@@ -253,6 +257,36 @@ class SetStore:
         if not isinstance(item, ColumnTable):
             raise TypeError(f"paged set {s.ident} ingests ColumnTables; "
                             f"got {type(item).__name__}")
+        existing = [i for i in (s.items or [])
+                    if isinstance(i, PagedColumns)]
+        if append and existing:
+            pc = existing[0]
+            from netsdb_tpu.relational.autojoin import merge_dicts
+
+            cols = {n: np.asarray(item[n]) for n in item.cols
+                    if n != "_rowid"}
+            if item.valid is not None:
+                keep = np.asarray(item.mask())
+                cols = {n: c[keep] for n, c in cols.items()}
+            missing = [n for n in pc.dicts
+                       if n in cols and n not in item.dicts]
+            if missing:
+                raise ValueError(
+                    f"append to {s.ident}: columns {missing} are "
+                    f"dict-encoded in the stored set but arrive as raw "
+                    f"ints — codes would be meaningless")
+            for name, d_new in item.dicts.items():
+                d_old = pc.dicts.get(name)
+                if d_old is None:
+                    raise ValueError(f"append to {s.ident}: column "
+                                     f"{name!r} is dict-encoded in the "
+                                     f"batch but not in the stored set")
+                merged, remap = merge_dicts(d_old, d_new)
+                pc.dicts[name] = merged
+                cols[name] = remap[cols[name]]
+            pc.append(cols)
+            s.last_access = time.time()
+            return
         # page row count sized to the configured page bytes (floor 64 so
         # tiny test pages still hold whole rows); for placed sets,
         # rounded to the shard granularity so streamed chunks mesh-shard
@@ -289,6 +323,38 @@ class SetStore:
             items = [s.placement.apply(i) for i in items]
         s.items = items
         s.nbytes = sum(_item_nbytes(i) for i in items)
+        s.last_access = time.time()
+        self._maybe_evict(exclude=ident)
+
+    @_locked
+    def append_table(self, ident: SetIdentifier, table) -> None:
+        """Append a batch of rows to a table set (the reference's
+        addData flow, ``StorageAddData``): paged sets write additional
+        arena pages (no rewrite); memory sets concat on device with
+        dictionary remap. Atomic under the store lock."""
+        from netsdb_tpu.relational.autojoin import concat_tables
+        from netsdb_tpu.relational.table import ColumnTable
+
+        s = self._require(ident)
+        if s.alias_of is not None:
+            raise ValueError(f"set {ident} aliases {s.alias_of}; it is read-only")
+        if s.storage == "paged":
+            # first batch falls through to a fresh ingest inside
+            self._ingest_paged(s, [table], append=True)
+            return
+        if s.items is None:
+            self._load_from_spill(s)
+        tables = [i for i in s.items if isinstance(i, ColumnTable)]
+        if len(s.items) != len(tables) or len(tables) > 1:
+            raise ValueError(
+                f"append_table needs a single-relation table set; "
+                f"{ident} holds {len(s.items)} items "
+                f"({len(tables)} tables) — appending would drop the rest")
+        new = concat_tables(tables[0], table) if tables else table
+        if s.placement is not None:
+            new = s.placement.apply(new)
+        s.items = [new]
+        s.nbytes = _item_nbytes(new)
         s.last_access = time.time()
         self._maybe_evict(exclude=ident)
 

@@ -30,7 +30,7 @@ def test_paged_columns_roundtrip(tables):
     store = _store()
     pc = O.PagedColumns.from_table(store, "lineitem", li, O.Q01_COLUMNS)
     seen = 0
-    for cols, valid in pc.stream():
+    for cols, valid, _start in pc.stream():
         n = int(np.asarray(valid).sum())
         got = np.asarray(cols["l_quantity"])[:n]
         want = np.asarray(li["l_quantity"])[seen:seen + n]

@@ -347,3 +347,102 @@ def test_empty_paged_set_snapshot_keeps_storage(tmp_path):
                               page_pool_bytes=16384))
     c2.store.load_set(ident)
     assert c2.store.set_stats(ident)["storage"] == "paged"
+
+
+# ---------------------------------------------------- append ingest (r4)
+def test_append_ingest_paged_matches_single_batch(tmp_path, tables):
+    """send_table(append=True) writes ADDITIONAL arena pages (ragged
+    blocks mid-stream); queries over the appended set match one-shot
+    ingest of the concatenated rows — the reference's addData flow."""
+    li = tables["lineitem"]
+    n = li.num_rows
+    rows_np = {k: np.asarray(li[k]) for k in li.cols}
+    first = {k: v[:n // 2] for k, v in rows_np.items()}
+    second = {k: v[n // 2:] for k, v in rows_np.items()}
+    from netsdb_tpu.relational.table import ColumnTable as CT
+
+    cfg = Configuration(root_dir=str(tmp_path / "ap"),
+                        page_size_bytes=4096, page_pool_bytes=16384)
+    c = Client(cfg)
+    c.create_database("d")
+    for name, t in tables.items():
+        if name == "lineitem":
+            c.create_set("d", name, type_name="table", storage="paged")
+            c.send_table("d", name, CT(first, dict(li.dicts)))
+            c.send_table("d", name, CT(second, dict(li.dicts)),
+                         append=True)
+        else:
+            c.create_set("d", name, type_name="table")
+            c.send_table("d", name, t)
+    info = c.analyze_set("d", "lineitem")
+    assert info["num_rows"] == n
+    assert info["stats"]["l_orderkey"].key_space == \
+        int(rows_np["l_orderkey"].max()) + 1
+
+    out = rdag.run_query(c, rdag.q01_sink("d"))
+    got = {(r["l_returnflag"], r["l_linestatus"]): r for r in out.to_rows()}
+    for key, v in cq01(tables):
+        np.testing.assert_allclose(got[key]["sum_charge"], v["sum_charge"],
+                                   rtol=1e-5)
+        assert got[key]["count"] == v["count"]
+    r3 = rdag.run_query(c, rdag.q03_sink_for(c, "d"))
+    assert [r["okey"] for r in rdag.q03_rows(r3)] == \
+        [r["okey"] for r in cq03(tables)]
+    _assert_spilled(c)
+
+
+def test_append_ingest_memory_table_concat_with_dict_remap(tmp_path):
+    c = Client(Configuration(root_dir=str(tmp_path / "am")))
+    c.create_database("d")
+    c.create_set("d", "t", type_name="table")
+    c.send_table("d", "t", [{"k": "a", "v": 1}, {"k": "b", "v": 2}])
+    c.send_table("d", "t", [{"k": "c", "v": 3}, {"k": "a", "v": 4}],
+                 append=True)
+    t = c.get_table("d", "t")
+    assert t.dicts["k"] == ["a", "b", "c"]
+    assert sorted((r["k"], r["v"]) for r in t.to_rows()) == \
+        [("a", 1), ("a", 4), ("b", 2), ("c", 3)]
+
+
+def test_append_ingest_paged_with_new_dict_entries(tmp_path):
+    """Appended batches whose string columns carry NEW dictionary
+    entries remap into the stored dictionaries (merge_dicts), and
+    earlier pages' codes stay valid."""
+    cfg = Configuration(root_dir=str(tmp_path / "ad"),
+                        page_size_bytes=4096, page_pool_bytes=16384)
+    c = Client(cfg)
+    c.create_database("d")
+    c.create_set("d", "ev", type_name="table", storage="paged")
+    c.send_table("d", "ev", [{"kind": "x", "n": i} for i in range(100)])
+    c.send_table("d", "ev", [{"kind": "y", "n": i} for i in range(50)],
+                 append=True)
+    t = c.get_table("d", "ev")
+    kinds = [t.dicts["kind"][int(code)]
+             for code in np.asarray(t["kind"])]
+    assert kinds.count("x") == 100 and kinds.count("y") == 50
+
+
+def test_append_rejects_raw_ints_into_dict_column(tmp_path):
+    from netsdb_tpu.relational.table import ColumnTable as CT
+
+    c = Client(Configuration(root_dir=str(tmp_path / "ar"),
+                             page_size_bytes=4096, page_pool_bytes=16384))
+    c.create_database("d")
+    c.create_set("d", "ev", type_name="table", storage="paged")
+    c.send_table("d", "ev", [{"kind": "x", "n": 1}])
+    bad = CT({"kind": np.asarray([7], np.int32),
+              "n": np.asarray([2], np.int32)})  # raw ints, no dict
+    with pytest.raises(ValueError, match="dict-encoded in the stored"):
+        c.send_table("d", "ev", bad, append=True)
+
+
+def test_append_table_refuses_multi_item_sets(tmp_path):
+    c = Client(Configuration(root_dir=str(tmp_path / "mi")))
+    c.create_database("d")
+    c.create_set("d", "objs", type_name="object")
+    c.send_data("d", "objs", [1, 2, 3])
+    from netsdb_tpu.relational.table import ColumnTable as CT
+
+    with pytest.raises(ValueError, match="single-relation"):
+        c.store.append_table(SetIdentifier("d", "objs"),
+                             CT({"v": np.asarray([1], np.int32)}))
