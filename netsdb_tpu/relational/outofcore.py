@@ -63,6 +63,25 @@ class PagedColumns:
 
     # ------------------------------------------------------------ ingest
     @staticmethod
+    def _pack(cols: Dict[str, np.ndarray], int_names: List[str],
+              float_names: List[str]):
+        """Columns → (int32 matrix, float32 matrix, row count), the ONE
+        packing used by ingest and append (divergent packing would make
+        appended pages unreadable against ingested ones)."""
+        lengths = {n: len(np.asarray(c)) for n, c in cols.items()}
+        if len(set(lengths.values())) > 1:
+            raise ValueError(f"ragged columns cannot page together: "
+                             f"{lengths}")
+        n = next(iter(lengths.values()))
+        imat = (np.stack([np.asarray(cols[c]).astype(np.int32)
+                          for c in int_names], axis=1)
+                if int_names else None)
+        fmat = (np.stack([np.asarray(cols[c]).astype(np.float32)
+                          for c in float_names], axis=1)
+                if float_names else None)
+        return imat, fmat, n
+
+    @staticmethod
     def ingest(store: PagedTensorStore, name: str,
                cols: Dict[str, np.ndarray],
                row_block: Optional[int] = None,
@@ -74,11 +93,8 @@ class PagedColumns:
                            if np.asarray(c).dtype.kind in _INT_KINDS)
         float_names = sorted(n for n, c in cols.items()
                              if n not in int_names)
-        lengths = {n: len(np.asarray(c)) for n, c in cols.items()}
-        if len(set(lengths.values())) > 1:
-            raise ValueError(f"ragged columns cannot page together: "
-                             f"{lengths}")
-        num_rows = next(iter(lengths.values()))
+        imat, fmat, num_rows = PagedColumns._pack(cols, int_names,
+                                                  float_names)
         if row_block is None:
             width = max(len(int_names) + len(float_names), 1)
             row_block = max(store.config.page_size_bytes // (4 * width),
@@ -87,15 +103,11 @@ class PagedColumns:
         from netsdb_tpu.relational.stats import analyze_array
 
         stats = {}
-        if int_names:
-            imat = np.stack([np.asarray(cols[n]).astype(np.int32)
-                             for n in int_names], axis=1)
+        if imat is not None:
             stats = {n: analyze_array(imat[:, j])
                      for j, n in enumerate(int_names)}
             store.put(f"{name}.int", imat, row_block=row_block)
-        if float_names:
-            fmat = np.stack([np.asarray(cols[n]).astype(np.float32)
-                             for n in float_names], axis=1)
+        if fmat is not None:
             store.put(f"{name}.float", fmat, row_block=row_block)
         return PagedColumns(store, name, int_names, float_names,
                             num_rows, row_block, dicts, stats)
@@ -114,33 +126,41 @@ class PagedColumns:
     def append(self, cols: Dict[str, np.ndarray]) -> None:
         """Append a batch of rows as ADDITIONAL pages (the reference's
         addData continuously appending to a set) — no rewrite of
-        existing pages; ingest-time stats merge with the batch's."""
+        existing pages. ATOMIC at the relation level: a failure while
+        writing either matrix rolls both back to the pre-append page
+        count (a half-written batch would otherwise desynchronize the
+        co-paged int/float streams and brick the whole set). Stats and
+        ``num_rows`` update only after both writes succeed."""
         from netsdb_tpu.relational.stats import ColumnStats, analyze_array
 
-        lengths = {n: len(np.asarray(c)) for n, c in cols.items()}
         if set(cols) != set(self.int_names) | set(self.float_names):
             raise ValueError(
                 f"append schema mismatch: have "
                 f"{sorted(set(self.int_names) | set(self.float_names))}, "
                 f"got {sorted(cols)}")
-        if len(set(lengths.values())) > 1:
-            raise ValueError(f"ragged columns cannot page together: "
-                             f"{lengths}")
-        n_new = next(iter(lengths.values()))
-        if self.int_names:
-            imat = np.stack([np.asarray(cols[n]).astype(np.int32)
-                             for n in self.int_names], axis=1)
-            for j, name in enumerate(self.int_names):
-                new = analyze_array(imat[:, j])
-                old = self.stats.get(name)
-                self.stats[name] = (new if old is None else ColumnStats(
-                    old.n_rows + new.n_rows, min(old.min_val, new.min_val),
-                    max(old.max_val, new.max_val), -1))
-            self.store.put(f"{self.name}.int", imat, append=True)
-        if self.float_names:
-            fmat = np.stack([np.asarray(cols[n]).astype(np.float32)
-                             for n in self.float_names], axis=1)
-            self.store.put(f"{self.name}.float", fmat, append=True)
+        imat, fmat, n_new = self._pack(cols, self.int_names,
+                                       self.float_names)
+        if n_new == 0:
+            return  # all-masked/empty batch: a no-op, not a stats merge
+        undo = []
+        for suffix, mat in ((".int", imat), (".float", fmat)):
+            if mat is None:
+                continue
+            full = self.name + suffix
+            undo.append((full, self.store.num_blocks(full),
+                         self.num_rows))
+            try:
+                self.store.put(full, mat, append=True)
+            except Exception:
+                for uname, npages, rows in undo:
+                    self.store.truncate_to(uname, npages, rows)
+                raise
+        for j, name in enumerate(self.int_names):
+            new = analyze_array(imat[:, j])
+            old = self.stats.get(name)
+            self.stats[name] = (new if old is None else ColumnStats(
+                old.n_rows + new.n_rows, min(old.min_val, new.min_val),
+                max(old.max_val, new.max_val), -1))
         self.num_rows += n_new
 
     # ------------------------------------------------------------ stream

@@ -499,7 +499,7 @@ def q03_finish(joined: ShardedRows, gks: int, d: int, k: int):
 
 def q03_row_sink_for(client, db: str, segment: str = "BUILDING",
                      date: str = "1995-03-15", k: int = 10,
-                     slack: float = 2.0):
+                     slack: float = 2.0, n_parts: Optional[int] = None):
     """The row-output shuffle Q03 as a PARTITION-NODE DAG over placed
     sets — no hand mesh anywhere: the mesh comes off the stored
     columns' placement shardings, statistics come from
@@ -525,11 +525,18 @@ def q03_row_sink_for(client, db: str, segment: str = "BUILDING",
     # -1 for an unknown segment → empty result, not a build-time crash
     seg_code = seg_dict.index(segment) if segment in seg_dict else -1
     d = date_to_int(date)
-    pl = client.store.placement_of(SetIdentifier(db, "lineitem"))
-    if pl is None:
-        raise ValueError("q03_row_sink_for needs a placed lineitem set "
-                         "(the Partition nodes shuffle on its mesh)")
-    n_parts = pl.axis_size()
+    if n_parts is None:
+        # in-process: read the shard count off the set's placement;
+        # RemoteClients (no local store) pass n_parts explicitly
+        store = getattr(client, "store", None)
+        pl = (store.placement_of(SetIdentifier(db, "lineitem"))
+              if store is not None else None)
+        if pl is None:
+            raise ValueError(
+                "q03_row_sink_for needs a placed lineitem set (the "
+                "Partition nodes shuffle on its mesh) — or pass n_parts "
+                "explicitly when building from a RemoteClient")
+        n_parts = pl.axis_size()
     jp_cust = JoinPlan("lut", cust_ks)
 
     def filter_orders(orders: ColumnTable, cust: ColumnTable) -> ColumnTable:

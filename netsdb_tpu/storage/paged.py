@@ -156,6 +156,20 @@ class PagedTensorStore:
         self._meta[sid] = ((rows, cols), (row_block, cols), dense.dtype)
         self._layout.pop(sid, None)
 
+    def truncate_to(self, name: str, n_pages: int, rows: int) -> None:
+        """Roll a set back to its first ``n_pages`` pages / ``rows``
+        rows — the append-failure undo (frees the partially written
+        pages so a failed batch cannot desynchronize co-paged
+        matrices)."""
+        sid = self._ids.get(name)
+        if sid is None:
+            return
+        for pid in self.backend.set_pages(sid)[n_pages:]:
+            self.backend.free_page(pid)
+        (_, cols), (rb, _), dtype = self._meta[sid]
+        self._meta[sid] = ((rows, cols), (rb, cols), dtype)
+        self._layout.pop(sid, None)
+
     def _block_layout(self, sid: int) -> Tuple[list, list]:
         """(per-page row counts, per-page start rows), derived from
         ACTUAL page sizes (metadata-only backend calls) — correct for

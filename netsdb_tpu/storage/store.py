@@ -268,6 +268,14 @@ class SetStore:
             if item.valid is not None:
                 keep = np.asarray(item.mask())
                 cols = {n: c[keep] for n, c in cols.items()}
+            # validate EVERYTHING before mutating any stored state — a
+            # rejected batch must leave the set (dictionaries included)
+            # exactly as it was
+            expected = set(pc.int_names) | set(pc.float_names)
+            if set(cols) != expected:
+                raise ValueError(
+                    f"append to {s.ident}: schema mismatch — stored "
+                    f"{sorted(expected)}, batch {sorted(cols)}")
             missing = [n for n in pc.dicts
                        if n in cols and n not in item.dicts]
             if missing:
@@ -275,6 +283,7 @@ class SetStore:
                     f"append to {s.ident}: columns {missing} are "
                     f"dict-encoded in the stored set but arrive as raw "
                     f"ints — codes would be meaningless")
+            staged_dicts = {}
             for name, d_new in item.dicts.items():
                 d_old = pc.dicts.get(name)
                 if d_old is None:
@@ -282,9 +291,10 @@ class SetStore:
                                      f"{name!r} is dict-encoded in the "
                                      f"batch but not in the stored set")
                 merged, remap = merge_dicts(d_old, d_new)
-                pc.dicts[name] = merged
+                staged_dicts[name] = merged
                 cols[name] = remap[cols[name]]
-            pc.append(cols)
+            pc.append(cols)  # atomic (rolls back its pages on failure)
+            pc.dicts.update(staged_dicts)  # commit only after success
             s.last_access = time.time()
             return
         # page row count sized to the configured page bytes (floor 64 so

@@ -446,3 +446,45 @@ def test_append_table_refuses_multi_item_sets(tmp_path):
     with pytest.raises(ValueError, match="single-relation"):
         c.store.append_table(SetIdentifier("d", "objs"),
                              CT({"v": np.asarray([1], np.int32)}))
+
+
+def test_append_failure_rolls_back_atomically(tmp_path, monkeypatch):
+    """A write failure mid-append (e.g. arena exhausted on the float
+    matrix) must roll BOTH matrices back — the set stays readable with
+    exactly its pre-append contents, stats and dicts unpolluted."""
+    from netsdb_tpu.relational.outofcore import PagedColumns
+    from netsdb_tpu.storage.paged import PagedTensorStore
+
+    c = Client(Configuration(root_dir=str(tmp_path / "rb"),
+                             page_size_bytes=4096,
+                             page_pool_bytes=16384))
+    c.create_database("d")
+    c.create_set("d", "ev", type_name="table", storage="paged")
+    c.send_table("d", "ev", [{"kind": "x", "n": i, "w": float(i)}
+                             for i in range(100)])
+    pc = c.store.get_items(SetIdentifier("d", "ev"))[0]
+    dicts_before = {k: list(v) for k, v in pc.dicts.items()}
+    stats_before = dict(pc.stats)
+    rows_before = pc.num_rows
+
+    orig_put = PagedTensorStore.put
+
+    def failing_put(self, name, dense, row_block=None, append=False):
+        if append and name.endswith(".float"):
+            raise MemoryError("synthetic arena exhaustion")
+        return orig_put(self, name, dense, row_block=row_block,
+                        append=append)
+
+    monkeypatch.setattr(PagedTensorStore, "put", failing_put)
+    with pytest.raises(MemoryError):
+        c.send_table("d", "ev", [{"kind": "z", "n": 7, "w": 7.0}],
+                     append=True)
+    monkeypatch.setattr(PagedTensorStore, "put", orig_put)
+
+    assert pc.num_rows == rows_before
+    assert pc.dicts == dicts_before  # no 'z' pollution
+    assert pc.stats == stats_before
+    t = c.get_table("d", "ev")  # still readable, pre-append content
+    assert t.num_rows == rows_before
+    kinds = {t.dicts["kind"][int(code)] for code in np.asarray(t["kind"])}
+    assert kinds == {"x"}
