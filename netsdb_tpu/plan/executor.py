@@ -124,7 +124,13 @@ def _execute_streamed(client, plan: LogicalPlan, scan_values: Dict[int, Any],
     a paged set stream it page-by-page (``_run_fold``); everything else
     evaluates eagerly on resident values. Fold-less consumers of a
     paged set materialize it (correct, not streamed — the documented
-    fallback, like the reference pinning a set that fits RAM)."""
+    fallback, like the reference pinning a set that fits RAM).
+
+    A job mixing paged and resident-only sinks runs ENTIRELY on this
+    path: the resident sinks stay correct but lose the whole-plan jit
+    of the pure-resident route (fold steps are still compiled and
+    cached). Submit resident-only sinks as their own jobs when that
+    matters."""
     from netsdb_tpu.plan.fold import flatten_resident
     from netsdb_tpu.relational.outofcore import PagedColumns
 

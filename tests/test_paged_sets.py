@@ -488,3 +488,33 @@ def test_append_failure_rolls_back_atomically(tmp_path, monkeypatch):
     assert t.num_rows == rows_before
     kinds = {t.dicts["kind"][int(code)] for code in np.asarray(t["kind"])}
     assert kinds == {"x"}
+
+
+@pytest.mark.parametrize("seed", [0, 1, 2])
+def test_append_split_invariance_property(tmp_path, tables, seed):
+    """Property: ingesting lineitem as K random-sized appended batches
+    gives the same q06/q01 answers as one-shot ingest, for any split."""
+    rng = np.random.default_rng(seed)
+    li = tables["lineitem"]
+    n = li.num_rows
+    cuts = np.sort(rng.choice(np.arange(1, n), size=3, replace=False))
+    bounds = [0, *cuts.tolist(), n]
+    rows_np = {k: np.asarray(li[k]) for k in li.cols}
+    from netsdb_tpu.relational.table import ColumnTable as CT
+
+    c = Client(Configuration(root_dir=str(tmp_path / f"prop{seed}"),
+                             page_size_bytes=4096,
+                             page_pool_bytes=16384))
+    c.create_database("d")
+    c.create_set("d", "lineitem", type_name="table", storage="paged")
+    for i in range(len(bounds) - 1):
+        lo, hi = bounds[i], bounds[i + 1]
+        batch = CT({k: v[lo:hi] for k, v in rows_np.items()},
+                   dict(li.dicts))
+        c.send_table("d", "lineitem", batch, append=(i > 0))
+    info = c.analyze_set("d", "lineitem")
+    assert info["num_rows"] == n
+    out = rdag.run_query(c, rdag.q06_sink("d"))
+    ref = dict(cq06(tables))["revenue"]
+    np.testing.assert_allclose(float(np.asarray(out["revenue"])[0]),
+                               ref, rtol=1e-5)
