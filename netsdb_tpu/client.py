@@ -290,7 +290,23 @@ class Client:
 
         Block shape resolution: explicit argument > the set's
         advisor-chosen placement (catalog meta, written by
-        ``create_set`` under a PlacementAdvisor) > config default."""
+        ``create_set`` under a PlacementAdvisor) > config default.
+
+        A ``storage="paged"`` set takes the HOST array straight into
+        the arena — no BlockedTensor, nothing device-resident (the
+        whole point is matrices larger than HBM; consume them with
+        :meth:`paged_matmul`). Returns None in that case."""
+        ident = _ident(db, set_name)
+        if self.store.storage_of(ident) == "paged":
+            dense_np = np.ascontiguousarray(
+                np.asarray(dense, dtype or np.float32))
+            self.store.add_data(ident, [dense_np])
+            cat = self.catalog.get_set(db, set_name)
+            if cat is not None:
+                cat["meta"].update(shape=list(dense_np.shape),
+                                   dtype=str(dense_np.dtype))
+                self.catalog.update_set_meta(db, set_name, cat["meta"])
+            return None
         if block_shape is None:
             info = self.catalog.get_set(db, set_name)
             placed = (info or {}).get("meta", {}).get("block_shape")
@@ -384,6 +400,14 @@ class Client:
 
     def get_tensor(self, db: str, set_name: str) -> BlockedTensor:
         return self.store.get_tensor(_ident(db, set_name))
+
+    def paged_matmul(self, db: str, set_name: str, rhs) -> np.ndarray:
+        """``stored @ rhs`` with the stored matrix STREAMED page by
+        page through the device — the larger-than-HBM weight pattern
+        as a set property: ``create_set(storage="paged")`` +
+        ``send_matrix`` pages the matrix into the arena, and only one
+        page + ``rhs`` are device-resident at a time."""
+        return self.store.paged_matmul(_ident(db, set_name), rhs)
 
     def get_set_iterator(self, db: str, set_name: str) -> Iterator[Any]:
         return self.store.scan(_ident(db, set_name))

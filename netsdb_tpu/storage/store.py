@@ -97,6 +97,17 @@ def _item_nbytes(item: Any) -> int:
     return 256  # rough per-object estimate for host records
 
 
+@dataclasses.dataclass
+class _PagedMatrix:
+    """Handle for a matrix living as arena pages (a paged TENSOR set):
+    identity only — shape/dtype's authoritative copies live in the
+    page store's meta; the data streams through
+    ``SetStore.paged_matmul``, never materializing densely (ref:
+    pipelines over pinned weight pages)."""
+
+    ident: str
+
+
 def _locked(method):
     """Run a public store method under the store's reentrant lock."""
 
@@ -199,11 +210,11 @@ class SetStore:
             s.items = []
             s.nbytes = 0
 
-    @staticmethod
-    def _drop_paged_items(s: Optional[_StoredSet]) -> None:
-        """Return a dropped paged relation's pages to the shared capped
-        arena — without this, remove/clear of paged sets would leak
-        dead pages against ``page_pool_bytes`` until process restart."""
+    def _drop_paged_items(self, s: Optional[_StoredSet]) -> None:
+        """Return a dropped paged relation's (or paged matrix's) pages
+        to the shared capped arena — without this, remove/clear of
+        paged sets would leak dead pages against ``page_pool_bytes``
+        until process restart."""
         if s is None or not s.items:
             return
         from netsdb_tpu.relational.outofcore import PagedColumns
@@ -211,6 +222,9 @@ class SetStore:
         for item in s.items:
             if isinstance(item, PagedColumns):
                 item.drop()
+            elif isinstance(item, _PagedMatrix) and \
+                    self._page_store is not None:
+                self._page_store.drop(f"{item.ident}.mat")
 
     @_locked
     def list_sets(self) -> List[SetIdentifier]:
@@ -254,9 +268,28 @@ class SetStore:
         if isinstance(item, PagedColumns):
             s.items = [item]
             return
+        if isinstance(item, (np.ndarray, BlockedTensor)):
+            if append:
+                raise ValueError(f"append is not supported for paged "
+                                 f"matrices ({s.ident}); re-send the "
+                                 f"full matrix")
+            # paged TENSOR set: a matrix larger than HBM pages into the
+            # arena; consumers stream it (``paged_matmul`` — the r1
+            # matmul_streamed capability, now a property of the set).
+            # Replace semantics: drop the old contents first (a
+            # cross-type replace would otherwise leak pages forever)
+            self._drop_paged_items(s)
+            dense = (np.asarray(item.to_dense()) if
+                     isinstance(item, BlockedTensor) else
+                     np.ascontiguousarray(item))
+            self.page_store().put(f"{s.ident}.mat", dense)
+            s.items = [_PagedMatrix(str(s.ident))]
+            s.nbytes = 0
+            s.last_access = time.time()
+            return
         if not isinstance(item, ColumnTable):
-            raise TypeError(f"paged set {s.ident} ingests ColumnTables; "
-                            f"got {type(item).__name__}")
+            raise TypeError(f"paged set {s.ident} ingests ColumnTables "
+                            f"or matrices; got {type(item).__name__}")
         existing = [i for i in (s.items or [])
                     if isinstance(i, PagedColumns)]
         if append and existing:
@@ -297,6 +330,9 @@ class SetStore:
             pc.dicts.update(staged_dicts)  # commit only after success
             s.last_access = time.time()
             return
+        # fresh/replace table ingest: drop whatever the set held (table
+        # pages or a matrix) so cross-type replaces cannot leak
+        self._drop_paged_items(s)
         # page row count sized to the configured page bytes (floor 64 so
         # tiny test pages still hold whole rows); for placed sets,
         # rounded to the shard granularity so streamed chunks mesh-shard
@@ -335,6 +371,26 @@ class SetStore:
         s.nbytes = sum(_item_nbytes(i) for i in items)
         s.last_access = time.time()
         self._maybe_evict(exclude=ident)
+
+    @_locked
+    def paged_matmul(self, ident: SetIdentifier, rhs) -> np.ndarray:
+        """``stored_matrix @ rhs`` with the left side STREAMED page by
+        page through the device — the larger-than-HBM weight pattern
+        (only one page + rhs resident at a time; r1's matmul_streamed,
+        reachable as a set property since the matrix lives in a
+        ``storage="paged"`` set). Runs UNDER the store lock for its
+        whole duration: a concurrent remove/re-ingest freeing the pages
+        mid-stream would otherwise corrupt the product (the reference
+        pins pages for exactly this; a per-set pin would narrow the
+        critical section if the global lock ever becomes a bottleneck)."""
+        s = self._require(ident)
+        pm = next((i for i in (s.items or [])
+                   if isinstance(i, _PagedMatrix)), None)
+        if pm is None:
+            raise ValueError(f"set {ident} holds no paged matrix")
+        s.last_access = time.time()
+        return self.page_store().matmul_streamed(f"{pm.ident}.mat",
+                                                 np.asarray(rhs))
 
     @_locked
     def append_table(self, ident: SetIdentifier, table) -> None:
@@ -376,6 +432,9 @@ class SetStore:
         s = self._require(ident)
         if s.alias_of is not None:
             raise ValueError(f"set {ident} aliases {s.alias_of}; it is read-only")
+        if s.storage == "paged":
+            self._ingest_paged(s, [tensor])
+            return
         if s.placement is not None:
             tensor = s.placement.apply(tensor)
         s.items = [tensor]
@@ -385,6 +444,10 @@ class SetStore:
 
     def get_tensor(self, ident: SetIdentifier) -> BlockedTensor:
         items = self.get_items(ident)
+        if any(isinstance(i, _PagedMatrix) for i in items):
+            raise ValueError(
+                f"set {ident} holds a PAGED matrix — it streams, it is "
+                f"never device-resident; consume it with paged_matmul")
         tensors = [i for i in items if isinstance(i, BlockedTensor)]
         if len(tensors) != 1:
             raise ValueError(
@@ -476,6 +539,12 @@ class SetStore:
                 # HOST-side snapshot (numpy columns): the flush path
                 # must never materialize the relation in device memory
                 payload.append(("paged", item.to_host_table(), None, None))
+            elif isinstance(item, _PagedMatrix):
+                # paged matrix: host-side block concat (never device)
+                blocks = [b for _, b in self.page_store().stream_blocks(
+                    f"{item.ident}.mat")]
+                payload.append(("paged_mat", np.concatenate(blocks),
+                                None, None))
             else:
                 payload.append(("object", item, None, None))
         record = {"ident": tuple(s.ident), "persistence": s.persistence,
@@ -561,7 +630,7 @@ class SetStore:
 
             s.placement = Placement.from_meta(blob["placement"])
         paged_tables = [data for kind, data, _, _ in blob["items"]
-                        if kind == "paged"]
+                        if kind in ("paged", "paged_mat")]
         if paged_tables:
             # snapshot of a paged set: re-ingest the relation into the
             # arena — the set comes back PAGED, placement and all

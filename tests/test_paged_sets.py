@@ -518,3 +518,50 @@ def test_append_split_invariance_property(tmp_path, tables, seed):
     ref = dict(cq06(tables))["revenue"]
     np.testing.assert_allclose(float(np.asarray(out["revenue"])[0]),
                                ref, rtol=1e-5)
+
+
+# ------------------------------------------------- paged TENSOR sets
+def test_paged_tensor_set_streams_matmul(tmp_path):
+    """A weight matrix in a storage="paged" set streams through
+    paged_matmul page by page (spills under the capped arena), and
+    dropping the set returns its pages — larger-than-HBM weights as a
+    set property."""
+    cfg = Configuration(root_dir=str(tmp_path / "pm"),
+                        page_size_bytes=65536, page_pool_bytes=262144)
+    c = Client(cfg)
+    c.create_database("d")
+    c.create_set("d", "w", storage="paged")
+    rng = np.random.default_rng(21)
+    w = rng.standard_normal((2048, 128)).astype(np.float32)  # 1 MB
+    x = rng.standard_normal((128, 64)).astype(np.float32)
+    c.send_matrix("d", "w", w)
+    out = c.paged_matmul("d", "w", x)
+    np.testing.assert_allclose(out, w @ x, rtol=2e-4, atol=2e-4)
+    st = c.store.page_store().stats()
+    assert st["spills"] > 0  # 1 MB matrix under a 256 KB pool
+    used = st["bytes_allocated"]
+    c.remove_set("d", "w")
+    assert c.store.page_store().stats()["bytes_allocated"] < used
+    with pytest.raises((ValueError, KeyError)):
+        c.paged_matmul("d", "w", x)
+
+
+def test_paged_matrix_flush_reload_roundtrip(tmp_path):
+    cfg = Configuration(root_dir=str(tmp_path / "pmr"),
+                        page_size_bytes=65536, page_pool_bytes=262144)
+    c = Client(cfg)
+    c.create_database("d")
+    c.create_set("d", "w", storage="paged", persistence="persistent")
+    rng = np.random.default_rng(22)
+    w = rng.standard_normal((1024, 64)).astype(np.float32)
+    x = rng.standard_normal((64, 8)).astype(np.float32)
+    c.send_matrix("d", "w", w)
+    c.store.flush(SetIdentifier("d", "w"))
+
+    c2 = Client(Configuration(root_dir=str(tmp_path / "pmr"),
+                              page_size_bytes=65536,
+                              page_pool_bytes=262144))
+    c2.store.load_set(SetIdentifier("d", "w"))
+    assert c2.store.set_stats(SetIdentifier("d", "w"))["storage"] == "paged"
+    np.testing.assert_allclose(c2.paged_matmul("d", "w", x), w @ x,
+                               rtol=2e-4, atol=2e-4)
