@@ -80,12 +80,19 @@ def _run_fold_once(fold, pc, resident, placement, step_jit):
     chunk before the step so the fold executes distributed per chunk
     (ref ``PipelineStage.cc:228-265`` — workers stream local
     partitions through the same pipeline)."""
+    import contextlib
+
     state = None
     for pidx, (init, step) in enumerate(fold.passes):
         jstep = step_jit(pidx, step)
         state = init(state, pc, *resident)
-        for chunk in pc.stream_tables(placement=placement):
-            state = jstep(state, chunk, *resident)
+        # closing(): a step raising mid-stream must release the page
+        # stream's read lock NOW, not at GC (a retained traceback would
+        # otherwise hold the lock and block appends/drops indefinitely)
+        with contextlib.closing(
+                pc.stream_tables(placement=placement)) as chunks:
+            for chunk in chunks:
+                state = jstep(state, chunk, *resident)
     return fold.finalize(state, pc, *resident)
 
 
@@ -105,12 +112,16 @@ def _run_fold(node, fold, pc, resident, placement, step_jit):
         rest = [v.to_table() if isinstance(v, PagedColumns) and i != bi
                 else v for i, v in enumerate(resident)]
         out = None
-        for btab in resident[bi].stream_tables(prefetch=0):
-            part_res = list(rest)
-            part_res[bi] = btab
-            part = _run_fold_once(fold, pc, tuple(part_res), placement,
-                                  step_jit)
-            out = part if out is None else fold.merge(out, part)
+        import contextlib
+
+        with contextlib.closing(
+                resident[bi].stream_tables(prefetch=0)) as btabs:
+            for btab in btabs:
+                part_res = list(rest)
+                part_res[bi] = btab
+                part = _run_fold_once(fold, pc, tuple(part_res),
+                                      placement, step_jit)
+                out = part if out is None else fold.merge(out, part)
         return out
     if builds:  # no merge rule: the build side must be resident
         resident = tuple(v.to_table() if isinstance(v, PagedColumns)
@@ -141,10 +152,16 @@ def _execute_streamed(client, plan: LogicalPlan, scan_values: Dict[int, Any],
         and isinstance(scan_values.get(n.node_id), PagedColumns)
     }
     plan_key = plan.cache_key()
+    # nodes are keyed by topo POSITION, not label alone: two fold-bearing
+    # nodes sharing a label in one plan must not reuse each other's
+    # jitted steps (plan_key renumbers nodes n0..nN, so structurally
+    # identical plans still share cache entries)
+    topo_pos = {n.node_id: i for i, n in enumerate(plan.topo)}
 
     def step_jit_for(node):
         def step_jit(pidx, step):
-            key = f"fold::{job_name}::{plan_key}::{node.label}::{pidx}"
+            key = (f"fold::{job_name}::{plan_key}::"
+                   f"n{topo_pos[node.node_id]}::{node.label}::{pidx}")
             with _cache_lock:
                 fn = _compiled_cache.get(key)
                 if fn is not None:

@@ -30,6 +30,7 @@ import numpy as np
 
 from netsdb_tpu.relational.table import ColumnTable, date_to_int, int_to_date
 from netsdb_tpu.storage.paged import PagedTensorStore
+from netsdb_tpu.utils.locks import RWLock
 
 _INT_KINDS = "ib"
 
@@ -55,6 +56,11 @@ class PagedColumns:
         self.num_rows = num_rows
         self.row_block = row_block
         self.dicts = dicts or {}
+        # stream-vs-mutation guard: streams (executor folds, snapshots)
+        # run OUTSIDE the SetStore lock, so a concurrent append/drop
+        # could free or grow pages mid-stream; streams hold read, the
+        # mutators hold write (the arena pin, Python-side)
+        self.rw = RWLock()
         # ingest-time ColumnStats per int column — collected in the one
         # pass that already touches every row, so the planner never has
         # to re-stream the set (the reference's StorageCollectStats
@@ -138,30 +144,41 @@ class PagedColumns:
                 f"append schema mismatch: have "
                 f"{sorted(set(self.int_names) | set(self.float_names))}, "
                 f"got {sorted(cols)}")
+        # _pack re-casts by the STORED classification, so a float batch
+        # column landing on an int-classified stored column would
+        # silently truncate via astype(int32) — reject it (int→float
+        # widens losslessly and stays allowed)
+        for n in self.int_names:
+            if np.asarray(cols[n]).dtype.kind not in _INT_KINDS:
+                raise TypeError(
+                    f"append column {n!r} is float-valued but the "
+                    f"stored column is int-classified; casting would "
+                    f"truncate — convert explicitly first")
         imat, fmat, n_new = self._pack(cols, self.int_names,
                                        self.float_names)
         if n_new == 0:
             return  # all-masked/empty batch: a no-op, not a stats merge
-        undo = []
-        for suffix, mat in ((".int", imat), (".float", fmat)):
-            if mat is None:
-                continue
-            full = self.name + suffix
-            undo.append((full, self.store.num_blocks(full),
-                         self.num_rows))
-            try:
-                self.store.put(full, mat, append=True)
-            except Exception:
-                for uname, npages, rows in undo:
-                    self.store.truncate_to(uname, npages, rows)
-                raise
-        for j, name in enumerate(self.int_names):
-            new = analyze_array(imat[:, j])
-            old = self.stats.get(name)
-            self.stats[name] = (new if old is None else ColumnStats(
-                old.n_rows + new.n_rows, min(old.min_val, new.min_val),
-                max(old.max_val, new.max_val), -1))
-        self.num_rows += n_new
+        with self.rw.write():  # drain in-flight streams before growing
+            undo = []
+            for suffix, mat in ((".int", imat), (".float", fmat)):
+                if mat is None:
+                    continue
+                full = self.name + suffix
+                undo.append((full, self.store.num_blocks(full),
+                             self.num_rows))
+                try:
+                    self.store.put(full, mat, append=True)
+                except Exception:
+                    for uname, npages, rows in undo:
+                        self.store.truncate_to(uname, npages, rows)
+                    raise
+            for j, name in enumerate(self.int_names):
+                new = analyze_array(imat[:, j])
+                old = self.stats.get(name)
+                self.stats[name] = (new if old is None else ColumnStats(
+                    old.n_rows + new.n_rows, min(old.min_val, new.min_val),
+                    max(old.max_val, new.max_val), -1))
+            self.num_rows += n_new
 
     # ------------------------------------------------------------ stream
     def stream(self, prefetch: int = 2
@@ -170,7 +187,17 @@ class PagedColumns:
         to ``row_block`` rows — the PageScanner loop feeding the
         compiled chunk step. Ragged blocks (appended batches' tails)
         are masked, never reshaped; ``start_row`` is the chunk's global
-        row offset (exact even for ragged streams)."""
+        row offset (exact even for ragged streams).
+
+        Holds the relation's read lock for the generator's lifetime, so
+        a concurrent append/drop (write lock) cannot free or grow pages
+        mid-stream."""
+        with self.rw.read():
+            yield from self._stream_unlocked(prefetch)
+
+    def _stream_unlocked(self, prefetch: int = 2
+                         ) -> Iterator[Tuple[Dict[str, jnp.ndarray],
+                                             jnp.ndarray, int]]:
         streams = []
         if self.int_names:
             streams.append((self.int_names,
@@ -218,9 +245,11 @@ class PagedColumns:
 
     def drop(self) -> None:
         """Free this relation's pages from the shared arena (both the
-        int and float matrices). After this the PagedColumns is dead."""
-        for suffix in (".int", ".float"):
-            self.store.drop(self.name + suffix)
+        int and float matrices). After this the PagedColumns is dead.
+        Waits for in-flight streams (read lock holders) to drain."""
+        with self.rw.write():
+            for suffix in (".int", ".float"):
+                self.store.drop(self.name + suffix)
 
     def stream_tables(self, prefetch: int = 2,
                       placement=None) -> Iterator[ColumnTable]:
@@ -236,18 +265,25 @@ class PagedColumns:
         the shard granularity, so placed chunks shard without a second
         padding round."""
         base_rowid = jnp.arange(self.row_block, dtype=jnp.int32)
-        for cols, valid, start in self.stream(prefetch):
-            cols = dict(cols)
-            # the stream's own start is exact even for ragged
-            # (appended) block sequences; invalid tail rows get bogus
-            # ids, masked like everything else
-            cols["_rowid"] = base_rowid + start
-            t = ColumnTable(cols, self.dicts, valid)
-            if placement is not None:
-                from netsdb_tpu.parallel.placement import shard_table
+        inner = self.stream(prefetch)
+        try:
+            for cols, valid, start in inner:
+                cols = dict(cols)
+                # the stream's own start is exact even for ragged
+                # (appended) block sequences; invalid tail rows get bogus
+                # ids, masked like everything else
+                cols["_rowid"] = base_rowid + start
+                t = ColumnTable(cols, self.dicts, valid)
+                if placement is not None:
+                    from netsdb_tpu.parallel.placement import shard_table
 
-                t = shard_table(t, placement)
-            yield t
+                    t = shard_table(t, placement)
+                yield t
+        finally:
+            # deterministic read-lock release: an abandoned/partially
+            # consumed stream_tables generator must not keep the inner
+            # stream (and its lock) alive until GC
+            inner.close()
 
     def to_host_table(self) -> ColumnTable:
         """Materialize the relation as one HOST-resident ColumnTable
@@ -256,14 +292,20 @@ class PagedColumns:
         large the paged relation is."""
         parts: Dict[str, List[np.ndarray]] = {}
         n_done = 0
-        for cols, valid, _start in self.stream():
-            n = int(np.asarray(valid).sum())
-            for k, v in cols.items():
-                parts.setdefault(k, []).append(np.asarray(v)[:n])
-            n_done += n
-        if n_done != self.num_rows:
+        # the consistency check compares against num_rows AS OF the
+        # snapshot (read under the same lock the stream holds): a
+        # concurrent append landing after the stream drains must not
+        # turn a perfectly consistent pre-append snapshot into an error
+        with self.rw.read():
+            expected = self.num_rows
+            for cols, valid, _start in self._stream_unlocked():
+                n = int(np.asarray(valid).sum())
+                for k, v in cols.items():
+                    parts.setdefault(k, []).append(np.asarray(v)[:n])
+                n_done += n
+        if n_done != expected:
             raise RuntimeError(f"paged set {self.name!r}: streamed "
-                               f"{n_done} rows, expected {self.num_rows}")
+                               f"{n_done} rows, expected {expected}")
         from netsdb_tpu.relational.stats import inject_stats
 
         out = ColumnTable({k: np.concatenate(v)
@@ -417,8 +459,11 @@ def ooc_q03(pc: PagedColumns, store: PagedTensorStore,
         btab = ColumnTable({"o_orderkey": jnp.asarray(keys),
                             "o_orderdate": jnp.asarray(bmat[:, 1])})
         state = fold.passes[0][0](None, pc, btab)
-        for chunk in pc.stream_tables():
-            state = jstep(state, chunk, btab)
+        import contextlib
+
+        with contextlib.closing(pc.stream_tables()) as chunks:
+            for chunk in chunks:
+                state = jstep(state, chunk, btab)
         part = fold.finalize(state, pc, btab)
         out = part if out is None else fold.merge(out, part)
     return q03_rows(out) if out is not None else []

@@ -326,6 +326,15 @@ class RemoteClient:
         reply = self._request(MsgType.GET_TENSOR, {"db": db, "set": set_name})
         return RemoteTensor(reply["data"], reply.get("block_shape"))
 
+    def paged_matmul(self, db: str, set_name: str, rhs) -> np.ndarray:
+        """``stored @ rhs`` computed daemon-side with the stored matrix
+        streamed from the arena (paged TENSOR sets never materialize;
+        their GET_TENSOR raises by design)."""
+        reply = self._request(MsgType.PAGED_MATMUL,
+                              {"db": db, "set": set_name,
+                               "rhs": np.asarray(rhs)})
+        return np.asarray(reply["data"])
+
     def get_tensor_chunked(self, db: str, set_name: str,
                            chunk_bytes: int = 8 << 20) -> RemoteTensor:
         """Pull a tensor as a chunked stream: client holds the result

@@ -96,6 +96,10 @@ class MsgType(IntEnum):
     # (FrontendQueryTestServer.cc:785-890); reads never enter the SPMD
     # program, so no collective/ordering hazards
     LOCAL_SHARDS = 42
+    # streamed compute over a paged TENSOR set: stored @ rhs with the
+    # stored matrix paged through the device (larger-than-HBM weights
+    # behind the daemon; ref pipelines over pinned weight pages)
+    PAGED_MATMUL = 43
 
 
 class ProtocolError(ConnectionError):

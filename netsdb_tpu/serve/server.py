@@ -270,6 +270,7 @@ class ServeController:
             MsgType.COLLECT_STATS: self._on_collect_stats,
             MsgType.ANALYZE_SET: self._on_analyze_set,
             MsgType.LOCAL_SHARDS: self._on_local_shards,
+            MsgType.PAGED_MATMUL: self._on_paged_matmul,
         }
 
     # --- lifecycle ----------------------------------------------------
@@ -574,8 +575,23 @@ class ServeController:
     def _on_send_matrix(self, p):
         dense, block_shape = tensor_from_wire(p["tensor"])
         t = self.library.send_matrix(p["db"], p["set"], dense, block_shape)
+        if t is None:
+            # storage="paged" set: the matrix went into the arena, not
+            # HBM — reply from the ingested array (there is no blocked
+            # tensor to describe)
+            return MsgType.OK, {"shape": list(dense.shape),
+                                "dtype": str(np.asarray(dense).dtype),
+                                "block_shape": None}
         return MsgType.OK, {"shape": list(t.shape), "dtype": str(t.dtype),
                             "block_shape": list(t.meta.block_shape)}
+
+    def _on_paged_matmul(self, p):
+        """stored @ rhs with the stored matrix streamed from the arena
+        page by page — the daemon-side consumption path for paged
+        TENSOR sets (whose GET_TENSOR deliberately raises)."""
+        out = self.library.paged_matmul(p["db"], p["set"],
+                                        np.asarray(p["rhs"]))
+        return MsgType.OK, {"data": np.asarray(out)}
 
     def _on_get_tensor(self, p):
         t = self.library.get_tensor(p["db"], p["set"])

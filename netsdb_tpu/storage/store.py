@@ -194,32 +194,43 @@ class SetStore:
     def exists(self, ident: SetIdentifier) -> bool:
         return ident in self._sets or os.path.exists(self._spill_path(ident))
 
-    @_locked
     def remove_set(self, ident: SetIdentifier) -> None:
-        s = self._sets.pop(ident, None)
-        self._drop_paged_items(s)
-        path = self._spill_path(ident)
-        if os.path.exists(path):
-            os.remove(path)
+        with self._lock:
+            s = self._sets.pop(ident, None)
+            detached = list(s.items or []) if s is not None else []
+            if s is not None:
+                s.items = []
+            path = self._spill_path(ident)
+            if os.path.exists(path):
+                os.remove(path)
+        # page reclaim happens OUTSIDE the store lock: dropping a paged
+        # relation waits for in-flight streams (its write lock) and must
+        # not freeze every unrelated store operation while it waits
+        self._drop_detached(detached)
 
-    @_locked
     def clear_set(self, ident: SetIdentifier) -> None:
-        s = self._sets.get(ident)
-        if s is not None:
-            self._drop_paged_items(s)
-            s.items = []
-            s.nbytes = 0
+        with self._lock:
+            s = self._sets.get(ident)
+            detached = list(s.items or []) if s is not None else []
+            if s is not None:
+                s.items = []
+                s.nbytes = 0
+        self._drop_detached(detached)
 
     def _drop_paged_items(self, s: Optional[_StoredSet]) -> None:
         """Return a dropped paged relation's (or paged matrix's) pages
         to the shared capped arena — without this, remove/clear of
         paged sets would leak dead pages against ``page_pool_bytes``
-        until process restart."""
+        until process restart. Called with the store lock held (ingest
+        replace); remove/clear detach first and drop unlocked."""
         if s is None or not s.items:
             return
+        self._drop_detached(s.items)
+
+    def _drop_detached(self, items: List[Any]) -> None:
         from netsdb_tpu.relational.outofcore import PagedColumns
 
-        for item in s.items:
+        for item in items:
             if isinstance(item, PagedColumns):
                 item.drop()
             elif isinstance(item, _PagedMatrix) and \
@@ -266,6 +277,11 @@ class SetStore:
                              f"relation; got {len(items)} items")
         item = items[0]
         if isinstance(item, PagedColumns):
+            # replacing with a new handle must free the OLD relation's
+            # arena pages (the same cross-type-leak rule as below) —
+            # unless the "new" handle IS the stored one (no-op re-add)
+            if not (s.items and len(s.items) == 1 and s.items[0] is item):
+                self._drop_paged_items(s)
             s.items = [item]
             return
         if isinstance(item, (np.ndarray, BlockedTensor)):

@@ -1,0 +1,51 @@
+"""Small concurrency primitives shared across the storage layer."""
+
+from __future__ import annotations
+
+import contextlib
+import threading
+
+
+class RWLock:
+    """Readers-preference shared/exclusive lock.
+
+    The arena pin-refcount pattern (``native/pagestore.cpp``) at Python
+    granularity: many concurrent page streams may read one paged
+    relation while mutations (append / drop) wait for the readers to
+    drain. Readers-preference deliberately: a stream that opens a
+    nested stream of the same relation (grace-hash self-probe) must not
+    deadlock behind a queued writer, and at this layer's scale writer
+    starvation is not a realistic load.
+    """
+
+    def __init__(self):
+        self._cond = threading.Condition()
+        self._readers = 0
+        self._writer = False
+
+    @contextlib.contextmanager
+    def read(self):
+        with self._cond:
+            while self._writer:
+                self._cond.wait()
+            self._readers += 1
+        try:
+            yield
+        finally:
+            with self._cond:
+                self._readers -= 1
+                if self._readers == 0:
+                    self._cond.notify_all()
+
+    @contextlib.contextmanager
+    def write(self):
+        with self._cond:
+            while self._writer or self._readers:
+                self._cond.wait()
+            self._writer = True
+        try:
+            yield
+        finally:
+            with self._cond:
+                self._writer = False
+                self._cond.notify_all()

@@ -110,3 +110,22 @@ def test_remote_get_table_materializes_paged(served, tables):
     np.testing.assert_array_equal(
         np.sort(np.asarray(t["l_orderkey"])),
         np.sort(np.asarray(tables["lineitem"]["l_orderkey"])))
+
+
+def test_remote_send_matrix_to_paged_set_and_matmul(served):
+    """SEND_MATRIX to a storage="paged" set must succeed over the wire
+    (the daemon-side library returns None — no BlockedTensor exists for
+    an arena-resident matrix) and the matrix must be consumable via the
+    PAGED_MATMUL frame, streamed daemon-side (advisor r4, medium)."""
+    ctl, c = served
+    rng = np.random.default_rng(3)
+    m = rng.standard_normal((256, 32)).astype(np.float32)
+    c.create_set("d", "pw", type_name="tensor", storage="paged")
+    t = c.send_matrix("d", "pw", m)  # must not raise daemon-side
+    assert tuple(t.shape) == (256, 32)
+    rhs = rng.standard_normal((32, 8)).astype(np.float32)
+    out = c.paged_matmul("d", "pw", rhs)
+    np.testing.assert_allclose(out, m @ rhs, rtol=1e-5, atol=1e-5)
+    # paged TENSOR sets never materialize: remote GET_TENSOR refuses
+    with pytest.raises(Exception, match="[Pp]aged|PAGED"):
+        c.get_tensor("d", "pw")
