@@ -500,6 +500,33 @@ def _cmd_selftest(args) -> int:
               and (not store.native or store.stats()["spills"] > 0),
               "paged matmul matches numpy (spills>0 when native)")
 
+    def paged_weights():  # round 5: inference over PAGED weight sets
+        import tempfile
+
+        from netsdb_tpu.models.ff import FFModel
+
+        def run(storages):
+            pc = Client(Configuration(
+                root_dir=tempfile.mkdtemp(prefix="st_pw_"),
+                page_size_bytes=4096, page_pool_bytes=16384))
+            m = FFModel(db="ff", block=(32, 32))
+            m.setup(pc, storages=storages)
+            m.load_random_weights(pc, 96, 128, 10, seed=0)
+            m.load_inputs(pc, rng.standard_normal(
+                (32, 96)).astype(np.float32))
+            return (np.asarray(m.inference(pc).to_dense()),
+                    pc.store.page_store() if storages else None)
+
+        # deterministic inputs: same rng state both runs
+        state = rng.bit_generator.state
+        ref, _ = run(None)
+        rng.bit_generator.state = state
+        out, store = run({"w1": "paged", "wo": "paged"})
+        check(bool(np.array_equal(ref, out))
+              and (not store.native or store.stats()["spills"] > 0),
+              "FF inference over paged weight sets bit-matches resident "
+              "(spills>0 when native)")
+
     steps = [("selection", selection), ("aggregation", aggregation),
              ("lda", lda), ("ff", ff), ("lstm", lstm), ("conv", conv),
              ("tpch-columnar", tpch_columnar), ("pdml", pdml),
@@ -510,7 +537,8 @@ def _cmd_selftest(args) -> int:
              ("autojoin", autojoin), ("dedup-pool", dedup_pool),
              ("paged-set-api", paged_set_api),
              ("placement-arm", placement_arm),
-             ("paged-matmul", paged_matmul)]
+             ("paged-matmul", paged_matmul),
+             ("paged-weights", paged_weights)]
     for name, fn in steps:
         step(name, fn)
     print(f"{len(steps) - len(failures)}/{len(steps)} passed")

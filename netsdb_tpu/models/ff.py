@@ -60,18 +60,25 @@ class FFModel:
 
     # --- setup (ref ff::setup + createSet, SimpleFF.cc:60-82) ---------
     def setup(self, client: Client,
-              placements: Optional[Dict[str, object]] = None) -> None:
+              placements: Optional[Dict[str, object]] = None,
+              storages: Optional[Dict[str, str]] = None) -> None:
         """``placements`` maps set name → Placement: declare at createSet
         how each model set shards over the mesh (inputs/activations on
         ``data``, weight rows/cols on ``model``, biases replicated) —
         the reference's per-set PartitionPolicy, upgraded from "which
         worker" to "which mesh axis". Execution then distributes with no
         further client involvement: the executor's jit sees the stored
-        shardings."""
+        shardings.
+
+        ``storages`` maps set name → "memory"|"paged": weight sets
+        declared ``paged`` live as arena pages and STREAM through the
+        inference DAG (larger-than-HBM weights, the reference's
+        storage-managed weight scans — ``SimpleFF.cc:94-290``)."""
         client.create_database(self.db)
         for s in self.SETS:
             client.create_set(self.db, s,
-                              placement=(placements or {}).get(s))
+                              placement=(placements or {}).get(s),
+                              storage=(storages or {}).get(s, "memory"))
         client.register_type("FFMatrixBlock", "netsdb_tpu.core.blocked:BlockedTensor")
         # a live placement advisor (client.set_placement_advisor) may
         # have chosen the block shape at create_set — adopt it so the
@@ -126,17 +133,28 @@ class FFModel:
         b1 = ScanSet(self.db, "b1")
         wo = ScanSet(self.db, "wo")
         bo = ScanSet(self.db, "bo")
+        # both weight matmuls are row-decomposable in the weight: when
+        # the weight set is storage="paged", the executor streams its
+        # row-block pages through the same fn and concatenates output
+        # rows (out_block pins the assembled meta to the resident
+        # path's) — the reference's page-fed weight scans
+        # (SimpleFF.cc:94-290 + FFMatrixBlockScanner.h); resident sets
+        # ignore the fold entirely
+        from netsdb_tpu.plan.fold import TensorFold
+
+        wfold = TensorFold(mode="rows",
+                           out_block=(self.block[0], self.block[0]))
         # FFTransposeMult + FFAggMatrix: w1 · inputsᵀ → (hidden x batch)
         h = Join(w1, inputs, fn=lambda w, x: matmul_t(w, x, cd,
                                                       accum_dtype=cd),
-                 label="FFTransposeMult")
+                 label="FFTransposeMult", tensor_fold=wfold)
         # FFReluBiasSum
         y1 = Join(h, b1,
                   fn=lambda hh, bb: nn_ops.bias_relu(hh, bb, dropout_rate, key),
                   label="FFReluBiasSum")
         # FFInputLayerJoin + FFAggMatrix: wo · y1 → (labels x batch)
         yo_lin = Join(wo, y1, fn=lambda w, y: matmul(w, y, cd),
-                      label="FFInputLayerJoin")
+                      label="FFInputLayerJoin", tensor_fold=wfold)
         # FFTransposeBiasSum → FFRowAggregate → FFOutputLayer, fused
         out = Join(yo_lin, bo,
                    fn=lambda y, b: nn_ops.ff_output_layer(y, b, axis=0),

@@ -44,15 +44,19 @@ class TransformerLayerModel:
         self.db = db
         self.num_heads = num_heads
 
-    def setup(self, client: Client, placements=None) -> None:
+    def setup(self, client: Client, placements=None,
+              storages=None) -> None:
         """``placements`` maps set name → Placement (weights typically
         replicated; the activation set sharded on the sequence axis) —
         the long-context model declared distributed the same way the
-        relational sets are (round 3)."""
+        relational sets are (round 3). ``storages`` maps set name →
+        "memory"|"paged": paged weight sets stream through the staged
+        DAG (``build_forward_dag_staged``)."""
         client.create_database(self.db)
         for s in self.SETS:
             client.create_set(self.db, s,
-                              placement=(placements or {}).get(s))
+                              placement=(placements or {}).get(s),
+                              storage=(storages or {}).get(s, "memory"))
 
     def load_random_weights(self, client: Client, embed: int,
                             seed: int = 0) -> None:
@@ -183,6 +187,62 @@ class TransformerLayerModel:
                    label=f"transformer-fwd:{self.num_heads}:{causal}:"
                          f"{axis}:{mesh_tag}")
         return WriteSet(out, self.db, output_set)
+
+    def build_forward_dag_staged(self, input_set: str = "x",
+                                 output_set: str = "y",
+                                 causal: bool = True):
+        """Forward as STAGED Computation nodes (attention → ln → MLP-up
+        → MLP-down → residual) instead of one fused fn, so the MLP
+        weights — the layer's largest matrices — may live in
+        ``storage="paged"`` sets and STREAM through the DAG: each
+        weight's row blocks are contraction slices accumulated by a
+        reduce-mode :class:`~netsdb_tpu.plan.fold.TensorFold` (the
+        reference's page-fed weight scans, ``SimpleFF.cc:94-290``,
+        applied to the transformer MLP). With resident sets the same
+        DAG evaluates the plain fns — storage stays a property of the
+        set, not the query."""
+        from netsdb_tpu.plan.computations import (Apply, Join, ScanSet,
+                                                  WriteSet)
+        from netsdb_tpu.plan.fold import TensorFold
+
+        heads, db = self.num_heads, self.db
+
+        def attn(gathered, wo_bt):
+            x, wq = gathered
+            a = mha_forward(self._ln(x), wq.to_dense(), wo_bt.to_dense(),
+                            heads, causal=causal)
+            return x + a
+
+        g1 = Join(ScanSet(db, input_set), ScanSet(db, "w_qkv"),
+                  fn=lambda a, b: (a, b), label="gather:w_qkv")
+        a1 = Join(g1, ScanSet(db, "w_out"), fn=attn,
+                  label=f"attn:{heads}:{causal}")
+        ln2 = Apply(a1, fn=self._ln, label="ln2")
+
+        def contract_partial(eq):
+            def partial(carry, start, block, acts):
+                sl = jax.lax.dynamic_slice_in_dim(
+                    acts, start, block.shape[0], axis=-1)
+                p = jnp.einsum(eq, sl, block, precision=_HI)
+                return p if carry is None else carry + p
+            return partial
+
+        h = Join(ln2, ScanSet(db, "w_up"),
+                 fn=lambda xs, wu: jax.nn.gelu(jnp.einsum(
+                     "bse,ef->bsf", xs, wu.to_dense(), precision=_HI)),
+                 tensor_fold=TensorFold(
+                     mode="reduce", partial=contract_partial("bse,ef->bsf"),
+                     finalize=lambda c, xs: jax.nn.gelu(c)),
+                 label="mlp-up")
+        mlp = Join(h, ScanSet(db, "w_down"),
+                   fn=lambda hs, wd: jnp.einsum(
+                       "bsf,fe->bse", hs, wd.to_dense(), precision=_HI),
+                   tensor_fold=TensorFold(
+                       mode="reduce",
+                       partial=contract_partial("bsf,fe->bse")),
+                   label="mlp-down")
+        out = Join(a1, mlp, fn=lambda a, m2: a + m2, label="residual2")
+        return WriteSet(out, db, output_set)
 
     def serve_forward(self, client: Client, input_set: str = "x",
                       output_set: str = "y", causal: bool = True,

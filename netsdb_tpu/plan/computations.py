@@ -68,7 +68,8 @@ class Apply(Computation):
     op_kind = "Apply"
 
     def __init__(self, input_: Computation, fn: Optional[Callable[[Any], Any]] = None,
-                 label: str = "", traceable: bool = True, fold=None):
+                 label: str = "", traceable: bool = True, fold=None,
+                 tensor_fold=None):
         """``traceable=False`` marks a host-side projection (numpy / Python
         object work) that must run eagerly outside jit — the reference
         analogue is a C++ lambda that touches non-tensor state.
@@ -77,9 +78,15 @@ class Apply(Computation):
         a streamable decomposition; when the scanned set is paged, the
         executor folds the node over the page stream instead of calling
         ``fn``. With ``fn=None`` the whole-table path is derived from
-        the fold, so the two cannot diverge."""
+        the fold, so the two cannot diverge.
+
+        ``tensor_fold`` (:class:`netsdb_tpu.plan.fold.TensorFold`) is
+        the same for a paged TENSOR input: the executor streams the
+        matrix's row-block pages through the node (in-DB inference over
+        storage-managed weights, ref ``SimpleFF.cc:94-290``)."""
         super().__init__([input_])
         self.fold = fold
+        self.tensor_fold = tensor_fold
         if fn is None:
             if fold is None:
                 raise ValueError("Apply needs fn or fold")
@@ -156,7 +163,8 @@ class Join(Computation):
                  project: Optional[Callable[[Any, Any], Any]] = None,
                  label: str = "", fold=None, fold_src: int = 0,
                  on: Optional[tuple] = None,
-                 take: Optional[Sequence[str]] = None):
+                 take: Optional[Sequence[str]] = None,
+                 tensor_fold=None):
         """``fold`` + ``fold_src``: streamable decomposition (see
         :class:`netsdb_tpu.plan.fold.FoldSpec`); ``fold_src`` says which
         input (0=left, 1=right) is the probe/fact side the page stream
@@ -176,6 +184,9 @@ class Join(Computation):
         super().__init__([left, right])
         self.fold = fold
         self.fold_src = fold_src
+        # streamable decomposition over a paged TENSOR input (weight
+        # scans — see Apply docstring / plan.fold.TensorFold)
+        self.tensor_fold = tensor_fold
         self.on = tuple(on) if on else None
         self.take = take
         if fn is None and fold is not None and left_key is None:

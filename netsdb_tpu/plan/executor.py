@@ -18,6 +18,7 @@ The per-job compiled-function cache replaces the master's
 
 from __future__ import annotations
 
+import contextlib
 import time
 from typing import Any, Dict, List, Optional
 
@@ -33,7 +34,7 @@ from netsdb_tpu.plan.computations import (
     WriteSet,
 )
 from netsdb_tpu.plan.planner import LogicalPlan, plan_from_sinks
-from netsdb_tpu.storage.store import SetIdentifier
+from netsdb_tpu.storage.store import SetIdentifier, _PagedMatrix
 
 # job_name+canonical-plan → compiled callable (the PreCompiledWorkload
 # analogue, QuerySchedulerServer.cc:1242-1264). LRU-bounded: a serving
@@ -80,8 +81,6 @@ def _run_fold_once(fold, pc, resident, placement, step_jit):
     chunk before the step so the fold executes distributed per chunk
     (ref ``PipelineStage.cc:228-265`` — workers stream local
     partitions through the same pipeline)."""
-    import contextlib
-
     state = None
     for pidx, (init, step) in enumerate(fold.passes):
         jstep = step_jit(pidx, step)
@@ -112,8 +111,6 @@ def _run_fold(node, fold, pc, resident, placement, step_jit):
         rest = [v.to_table() if isinstance(v, PagedColumns) and i != bi
                 else v for i, v in enumerate(resident)]
         out = None
-        import contextlib
-
         with contextlib.closing(
                 resident[bi].stream_tables(prefetch=0)) as btabs:
             for btab in btabs:
@@ -127,6 +124,71 @@ def _run_fold(node, fold, pc, resident, placement, step_jit):
         resident = tuple(v.to_table() if isinstance(v, PagedColumns)
                          else v for v in resident)
     return _run_fold_once(fold, pc, resident, placement, step_jit)
+
+
+def _run_tensor_stream(node, tfold, in_vals, src, step_jit):
+    """Stream a paged TENSOR input through a node — in-DB inference
+    over storage-managed weights (ref ``SimpleFF.cc:94-290``: FF
+    scans its weight sets page-fed via ``FFMatrixBlockScanner`` +
+    ``PageScanner.h:25-34``). Only one weight page (plus the node's
+    resident inputs and the assembled output) is device-resident at a
+    time.
+
+    mode "rows": evaluate the node's fn once per row block (the block
+    substituted for the paged input) and concatenate output rows;
+    ``out_block`` re-blocks the assembly so its meta — and downstream
+    padded shapes — match the resident path exactly.
+    mode "reduce": blocks are contraction slices; ``partial``
+    accumulates, ``finalize`` applies the epilogue."""
+    import jax.numpy as jnp
+
+    pt = in_vals[src]
+    others = [v for i, v in enumerate(in_vals) if i != src]
+    placement = pt.placement
+
+    def place(block):
+        b = jnp.asarray(block)
+        if placement is not None:
+            b = placement.apply(b)
+        return b
+
+    if tfold.mode == "rows":
+        def step(block, *os):
+            bt = BlockedTensor.from_dense(block, tuple(block.shape))
+            args = list(os)
+            args.insert(src, bt)
+            return node.fn(*args)
+
+        jstep = step_jit(0, step)
+        outs = []
+        was_blocked = False
+        with contextlib.closing(pt.stream_blocks()) as blocks:
+            for _start, block in blocks:
+                out = jstep(place(block), *others)
+                if isinstance(out, BlockedTensor):
+                    was_blocked = True
+                    out = out.to_dense()
+                outs.append(out)
+        dense = jnp.concatenate(outs, axis=0)
+        if tfold.out_block is not None:
+            return BlockedTensor.from_dense(dense, tfold.out_block)
+        if was_blocked:
+            return BlockedTensor.from_dense(dense, tuple(dense.shape))
+        return dense
+
+    # mode "reduce": carry accumulation over contraction slices
+    def step(carry, start, block, *os):
+        return tfold.partial(carry, start, block, *os)
+
+    jstep = step_jit(1, step)
+    carry = None
+    with contextlib.closing(pt.stream_blocks()) as blocks:
+        for start, block in blocks:
+            carry = jstep(carry, jnp.asarray(start, jnp.int32),
+                          place(block), *others)
+    if tfold.finalize is not None:
+        return tfold.finalize(carry, *others)
+    return carry
 
 
 def _execute_streamed(client, plan: LogicalPlan, scan_values: Dict[int, Any],
@@ -144,6 +206,7 @@ def _execute_streamed(client, plan: LogicalPlan, scan_values: Dict[int, Any],
     matters."""
     from netsdb_tpu.plan.fold import flatten_resident
     from netsdb_tpu.relational.outofcore import PagedColumns
+    from netsdb_tpu.storage.paged import PagedTensor
 
     placements = {
         n.node_id: client.store.placement_of(
@@ -199,6 +262,38 @@ def _execute_streamed(client, plan: LogicalPlan, scan_values: Dict[int, Any],
                 node, fold, in_vals[src], resident, placement,
                 step_jit_for(node))
             continue
+        tsrcs = [i for i, v in enumerate(in_vals)
+                 if isinstance(v, PagedTensor)]
+        if tsrcs:
+            tfold = getattr(node, "tensor_fold", None)
+            if tfold is None or len(tsrcs) > 1:
+                # NO silent materialization: a paged weight exists
+                # because it does not fit — a fold-less consumer would
+                # defeat that by construction
+
+                def set_of(i):
+                    inp = node.inputs[i]
+                    return (f"{inp.db}:{inp.set_name}"
+                            if isinstance(inp, ScanSet) else in_vals[i].name)
+
+                raise ValueError(
+                    f"node "
+                    f"{getattr(node, 'label', node.op_kind)!r} "
+                    f"consumes paged tensor set(s) "
+                    f"{[set_of(i) for i in tsrcs]} but "
+                    + ("declares no tensor_fold" if tfold is None else
+                       "only one input may stream")
+                    + "; give the node a plan.fold.TensorFold, or store "
+                      "the set with storage='memory'")
+            # a co-input that is a paged RELATION materializes (the
+            # documented fold-less fallback) — it cannot ride into the
+            # jitted tensor step as a raw stream handle
+            in_vals = [table_of(node.inputs[i].node_id, v)
+                       if isinstance(v, PagedColumns) else v
+                       for i, v in enumerate(in_vals)]
+            values[node.node_id] = _run_tensor_stream(
+                node, tfold, in_vals, tsrcs[0], step_jit_for(node))
+            continue
         in_vals = [table_of(node.inputs[i].node_id, v)
                    if isinstance(v, PagedColumns) else v
                    for i, v in enumerate(in_vals)]
@@ -244,10 +339,18 @@ def execute_computations(
                 # paged set: the value IS the page stream handle; the
                 # streamed evaluator folds consumers over it
                 scan_values[node.node_id] = items[0]
+            elif len(items) == 1 and isinstance(items[0], _PagedMatrix):
+                # paged TENSOR set (weights in the arena): the value is
+                # a streaming handle; TensorFold-bearing consumers
+                # stream it, everything else errors (never materialize)
+                scan_values[node.node_id] = client.store.paged_tensor(
+                    ident)
             else:
                 scan_values[node.node_id] = items
 
-    any_paged = any(isinstance(v, PagedColumns)
+    from netsdb_tpu.storage.paged import PagedTensor
+
+    any_paged = any(isinstance(v, (PagedColumns, PagedTensor))
                     for v in scan_values.values())
     all_traceable = all(_is_traceable(n) for n in plan.topo)
 

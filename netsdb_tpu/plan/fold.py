@@ -71,6 +71,56 @@ class FoldSpec:
         return self.finalize(state, table, *resident)
 
 
+@dataclasses.dataclass(frozen=True)
+class TensorFold:
+    """Streamable decomposition of a node over a paged TENSOR input —
+    the weight-scan analogue of :class:`FoldSpec`.
+
+    The reference's defining scenario is in-DB inference with
+    storage-managed weights: FF inference *scans* its weight sets
+    page-fed like any other pipeline (``src/FF/source/SimpleFF.cc:
+    94-290``, ``src/FF/headers/FFMatrixBlockScanner.h``, fed by
+    ``src/storage/headers/PageScanner.h:25-34``). A node carrying a
+    TensorFold can consume a ``storage="paged"`` matrix the same way:
+    the executor streams the matrix's row-block pages through the node
+    instead of materializing it (which :meth:`SetStore.get_tensor`
+    refuses for paged sets by design).
+
+    Two decompositions cover the weight-matmul family:
+
+    - ``mode="rows"``: the node's ``fn`` is ROW-decomposable in the
+      paged input — row block *i* of the paged matrix produces row
+      block *i* of the output (``w @ x`` patterns: ``matmul`` /
+      ``matmul_t`` with the paged side on the left). The executor
+      evaluates ``fn`` once per block (one compiled step, reused; the
+      ragged tail block is a second trace) and concatenates the output
+      rows. ``out_block`` pins the assembled BlockedTensor's block
+      shape so the result's meta — and therefore downstream padded
+      shapes — match the resident path exactly.
+
+    - ``mode="reduce"``: the paged input's row blocks are CONTRACTION
+      slices (``x @ w`` patterns with the paged side on the right):
+      ``partial(carry, start, block, *others) -> carry`` accumulates
+      partial products (carry is ``None`` on the first block);
+      ``finalize(carry, *others)`` applies any epilogue (e.g. the gelu
+      after an MLP up-projection). ``others`` are the node's non-paged
+      input values in input order.
+    """
+
+    mode: str = "rows"
+    out_block: Optional[Tuple[int, int]] = None
+    partial: Optional[Callable] = None
+    finalize: Optional[Callable] = None
+
+    def __post_init__(self):
+        if self.mode not in ("rows", "reduce"):
+            raise ValueError(f"TensorFold mode must be 'rows' or "
+                             f"'reduce', got {self.mode!r}")
+        if self.mode == "reduce" and self.partial is None:
+            raise ValueError("TensorFold(mode='reduce') needs a partial "
+                             "accumulator")
+
+
 def single_pass(init: Callable, step: Callable,
                 finalize: Callable, merge: Optional[Callable] = None
                 ) -> FoldSpec:

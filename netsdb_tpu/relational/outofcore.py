@@ -22,6 +22,7 @@ validity mask like everywhere else in this framework).
 
 from __future__ import annotations
 
+import contextlib
 from typing import Dict, Iterator, List, Optional, Tuple
 
 import jax
@@ -61,6 +62,9 @@ class PagedColumns:
         # could free or grow pages mid-stream; streams hold read, the
         # mutators hold write (the arena pin, Python-side)
         self.rw = RWLock()
+        self.dropped = False  # set by drop(); appends must not
+        # resurrect freed arena names (a fresh put under a dead name
+        # would leak unreferenced pages)
         # ingest-time ColumnStats per int column — collected in the one
         # pass that already touches every row, so the planner never has
         # to re-stream the set (the reference's StorageCollectStats
@@ -159,6 +163,9 @@ class PagedColumns:
         if n_new == 0:
             return  # all-masked/empty batch: a no-op, not a stats merge
         with self.rw.write():  # drain in-flight streams before growing
+            if self.dropped:
+                raise KeyError(f"paged relation {self.name!r} was "
+                               f"dropped; cannot append")
             undo = []
             for suffix, mat in ((".int", imat), (".float", fmat)):
                 if mat is None:
@@ -193,6 +200,9 @@ class PagedColumns:
         a concurrent append/drop (write lock) cannot free or grow pages
         mid-stream."""
         with self.rw.read():
+            if self.dropped:
+                raise KeyError(f"paged relation {self.name!r} was "
+                               f"dropped; cannot stream")
             yield from self._stream_unlocked(prefetch)
 
     def _stream_unlocked(self, prefetch: int = 2
@@ -248,6 +258,7 @@ class PagedColumns:
         int and float matrices). After this the PagedColumns is dead.
         Waits for in-flight streams (read lock holders) to drain."""
         with self.rw.write():
+            self.dropped = True
             for suffix in (".int", ".float"):
                 self.store.drop(self.name + suffix)
 
@@ -459,8 +470,6 @@ def ooc_q03(pc: PagedColumns, store: PagedTensorStore,
         btab = ColumnTable({"o_orderkey": jnp.asarray(keys),
                             "o_orderdate": jnp.asarray(bmat[:, 1])})
         state = fold.passes[0][0](None, pc, btab)
-        import contextlib
-
         with contextlib.closing(pc.stream_tables()) as chunks:
             for chunk in chunks:
                 state = jstep(state, chunk, btab)

@@ -74,6 +74,47 @@ class _PyPageBackend:
         pass
 
 
+class PagedTensor:
+    """Streaming read handle for a matrix living as arena pages — the
+    value a ``ScanSet`` of a paged TENSOR set produces in the executor.
+
+    Never materializes: consumers stream row blocks (the reference's
+    FFMatrixBlockScanner feeding weight pages into the inference
+    pipeline, ``src/FF/headers/FFMatrixBlockScanner.h`` +
+    ``src/storage/headers/PageScanner.h:25-34``). ``rw`` is the owning
+    set item's stream-vs-mutation lock; ``placement`` the owning set's
+    declared distribution (applied per block by the executor).
+    """
+
+    def __init__(self, store: "PagedTensorStore", name: str,
+                 rw=None, placement=None):
+        from netsdb_tpu.utils.locks import RWLock
+
+        self.store = store
+        self.name = name
+        self.rw = rw if rw is not None else RWLock()
+        self.placement = placement
+
+    @property
+    def shape(self) -> Tuple[int, int]:
+        return self.store.meta(self.name)[0]
+
+    @property
+    def dtype(self) -> np.dtype:
+        return self.store.meta(self.name)[2]
+
+    def num_blocks(self) -> int:
+        return self.store.num_blocks(self.name)
+
+    def stream_blocks(self, prefetch: int = 2
+                      ) -> Iterator[Tuple[int, np.ndarray]]:
+        """Yield (start_row, block) holding the read lock for the
+        generator's lifetime (a concurrent drop/replace must not free
+        pages mid-stream); consumers should close() abandoned streams."""
+        with self.rw.read():
+            yield from self.store.stream_blocks(self.name, prefetch)
+
+
 class PagedTensorStore:
     """Row-block paged storage for large matrices."""
 
@@ -187,6 +228,14 @@ class PagedTensorStore:
         starts = list(itertools.accumulate([0] + ns[:-1]))
         self._layout[sid] = (ns, starts)
         return ns, starts
+
+    def meta(self, name: str) -> Tuple[Tuple[int, int], Tuple[int, int],
+                                       np.dtype]:
+        """((rows, cols), (row_block, cols), dtype) of a stored matrix
+        — the public face of the per-set metadata (PagedTensor and the
+        serve layer read shape/dtype through this, never the private
+        maps)."""
+        return self._meta[self._ids[name]]
 
     def read_block(self, name: str, index: int) -> Tuple[int, np.ndarray]:
         """Random access to one row-block: (start_row, block). The

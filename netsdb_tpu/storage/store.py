@@ -68,6 +68,10 @@ class _StoredSet:
 
     ident: SetIdentifier
     items: Optional[List[Any]]  # None => spilled to disk
+    # serializes PAGED appends per set OUTSIDE the global store lock
+    # (an append must wait for in-flight streams to drain — rw.write —
+    # and that wait must not freeze every unrelated store operation)
+    append_mu: Any = dataclasses.field(default_factory=threading.Lock)
     persistence: str = "transient"  # ref PersistenceType (DataTypes.h:53)
     eviction: str = "lru"  # ref LocalitySet replacement policy
     last_access: float = 0.0
@@ -102,10 +106,18 @@ class _PagedMatrix:
     """Handle for a matrix living as arena pages (a paged TENSOR set):
     identity only — shape/dtype's authoritative copies live in the
     page store's meta; the data streams through
-    ``SetStore.paged_matmul``, never materializing densely (ref:
-    pipelines over pinned weight pages)."""
+    ``SetStore.paged_matmul`` or a :class:`PagedTensor` scan handle,
+    never materializing densely (ref: pipelines over pinned weight
+    pages). ``rw`` guards streams vs drop/replace."""
 
     ident: str
+    rw: Any = None
+
+    def __post_init__(self):
+        if self.rw is None:
+            from netsdb_tpu.utils.locks import RWLock
+
+            self.rw = RWLock()
 
 
 def _locked(method):
@@ -145,6 +157,12 @@ class SetStore:
         # one shared-memory pool per worker); lazy — most processes
         # never create a paged set
         self._page_store = None
+        # arena names are GENERATION-unique (ident#gN): a deferred
+        # unlocked drop after remove_set must never free the pages of a
+        # same-named set re-created in the window
+        import itertools
+
+        self._gen = itertools.count()
 
     def page_store(self):
         """The shared :class:`PagedTensorStore` backing every
@@ -235,32 +253,36 @@ class SetStore:
                 item.drop()
             elif isinstance(item, _PagedMatrix) and \
                     self._page_store is not None:
-                self._page_store.drop(f"{item.ident}.mat")
+                with item.rw.write():  # drain in-flight weight streams
+                    self._page_store.drop(f"{item.ident}.mat")
 
     @_locked
     def list_sets(self) -> List[SetIdentifier]:
         return list(self._sets.keys())
 
     # --- data path (ref: StorageAddData / UserSet::addObject) ---------
-    @_locked
     def add_data(self, ident: SetIdentifier, items: List[Any]) -> None:
-        s = self._require(ident)
-        if s.alias_of is not None:
-            raise ValueError(f"set {ident} aliases {s.alias_of}; it is read-only")
-        if s.storage == "paged":
-            self._ingest_paged(s, items)
-            return
-        if s.items is None:  # evicted to disk: reload before appending
-            self._load_from_spill(s)
-        if s.placement is not None:
-            items = [s.placement.apply(i) for i in items]
-        s.items.extend(items)
-        s.nbytes += sum(_item_nbytes(i) for i in items)
-        s.last_access = time.time()
-        self._maybe_evict(exclude=ident)
+        dead = []
+        with self._lock:
+            s = self._require(ident)
+            if s.alias_of is not None:
+                raise ValueError(f"set {ident} aliases {s.alias_of}; "
+                                 f"it is read-only")
+            if s.storage == "paged":
+                dead = self._ingest_paged(s, items)
+            else:
+                if s.items is None:  # evicted: reload before appending
+                    self._load_from_spill(s)
+                if s.placement is not None:
+                    items = [s.placement.apply(i) for i in items]
+                s.items.extend(items)
+                s.nbytes += sum(_item_nbytes(i) for i in items)
+                s.last_access = time.time()
+                self._maybe_evict(exclude=ident)
+        self._drop_detached(dead)  # replaced pages reclaim UNLOCKED
 
     def _ingest_paged(self, s: _StoredSet, items: List[Any],
-                      append: bool = False) -> None:
+                      append: bool = False) -> List[Any]:
         """Route a relation into the page arena instead of RAM — the set
         property the reference expresses by EVERY set living in pages
         (``PangeaStorageServer.h:31-52``); here only sets that opt into
@@ -268,7 +290,12 @@ class SetStore:
         (matching ``send_table`` semantics); re-ingest replaces, or
         APPENDS new pages when asked (the reference's addData flow) —
         dictionary-encoded batch columns remap into the stored
-        dictionaries first."""
+        dictionaries first.
+
+        Returns the REPLACED paged items: arena names are generation-
+        unique, so the caller reclaims the old pages OUTSIDE the store
+        lock (``_drop_detached`` waits for in-flight streams; that wait
+        must not freeze unrelated store operations)."""
         from netsdb_tpu.relational.outofcore import PagedColumns
         from netsdb_tpu.relational.table import ColumnTable
 
@@ -277,13 +304,14 @@ class SetStore:
                              f"relation; got {len(items)} items")
         item = items[0]
         if isinstance(item, PagedColumns):
-            # replacing with a new handle must free the OLD relation's
-            # arena pages (the same cross-type-leak rule as below) —
-            # unless the "new" handle IS the stored one (no-op re-add)
+            # replacing with a new handle: the OLD relation's arena
+            # pages go back to the caller for reclaim (cross-type-leak
+            # rule) — unless the "new" handle IS the stored one
+            dead = []
             if not (s.items and len(s.items) == 1 and s.items[0] is item):
-                self._drop_paged_items(s)
+                dead = list(s.items or [])
             s.items = [item]
-            return
+            return dead
         if isinstance(item, (np.ndarray, BlockedTensor)):
             if append:
                 raise ValueError(f"append is not supported for paged "
@@ -292,17 +320,18 @@ class SetStore:
             # paged TENSOR set: a matrix larger than HBM pages into the
             # arena; consumers stream it (``paged_matmul`` — the r1
             # matmul_streamed capability, now a property of the set).
-            # Replace semantics: drop the old contents first (a
-            # cross-type replace would otherwise leak pages forever)
-            self._drop_paged_items(s)
+            # Replace semantics: the old contents are returned for
+            # unlocked reclaim (cross-type replaces must not leak)
+            dead = list(s.items or [])
             dense = (np.asarray(item.to_dense()) if
                      isinstance(item, BlockedTensor) else
                      np.ascontiguousarray(item))
-            self.page_store().put(f"{s.ident}.mat", dense)
-            s.items = [_PagedMatrix(str(s.ident))]
+            arena_name = f"{s.ident}#g{next(self._gen)}"
+            self.page_store().put(f"{arena_name}.mat", dense)
+            s.items = [_PagedMatrix(arena_name)]
             s.nbytes = 0
             s.last_access = time.time()
-            return
+            return dead
         if not isinstance(item, ColumnTable):
             raise TypeError(f"paged set {s.ident} ingests ColumnTables "
                             f"or matrices; got {type(item).__name__}")
@@ -345,10 +374,11 @@ class SetStore:
             pc.append(cols)  # atomic (rolls back its pages on failure)
             pc.dicts.update(staged_dicts)  # commit only after success
             s.last_access = time.time()
-            return
-        # fresh/replace table ingest: drop whatever the set held (table
-        # pages or a matrix) so cross-type replaces cannot leak
-        self._drop_paged_items(s)
+            return []
+        # fresh/replace table ingest: whatever the set held (table pages
+        # or a matrix) is returned for unlocked reclaim — generation-
+        # unique arena names make new-before-drop ordering safe
+        dead = list(s.items or [])
         # page row count sized to the configured page bytes (floor 64 so
         # tiny test pages still hold whole rows); for placed sets,
         # rounded to the shard granularity so streamed chunks mesh-shard
@@ -362,11 +392,13 @@ class SetStore:
         if item.valid is not None:
             keep = np.asarray(item.mask())
             cols = {n: c[keep] for n, c in cols.items()}
-        pc = PagedColumns.ingest(self.page_store(), str(s.ident), cols,
+        pc = PagedColumns.ingest(self.page_store(),
+                                 f"{s.ident}#g{next(self._gen)}", cols,
                                  row_block=row_block, dicts=dict(item.dicts))
         s.items = [pc]
         s.nbytes = 0  # pages are accounted (and capped) by the arena
         s.last_access = time.time()
+        return dead
 
     @_locked
     def update_set(self, ident: SetIdentifier, fn) -> None:
@@ -388,42 +420,84 @@ class SetStore:
         s.last_access = time.time()
         self._maybe_evict(exclude=ident)
 
-    @_locked
     def paged_matmul(self, ident: SetIdentifier, rhs) -> np.ndarray:
         """``stored_matrix @ rhs`` with the left side STREAMED page by
         page through the device — the larger-than-HBM weight pattern
         (only one page + rhs resident at a time; r1's matmul_streamed,
         reachable as a set property since the matrix lives in a
-        ``storage="paged"`` set). Runs UNDER the store lock for its
-        whole duration: a concurrent remove/re-ingest freeing the pages
-        mid-stream would otherwise corrupt the product (the reference
-        pins pages for exactly this; a per-set pin would narrow the
-        critical section if the global lock ever becomes a bottleneck)."""
+        ``storage="paged"`` set). The stream runs OUTSIDE the store
+        lock under the item's read lock (the arena pin): a concurrent
+        remove/re-ingest waits for the stream instead of the stream
+        freezing every other store operation."""
+        with self._lock:
+            s = self._require(ident)
+            pm = next((i for i in (s.items or [])
+                       if isinstance(i, _PagedMatrix)), None)
+            if pm is None:
+                raise ValueError(f"set {ident} holds no paged matrix")
+            s.last_access = time.time()
+            ps = self.page_store()
+        with pm.rw.read():
+            return ps.matmul_streamed(f"{pm.ident}.mat", np.asarray(rhs))
+
+    @_locked
+    def paged_tensor(self, ident: SetIdentifier):
+        """Streaming read handle for a paged TENSOR set — the ScanSet
+        value the executor feeds to :class:`~netsdb_tpu.plan.fold.
+        TensorFold`-bearing nodes (in-DB inference over storage-managed
+        weights, ref ``SimpleFF.cc:94-290``). Never materializes."""
+        from netsdb_tpu.storage.paged import PagedTensor
+
         s = self._require(ident)
         pm = next((i for i in (s.items or [])
                    if isinstance(i, _PagedMatrix)), None)
         if pm is None:
             raise ValueError(f"set {ident} holds no paged matrix")
         s.last_access = time.time()
-        return self.page_store().matmul_streamed(f"{pm.ident}.mat",
-                                                 np.asarray(rhs))
+        return PagedTensor(self.page_store(), f"{pm.ident}.mat",
+                           rw=pm.rw, placement=s.placement)
 
-    @_locked
     def append_table(self, ident: SetIdentifier, table) -> None:
         """Append a batch of rows to a table set (the reference's
         addData flow, ``StorageAddData``): paged sets write additional
         arena pages (no rewrite); memory sets concat on device with
-        dictionary remap. Atomic under the store lock."""
+        dictionary remap.
+
+        Paged appends serialize on the SET's append lock outside the
+        global store lock: the page write must wait for in-flight
+        streams of the same relation (rw.write), and that wait must not
+        freeze unrelated store operations. The store lock is re-taken
+        only to verify the set wasn't removed/replaced in between."""
+        from netsdb_tpu.relational.autojoin import concat_tables
+        from netsdb_tpu.relational.table import ColumnTable
+
+        with self._lock:
+            s = self._require(ident)
+            if s.alias_of is not None:
+                raise ValueError(f"set {ident} aliases {s.alias_of}; "
+                                 f"it is read-only")
+            paged = s.storage == "paged"
+        if paged:
+            with s.append_mu:  # concurrent appends: dict remaps must
+                with self._lock:  # not interleave (per-set, not global)
+                    if self._sets.get(ident) is not s:
+                        raise KeyError(f"set {ident} was removed during "
+                                       f"append")
+                # first batch falls through to a fresh ingest inside;
+                # validation + dict staging read pc under append_mu
+                self._drop_detached(
+                    self._ingest_paged(s, [table], append=True))
+            return
+        self._append_table_memory(ident, table)
+
+    @_locked
+    def _append_table_memory(self, ident: SetIdentifier, table) -> None:
         from netsdb_tpu.relational.autojoin import concat_tables
         from netsdb_tpu.relational.table import ColumnTable
 
         s = self._require(ident)
         if s.alias_of is not None:
             raise ValueError(f"set {ident} aliases {s.alias_of}; it is read-only")
-        if s.storage == "paged":
-            # first batch falls through to a fresh ingest inside
-            self._ingest_paged(s, [table], append=True)
-            return
         if s.items is None:
             self._load_from_spill(s)
         tables = [i for i in s.items if isinstance(i, ColumnTable)]
@@ -440,23 +514,26 @@ class SetStore:
         s.last_access = time.time()
         self._maybe_evict(exclude=ident)
 
-    @_locked
     def put_tensor(self, ident: SetIdentifier, tensor: BlockedTensor) -> None:
         """Replace a set's contents with one tensor — the dominant pattern
         for model-weight sets (each netsDB weight set is exactly one
         blocked matrix)."""
-        s = self._require(ident)
-        if s.alias_of is not None:
-            raise ValueError(f"set {ident} aliases {s.alias_of}; it is read-only")
-        if s.storage == "paged":
-            self._ingest_paged(s, [tensor])
-            return
-        if s.placement is not None:
-            tensor = s.placement.apply(tensor)
-        s.items = [tensor]
-        s.nbytes = _item_nbytes(tensor)
-        s.last_access = time.time()
-        self._maybe_evict(exclude=ident)
+        dead = []
+        with self._lock:
+            s = self._require(ident)
+            if s.alias_of is not None:
+                raise ValueError(f"set {ident} aliases {s.alias_of}; "
+                                 f"it is read-only")
+            if s.storage == "paged":
+                dead = self._ingest_paged(s, [tensor])
+            else:
+                if s.placement is not None:
+                    tensor = s.placement.apply(tensor)
+                s.items = [tensor]
+                s.nbytes = _item_nbytes(tensor)
+                s.last_access = time.time()
+                self._maybe_evict(exclude=ident)
+        self._drop_detached(dead)  # replaced pages reclaim UNLOCKED
 
     def get_tensor(self, ident: SetIdentifier) -> BlockedTensor:
         items = self.get_items(ident)
@@ -649,8 +726,11 @@ class SetStore:
                         if kind in ("paged", "paged_mat")]
         if paged_tables:
             # snapshot of a paged set: re-ingest the relation into the
-            # arena — the set comes back PAGED, placement and all
-            self._ingest_paged(s, paged_tables)
+            # arena — the set comes back PAGED, placement and all.
+            # (Reload happens under the store lock; a reload never
+            # replaces live paged items, so the dead list is empty —
+            # still reclaimed for belt-and-braces.)
+            self._drop_detached(self._ingest_paged(s, paged_tables))
             self.stats.misses += 1
             self.stats.loads += 1
             return

@@ -190,7 +190,7 @@ def test_q03_grace_hash_paged_build_and_probe(tmp_path, tables):
         "d", n_customers=cust["stats"]["c_custkey"].key_space,
         segment_code=cust["dicts"]["c_mktsegment"].index("BUILDING")))
     bpc = c.store.get_items(SetIdentifier("d", "q03_build"))[0]
-    assert bpc.store.num_blocks("d:q03_build.int") > 1  # real partitions
+    assert bpc.store.num_blocks(f"{bpc.name}.int") > 1  # real partitions
     out = rdag.run_query(c, rdag.q03_probe_sink(
         "d", n_orders=orders["stats"]["o_orderkey"].key_space))
     rows = rdag.q03_rows(out)

@@ -1,0 +1,223 @@
+"""Model inference over PAGED weight sets — round-5 item 1.
+
+The reference's defining scenario is in-database inference with
+storage-managed weights: FF inference *scans* its weight sets page-fed
+like any other pipeline (``src/FF/source/SimpleFF.cc:94-290``,
+``src/FF/headers/FFMatrixBlockScanner.h``, fed by
+``src/storage/headers/PageScanner.h:25-34``). These tests pin the
+TPU-native equivalent: ``create_set(storage="paged")`` weight sets
+stream through the UNCHANGED Computation DAGs via
+:class:`netsdb_tpu.plan.fold.TensorFold` — under a capped arena
+(spills asserted), matching resident inference, composing with
+placement, and erroring loudly where streaming is impossible instead of
+silently materializing.
+"""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from netsdb_tpu.client import Client
+from netsdb_tpu.config import Configuration
+from netsdb_tpu.models.ff import FFModel
+from netsdb_tpu.models.transformer import TransformerLayerModel
+
+F, H, L, B = 96, 128, 10, 32
+
+
+def _ff_out(tmp_path, tag, storages=None, placements=None, block=(32, 32)):
+    cfg = Configuration(root_dir=str(tmp_path / tag),
+                        page_size_bytes=4096, page_pool_bytes=16384)
+    c = Client(cfg)
+    m = FFModel(db="ff", block=block)
+    m.setup(c, placements=placements, storages=storages)
+    m.load_random_weights(c, F, H, L, seed=0)
+    x = np.random.default_rng(1).standard_normal((B, F)).astype(np.float32)
+    m.load_inputs(c, x)
+    out = np.asarray(m.inference(c).to_dense())
+    return out, c
+
+
+def test_ff_inference_paged_weights_matches_resident_bitwise(tmp_path):
+    """w1 and wo live as arena pages under a 16 KB pool; the SAME
+    inference DAG streams them (spills > 0) and the output is
+    BIT-IDENTICAL to resident inference (row-block decomposition
+    leaves each output element's contraction untouched)."""
+    res, _ = _ff_out(tmp_path, "res")
+    pag, c = _ff_out(tmp_path, "pag", storages={"w1": "paged",
+                                                "wo": "paged"})
+    st = c.store.page_store().stats()
+    assert st["spills"] > 0, "arena must have spilled (weights > pool)"
+    np.testing.assert_array_equal(res, pag)
+
+
+def test_ff_paged_weights_compose_with_placement(tmp_path):
+    """A paged weight set that is ALSO placed streams each block onto
+    the placement's mesh before the step (weight pages × distribution,
+    the reference's storage × scheduling composition)."""
+    from netsdb_tpu.parallel.placement import Placement
+
+    res, _ = _ff_out(tmp_path, "res2")
+    pl = {"w1": Placement((("model", 0),), (None, "model")),
+          "wo": Placement((("model", 0),), (None, None))}
+    pag, c = _ff_out(tmp_path, "pag2",
+                     storages={"w1": "paged", "wo": "paged"},
+                     placements=pl)
+    assert c.store.page_store().stats()["spills"] > 0
+    np.testing.assert_allclose(res, pag, rtol=1e-6, atol=1e-7)
+
+
+def test_ff_paged_weights_through_daemon(tmp_path):
+    """The same scenario through the client API against a live daemon:
+    weights SEND_MATRIX'd into paged sets, inference executed
+    remotely."""
+    from netsdb_tpu.serve.client import RemoteClient
+    from netsdb_tpu.serve.server import ServeController
+
+    cfg = Configuration(root_dir=str(tmp_path / "served"),
+                        page_size_bytes=4096, page_pool_bytes=16384)
+    ctl = ServeController(cfg, port=0)
+    port = ctl.start()
+    try:
+        rc = RemoteClient(f"127.0.0.1:{port}")
+        m = FFModel(db="ff", block=(32, 32))
+        rc.create_database("ff")
+        for s in m.SETS:
+            rc.create_set("ff", s,
+                          storage="paged" if s in ("w1", "wo")
+                          else "memory")
+        m.load_random_weights(rc, F, H, L, seed=0)
+        x = np.random.default_rng(1).standard_normal(
+            (B, F)).astype(np.float32)
+        m.load_inputs(rc, x)
+        sink = m.build_inference_dag()
+        rc.execute_computations(sink, job_name="ff-paged-remote")
+        out = np.asarray(rc.get_tensor("ff", "output").to_dense())
+        ref, _ = _ff_out(tmp_path, "oracle")
+        np.testing.assert_array_equal(ref, out)
+        assert ctl.library.store.page_store().stats()["spills"] > 0
+    finally:
+        ctl.shutdown()
+
+
+def test_transformer_layer_paged_mlp_matches_resident(tmp_path):
+    """One transformer layer with w_up/w_down paged: the staged DAG's
+    reduce-mode TensorFolds accumulate contraction slices; result
+    matches the resident staged DAG and the fused ``forward``."""
+    E, S, Bt = 64, 16, 2
+
+    def run(tag, storages):
+        cfg = Configuration(root_dir=str(tmp_path / tag),
+                            page_size_bytes=4096, page_pool_bytes=16384)
+        c = Client(cfg)
+        m = TransformerLayerModel(db="tf", num_heads=4)
+        m.setup(c, storages=storages)
+        m.load_random_weights(c, E, seed=2)
+        x = np.random.default_rng(3).standard_normal(
+            (Bt, S, E)).astype(np.float32)
+        m.load_inputs(c, x)
+        sink = m.build_forward_dag_staged()
+        res = c.execute_computations(sink, job_name=f"tf-{tag}")
+        return np.asarray(next(iter(res.values()))), c, m, x
+
+    res, c0, m0, x = run("tfres", None)
+    pag, c1, _, _ = run("tfpag", {"w_up": "paged", "w_down": "paged"})
+    assert c1.store.page_store().stats()["spills"] > 0
+    np.testing.assert_allclose(res, pag, rtol=2e-5, atol=2e-5)
+    # staged DAG == fused forward on the same params
+    p = m0.params_from_store(c0)
+    fused = np.asarray(m0.forward(p, jnp.asarray(x)))
+    np.testing.assert_allclose(res, fused, rtol=2e-5, atol=2e-5)
+
+
+def test_fold_less_consumer_of_paged_tensor_errors(tmp_path):
+    """A node without a TensorFold consuming a paged tensor set must
+    raise with guidance — NEVER silently materialize the weight that
+    was paged precisely because it does not fit."""
+    from netsdb_tpu.plan.computations import Apply, ScanSet, WriteSet
+
+    cfg = Configuration(root_dir=str(tmp_path / "err"),
+                        page_size_bytes=4096, page_pool_bytes=16384)
+    c = Client(cfg)
+    c.create_database("d")
+    c.create_set("d", "w", storage="paged")
+    c.send_matrix("d", "w", np.ones((64, 16), np.float32))
+    sink = WriteSet(Apply(ScanSet("d", "w"), fn=lambda t: t,
+                          label="ident"), "d", "out")
+    with pytest.raises(ValueError, match="tensor_fold"):
+        c.execute_computations(sink, job_name="bad")
+
+
+def test_paged_weight_set_survives_flush_reload(tmp_path):
+    """Durability composes: flush a paged weight set, reload in a fresh
+    client over the same root, inference still streams and matches."""
+    from netsdb_tpu.storage.store import SetIdentifier
+
+    res, _ = _ff_out(tmp_path, "res3")
+    root = tmp_path / "dur"
+    cfg = Configuration(root_dir=str(root), page_size_bytes=4096,
+                        page_pool_bytes=16384)
+    c = Client(cfg)
+    m = FFModel(db="ff", block=(32, 32))
+    m.setup(c, storages={"w1": "paged", "wo": "paged"})
+    m.load_random_weights(c, F, H, L, seed=0)
+    x = np.random.default_rng(1).standard_normal((B, F)).astype(np.float32)
+    m.load_inputs(c, x)
+    for s in ("w1", "b1", "wo", "bo", "inputs"):
+        c.store.flush(SetIdentifier("ff", s))
+    c2 = Client(Configuration(root_dir=str(root), page_size_bytes=4096,
+                              page_pool_bytes=16384))
+    for s in ("w1", "b1", "wo", "bo", "inputs"):
+        c2.store.load_set(SetIdentifier("ff", s))
+    assert c2.store.storage_of(SetIdentifier("ff", "w1")) == "paged"
+    out = np.asarray(m.inference(c2).to_dense())
+    np.testing.assert_array_equal(res, out)
+
+
+def test_recreate_same_name_survives_deferred_drop(tmp_path):
+    """remove_set reclaims pages OUTSIDE the store lock; arena names
+    are generation-unique, so a same-named set re-created in the window
+    keeps its fresh pages (r5 review finding: drop-by-name race)."""
+    from netsdb_tpu.storage.store import SetIdentifier
+
+    cfg = Configuration(root_dir=str(tmp_path / "gen"),
+                        page_size_bytes=4096, page_pool_bytes=16384)
+    c = Client(cfg)
+    c.create_database("d")
+    c.create_set("d", "w", storage="paged")
+    c.send_matrix("d", "w", np.ones((64, 16), np.float32))
+    # grab the OLD item (as a deferred drop would), replace the set,
+    # then run the stale drop — the new generation must survive
+    old_items = list(
+        c.store._sets[SetIdentifier("d", "w")].items)
+    c.remove_set("d", "w")
+    c.create_set("d", "w", storage="paged")
+    m2 = np.full((64, 16), 2.0, np.float32)
+    c.send_matrix("d", "w", m2)
+    c.store._drop_detached(old_items)  # stale drop, second time: no-op
+    out = c.paged_matmul("d", "w", np.eye(16, dtype=np.float32))
+    np.testing.assert_array_equal(out, m2)
+
+
+def test_append_to_dropped_paged_relation_raises(tmp_path):
+    """An append racing a remove must fail loudly, not resurrect freed
+    arena names (r5 review finding)."""
+    from netsdb_tpu.relational.table import ColumnTable
+
+    cfg = Configuration(root_dir=str(tmp_path / "race"),
+                        page_size_bytes=4096, page_pool_bytes=16384)
+    c = Client(cfg)
+    c.create_database("d")
+    c.create_set("d", "t", storage="paged")
+    t = ColumnTable({"a": np.arange(100, dtype=np.int32),
+                     "b": np.ones(100, np.float32)})
+    c.send_table("d", "t", t)
+    from netsdb_tpu.storage.store import SetIdentifier
+
+    pc = c.store.get_items(SetIdentifier("d", "t"))[0]
+    pc.drop()
+    with pytest.raises(KeyError, match="dropped"):
+        pc.append({"a": np.arange(5, dtype=np.int32),
+                   "b": np.ones(5, np.float32)})
