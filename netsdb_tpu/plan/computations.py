@@ -164,7 +164,7 @@ class Join(Computation):
                  label: str = "", fold=None, fold_src: int = 0,
                  on: Optional[tuple] = None,
                  take: Optional[Sequence[str]] = None,
-                 tensor_fold=None):
+                 tensor_fold=None, passthrough: bool = False):
         """``fold`` + ``fold_src``: streamable decomposition (see
         :class:`netsdb_tpu.plan.fold.FoldSpec`); ``fold_src`` says which
         input (0=left, 1=right) is the probe/fact side the page stream
@@ -187,6 +187,11 @@ class Join(Computation):
         # streamable decomposition over a paged TENSOR input (weight
         # scans — see Apply docstring / plan.fold.TensorFold)
         self.tensor_fold = tensor_fold
+        # passthrough=True: fn only re-shapes its inputs (the gather-
+        # chain tuple append) — the streamed executor forwards paged
+        # handles through it UNMATERIALIZED so a downstream fold can
+        # stream them (grace-hash build sides behind a gather chain)
+        self.passthrough = passthrough
         self.on = tuple(on) if on else None
         self.take = take
         if fn is None and fold is not None and left_key is None:

@@ -95,24 +95,133 @@ def _run_fold_once(fold, pc, resident, placement, step_jit):
     return fold.finalize(state, pc, *resident)
 
 
+def _pad_table_rows(t, rows: int):
+    """Pad a ColumnTable with invalid rows up to ``rows`` — build
+    partitions pad to ONE uniform size so every partition reuses a
+    single compiled step (the same static-shape discipline as the
+    chunk stream)."""
+    import jax.numpy as jnp
+
+    from netsdb_tpu.relational.table import ColumnTable
+
+    pad = rows - t.num_rows
+    if pad <= 0:
+        return t
+    cols = {k: jnp.concatenate(
+        [jnp.asarray(v), jnp.zeros((pad,) + v.shape[1:], v.dtype)])
+        for k, v in t.cols.items()}
+    valid = jnp.concatenate([t.mask(), jnp.zeros((pad,), jnp.bool_)])
+    return ColumnTable(cols, t.dicts, valid)
+
+
+def _part_chunks(ppc, placement):
+    """Stream one probe partition, restoring the ORIGINAL global
+    ``_rowid`` saved by the partitioner (folds arbitrate ties on it)."""
+    from netsdb_tpu.relational.table import ColumnTable
+
+    if ppc is None:
+        return
+    with contextlib.closing(
+            ppc.stream_tables(prefetch=0, placement=placement)) as cs:
+        for t in cs:
+            if "_rowid0" in t.cols:
+                cols = dict(t.cols)
+                cols["_rowid"] = cols.pop("_rowid0")
+                t = ColumnTable(cols, t.dicts, t.valid)
+            yield t
+
+
+def _run_fold_grace(fold, pc, rest, bi, build_pc, placement, step_jit):
+    """ONE-PASS grace hash for a paged build side: hash-partition BOTH
+    streams by the declared join keys into arena spill partitions (one
+    pass each), then loop partition PAIRS — build partition + its probe
+    partition resident together, outputs merged. Every probe page is
+    read once for partitioning and each repartitioned row once for
+    probing, instead of the whole probe stream once per build block
+    (the reference partitions both sides the same way,
+    ``PipelineStage.cc:1652-1728`` + ``HashSetManager.h``)."""
+    from netsdb_tpu.relational.outofcore import partition_by_key
+
+    nparts = build_pc.num_pages()
+    build_parts: list = []
+    probe_parts: list = []
+    out = None
+    try:
+        # inside the try: a failure partitioning the SECOND side must
+        # still reclaim the first side's spill partitions
+        build_parts = partition_by_key(build_pc, fold.build_key, nparts)
+        probe_parts = partition_by_key(pc, fold.probe_key, nparts,
+                                       keep_rowid=True)
+        maxr = max((bp.num_rows for bp in build_parts
+                    if bp is not None), default=0)
+        for p in range(nparts):
+            if build_parts[p] is None:
+                continue  # no build rows: probes there can only miss
+            btab = _pad_table_rows(build_parts[p].to_table(), maxr)
+            part_res = list(rest)
+            part_res[bi] = btab
+            state = None
+            for pidx, (init, step) in enumerate(fold.passes):
+                jstep = step_jit(pidx, step)
+                state = init(state, pc, *part_res)
+                for chunk in _part_chunks(probe_parts[p], placement):
+                    state = jstep(state, chunk, *part_res)
+            part = fold.finalize(state, pc, *part_res)
+            out = part if out is None else fold.merge(out, part)
+    finally:
+        for lst in (build_parts, probe_parts):
+            for prt in lst:
+                if prt is not None:
+                    prt.drop()
+    return out
+
+
 def _run_fold(node, fold, pc, resident, placement, step_jit):
-    """Dispatch a fold, handling a paged BUILD side: when one resident
+    """Dispatch a fold, handling a paged BUILD side: when a resident
     input is itself paged and the fold declares ``merge``, the join
-    runs grace-hash style — outer loop over the build's key-range
-    blocks (each resident only while probed; ref partitioned hash sets,
-    ``src/queryExecution/headers/HashSetManager.h``), inner stream over
-    the probe, per-partition outputs merged."""
+    runs grace-hash style (ref partitioned hash sets,
+    ``src/queryExecution/headers/HashSetManager.h``) — ONE-PASS
+    (both sides hash-partitioned, partition pairs joined) when the
+    fold declares its join keys, else the legacy per-build-block
+    re-stream. Other paged residents assemble HOST-side (never a
+    silent device materialization of a set that was paged because it
+    does not fit)."""
     from netsdb_tpu.relational.outofcore import PagedColumns
 
     builds = [i for i, v in enumerate(resident)
               if isinstance(v, PagedColumns)]
-    if len(builds) == 1 and fold.merge is not None:
-        bi = builds[0]
-        rest = [v.to_table() if isinstance(v, PagedColumns) and i != bi
-                else v for i, v in enumerate(resident)]
+    bi = None
+    keyed = False  # bi really holds the declared build_key column
+    if builds and fold.merge is not None:
+        if fold.build_key is not None:
+            # a declared build_key scopes the merge rule: it is only
+            # correct for partitions of THAT side (key-disjoint blocks;
+            # e.g. q02's per-part winner merge is wrong for partitions
+            # of supplier) — other paged residents assemble host-side
+            for i in builds:
+                v = resident[i]
+                if (fold.build_key in v.int_names
+                        or fold.build_key in v.float_names):
+                    bi = i
+                    keyed = True
+                    break
+        else:
+            # no declared key (q03-style folds whose merge is written
+            # for arbitrary row partitions of their one build side)
+            bi = builds[0]
+    if bi is not None:
+        build_pc = resident[bi]
+        rest = [v.to_host_table() if isinstance(v, PagedColumns)
+                and i != bi else v for i, v in enumerate(resident)]
+        if (keyed and fold.probe_key is not None
+                and build_pc.num_pages() > 1):
+            return _run_fold_grace(fold, pc, rest, bi, build_pc,
+                                   placement, step_jit)
+        # legacy discipline (no declared keys): outer loop over build
+        # blocks, full probe re-stream per block
         out = None
         with contextlib.closing(
-                resident[bi].stream_tables(prefetch=0)) as btabs:
+                build_pc.stream_tables(prefetch=0)) as btabs:
             for btab in btabs:
                 part_res = list(rest)
                 part_res[bi] = btab
@@ -120,8 +229,8 @@ def _run_fold(node, fold, pc, resident, placement, step_jit):
                                       placement, step_jit)
                 out = part if out is None else fold.merge(out, part)
         return out
-    if builds:  # no merge rule: the build side must be resident
-        resident = tuple(v.to_table() if isinstance(v, PagedColumns)
+    if builds:  # no merge rule: assemble the build side HOST-side
+        resident = tuple(v.to_host_table() if isinstance(v, PagedColumns)
                          else v for v in resident)
     return _run_fold_once(fold, pc, resident, placement, step_jit)
 
@@ -239,13 +348,25 @@ def _execute_streamed(client, plan: LogicalPlan, scan_values: Dict[int, Any],
         return step_jit
 
     values: Dict[int, Any] = dict(scan_values)
-    materialized: Dict[int, Any] = {}  # per-scan to_table memo: N
-    # fold-less consumers of one paged set must not stream it N times
+    materialized: Dict[int, Any] = {}  # per-relation memo: N fold-less
+    # consumers of one paged set must not stream it N times
 
-    def table_of(nid: int, pc: PagedColumns):
-        if nid not in materialized:
-            materialized[nid] = pc.to_table()
-        return materialized[nid]
+    def table_of(pc: PagedColumns):
+        # HOST-side assembly (numpy columns): the fold-less fallback
+        # must not materialize a paged set in device memory — consumers
+        # that compute on it upload transiently as jit arguments
+        if id(pc) not in materialized:
+            materialized[id(pc)] = pc.to_host_table()
+        return materialized[id(pc)]
+
+    def demote(v):
+        """Replace paged handles (possibly inside gather tuples) with
+        host-assembled tables for non-streaming consumers."""
+        if isinstance(v, PagedColumns):
+            return table_of(v)
+        if isinstance(v, tuple):
+            return tuple(demote(x) for x in v)
+        return v
 
     for node in plan.topo:
         if node.node_id in values:
@@ -288,15 +409,15 @@ def _execute_streamed(client, plan: LogicalPlan, scan_values: Dict[int, Any],
             # a co-input that is a paged RELATION materializes (the
             # documented fold-less fallback) — it cannot ride into the
             # jitted tensor step as a raw stream handle
-            in_vals = [table_of(node.inputs[i].node_id, v)
-                       if isinstance(v, PagedColumns) else v
-                       for i, v in enumerate(in_vals)]
+            in_vals = [demote(v) for v in in_vals]
             values[node.node_id] = _run_tensor_stream(
                 node, tfold, in_vals, tsrcs[0], step_jit_for(node))
             continue
-        in_vals = [table_of(node.inputs[i].node_id, v)
-                   if isinstance(v, PagedColumns) else v
-                   for i, v in enumerate(in_vals)]
+        if not getattr(node, "passthrough", False):
+            # gather-chain nodes forward paged handles untouched so a
+            # downstream fold can stream them; real consumers get the
+            # host-assembled fallback (tuples from gathers included)
+            in_vals = [demote(v) for v in in_vals]
         values[node.node_id] = node.evaluate(*in_vals)
     return values
 

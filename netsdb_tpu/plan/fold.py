@@ -54,12 +54,22 @@ class FoldSpec:
     passes: Tuple[Tuple[Callable, Callable], ...]
     finalize: Callable
     # merge(out_a, out_b) -> out: combines the outputs of independent
-    # key-range partitions when the BUILD side of a join is itself
-    # paged (grace-hash: outer loop over build blocks, inner stream
-    # over the probe — ref ``src/queryExecution/headers/
-    # HashSetManager.h`` partitioned hash sets). None = the node does
-    # not support a partitioned build.
+    # key partitions when the BUILD side of a join is itself paged
+    # (grace-hash — ref ``src/queryExecution/headers/HashSetManager.h``
+    # partitioned hash sets). None = the node does not support a
+    # partitioned build.
     merge: Optional[Callable] = None
+    # the equi-join columns for the ONE-PASS grace hash: with both keys
+    # declared (and merge), a paged build side triggers hash-
+    # partitioning of BOTH streams into arena spill partitions in one
+    # pass each, then a partition-pair loop — every probe page is read
+    # once, not once per build block (the reference partitions both
+    # sides the same way, ``PipelineStage.cc:1652-1728``).
+    # probe_key: column in the streamed (fact) chunk; build_key: column
+    # in the paged build relation (also how the executor identifies
+    # WHICH paged resident input is the build).
+    probe_key: Optional[str] = None
+    build_key: Optional[str] = None
 
     def whole(self, table: Any, *resident: Any) -> Any:
         """Whole-table evaluation — the resident-set path. Runs the
@@ -122,9 +132,11 @@ class TensorFold:
 
 
 def single_pass(init: Callable, step: Callable,
-                finalize: Callable, merge: Optional[Callable] = None
-                ) -> FoldSpec:
-    return FoldSpec(((init, step),), finalize, merge)
+                finalize: Callable, merge: Optional[Callable] = None,
+                probe_key: Optional[str] = None,
+                build_key: Optional[str] = None) -> FoldSpec:
+    return FoldSpec(((init, step),), finalize, merge,
+                    probe_key=probe_key, build_key=build_key)
 
 
 def flatten_resident(values: Tuple[Any, ...]) -> Tuple[Any, ...]:

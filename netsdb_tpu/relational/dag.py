@@ -259,7 +259,8 @@ def q03_probe_fold(d: int, k: int, jp_orders):
                                  "revenue": jnp.take(rev, idx)},
                            valid=ok)
 
-    return single_pass(init, step, fin, merge)
+    return single_pass(init, step, fin, merge,
+                       probe_key="l_orderkey", build_key="o_orderkey")
 
 
 def q03_sink_for(client, db: str, segment: str = "BUILDING",
@@ -425,10 +426,13 @@ def suite_sink_for(client, db: str, qname: str,
                      fold=fold)
     else:
         for n in names[1:-1]:
+            # passthrough: a PAGED dim rides the gather chain as its
+            # stream handle so the fold node can grace-hash it (or
+            # host-materialize it itself) — the gather must not force it
             node = Join(node, ScanSet(db, n),
                         fn=lambda a, b: (a + (b,) if isinstance(a, tuple)
                                          else (a, b)),
-                        label=f"gather:{n}")
+                        label=f"gather:{n}", passthrough=True)
         # the fold's stream side must be a DIRECT input of this node:
         # the last scan (fold_src=1) or, for 2-table queries, the first
         direct = (fact == names[-1]

@@ -190,7 +190,18 @@ def fold_q02(cap: Captured, dicts, nrows, *, size: int = 15,
         ints = jnp.stack([has.astype(jnp.int32), st["sup_row"], nat_row])
         return (ints, st["cmin"])
 
-    return single_pass(init, step, fin)
+    def merge(a, b):
+        # grace partitions hold DISJOINT part-key sets (both sides
+        # hashed on partkey), so per-key winners never conflict: where
+        # b found a winner, take b, else a
+        ai, ac = a
+        bi, bc = b
+        bhas = bi[0] > 0
+        return (jnp.where(bhas[None, :], bi, ai),
+                jnp.where(bhas, bc, ac))
+
+    return single_pass(init, step, fin, merge,
+                       probe_key="ps_partkey", build_key="p_partkey")
 
 
 # ---------------------------------------------------------------- Q03
@@ -303,7 +314,11 @@ def fold_q12(cap: Captured, dicts, nrows, *, mode1: str = "MAIL",
             [K.segment_count(l_mode, n_modes, mask & high),
              K.segment_count(l_mode, n_modes, mask & ~high)])
 
-    return single_pass(init, step, lambda st, src, orders: (st,))
+    # paged-orders build: partitions hold disjoint order-key ranges, so
+    # per-mode counts simply add across partition outputs
+    return single_pass(init, step, lambda st, src, orders: (st,),
+                       merge=lambda a, b: (a[0] + b[0],),
+                       probe_key="l_orderkey", build_key="o_orderkey")
 
 
 # ---------------------------------------------------------------- Q13
@@ -332,12 +347,23 @@ def fold_q13(cap: Captured, dicts, nrows, *, word1: str = "special",
 
     def fin(st, src, cust):
         cust = _fm(cust)
-        per_cust = jnp.take(st, cust["c_custkey"])
+        c_key = cust["c_custkey"]
+        real = c_key >= 0  # grace partitions pad with invalid rows
+        # (key -1 after the mask fold); they must not count as
+        # zero-order customers
+        per_cust = jnp.where(real, jnp.take(st, c_key), 0)
         hist = K.bincount_masked(jnp.minimum(per_cust, _Q13_CAP - 1),
-                                 _Q13_CAP)
+                                 _Q13_CAP, real)
         return (hist, jnp.max(per_cust, initial=0))
 
-    return single_pass(init, step, fin)
+    # paged-customer build: every customer lives in exactly ONE key
+    # partition and its orders are routed to the same one, so the
+    # count histograms add (zero-order customers contribute to hist[0]
+    # in their own partition) and the max is the max of maxes
+    return single_pass(init, step, fin,
+                       merge=lambda a, b: (a[0] + b[0],
+                                           jnp.maximum(a[1], b[1])),
+                       probe_key="o_custkey", build_key="c_custkey")
 
 
 # ---------------------------------------------------------------- Q14

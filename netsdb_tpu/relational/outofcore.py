@@ -23,6 +23,7 @@ validity mask like everywhere else in this framework).
 from __future__ import annotations
 
 import contextlib
+import itertools
 from typing import Dict, Iterator, List, Optional, Tuple
 
 import jax
@@ -65,6 +66,11 @@ class PagedColumns:
         self.dropped = False  # set by drop(); appends must not
         # resurrect freed arena names (a fresh put under a dead name
         # would leak unreferenced pages)
+        # chunks yielded over this relation's lifetime — the per-
+        # relation page-load diagnostic the grace-hash tests assert on
+        # (one-pass discipline: probe chunks read ONCE, not once per
+        # build block)
+        self.pages_streamed = 0
         # ingest-time ColumnStats per int column — collected in the one
         # pass that already touches every row, so the planner never has
         # to re-stream the set (the reference's StorageCollectStats
@@ -188,7 +194,7 @@ class PagedColumns:
             self.num_rows += n_new
 
     # ------------------------------------------------------------ stream
-    def stream(self, prefetch: int = 2
+    def stream(self, prefetch: int = 2, device: bool = True
                ) -> Iterator[Tuple[Dict[str, jnp.ndarray], jnp.ndarray, int]]:
         """Yield (cols, valid, start_row) per chunk, every chunk padded
         to ``row_block`` rows — the PageScanner loop feeding the
@@ -196,16 +202,18 @@ class PagedColumns:
         are masked, never reshaped; ``start_row`` is the chunk's global
         row offset (exact even for ragged streams).
 
-        Holds the relation's read lock for the generator's lifetime, so
-        a concurrent append/drop (write lock) cannot free or grow pages
-        mid-stream."""
+        ``device=False`` keeps the chunks as NUMPY columns (the serve
+        wire streams pages to a client — the device must never see
+        them). Holds the relation's read lock for the generator's
+        lifetime, so a concurrent append/drop (write lock) cannot free
+        or grow pages mid-stream."""
         with self.rw.read():
             if self.dropped:
                 raise KeyError(f"paged relation {self.name!r} was "
                                f"dropped; cannot stream")
-            yield from self._stream_unlocked(prefetch)
+            yield from self._stream_unlocked(prefetch, device)
 
-    def _stream_unlocked(self, prefetch: int = 2
+    def _stream_unlocked(self, prefetch: int = 2, device: bool = True
                          ) -> Iterator[Tuple[Dict[str, jnp.ndarray],
                                              jnp.ndarray, int]]:
         streams = []
@@ -250,8 +258,18 @@ class PagedColumns:
             if pad:
                 chunk = {k: np.pad(v, (0, pad)) for k, v in chunk.items()}
             valid = np.arange(self.row_block) < n
-            yield ({k: jnp.asarray(v) for k, v in chunk.items()},
-                   jnp.asarray(valid), start)
+            self.pages_streamed += 1
+            if device:
+                yield ({k: jnp.asarray(v) for k, v in chunk.items()},
+                       jnp.asarray(valid), start)
+            else:
+                yield chunk, valid, start
+
+    def num_pages(self) -> int:
+        """Row-chunk page count (the co-paged int/float streams share
+        one blocking, so either matrix's count is THE count)."""
+        suffix = ".int" if self.int_names else ".float"
+        return self.store.num_blocks(self.name + suffix)
 
     def drop(self) -> None:
         """Free this relation's pages from the shared arena (both the
@@ -296,6 +314,18 @@ class PagedColumns:
             # stream (and its lock) alive until GC
             inner.close()
 
+    def stream_host_tables(self, prefetch: int = 2
+                           ) -> Iterator[ColumnTable]:
+        """Yield each chunk as a COMPACT host-side ColumnTable (numpy
+        columns, padding stripped, no ``_rowid``) — the serve wire's
+        page feed (``FrontendQueryTestServer.cc:785-890`` streams each
+        node's local pages to the client page by page): per-frame bytes
+        bounded by one page, and the device never sees the data."""
+        for cols, valid, _start in self.stream(prefetch, device=False):
+            n = int(np.asarray(valid).sum())
+            yield ColumnTable({k: v[:n] for k, v in cols.items()},
+                              dict(self.dicts), None)
+
     def to_host_table(self) -> ColumnTable:
         """Materialize the relation as one HOST-resident ColumnTable
         (numpy columns, nothing touches the device) — the snapshot path
@@ -334,6 +364,70 @@ class PagedColumns:
         out = ColumnTable({k: jnp.asarray(v) for k, v in host.cols.items()},
                           host.dicts, None)
         return inject_stats(out, self.stats)
+
+
+# ----------------------------------------------- grace-hash partitioning
+_grace_ids = itertools.count()
+
+
+def partition_by_key(pc: PagedColumns, key: str, nparts: int,
+                     keep_rowid: bool = False
+                     ) -> List[Optional[PagedColumns]]:
+    """ONE streaming pass over ``pc``, hash-partitioning its valid rows
+    by ``key % nparts`` into ``nparts`` spill relations in the SAME
+    arena — the reference's partition stage writing both join sides
+    through the partitioned hash-set manager
+    (``src/queryExecution/source/PipelineStage.cc:1652-1728``,
+    ``HashSetManager.h``). Per-partition output buffers flush to arena
+    pages at the relation's row_block (bounded host memory: nparts ×
+    row_block rows), so partitions spill like any other paged data.
+
+    ``keep_rowid=True`` stores the original global ``_rowid`` as a
+    ``_rowid0`` column (the partition stream renumbers ``_rowid``;
+    folds that arbitrate on global row order need the original).
+    Negative keys (orphans/invalid) route to partition 0, where the
+    kernels' orphan-key rule drops them. Returns None for partitions
+    that received no rows."""
+    parts: List[Optional[PagedColumns]] = [None] * nparts
+    bufs: List[Dict[str, List[np.ndarray]]] = [{} for _ in range(nparts)]
+    buf_rows = [0] * nparts
+    uid = next(_grace_ids)
+
+    def flush(p: int) -> None:
+        if buf_rows[p] == 0:
+            return
+        cols = {k: np.concatenate(v) for k, v in bufs[p].items()}
+        if parts[p] is None:
+            parts[p] = PagedColumns.ingest(
+                pc.store, f"{pc.name}#gr{uid}p{p}", cols,
+                row_block=pc.row_block, dicts=dict(pc.dicts))
+        else:
+            parts[p].append(cols)
+        bufs[p] = {}
+        buf_rows[p] = 0
+
+    # pure HOST pass: hashing/routing never touches the device (the
+    # chunks would only round-trip H2D→D2H for numpy bucketing)
+    with contextlib.closing(pc.stream(prefetch=2,
+                                      device=False)) as chunks:
+        for ccols, valid, start in chunks:
+            n = int(np.asarray(valid).sum())
+            cols = {k: v[:n] for k, v in ccols.items()}
+            if keep_rowid:
+                cols["_rowid0"] = np.arange(
+                    start, start + n, dtype=np.int32)
+            kv = cols[key]
+            pid = np.where(kv >= 0, kv % nparts, 0)
+            for p in np.unique(pid):
+                sel = pid == p
+                for name, c in cols.items():
+                    bufs[p].setdefault(name, []).append(c[sel])
+                buf_rows[p] += int(sel.sum())
+                if buf_rows[p] >= pc.row_block:
+                    flush(p)
+    for p in range(nparts):
+        flush(p)
+    return parts
 
 
 # --------------------------------------------------------- fold runner

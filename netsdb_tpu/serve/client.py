@@ -381,6 +381,35 @@ class RemoteClient:
                                    "max_frame_bytes": int(max_frame_bytes)}):
             yield from pickle.loads(frame["batch"])
 
+    def get_table_streamed(self, db: str, set_name: str,
+                           max_frame_bytes: int = 4 << 20):
+        """Assemble a table set from the STREAMED scan: for paged sets
+        the daemon ships one host-side chunk table per frame straight
+        off its arena stream (it never materializes the relation,
+        device- or wire-side); this client holds the growing columns
+        plus ONE chunk. The page-streamed remote read for exactly the
+        sets ``get_table``'s single-frame reply is too big for."""
+        from netsdb_tpu.relational.table import ColumnTable
+
+        parts: dict = {}
+        dicts: dict = {}
+        got = False
+        for item in self.scan_stream(db, set_name, max_frame_bytes):
+            if not isinstance(item, ColumnTable):
+                raise TypeError(
+                    f"set {db}:{set_name} holds "
+                    f"{type(item).__name__} items, not tables")
+            got = True
+            dicts.update(item.dicts)
+            cols = item.compact().cols if item.valid is not None \
+                else item.cols
+            for k, v in cols.items():
+                parts.setdefault(k, []).append(np.asarray(v))
+        if not got:
+            raise ValueError(f"set {db}:{set_name} is empty")
+        return ColumnTable({k: np.concatenate(v)
+                            for k, v in parts.items()}, dicts, None)
+
     @staticmethod
     def _stream_frames(sock: socket.socket, msg_type: MsgType,
                        payload: Any) -> Iterator[Any]:

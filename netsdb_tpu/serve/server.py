@@ -741,14 +741,25 @@ class ServeController:
     def _scan_items(self, db: str, set_name: str):
         """Set scan for the wire: a paged set's PagedColumns handle is
         process-local (it wraps the native arena), so it ships as its
-        materialized table, and mesh-spanning placed items assemble
-        their global value first (``_fetch_global``) — clients wanting
-        summaries only should use ANALYZE_SET instead."""
+        HOST-assembled table (numpy columns — the device never sees a
+        set that was paged because it does not fit; the STREAMED scan
+        ships it page by page instead), and mesh-spanning placed items
+        assemble their global value first (``_fetch_global``) — clients
+        wanting summaries only should use ANALYZE_SET instead."""
         from netsdb_tpu.relational.outofcore import PagedColumns
+        from netsdb_tpu.storage.store import _PagedMatrix
 
         for item in self.library.get_set_iterator(db, set_name):
             if isinstance(item, PagedColumns):
-                yield item.to_table()
+                yield item.to_host_table()
+            elif isinstance(item, _PagedMatrix):
+                # the handle is process-local (it wraps the native
+                # arena + a lock); the matrix itself deliberately never
+                # materializes — consume it with PAGED_MATMUL
+                raise ValueError(
+                    f"set {db}:{set_name} holds a PAGED matrix — it "
+                    f"streams (PAGED_MATMUL) and cannot be scanned "
+                    f"over the wire")
             else:
                 yield self._fetch_global(db, set_name, item)
 
@@ -758,6 +769,29 @@ class ServeController:
         items = list(self._scan_items(p["db"], p["set"]))
         # host objects are arbitrary Python → pickle codec on the reply
         return MsgType.OK, {"items": items}, CODEC_PICKLE
+
+    @staticmethod
+    def _stream_paged(pc):
+        """One host-side compact chunk table per frame, straight off
+        the arena stream — the paged relation never materializes on
+        the device or as one wire blob."""
+        import contextlib
+        import pickle
+
+        def gen():
+            seq = 0
+            with contextlib.closing(
+                    pc.stream_host_tables(prefetch=2)) as chunks:
+                for tbl in chunks:
+                    blob = pickle.dumps([tbl],
+                                        protocol=pickle.HIGHEST_PROTOCOL)
+                    yield MsgType.STREAM_ITEM, {"seq": seq,
+                                                "batch": blob,
+                                                "paged_chunk": True}
+                    seq += 1
+            yield MsgType.STREAM_END, {"frames": seq, "items": seq}
+
+        return gen()
 
     def _on_scan_set_stream(self, p):
         """Streamed scan: items go out in frames of ~``max_frame_bytes``
@@ -771,10 +805,33 @@ class ServeController:
         count adapts to the observed bytes-per-item of the previous
         frame (growth capped at 4×/frame), so a frame overshoots the
         budget only while item sizes are growing and re-converges on
-        the next frame — bounded memory, amortized serialization."""
+        the next frame — bounded memory, amortized serialization.
+
+        A PAGED set streams its pages directly: one host-side compact
+        chunk table per frame straight off the arena stream — the
+        relation never materializes on the device OR as one wire blob
+        (the reference streaming each node's local pages to the client
+        page by page, ``FrontendQueryTestServer.cc:785-890``)."""
         import pickle
 
+        from netsdb_tpu.relational.outofcore import PagedColumns
+
         budget = int(p.get("max_frame_bytes") or (4 << 20))
+        # cheap storage peek — listing a big (possibly spilled)
+        # non-paged set's items here would double-iterate it
+        pc = None
+        store = getattr(self.library, "store", None)
+        if store is not None:
+            from netsdb_tpu.storage.store import SetIdentifier
+
+            ident = SetIdentifier(p["db"], p["set"])
+            if store.storage_of(ident) == "paged":
+                items = store.get_items(ident)
+                if len(items) == 1 and isinstance(items[0],
+                                                  PagedColumns):
+                    pc = items[0]
+        if pc is not None:
+            return self._stream_paged(pc)
 
         def stream():
             seq = 0

@@ -338,42 +338,7 @@ class SetStore:
         existing = [i for i in (s.items or [])
                     if isinstance(i, PagedColumns)]
         if append and existing:
-            pc = existing[0]
-            from netsdb_tpu.relational.autojoin import merge_dicts
-
-            cols = {n: np.asarray(item[n]) for n in item.cols
-                    if n != "_rowid"}
-            if item.valid is not None:
-                keep = np.asarray(item.mask())
-                cols = {n: c[keep] for n, c in cols.items()}
-            # validate EVERYTHING before mutating any stored state — a
-            # rejected batch must leave the set (dictionaries included)
-            # exactly as it was
-            expected = set(pc.int_names) | set(pc.float_names)
-            if set(cols) != expected:
-                raise ValueError(
-                    f"append to {s.ident}: schema mismatch — stored "
-                    f"{sorted(expected)}, batch {sorted(cols)}")
-            missing = [n for n in pc.dicts
-                       if n in cols and n not in item.dicts]
-            if missing:
-                raise ValueError(
-                    f"append to {s.ident}: columns {missing} are "
-                    f"dict-encoded in the stored set but arrive as raw "
-                    f"ints — codes would be meaningless")
-            staged_dicts = {}
-            for name, d_new in item.dicts.items():
-                d_old = pc.dicts.get(name)
-                if d_old is None:
-                    raise ValueError(f"append to {s.ident}: column "
-                                     f"{name!r} is dict-encoded in the "
-                                     f"batch but not in the stored set")
-                merged, remap = merge_dicts(d_old, d_new)
-                staged_dicts[name] = merged
-                cols[name] = remap[cols[name]]
-            pc.append(cols)  # atomic (rolls back its pages on failure)
-            pc.dicts.update(staged_dicts)  # commit only after success
-            s.last_access = time.time()
+            self._append_paged_existing(s, existing[0], item)
             return []
         # fresh/replace table ingest: whatever the set held (table pages
         # or a matrix) is returned for unlocked reclaim — generation-
@@ -399,6 +364,48 @@ class SetStore:
         s.nbytes = 0  # pages are accounted (and capped) by the arena
         s.last_access = time.time()
         return dead
+
+    def _append_paged_existing(self, s: _StoredSet, pc, item) -> None:
+        """Append a batch to a LIVE paged relation (never a fresh
+        ingest — the pc is pinned by the caller, so a concurrent
+        remove cannot silently turn this into an orphaned re-create;
+        ``pc.append`` raises if the relation was dropped). Safe to run
+        outside the store lock under the set's append lock."""
+        from netsdb_tpu.relational.autojoin import merge_dicts
+
+        cols = {n: np.asarray(item[n]) for n in item.cols
+                if n != "_rowid"}
+        if item.valid is not None:
+            keep = np.asarray(item.mask())
+            cols = {n: c[keep] for n, c in cols.items()}
+        # validate EVERYTHING before mutating any stored state — a
+        # rejected batch must leave the set (dictionaries included)
+        # exactly as it was
+        expected = set(pc.int_names) | set(pc.float_names)
+        if set(cols) != expected:
+            raise ValueError(
+                f"append to {s.ident}: schema mismatch — stored "
+                f"{sorted(expected)}, batch {sorted(cols)}")
+        missing = [n for n in pc.dicts
+                   if n in cols and n not in item.dicts]
+        if missing:
+            raise ValueError(
+                f"append to {s.ident}: columns {missing} are "
+                f"dict-encoded in the stored set but arrive as raw "
+                f"ints — codes would be meaningless")
+        staged_dicts = {}
+        for name, d_new in item.dicts.items():
+            d_old = pc.dicts.get(name)
+            if d_old is None:
+                raise ValueError(f"append to {s.ident}: column "
+                                 f"{name!r} is dict-encoded in the "
+                                 f"batch but not in the stored set")
+            merged, remap = merge_dicts(d_old, d_new)
+            staged_dicts[name] = merged
+            cols[name] = remap[cols[name]]
+        pc.append(cols)  # atomic (rolls back its pages on failure)
+        pc.dicts.update(staged_dicts)  # commit only after success
+        s.last_access = time.time()
 
     @_locked
     def update_set(self, ident: SetIdentifier, fn) -> None:
@@ -478,15 +485,32 @@ class SetStore:
                                  f"it is read-only")
             paged = s.storage == "paged"
         if paged:
+            from netsdb_tpu.relational.outofcore import PagedColumns
+
             with s.append_mu:  # concurrent appends: dict remaps must
-                with self._lock:  # not interleave (per-set, not global)
+                # not interleave (per-set, not global)
+                with self._lock:
                     if self._sets.get(ident) is not s:
-                        raise KeyError(f"set {ident} was removed during "
-                                       f"append")
-                # first batch falls through to a fresh ingest inside;
-                # validation + dict staging read pc under append_mu
-                self._drop_detached(
-                    self._ingest_paged(s, [table], append=True))
+                        raise KeyError(f"set {ident} was removed "
+                                       f"during append")
+                    pc = next((i for i in (s.items or [])
+                               if isinstance(i, PagedColumns)), None)
+                    if pc is None:
+                        # FIRST batch = a fresh ingest, done under the
+                        # store lock: no streams can exist on a
+                        # relation that doesn't, so no rw wait — and a
+                        # concurrent replace can no longer interleave
+                        # and orphan one relation's pages
+                        dead = self._ingest_paged(s, [table],
+                                                  append=True)
+                if pc is not None:
+                    # live relation: append outside the store lock
+                    # (waits for in-flight streams via pc.rw; a
+                    # concurrent remove/replace drops pc, making
+                    # pc.append fail loudly instead of resurrecting)
+                    self._append_paged_existing(s, pc, table)
+                    dead = []
+            self._drop_detached(dead)
             return
         self._append_table_memory(ident, table)
 

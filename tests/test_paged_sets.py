@@ -304,19 +304,20 @@ def test_objects_set_empty_batch_and_append(tmp_path):
 def test_foldless_consumer_materialize_fallback(paged_client, tables,
                                                 monkeypatch):
     """A fold-less node over a paged set takes the documented
-    materialize fallback — correct, and memoized per scan (two
-    consumers in one job stream the relation ONCE)."""
+    materialize fallback — HOST-side assembly (round-5: never into
+    device memory), memoized per relation (two consumers in one job
+    stream the relation ONCE)."""
     from netsdb_tpu.plan.computations import Apply, ScanSet, WriteSet
     from netsdb_tpu.relational.outofcore import PagedColumns
 
     calls = {"n": 0}
-    orig = PagedColumns.to_table
+    orig = PagedColumns.to_host_table
 
     def counting(self):
         calls["n"] += 1
         return orig(self)
 
-    monkeypatch.setattr(PagedColumns, "to_table", counting)
+    monkeypatch.setattr(PagedColumns, "to_host_table", counting)
     scan = ScanSet("d", "lineitem")
     s1 = WriteSet(Apply(scan, lambda t: t.select(["l_orderkey"]),
                         traceable=False, label="proj_a"), "d", "out_a")
@@ -565,3 +566,80 @@ def test_paged_matrix_flush_reload_roundtrip(tmp_path):
     assert c2.store.set_stats(SetIdentifier("d", "w"))["storage"] == "paged"
     np.testing.assert_allclose(c2.paged_matmul("d", "w", x), w @ x,
                                rtol=2e-4, atol=2e-4)
+
+
+# ------------------------------- round 5: one-pass grace hash, all-paged
+ALL_PAGED = ("lineitem", "orders", "partsupp", "customer", "part",
+             "supplier")
+
+
+@pytest.mark.parametrize("qname", ["q02", "q12", "q13"])
+def test_suite_queries_with_both_sides_paged(qname, tmp_path, tables,
+                                             resident_client):
+    """q12/q13 with orders AND their build sides paged, q02 with
+    part/supplier paged: the fold's declared join keys trigger the
+    ONE-PASS grace hash (both streams hash-partitioned into arena spill
+    partitions, partition pairs joined) — results match resident."""
+    c = _paged_client(tmp_path, tables, facts=ALL_PAGED)
+    rm = jax.device_get(rdag.run_query(
+        resident_client, rdag.suite_sink_for(resident_client, "d", qname)))
+    rp = jax.device_get(rdag.run_query(
+        c, rdag.suite_sink_for(c, "d", qname)))
+    assert len(rm) == len(rp)
+    for a, b in zip(rm, rp):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=1e-4, atol=1e-3)
+    _assert_spilled(c)
+
+
+def test_grace_hash_is_one_pass_over_the_probe(tmp_path, tables):
+    """The one-pass discipline, asserted on the per-relation stream
+    counter: the probe's OWN pages are read exactly once (the
+    partitioning pass) — not once per build block as the legacy loop
+    did (round-4 weak #2: O(build_blocks x probe_pages))."""
+    c = _paged_client(tmp_path, tables, facts=ALL_PAGED)
+    li = c.store.get_items(SetIdentifier("d", "lineitem"))[0]
+    orders = c.store.get_items(SetIdentifier("d", "orders"))[0]
+    assert orders.num_pages() > 1  # real partitioned build
+    before = li.pages_streamed
+    rdag.run_query(c, rdag.suite_sink_for(c, "d", "q12"))
+    probe_passes = (li.pages_streamed - before) / li.num_pages()
+    # exactly one pass over the probe's own pages (partitioning);
+    # repartitioned rows stream from partition relations, not from li
+    assert probe_passes == 1.0, (
+        f"probe streamed {probe_passes}x its pages; one-pass grace "
+        f"hash must read the probe once, legacy was "
+        f"{orders.num_pages()}x")
+
+
+def test_paged_dim_without_merge_assembles_host_side(tmp_path, tables,
+                                                     resident_client):
+    """A paged build side consumed by a fold WITHOUT grace keys (q04:
+    orders is the resident arg of a member-probe fold) assembles
+    HOST-side — never silently into device memory — and matches."""
+    c = _paged_client(tmp_path, tables, facts=("lineitem", "orders"))
+    rm = jax.device_get(rdag.run_query(
+        resident_client, rdag.suite_sink_for(resident_client, "d", "q04")))
+    rp = jax.device_get(rdag.run_query(
+        c, rdag.suite_sink_for(c, "d", "q04")))
+    for a, b in zip(rm, rp):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=1e-4, atol=1e-3)
+
+
+def test_q02_with_only_supplier_paged_takes_host_fallback(
+        tmp_path, tables, resident_client):
+    """A paged build side that is NOT the fold's declared key side
+    (supplier vs build_key=p_partkey) must NOT be key-partitioned —
+    q02's merge is only correct for partitions of the part side. It
+    assembles host-side instead, and results match (r5 review
+    finding)."""
+    c = _paged_client(tmp_path, tables,
+                      facts=("partsupp", "supplier"))
+    rm = jax.device_get(rdag.run_query(
+        resident_client, rdag.suite_sink_for(resident_client, "d", "q02")))
+    rp = jax.device_get(rdag.run_query(
+        c, rdag.suite_sink_for(c, "d", "q02")))
+    for a, b in zip(rm, rp):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=1e-4, atol=1e-3)
