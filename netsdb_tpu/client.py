@@ -193,15 +193,19 @@ class Client:
             get_history_db().record(
                 f"{db}.{set_name}:placement", plan_key=f"set:{db}.{set_name}",
                 elapsed_s=0.0, config_label=placement.label())
-        if arm is not None and type_name == "tensor":
+        if arm is not None and type_name == "tensor" \
+                and "block" in arm.specs:
             # live Lachesis decision: the chosen placement (block shape
             # = the reference's page-size knob) lands in the catalog and
             # the history DB, and send_matrix defaults to it. Decision
             # rows live under "<key>:decisions" so they audit the live
             # choices without polluting the reward means.
+            # Stashed ONLY when the arm actually decided something for
+            # THIS set: a model's later sets consulting the advisor
+            # must not overwrite the arm a placement decision applied
+            # (job timings would then record against the wrong arm)
             meta["placement"] = arm.label
-            if "block" in arm.specs:
-                meta["block_shape"] = list(arm.specs["block"])
+            meta["block_shape"] = list(arm.specs["block"])
             self._advisor_arm = arm  # the placement actually in force
             self._advisor.db.record(f"{self._advisor_key}:decisions",
                                     plan_key=f"set:{db}.{set_name}",

@@ -165,6 +165,128 @@ def distribution_candidates():
     )
 
 
+def batch_candidates():
+    """Replicated vs batch-sharded ACTIVATIONS for FF inference — the
+    data-parallelism decision as advisor arms, keyed by set name so
+    only the ``inputs`` set takes the arm's placement. This pair is
+    DISCRIMINATING by construction: a replicated batch makes every
+    mesh device compute the full inference (N× the FLOPs under SPMD —
+    on the shared-core virtual CPU mesh that is N× the wall clock, on
+    real chips N× the energy/HBM for no throughput), while the sharded
+    arm splits the batch. The gap is workload-sized, far outside the
+    measurement-noise band, so convergence is asserted STRICTLY."""
+    from netsdb_tpu.parallel.placement import Placement
+
+    return (
+        PlacementCandidate("x_replicated", (1,),
+                           {"inputs": Placement((("data", 0),),
+                                                (None, None))}),
+        PlacementCandidate("x_sharded", (1,),
+                           {"inputs": Placement((("data", 0),),
+                                                ("data", None))}),
+    )
+
+
+def bench_batch_distribution_ab(width: int = 768, batch: int = 4096,
+                                labels: int = 16, rounds: int = 4,
+                                reps: int = 3,
+                                history_path: str = ":memory:",
+                                seed: int = 0,
+                                advisor_kind: str = "drl"
+                                ) -> Dict[str, object]:
+    """Live A/B where the advisor decides whether the FF inference
+    batch is replicated or data-sharded over the mesh — the
+    DISCRIMINATING distribution decision (see
+    :func:`batch_candidates`): the loser does mesh-size× the compute,
+    so the greedy choice must match the measured winner exactly
+    (``converged_strict``; the 25%-band fallback of ``_converged`` is
+    reserved for genuinely indistinguishable arms, documented there).
+
+    Weights are replicated explicitly; only ``inputs`` consults the
+    advisor. Each measured round runs ``reps`` inferences (amortizing
+    per-job dispatch overhead) under a warm compile cache."""
+    import os
+
+    import jax
+
+    from netsdb_tpu.parallel.placement import Placement
+
+    hdb = HistoryDB(history_path)
+    cands = list(batch_candidates())
+    if advisor_kind == "drl":
+        from netsdb_tpu.learning.rl import DRLPlacementAdvisor
+
+        advisor = DRLPlacementAdvisor(cands, hdb, seed=seed)
+    else:
+        advisor = PlacementAdvisor(cands, hdb)
+    job = "ab-batch-dist"
+    rng = np.random.default_rng(seed)
+    w1 = rng.standard_normal((width, width)).astype(np.float32) * 0.02
+    b1 = rng.standard_normal((width,)).astype(np.float32) * 0.01
+    wo = rng.standard_normal((labels, width)).astype(np.float32) * 0.02
+    bo = rng.standard_normal((labels,)).astype(np.float32) * 0.01
+    x = rng.standard_normal((batch, width)).astype(np.float32)
+    uid = os.getuid() if hasattr(os, "getuid") else "u"
+    cache_dir = os.path.join(tempfile.gettempdir(),
+                             f"netsdb_ab_cache_{uid}")
+    wpl = {n: Placement((("data", 0),), (None, None))
+           for n in ("w1", "b1", "wo", "bo")}
+
+    def one_round(placement_override=None):
+        root = tempfile.mkdtemp(prefix="ab_batch_")
+        try:
+            client = Client(Configuration(
+                root_dir=root, compilation_cache_dir=cache_dir))
+            if placement_override is None:
+                client.set_placement_advisor(advisor, key=job)
+            model = FFModel(db="ab", block=(256, 256))
+            placements = dict(wpl)
+            if placement_override is not None:
+                placements["inputs"] = placement_override
+            model.setup(client, placements=placements)
+            arm = getattr(client, "_advisor_arm", None)
+            model.load_weights(client, w1, b1, wo, bo)
+            model.load_inputs(client, x)
+            out = model.inference(client)  # warm this arm's program
+            jax.block_until_ready(out.data)
+            t0 = time.perf_counter()
+            for _ in range(reps):
+                out = model.inference(client)
+            jax.block_until_ready(out.data)
+            return arm, (time.perf_counter() - t0) / reps
+        finally:
+            shutil.rmtree(root, ignore_errors=True)
+
+    for cand in cands:  # warm both compiled programs, unrecorded
+        one_round(placement_override=cand.specs["inputs"])
+    chosen = []
+    r = 0
+    while r < rounds or (r < 2 * rounds and any(
+            hdb.mean_elapsed(job, c.label) is None for c in cands)):
+        # extra rounds until every arm has a measurement: a stochastic
+        # policy that happened to sample one arm only would make the
+        # convergence check vacuous — the exact r4 complaint
+        arm, elapsed = one_round()
+        assert arm is not None, "advisor arm was not applied"
+        advisor.record(job, arm, elapsed)
+        chosen.append((arm.label, round(elapsed, 4)))
+        r += 1
+
+    means = {c.label: hdb.mean_elapsed(job, c.label)
+             for c in advisor.candidates}
+    winner = (advisor.choose(job, explore=False).label
+              if advisor_kind == "drl" else advisor.choose(job).label)
+    vals = {k: v for k, v in means.items() if v is not None}
+    by_mean = min(vals, key=vals.get) if vals else None
+    worst = max(vals.values()) if vals else None
+    best = min(vals.values()) if vals else None
+    return {"advisor": advisor_kind, "rounds": chosen, "mean_s": means,
+            "winner": winner, "by_mean": by_mean,
+            "gap": round(worst / best, 2) if best else None,
+            "converged_strict": winner == by_mean,
+            "decisions_recorded": len(hdb.runs(f"{job}:decisions"))}
+
+
 def bench_distribution_ab(scale: int = 16, rounds: int = 4,
                           history_path: str = ":memory:",
                           seed: int = 0,

@@ -34,6 +34,7 @@ from netsdb_tpu.plan.computations import (
     WriteSet,
 )
 from netsdb_tpu.plan.planner import LogicalPlan, plan_from_sinks
+from netsdb_tpu.storage.paged import PagedObjects
 from netsdb_tpu.storage.store import SetIdentifier, _PagedMatrix
 
 # job_name+canonical-plan → compiled callable (the PreCompiledWorkload
@@ -308,11 +309,11 @@ def _execute_streamed(client, plan: LogicalPlan, scan_values: Dict[int, Any],
     paged set materialize it (correct, not streamed — the documented
     fallback, like the reference pinning a set that fits RAM).
 
-    A job mixing paged and resident-only sinks runs ENTIRELY on this
-    path: the resident sinks stay correct but lose the whole-plan jit
-    of the pure-resident route (fold steps are still compiled and
-    cached). Submit resident-only sinks as their own jobs when that
-    matters."""
+    A job mixing paged-reachable and resident-only SINKS never reaches
+    here whole: ``execute_computations`` auto-splits it and routes the
+    resident-only component through the fused whole-plan jit (round
+    5). This path sees only components that genuinely touch paged
+    sets; their non-fold resident consumers stay correct but unfused."""
     from netsdb_tpu.plan.fold import flatten_resident
     from netsdb_tpu.relational.outofcore import PagedColumns
     from netsdb_tpu.storage.paged import PagedTensor
@@ -436,6 +437,41 @@ def execute_computations(
     from netsdb_tpu.relational.outofcore import PagedColumns
     from netsdb_tpu.relational.table import ColumnTable
 
+    if len(plan.sinks) > 1:
+        # AUTO-SPLIT (round 5), decided from the CHEAP storage peek
+        # BEFORE any scan set is fetched: sinks whose transitive inputs
+        # touch no paged set must not lose the fused whole-plan jit
+        # because an unrelated sink in the same job went paged (the
+        # reference plans stages per source, not per job —
+        # ``TCAPAnalyzer.h:20-40``). Recursion re-plans each component;
+        # the compiled cache keys on the component's own canonical plan.
+        paged_scan_ids = {
+            n.node_id for n in plan.topo if isinstance(n, ScanSet)
+            and client.store.storage_of(
+                SetIdentifier(n.db, n.set_name)) == "paged"}
+
+        def touches_paged(sink) -> bool:
+            stack, seen = [sink], set()
+            while stack:
+                n = stack.pop()
+                if n.node_id in seen:
+                    continue
+                seen.add(n.node_id)
+                if n.node_id in paged_scan_ids:
+                    return True
+                stack.extend(n.inputs)
+            return False
+
+        if paged_scan_ids:
+            resident_sinks = [s for s in sinks if not touches_paged(s)]
+            if resident_sinks and len(resident_sinks) < len(sinks):
+                paged_sinks = [s for s in sinks if touches_paged(s)]
+                out = execute_computations(client, resident_sinks,
+                                           job_name, materialize)
+                out.update(execute_computations(client, paged_sinks,
+                                                job_name, materialize))
+                return out
+
     scan_values: Dict[int, Any] = {}
     tensor_scans: List[ScanSet] = []
     for node in plan.topo:
@@ -466,12 +502,18 @@ def execute_computations(
                 # stream it, everything else errors (never materialize)
                 scan_values[node.node_id] = client.store.paged_tensor(
                     ident)
+            elif len(items) == 1 and isinstance(items[0], PagedObjects):
+                # paged OBJECT set: the handle IS an iterable of
+                # records, so the eager Filter/Join/Aggregate
+                # interpreter consumes it page-streamed unchanged
+                scan_values[node.node_id] = items[0]
             else:
                 scan_values[node.node_id] = items
 
     from netsdb_tpu.storage.paged import PagedTensor
 
-    any_paged = any(isinstance(v, (PagedColumns, PagedTensor))
+    any_paged = any(isinstance(v, (PagedColumns, PagedTensor,
+                                   PagedObjects))
                     for v in scan_values.values())
     all_traceable = all(_is_traceable(n) for n in plan.topo)
 

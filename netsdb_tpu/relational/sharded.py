@@ -19,21 +19,18 @@ movements (SURVEY §2.6):
   columns replicated in the shard_map (``P(None)``); each shard probes
   its rows against the full build LUT locally.
 
-Any query whose result is a fixed-shape aggregate distributes this way;
-``sharded_query`` wraps a local kernel accordingly, and the concrete
-``sharded_q01`` / ``sharded_q06`` / ``sharded_q04`` bodies below REUSE
-the single-chip query cores' logic so the distributed answers are
-bit-comparable to the local engine (tests cross-check both on the
-virtual 8-device CPU mesh).
-
-LAYERING (round 4): this module is the shard_map KERNEL layer. The
+LAYERING (round 5): this module is the shard_map KERNEL layer
+(``sharded_query`` and friends, consumed by ``relational.shuffle``)
+plus thin mesh wrappers ``sharded_qXX`` over the ONE set of query
+decompositions in :mod:`netsdb_tpu.relational.folds` — the same
+FoldSpecs the paged/streamed engine runs, here in whole-table form
+under jit with sharded inputs (XLA inserts the collectives). The
 user-facing distribution surface is the SET API — create the sets with
 a Placement and run ``relational.dag.suite_sink_for`` (aggregate form)
-or ``relational.shuffle.q03_row_sink_for`` (row-output form); those
-DAGs reach the same physics with the mesh taken from the stored
-columns' shardings. Call these functions directly only when you hold
-raw arrays and a mesh (benchmarks, library composition) — application
-code should not hand-shard.
+or ``relational.shuffle.q03_row_sink_for`` (row-output form). Call
+these functions directly only when you hold raw arrays and a mesh
+(benchmarks, library composition) — application code should not
+hand-shard.
 
 Row padding: a sharded axis must divide the device count, so fact
 columns are padded and a validity mask rides along (the mask approach
@@ -42,21 +39,14 @@ every tensor op in this framework uses).
 
 from __future__ import annotations
 
-import functools
 from typing import Callable, Dict, Optional, Sequence, Tuple
 
 import jax
 import jax.numpy as jnp
-import numpy as np
 from jax.sharding import Mesh, PartitionSpec as P
 
 from netsdb_tpu.relational import kernels as K
-import re
-
-from netsdb_tpu.relational import planner as PLN
-from netsdb_tpu.relational.queries import Tables, _lut, q22_code_lut
-from netsdb_tpu.relational.stats import key_space
-from netsdb_tpu.relational.table import date_to_int
+from netsdb_tpu.relational.queries import Tables
 
 
 def shard_fact_columns(cols: Dict[str, jnp.ndarray], n_shards: int,
@@ -141,372 +131,111 @@ def probe_marks(marks: jnp.ndarray, keys: jnp.ndarray,
     return in_space & (jnp.take(marks, jnp.clip(keys, 0, n_keys - 1)) > 0)
 
 
-# ------------------------------------------------------------------ Q01
-_Q01_COLS = ("l_shipdate", "l_returnflag", "l_linestatus", "l_quantity",
-             "l_extendedprice", "l_discount", "l_tax")
+# ---------------------------------------------------- the query cores
+# ONE code path per query core (round 5): every sharded_qXX is a thin
+# wrapper over the SAME FoldSpec the set-API DAG streams for paged sets
+# (``relational.folds``) — the whole-table form of the fold runs under
+# jit with the fact columns mesh-sharded and the dimensions replicated,
+# and XLA inserts the psum the retired hand-written shard_map bodies
+# (round 1-4) expressed explicitly. The kernel layer above
+# (``sharded_query`` etc.) remains for library composition
+# (``relational.shuffle``); query logic lives in the folds only.
+
+_FOLD_JIT: Dict[tuple, Callable] = {}
 
 
-def _q01_local(valid, li, n_groups: int, n_ls: int, delta: int):
-    mask = valid & (li["l_shipdate"] <= delta)
-    seg = li["l_returnflag"] * n_ls + li["l_linestatus"]
-    qty = li["l_quantity"].astype(jnp.float32)
-    disc_price = li["l_extendedprice"] * (1.0 - li["l_discount"])
-    charge = disc_price * (1.0 + li["l_tax"])
-    rows = [K.segment_sum(v, seg, n_groups, mask)
-            for v in (qty, li["l_extendedprice"], disc_price, charge,
-                      li["l_discount"])]
-    # counts stay int32 through the psum — f32 partials would absorb
-    # +1 increments past 2^24 rows/group (same guard as the single-chip
-    # core, queries.py _q01_core)
-    return jnp.stack(rows), K.segment_count(seg, n_groups, mask)
+def fold_sharded(qname: str, tables: Tables, mesh: Mesh,
+                 axis: str = "data", **params):
+    """Run one suite query's fold distributed over ``(mesh, axis)``:
+    fact rows sharded, dimensions replicated (broadcast join), output
+    the fold's finalize tuple — matching the resident engine's suite
+    outputs elementwise (the equivalence the paged tests pin)."""
+    from jax.sharding import NamedSharding
+
+    from netsdb_tpu.relational.dag import _QUERY_TABLES
+    from netsdb_tpu.relational.folds import SUITE_FOLDS
+    from netsdb_tpu.relational.stats import analyze_table
+    from netsdb_tpu.relational.table import ColumnTable
+
+    names = _QUERY_TABLES[qname]
+    fact, builder = SUITE_FOLDS[qname]
+    cap = {n: analyze_table(tables[n]) for n in names}
+    dicts = {n: tables[n].dicts for n in names}
+    nrows = {n: tables[n].num_rows for n in names}
+    fold = builder(cap, dicts, nrows, **params)
+
+    div = mesh.shape[axis]
+    placed = {}
+    for n in names:
+        t = tables[n]
+        if n == fact:
+            pad = (-t.num_rows) % div
+            sh = NamedSharding(mesh, P(axis))
+            cols = {}
+            for k, c in t.cols.items():
+                c = jnp.asarray(c)
+                if pad:
+                    c = jnp.concatenate(
+                        [c, jnp.zeros((pad,) + c.shape[1:], c.dtype)])
+                cols[k] = jax.device_put(c, sh)
+            nr = t.num_rows + pad
+            # global row ids: folds arbitrate ties on them (q02)
+            cols.setdefault("_rowid", jax.device_put(
+                jnp.arange(nr, dtype=jnp.int32), sh))
+            valid = t.mask()
+            if pad:
+                valid = jnp.concatenate(
+                    [valid, jnp.zeros((pad,), jnp.bool_)])
+            placed[n] = ColumnTable(cols, t.dicts,
+                                    jax.device_put(valid, sh))
+        else:
+            sh = NamedSharding(mesh, P())
+            cols = {k: jax.device_put(jnp.asarray(c), sh)
+                    for k, c in t.cols.items()}
+            valid = (jax.device_put(t.mask(), sh)
+                     if t.valid is not None else None)
+            placed[n] = ColumnTable(cols, t.dicts, valid)
+
+    fact_t = placed[fact]
+    resident = tuple(placed[n] for n in names if n != fact)
+    # one jitted runner per equivalent fold build (same query, params,
+    # row counts and key spaces ⇒ deterministic identical closures):
+    # jitting per call would recompile every time (env gotcha)
+    key = (qname, repr(sorted(params.items())),
+           tuple(sorted(nrows.items())),
+           tuple(sorted((n, c, s.key_space)
+                        for n, cs in cap.items()
+                        for c, s in cs.items())))
+    fn = _FOLD_JIT.get(key)
+    if fn is None:
+        fn = jax.jit(
+            lambda ft, res, _fold=fold: _fold.whole(ft, *res))
+        if len(_FOLD_JIT) > 64:
+            _FOLD_JIT.clear()  # unbounded-growth guard
+        _FOLD_JIT[key] = fn
+    return fn(fact_t, resident)
 
 
-def sharded_q01(tables: Tables, mesh: Mesh, axis: str = "data",
-                delta_date: str = "1998-09-02"):
-    """Distributed pricing-summary → (sums (5, groups) f32,
-    counts (groups,) i32), identical to the single-chip core's."""
-    li = tables["lineitem"]
-    n_ls = len(li.dicts["l_linestatus"])
-    n_groups = len(li.dicts["l_returnflag"]) * n_ls
-    kern = functools.partial(_q01_local, n_groups=n_groups, n_ls=n_ls,
-                             delta=date_to_int(delta_date))
-    return sharded_query(kern, mesh, axis,
-                         {k: li.cols[k] for k in _Q01_COLS})
+def _wrap(qname: str):
+    def runner(tables: Tables, mesh: Mesh, axis: str = "data",
+               **params):
+        return fold_sharded(qname, tables, mesh, axis, **params)
+
+    runner.__name__ = f"sharded_{qname}"
+    runner.__doc__ = (
+        f"Thin wrapper: {qname} distributed over a mesh via "
+        f"``fold_sharded`` — same fold as the paged/streamed path "
+        f"(``relational.folds.fold_{qname}``), whole-table under jit.")
+    return runner
 
 
-# ------------------------------------------------------------------ Q06
-def _q06_local(valid, li, a, b, disc, qty):
-    c = li
-    mask = (valid & (c["l_shipdate"] >= a) & (c["l_shipdate"] < b)
-            & (c["l_discount"] >= disc - 0.011)
-            & (c["l_discount"] <= disc + 0.011)
-            & (c["l_quantity"] < qty))
-    return jnp.sum(jnp.where(mask, c["l_extendedprice"] * c["l_discount"],
-                             0.0))
-
-
-def sharded_q06(tables: Tables, mesh: Mesh, axis: str = "data",
-                d0: str = "1994-01-01", d1: str = "1995-01-01",
-                discount: float = 0.06, quantity: int = 24) -> jax.Array:
-    li = tables["lineitem"]
-    cols = {k: li.cols[k] for k in ("l_shipdate", "l_discount",
-                                    "l_quantity", "l_extendedprice")}
-
-    def local(valid, c):
-        return _q06_local(valid, c, date_to_int(d0), date_to_int(d1),
-                          discount, quantity)
-
-    return sharded_query(local, mesh, axis, cols)
-
-
-# ------------------------------------------------------------------ Q04
-def sharded_q04(tables: Tables, mesh: Mesh, axis: str = "data",
-                d0: str = "1993-07-01",
-                d1: str = "1993-10-01") -> jax.Array:
-    """Distributed EXISTS semi-join + count in two collective phases —
-    the reference's plan shape exactly:
-
-    1. lineitem row-sharded: each shard marks the order keys for which
-       it holds a late item; ``psum`` merges the marks (combiner →
-       shuffle → aggregator).
-    2. orders row-sharded, the merged mark table REPLICATED — the
-       broadcast-join build side (``BroadcastJoinBuildHTJobStage``) —
-       and probed per shard; the per-priority counts psum again.
-    """
-    orders, li = tables["orders"], tables["lineitem"]
-    n_pri = len(orders.dicts["o_orderpriority"])
-    n_okey = key_space(li, "l_orderkey")
-    a, b = date_to_int(d0), date_to_int(d1)
-
-    marks = sharded_key_marks(
-        mesh, axis, li["l_orderkey"], n_okey,
-        extra_cols={"l_commitdate": li["l_commitdate"],
-                    "l_receiptdate": li["l_receiptdate"]},
-        mask_fn=lambda valid, c: c["l_commitdate"] < c["l_receiptdate"])
-
-    def count_local(valid, o, marks_rep):
-        has_late = valid & probe_marks(marks_rep, o["o_orderkey"], n_okey)
-        in_q = (o["o_orderdate"] >= a) & (o["o_orderdate"] < b)
-        return K.segment_count(o["o_orderpriority"], n_pri,
-                               has_late & in_q)
-
-    return sharded_query(
-        count_local, mesh, axis,
-        {k: orders.cols[k] for k in
-         ("o_orderkey", "o_orderdate", "o_orderpriority")},
-        replicated=(marks,))
-
-
-# ------------------------------------------------------------------ Q12
-def sharded_q12(tables: Tables, mesh: Mesh, axis: str = "data",
-                mode1: str = "MAIL", mode2: str = "SHIP",
-                d0: str = "1994-01-01", d1: str = "1995-01-01") -> jax.Array:
-    """Late-shipmode counts: lineitem sharded, orders replicated (the
-    broadcast-join side feeding the priority lookup)."""
-    li, orders = tables["lineitem"], tables["orders"]
-    n_modes = len(li.dicts["l_shipmode"])
-    jp_orders = PLN.plan_join(orders, "o_orderkey", li, "l_orderkey")
-    m1, m2 = li.code("l_shipmode", mode1), li.code("l_shipmode", mode2)
-    hi = _lut(orders.dicts["o_orderpriority"],
-              lambda s: s in ("1-URGENT", "2-HIGH"))
-    a, b = date_to_int(d0), date_to_int(d1)
-
-    def local(valid, c, o_key, o_pri, hi_lut):
-        mask = (valid & ((c["l_shipmode"] == m1) | (c["l_shipmode"] == m2))
-                & (c["l_commitdate"] < c["l_receiptdate"])
-                & (c["l_shipdate"] < c["l_commitdate"])
-                & (c["l_receiptdate"] >= a) & (c["l_receiptdate"] < b))
-        oidx, ohit = K.pk_fk_join(o_key, c["l_orderkey"], plan=jp_orders)
-        mask = mask & ohit
-        high = jnp.take(hi_lut, jnp.take(o_pri, oidx))
-        return jnp.stack([
-            K.segment_count(c["l_shipmode"], n_modes, mask & high),
-            K.segment_count(c["l_shipmode"], n_modes, mask & ~high)])
-
-    return sharded_query(
-        local, mesh, axis,
-        {k: li.cols[k] for k in ("l_orderkey", "l_shipmode", "l_shipdate",
-                                 "l_commitdate", "l_receiptdate")},
-        replicated=(orders["o_orderkey"], orders["o_orderpriority"], hi))
-
-
-# ------------------------------------------------------------------ Q13
-def sharded_q13(tables: Tables, mesh: Mesh, axis: str = "data",
-                word1: str = "special",
-                word2: str = "requests") -> jax.Array:
-    """Per-customer order counts (n_cust,) int32, psum-merged; the
-    histogram finishes on the merged vector exactly as the single-chip
-    query does."""
-    cust, orders = tables["customer"], tables["orders"]
-    n_cust = key_space(cust, "c_custkey")
-    if "o_comment" in orders.dicts:
-        pat = re.compile(f"{re.escape(word1)}.*{re.escape(word2)}")
-        keep_lut = _lut(orders.dicts["o_comment"],
-                        lambda s: not pat.search(s))
-        keep = jnp.take(keep_lut, orders["o_comment"])
-    else:
-        keep = jnp.ones((orders["o_custkey"].shape[0],), jnp.bool_)
-
-    def local(valid, c):
-        return K.segment_count(c["o_custkey"], n_cust, valid & c["keep"])
-
-    counts = sharded_query(local, mesh, axis,
-                           {"o_custkey": orders["o_custkey"],
-                            "keep": keep})
-    return jnp.take(counts, cust["c_custkey"])  # per-customer, zeros kept
-
-
-# ------------------------------------------------------------------ Q14
-def sharded_q14(tables: Tables, mesh: Mesh, axis: str = "data",
-                d0: str = "1995-09-01",
-                d1: str = "1995-10-01") -> jax.Array:
-    """(promo_revenue, total_revenue): lineitem sharded, part replicated."""
-    li, part = tables["lineitem"], tables["part"]
-    jp_part = PLN.plan_join(part, "p_partkey", li, "l_partkey")
-    promo = _lut(part.dicts["p_type"], lambda s: s.startswith("PROMO"))
-    a, b = date_to_int(d0), date_to_int(d1)
-
-    def local(valid, c, p_key, p_type, promo_lut):
-        mask = valid & (c["l_shipdate"] >= a) & (c["l_shipdate"] < b)
-        pidx, phit = K.pk_fk_join(p_key, c["l_partkey"], plan=jp_part)
-        mask = mask & phit
-        rev = jnp.where(mask, c["l_extendedprice"] * (1.0 - c["l_discount"]),
-                        0.0)
-        is_promo = jnp.take(promo_lut, jnp.take(p_type, pidx))
-        return jnp.stack([jnp.sum(jnp.where(is_promo, rev, 0.0)),
-                          jnp.sum(rev)])
-
-    return sharded_query(
-        local, mesh, axis,
-        {k: li.cols[k] for k in ("l_partkey", "l_shipdate",
-                                 "l_extendedprice", "l_discount")},
-        replicated=(part["p_partkey"], part["p_type"], promo))
-
-
-# ------------------------------------------------------------------ Q17
-def sharded_q17(tables: Tables, mesh: Mesh, axis: str = "data",
-                brand: str = "Brand#23",
-                container: str = "MED BOX") -> jax.Array:
-    """Small-quantity revenue, two phases: (1) per-part qty sums+counts
-    psum (the global avg needs every shard's rows), (2) the avg table
-    replicated back and the below-avg revenue summed per shard."""
-    li, part = tables["lineitem"], tables["part"]
-    jp_part = PLN.plan_join(part, "p_partkey", li, "l_partkey")
-    n_part = jp_part.key_space
-    brand_code = part.code("p_brand", brand)
-    cont_code = part.code("p_container", container)
-    li_cols = {k: li.cols[k] for k in ("l_partkey", "l_quantity",
-                                       "l_extendedprice")}
-
-    def phase1(valid, c, p_key, p_brand, p_cont):
-        part_ok = (p_brand == brand_code) & (p_cont == cont_code)
-        _, phit = K.pk_fk_join(p_key, c["l_partkey"], part_ok,
-                               plan=jp_part)
-        phit = phit & valid
-        qty = c["l_quantity"].astype(jnp.float32)
-        return (K.segment_sum(qty, c["l_partkey"], n_part, phit),
-                K.segment_count(c["l_partkey"], n_part, phit))
-
-    sums, cnts = sharded_query(
-        phase1, mesh, axis, li_cols,
-        replicated=(part["p_partkey"], part["p_brand"],
-                    part["p_container"]))
-    avg = sums / jnp.maximum(cnts, 1).astype(jnp.float32)
-
-    def phase2(valid, c, p_key, p_brand, p_cont, avg_rep):
-        part_ok = (p_brand == brand_code) & (p_cont == cont_code)
-        _, phit = K.pk_fk_join(p_key, c["l_partkey"], part_ok,
-                               plan=jp_part)
-        phit = phit & valid
-        qty = c["l_quantity"].astype(jnp.float32)
-        small = phit & (qty < 0.2 * jnp.take(avg_rep, c["l_partkey"]))
-        return jnp.sum(jnp.where(small, c["l_extendedprice"], 0.0))
-
-    total = sharded_query(
-        phase2, mesh, axis, li_cols,
-        replicated=(part["p_partkey"], part["p_brand"],
-                    part["p_container"], avg))
-    return total / 7.0
-
-
-# ------------------------------------------------------------------ Q22
-def sharded_q22(tables: Tables, mesh: Mesh, axis: str = "data",
-                prefixes: Tuple[str, ...] = ("13", "31", "23", "29", "30",
-                                             "18", "17")) -> jax.Array:
-    """Anti-join in three collective phases: order marks psum; global
-    positive-balance average psum; per-prefix counts/sums psum with the
-    marks replicated (broadcast anti-join probe)."""
-    cust, orders = tables["customer"], tables["orders"]
-    pref_list, code_lut = q22_code_lut(cust.dicts["c_phone"], prefixes)
-    n_pref = len(pref_list)
-    n_ckey = key_space(orders, "o_custkey")
-
-    marks = sharded_key_marks(mesh, axis, orders["o_custkey"], n_ckey)
-
-    cust_cols = {k: cust.cols[k] for k in ("c_custkey", "c_phone",
-                                           "c_acctbal")}
-
-    def avg_local(valid, c, lut):
-        pref = jnp.take(lut, c["c_phone"])
-        pos = valid & (pref >= 0) & (c["c_acctbal"] > 0)
-        return (jnp.sum(jnp.where(pos, c["c_acctbal"], 0.0)),
-                jnp.sum(pos.astype(jnp.int32)))
-
-    bal_sum, bal_cnt = sharded_query(avg_local, mesh, axis, cust_cols,
-                                     replicated=(code_lut,))
-    avg = bal_sum / jnp.maximum(bal_cnt, 1).astype(jnp.float32)
-
-    def count_local(valid, c, lut, marks_rep, avg_rep):
-        pref = jnp.take(lut, c["c_phone"])
-        has_orders = probe_marks(marks_rep, c["c_custkey"], n_ckey)
-        sel = (valid & (pref >= 0) & (c["c_acctbal"] > avg_rep)
-               & ~has_orders)
-        seg = jnp.clip(pref, 0, n_pref - 1)
-        return jnp.stack([
-            K.segment_count(seg, n_pref, sel).astype(jnp.float32),
-            K.segment_sum(c["c_acctbal"], seg, n_pref, sel)])
-
-    return sharded_query(count_local, mesh, axis, cust_cols,
-                         replicated=(code_lut, marks, avg))
-
-
-# ------------------------------------------------------------------ Q03
-def sharded_q03(tables: Tables, mesh: Mesh, axis: str = "data",
-                segment: str = "BUILDING", date: str = "1995-03-15",
-                k: int = 10):
-    """Top unshipped orders: lineitem sharded, customer/orders
-    replicated; per-order revenue psum-merged, top-k on the merged
-    vector (small) outside the map."""
-    cust, orders, li = tables["customer"], tables["orders"], tables["lineitem"]
-    jp_orders = PLN.plan_join(orders, "o_orderkey", li, "l_orderkey")
-    jp_cust = PLN.plan_join(cust, "c_custkey", orders, "o_custkey")
-    n_orders = jp_orders.key_space
-    seg_code = cust.code("c_mktsegment", segment)
-    d = date_to_int(date)
-
-    def local(valid, c, c_key, c_seg, o_key, o_cust, o_date):
-        cust_ok = c_seg == seg_code
-        _, chit = K.pk_fk_join(c_key, o_cust, cust_ok, plan=jp_cust)
-        order_ok = chit & (o_date < d)
-        oidx, ohit = K.pk_fk_join(o_key, c["l_orderkey"], order_ok,
-                                  plan=jp_orders)
-        li_ok = valid & ohit & (c["l_shipdate"] > d)
-        rev = c["l_extendedprice"] * (1.0 - c["l_discount"])
-        return K.segment_sum(rev, c["l_orderkey"], n_orders, li_ok)
-
-    rev = sharded_query(
-        local, mesh, axis,
-        {q: li.cols[q] for q in ("l_orderkey", "l_shipdate",
-                                 "l_extendedprice", "l_discount")},
-        replicated=(cust["c_custkey"], cust["c_mktsegment"],
-                    orders["o_orderkey"], orders["o_custkey"],
-                    orders["o_orderdate"]))
-    top_idx, top_ok = K.top_k_masked(rev, k, rev > 0)
-    # order date lookup for the winners — the same guarded LUT probe as
-    # every other join in this module
-    oidx, ohit = K.pk_fk_join(orders["o_orderkey"], top_idx,
-                              plan=jp_orders)
-    odate = jnp.where(ohit, jnp.take(orders["o_orderdate"], oidx), 0)
-    return top_idx, top_ok, odate, jnp.take(rev, top_idx)
-
-
-# ------------------------------------------------------------------ Q02
-def sharded_q02(tables: Tables, mesh: Mesh, axis: str = "data",
-                size: int = 15, type_suffix: str = "BRUSHED",
-                region: str = "EUROPE"):
-    """Min-cost supplier per part: partsupp sharded, the entire
-    dimension chain (part/supplier/nation/region) replicated; the
-    per-part min cost merges with ``pmin`` (the aggregate's own
-    combine), then a second pmin pass picks the global winner row."""
-    part, ps = tables["part"], tables["partsupp"]
-    sup, nat, reg = tables["supplier"], tables["nation"], tables["region"]
-    jp_part = PLN.plan_join(part, "p_partkey", ps, "ps_partkey")
-    jp_sup = PLN.plan_join(sup, "s_suppkey", ps, "ps_suppkey")
-    jp_nat = PLN.plan_join(nat, "n_nationkey", sup, "s_nationkey")
-    jp_reg = PLN.plan_join(reg, "r_regionkey", nat, "n_regionkey")
-    n_part = jp_part.key_space
-    type_ok = _lut(part.dicts["p_type"], lambda s: s.endswith(type_suffix))
-    region_code = reg.code("r_name", region)
-    ps_cols = {q: ps.cols[q] for q in ("ps_partkey", "ps_suppkey",
-                                       "ps_supplycost")}
-    dims = (part["p_partkey"], part["p_size"], part["p_type"],
-            sup["s_suppkey"], sup["s_nationkey"],
-            nat["n_nationkey"], nat["n_regionkey"],
-            reg["r_regionkey"], reg["r_name"], type_ok)
-
-    def valid_mask(valid, c, p_key, p_size, p_type, s_key, s_nat, n_key,
-                   n_regk, r_key, r_name, tok):
-        part_ok = (p_size == size) & jnp.take(tok, p_type)
-        _, phit = K.pk_fk_join(p_key, c["ps_partkey"], part_ok,
-                               plan=jp_part)
-        nidx, nhit = K.pk_fk_join(n_key, s_nat, plan=jp_nat)
-        sup_region = jnp.take(n_regk, nidx)
-        ridx, rhit = K.pk_fk_join(r_key, sup_region, plan=jp_reg)
-        in_region = nhit & rhit & (jnp.take(r_name, ridx) == region_code)
-        _, shit = K.pk_fk_join(s_key, c["ps_suppkey"], in_region,
-                               plan=jp_sup)
-        return valid & phit & shit
-
-    def phase1(valid, c, *dims_r):
-        ok = valid_mask(valid, c, *dims_r)
-        return K.segment_min(c["ps_supplycost"], c["ps_partkey"], n_part,
-                             ok)
-
-    cost_min = sharded_query(phase1, mesh, axis, ps_cols,
-                             replicated=dims, combine=jax.lax.pmin)
-
-    def phase2(valid, c, *args):
-        *dims_r, cmin = args
-        ok = valid_mask(valid, c, *dims_r)
-        at_min = ok & (c["ps_supplycost"] == jnp.take(cmin,
-                                                      c["ps_partkey"]))
-        # global row ids travel as a fact column so winner correctness
-        # does not depend on shard_fact_columns' internal row layout
-        return K.segment_min(c["row_id"], c["ps_partkey"], n_part, at_min)
-
-    winner = sharded_query(
-        phase2, mesh, axis,
-        {**ps_cols,
-         "row_id": jnp.arange(ps.num_rows, dtype=jnp.int32)},
-        replicated=dims + (cost_min,), combine=jax.lax.pmin)
-    return winner, cost_min
+sharded_q01 = _wrap("q01")
+sharded_q02 = _wrap("q02")
+sharded_q03 = _wrap("q03")
+sharded_q04 = _wrap("q04")
+sharded_q06 = _wrap("q06")
+sharded_q12 = _wrap("q12")
+sharded_q13 = _wrap("q13")
+sharded_q14 = _wrap("q14")
+sharded_q17 = _wrap("q17")
+sharded_q22 = _wrap("q22")

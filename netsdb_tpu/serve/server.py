@@ -747,11 +747,17 @@ class ServeController:
         assemble their global value first (``_fetch_global``) — clients
         wanting summaries only should use ANALYZE_SET instead."""
         from netsdb_tpu.relational.outofcore import PagedColumns
+        from netsdb_tpu.storage.paged import PagedObjects
         from netsdb_tpu.storage.store import _PagedMatrix
 
         for item in self.library.get_set_iterator(db, set_name):
             if isinstance(item, PagedColumns):
                 yield item.to_host_table()
+            elif isinstance(item, PagedObjects):
+                # record pages stream as records (the handle is
+                # process-local; in the STREAMED scan these pack into
+                # adaptive bounded frames like any object items)
+                yield from item
             elif isinstance(item, _PagedMatrix):
                 # the handle is process-local (it wraps the native
                 # arena + a lock); the matrix itself deliberately never

@@ -115,6 +115,100 @@ class PagedTensor:
             yield from self.store.stream_blocks(self.name, prefetch)
 
 
+class PagedObjects:
+    """Arbitrary host records paged as PICKLED BATCHES in the shared
+    arena — the reference's pages hold arbitrary ``pdb::Object``s
+    (``src/storage/headers/PDBPage.h:17-33``), so record workloads
+    (reddit-style Filter/Join/Aggregate over Python objects) are
+    out-of-core for free there; this is the TPU-native equivalent for
+    the EAGER interpreter path. Iterating the handle streams records
+    page by page (pin one batch, yield, move on), so the eager
+    Filter/Join/Aggregate nodes consume it unchanged.
+
+    Batches target ~the configured page size of pickled payload; the
+    arena caps/spills these pages exactly like column pages.
+    """
+
+    def __init__(self, store: "PagedTensorStore", name: str,
+                 num_items: int = 0):
+        from netsdb_tpu.utils.locks import RWLock
+
+        self.store = store
+        self.name = name
+        self.num_items = num_items
+        self.rw = RWLock()
+        self.dropped = False
+        store.backend.create_set(store._set_id(name))
+
+    @staticmethod
+    def ingest(store: "PagedTensorStore", name: str,
+               items: list) -> "PagedObjects":
+        po = PagedObjects(store, name)
+        po.append(items)
+        return po
+
+    def append(self, items: list) -> None:
+        """Write records as additional pickled-batch pages (the
+        reference's addData continuously appending objects)."""
+        import pickle
+
+        if not items:
+            return
+        with self.rw.write():
+            if self.dropped:
+                raise KeyError(f"paged object set {self.name!r} was "
+                               f"dropped; cannot append")
+            sid = self.store._set_id(self.name)
+            target = max(self.store.config.page_size_bytes, 4096)
+            # records-per-page adapts to the measured bytes-per-record
+            # of the previous batch (same discipline as the serve
+            # stream's frame packing; per-record pickling for exact
+            # sizing measured far slower)
+            per_rec = 256
+            batch: list = []
+            for it in items:
+                batch.append(it)
+                if len(batch) >= max(target // per_rec, 8):
+                    blob = pickle.dumps(batch,
+                                        protocol=pickle.HIGHEST_PROTOCOL)
+                    self.store.backend.write_page(sid, blob)
+                    per_rec = max(len(blob) // len(batch), 1)
+                    batch = []
+            if batch:
+                self.store.backend.write_page(
+                    sid, pickle.dumps(batch,
+                                      protocol=pickle.HIGHEST_PROTOCOL))
+            self.num_items += len(items)
+
+    def __iter__(self):
+        """Stream records page by page under the read lock — the
+        PageScanner feed for the eager interpreter."""
+        import pickle
+
+        with self.rw.read():
+            if self.dropped:
+                raise KeyError(f"paged object set {self.name!r} was "
+                               f"dropped; cannot stream")
+            sid = self.store._set_id(self.name)
+            for pid in self.store.backend.set_pages(sid):
+                yield from pickle.loads(self.store.backend.read_page(pid))
+
+    def __len__(self) -> int:
+        return self.num_items
+
+    def to_list(self) -> list:
+        return list(self)
+
+    def drop(self) -> None:
+        with self.rw.write():
+            self.dropped = True
+            sid = self.store._ids.pop(self.name, None)
+            if sid is None:
+                return
+            for pid in self.store.backend.set_pages(sid):
+                self.store.backend.free_page(pid)
+
+
 class PagedTensorStore:
     """Row-block paged storage for large matrices."""
 

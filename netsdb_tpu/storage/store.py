@@ -247,9 +247,10 @@ class SetStore:
 
     def _drop_detached(self, items: List[Any]) -> None:
         from netsdb_tpu.relational.outofcore import PagedColumns
+        from netsdb_tpu.storage.paged import PagedObjects
 
         for item in items:
-            if isinstance(item, PagedColumns):
+            if isinstance(item, (PagedColumns, PagedObjects)):
                 item.drop()
             elif isinstance(item, _PagedMatrix) and \
                     self._page_store is not None:
@@ -299,10 +300,13 @@ class SetStore:
         from netsdb_tpu.relational.outofcore import PagedColumns
         from netsdb_tpu.relational.table import ColumnTable
 
-        if len(items) != 1:
+        if not items:
+            return []
+        item = items[0]
+        if isinstance(item, (PagedColumns, np.ndarray, BlockedTensor,
+                             ColumnTable)) and len(items) != 1:
             raise ValueError(f"paged set {s.ident} holds exactly one "
                              f"relation; got {len(items)} items")
-        item = items[0]
         if isinstance(item, PagedColumns):
             # replacing with a new handle: the OLD relation's arena
             # pages go back to the caller for reclaim (cross-type-leak
@@ -333,8 +337,26 @@ class SetStore:
             s.last_access = time.time()
             return dead
         if not isinstance(item, ColumnTable):
-            raise TypeError(f"paged set {s.ident} ingests ColumnTables "
-                            f"or matrices; got {type(item).__name__}")
+            # HOST-OBJECT records: pickled-batch pages (the reference's
+            # pages hold arbitrary pdb::Objects, PDBPage.h:17-33).
+            # Object add_data APPENDS, matching the memory object
+            # path's extend semantics (relations replace; see above)
+            from netsdb_tpu.storage.paged import PagedObjects
+
+            po = next((i for i in (s.items or [])
+                       if isinstance(i, PagedObjects)), None)
+            if po is not None:
+                po.append(items)
+                s.last_access = time.time()
+                return []
+            dead = list(s.items or [])
+            po = PagedObjects.ingest(
+                self.page_store(), f"{s.ident}#g{next(self._gen)}",
+                items)
+            s.items = [po]
+            s.nbytes = 0
+            s.last_access = time.time()
+            return dead
         existing = [i for i in (s.items or [])
                     if isinstance(i, PagedColumns)]
         if append and existing:
@@ -641,6 +663,7 @@ class SetStore:
         host once, the same peak as the original ingest). The arena's
         own spill files remain capacity, not durability."""
         from netsdb_tpu.relational.outofcore import PagedColumns
+        from netsdb_tpu.storage.paged import PagedObjects
 
         s = self._require(ident)
         items = self.get_items(ident)
@@ -662,6 +685,10 @@ class SetStore:
                     f"{item.ident}.mat")]
                 payload.append(("paged_mat", np.concatenate(blocks),
                                 None, None))
+            elif isinstance(item, PagedObjects):
+                # object pages snapshot as the record list (host-side)
+                payload.append(("paged_objs", item.to_list(), None,
+                                None))
             else:
                 payload.append(("object", item, None, None))
         record = {"ident": tuple(s.ident), "persistence": s.persistence,
@@ -746,6 +773,14 @@ class SetStore:
             from netsdb_tpu.parallel.placement import Placement
 
             s.placement = Placement.from_meta(blob["placement"])
+        paged_objs = [data for kind, data, _, _ in blob["items"]
+                      if kind == "paged_objs"]
+        if paged_objs:
+            # object-set snapshot: records re-page into the arena
+            self._drop_detached(self._ingest_paged(s, paged_objs[0]))
+            self.stats.misses += 1
+            self.stats.loads += 1
+            return
         paged_tables = [data for kind, data, _, _ in blob["items"]
                         if kind in ("paged", "paged_mat")]
         if paged_tables:

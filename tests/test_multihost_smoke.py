@@ -420,3 +420,108 @@ def test_two_process_paged_and_placed_fold(tmp_path):
     cross-process 8-device mesh through the unchanged q01 sink, with
     spills on every process and results matching the in-memory engine."""
     _run_two_process(tmp_path, _PAGED_WORKER, "PAGEDWORKER", 240)
+
+
+_PAGED_DAEMON_WORKER = textwrap.dedent("""
+    import os, sys, time
+    sys.path.insert(0, {repo!r})
+    import jax
+    jax.config.update("jax_platforms", "cpu")
+    from netsdb_tpu.parallel.distributed import initialize_cluster
+
+    pid = int(sys.argv[1])
+    p0_port, p1_port = int(sys.argv[3]), int(sys.argv[4])
+    ok = initialize_cluster(coordinator_address={addr!r},
+                            num_processes=2, process_id=pid)
+    assert ok and jax.device_count() == 8
+
+    from netsdb_tpu.config import Configuration
+    from netsdb_tpu.serve.server import ServeController
+
+    # per-daemon capped arenas: each process pages ITS copy of the
+    # mirrored set and must spill (the reference's per-worker Pangea
+    # shared-memory pools)
+    cfg = Configuration(root_dir=os.path.join(sys.argv[2], f"mpd{{pid}}"),
+                        page_size_bytes=4096, page_pool_bytes=16384)
+    if pid == 1:
+        ctl = ServeController(cfg, port=p1_port)
+        ctl.start()
+        ctl.serve_forever()  # until the master sends SHUTDOWN
+        if ctl.library.store.page_store().native:
+            st = ctl.library.store.page_store().stats()
+            assert st["spills"] > 0 and st["loads"] > 0, st
+        print("PAGEDDAEMON 1 OK")
+        sys.exit(0)
+
+    import socket as _s
+    for _ in range(600):
+        try:
+            _s.create_connection(("127.0.0.1", p1_port), timeout=1).close()
+            break
+        except OSError:
+            time.sleep(0.2)
+    ctl = ServeController(cfg, port=p0_port,
+                          followers=[f"127.0.0.1:{{p1_port}}"])
+    ctl.start()
+
+    # ROUND 5: the FULL storage x scheduling composition THROUGH the
+    # daemon topology — a set that is paged (per-process arenas) AND
+    # placed (cross-process 8-device mesh), ingested and queried via
+    # mirrored frames only (PipelineStage.cc:228-265 +
+    # QuerySchedulerServer.cc:216-330)
+    from netsdb_tpu.serve.client import RemoteClient
+    from netsdb_tpu.parallel.placement import Placement
+    from netsdb_tpu.relational import dag as rdag
+    from netsdb_tpu.workloads import tpch
+
+    rows = tpch.generate(scale=4, seed=9)
+    c = RemoteClient(f"127.0.0.1:{{p0_port}}")
+    c.create_database("tpch")
+    c.create_set("tpch", "lineitem", type_name="table",
+                 storage="paged",
+                 placement=Placement((("data", 8),), ("data",)))
+    c.send_table("tpch", "lineitem", rows["lineitem"])
+
+    if not ctl.library.store.page_store().native:
+        RemoteClient(f"127.0.0.1:{{p1_port}}").shutdown_server()
+        c.close(); ctl.shutdown()
+        print("PAGEDDAEMON 0 SKIP no native page store")
+        sys.exit(0)
+
+    c.execute_computations(rdag.q01_sink("tpch"), job_name="mh-pq01",
+                           fetch_results=False)
+    st = ctl.library.store.page_store().stats()
+    assert st["spills"] > 0 and st["loads"] > 0, st  # master streamed
+
+    import numpy as np
+    res = ctl.library.get_table("tpch", "q01_out")
+    counts = np.asarray(jax.device_get(res["count"]))
+    rf, ls = res.dicts["l_returnflag"], res.dicts["l_linestatus"]
+    rfc = np.asarray(jax.device_get(res["l_returnflag"]))
+    lsc = np.asarray(jax.device_get(res["l_linestatus"]))
+    got = {{}}
+    for i in range(len(counts)):
+        if counts[i]:
+            got[(rf[int(rfc[i])], ls[int(lsc[i])])] = int(counts[i])
+    import collections
+    want = collections.Counter()
+    for r in rows["lineitem"]:
+        if r["l_shipdate"] <= "1998-09-02":
+            want[(r["l_returnflag"], r["l_linestatus"])] += 1
+    assert got == dict(want), (got, dict(want))
+
+    RemoteClient(f"127.0.0.1:{{p1_port}}").shutdown_server()
+    c.close(); ctl.shutdown()
+    print("PAGEDDAEMON 0 OK")
+""")
+
+
+@pytest.mark.slow
+def test_two_process_paged_and_placed_through_daemon(tmp_path):
+    """Round 5 item 7: a paged AND placed lineitem driven through the
+    master→follower DAEMON topology — mirrored DDL/ingest land in each
+    process's capped arena, the mirrored q01 job streams both arenas
+    SPMD onto the cross-process mesh, spills asserted on BOTH daemons,
+    result matching the row oracle."""
+    _run_two_process(tmp_path, _PAGED_DAEMON_WORKER, "PAGEDDAEMON", 300,
+                     extra_args=lambda: (_free_port(), _free_port()))

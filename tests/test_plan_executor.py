@@ -207,3 +207,62 @@ class TestPartitionComp:
 
         with pytest.raises(ValueError, match="num_partitions"):
             Partition(ScanSet("a", "b"), lambda r: r, 0)
+
+
+def test_mixed_paged_resident_job_auto_splits(tmp_path):
+    """Round 5 item 8: a job with one paged-reachable sink and one
+    resident-only sink auto-splits — the resident sink compiles into
+    the cached fused whole-plan program (cache entry present, hit on
+    re-run), results identical to running the sinks as separate
+    jobs."""
+    import jax.numpy as jnp
+
+    from netsdb_tpu import plan as _  # noqa: F401 (registry import)
+    from netsdb_tpu.client import Client
+    from netsdb_tpu.config import Configuration
+    from netsdb_tpu.plan import executor as ex
+    from netsdb_tpu.relational.table import ColumnTable
+
+    cfg = Configuration(root_dir=str(tmp_path / "split"),
+                        page_size_bytes=4096, page_pool_bytes=16384)
+    c = Client(cfg)
+    c.create_database("d")
+    c.create_set("d", "pg", storage="paged")
+    c.send_table("d", "pg", ColumnTable(
+        {"a": np.arange(5000, dtype=np.int32),
+         "b": np.ones(5000, np.float32)}))
+    c.create_set("d", "res")
+    t = BlockedTensor.from_dense(
+        np.arange(64, dtype=np.float32).reshape(8, 8), (4, 4))
+    c.store.put_tensor(SetIdentifier("d", "res"), t)
+
+    from netsdb_tpu.plan.fold import single_pass
+
+    fold = single_pass(
+        lambda prev, src: jnp.zeros((), jnp.float32),
+        lambda st, chunk: st + jnp.sum(
+            jnp.where(chunk.mask(), chunk["b"], 0.0)),
+        lambda st, src: ColumnTable(cols={"s": st[None]}))
+    paged_sink = WriteSet(Apply(ScanSet("d", "pg"), fold=fold,
+                                label="sum_b"), "d", "pg_out")
+    res_sink = WriteSet(Apply(ScanSet("d", "res"),
+                              lambda x: x.with_data(x.data * 2.0),
+                              label="dbl"), "d", "res_out")
+
+    clear_compiled_cache()
+    out = c.execute_computations(paged_sink, res_sink, job_name="mix")
+    vals = {i.set: v for i, v in out.items()}
+    np.testing.assert_allclose(float(np.asarray(vals["pg_out"]["s"])[0]),
+                               5000.0)
+    np.testing.assert_array_equal(np.asarray(vals["res_out"].to_dense()),
+                                  np.arange(64).reshape(8, 8) * 2.0)
+    # the resident component took the WHOLE-PLAN jit path: its fused
+    # program is in the compiled cache (streamed fold steps key with a
+    # fold:: prefix; the plain entry is the resident program)
+    plain = [k for k in ex._compiled_cache
+             if not k.startswith("fold::")]
+    assert len(plain) == 1, list(ex._compiled_cache)
+    # re-running hits the cache (no second entry)
+    c.execute_computations(paged_sink, res_sink, job_name="mix")
+    assert len([k for k in ex._compiled_cache
+                if not k.startswith("fold::")]) == 1

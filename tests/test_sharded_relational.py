@@ -1,6 +1,14 @@
-"""Distributed relational execution on the virtual 8-device mesh:
-sharded query results must match the single-chip columnar engine
-(the pseudo-cluster-style check — same data, partitioned vs not)."""
+"""Distributed relational execution on the virtual 8-device mesh.
+
+Round 5 retired the hand-written per-query shard_map bodies: every
+``sharded_qXX`` is now a thin wrapper over the SAME FoldSpec the
+paged/streamed engine runs (``relational.folds``), whole-table under
+jit with fact columns mesh-sharded — one code path per query core
+(the reference has ONE PipelineStage, ``PipelineStage.cc:933-1213``).
+These tests pin: distributed fold outputs == the single-chip suite
+cores (pseudo-cluster check — same data, partitioned vs not), and
+partition-count invariance.
+"""
 
 import jax
 import numpy as np
@@ -8,10 +16,13 @@ import pytest
 
 from netsdb_tpu.parallel.mesh import make_mesh
 from netsdb_tpu.relational import queries as Q
+from netsdb_tpu.relational import sharded as S
+from netsdb_tpu.relational.dag import _QUERY_TABLES
 from netsdb_tpu.relational.queries import tables_from_rows
-from netsdb_tpu.relational.sharded import (sharded_q01, sharded_q04,
-                                           sharded_q06)
+from netsdb_tpu.relational.sharded import fold_sharded
 from netsdb_tpu.workloads import tpch
+
+ALL_QUERIES = sorted(_QUERY_TABLES)
 
 
 @pytest.fixture(scope="module")
@@ -24,159 +35,65 @@ def mesh():
     return make_mesh((8,), ("data",), devices=jax.devices()[:8])
 
 
-def test_sharded_q01_matches_local(tables, mesh):
-    li = tables["lineitem"]
-    n_ls = len(li.dicts["l_linestatus"])
-    n_groups = len(li.dicts["l_returnflag"]) * n_ls
-    sums, counts = Q._q01_core(
-        n_groups, n_ls, li["l_shipdate"], li["l_returnflag"],
-        li["l_linestatus"], li["l_quantity"], li["l_extendedprice"],
-        li["l_discount"], li["l_tax"], Q.date_to_int("1998-09-02"))
-    got_sums, got_counts = sharded_q01(tables, mesh)
-    np.testing.assert_allclose(np.asarray(got_sums), np.asarray(sums),
-                               rtol=1e-5, atol=1e-3)
-    assert got_counts.dtype == np.int32  # f32 saturates at 2^24 rows/group
-    np.testing.assert_array_equal(np.asarray(got_counts),
-                                  np.asarray(counts))
+def _resident(qname, tables, **params):
+    """Single-chip oracle: the suite core the resident engine runs
+    (the same outputs the folds produce — pinned by the paged tests)."""
+    core, args_fn = Q._SUITE_CORES[qname]
+    out = core(*args_fn(tables, **params))
+    return out if isinstance(out, tuple) else (out,)
 
 
-def test_sharded_q06_matches_local(tables, mesh):
-    li = tables["lineitem"]
-    expect = float(Q._q06_core(
-        li["l_shipdate"], li["l_discount"], li["l_quantity"],
-        li["l_extendedprice"], Q.date_to_int("1994-01-01"),
-        Q.date_to_int("1995-01-01"), 0.06, 24))
-    got = float(sharded_q06(tables, mesh))
-    assert got == pytest.approx(expect, rel=1e-5, abs=1e-3)
+@pytest.mark.parametrize("qname", ALL_QUERIES)
+def test_sharded_fold_matches_local(qname, tables, mesh):
+    """All ten query cores distributed over the 8-device mesh match
+    the single-chip engine — through the ONE fold per query."""
+    want = jax.device_get(_resident(qname, tables))
+    got = jax.device_get(fold_sharded(qname, tables, mesh))
+    assert len(want) == len(got)
+    for a, b in zip(got, want):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=1e-4, atol=1e-3)
 
 
-def test_sharded_q04_matches_local(tables, mesh):
-    expect = np.asarray(Q._q04_core(*Q._args_q04(tables)))
-    got = np.asarray(sharded_q04(tables, mesh))
-    np.testing.assert_array_equal(got, expect)
+def test_sharded_q01_counts_stay_int32(tables, mesh):
+    """f32 count partials would absorb +1 increments past 2^24
+    rows/group; the fold keeps them int32 through the collective."""
+    _sums, counts = S.sharded_q01(tables, mesh)
+    assert np.asarray(counts).dtype == np.int32
 
 
-def test_sharded_q01_other_mesh_shapes(tables):
-    """Partition count must not change the answer (the reference's
-    pseudo-cluster invariant across serverlist sizes)."""
-    rs, rc = sharded_q01(
-        tables, make_mesh((2,), ("data",), devices=jax.devices()[:2]))
-    for n in (4, 8):
-        m = make_mesh((n,), ("data",), devices=jax.devices()[:n])
-        s, c = sharded_q01(tables, m)
-        np.testing.assert_allclose(np.asarray(s), np.asarray(rs),
-                                   rtol=1e-5, atol=1e-3)
-        np.testing.assert_array_equal(np.asarray(c), np.asarray(rc))
-
-
-@pytest.mark.parametrize("qname", ["q04", "q06", "q17", "q22"])
+@pytest.mark.parametrize("qname", ["q01", "q04", "q06", "q17", "q22"])
 def test_sharded_mesh_shape_invariance(tables, qname):
-    """Multi-phase and pmin plans must also be partition-count
-    invariant (covers semi-join, scalar-sum, two-phase-avg, and
-    anti-join shapes; q01 above covers the groupby shape)."""
-    from netsdb_tpu.relational import sharded as S
-
-    fn = getattr(S, f"sharded_{qname}")
-    ref = fn(tables, make_mesh((2,), ("data",), devices=jax.devices()[:2]))
-    got = fn(tables, make_mesh((8,), ("data",), devices=jax.devices()[:8]))
+    """Partition count must not change the answer (the reference's
+    pseudo-cluster invariant across serverlist sizes); covers groupby,
+    semi-join, scalar-sum, two-pass-avg, and anti-join shapes."""
+    ref = fold_sharded(
+        qname, tables,
+        make_mesh((2,), ("data",), devices=jax.devices()[:2]))
+    got = fold_sharded(
+        qname, tables,
+        make_mesh((8,), ("data",), devices=jax.devices()[:8]))
     for a, b in zip(jax.tree_util.tree_leaves(got),
                     jax.tree_util.tree_leaves(ref)):
         np.testing.assert_allclose(np.asarray(a), np.asarray(b),
                                    rtol=1e-5, atol=1e-2)
 
 
-def test_sharded_q12_matches_local(tables, mesh):
-    from netsdb_tpu.relational.sharded import sharded_q12
-    expect = np.asarray(Q._q12_core(*Q._args_q12(tables)))
-    got = np.asarray(sharded_q12(tables, mesh))
-    np.testing.assert_array_equal(got, expect)
+def test_sharded_wrappers_are_thin(tables, mesh):
+    """The named sharded_qXX surface delegates to fold_sharded — no
+    second query-core implementation exists to diverge."""
+    a = jax.device_get(S.sharded_q06(tables, mesh))
+    b = jax.device_get(fold_sharded("q06", tables, mesh))
+    for x, y in zip(jax.tree_util.tree_leaves(a),
+                    jax.tree_util.tree_leaves(b)):
+        np.testing.assert_array_equal(np.asarray(x), np.asarray(y))
 
 
-def test_sharded_q13_matches_local(tables, mesh):
-    import re
-
-    import jax.numpy as jnp
-
-    from netsdb_tpu.relational.queries import _lut
-    from netsdb_tpu.relational.sharded import sharded_q13
-    cust, orders = tables["customer"], tables["orders"]
-    n_cust = Q.key_space(cust, "c_custkey")
-    if "o_comment" in orders.dicts:
-        pat = re.compile("special.*requests")
-        keep = jnp.take(_lut(orders.dicts["o_comment"],
-                             lambda s: not pat.search(s)),
-                        orders["o_comment"])
-    else:
-        keep = jnp.ones((orders["o_custkey"].shape[0],), jnp.bool_)
-    expect = np.asarray(Q._q13_per_cust(
-        n_cust, orders["o_custkey"], keep, cust["c_custkey"]))
-    got = np.asarray(sharded_q13(tables, mesh))
-    np.testing.assert_array_equal(got, expect)
-
-
-def test_sharded_q14_matches_local(tables, mesh):
-    from netsdb_tpu.relational.sharded import sharded_q14
-    expect = np.asarray(Q._q14_core(*Q._args_q14(tables)))
-    got = np.asarray(sharded_q14(tables, mesh))
-    np.testing.assert_allclose(got, expect, rtol=1e-5, atol=1e-3)
-
-
-def test_sharded_q17_matches_local(tables, mesh):
-    from netsdb_tpu.relational.sharded import sharded_q17
-    part = tables["part"]
-    brand = part.dicts["p_brand"][0]
-    cont = part.dicts["p_container"][0]
-    expect = float(Q._q17_core(*Q._args_q17(tables, brand, cont)))
-    got = float(sharded_q17(tables, mesh, brand=brand, container=cont))
-    assert got == pytest.approx(expect, rel=1e-5, abs=1e-3)
-
-
-def test_sharded_q22_matches_local(tables, mesh):
-    from netsdb_tpu.relational.sharded import sharded_q22
-    prefixes = ("13", "31", "23", "29", "30", "18", "17")
-    expect = np.asarray(Q._q22_core(*Q._args_q22(tables, prefixes)))
-    got = np.asarray(sharded_q22(tables, mesh, prefixes=prefixes))
-    np.testing.assert_allclose(got, expect, rtol=1e-5, atol=1e-2)
-
-
-def test_sharded_q03_matches_local(tables, mesh):
-    from netsdb_tpu.relational.sharded import sharded_q03
-    cust = tables["customer"]
-    seg = cust.dicts["c_mktsegment"][0]
-    ints, rev = Q._q03_core(*Q._args_q03(tables, segment=seg))
-    ints, rev = np.asarray(ints), np.asarray(rev)
-    top_idx, top_ok, odate, grev = sharded_q03(tables, mesh, segment=seg)
-    np.testing.assert_array_equal(np.asarray(top_idx), ints[0])
-    np.testing.assert_array_equal(np.asarray(top_ok), ints[1].astype(bool))
-    # odates agree where the slot is live
-    live = ints[1].astype(bool)
-    np.testing.assert_array_equal(np.asarray(odate)[live], ints[2][live])
-    np.testing.assert_allclose(np.asarray(grev), rev, rtol=1e-5, atol=1e-2)
-
-
-def test_sharded_q02_matches_local(tables, mesh):
-    from netsdb_tpu.relational.sharded import sharded_q02
-    from netsdb_tpu.relational.queries import _lut
-    part, ps = tables["part"], tables["partsupp"]
-    reg = tables["region"]
-    size = int(np.asarray(part["p_size"])[0])
-    suffix = part.dicts["p_type"][0].split()[-1]
-    region = reg.dicts["r_name"][0]
-    ints, cost_min = Q._q02_core(*Q._args_q02(
-        tables, size=size, type_suffix=suffix, region=region))
-    ints = np.asarray(ints)
-    winner, g_cost = sharded_q02(tables, mesh, size=size,
-                                 type_suffix=suffix, region=region)
-    winner, g_cost = np.asarray(winner), np.asarray(g_cost)
-    has = ints[0].astype(bool)
-    # min costs agree everywhere a part qualifies
-    np.testing.assert_allclose(g_cost[has], np.asarray(cost_min)[has],
-                               rtol=1e-6, atol=1e-4)
-    imax = np.iinfo(np.int32).max
-    np.testing.assert_array_equal(winner < imax, has)
-    # winning rows resolve to the same supplier cost (row ids may differ
-    # when several rows tie at the min — any-representative semantics)
-    ps_cost = np.asarray(ps["ps_supplycost"])
-    live = winner[has]
-    np.testing.assert_allclose(ps_cost[live], g_cost[has], rtol=1e-6,
-                               atol=1e-4)
+def test_fold_jit_cache_reused(tables, mesh):
+    """Same query + data statistics reuse ONE jitted runner (the
+    per-call-jit recompile trap)."""
+    S._FOLD_JIT.clear()
+    fold_sharded("q06", tables, mesh)
+    n = len(S._FOLD_JIT)
+    fold_sharded("q06", tables, mesh)
+    assert len(S._FOLD_JIT) == n
