@@ -191,33 +191,22 @@ class TransformerLayerModel:
     def build_forward_dag_staged(self, input_set: str = "x",
                                  output_set: str = "y",
                                  causal: bool = True):
-        """Forward as STAGED Computation nodes (attention → ln → MLP-up
-        → MLP-down → residual) instead of one fused fn, so the MLP
-        weights — the layer's largest matrices — may live in
-        ``storage="paged"`` sets and STREAM through the DAG: each
-        weight's row blocks are contraction slices accumulated by a
-        reduce-mode :class:`~netsdb_tpu.plan.fold.TensorFold` (the
-        reference's page-fed weight scans, ``SimpleFF.cc:94-290``,
-        applied to the transformer MLP). With resident sets the same
-        DAG evaluates the plain fns — storage stays a property of the
-        set, not the query."""
+        """Forward as STAGED Computation nodes (ln → qkv-proj →
+        attention core → out-proj → residual → ln → MLP-up → MLP-down
+        → residual) instead of one fused fn, so EVERY weight matrix
+        (w_qkv, w_out, w_up, w_down) may live in a ``storage="paged"``
+        set and STREAM through the DAG: each weight's row blocks are
+        contraction slices accumulated by a reduce-mode
+        :class:`~netsdb_tpu.plan.fold.TensorFold` (the reference's
+        page-fed weight scans, ``SimpleFF.cc:94-290``, applied to the
+        transformer layer). With resident sets the same DAG evaluates
+        the plain fns — storage stays a property of the set, not the
+        query."""
         from netsdb_tpu.plan.computations import (Apply, Join, ScanSet,
                                                   WriteSet)
         from netsdb_tpu.plan.fold import TensorFold
 
         heads, db = self.num_heads, self.db
-
-        def attn(gathered, wo_bt):
-            x, wq = gathered
-            a = mha_forward(self._ln(x), wq.to_dense(), wo_bt.to_dense(),
-                            heads, causal=causal)
-            return x + a
-
-        g1 = Join(ScanSet(db, input_set), ScanSet(db, "w_qkv"),
-                  fn=lambda a, b: (a, b), label="gather:w_qkv")
-        a1 = Join(g1, ScanSet(db, "w_out"), fn=attn,
-                  label=f"attn:{heads}:{causal}")
-        ln2 = Apply(a1, fn=self._ln, label="ln2")
 
         def contract_partial(eq):
             def partial(carry, start, block, acts):
@@ -226,6 +215,40 @@ class TransformerLayerModel:
                 p = jnp.einsum(eq, sl, block, precision=_HI)
                 return p if carry is None else carry + p
             return partial
+
+        def proj_fold():  # (B,S,E') @ paged (E',F): rows = contraction
+            return TensorFold(mode="reduce",
+                              partial=contract_partial("bse,ef->bsf"))
+
+        from netsdb_tpu.ops.attention import (attention_dispatch,
+                                              merge_heads,
+                                              split_qkv_heads)
+
+        ln1 = Apply(ScanSet(db, input_set), fn=self._ln, label="ln1")
+        # qkv projection: w_qkv (E,3E) may be paged — its row blocks
+        # are contraction slices of ln(x)
+        qkv = Join(ln1, ScanSet(db, "w_qkv"),
+                   fn=lambda xs, w: jnp.einsum("bse,ef->bsf", xs,
+                                               w.to_dense(),
+                                               precision=_HI),
+                   tensor_fold=proj_fold(), label="qkv-proj")
+
+        def attn_core(q_k_v):
+            q, k, v = split_qkv_heads(q_k_v, heads)
+            return merge_heads(attention_dispatch(q, k, v,
+                                                  causal=causal))
+
+        core = Apply(qkv, fn=attn_core,
+                     label=f"attn-core:{heads}:{causal}")
+        # out projection: w_out (E,E) may be paged the same way
+        proj = Join(core, ScanSet(db, "w_out"),
+                    fn=lambda os, w: jnp.einsum("bse,ef->bsf", os,
+                                                w.to_dense(),
+                                                precision=_HI),
+                    tensor_fold=proj_fold(), label="out-proj")
+        a1 = Join(ScanSet(db, input_set), proj,
+                  fn=lambda x, a: x + a, label="residual1")
+        ln2 = Apply(a1, fn=self._ln, label="ln2")
 
         h = Join(ln2, ScanSet(db, "w_up"),
                  fn=lambda xs, wu: jax.nn.gelu(jnp.einsum(

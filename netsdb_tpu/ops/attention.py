@@ -88,13 +88,14 @@ def blockwise_attention(q: jax.Array, k: jax.Array, v: jax.Array,
     return num / jnp.maximum(den, 1e-30)
 
 
-def qkv_project(x: jax.Array, w_qkv: jax.Array, num_heads: int):
-    """x (B,S,E) → q/k/v (B,H,S,D) — shared by local and
-    sequence-parallel layers."""
-    b, s, e = x.shape
+def split_qkv_heads(qkv: jax.Array, num_heads: int):
+    """Packed (B,S,3E) projection → q/k/v (B,H,S,D) — THE layout
+    convention (split into thirds, then head reshape/transpose); every
+    consumer (fused forward, staged DAGs) must share it or the paths
+    silently diverge."""
+    b, s, f = qkv.shape
+    e = f // 3
     d = e // num_heads
-    qkv = jnp.einsum("bse,ef->bsf", x, w_qkv,
-                     precision=jax.lax.Precision.HIGHEST)
     q, k, v = jnp.split(qkv, 3, axis=-1)
 
     def heads(t):
@@ -103,11 +104,24 @@ def qkv_project(x: jax.Array, w_qkv: jax.Array, num_heads: int):
     return heads(q), heads(k), heads(v)
 
 
+def merge_heads(out: jax.Array) -> jax.Array:
+    """(B,H,S,D) attention output → (B,S,E), the inverse of
+    :func:`split_qkv_heads`'s layout."""
+    b, h, s, d = out.shape
+    return out.transpose(0, 2, 1, 3).reshape(b, s, h * d)
+
+
+def qkv_project(x: jax.Array, w_qkv: jax.Array, num_heads: int):
+    """x (B,S,E) → q/k/v (B,H,S,D) — shared by local and
+    sequence-parallel layers."""
+    qkv = jnp.einsum("bse,ef->bsf", x, w_qkv,
+                     precision=jax.lax.Precision.HIGHEST)
+    return split_qkv_heads(qkv, num_heads)
+
+
 def merge_project(out: jax.Array, w_out: jax.Array) -> jax.Array:
     """(B,H,S,D) attention output → (B,S,E) through the out projection."""
-    b, h, s, d = out.shape
-    out = out.transpose(0, 2, 1, 3).reshape(b, s, h * d)
-    return jnp.einsum("bse,ef->bsf", out, w_out,
+    return jnp.einsum("bse,ef->bsf", merge_heads(out), w_out,
                       precision=jax.lax.Precision.HIGHEST)
 
 

@@ -597,7 +597,6 @@ def bench_paged_set_api(rows: int = 60_000_000,
     setup the chunk uploads are transfer-bound (~12-18 MB/s);
     attached-HBM numbers are the deployment case (BASELINE.md
     caveat)."""
-    import json
     import shutil
     import tempfile
     import time

@@ -199,13 +199,22 @@ def fold_sharded(qname: str, tables: Tables, mesh: Mesh,
     fact_t = placed[fact]
     resident = tuple(placed[n] for n in names if n != fact)
     # one jitted runner per equivalent fold build (same query, params,
-    # row counts and key spaces ⇒ deterministic identical closures):
-    # jitting per call would recompile every time (env gotcha)
+    # row counts, key spaces AND dictionary contents ⇒ deterministic
+    # identical closures): fold builders bake dict-derived codes/LUTs
+    # into the closure (q12's shipmode codes, q13's comment regex LUT),
+    # so two datasets differing only in dict encoding must not share a
+    # runner — same hazard class as the transformer DAG's mesh tag.
+    # Jitting per call would recompile every time (env gotcha).
+    import hashlib
+
+    dict_tag = hashlib.blake2s(repr(sorted(
+        (n, c, tuple(d)) for n in names
+        for c, d in tables[n].dicts.items())).encode()).hexdigest()[:12]
     key = (qname, repr(sorted(params.items())),
            tuple(sorted(nrows.items())),
            tuple(sorted((n, c, s.key_space)
                         for n, cs in cap.items()
-                        for c, s in cs.items())))
+                        for c, s in cs.items())), dict_tag)
     fn = _FOLD_JIT.get(key)
     if fn is None:
         fn = jax.jit(

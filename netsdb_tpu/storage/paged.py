@@ -219,6 +219,7 @@ class PagedTensorStore:
         config.ensure_dirs()
         self._meta: Dict[int, Tuple[Tuple[int, int], Tuple[int, int], np.dtype]] = {}
         self._ids: Dict[str, int] = {}
+        self._next_sid = 1
         # per-set (block_rows, block_starts) cache — derived from page
         # sizes once and reused, so read_block/stream starts stay O(1)
         # per call instead of O(pages); invalidated on put/append/drop
@@ -248,8 +249,13 @@ class PagedTensorStore:
                 self.native = False
 
     def _set_id(self, name: str) -> int:
+        # MONOTONIC allocation: len()+1 would recycle the id of a live
+        # set after any drop() popped an entry, intermixing two sets'
+        # pages (r5 review finding — reproduced as cross-set
+        # corruption via the PagedObjects drop/re-ingest lifecycle)
         if name not in self._ids:
-            self._ids[name] = len(self._ids) + 1
+            self._ids[name] = self._next_sid
+            self._next_sid += 1
         return self._ids[name]
 
     def put(self, name: str, dense: np.ndarray,

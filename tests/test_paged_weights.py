@@ -103,7 +103,7 @@ def test_ff_paged_weights_through_daemon(tmp_path):
 
 
 def test_transformer_layer_paged_mlp_matches_resident(tmp_path):
-    """One transformer layer with w_up/w_down paged: the staged DAG's
+    """One transformer layer with paged weights: the staged DAG's
     reduce-mode TensorFolds accumulate contraction slices; result
     matches the resident staged DAG and the fused ``forward``."""
     E, S, Bt = 64, 16, 2
@@ -126,6 +126,12 @@ def test_transformer_layer_paged_mlp_matches_resident(tmp_path):
     pag, c1, _, _ = run("tfpag", {"w_up": "paged", "w_down": "paged"})
     assert c1.store.page_store().stats()["spills"] > 0
     np.testing.assert_allclose(res, pag, rtol=2e-5, atol=2e-5)
+    # ALL FOUR weights paged — the attention projections stream too
+    allp, c2, _, _ = run("tfall", {w: "paged" for w in
+                                   ("w_qkv", "w_out", "w_up",
+                                    "w_down")})
+    assert c2.store.page_store().stats()["spills"] > 0
+    np.testing.assert_allclose(res, allp, rtol=2e-5, atol=2e-5)
     # staged DAG == fused forward on the same params
     p = m0.params_from_store(c0)
     fused = np.asarray(m0.forward(p, jnp.asarray(x)))
@@ -307,3 +313,24 @@ def test_paged_object_set_scans_through_daemon(tmp_path):
     finally:
         rc.close()
         ctl.shutdown()
+
+
+def test_dropped_object_set_does_not_recycle_live_set_id(tmp_path):
+    """Arena set ids are allocated monotonically: dropping set A and
+    creating set C must not hand C the id of still-live set B (r5
+    review finding, reproduced as cross-set record corruption)."""
+    from netsdb_tpu.storage.paged import PagedObjects, PagedTensorStore
+
+    cfg = Configuration(root_dir=str(tmp_path / "sid"),
+                        page_size_bytes=4096, page_pool_bytes=16384)
+    store = PagedTensorStore(cfg, pool_bytes=16384)
+    a = PagedObjects.ingest(store, "a", [{"s": "a", "i": i}
+                                         for i in range(20)])
+    b = PagedObjects.ingest(store, "b", [{"s": "b", "i": i}
+                                         for i in range(20)])
+    a.drop()
+    PagedObjects.ingest(store, "c", [{"s": "c", "i": i}
+                                     for i in range(20)])
+    got = list(b)
+    assert len(got) == 20 and all(r["s"] == "b" for r in got)
+    store.close()

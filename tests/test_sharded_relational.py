@@ -97,3 +97,35 @@ def test_fold_jit_cache_reused(tables, mesh):
     n = len(S._FOLD_JIT)
     fold_sharded("q06", tables, mesh)
     assert len(S._FOLD_JIT) == n
+
+
+def test_fold_jit_cache_distinguishes_dict_encodings(mesh):
+    """Two datasets with equal row counts/key spaces but DIFFERENT
+    dictionary encodings must not share a jitted fold runner — fold
+    builders bake dict-derived codes into the closure (r5 review
+    finding, reproduced as silently wrong q12 counts)."""
+    rows = tpch.generate(scale=2, seed=11)
+    t1 = tables_from_rows(rows)
+    # re-encode l_shipmode with the dictionary REVERSED (codes remap)
+    import numpy as np
+
+    li = t1["lineitem"]
+    d = li.dicts["l_shipmode"]
+    rev = list(reversed(d))
+    remap = np.array([rev.index(s) for s in d], np.int32)
+    cols = dict(li.cols)
+    cols["l_shipmode"] = remap[np.asarray(li["l_shipmode"])]
+    from netsdb_tpu.relational.table import ColumnTable
+
+    t2 = dict(t1)
+    t2["lineitem"] = ColumnTable(cols,
+                                 {**li.dicts, "l_shipmode": rev},
+                                 li.valid)
+    a = jax.device_get(fold_sharded("q12", t1, mesh))
+    b = jax.device_get(fold_sharded("q12", t2, mesh))
+    ra = jax.device_get(_resident("q12", t1))
+    rb = jax.device_get(_resident("q12", t2))
+    for x, y in zip(a, ra):
+        np.testing.assert_allclose(np.asarray(x), np.asarray(y))
+    for x, y in zip(b, rb):
+        np.testing.assert_allclose(np.asarray(x), np.asarray(y))
