@@ -376,10 +376,11 @@ class Client:
         if not tables:
             paged = [i for i in items if isinstance(i, PagedColumns)]
             if len(paged) == 1:
-                # compatibility materialization — streams every page
-                # back into one resident table; queries should go
+                # compatibility materialization — HOST-side assembly
+                # (numpy columns, nothing touches HBM): the set was
+                # paged because it does not fit; queries should go
                 # through the DAG path, which folds over the stream
-                return paged[0].to_table()
+                return paged[0].to_host_table()
         if len(tables) != 1:
             raise ValueError(
                 f"set {db}:{set_name} holds {len(tables)} tables; expected 1")
