@@ -151,8 +151,11 @@ def _run_fold_grace(fold, pc, rest, bi, build_pc, placement, step_jit):
         # inside the try: a failure partitioning the SECOND side must
         # still reclaim the first side's spill partitions
         build_parts = partition_by_key(build_pc, fold.build_key, nparts)
+        # partition pages carry only the columns the fold's step reads
+        # (the reference's pipelines carry only listed tuple attrs)
         probe_parts = partition_by_key(pc, fold.probe_key, nparts,
-                                       keep_rowid=True)
+                                       keep_rowid=True,
+                                       columns=fold.probe_columns)
         maxr = max((bp.num_rows for bp in build_parts
                     if bp is not None), default=0)
         for p in range(nparts):

@@ -70,6 +70,12 @@ class FoldSpec:
     # WHICH paged resident input is the build).
     probe_key: Optional[str] = None
     build_key: Optional[str] = None
+    # columns of the probe the fold's step actually reads: the grace
+    # partitioner projects the repartitioned spill pages down to these
+    # (plus the key), cutting partition IO — the reference's pipelines
+    # carry only the tuple attributes the TCAP computation lists.
+    # None = carry everything.
+    probe_columns: Optional[Tuple[str, ...]] = None
 
     def whole(self, table: Any, *resident: Any) -> Any:
         """Whole-table evaluation — the resident-set path. Runs the
@@ -134,9 +140,12 @@ class TensorFold:
 def single_pass(init: Callable, step: Callable,
                 finalize: Callable, merge: Optional[Callable] = None,
                 probe_key: Optional[str] = None,
-                build_key: Optional[str] = None) -> FoldSpec:
+                build_key: Optional[str] = None,
+                probe_columns: Optional[Tuple[str, ...]] = None
+                ) -> FoldSpec:
     return FoldSpec(((init, step),), finalize, merge,
-                    probe_key=probe_key, build_key=build_key)
+                    probe_key=probe_key, build_key=build_key,
+                    probe_columns=probe_columns)
 
 
 def flatten_resident(values: Tuple[Any, ...]) -> Tuple[Any, ...]:

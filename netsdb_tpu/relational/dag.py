@@ -260,7 +260,9 @@ def q03_probe_fold(d: int, k: int, jp_orders):
                            valid=ok)
 
     return single_pass(init, step, fin, merge,
-                       probe_key="l_orderkey", build_key="o_orderkey")
+                       probe_key="l_orderkey", build_key="o_orderkey",
+                       probe_columns=("l_shipdate", "l_extendedprice",
+                                      "l_discount"))
 
 
 def q03_sink_for(client, db: str, segment: str = "BUILDING",

@@ -201,7 +201,8 @@ def fold_q02(cap: Captured, dicts, nrows, *, size: int = 15,
                 jnp.where(bhas, bc, ac))
 
     return single_pass(init, step, fin, merge,
-                       probe_key="ps_partkey", build_key="p_partkey")
+                       probe_key="ps_partkey", build_key="p_partkey",
+                       probe_columns=("ps_suppkey", "ps_supplycost"))
 
 
 # ---------------------------------------------------------------- Q03
@@ -318,7 +319,9 @@ def fold_q12(cap: Captured, dicts, nrows, *, mode1: str = "MAIL",
     # per-mode counts simply add across partition outputs
     return single_pass(init, step, lambda st, src, orders: (st,),
                        merge=lambda a, b: (a[0] + b[0],),
-                       probe_key="l_orderkey", build_key="o_orderkey")
+                       probe_key="l_orderkey", build_key="o_orderkey",
+                       probe_columns=("l_shipmode", "l_shipdate",
+                                      "l_commitdate", "l_receiptdate"))
 
 
 # ---------------------------------------------------------------- Q13
@@ -363,7 +366,8 @@ def fold_q13(cap: Captured, dicts, nrows, *, word1: str = "special",
     return single_pass(init, step, fin,
                        merge=lambda a, b: (a[0] + b[0],
                                            jnp.maximum(a[1], b[1])),
-                       probe_key="o_custkey", build_key="c_custkey")
+                       probe_key="o_custkey", build_key="c_custkey",
+                       probe_columns=("o_comment",))
 
 
 # ---------------------------------------------------------------- Q14

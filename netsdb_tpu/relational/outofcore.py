@@ -371,7 +371,8 @@ _grace_ids = itertools.count()
 
 
 def partition_by_key(pc: PagedColumns, key: str, nparts: int,
-                     keep_rowid: bool = False
+                     keep_rowid: bool = False,
+                     columns: Optional[Tuple[str, ...]] = None
                      ) -> List[Optional[PagedColumns]]:
     """ONE streaming pass over ``pc``, hash-partitioning its valid rows
     by ``key % nparts`` into ``nparts`` spill relations in the SAME
@@ -412,7 +413,8 @@ def partition_by_key(pc: PagedColumns, key: str, nparts: int,
                                       device=False)) as chunks:
         for ccols, valid, start in chunks:
             n = int(np.asarray(valid).sum())
-            cols = {k: v[:n] for k, v in ccols.items()}
+            cols = {k: v[:n] for k, v in ccols.items()
+                    if columns is None or k in columns or k == key}
             if keep_rowid:
                 cols["_rowid0"] = np.arange(
                     start, start + n, dtype=np.int32)
