@@ -545,7 +545,12 @@ def ooc_q03(pc: PagedColumns, store: PagedTensorStore,
     Thin wrapper: each LUT block becomes a build-side ColumnTable
     (non-qualifying keys → -1, dropped by the orphan-key rule) and the
     grace-hash loop runs the SAME fold + merge the set-API DAG uses for
-    a paged build side (``relational.dag.q03_probe_fold``)."""
+    a paged build side (``relational.dag.q03_probe_fold``). This bench
+    driver keeps the LEGACY per-block discipline (full probe re-stream
+    per LUT block — its build lives in a raw block store, not a
+    relation); the canonical ONE-PASS grace hash is the set-API path
+    (``q03_build_sink``/``q03_probe_sink``, both sides
+    hash-partitioned, probe pages read once)."""
     from netsdb_tpu.relational.dag import q03_probe_fold, q03_rows
     from netsdb_tpu.relational.planner import JoinPlan
 

@@ -126,6 +126,10 @@ def test_remote_send_matrix_to_paged_set_and_matmul(served):
     rhs = rng.standard_normal((32, 8)).astype(np.float32)
     out = c.paged_matmul("d", "pw", rhs)
     np.testing.assert_allclose(out, m @ rhs, rtol=1e-5, atol=1e-5)
-    # paged TENSOR sets never materialize: remote GET_TENSOR refuses
+    # paged TENSOR sets never materialize: remote GET_TENSOR refuses,
+    # and SCAN_SET rejects cleanly instead of crashing mid-pickle on
+    # the process-local handle (r5 review finding)
     with pytest.raises(Exception, match="[Pp]aged|PAGED"):
         c.get_tensor("d", "pw")
+    with pytest.raises(Exception, match="PAGED matrix"):
+        list(c.get_set_iterator("d", "pw"))
