@@ -334,3 +334,57 @@ def test_dropped_object_set_does_not_recycle_live_set_id(tmp_path):
     got = list(b)
     assert len(got) == 20 and all(r["s"] == "b" for r in got)
     store.close()
+
+
+def test_concurrent_stream_and_append_paged_relation(tmp_path):
+    """The stream-vs-mutation lock, exercised with real threads: an
+    append issued MID-STREAM blocks until the stream drains (readers-
+    preference RWLock), the in-flight stream sees a consistent
+    pre-append snapshot, and a fresh stream afterwards sees the
+    appended rows."""
+    import threading
+    import time as _t
+
+    from netsdb_tpu.relational.table import ColumnTable
+    from netsdb_tpu.storage.store import SetIdentifier
+
+    cfg = Configuration(root_dir=str(tmp_path / "conc"),
+                        page_size_bytes=4096, page_pool_bytes=16384)
+    c = Client(cfg)
+    c.create_database("d")
+    c.create_set("d", "t", type_name="table", storage="paged")
+    n0 = 5000
+    c.send_table("d", "t", ColumnTable(
+        {"a": np.arange(n0, dtype=np.int32),
+         "b": np.ones(n0, np.float32)}))
+    pc = c.store.get_items(SetIdentifier("d", "t"))[0]
+
+    appended = threading.Event()
+
+    def do_append():
+        c.store.append_table(
+            SetIdentifier("d", "t"),
+            ColumnTable({"a": np.arange(n0, n0 + 1000, dtype=np.int32),
+                         "b": np.ones(1000, np.float32)}))
+        appended.set()
+
+    seen = 0
+    t = None
+    stream = pc.stream_tables(prefetch=0)
+    try:
+        for chunk in stream:
+            seen += int(np.asarray(chunk.mask()).sum())
+            if t is None:
+                t = threading.Thread(target=do_append)
+                t.start()
+                _t.sleep(0.1)
+                # the append must still be blocked mid-stream
+                assert not appended.is_set()
+    finally:
+        stream.close()
+    t.join(timeout=30)
+    assert appended.is_set(), "append never completed after the stream"
+    assert seen == n0  # consistent pre-append snapshot
+    total = sum(int(np.asarray(ch.mask()).sum())
+                for ch in pc.stream_tables(prefetch=0))
+    assert total == n0 + 1000
