@@ -144,6 +144,15 @@ def _cmd_ooc_bench(args) -> int:
     return 0
 
 
+def _cmd_paged_api_bench(args) -> int:
+    from netsdb_tpu.relational.outofcore import bench_paged_set_api
+
+    print(json.dumps(bench_paged_set_api(rows=args.rows,
+                                         pool_bytes=args.pool_mb << 20),
+                     default=str))
+    return 0
+
+
 def _cmd_lsh_bench(args) -> int:
     from netsdb_tpu.dedup.lsh import bench_lsh_zoo
 
@@ -765,6 +774,13 @@ def main(argv=None) -> int:
     p.add_argument("--rows", type=int, default=60_000_000)
     p.add_argument("--pool-mb", type=int, default=1024)
 
+    p = sub.add_parser("paged-api-bench",
+                       help="SF10-scale q01 + one-pass grace q03 through "
+                            "the SET-API paged path (create_set(storage="
+                            "'paged') + suite/q03 sinks) under a pool cap")
+    p.add_argument("--rows", type=int, default=60_000_000)
+    p.add_argument("--pool-mb", type=int, default=1024)
+
     p = sub.add_parser("lsh-bench",
                        help="LSH dedup index over a synthetic model zoo")
     p.add_argument("--models", type=int, default=100)
@@ -780,7 +796,9 @@ def main(argv=None) -> int:
             "autotune": _cmd_autotune,
             "transformer-bench": _cmd_transformer_bench,
             "reddit-bench": _cmd_reddit_bench,
-            "ooc-bench": _cmd_ooc_bench, "lsh-bench": _cmd_lsh_bench,
+            "ooc-bench": _cmd_ooc_bench,
+            "paged-api-bench": _cmd_paged_api_bench,
+            "lsh-bench": _cmd_lsh_bench,
             "ab-bench": _cmd_ab_bench,
             "serve": _cmd_serve, "serve-bench": _cmd_serve_bench,
             "demo-ff": _cmd_demo_ff, "tpch": _cmd_tpch,
