@@ -171,11 +171,14 @@ class TransformerLayerModel:
             return self.forward(p, x, causal=causal)
 
         g1 = Join(ScanSet(self.db, input_set), ScanSet(self.db, "w_qkv"),
-                  fn=lambda a, b: (a, b), label="gather:w_qkv")
+                  fn=lambda a, b: (a, b), label="gather:w_qkv",
+                  passthrough=True)
         g2 = Join(g1, ScanSet(self.db, "w_out"),
-                  fn=lambda a, b: a + (b,), label="gather:w_out")
+                  fn=lambda a, b: a + (b,), label="gather:w_out",
+                  passthrough=True)
         g3 = Join(g2, ScanSet(self.db, "w_up"),
-                  fn=lambda a, b: a + (b,), label="gather:w_up")
+                  fn=lambda a, b: a + (b,), label="gather:w_up",
+                  passthrough=True)
         # the traced body CLOSES OVER the mesh, so the compiled-plan
         # cache key (built from labels) must pin the mesh identity —
         # axis names, shape AND device ids — or a same-shaped DAG built

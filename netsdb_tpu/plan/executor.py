@@ -51,6 +51,27 @@ _compiled_cache: "OrderedDict[str, Any]" = OrderedDict()
 _cache_lock = threading.Lock()
 
 
+def _cached_jit(key: str, fn) -> Any:
+    """compiled-cache get-or-insert with the ONE LRU discipline (all
+    three call sites: fold steps, eager traceable nodes, whole-plan
+    programs). The wrapper is published BEFORE its first call, so
+    concurrent serve-layer threads racing the same cold key all call
+    ONE jitted wrapper (jax dedups the trace/compile internally)
+    instead of compiling N identical programs."""
+    with _cache_lock:
+        cached = _compiled_cache.get(key)
+        if cached is not None:
+            _compiled_cache.move_to_end(key)
+            return cached
+    jfn = jax.jit(fn)
+    with _cache_lock:
+        jfn = _compiled_cache.setdefault(key, jfn)
+        _compiled_cache.move_to_end(key)
+        while len(_compiled_cache) > _COMPILED_CACHE_CAP:
+            _compiled_cache.popitem(last=False)
+    return jfn
+
+
 def _is_traceable(node: Computation) -> bool:
     """Host-object nodes can't go under jit: equi-joins/group-bys over
     Python records and predicate filters stay eager."""
@@ -339,17 +360,7 @@ def _execute_streamed(client, plan: LogicalPlan, scan_values: Dict[int, Any],
         def step_jit(pidx, step):
             key = (f"fold::{job_name}::{plan_key}::"
                    f"n{topo_pos[node.node_id]}::{node.label}::{pidx}")
-            with _cache_lock:
-                fn = _compiled_cache.get(key)
-                if fn is not None:
-                    _compiled_cache.move_to_end(key)
-                    return fn
-            fn = jax.jit(step)
-            with _cache_lock:
-                fn = _compiled_cache.setdefault(key, fn)
-                while len(_compiled_cache) > _COMPILED_CACHE_CAP:
-                    _compiled_cache.popitem(last=False)
-            return fn
+            return _cached_jit(key, step)
         return step_jit
 
     values: Dict[int, Any] = dict(scan_values)
@@ -425,26 +436,21 @@ def _execute_streamed(client, plan: LogicalPlan, scan_values: Dict[int, Any],
             in_vals = [demote(v) for v in in_vals]
         fn = getattr(node, "fn", None)
         if (fn is not None and _is_traceable(node)
-                and isinstance(node, (Apply, Join))
+                and isinstance(node, (Apply, Join, Aggregate))
+                and not getattr(node, "passthrough", False)
                 and _jit_safe_values(in_vals)):
             # traceable fn over table/tensor values: compile it like
             # the resident whole-plan path would, instead of eager
             # per-op dispatch (each unjitted op costs a device RTT —
             # a 15M-row q03 build filter measured minutes eager vs
-            # seconds compiled); cached with the fold-step discipline
+            # seconds compiled). Passthrough/gather nodes are EXCLUDED:
+            # jitting a pure restructuring fn would device-copy (and,
+            # for host-assembled tables, device-UPLOAD) everything it
+            # forwards — defeating the bounded-device-memory
+            # discipline the host fallbacks exist for.
             key = (f"eager::{job_name}::{plan_key}::"
                    f"n{topo_pos[node.node_id]}")
-            with _cache_lock:
-                jfn = _compiled_cache.get(key)
-                if jfn is not None:
-                    _compiled_cache.move_to_end(key)
-            if jfn is None:
-                jfn = jax.jit(fn)
-                with _cache_lock:
-                    jfn = _compiled_cache.setdefault(key, jfn)
-                    while len(_compiled_cache) > _COMPILED_CACHE_CAP:
-                        _compiled_cache.popitem(last=False)
-            values[node.node_id] = jfn(*in_vals)
+            values[node.node_id] = _cached_jit(key, fn)(*in_vals)
             continue
         values[node.node_id] = node.evaluate(*in_vals)
     return values
@@ -572,44 +578,28 @@ def execute_computations(
         # as constants, so a cached callable would pin stale data.
         cacheable = len(tensor_scans) == num_scans
         cache_key = f"{job_name}::{plan.cache_key()}"
-        fn = None
-        if cacheable:
-            with _cache_lock:
-                if cache_key in _compiled_cache:
-                    fn = _compiled_cache[cache_key]
-                    _compiled_cache.move_to_end(cache_key)
-        if fn is None:
-            # canonical arg keys (topo position) so independently built
-            # DAGs of the same shape hit one traced signature; host-object
-            # scan values are closed over (non-cacheable jobs only)
-            canon = {n.node_id: i for i, n in enumerate(plan.topo)}
-            host_values = {k: v for k, v in scan_values.items()
-                           if not isinstance(v, (BlockedTensor, ColumnTable,
-                                                 jax.Array))}
+        # canonical arg keys (topo position) so independently built
+        # DAGs of the same shape hit one traced signature; host-object
+        # scan values are closed over (non-cacheable jobs only)
+        canon = {n.node_id: i for i, n in enumerate(plan.topo)}
+        host_values = {k: v for k, v in scan_values.items()
+                       if not isinstance(v, (BlockedTensor, ColumnTable,
+                                             jax.Array))}
 
-            def run(tensor_args: Dict[int, BlockedTensor],
-                    _plan=plan, _canon=canon, _host=host_values):
-                merged = dict(_host)
-                for n in _plan.topo:
-                    if isinstance(n, ScanSet) and _canon[n.node_id] in tensor_args:
-                        merged[n.node_id] = tensor_args[_canon[n.node_id]]
-                values = _evaluate(_plan, merged)
-                return [values[s.inputs[0].node_id] for s in _plan.sinks]
+        def run(tensor_args: Dict[int, BlockedTensor],
+                _plan=plan, _canon=canon, _host=host_values):
+            merged = dict(_host)
+            for n in _plan.topo:
+                if isinstance(n, ScanSet) and _canon[n.node_id] in tensor_args:
+                    merged[n.node_id] = tensor_args[_canon[n.node_id]]
+            values = _evaluate(_plan, merged)
+            return [values[s.inputs[0].node_id] for s in _plan.sinks]
 
-            fn = jax.jit(run)
-            if cacheable:
-                # publish the wrapper BEFORE the first call: concurrent
-                # serve-layer threads racing the same cold plan then all
-                # call ONE jitted wrapper (jax dedups the trace/compile
-                # internally) instead of compiling N identical programs
-                with _cache_lock:
-                    if cache_key in _compiled_cache:
-                        fn = _compiled_cache[cache_key]  # lost the race
-                        _compiled_cache.move_to_end(cache_key)
-                    else:
-                        _compiled_cache[cache_key] = fn
-                        while len(_compiled_cache) > _COMPILED_CACHE_CAP:
-                            _compiled_cache.popitem(last=False)
+        # _cached_jit publishes the wrapper BEFORE its first call, so
+        # concurrent serve-layer threads racing the same cold plan all
+        # call ONE jitted wrapper (non-cacheable jobs close over host
+        # data and must not be shared)
+        fn = _cached_jit(cache_key, run) if cacheable else jax.jit(run)
         topo_pos = {n.node_id: i for i, n in enumerate(plan.topo)}
         canon_args = {topo_pos[n.node_id]: scan_values[n.node_id]
                       for n in tensor_scans}
