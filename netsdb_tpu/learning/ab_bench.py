@@ -88,27 +88,43 @@ def bench_placement_ab(width: int = 1100, batch: int = 4096,
     uid = os.getuid() if hasattr(os, "getuid") else "u"
     cache_dir = os.path.join(tempfile.gettempdir(),
                              f"netsdb_ab_cache_{uid}")
-    chosen = []
-    for _ in range(rounds):
+    def one_round(advisor_on: bool = True, force_block=None):
         root = tempfile.mkdtemp(prefix="ab_bench_")
         try:
             client = Client(Configuration(
                 root_dir=root, compilation_cache_dir=cache_dir))
-            client.set_placement_advisor(advisor, key=job)
+            if advisor_on:
+                client.set_placement_advisor(advisor, key=job)
             model = FFModel(db="ab")
             model.setup(client)  # create_set consults the advisor HERE
+            if force_block is not None:
+                model.block = tuple(force_block)
             cand = next(c for c in advisor.candidates
                         if tuple(c.specs["block"]) == model.block)
             model.load_weights(client, w1, b1, wo, bo)
             model.load_inputs(client, x)
-            t0 = time.perf_counter()
-            out = model.inference(client)
-            np.asarray(out.to_dense())  # sync
-            elapsed = time.perf_counter() - t0
-            advisor.record(job, cand, elapsed)
-            chosen.append((cand.label, round(elapsed, 4)))
+            model.inference(client)  # warm this arm's program
+            # min-of-3: the noise-robust location estimate for a
+            # milliseconds-scale job on a possibly loaded machine (a
+            # single inflated wall on the explore round would teach
+            # the advisor the wrong winner)
+            elapsed = float("inf")
+            for _ in range(3):
+                t0 = time.perf_counter()
+                out = model.inference(client)
+                np.asarray(out.to_dense())  # sync
+                elapsed = min(elapsed, time.perf_counter() - t0)
+            return cand, elapsed
         finally:
             shutil.rmtree(root, ignore_errors=True)
+
+    for cand in advisor.candidates:  # warm both compiles, unrecorded
+        one_round(advisor_on=False, force_block=cand.specs["block"])
+    chosen = []
+    for _ in range(rounds):
+        cand, elapsed = one_round()
+        advisor.record(job, cand, elapsed)
+        chosen.append((cand.label, round(elapsed, 4)))
 
     means = {c.label: hdb.mean_elapsed(job, c.label)
              for c in advisor.candidates}
