@@ -15,30 +15,64 @@ unchanged against either client.
 
 from __future__ import annotations
 
+import dataclasses
+import random
 import socket
 import threading
+import time
+import uuid
 from typing import Any, Dict, Iterator, List, Optional, Sequence, Tuple
 
 import numpy as np
 
+from netsdb_tpu.serve.errors import (  # noqa: F401 — re-exported API
+    AdmissionFullError,
+    AuthError,
+    ConnectionLostError,
+    CorruptFrameError,
+    DeadlineExceededError,
+    FollowerDegradedError,
+    RemoteError,
+    RemoteTimeoutError,
+    RetryableRemoteError,
+    classify_remote,
+)
 from netsdb_tpu.serve.protocol import (
     CODEC_MSGPACK,
     CODEC_PICKLE,
+    IDEMPOTENCY_KEY,
+    MUTATING_TYPES,
     MsgType,
     ProtocolError,
     recv_frame,
     send_frame,
     tensor_to_wire,
 )
+from netsdb_tpu.utils.timing import deadline_after, seconds_left
 
 
-class RemoteError(RuntimeError):
-    """A server-side handler raised; carries the remote traceback."""
+@dataclasses.dataclass
+class RetryPolicy:
+    """Exponential backoff with jitter for retryable failures.
 
-    def __init__(self, kind: str, message: str, remote_traceback: str = ""):
-        super().__init__(f"{kind}: {message}")
-        self.kind = kind
-        self.remote_traceback = remote_traceback
+    ``deadline_s`` bounds one LOGICAL request across all its attempts
+    (a per-request deadline, measured on the monotonic clock); when the
+    next backoff would cross it, :class:`DeadlineExceededError` is
+    raised instead of sleeping. ``max_attempts=1`` disables retries
+    (the follower mirror links use this: a mirror failure must surface
+    immediately so the leader can evict + resync, not be papered over)."""
+
+    max_attempts: int = 4
+    base_delay_s: float = 0.05
+    max_delay_s: float = 2.0
+    multiplier: float = 2.0
+    jitter: float = 0.5
+    deadline_s: Optional[float] = None
+
+    def backoff_s(self, attempt: int, rng: random.Random) -> float:
+        d = min(self.base_delay_s * self.multiplier ** (attempt - 1),
+                self.max_delay_s)
+        return d * (1.0 - self.jitter * rng.random())
 
 
 class RemoteTableInfo:
@@ -97,7 +131,22 @@ class RemoteClient:
     """``Client(address="host:port")`` returns one of these."""
 
     def __init__(self, address: str, token: Optional[str] = None,
-                 timeout: Optional[float] = None):
+                 timeout: Optional[float] = None,
+                 retry: Optional[RetryPolicy] = None,
+                 chaos=None, seed: Optional[int] = None,
+                 connect_timeout: Optional[float] = None):
+        """``timeout``: socket-level timeout applied to every blocking
+        recv after the handshake (None = block; a hung server then
+        surfaces as :class:`RemoteTimeoutError` instead of a wedged
+        caller). ``connect_timeout`` bounds the dial + handshake
+        separately — a caller that must tolerate slow REPLIES (long
+        jobs) can still refuse to hang on a peer that accepts the TCP
+        connection and then goes silent (defaults to ``timeout``).
+        ``retry``: :class:`RetryPolicy` for retryable failures; the
+        default retries 4 attempts with jittered exponential backoff.
+        ``chaos``: a :class:`~netsdb_tpu.serve.chaos.ChaosInjector`
+        faulting this client's request/reply frames (tests only).
+        ``seed`` seeds the backoff jitter for reproducible schedules."""
         host, _, port = address.rpartition(":")
         self.host = host or "127.0.0.1"
         self.port = int(port)
@@ -105,6 +154,16 @@ class RemoteClient:
         self._lock = threading.Lock()  # one in-flight request per conn
         self._sock: Optional[socket.socket] = None
         self._timeout = timeout
+        self._connect_timeout = (connect_timeout if connect_timeout
+                                 is not None else timeout)
+        self._retry = retry or RetryPolicy()
+        self._chaos = chaos
+        self._rng = random.Random(seed)
+        #: attempts consumed by the most recent logical request (1 = no
+        #: retry) and total retries over this client's lifetime —
+        #: observability for tests and callers tuning policies
+        self.last_attempts = 0
+        self.total_retries = 0
         # thread id that currently drives a streaming reply (scan_stream
         # / chunked pulls) — a nested request from that thread must NOT
         # wait on the lock (self-deadlock) nor write to the streaming
@@ -113,79 +172,193 @@ class RemoteClient:
         self._connect()
 
     # --- transport ----------------------------------------------------
-    def _dial(self) -> socket.socket:
+    def _dial(self, budget_s: Optional[float] = None) -> socket.socket:
         """Open + handshake one connection (the single copy of the
         dial sequence — main connection, one-shot side requests and
-        nested streams all come through here)."""
-        s = socket.create_connection((self.host, self.port),
-                                     timeout=self._timeout)
+        nested streams all come through here). ``budget_s`` caps the
+        connect + handshake below the configured connect timeout — the
+        per-request deadline must bound a hung DIAL too (a blackholed
+        host, or a peer that accepts TCP and never answers HELLO), not
+        just a hung reply."""
+        ct = self._connect_timeout
+        if budget_s is not None:
+            ct = budget_s if ct is None else min(ct, budget_s)
+        s = socket.create_connection((self.host, self.port), timeout=ct)
         try:
             s.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
             send_frame(s, MsgType.HELLO, {"token": self.token})
             typ, reply = recv_frame(s, allow_pickle=False)
             if typ == MsgType.ERR:
-                raise RemoteError(reply.get("error", "Error"),
-                                  reply.get("message", "handshake refused"))
+                # handshake refusals are fatal by construction (auth)
+                raise AuthError(reply.get("error", "AuthError"),
+                                reply.get("message", "handshake refused"))
+            s.settimeout(self._timeout)  # steady-state I/O bound
         except BaseException:
             s.close()
             raise
         return s
 
-    def _connect(self) -> None:
-        self._sock = self._dial()
+    def _connect(self, budget_s: Optional[float] = None) -> None:
+        self._sock = self._dial(budget_s)
 
     def _oneshot_request(self, msg_type: MsgType, payload: Any,
-                         codec: int) -> Any:
+                         codec: int,
+                         io_timeout: Optional[float] = None) -> Any:
         """Issue one request over a throwaway connection — used when the
         caller's thread is mid-stream on the main connection (e.g.
         ``for item in c.scan_stream(...): c.send_data(...)``), which
         must neither block on the held lock nor interleave frames."""
-        s = self._dial()
+        s = self._dial(io_timeout)
         try:
-            send_frame(s, msg_type, payload, codec)
-            typ, reply = recv_frame(s, allow_pickle=True)
+            if io_timeout is not None:
+                s.settimeout(io_timeout)
+            send_frame(s, msg_type, payload, codec, chaos=self._chaos)
+            typ, reply = self._recv_reply(s)
         finally:
             s.close()
         if typ == MsgType.ERR:
-            raise RemoteError(reply.get("error", "Error"),
-                              reply.get("message", ""),
-                              reply.get("traceback", ""))
+            raise classify_remote(reply)
+        return reply
+
+    @staticmethod
+    def _recv_reply(sock) -> Tuple[Any, Any]:
+        """Reply recv with decode failures typed: a body that fails to
+        decode (bit flips on the wire) is the retryable CorruptFrame
+        family, not an anonymous pickle/msgpack exception. Replies may
+        carry host objects (SCAN_SET) → pickle allowed on this side:
+        the client already trusts the server it chose to connect to."""
+        try:
+            return recv_frame(sock, allow_pickle=True)
+        except (ConnectionError, OSError):
+            raise
+        except Exception as e:
+            raise CorruptFrameError(
+                type(e).__name__, f"reply body failed to decode: {e}") from e
+
+    def _request_once(self, msg_type: MsgType, payload: Any, codec: int,
+                      io_timeout: Optional[float] = None) -> Any:
+        """One attempt on the persistent connection. Any mid-request
+        failure leaves the frame stream desynced — a later request
+        would read THIS request's late reply as its own — so the socket
+        is closed and the next attempt re-dials lazily. ``io_timeout``
+        tightens this attempt's socket timeout (the per-request
+        deadline must bound a HUNG attempt, not just the gaps between
+        attempts); the steady-state timeout is restored on success."""
+        with self._lock:
+            if self._sock is None:
+                self._connect(io_timeout)
+            try:
+                if io_timeout is not None:
+                    self._sock.settimeout(io_timeout)
+                send_frame(self._sock, msg_type, payload, codec,
+                           chaos=self._chaos)
+                typ, reply = self._recv_reply(self._sock)
+                if io_timeout is not None:
+                    self._sock.settimeout(self._timeout)
+            except Exception:
+                self._drop_connection()
+                raise
+        if typ == MsgType.ERR:
+            raise classify_remote(reply)
         return reply
 
     def _request(self, msg_type: MsgType, payload: Any,
-                 codec: int = CODEC_MSGPACK) -> Any:
-        if self._stream_owner == threading.get_ident():
-            return self._oneshot_request(msg_type, payload, codec)
-        with self._lock:
-            if self._sock is None:
-                self._connect()
+                 codec: int = CODEC_MSGPACK,
+                 deadline_s: Optional[float] = None) -> Any:
+        """One logical request: attach an idempotency token to mutating
+        frames, then retry retryable failures under the client's
+        :class:`RetryPolicy` and the per-request deadline. Every raised
+        error is typed (:class:`RemoteError` family) — callers never
+        see a bare socket exception."""
+        if msg_type in MUTATING_TYPES and isinstance(payload, dict) \
+                and IDEMPOTENCY_KEY not in payload:
+            # one token per LOGICAL request: every retry resends the
+            # same token, so the server can dedupe a mutation whose
+            # first reply was lost mid-wire
+            payload = dict(payload)
+            payload[IDEMPOTENCY_KEY] = uuid.uuid4().hex
+        oneshot = self._stream_owner == threading.get_ident()
+        policy = self._retry
+        budget_s = deadline_s if deadline_s is not None else policy.deadline_s
+        deadline = deadline_after(budget_s) if budget_s is not None else None
+        attempt = 1
+        while True:
+            self.last_attempts = attempt
+            io_timeout = None  # None = keep the steady-state timeout
+            if deadline is not None:
+                left = seconds_left(deadline)
+                if left <= 0:
+                    raise DeadlineExceededError(
+                        "DeadlineExceeded",
+                        f"request deadline of {budget_s}s already spent "
+                        f"before attempt {attempt}")
+                # the deadline bounds a HUNG attempt too, not just the
+                # backoff gaps: cap this attempt's socket timeout at
+                # the remaining budget
+                io_timeout = left if self._timeout is None \
+                    else min(self._timeout, left)
             try:
-                send_frame(self._sock, msg_type, payload, codec)
-                # replies may carry host objects (SCAN_SET) → pickle
-                # allowed on this side: the client already trusts the
-                # server it chose to connect to
-                typ, reply = recv_frame(self._sock, allow_pickle=True)
-            except Exception:
-                # a mid-request failure (timeout, reset) leaves the
-                # stream desynced — a later request would read THIS
-                # request's late reply as its own. Drop the connection;
-                # the next request reconnects fresh.
-                try:
-                    self._sock.close()
-                finally:
-                    self._sock = None
-                raise
-        if typ == MsgType.ERR:
-            raise RemoteError(reply.get("error", "Error"),
-                              reply.get("message", ""),
-                              reply.get("traceback", ""))
-        return reply
+                if oneshot:
+                    return self._oneshot_request(msg_type, payload, codec,
+                                                 io_timeout=io_timeout)
+                return self._request_once(msg_type, payload, codec,
+                                          io_timeout=io_timeout)
+            except RemoteError as e:
+                if not e.retryable:
+                    raise
+                failure: RemoteError = e
+            except (socket.timeout, TimeoutError) as e:
+                failure = RemoteTimeoutError(type(e).__name__,
+                                             str(e) or "socket timeout")
+            except (ConnectionError, OSError) as e:
+                # includes ProtocolError (desync/truncation) and refused
+                # re-dials — the connection is already dropped, the next
+                # attempt re-dials fresh
+                failure = ConnectionLostError(type(e).__name__, str(e))
+            if attempt >= policy.max_attempts:
+                raise failure
+            delay = policy.backoff_s(attempt, self._rng)
+            if deadline is not None and delay > seconds_left(deadline):
+                raise DeadlineExceededError(
+                    "DeadlineExceeded",
+                    f"request deadline of {budget_s}s exhausted after "
+                    f"{attempt} attempt(s); last failure: {failure}",
+                ) from failure
+            time.sleep(delay)
+            attempt += 1
+            self.total_retries += 1
+
+    def _drop_connection(self) -> None:
+        """Tear down the persistent socket (idempotent, never raises);
+        the next request re-dials lazily. Callers must hold ``_lock``
+        or be the only thread touching the client."""
+        s, self._sock = self._sock, None
+        if s is not None:
+            try:
+                s.close()
+            except OSError:
+                pass
+
+    def _force_close(self) -> None:
+        """Unstick an in-flight request from ANOTHER thread: shut the
+        socket down without taking ``_lock`` (the stuck thread holds
+        it), making its blocking recv fail immediately. Used by the
+        leader's follower eviction so a hung mirror can never wedge the
+        sender thread."""
+        s = self._sock
+        if s is not None:
+            try:
+                s.shutdown(socket.SHUT_RDWR)
+            except OSError:
+                pass
+            try:
+                s.close()
+            except OSError:
+                pass
 
     def close(self) -> None:
         with self._lock:
-            if self._sock is not None:
-                self._sock.close()
-                self._sock = None
+            self._drop_connection()
 
     def __enter__(self):
         return self
@@ -204,9 +377,10 @@ class RemoteClient:
             try:
                 send_frame(self._sock, MsgType.SHUTDOWN, {})
                 recv_frame(self._sock, allow_pickle=False)
+            except (ConnectionError, OSError):
+                pass  # the daemon may die before acking — that's success
             finally:
-                self._sock.close()
-                self._sock = None
+                self._drop_connection()
 
     # --- DDL (same facade as Client) ----------------------------------
     def create_database(self, db: str) -> None:
@@ -422,9 +596,7 @@ class RemoteClient:
             if typ == MsgType.STREAM_END:
                 return
             if typ == MsgType.ERR:
-                raise RemoteError(reply.get("error", "Error"),
-                                  reply.get("message", ""),
-                                  reply.get("traceback", ""))
+                raise classify_remote(reply)
             yield reply
 
     def _stream(self, msg_type: MsgType, payload: Any) -> Iterator[Any]:
@@ -459,11 +631,8 @@ class RemoteClient:
             raise
         finally:
             self._stream_owner = None
-            if not done and self._sock is not None:
-                try:
-                    self._sock.close()
-                finally:
-                    self._sock = None
+            if not done:
+                self._drop_connection()
             self._lock.release()
 
     def dedup_resident(self, sets: Sequence[Tuple[str, str]],

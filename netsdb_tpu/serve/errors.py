@@ -1,0 +1,162 @@
+"""Typed failure taxonomy for the serve control plane.
+
+The reference surfaces every RPC failure as an ``errMsg`` string the
+caller string-matches (``src/communication/headers/PDBCommunicator.h``);
+we instead split faults into two machine-readable families so the
+client can decide mechanically:
+
+* **retryable** — the request may not have been observed, or the
+  condition is transient: connection reset, mid-frame truncation,
+  corrupt frame, admission queue full, follower degraded/resyncing.
+  :class:`RemoteClient` retries these with exponential backoff +
+  jitter, bounded by a per-request deadline. Mutating frames carry an
+  idempotency token so a retry after an ambiguous outcome (the server
+  may have applied the mutation but the reply was lost) is deduplicated
+  server-side instead of double-applied.
+* **fatal** — the request was observed and deterministically refused:
+  handler errors, protocol violations, refused codecs, bad auth.
+  Retrying would yield the same answer; the error is raised immediately.
+
+Server side, handlers raise :class:`ServeFault` subclasses whose
+``retryable`` flag crosses the wire in the ERR payload; client side,
+:func:`classify_remote` rebuilds the matching :class:`RemoteError`
+subclass from the frame. Both halves live in one module so the kind
+names cannot drift apart.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict
+
+
+# --- server-side faults ------------------------------------------------
+
+class ServeFault(Exception):
+    """A fault a server handler raises deliberately. ``retryable``
+    rides the ERR payload so clients classify without string-matching;
+    ``kind`` is the wire name (defaults to the class name)."""
+
+    retryable = False
+
+    @property
+    def kind(self) -> str:
+        return type(self).__name__
+
+
+class AdmissionFull(ServeFault):
+    """The bounded job-admission queue did not free a slot within the
+    admission timeout — back off and retry (the reference's
+    QuerySchedulerServer would park the job; we refuse typed instead of
+    wedging a handler thread)."""
+
+    retryable = True
+
+
+class FollowerDegraded(ServeFault):
+    """A follower failed mid-mirror (or a resync is in progress). The
+    leader keeps serving from its own store; the follower is evicted
+    and resynced in the background. When the local mutation already
+    applied, ``local_result`` carries its reply so the idempotent retry
+    returns success without re-executing."""
+
+    retryable = True
+
+    def __init__(self, *args):
+        super().__init__(*args)
+        self.local_result = None
+
+
+class CorruptFrame(ServeFault):
+    """A frame arrived but its body failed to decode (bit flips, torn
+    writes). The request was never executed, so a resend is safe."""
+
+    retryable = True
+
+
+class RequestInFlight(ServeFault):
+    """A duplicate idempotency token arrived while the original request
+    is still executing; the retry should back off and re-ask (it will
+    then hit the completed-result cache)."""
+
+    retryable = True
+
+
+# --- client-side errors ------------------------------------------------
+
+class RemoteError(RuntimeError):
+    """Base: a request failed. ``kind`` is the server-side exception
+    class name (or the local failure type), ``remote_traceback`` the
+    server traceback when one crossed the wire. Fatal unless a subclass
+    says otherwise."""
+
+    retryable = False
+
+    def __init__(self, kind: str, message: str, remote_traceback: str = ""):
+        super().__init__(f"{kind}: {message}")
+        self.kind = kind
+        self.remote_traceback = remote_traceback
+
+
+class RetryableRemoteError(RemoteError):
+    """The transient family — safe to resend (mutations are deduped
+    server-side via the idempotency token)."""
+
+    retryable = True
+
+
+class ConnectionLostError(RetryableRemoteError):
+    """The transport died mid-request (reset, refused dial, peer closed
+    mid-frame). The outcome is ambiguous: the server may or may not
+    have executed the request — exactly what idempotency tokens are
+    for."""
+
+
+class RemoteTimeoutError(RetryableRemoteError):
+    """The socket-level timeout expired waiting for the peer."""
+
+
+class AdmissionFullError(RetryableRemoteError):
+    """Server-side :class:`AdmissionFull` — job queue saturated."""
+
+
+class FollowerDegradedError(RetryableRemoteError):
+    """Server-side :class:`FollowerDegraded` — a follower was evicted
+    mid-request or a resync holds the mutation path. The leader applied
+    the local mutation; the idempotent retry returns its result."""
+
+
+class CorruptFrameError(RetryableRemoteError):
+    """Server-side :class:`CorruptFrame` — the frame body failed to
+    decode; the request never ran."""
+
+
+class AuthError(RemoteError):
+    """Handshake refused — fatal, retrying cannot help."""
+
+
+class DeadlineExceededError(RemoteError):
+    """The per-request deadline expired before a retry could succeed.
+    Deliberately NOT retryable: the budget is spent; the caller decides
+    whether to re-issue with a fresh deadline."""
+
+
+_KIND_MAP: Dict[str, type] = {
+    "AdmissionFull": AdmissionFullError,
+    "FollowerDegraded": FollowerDegradedError,
+    "CorruptFrame": CorruptFrameError,
+    "AuthError": AuthError,
+}
+
+
+def classify_remote(reply: Dict[str, Any]) -> RemoteError:
+    """ERR frame payload → the matching typed error. Known kinds map to
+    their dedicated class; unknown kinds fall back on the frame's
+    ``retryable`` flag (so new server faults degrade gracefully to the
+    right *family* on old clients)."""
+    kind = reply.get("error", "Error")
+    message = reply.get("message", "")
+    tb = reply.get("traceback", "")
+    cls = _KIND_MAP.get(kind)
+    if cls is None:
+        cls = RetryableRemoteError if reply.get("retryable") else RemoteError
+    return cls(kind, message, tb)

@@ -27,8 +27,9 @@ from __future__ import annotations
 
 import socket
 import struct
+import time
 from enum import IntEnum
-from typing import Any, Tuple
+from typing import Any, Optional, Tuple
 
 import msgpack
 import numpy as np
@@ -100,6 +101,28 @@ class MsgType(IntEnum):
     # stored matrix paged through the device (larger-than-HBM weights
     # behind the daemon; ref pipelines over pinned weight pages)
     PAGED_MATMUL = 43
+    # fault tolerance: a leader tells an evicted follower to rebuild
+    # its store from a checkpoint snapshot (storage/checkpoint.py
+    # save_store/load_store) before being readmitted to the mirror set
+    RESYNC_FOLLOWER = 50
+
+
+#: payload key carrying the client-generated idempotency token on
+#: mutating frames. The server caches the completed reply per token, so
+#: a retry after an ambiguous failure (reply lost mid-wire) returns the
+#: first execution's result instead of double-applying the mutation.
+IDEMPOTENCY_KEY = "__idem__"
+
+#: frame types that mutate daemon state or launch jobs — the set the
+#: client attaches idempotency tokens to before retrying. Reads are
+#: naturally idempotent and retried bare.
+MUTATING_TYPES = frozenset({
+    MsgType.CREATE_DATABASE, MsgType.CREATE_SET, MsgType.REMOVE_SET,
+    MsgType.CLEAR_SET, MsgType.REGISTER_TYPE, MsgType.SEND_DATA,
+    MsgType.SEND_MATRIX, MsgType.ADD_SHARED_MAPPING, MsgType.FLUSH_DATA,
+    MsgType.LOAD_SET, MsgType.EXECUTE_COMPUTATIONS, MsgType.EXECUTE_PLAN,
+    MsgType.DEDUP_RESIDENT, MsgType.RESYNC_FOLLOWER,
+})
 
 
 class ProtocolError(ConnectionError):
@@ -154,34 +177,88 @@ def decode_body(body: bytes, codec: int, allow_pickle: bool) -> Any:
 
 
 def send_frame(sock: socket.socket, msg_type: int, payload: Any,
-               codec: int = CODEC_MSGPACK) -> None:
+               codec: int = CODEC_MSGPACK, chaos=None) -> None:
+    """``chaos``: optional :class:`~netsdb_tpu.serve.chaos.ChaosInjector`
+    that may drop/delay/corrupt/truncate this frame (tests only; the
+    production path pays one ``is None`` check)."""
     body = encode_body(payload, codec)
-    sock.sendall(_HEADER.pack(MAGIC, codec, int(msg_type), len(body)))
+    header = _HEADER.pack(MAGIC, codec, int(msg_type), len(body))
+    if chaos is not None:
+        header, body = chaos.on_send(sock, int(msg_type), header, body)
+    sock.sendall(header)
     sock.sendall(body)
 
 
-def _recv_exact(sock: socket.socket, n: int) -> memoryview:
+def _recv_exact(sock: socket.socket, n: int,
+                mid_timeout: Optional[float] = None,
+                started: bool = False) -> memoryview:
+    """Read exactly ``n`` bytes. ``mid_timeout`` is a CUMULATIVE
+    deadline on finishing the read once it has started (``started=True``
+    means the frame is already mid-flight, so the clock runs from byte
+    0): an idle connection may block indefinitely awaiting the next
+    frame, but once bytes flow the remainder must land within the
+    budget — a peer trickling one byte per near-timeout gap cannot hold
+    the thread past the deadline. Expiry raises
+    :class:`ProtocolError`, never a bare socket.timeout."""
     buf = bytearray(n)
     view = memoryview(buf)
     got = 0
-    while got < n:
-        r = sock.recv_into(view[got:], n - got)
-        if r == 0:
-            raise ProtocolError("peer closed mid-frame")
-        got += r
+    old_timeout: Any = False  # sentinel: False = not overridden
+    deadline = None
+    try:
+        if started and mid_timeout is not None:
+            old_timeout = sock.gettimeout()
+            deadline = time.monotonic() + mid_timeout
+        while got < n:
+            if deadline is not None:
+                left = deadline - time.monotonic()
+                if left <= 0:
+                    raise ProtocolError(
+                        f"peer stalled mid-frame ({n - got} of {n} bytes "
+                        f"still missing after {mid_timeout}s)")
+                sock.settimeout(left)
+            try:
+                r = sock.recv_into(view[got:], n - got)
+            except socket.timeout:
+                if old_timeout is False:
+                    raise  # the caller's own socket timeout, not ours
+                raise ProtocolError(
+                    f"peer stalled mid-frame (> {mid_timeout}s)")
+            if r == 0:
+                raise ProtocolError("peer closed mid-frame")
+            got += r
+            if got < n and mid_timeout is not None and old_timeout is False:
+                # first bytes landed — the frame has started; bound the
+                # remainder with one shared deadline
+                old_timeout = sock.gettimeout()
+                deadline = time.monotonic() + mid_timeout
+    finally:
+        if old_timeout is not False:
+            sock.settimeout(old_timeout)
     return memoryview(buf)
 
 
-def recv_frame_raw(sock: socket.socket) -> Tuple[MsgType, int, bytes]:
+def recv_frame_raw(sock: socket.socket, chaos=None,
+                   mid_frame_timeout: Optional[float] = None,
+                   ) -> Tuple[MsgType, int, bytes]:
     """Receive one frame without decoding — servers decode separately so
-    a refused codec becomes an ERR reply, not a dropped connection."""
-    header = _recv_exact(sock, _HEADER.size)
+    a refused codec becomes an ERR reply, not a dropped connection.
+
+    ``mid_frame_timeout`` is the deadline-discipline knob: waiting for
+    a frame to START may block (idle persistent connection), but once
+    the first header byte lands the rest of header + body must arrive
+    within the timeout or the read fails typed (server worker threads
+    pass this so a hung peer can never wedge a handler thread)."""
+    if chaos is not None:
+        chaos.on_recv(sock)
+    header = _recv_exact(sock, _HEADER.size, mid_timeout=mid_frame_timeout)
     magic, codec, msg_type, body_len = _HEADER.unpack(header)
     if magic != MAGIC:
         raise ProtocolError(f"bad frame magic {magic:#x}")
     if body_len > MAX_FRAME_BYTES:
         raise ProtocolError(f"frame of {body_len} bytes exceeds cap")
-    body = _recv_exact(sock, body_len)
+    body = _recv_exact(sock, body_len, mid_timeout=mid_frame_timeout,
+                       started=True)
     try:
         typ = MsgType(msg_type)
     except ValueError:
@@ -191,9 +268,11 @@ def recv_frame_raw(sock: socket.socket) -> Tuple[MsgType, int, bytes]:
     return typ, codec, bytes(body)
 
 
-def recv_frame(sock: socket.socket,
-               allow_pickle: bool = False) -> Tuple[MsgType, Any]:
-    msg_type, codec, body = recv_frame_raw(sock)
+def recv_frame(sock: socket.socket, allow_pickle: bool = False,
+               chaos=None, mid_frame_timeout: Optional[float] = None,
+               ) -> Tuple[MsgType, Any]:
+    msg_type, codec, body = recv_frame_raw(
+        sock, chaos=chaos, mid_frame_timeout=mid_frame_timeout)
     return msg_type, decode_body(body, codec, allow_pickle)
 
 

@@ -23,18 +23,27 @@ import importlib
 import importlib.util
 import inspect
 import itertools
+import os
 import socket
 import threading
 import time
 import traceback
+from collections import OrderedDict
 from typing import Any, Callable, Dict, Optional, Tuple
 
 import numpy as np
 
 from netsdb_tpu.client import Client
 from netsdb_tpu.config import Configuration, DEFAULT_CONFIG
+from netsdb_tpu.serve.errors import (
+    AdmissionFull,
+    CorruptFrame,
+    FollowerDegraded,
+    RequestInFlight,
+)
 from netsdb_tpu.serve.protocol import (
     CODEC_MSGPACK,
+    IDEMPOTENCY_KEY,
     MsgType,
     ProtocolError,
     decode_body,
@@ -44,6 +53,7 @@ from netsdb_tpu.serve.protocol import (
     tensor_from_wire,
 )
 from netsdb_tpu.storage.store import SetIdentifier
+from netsdb_tpu.utils.timing import deadline_after, seconds_left, wall_now
 
 
 def resolve_entry_point(entry: str, source: Optional[str] = None) -> Any:
@@ -120,9 +130,10 @@ class _FollowerLink:
     handler) runs on. ``submit`` returns a record whose ``done`` event
     fires when the follower acked (or errored)."""
 
-    def __init__(self, client):
+    def __init__(self, addr: str, client):
         import queue
 
+        self.addr = addr
         self.client = client
         self.q: "queue.Queue" = queue.Queue()
         # submit/close are atomic under this lock, so every real item
@@ -137,19 +148,25 @@ class _FollowerLink:
         rec: Dict[str, Any] = {"done": threading.Event()}
         with self._lk:
             if self._closed:
-                rec["error"] = (f"{self.client.host}:{self.client.port}: "
-                                f"follower link closed (daemon shutdown)")
+                rec["error"] = (f"{self.addr}: follower link closed "
+                                f"(evicted or daemon shutdown)")
                 rec["done"].set()
                 return rec
             self.q.put((typ, payload, codec, rec))
         return rec
 
-    def close(self) -> None:
+    def close(self, abort: bool = False) -> None:
+        """Stop the drain thread. ``abort=True`` additionally tears the
+        client socket down from this thread, so a drain blocked in a
+        recv on a hung follower fails immediately instead of holding
+        mirror records (and their waiters) forever — the eviction
+        path."""
         with self._lk:
-            if self._closed:
-                return
-            self._closed = True
-            self.q.put(None)
+            if not self._closed:
+                self._closed = True
+                self.q.put(None)
+        if abort:
+            self.client._force_close()
 
     def _drain(self) -> None:
         while True:
@@ -157,13 +174,76 @@ class _FollowerLink:
             if item is None:
                 return
             typ, payload, codec, rec = item
+            if self._closed:
+                # evicted mid-queue: items behind the failed one must
+                # fail fast, NOT re-dial the dead follower (the client
+                # would reconnect with no timeout and could hang this
+                # thread forever, un-abortable — the link is done)
+                rec["error"] = (f"{self.addr}: follower link closed "
+                                f"(evicted) — frame not forwarded")
+                rec["done"].set()
+                continue
             try:
                 rec["reply"] = self.client._request(typ, payload, codec)
             except Exception as e:  # noqa: BLE001 — surfaced by caller
-                rec["error"] = (f"{self.client.host}:{self.client.port}: "
-                                f"{type(e).__name__}: {e}")
+                rec["error"] = (f"{self.addr}: {type(e).__name__}: {e}")
             finally:
                 rec["done"].set()
+
+
+class _IdempotencyCache:
+    """Completed-reply cache keyed by client idempotency token — the
+    server half of the at-most-once contract for mutating frames. A
+    retry whose original is still executing parks on its event instead
+    of re-running the handler (double-apply is the failure mode this
+    whole class exists to prevent); a retry of a completed request gets
+    the cached reply frame verbatim."""
+
+    def __init__(self, capacity: int = 4096):
+        self._mu = threading.Lock()
+        self._done: "OrderedDict[str, Tuple]" = OrderedDict()
+        self._inflight: Dict[str, threading.Event] = {}
+        self._capacity = capacity
+
+    def claim(self, token: str, wait_s: float) -> Optional[Tuple]:
+        """Returns the cached (reply_type, reply, codec) when ``token``
+        already completed; None when the caller now OWNS execution (it
+        must call :meth:`finish` or :meth:`abort`). Raises
+        :class:`RequestInFlight` when the original execution is still
+        running after ``wait_s`` — the client backs off and retries."""
+        deadline = deadline_after(wait_s)
+        while True:
+            with self._mu:
+                if token in self._done:
+                    self._done.move_to_end(token)
+                    return self._done[token]
+                ev = self._inflight.get(token)
+                if ev is None:
+                    self._inflight[token] = threading.Event()
+                    return None
+            left = seconds_left(deadline)
+            if left <= 0 or not ev.wait(left):
+                raise RequestInFlight(
+                    f"duplicate request {token[:8]}… still executing "
+                    f"after {wait_s}s")
+            # original finished (or aborted) — loop to re-check
+
+    def finish(self, token: str, result: Tuple) -> None:
+        with self._mu:
+            self._done[token] = result
+            while len(self._done) > self._capacity:
+                self._done.popitem(last=False)
+            ev = self._inflight.pop(token, None)
+        if ev is not None:
+            ev.set()
+
+    def abort(self, token: str) -> None:
+        """The execution failed without a durable effect worth caching
+        (transient fault) — release waiters so a retry re-executes."""
+        with self._mu:
+            ev = self._inflight.pop(token, None)
+        if ev is not None:
+            ev.set()
 
 
 class ServeController:
@@ -187,26 +267,79 @@ class ServeController:
                  token: Optional[str] = None,
                  max_jobs: Optional[int] = None,
                  allow_pickle: bool = True,
-                 followers: Optional[list] = None):
+                 followers: Optional[list] = None,
+                 admission_timeout_s: float = 120.0,
+                 frame_timeout_s: float = 30.0,
+                 handshake_timeout_s: float = 10.0,
+                 heartbeat_interval_s: float = 2.0,
+                 heartbeat_timeout_s: float = 5.0,
+                 heartbeat_misses: int = 3,
+                 mirror_ack_timeout_s: Optional[float] = 300.0,
+                 resync_grace_s: float = 30.0,
+                 resync_timeout_s: float = 120.0,
+                 chaos=None, follower_chaos=None):
         """``followers``: addresses of worker daemons (one per other
         jax.distributed process). Every state-mutating/job frame this
         master handles is forwarded to them CONCURRENTLY with local
         execution — all processes then run the same SPMD program in the
         same order, which is what XLA's multi-controller collectives
         require (compilation is a rendezvous; sequential forwarding
-        would deadlock it). The reference's master→worker job flow."""
+        would deadlock it). The reference's master→worker job flow.
+
+        Fault-tolerance knobs (defaults are production-shaped; the
+        chaos tests shrink them):
+
+        * ``admission_timeout_s`` — how long a job waits for an
+          admission slot before the typed retryable ``AdmissionFull``.
+        * ``frame_timeout_s`` — mid-frame recv bound per worker thread
+          (a peer silent mid-frame can never wedge a handler), and the
+          bound a duplicate idempotent request waits for its original.
+        * ``heartbeat_*`` — leader→follower liveness probing over a
+          dedicated connection; ``heartbeat_misses`` consecutive
+          failures evict the follower into the degraded state.
+        * ``mirror_ack_timeout_s`` — bound on waiting for a follower's
+          mirror ack before evicting it (None = wait forever).
+        * ``resync_grace_s`` — how long a mutating frame waits for an
+          in-progress follower resync before the typed retryable
+          ``FollowerDegraded``.
+        * ``chaos``/``follower_chaos`` — explicit
+          :class:`~netsdb_tpu.serve.chaos.ChaosInjector` objects for
+          the client-facing and the leader→follower frame paths
+          (tests only; production pays one ``is None`` check)."""
         self.config = config
         self.host = host
         self.port = port
         self.token = token
         self.allow_pickle = allow_pickle
+        self.admission_timeout_s = admission_timeout_s
+        self.frame_timeout_s = frame_timeout_s
+        self.handshake_timeout_s = handshake_timeout_s
+        self.heartbeat_interval_s = heartbeat_interval_s
+        self.heartbeat_timeout_s = heartbeat_timeout_s
+        self.heartbeat_misses = heartbeat_misses
+        self.mirror_ack_timeout_s = mirror_ack_timeout_s
+        self.resync_grace_s = resync_grace_s
+        self.resync_timeout_s = resync_timeout_s
+        self._chaos = chaos
+        self._follower_chaos = follower_chaos
         # followers dial LAZILY (with retry) on the first mirrored
         # frame: a master may legitimately start before its workers
         # bind, and eager dialing would kill it with a raw
         # ConnectionRefusedError at startup
         self._follower_addrs: list = list(followers or [])
-        self._followers: list = []
-        self._links: list = []  # per-follower ordered sender queues
+        # addr → _FollowerLink (active, mirrored-to) and addr → reason
+        # (degraded: evicted, awaiting reattach+resync). Both guarded
+        # by _followers_mu; a follower address is always in exactly one
+        # of {undialed, active, degraded}.
+        self._links: Dict[str, _FollowerLink] = {}
+        self._degraded: Dict[str, str] = {}
+        self._followers_mu = threading.Lock()
+        # set while a follower resync holds the mutation path; mutating
+        # frames wait for it (bounded by resync_grace_s) then fail typed
+        self._resync_idle = threading.Event()
+        self._resync_idle.set()
+        self._resync_seq = itertools.count(1)
+        self._idem = _IdempotencyCache()
         self.library = Client(config)  # the resident state
         # ORDERING MODEL for mirrored frames (the SPMD argument):
         # - _mirror_lock is held only long enough to ENQUEUE a frame
@@ -240,7 +373,7 @@ class ServeController:
         self._job_seq = itertools.count(1)
         self._jobs: Dict[int, Dict[str, Any]] = {}
         self._jobs_lock = threading.Lock()
-        self._started = time.time()
+        self._started = time.monotonic()  # uptime only — never wall
         self._stop = threading.Event()
         self._listener: Optional[socket.socket] = None
         self._threads: list = []
@@ -271,6 +404,7 @@ class ServeController:
             MsgType.ANALYZE_SET: self._on_analyze_set,
             MsgType.LOCAL_SHARDS: self._on_local_shards,
             MsgType.PAGED_MATMUL: self._on_paged_matmul,
+            MsgType.RESYNC_FOLLOWER: self._on_resync_follower,
         }
 
     # --- lifecycle ----------------------------------------------------
@@ -286,6 +420,11 @@ class ServeController:
                              name="netsdb-serve-accept")
         t.start()
         self._threads.append(t)
+        if self._follower_addrs:
+            h = threading.Thread(target=self._health_loop, daemon=True,
+                                 name="netsdb-serve-health")
+            h.start()
+            self._threads.append(h)
         return self.port
 
     def serve_forever(self) -> None:
@@ -301,7 +440,9 @@ class ServeController:
 
     def shutdown(self) -> None:
         self._stop.set()
-        for link in self._links:
+        with self._followers_mu:
+            links = list(self._links.values())
+        for link in links:
             link.close()
         if self._listener is not None:
             try:
@@ -325,6 +466,12 @@ class ServeController:
         with conn:
             conn.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
             try:
+                # the handshake must complete promptly; after it, the
+                # connection may idle between frames, but once a frame
+                # STARTS the peer must finish it within frame_timeout_s
+                # (recv_frame_raw's mid-frame bound) — a hung peer can
+                # never wedge this worker thread
+                conn.settimeout(self.handshake_timeout_s)
                 typ, hello = recv_frame(conn, allow_pickle=False)
                 if typ != MsgType.HELLO:
                     raise ProtocolError("expected HELLO")
@@ -334,93 +481,419 @@ class ServeController:
                     return
                 send_frame(conn, MsgType.OK, {"server": "netsdb_tpu",
                                               "version": 2})
+                conn.settimeout(None)
             except (ProtocolError, ConnectionError, OSError):
                 return
             while not self._stop.is_set():
                 try:
-                    typ, codec_in, raw = recv_frame_raw(conn)
+                    typ, codec_in, raw = recv_frame_raw(
+                        conn, chaos=self._chaos,
+                        mid_frame_timeout=self.frame_timeout_s)
                 except (ProtocolError, ConnectionError, OSError):
                     return
                 try:
                     payload = decode_body(raw, codec_in, self.allow_pickle)
-                except Exception as e:  # refused codec / corrupt body
-                    try:
-                        send_frame(conn, MsgType.ERR, {
-                            "error": type(e).__name__, "message": str(e)})
-                        continue
-                    except OSError:
+                except ProtocolError as e:
+                    # refused codec — deterministic, fatal to retry
+                    if not self._send_err(conn, e, retryable=False):
                         return
+                    continue
+                except Exception as e:
+                    # body failed to decode: bit flips / torn frame.
+                    # The request never executed, so a resend is safe —
+                    # typed retryable (the chaos corrupt path).
+                    fault = CorruptFrame(f"{type(e).__name__}: {e}")
+                    if not self._send_err(conn, fault, retryable=True):
+                        return
+                    continue
                 if typ == MsgType.SHUTDOWN:
                     send_frame(conn, MsgType.OK, {})
                     self.shutdown()
                     return
-                handler = self.handlers.get(typ)
-                try:
-                    if handler is None:
-                        raise ProtocolError(f"no handler for {typ!r}")
-                    if self._follower_addrs and typ in self.MIRRORED:
-                        out = self._run_mirrored(typ, payload, codec_in,
-                                                 handler)
-                    else:
-                        out = handler(payload)
-                    if inspect.isgenerator(out):
-                        # streaming handler: each yielded (type, payload
-                        # [, codec]) goes out as its own frame; TCP
-                        # backpressure bounds server buffering to ONE
-                        # frame (the reference's page-by-page result
-                        # streaming, FrontendQueryTestServer.cc:785-890).
-                        # The contract: ends with STREAM_END, or ERR on
-                        # a mid-stream failure — either way the
-                        # connection stays frame-synchronized.
-                        for frame in out:
-                            if len(frame) == 3:
-                                f_type, f_payload, f_codec = frame
-                            else:
-                                (f_type, f_payload), f_codec = frame, CODEC_MSGPACK
-                            send_frame(conn, f_type, f_payload, f_codec)
-                        continue
-                    if len(out) == 3:  # handler picked the reply codec
-                        reply_type, reply, codec = out
-                    else:
-                        reply_type, reply = out
-                        codec = CODEC_MSGPACK
-                    send_frame(conn, reply_type, reply, codec)
-                except BrokenPipeError:
+                if not self._dispatch_frame(conn, typ, codec_in, payload):
                     return
-                except Exception as e:  # handler errors go back as ERR
-                    try:
-                        send_frame(conn, MsgType.ERR, {
-                            "error": type(e).__name__,
-                            "message": str(e),
-                            "traceback": traceback.format_exc(limit=20),
-                        })
-                    except OSError:
-                        return
+
+    def _send_reply(self, conn, typ, payload, codec=CODEC_MSGPACK) -> None:
+        """Reply send with the same deadline discipline as mid-frame
+        recv: the peer must DRAIN within frame_timeout_s or the send
+        fails (socket.timeout → the caller drops the connection) — a
+        client that stops reading can never wedge a handler thread in
+        sendall. The idle-recv timeout (None) is restored after."""
+        conn.settimeout(self.frame_timeout_s)
+        try:
+            send_frame(conn, typ, payload, codec, chaos=self._chaos)
+        finally:
+            conn.settimeout(None)
+
+    def _send_err(self, conn, exc, retryable: Optional[bool] = None,
+                  with_traceback: bool = False) -> bool:
+        """ERR frame for ``exc``; False when the connection is dead.
+        ``retryable`` rides the payload so clients classify without
+        string-matching (errors.classify_remote)."""
+        if retryable is None:
+            retryable = bool(getattr(exc, "retryable", False))
+        body = {"error": type(exc).__name__, "message": str(exc),
+                "retryable": retryable}
+        if with_traceback:
+            body["traceback"] = traceback.format_exc(limit=20)
+        try:
+            self._send_reply(conn, MsgType.ERR, body)
+            return True
+        except OSError:
+            return False
+
+    def _dispatch_frame(self, conn, typ, codec_in, payload) -> bool:
+        """Execute one decoded request frame and send its reply (or
+        replies, for streams). Returns False when the connection is
+        dead. Mutating frames carrying an idempotency token are
+        deduplicated here: a retry of a COMPLETED request replays the
+        cached reply without re-running the handler — the at-most-once
+        half of the client's retry contract."""
+        token = payload.pop(IDEMPOTENCY_KEY, None) \
+            if isinstance(payload, dict) else None
+        handler = self.handlers.get(typ)
+        try:
+            if token is not None:
+                cached = self._idem.claim(token, wait_s=self.frame_timeout_s)
+                if cached is not None:
+                    reply_type, reply, codec = cached
+                    self._send_reply(conn, reply_type, reply, codec)
+                    return True
+            try:
+                if handler is None:
+                    raise ProtocolError(f"no handler for {typ!r}")
+                if self._follower_addrs and typ in self.MIRRORED:
+                    out = self._run_mirrored(typ, payload, codec_in, handler,
+                                             token=token)
+                else:
+                    out = handler(payload)
+            except FollowerDegraded as e:
+                # the LOCAL mutation applied; only the mirror failed.
+                # Cache the local reply under the token so the client's
+                # retry returns success instead of double-applying,
+                # then surface the typed retryable error for THIS
+                # attempt (the ambiguous-outcome contract).
+                if token is not None:
+                    if e.local_result is not None:
+                        self._idem.finish(
+                            token, self._normalize_reply(e.local_result))
+                    else:
+                        self._idem.abort(token)
+                    token = None
+                raise
+            except BaseException:
+                if token is not None:
+                    # transient or handler failure: nothing durable to
+                    # replay — release waiters so a retry re-executes
+                    self._idem.abort(token)
+                    token = None
+                raise
+            if inspect.isgenerator(out):
+                # streaming handler: each yielded (type, payload
+                # [, codec]) goes out as its own frame; TCP
+                # backpressure bounds server buffering to ONE
+                # frame (the reference's page-by-page result
+                # streaming, FrontendQueryTestServer.cc:785-890).
+                # The contract: ends with STREAM_END, or ERR on
+                # a mid-stream failure — either way the
+                # connection stays frame-synchronized. Streams are
+                # not idempotency-cached (mutating frames never
+                # stream).
+                if token is not None:
+                    self._idem.abort(token)
+                    token = None
+                for frame in out:
+                    if len(frame) == 3:
+                        f_type, f_payload, f_codec = frame
+                    else:
+                        (f_type, f_payload), f_codec = frame, CODEC_MSGPACK
+                    self._send_reply(conn, f_type, f_payload, f_codec)
+                return True
+            result = self._normalize_reply(out)
+            if token is not None:
+                self._idem.finish(token, result)
+            self._send_reply(conn, *result)
+            return True
+        except BrokenPipeError:
+            return False
+        except Exception as e:  # handler errors go back as typed ERR
+            return self._send_err(conn, e, with_traceback=True)
+
+    @staticmethod
+    def _normalize_reply(out) -> Tuple[MsgType, Any, int]:
+        if len(out) == 3:  # handler picked the reply codec
+            return out[0], out[1], out[2]
+        return out[0], out[1], CODEC_MSGPACK
 
     # --- multi-host mirroring (master → workers) ----------------------
+    def _dial_follower(self, addr: str, timeout: Optional[float] = None):
+        """One follower connection with mirror-path semantics: NO
+        client-side retries (a mirror failure must surface immediately
+        so the leader can evict + resync, not be papered over by a
+        silent reconnect that breaks frame ordering). The dial +
+        handshake is always bounded — a peer that accepts TCP and goes
+        silent must fail the dial, not wedge the dialing thread;
+        ``timeout`` additionally bounds steady-state replies (used by
+        the resync RPC; mirror links leave it None because a mirrored
+        EXECUTE may legitimately run for minutes, and the ack-timeout
+        eviction already unsticks those)."""
+        from netsdb_tpu.serve.client import RemoteClient, RetryPolicy
+
+        return RemoteClient(addr, token=self.token,
+                            retry=RetryPolicy(max_attempts=1),
+                            chaos=self._follower_chaos,
+                            timeout=timeout,
+                            connect_timeout=self.handshake_timeout_s)
+
     def _ensure_followers(self, timeout_s: float = 30.0) -> None:
         """Dial any not-yet-connected follower, retrying while it comes
-        up (bring-up order between master and workers is free). Each
-        follower gets a :class:`_FollowerLink` — a FIFO sender thread
-        whose queue order IS the follower's frame order."""
-        if len(self._followers) == len(self._follower_addrs):
+        up (bring-up order between master and workers is free — the
+        deadline is MONOTONIC, so wall-clock jumps cannot break the
+        retry window). Each follower gets a :class:`_FollowerLink` — a
+        FIFO sender thread whose queue order IS the follower's frame
+        order. A follower that never answers within the window is
+        evicted into the degraded state (the reattach loop keeps
+        trying) and the frame that needed it fails typed-retryable."""
+        with self._followers_mu:
+            undialed = [a for a in self._follower_addrs
+                        if a not in self._links and a not in self._degraded]
+        if not undialed:
             return
-        from netsdb_tpu.serve.client import RemoteClient
-
-        for addr in self._follower_addrs[len(self._followers):]:
-            deadline = time.time() + timeout_s
+        for addr in undialed:
+            deadline = deadline_after(timeout_s)
             while True:
                 try:
-                    fc = RemoteClient(addr, token=self.token)
-                    self._followers.append(fc)
-                    self._links.append(_FollowerLink(fc))
+                    fc = self._dial_follower(addr)
+                    with self._followers_mu:
+                        self._links[addr] = _FollowerLink(addr, fc)
                     break
                 except OSError as e:
-                    if time.time() >= deadline:
-                        raise ConnectionError(
+                    if seconds_left(deadline) <= 0:
+                        self._evict_follower(
+                            addr, f"unreachable after {timeout_s:.0f}s: {e}")
+                        raise FollowerDegraded(
                             f"follower daemon {addr} unreachable after "
-                            f"{timeout_s:.0f}s: {e}") from e
+                            f"{timeout_s:.0f}s; evicted for background "
+                            f"reattach: {e}") from e
                     time.sleep(0.3)
+
+    def _evict_follower(self, addr: str, reason: str) -> None:
+        """Move a follower out of the mirror set into the degraded
+        state. The leader keeps serving reads/queries from its own
+        store; a background reattach loop resyncs the follower from a
+        leader checkpoint before readmitting it. Idempotent."""
+        with self._followers_mu:
+            link = self._links.pop(addr, None)
+            self._degraded[addr] = reason
+        if link is not None:
+            link.close(abort=True)
+
+    def follower_status(self) -> Dict[str, Any]:
+        with self._followers_mu:
+            return {"active": sorted(self._links),
+                    "degraded": dict(self._degraded)}
+
+    # --- follower health + graceful degradation -----------------------
+    def _health_loop(self) -> None:
+        """Leader-side liveness: heartbeat every active follower over a
+        DEDICATED connection (never the ordered mirror link — a probe
+        must not queue behind a big forward), evict after
+        ``heartbeat_misses`` consecutive failures, and keep trying to
+        reattach + resync degraded followers."""
+        from netsdb_tpu.serve.client import RemoteClient, RetryPolicy
+
+        misses: Dict[str, int] = {}
+        probes: Dict[str, Any] = {}
+        while not self._stop.wait(self.heartbeat_interval_s):
+            with self._followers_mu:
+                active = list(self._links)
+                degraded = list(self._degraded)
+            for addr in active:
+                try:
+                    probe = probes.get(addr)
+                    if probe is None:
+                        probe = RemoteClient(
+                            addr, token=self.token,
+                            timeout=self.heartbeat_timeout_s,
+                            retry=RetryPolicy(max_attempts=1))
+                        probes[addr] = probe
+                    probe.ping()
+                    misses[addr] = 0
+                except Exception as e:  # noqa: BLE001 — counted, typed below
+                    probe = probes.pop(addr, None)
+                    if probe is not None:
+                        probe.close()
+                    misses[addr] = misses.get(addr, 0) + 1
+                    if misses[addr] >= self.heartbeat_misses:
+                        misses[addr] = 0
+                        self._evict_follower(
+                            addr, f"{self.heartbeat_misses} missed "
+                                  f"heartbeats: {type(e).__name__}: {e}")
+            for addr in degraded:
+                if self._stop.is_set():
+                    return
+                self._try_reattach(addr)
+        for probe in probes.values():
+            probe.close()
+
+    def _try_reattach(self, addr: str) -> bool:
+        """Attempt to bring one degraded follower back: dial it, resync
+        its store from a leader checkpoint, readmit it to the mirror
+        set. Quietly returns False while the follower stays down. The
+        resync connection is FULLY bounded (dial, handshake, reply) —
+        the resync holds the leader's write path, so a follower that
+        answers the dial and then hangs must fail the resync within
+        ``resync_timeout_s``, never wedge the health thread (and with
+        it every mutation) forever."""
+        try:
+            fc = self._dial_follower(addr, timeout=self.resync_timeout_s)
+        except OSError:
+            return False
+        try:
+            self._resync_follower(addr, fc)
+            return True
+        except Exception as e:  # noqa: BLE001 — recorded, retried later
+            fc.close()
+            with self._followers_mu:
+                if addr in self._degraded:
+                    self._degraded[addr] = (f"resync failed: "
+                                            f"{type(e).__name__}: {e}")
+            return False
+
+    def _resync_follower(self, addr: str, fc) -> None:
+        """Rebuild ``addr``'s store from a leader snapshot, then
+        readmit it. The snapshot is taken under the exclusive frame
+        order (and the collective lock), so no mutation can interleave
+        between 'what the checkpoint holds' and 'first mirrored frame
+        the readmitted follower sees' — the store-equality guarantee.
+        Reads never take these locks: the leader keeps serving them
+        throughout (degraded mode is only a write-path pause). Old
+        snapshot steps are pruned after success — a flapping follower
+        must not fill the leader's disk."""
+        from netsdb_tpu.storage import checkpoint
+
+        self._resync_idle.clear()
+        self._order.acquire_write()
+        try:
+            with self._collective_lock:
+                step = next(self._resync_seq)
+                root = os.path.join(self.config.root_dir, "resync")
+                checkpoint.save_store(root, self._snapshot_state(), step)
+                fc._request(MsgType.RESYNC_FOLLOWER,
+                            {"path": root, "step": step})
+                # the resync client carries resync_timeout_s on every
+                # recv; the LINK must not (mirrored EXECUTEs may run
+                # for minutes) — so the readmitted link gets a fresh
+                # unbounded-reply connection
+                fc.close()
+                link_client = self._dial_follower(addr)
+                with self._followers_mu:
+                    self._degraded.pop(addr, None)
+                    self._links[addr] = _FollowerLink(addr, link_client)
+                checkpoint.prune_steps(root, keep=1)
+        finally:
+            self._order.release_write()
+            self._resync_idle.set()
+
+    def _snapshot_state(self) -> Dict[str, Any]:
+        """The leader's replayable state: databases, registered types,
+        and every set as host values. Paged relations snapshot as their
+        host-assembled form (chunk tables / records) and re-page on the
+        follower; only a paged MATRIX — which by design never
+        materializes (PAGED_MATMUL streams it) — is recreated empty,
+        so mirrored frames targeting it still find the set instead of
+        failing and re-evicting the follower forever (page-level
+        streaming resync is a ROADMAP follow-on)."""
+        from netsdb_tpu.core.blocked import BlockedTensor
+        from netsdb_tpu.relational.outofcore import PagedColumns
+        from netsdb_tpu.storage.paged import PagedObjects
+
+        cat = self.library.catalog
+        types = []
+        for t in cat.list_types():
+            types.append({"type": t["type"],
+                          "entry_point": t["entry_point"],
+                          "source": cat.get_type_source(t["type"])})
+        sets = []
+        for ident in self.library.store.list_sets():
+            meta = cat.get_set(ident.db, ident.set) or {}
+            storage = self.library.store.storage_of(ident)
+            entry: Dict[str, Any] = {
+                "db": ident.db, "set": ident.set,
+                "type_name": meta.get("type", "tensor"),
+                "persistence": meta.get("persistence", "transient"),
+                "storage": storage,
+            }
+            items = self.library.store.get_items(ident)
+            if storage == "paged":
+                if len(items) == 1 and isinstance(items[0], PagedColumns):
+                    entry["kind"] = "paged-table"
+                    entry["table"] = items[0].to_host_table()
+                elif len(items) == 1 and isinstance(items[0], PagedObjects):
+                    entry["kind"] = "paged-objects"
+                    entry["items"] = list(items[0])
+                else:
+                    # _PagedMatrix: deliberately never materializes —
+                    # recreate the (empty) set so the follower keeps
+                    # accepting frames for it; content diverges until
+                    # re-ingest (documented ROADMAP follow-on)
+                    entry["kind"] = "paged-empty"
+            elif len(items) == 1 and isinstance(items[0], BlockedTensor):
+                t = items[0]
+                entry["kind"] = "tensor"
+                entry["dense"] = np.asarray(t.to_dense())
+                entry["block_shape"] = list(t.meta.block_shape)
+            else:
+                entry["kind"] = "objects"
+                entry["items"] = list(items)
+            sets.append(entry)
+        return {"databases": cat.list_databases(), "types": types,
+                "sets": sets}
+
+    def _on_resync_follower(self, p):
+        """Follower side: replace this daemon's store with the leader's
+        checkpoint snapshot (storage/checkpoint.py save_store). Loads
+        pickle from the given path — the codec-1 trust boundary, so it
+        requires allow_pickle (trusted-cluster control planes only)."""
+        if not self.allow_pickle:
+            raise ProtocolError(
+                "RESYNC_FOLLOWER refused: snapshot restore executes "
+                "pickle and this daemon has allow_pickle off")
+        from netsdb_tpu.storage import checkpoint
+
+        snap = checkpoint.load_store(p["path"], p.get("step"))
+        for ident in list(self.library.store.list_sets()):
+            self.library.remove_set(ident.db, ident.set)
+        for db in snap["databases"]:
+            self.library.create_database(db)
+        for t in snap.get("types", []):
+            self.library.register_type(t["type"], t["entry_point"],
+                                       source=t.get("source"))
+        restored = 0
+        for entry in snap["sets"]:
+            self.library.create_set(entry["db"], entry["set"],
+                                    type_name=entry["type_name"],
+                                    persistence=entry["persistence"],
+                                    storage=entry.get("storage", "memory"))
+            kind = entry["kind"]
+            if kind == "tensor":
+                self.library.send_matrix(entry["db"], entry["set"],
+                                         entry["dense"],
+                                         tuple(entry["block_shape"]))
+            elif kind == "paged-table":
+                # host chunk table re-pages through the ingest path
+                self.library.send_table(entry["db"], entry["set"],
+                                        entry["table"])
+            elif kind == "paged-empty":
+                pass  # set exists; content streams in on next ingest
+            elif entry["items"]:
+                # verbatim replay (items are already post-ingest form;
+                # send_data would re-columnarize "objects" sets)
+                self.library.store.add_data(
+                    SetIdentifier(entry["db"], entry["set"]),
+                    list(entry["items"]))
+            restored += 1
+        return MsgType.OK, {"restored_sets": restored}
 
     #: mirrored frames scoped to ONE (db, set) target — these serialize
     #: per set (and hold the RW order shared) in replicated-daemon
@@ -436,84 +909,140 @@ class ServeController:
             return self._set_locks.setdefault((db, set_name),
                                               threading.Lock())
 
-    def _run_mirrored(self, typ, payload, codec, handler):
+    def _run_mirrored(self, typ, payload, codec, handler, token=None):
         """Execute one mutating/job frame on EVERY process, holding the
         frame's ORDERING lock across both the follower enqueue and the
         local handler (see the ordering model in ``__init__`` — the
         lock choice is what keeps master execution order equal to
         follower receipt order for conflicting frames). Forwarding
         itself still overlaps local execution (the processes rendezvous
-        inside XLA). A follower failure after local success is raised
-        as a split-brain error: the cluster's stores have diverged and
-        the operator must recover (the reference aborts the job the
-        same way on worker failure)."""
+        inside XLA). A follower failure after local success EVICTS the
+        follower into the degraded state (background resync reattaches
+        it from a leader checkpoint) and surfaces as the typed
+        retryable ``FollowerDegraded`` — the idempotent retry then
+        returns the locally-applied result instead of double-applying
+        (this replaces the old raise-and-diverge split-brain error)."""
         import jax
 
+        if not self._resync_idle.wait(self.resync_grace_s):
+            # a resync holds the write path; shed typed-retryable
+            # instead of queueing unboundedly behind it
+            raise FollowerDegraded(
+                f"follower resync in progress (> {self.resync_grace_s}s); "
+                f"retry shortly")
         if jax.process_count() > 1:
             # true SPMD: one total order for everything mirrored
             with self._collective_lock:
-                return self._mirror_once(typ, payload, codec, handler)
+                return self._mirror_once(typ, payload, codec, handler, token)
         if typ in self.SET_SCOPED_FRAMES and "db" in payload \
                 and "set" in payload:
             self._order.acquire_read()
             try:
                 with self._set_lock(payload["db"], payload["set"]):
-                    return self._mirror_once(typ, payload, codec, handler)
+                    return self._mirror_once(typ, payload, codec, handler,
+                                             token)
             finally:
                 self._order.release_read()
         self._order.acquire_write()
         try:
-            return self._mirror_once(typ, payload, codec, handler)
+            return self._mirror_once(typ, payload, codec, handler, token)
         finally:
             self._order.release_write()
 
-    def _mirror_once(self, typ, payload, codec, handler):
+    def _mirror_once(self, typ, payload, codec, handler, token=None):
+        # forward the CLIENT's idempotency token (popped before
+        # dispatch) so followers dedupe too: if the local handler fails
+        # retryably AFTER the forward (e.g. AdmissionFull), the
+        # client's retry re-forwards the frame — without the shared
+        # token each follower would apply it twice and diverge
+        fwd = payload if token is None \
+            else {**payload, IDEMPOTENCY_KEY: token}
         with self._mirror_lock:  # short: dial + ordered enqueue only
             self._ensure_followers()
-            pending = [link.submit(typ, payload, codec)
-                       for link in self._links]
+            with self._followers_mu:
+                pending = [(addr, link.submit(typ, fwd, codec))
+                           for addr, link in self._links.items()]
         try:
             out = handler(payload)
         finally:
-            for p in pending:
-                p["done"].wait()
-        errors = [p["error"] for p in pending if p.get("error")]
-        if errors:
-            raise RuntimeError(
-                "follower(s) failed; stores may have diverged: "
-                + "; ".join(errors))
+            failures = self._collect_mirror_failures(pending)
+        if failures:
+            exc = FollowerDegraded(
+                "mirror failed; follower(s) evicted for resync: "
+                + "; ".join(f"{a}: {m}" for a, m in failures))
+            exc.local_result = out  # applied here — retry must not redo
+            raise exc
         return out
+
+    def _collect_mirror_failures(self, pending) -> list:
+        """Wait (bounded) for every follower ack; evict the ones that
+        errored or hung. ONE shared deadline covers the whole frame —
+        three hung followers cost one timeout, not three stacked. The
+        ack-timeout eviction aborts the link's socket, so its drain
+        thread unblocks — a hung follower can never wedge the leader's
+        handler thread."""
+        deadline = (deadline_after(self.mirror_ack_timeout_s)
+                    if self.mirror_ack_timeout_s is not None else None)
+        failures = []
+        for addr, rec in pending:
+            left = (max(0.0, seconds_left(deadline))
+                    if deadline is not None else None)
+            if not rec["done"].wait(left):
+                failures.append(
+                    (addr, f"no mirror ack within the frame's "
+                           f"{self.mirror_ack_timeout_s}s budget"))
+                self._evict_follower(
+                    addr, f"mirror ack timeout "
+                          f"({self.mirror_ack_timeout_s}s)")
+            elif rec.get("error"):
+                failures.append((addr, rec["error"]))
+                self._evict_follower(addr, rec["error"])
+        return failures
 
     # --- job bookkeeping ----------------------------------------------
     def _run_job(self, job_name: str, fn: Callable[[], Any]) -> Any:
         job_id = next(self._job_seq)
+        # "submitted" is a display timestamp (list_jobs), never compared
+        # against a deadline — the one sanctioned wall-clock read
         rec = {"id": job_id, "name": job_name, "status": "queued",
-               "submitted": time.time(), "elapsed": None}
+               "submitted": wall_now(), "elapsed": None}
         with self._jobs_lock:
             self._jobs[job_id] = rec
             # bounded history so a long-lived daemon cannot grow this
             while len(self._jobs) > 1024:
                 self._jobs.pop(next(iter(self._jobs)))
-        with self._jobs_sem:
-            rec["status"] = "running"
-            t0 = time.perf_counter()
-            try:
-                out = fn()
-                rec["status"] = "done"
-                return out
-            except Exception:
-                rec["status"] = "failed"
-                raise
-            finally:
-                rec["elapsed"] = time.perf_counter() - t0
+        # bounded admission: a saturated queue refuses typed-retryable
+        # instead of parking the handler thread forever (the client
+        # backs off and re-asks — the reference's job queue would just
+        # grow; ours must never wedge a worker thread)
+        if not self._jobs_sem.acquire(timeout=self.admission_timeout_s):
+            rec["status"] = "rejected"
+            raise AdmissionFull(
+                f"job {job_name!r} found no admission slot within "
+                f"{self.admission_timeout_s}s — back off and retry")
+        rec["status"] = "running"
+        t0 = time.perf_counter()
+        try:
+            out = fn()
+            rec["status"] = "done"
+            return out
+        except Exception:
+            rec["status"] = "failed"
+            raise
+        finally:
+            rec["elapsed"] = time.perf_counter() - t0
+            self._jobs_sem.release()
 
     # --- handlers -----------------------------------------------------
     def _on_ping(self, p) -> Tuple[MsgType, Any]:
         with self._jobs_lock:
             done = sum(1 for j in self._jobs.values() if j["status"] == "done")
-        return MsgType.OK, {"uptime": time.time() - self._started,
-                            "jobs_done": done,
-                            "sets": len(self.library.store.list_sets())}
+        out = {"uptime": time.monotonic() - self._started,
+               "jobs_done": done,
+               "sets": len(self.library.store.list_sets())}
+        if self._follower_addrs:
+            out["followers"] = self.follower_status()
+        return MsgType.OK, out
 
     def _on_create_database(self, p):
         self.library.create_database(p["db"])
@@ -711,15 +1240,22 @@ class ServeController:
                 covered[name] = cov
             with self._mirror_lock:
                 self._ensure_followers()
-                recs = [link.submit(MsgType.LOCAL_SHARDS,
-                                    {"db": db, "set": set_name},
-                                    CODEC_MSGPACK)
-                        for link in self._links]
-            for rec in recs:
-                rec["done"].wait()
-                if rec.get("error"):
-                    raise RuntimeError(f"follower shard fetch failed: "
-                                       f"{rec['error']}")
+                with self._followers_mu:
+                    recs = [(addr, link.submit(MsgType.LOCAL_SHARDS,
+                                               {"db": db, "set": set_name},
+                                               CODEC_MSGPACK))
+                            for addr, link in self._links.items()]
+            # same deadline discipline as the mutation mirror: a
+            # follower that hangs serving LOCAL_SHARDS (heartbeats may
+            # still pass — the daemon is alive, one handler is stuck)
+            # is evicted at the shared ack deadline and the read fails
+            # TYPED-retryable, never wedging this handler thread
+            failures = self._collect_mirror_failures(recs)
+            if failures:
+                raise FollowerDegraded(
+                    "follower shard fetch failed; evicted for resync: "
+                    + "; ".join(f"{a}: {m}" for a, m in failures))
+            for _addr, rec in recs:
                 for name, shards in (rec["reply"]["leaves"] or {}).items():
                     for sh in shards:
                         idx = tuple(slice(a, b) for a, b in sh["idx"])

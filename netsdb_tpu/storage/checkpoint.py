@@ -117,3 +117,67 @@ def restore(root: str, target: Any, step: Optional[int] = None) -> Any:
     import jax.numpy as jnp
     leaves = [jnp.asarray(r) for r in leaves_r]
     return jax.tree_util.tree_unflatten(treedef, leaves)
+
+
+# --- whole-store snapshots (follower resync) ---------------------------
+# The serve layer's fault-tolerance path: when a follower daemon is
+# evicted (failed mid-mirror, missed heartbeats), the leader snapshots
+# its store here and the follower rebuilds from the snapshot before
+# being readmitted — the same step-dir convention as model checkpoints
+# (list_steps/latest_step see both), but the payload is an opaque
+# pickled snapshot because sets hold arbitrary host objects (relational
+# rows, ColumnTables) that are not numeric pytrees.
+#
+# TRUST BOUNDARY: load_store executes pickle from the given path —
+# exactly the serve protocol's codec-1 boundary (serve/protocol.py
+# security note). The RESYNC_FOLLOWER handler therefore requires
+# allow_pickle on the follower daemon.
+
+_STORE_FILE = "store.pkl"
+
+
+def save_store(root: str, snapshot: Any, step: int) -> str:
+    """Persist ``snapshot`` (any picklable object — the serve layer
+    passes its databases/sets/types dump) as ``root/step_<step>``.
+    Atomic per step: the file lands via rename, so a reader never
+    observes a torn snapshot. Returns the step directory."""
+    import pickle
+
+    path = _step_dir(root, step)
+    os.makedirs(path, exist_ok=True)
+    final = os.path.join(path, _STORE_FILE)
+    tmp = final + ".tmp"
+    with open(tmp, "wb") as f:
+        pickle.dump(snapshot, f, protocol=pickle.HIGHEST_PROTOCOL)
+    os.replace(tmp, final)
+    return path
+
+
+def prune_steps(root: str, keep: int = 1) -> list:
+    """Delete all but the newest ``keep`` step directories under
+    ``root`` (snapshots are full-store, so only the latest is ever
+    restored — a follower flapping for days must not fill the leader's
+    disk). Returns the removed step numbers."""
+    import shutil
+
+    steps = list_steps(root)
+    victims = steps[:-keep] if keep > 0 else steps
+    for s in victims:
+        shutil.rmtree(_step_dir(root, s), ignore_errors=True)
+    return victims
+
+
+def load_store(root: str, step: Optional[int] = None) -> Any:
+    """Load a :func:`save_store` snapshot; ``step`` defaults to the
+    latest under ``root``. Raises FileNotFoundError when absent."""
+    import pickle
+
+    if step is None:
+        step = latest_step(root)
+        if step is None:
+            raise FileNotFoundError(f"no store snapshots under {root}")
+    final = os.path.join(_step_dir(root, step), _STORE_FILE)
+    if not os.path.exists(final):
+        raise FileNotFoundError(f"no store snapshot at {final}")
+    with open(final, "rb") as f:
+        return pickle.load(f)

@@ -19,6 +19,32 @@ import time
 from typing import Callable, Dict, List, Optional
 
 
+# --- clock discipline (serve layer) ------------------------------------
+# Deadlines and intervals MUST use the monotonic clock: time.time() can
+# jump (NTP step, manual set), which once broke the serve layer's 30 s
+# follower dial-retry loop. tests/test_static_checks.py enforces that
+# serve/ never calls time.time(); the one legitimate wall-clock use —
+# a human-readable timestamp in job records — goes through wall_now()
+# here so the intent is explicit at every call site.
+
+def wall_now() -> float:
+    """Wall-clock seconds since the epoch — DISPLAY ONLY (job-record
+    timestamps, logs). Never compare this against a deadline; use
+    :func:`deadline_after`/:func:`seconds_left` instead."""
+    return time.time()
+
+
+def deadline_after(seconds: float) -> float:
+    """A deadline ``seconds`` from now on the monotonic clock."""
+    return time.monotonic() + seconds
+
+
+def seconds_left(deadline: float) -> float:
+    """Seconds remaining until a :func:`deadline_after` deadline
+    (negative once expired)."""
+    return deadline - time.monotonic()
+
+
 def device_seconds(run: Callable[[int], None], lo: int = 4, hi: int = 20,
                    **kw) -> Optional[float]:
     """Seconds-per-iteration via :func:`scan_slope_seconds`, or None when

@@ -48,3 +48,6 @@ def pytest_configure(config):
     config.addinivalue_line(
         "markers", "slow: long-running integration tests (multi-process "
         "bring-up etc.)")
+    config.addinivalue_line(
+        "markers", "chaos: seeded-deterministic fault-injection tests for "
+        "the serve control plane (fast, CPU-only — these stay in tier-1)")

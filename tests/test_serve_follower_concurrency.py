@@ -157,3 +157,55 @@ def test_jobs_and_mutations_interleave_correctly(master_follower):
     assert sorted(mctl.library.get_set_iterator("d", "nums")) == \
         sorted(fctl.library.get_set_iterator("d", "nums")) == \
         list(range(1, 21))
+
+
+# --- degraded mode (fault-tolerant control plane) ----------------------
+
+@pytest.mark.chaos
+def test_dead_follower_is_evicted_and_leader_keeps_serving(tmp_path):
+    """A follower daemon that dies outright: heartbeats evict it into
+    the degraded state, after which the leader keeps serving BOTH reads
+    and mutations from its own store — no raise-and-diverge, no
+    untyped errors, and the degradation is observable via ping."""
+    import time
+
+    from netsdb_tpu.serve.client import RetryPolicy
+
+    fctl = ServeController(Configuration(root_dir=str(tmp_path / "f")),
+                           port=0)
+    fport = fctl.start()
+    mctl = ServeController(Configuration(root_dir=str(tmp_path / "m")),
+                           port=0, followers=[f"127.0.0.1:{fport}"],
+                           heartbeat_interval_s=0.1,
+                           heartbeat_timeout_s=0.3,
+                           heartbeat_misses=2,
+                           mirror_ack_timeout_s=2.0)
+    mport = mctl.start()
+    try:
+        c = RemoteClient(f"127.0.0.1:{mport}",
+                         retry=RetryPolicy(max_attempts=5,
+                                           base_delay_s=0.02))
+        c.create_database("d")
+        c.create_set("d", "s", type_name="object")
+        c.send_data("d", "s", [{"i": 0}])
+        assert sorted(r["i"] for r in
+                      fctl.library.get_set_iterator("d", "s")) == [0]
+
+        fctl.shutdown()  # the follower daemon dies
+        deadline = time.monotonic() + 20.0
+        while time.monotonic() < deadline:
+            if mctl.follower_status()["degraded"]:
+                break
+            time.sleep(0.05)
+        status = mctl.follower_status()
+        assert status["degraded"] and not status["active"], status
+
+        # degraded mode: mutations and reads keep working leader-side
+        c.send_data("d", "s", [{"i": 1}])
+        got = sorted(r["i"] for r in c.get_set_iterator("d", "s"))
+        assert got == [0, 1]
+        info = c.ping()
+        assert info["followers"]["degraded"], info
+    finally:
+        mctl.shutdown()
+        fctl.shutdown()

@@ -1,0 +1,97 @@
+"""Static guards for the serve layer — runnable as a script or a test.
+
+Two regressions this PR's fault-tolerance work must never quietly
+reacquire:
+
+1. **Wall-clock deadlines.** ``time.time()`` jumps (NTP steps, manual
+   sets) once broke the 30 s follower dial-retry loop; every deadline
+   in ``netsdb_tpu/serve/`` must use ``time.monotonic()`` (display
+   timestamps go through ``utils.timing.wall_now`` so the intent is
+   explicit). Any ``time.time()`` call — or ``from time import time``
+   — in the serve layer fails this check.
+
+2. **Opaque exception swallowing.** ``except:`` / ``except Exception:``
+   / ``except BaseException:`` handlers that neither bind the
+   exception (``as e`` — it gets typed/forwarded) nor re-raise it
+   erase the typed error taxonomy. AST-checked, so a bare ``raise``
+   anywhere in the handler body counts as re-raising.
+
+Run standalone: ``python tests/test_static_checks.py`` (exit 1 on
+violations) — the CI-script form the pytest wrapper shares.
+"""
+
+import ast
+import os
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+SERVE_DIR = os.path.join(REPO, "netsdb_tpu", "serve")
+
+
+def _is_wall_clock_call(node: ast.Call) -> bool:
+    f = node.func
+    if isinstance(f, ast.Attribute) and f.attr == "time" \
+            and isinstance(f.value, ast.Name) and f.value.id == "time":
+        return True  # time.time()
+    return False
+
+
+def _handler_reraises(handler: ast.ExceptHandler) -> bool:
+    for sub in ast.walk(handler):
+        if isinstance(sub, ast.Raise):
+            return True
+    return False
+
+
+def _check_file(path: str) -> list:
+    with open(path) as f:
+        src = f.read()
+    tree = ast.parse(src, filename=path)
+    rel = os.path.relpath(path, REPO)
+    out = []
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Call) and _is_wall_clock_call(node):
+            out.append(f"{rel}:{node.lineno}: time.time() in the serve "
+                       f"layer — deadlines must be time.monotonic() "
+                       f"(display timestamps: utils.timing.wall_now)")
+        if isinstance(node, ast.ImportFrom) and node.module == "time":
+            if any(a.name == "time" for a in node.names):
+                out.append(f"{rel}:{node.lineno}: 'from time import "
+                           f"time' hides wall-clock reads from review")
+        if isinstance(node, ast.ExceptHandler):
+            broad = node.type is None or (
+                isinstance(node.type, ast.Name)
+                and node.type.id in ("Exception", "BaseException"))
+            if broad and node.name is None \
+                    and not _handler_reraises(node):
+                out.append(f"{rel}:{node.lineno}: broad except that "
+                           f"neither binds ('as e') nor re-raises — "
+                           f"type it or forward it (serve/errors.py)")
+    return out
+
+
+def check_serve_layer() -> list:
+    violations = []
+    for name in sorted(os.listdir(SERVE_DIR)):
+        if name.endswith(".py"):
+            violations.extend(_check_file(os.path.join(SERVE_DIR, name)))
+    return violations
+
+
+def test_serve_layer_clock_and_exception_discipline():
+    violations = check_serve_layer()
+    assert not violations, "\n" + "\n".join(violations)
+
+
+def main() -> int:
+    violations = check_serve_layer()
+    for v in violations:
+        print(v, file=sys.stderr)
+    print(f"serve-layer static check: "
+          f"{'FAIL' if violations else 'ok'} "
+          f"({len(violations)} violation(s))")
+    return 1 if violations else 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())
