@@ -635,11 +635,17 @@ def _cmd_serve(args) -> int:
 
 
 def _cmd_serve_bench(args) -> int:
-    from netsdb_tpu.workloads.serve_bench import run_serve_bench
+    if getattr(args, "data_plane", False):
+        from netsdb_tpu.workloads.serve_bench import run_data_plane_bench
 
-    out = run_serve_bench(clients=args.clients, jobs_per_client=args.jobs,
-                          batch=args.batch, port=args.port,
-                          platform=args.platform)
+        out = run_data_plane_bench(table_mb=args.table_mb)
+    else:
+        from netsdb_tpu.workloads.serve_bench import run_serve_bench
+
+        out = run_serve_bench(clients=args.clients,
+                              jobs_per_client=args.jobs,
+                              batch=args.batch, port=args.port,
+                              platform=args.platform)
     print(json.dumps(out, indent=2))
     return 0
 
@@ -748,6 +754,11 @@ def main(argv=None) -> int:
                    help="0 = spawn a private daemon on an ephemeral port")
     p.add_argument("--platform", default=None,
                    help="jax platform for the spawned daemon (e.g. cpu)")
+    p.add_argument("--data-plane", action="store_true",
+                   help="v3 data-plane numbers instead: single-frame vs "
+                   "streamed pipelined ingest MB/s, scan MB/s, zero-copy "
+                   "tensor push/pull, hedged-read p99")
+    p.add_argument("--table-mb", type=int, default=64)
 
     p = sub.add_parser("autotune",
                        help="measure physical-strategy crossovers "

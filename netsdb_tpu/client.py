@@ -59,22 +59,27 @@ class Client:
     def __new__(cls, config: Configuration = DEFAULT_CONFIG,
                 catalog_path: Optional[str] = None,
                 address: Optional[str] = None,
-                token: Optional[str] = None):
+                token: Optional[str] = None,
+                replicas=None):
         if address is not None:
             # thin RPC mode — talk to a resident daemon instead of
             # owning the store (reference: PDBClient always works this
             # way; here the in-process library is the default and
-            # ``Client(address="host:port")`` is the served form)
+            # ``Client(address="host:port")`` is the served form).
+            # ``replicas``: other daemon addresses holding the same
+            # data — enables client-side hedged reads (tail latency;
+            # see RemoteClient).
             from netsdb_tpu.serve.client import RemoteClient
 
-            return RemoteClient(address, token=token)
+            return RemoteClient(address, token=token, replicas=replicas)
         return super().__new__(cls)
 
     def __init__(self, config: Configuration = DEFAULT_CONFIG,
                  catalog_path: Optional[str] = None,
                  address: Optional[str] = None,
-                 token: Optional[str] = None):
-        del address, token  # consumed by __new__ (RemoteClient path)
+                 token: Optional[str] = None,
+                 replicas=None):
+        del address, token, replicas  # consumed by __new__ (RemoteClient)
         self.config = config
         config.ensure_dirs()
         from netsdb_tpu.config import enable_compilation_cache

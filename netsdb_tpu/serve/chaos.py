@@ -27,8 +27,15 @@ Actions (``where="send"`` unless noted):
 * ``corrupt`` — every body byte is XOR-flipped; the header (and its
   length field) stays valid, so the peer reads a well-framed body that
   fails to decode — the CorruptFrame path.
-* ``truncate`` — header + half the body are written, then the socket
-  is torn down: the peer's ``_recv_exact`` sees EOF mid-frame.
+* ``corrupt_seg`` — flips one byte in the middle of the largest
+  OUT-OF-BAND tensor segment (wire format v3): the msgpack body still
+  decodes, but the segment no longer matches its checksum in the
+  segment table — the corruption lands where msgpack's own framing
+  cannot see it. Falls back to ``corrupt`` on frames without segments.
+* ``truncate`` — the frame is cut mid-flight, then the socket is torn
+  down: the peer's ``_recv_exact`` sees EOF mid-frame. On a codec-2
+  frame the cut lands INSIDE the first tensor segment (header, segment
+  table and body all arrive intact first).
 * ``kill`` — alias of ``drop``; reads better in follower-kill tests.
 
 Every injected fault is recorded in :attr:`faults` for assertions.
@@ -41,7 +48,7 @@ import threading
 import time
 from typing import Any, List, Optional, Tuple
 
-_ACTIONS = ("drop", "delay", "corrupt", "truncate", "kill")
+_ACTIONS = ("drop", "delay", "corrupt", "corrupt_seg", "truncate", "kill")
 
 
 class ChaosInjector:
@@ -112,24 +119,43 @@ class ChaosInjector:
         except OSError:
             pass
 
-    def on_send(self, sock, msg_type: int, header: bytes,
-                body: bytes) -> Tuple[bytes, bytes]:
-        """Possibly fault the outgoing frame; returns the (header, body)
-        to actually write. ``drop``/``truncate`` tear the socket down
-        and raise ConnectionResetError so the caller's failure path
-        runs exactly as it would on a real reset."""
+    def on_send(self, sock, msg_type: int, header: bytes, body: bytes,
+                segtable: bytes = b"", segments=()) -> Tuple:
+        """Possibly fault the outgoing frame; returns the (header,
+        segtable, body, segments) to actually write. ``segments`` are
+        the out-of-band tensor buffers of a codec-2 frame (empty
+        otherwise); the segment TABLE — lengths + checksums — is never
+        rewritten, so a mutated segment arrives detectably stale.
+        ``drop``/``truncate`` tear the socket down and raise
+        ConnectionResetError so the caller's failure path runs exactly
+        as it would on a real reset."""
+        segments = list(segments)
         action, dly = self._next("send", msg_type)
         if action is None:
-            return header, body
+            return header, segtable, body, segments
         if action == "delay":
             time.sleep(dly)
-            return header, body
-        if action == "corrupt":
-            return header, bytes(b ^ 0xA5 for b in body)
+            return header, segtable, body, segments
+        if action == "corrupt_seg" and segments:
+            i = max(range(len(segments)), key=lambda k: segments[k].nbytes)
+            mutated = bytearray(segments[i])
+            mutated[len(mutated) // 2] ^= 0xA5
+            segments[i] = memoryview(mutated)
+            return header, segtable, body, segments
+        if action in ("corrupt", "corrupt_seg"):
+            return header, segtable, bytes(b ^ 0xA5 for b in body), segments
         if action == "truncate":
             try:
                 sock.sendall(header)
-                sock.sendall(body[: max(1, len(body) // 2)])
+                sock.sendall(segtable)
+                if segments:
+                    # the cut lands INSIDE a tensor segment: body and
+                    # segment table arrive whole, the raw buffer doesn't
+                    sock.sendall(body)
+                    first = segments[0]
+                    sock.sendall(first[: max(1, first.nbytes // 2)])
+                else:
+                    sock.sendall(body[: max(1, len(body) // 2)])
             except OSError:
                 pass
             self._teardown(sock)

@@ -16,11 +16,13 @@ unchanged against either client.
 from __future__ import annotations
 
 import dataclasses
+import queue as _queue
 import random
 import socket
 import threading
 import time
 import uuid
+from collections import deque as _deque
 from typing import Any, Dict, Iterator, List, Optional, Sequence, Tuple
 
 import numpy as np
@@ -32,6 +34,7 @@ from netsdb_tpu.serve.errors import (  # noqa: F401 — re-exported API
     CorruptFrameError,
     DeadlineExceededError,
     FollowerDegradedError,
+    ProtocolVersionError,
     RemoteError,
     RemoteTimeoutError,
     RetryableRemoteError,
@@ -42,6 +45,7 @@ from netsdb_tpu.serve.protocol import (
     CODEC_PICKLE,
     IDEMPOTENCY_KEY,
     MUTATING_TYPES,
+    PROTO_VERSION,
     MsgType,
     ProtocolError,
     recv_frame,
@@ -134,7 +138,11 @@ class RemoteClient:
                  timeout: Optional[float] = None,
                  retry: Optional[RetryPolicy] = None,
                  chaos=None, seed: Optional[int] = None,
-                 connect_timeout: Optional[float] = None):
+                 connect_timeout: Optional[float] = None,
+                 replicas: Optional[Sequence[str]] = None,
+                 hedge_delay_s: Optional[float] = None,
+                 ingest_window: int = 4,
+                 ingest_chunk_bytes: int = 8 << 20):
         """``timeout``: socket-level timeout applied to every blocking
         recv after the handshake (None = block; a hung server then
         surfaces as :class:`RemoteTimeoutError` instead of a wedged
@@ -146,7 +154,22 @@ class RemoteClient:
         default retries 4 attempts with jittered exponential backoff.
         ``chaos``: a :class:`~netsdb_tpu.serve.chaos.ChaosInjector`
         faulting this client's request/reply frames (tests only).
-        ``seed`` seeds the backoff jitter for reproducible schedules."""
+        ``seed`` seeds the backoff jitter for reproducible schedules.
+
+        ``replicas``: addresses of other daemons holding the same data
+        (mirrored followers). When set, idempotent READS hedge: if the
+        primary's reply hasn't landed after the observed-p99 latency
+        (or ``hedge_delay_s`` when given), the same request is issued
+        to a replica over a one-shot connection and the first success
+        wins — tail latency becomes the replicas' min, not the
+        primary's max. Mutations never hedge (ordering runs through the
+        leader).
+
+        ``ingest_window``/``ingest_chunk_bytes``: the bulk-ingest
+        pipeline knobs — ``send_data``/``send_table`` stream large
+        payloads as ~``ingest_chunk_bytes`` chunks with up to
+        ``ingest_window`` chunks in flight before waiting on acks
+        (depth-W pipelining, not stop-and-wait)."""
         host, _, port = address.rpartition(":")
         self.host = host or "127.0.0.1"
         self.port = int(port)
@@ -164,6 +187,16 @@ class RemoteClient:
         #: observability for tests and callers tuning policies
         self.last_attempts = 0
         self.total_retries = 0
+        # hedged-read state: replica ring + observed read latencies
+        # (the adaptive p99 hedge trigger) + counters for tests/tuning
+        self._replicas = list(replicas or [])
+        self._hedge_delay_s = hedge_delay_s
+        self._read_lat = _deque(maxlen=256)
+        self._hedge_rr = 0
+        self.hedges_issued = 0
+        self.hedges_won = 0
+        self.ingest_window = max(1, int(ingest_window))
+        self.ingest_chunk_bytes = max(64 << 10, int(ingest_chunk_bytes))
         # thread id that currently drives a streaming reply (scan_stream
         # / chunked pulls) — a nested request from that thread must NOT
         # wait on the lock (self-deadlock) nor write to the streaming
@@ -172,26 +205,42 @@ class RemoteClient:
         self._connect()
 
     # --- transport ----------------------------------------------------
-    def _dial(self, budget_s: Optional[float] = None) -> socket.socket:
+    def _dial(self, budget_s: Optional[float] = None,
+              address: Optional[str] = None) -> socket.socket:
         """Open + handshake one connection (the single copy of the
-        dial sequence — main connection, one-shot side requests and
-        nested streams all come through here). ``budget_s`` caps the
-        connect + handshake below the configured connect timeout — the
-        per-request deadline must bound a hung DIAL too (a blackholed
-        host, or a peer that accepts TCP and never answers HELLO), not
-        just a hung reply."""
+        dial sequence — main connection, one-shot side requests,
+        nested streams and replica hedges all come through here).
+        ``budget_s`` caps the connect + handshake below the configured
+        connect timeout — the per-request deadline must bound a hung
+        DIAL too (a blackholed host, or a peer that accepts TCP and
+        never answers HELLO), not just a hung reply. The HELLO carries
+        :data:`~netsdb_tpu.serve.protocol.PROTO_VERSION`; a
+        wire-format mismatch in either direction is the typed fatal
+        :class:`ProtocolVersionError` — mixed-version peers never get
+        past the handshake."""
+        host, port = self.host, self.port
+        if address is not None:
+            h, _, p = address.rpartition(":")
+            host, port = (h or "127.0.0.1"), int(p)
         ct = self._connect_timeout
         if budget_s is not None:
             ct = budget_s if ct is None else min(ct, budget_s)
-        s = socket.create_connection((self.host, self.port), timeout=ct)
+        s = socket.create_connection((host, port), timeout=ct)
         try:
             s.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
-            send_frame(s, MsgType.HELLO, {"token": self.token})
+            send_frame(s, MsgType.HELLO, {"token": self.token,
+                                          "proto": PROTO_VERSION})
             typ, reply = recv_frame(s, allow_pickle=False)
             if typ == MsgType.ERR:
-                # handshake refusals are fatal by construction (auth)
-                raise AuthError(reply.get("error", "AuthError"),
-                                reply.get("message", "handshake refused"))
+                # handshake refusals are fatal by construction
+                # (auth / wire-format mismatch)
+                raise classify_remote(reply)
+            if reply.get("version") != PROTO_VERSION:
+                raise ProtocolVersionError(
+                    "ProtocolVersionError",
+                    f"daemon at {host}:{port} speaks wire format "
+                    f"v{reply.get('version')}; this client is "
+                    f"v{PROTO_VERSION} — mixed versions are refused")
             s.settimeout(self._timeout)  # steady-state I/O bound
         except BaseException:
             s.close()
@@ -203,12 +252,14 @@ class RemoteClient:
 
     def _oneshot_request(self, msg_type: MsgType, payload: Any,
                          codec: int,
-                         io_timeout: Optional[float] = None) -> Any:
+                         io_timeout: Optional[float] = None,
+                         address: Optional[str] = None) -> Any:
         """Issue one request over a throwaway connection — used when the
         caller's thread is mid-stream on the main connection (e.g.
         ``for item in c.scan_stream(...): c.send_data(...)``), which
-        must neither block on the held lock nor interleave frames."""
-        s = self._dial(io_timeout)
+        must neither block on the held lock nor interleave frames, and
+        by hedged reads dialing a replica (``address``)."""
+        s = self._dial(io_timeout, address=address)
         try:
             if io_timeout is not None:
                 s.settimeout(io_timeout)
@@ -262,22 +313,17 @@ class RemoteClient:
             raise classify_remote(reply)
         return reply
 
-    def _request(self, msg_type: MsgType, payload: Any,
-                 codec: int = CODEC_MSGPACK,
-                 deadline_s: Optional[float] = None) -> Any:
-        """One logical request: attach an idempotency token to mutating
-        frames, then retry retryable failures under the client's
-        :class:`RetryPolicy` and the per-request deadline. Every raised
-        error is typed (:class:`RemoteError` family) — callers never
-        see a bare socket exception."""
-        if msg_type in MUTATING_TYPES and isinstance(payload, dict) \
-                and IDEMPOTENCY_KEY not in payload:
-            # one token per LOGICAL request: every retry resends the
-            # same token, so the server can dedupe a mutation whose
-            # first reply was lost mid-wire
-            payload = dict(payload)
-            payload[IDEMPOTENCY_KEY] = uuid.uuid4().hex
-        oneshot = self._stream_owner == threading.get_ident()
+    def _retry_driver(self, attempt_fn,
+                      deadline_s: Optional[float] = None) -> Any:
+        """The ONE retry engine (plain requests, hedged reads and bulk
+        conversations all run through here): call ``attempt_fn(
+        io_timeout)`` under the client's :class:`RetryPolicy` and the
+        per-request deadline, retrying typed-retryable failures with
+        jittered exponential backoff. ``io_timeout`` caps the attempt's
+        socket timeout at the remaining budget — the deadline bounds a
+        HUNG attempt too, not just the backoff gaps. Every raised error
+        is typed (:class:`RemoteError` family) — callers never see a
+        bare socket exception."""
         policy = self._retry
         budget_s = deadline_s if deadline_s is not None else policy.deadline_s
         deadline = deadline_after(budget_s) if budget_s is not None else None
@@ -292,17 +338,10 @@ class RemoteClient:
                         "DeadlineExceeded",
                         f"request deadline of {budget_s}s already spent "
                         f"before attempt {attempt}")
-                # the deadline bounds a HUNG attempt too, not just the
-                # backoff gaps: cap this attempt's socket timeout at
-                # the remaining budget
                 io_timeout = left if self._timeout is None \
                     else min(self._timeout, left)
             try:
-                if oneshot:
-                    return self._oneshot_request(msg_type, payload, codec,
-                                                 io_timeout=io_timeout)
-                return self._request_once(msg_type, payload, codec,
-                                          io_timeout=io_timeout)
+                return attempt_fn(io_timeout)
             except RemoteError as e:
                 if not e.retryable:
                     raise
@@ -327,6 +366,201 @@ class RemoteClient:
             time.sleep(delay)
             attempt += 1
             self.total_retries += 1
+
+    def _request(self, msg_type: MsgType, payload: Any,
+                 codec: int = CODEC_MSGPACK,
+                 deadline_s: Optional[float] = None) -> Any:
+        """One logical request: attach an idempotency token to mutating
+        frames, then retry under :meth:`_retry_driver`."""
+        if msg_type in MUTATING_TYPES and isinstance(payload, dict) \
+                and IDEMPOTENCY_KEY not in payload:
+            # one token per LOGICAL request: every retry resends the
+            # same token, so the server can dedupe a mutation whose
+            # first reply was lost mid-wire
+            payload = dict(payload)
+            payload[IDEMPOTENCY_KEY] = uuid.uuid4().hex
+        oneshot = self._stream_owner == threading.get_ident()
+
+        def attempt(io_timeout):
+            if oneshot:
+                return self._oneshot_request(msg_type, payload, codec,
+                                             io_timeout=io_timeout)
+            if self._replicas and msg_type not in MUTATING_TYPES \
+                    and msg_type != MsgType.SHUTDOWN:
+                return self._request_hedged(msg_type, payload, codec,
+                                            io_timeout=io_timeout)
+            return self._request_once(msg_type, payload, codec,
+                                      io_timeout=io_timeout)
+
+        return self._retry_driver(attempt, deadline_s)
+
+    # --- windowed bulk ingest (BULK_BEGIN/CHUNK/COMMIT) ---------------
+    def _bulk_once(self, sock: socket.socket, begin: dict,
+                   chunk_fn) -> Any:
+        """One attempt of a streamed-ingest conversation on ``sock``:
+        BEGIN, then chunks pipelined ``ingest_window`` deep (each chunk
+        is acked by the server after it DECODES — outside any set lock
+        — so acks overlap the client's next sends instead of
+        stop-and-wait), then COMMIT, whose reply is the target op's
+        reply. A BEGIN answered without ``go`` is the server replaying
+        a completed execution from the idempotency cache — the retry
+        path after a lost final ack — and ends the conversation
+        immediately."""
+        send_frame(sock, MsgType.BULK_BEGIN, begin, chaos=self._chaos)
+        typ, reply = self._recv_reply(sock)
+        if typ == MsgType.ERR:
+            raise classify_remote(reply)
+        if not (isinstance(reply, dict) and reply.get("go")):
+            return reply  # deduplicated replay of the completed reply
+        seq = 0
+        unacked = 0
+        for chunk in chunk_fn():
+            chunk["seq"] = seq
+            send_frame(sock, MsgType.BULK_CHUNK, chunk, chaos=self._chaos)
+            seq += 1
+            unacked += 1
+            while unacked >= self.ingest_window:
+                typ, ack = self._recv_reply(sock)
+                if typ == MsgType.ERR:
+                    raise classify_remote(ack)
+                unacked -= 1
+        while unacked:
+            typ, ack = self._recv_reply(sock)
+            if typ == MsgType.ERR:
+                raise classify_remote(ack)
+            unacked -= 1
+        send_frame(sock, MsgType.BULK_COMMIT, {"chunks": seq},
+                   chaos=self._chaos)
+        typ, reply = self._recv_reply(sock)
+        if typ == MsgType.ERR:
+            raise classify_remote(reply)
+        return reply
+
+    def _bulk_request(self, op: MsgType, meta: dict, chunk_fn,
+                      deadline_s: Optional[float] = None) -> Any:
+        """One LOGICAL bulk ingest: stream ``chunk_fn()``'s chunks under
+        the windowed-ack protocol, retrying the whole conversation on
+        retryable failures under the client's :class:`RetryPolicy`.
+        ``chunk_fn`` must return a fresh chunk iterator per call (each
+        retry re-streams). The single idempotency token spans every
+        attempt: nothing applies server-side until COMMIT, and a retry
+        after a lost COMMIT reply replays the cached result instead of
+        double-applying. From a thread that is mid-stream on the main
+        connection the whole conversation rides a one-shot side
+        connection (same rule as nested plain requests)."""
+        token = uuid.uuid4().hex
+        begin = {"op": int(op), "meta": meta, IDEMPOTENCY_KEY: token}
+
+        def attempt(io_timeout):
+            if self._stream_owner == threading.get_ident():
+                s = self._dial(io_timeout)
+                try:
+                    if io_timeout is not None:
+                        s.settimeout(io_timeout)
+                    return self._bulk_once(s, begin, chunk_fn)
+                finally:
+                    s.close()
+            with self._lock:
+                if self._sock is None:
+                    self._connect(io_timeout)
+                try:
+                    if io_timeout is not None:
+                        self._sock.settimeout(io_timeout)
+                    out = self._bulk_once(self._sock, begin, chunk_fn)
+                    if io_timeout is not None:
+                        self._sock.settimeout(self._timeout)
+                    return out
+                except Exception:
+                    # ANY mid-conversation failure desyncs the
+                    # chunk stream — drop and re-dial on retry
+                    self._drop_connection()
+                    raise
+
+        return self._retry_driver(attempt, deadline_s)
+
+    # --- hedged reads -------------------------------------------------
+    def hedge_delay_s(self) -> float:
+        """Current hedge trigger: the explicit knob when set, else the
+        observed p99 of this client's recent read latencies (adaptive —
+        a hedge should fire only when THIS request is already in the
+        tail), else a 50 ms cold-start default."""
+        if self._hedge_delay_s is not None:
+            return self._hedge_delay_s
+        if len(self._read_lat) >= 8:
+            lat = sorted(self._read_lat)
+            return lat[int(0.99 * (len(lat) - 1))]
+        return 0.05
+
+    def _request_hedged(self, msg_type: MsgType, payload: Any, codec: int,
+                        io_timeout: Optional[float] = None) -> Any:
+        """One attempt of an idempotent read with tail-latency hedging:
+        the primary runs on the persistent connection; if its reply
+        hasn't landed within :meth:`hedge_delay_s`, the SAME request is
+        issued to the next replica over a one-shot connection and the
+        first success wins. When the hedge wins, the primary's socket
+        is force-closed so its worker thread (and the connection lock)
+        are released promptly instead of waiting out a slow reply.
+        Reads are idempotent by taxonomy, so duplicated execution is
+        harmless; failures surface exactly like an unhedged attempt
+        (the retry loop above classifies them).
+
+        Cost note: the primary runs on a short-lived thread so the
+        caller can time it — ~tens of µs per read, small against a
+        loopback RPC and irrelevant against the tail latencies hedging
+        exists to cut. Clients that never want that overhead simply
+        don't pass ``replicas``."""
+        t0 = time.perf_counter()
+        results: "_queue.Queue" = _queue.Queue()
+
+        def attempt(tag, fn):
+            try:
+                results.put((tag, None, fn()))
+            except BaseException as e:  # noqa: BLE001 — re-raised below
+                results.put((tag, e, None))
+
+        threading.Thread(
+            target=attempt, daemon=True,
+            args=("primary", lambda: self._request_once(
+                msg_type, payload, codec, io_timeout=io_timeout)),
+        ).start()
+        try:
+            tag, err, val = results.get(timeout=self.hedge_delay_s())
+        except _queue.Empty:
+            self.hedges_issued += 1
+            addr = self._replicas[self._hedge_rr % len(self._replicas)]
+            self._hedge_rr += 1
+            threading.Thread(
+                target=attempt, daemon=True,
+                args=("hedge", lambda: self._oneshot_request(
+                    msg_type, payload, codec, io_timeout=io_timeout,
+                    address=addr)),
+            ).start()
+            tag, err, val = results.get()
+            if err is not None:
+                # first responder failed — wait for the straggler
+                tag2, err2, val2 = results.get()
+                if err2 is None:
+                    tag, err, val = tag2, None, val2
+                elif tag == "hedge":
+                    tag, err = "primary", err2  # prefer the primary's error
+        if err is not None:
+            raise err
+        if tag == "hedge":
+            self.hedges_won += 1
+            # release the primary (it holds _lock until its recv ends)
+            self._force_close()
+            # if the primary ALREADY finished and released the lock,
+            # nobody else will reap the now-closed socket — a later
+            # request would find it non-None, fail, and burn a retry
+            # attempt. Non-blocking: when the primary still holds the
+            # lock, its own failure path drops the connection.
+            if self._lock.acquire(blocking=False):
+                try:
+                    self._drop_connection()
+                finally:
+                    self._lock.release()
+        self._read_lat.append(time.perf_counter() - t0)
+        return val
 
     def _drop_connection(self) -> None:
         """Tear down the persistent socket (idempotent, never raises);
@@ -435,24 +669,124 @@ class RemoteClient:
                        "source": source})
 
     # --- data path ----------------------------------------------------
-    def send_data(self, db: str, set_name: str, items: Sequence[Any]) -> None:
-        self._request(MsgType.SEND_DATA,
-                      {"db": db, "set": set_name, "items": list(items)},
-                      codec=CODEC_PICKLE)
+
+    #: below this many items, ``send_data`` keeps the single-frame path
+    #: (a BEGIN/COMMIT conversation is pure overhead for tiny batches)
+    PIPELINE_MIN_ITEMS = 64
+
+    def _item_chunks(self, items: list, chunk_bytes: int):
+        """Adaptive item batching — ``scan_stream``'s frame sizing
+        applied to the SEND direction: the first chunk holds one item
+        (never pack an unmeasured batch), then the batch size tracks
+        observed bytes-per-item with growth capped at 4×/chunk. Each
+        blob rides as a uint8 view so the pickled bytes go out-of-band
+        (no msgpack body copy)."""
+        import pickle
+
+        def chunks():
+            i = 0
+            target = 1
+            while i < len(items):
+                batch = items[i:i + target]
+                blob = pickle.dumps(batch, protocol=pickle.HIGHEST_PROTOCOL)
+                yield {"n": len(batch), "blob": np.frombuffer(blob, np.uint8)}
+                per_item = max(len(blob) // len(batch), 1)
+                target = max(1, min(chunk_bytes // per_item, 4 * target))
+                i += len(batch)
+
+        return chunks
+
+    def send_data(self, db: str, set_name: str, items: Sequence[Any],
+                  pipeline: Optional[bool] = None,
+                  chunk_bytes: Optional[int] = None) -> None:
+        """Object ingest. Large batches stream as bounded chunks under
+        the depth-W windowed-ack pipeline (``pipeline=None`` decides by
+        item count; force ``True``/``False`` to pin a path — the bench
+        pins both to record the streamed-vs-monolithic win)."""
+        items = list(items)
+        use = (pipeline if pipeline is not None
+               else len(items) >= self.PIPELINE_MIN_ITEMS)
+        if not use:
+            self._request(MsgType.SEND_DATA,
+                          {"db": db, "set": set_name, "items": items},
+                          codec=CODEC_PICKLE)
+            return
+        cb = int(chunk_bytes or self.ingest_chunk_bytes)
+        self._bulk_request(
+            MsgType.SEND_DATA,
+            {"db": db, "set": set_name, "mode": "items"},
+            self._item_chunks(items, cb))
+
+    def _table_chunks(self, table, chunk_bytes: int):
+        """Row-range slices of a ColumnTable's columns: numpy views
+        (zero copy) that ride as out-of-band segments — the zero-copy
+        bulk-table path. The dictionaries travel once in the BEGIN
+        meta; every chunk shares them."""
+        cols = {k: np.ascontiguousarray(np.asarray(v))
+                for k, v in table.cols.items()}
+        nrows = table.num_rows
+        row_bytes = max(1, sum(c.dtype.itemsize for c in cols.values()))
+        per_chunk = max(1, chunk_bytes // row_bytes)
+
+        def chunks():
+            for start in range(0, max(nrows, 1), per_chunk):
+                stop = min(nrows, start + per_chunk)
+                yield {"rows": [start, stop],
+                       "cols": {k: v[start:stop] for k, v in cols.items()}}
+
+        return chunks
 
     def send_table(self, db: str, set_name: str, rows_or_table,
                    date_cols: Sequence[str] = (),
-                   append: bool = False) -> "RemoteTableInfo":
+                   append: bool = False,
+                   pipeline: Optional[bool] = None,
+                   chunk_bytes: Optional[int] = None) -> "RemoteTableInfo":
         """Ship rows (or a pre-built ColumnTable) for daemon-side
         columnar ingest — dictionary encoding + the set's placement
         happen server-side, where the devices are. Returns a
         :class:`RemoteTableInfo` quacking like the ingested table's
         summary (``num_rows``/``columns``), mirroring the in-process
-        facade without pulling the whole table back."""
+        facade without pulling the whole table back.
+
+        Bulk payloads stream: a ColumnTable goes out as row-range
+        column slices riding out-of-band segments (zero host-side
+        copies of the column bytes); a rows list goes out as adaptive
+        pickled batches. Both run ``ingest_window`` chunks deep under
+        the windowed-ack pipeline. ``pipeline=None`` decides by size;
+        pin ``True``/``False`` to force a path."""
         from netsdb_tpu.relational.table import ColumnTable
 
-        items = (rows_or_table if isinstance(rows_or_table, ColumnTable)
-                 else list(rows_or_table))
+        cb = int(chunk_bytes or self.ingest_chunk_bytes)
+        if isinstance(rows_or_table, ColumnTable):
+            table = rows_or_table
+            if table.valid is not None:
+                table = table.compact()
+            nbytes = sum(np.asarray(v).nbytes for v in table.cols.values())
+            use = pipeline if pipeline is not None else nbytes >= cb
+            if use:
+                reply = self._bulk_request(
+                    MsgType.SEND_DATA,
+                    {"db": db, "set": set_name, "mode": "table",
+                     "date_cols": list(date_cols), "append": append,
+                     "dicts": {k: list(v) for k, v in table.dicts.items()},
+                     "nrows": table.num_rows},
+                    self._table_chunks(table, cb))
+                return RemoteTableInfo(reply["count"],
+                                       list(reply["columns"]))
+            items = table
+        else:
+            items = list(rows_or_table)
+            use = (pipeline if pipeline is not None
+                   else len(items) >= self.PIPELINE_MIN_ITEMS)
+            if use:
+                reply = self._bulk_request(
+                    MsgType.SEND_DATA,
+                    {"db": db, "set": set_name, "mode": "items",
+                     "as_table": True, "date_cols": list(date_cols),
+                     "append": append},
+                    self._item_chunks(items, cb))
+                return RemoteTableInfo(reply["count"],
+                                       list(reply["columns"]))
         reply = self._request(
             MsgType.SEND_DATA,
             {"db": db, "set": set_name, "items": items,
@@ -524,12 +858,15 @@ class RemoteClient:
                 meta = frame["meta"]
                 buf = bytearray(meta["nbytes"])
             else:
-                b = frame["b"]
-                buf[off:off + len(b)] = b
-                off += len(b)
+                b = frame["b"]  # uint8 ndarray (out-of-band) or bytes
+                n = b.nbytes if isinstance(b, np.ndarray) else len(b)
+                buf[off:off + n] = b if not isinstance(b, np.ndarray) \
+                    else memoryview(b)
+                off += n
         if meta is None:
             raise ProtocolError("empty chunked-tensor stream")
-        dense = np.frombuffer(bytes(buf), dtype=np.dtype(meta["dtype"])
+        # frombuffer over the assembled bytearray: writable, no copy
+        dense = np.frombuffer(buf, dtype=np.dtype(meta["dtype"])
                               ).reshape(meta["shape"])
         return RemoteTensor(dense, meta.get("block_shape"))
 
@@ -634,6 +971,25 @@ class RemoteClient:
             if not done:
                 self._drop_connection()
             self._lock.release()
+
+    def resync_follower(self, snapshot_blob, step: int,
+                        chunk_bytes: int = 8 << 20) -> Dict[str, Any]:
+        """Stream a leader store snapshot (``checkpoint.dumps_store``
+        bytes) to this daemon in bounded frames under the windowed-ack
+        pipeline — follower resync with NO shared-filesystem
+        assumption (the snapshot never touches the follower's disk).
+        Chunks are memoryview slices of the blob riding out-of-band
+        (zero copies leader-side)."""
+        mv = memoryview(snapshot_blob)
+
+        def chunks():
+            for off in range(0, max(mv.nbytes, 1), chunk_bytes):
+                yield {"blob": np.frombuffer(mv[off:off + chunk_bytes],
+                                             np.uint8)}
+
+        return self._bulk_request(
+            MsgType.RESYNC_FOLLOWER,
+            {"step": int(step), "nbytes": mv.nbytes}, chunks)
 
     def dedup_resident(self, sets: Sequence[Tuple[str, str]],
                        bands: int = 16, seed: int = 0) -> Dict[str, Any]:

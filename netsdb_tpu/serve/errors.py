@@ -134,6 +134,13 @@ class AuthError(RemoteError):
     """Handshake refused — fatal, retrying cannot help."""
 
 
+class ProtocolVersionError(RemoteError):
+    """The peer speaks a different wire-format version (HELLO carries
+    ``proto``; see ``protocol.PROTO_VERSION``). Fatal by construction:
+    a v2 peer would misparse a v3 out-of-band segment table as body
+    bytes, so mixed-version connections are refused at handshake."""
+
+
 class DeadlineExceededError(RemoteError):
     """The per-request deadline expired before a retry could succeed.
     Deliberately NOT retryable: the budget is spent; the caller decides
@@ -145,6 +152,7 @@ _KIND_MAP: Dict[str, type] = {
     "FollowerDegraded": FollowerDegradedError,
     "CorruptFrame": CorruptFrameError,
     "AuthError": AuthError,
+    "ProtocolVersionError": ProtocolVersionError,
 }
 
 

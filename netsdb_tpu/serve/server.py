@@ -43,7 +43,10 @@ from netsdb_tpu.serve.errors import (
 )
 from netsdb_tpu.serve.protocol import (
     CODEC_MSGPACK,
+    CODEC_PICKLE,
     IDEMPOTENCY_KEY,
+    MAX_FRAME_BYTES,
+    PROTO_VERSION,
     MsgType,
     ProtocolError,
     decode_body,
@@ -246,6 +249,130 @@ class _IdempotencyCache:
             ev.set()
 
 
+def _blob_view(b) -> memoryview:
+    """Chunk blob → memoryview. Out-of-band blobs arrive as writable
+    uint8 arrays, small inline ones as bytes; both are buffers."""
+    return memoryview(b)
+
+
+class _BulkAssembler:
+    """Server half of one streamed-ingest conversation: ``add`` decodes
+    a chunk as it lands (OUTSIDE any set lock — the windowed pipeline
+    overlaps this work with the client's next sends), ``finish`` builds
+    the payload the target op's handler applies under its normal
+    ordering locks at COMMIT."""
+
+    def __init__(self, meta: dict):
+        self.meta = meta
+        self.chunks = 0
+
+    def add(self, payload: dict) -> None:
+        raise NotImplementedError
+
+    def finish(self) -> Tuple[dict, int]:
+        raise NotImplementedError
+
+
+class _ItemsAssembler(_BulkAssembler):
+    """Pickled item batches (object rows / as_table row dicts)."""
+
+    def __init__(self, meta: dict, allow_pickle: bool):
+        super().__init__(meta)
+        if not allow_pickle:
+            raise ProtocolError(
+                "bulk item ingest refused: chunks carry pickle and this "
+                "daemon has allow_pickle off")
+        self.items: list = []
+
+    def add(self, payload: dict) -> None:
+        import pickle
+
+        self.items.extend(pickle.loads(_blob_view(payload["blob"])))
+        self.chunks += 1
+
+    def finish(self) -> Tuple[dict, int]:
+        out = {"db": self.meta["db"], "set": self.meta["set"],
+               "items": self.items}
+        if self.meta.get("as_table"):
+            out.update(as_table=True,
+                       date_cols=list(self.meta.get("date_cols") or ()),
+                       append=bool(self.meta.get("append")))
+        return out, CODEC_PICKLE
+
+
+class _TableAssembler(_BulkAssembler):
+    """Row-range column slices of one ColumnTable: the full columns are
+    preallocated from the BEGIN meta (``nrows``) on the first chunk and
+    each chunk lands at its row offset INSIDE ``add`` — the assembly
+    copy overlaps the client's in-flight sends instead of serializing
+    at COMMIT. ``finish`` only rebuilds the table around the filled
+    arrays (with the dictionaries that traveled once in BEGIN) after
+    checking row coverage."""
+
+    def __init__(self, meta: dict):
+        super().__init__(meta)
+        self.nrows = int(meta.get("nrows") or 0)
+        self.cols: Optional[Dict[str, np.ndarray]] = None
+        self.filled = 0
+
+    def add(self, payload: dict) -> None:
+        start, stop = (int(v) for v in payload["rows"])
+        if self.cols is None:
+            self.cols = {
+                name: np.empty((self.nrows,) + np.asarray(arr).shape[1:],
+                               np.asarray(arr).dtype)
+                for name, arr in payload["cols"].items()}
+        for name, arr in payload["cols"].items():
+            self.cols[name][start:stop] = np.asarray(arr)
+        self.filled += stop - start
+        self.chunks += 1
+
+    def finish(self) -> Tuple[dict, int]:
+        from netsdb_tpu.relational.table import ColumnTable
+
+        if self.filled != self.nrows or self.cols is None:
+            raise CorruptFrame(
+                f"bulk table stream covered {self.filled} of "
+                f"{self.nrows} rows")
+        table = ColumnTable(
+            self.cols,
+            {k: list(v) for k, v in (self.meta.get("dicts") or {}).items()},
+            None)
+        return {"db": self.meta["db"], "set": self.meta["set"],
+                "items": table, "as_table": True,
+                "date_cols": list(self.meta.get("date_cols") or ()),
+                "append": bool(self.meta.get("append"))}, CODEC_PICKLE
+
+
+class _BlobAssembler(_BulkAssembler):
+    """Opaque byte stream (the wire-streamed RESYNC_FOLLOWER snapshot):
+    chunks land in a preallocated buffer at their running offset."""
+
+    def __init__(self, meta: dict):
+        super().__init__(meta)
+        self.buf = bytearray(int(meta.get("nbytes") or 0))
+        self.off = 0
+
+    def add(self, payload: dict) -> None:
+        mv = _blob_view(payload["blob"])
+        end = self.off + mv.nbytes
+        if end > len(self.buf):
+            # more bytes than BEGIN declared: a torn/duplicated stream
+            # (or a lying peer) — refuse instead of growing unbounded
+            raise CorruptFrame(
+                f"bulk blob stream overflowed its declared "
+                f"{len(self.buf)} bytes at offset {self.off}")
+        self.buf[self.off:end] = mv
+        self.off = end
+        self.chunks += 1
+
+    def finish(self) -> Tuple[dict, int]:
+        out = dict(self.meta)
+        out.pop("nbytes", None)
+        out["snapshot_blob"] = memoryview(self.buf)[:self.off]  # no copy
+        return out, CODEC_PICKLE
+
+
 class ServeController:
     """The daemon. ``start()`` runs the listener on a background thread
     (tests); ``serve_forever()`` blocks (the CLI ``serve`` command)."""
@@ -339,6 +466,9 @@ class ServeController:
         self._resync_idle = threading.Event()
         self._resync_idle.set()
         self._resync_seq = itertools.count(1)
+        #: how the last RESYNC_FOLLOWER restored ("wire" | "path") —
+        #: observability for the no-shared-fs acceptance test
+        self.last_resync_mode: Optional[str] = None
         self._idem = _IdempotencyCache()
         self.library = Client(config)  # the resident state
         # ORDERING MODEL for mirrored frames (the SPMD argument):
@@ -475,24 +605,35 @@ class ServeController:
                 typ, hello = recv_frame(conn, allow_pickle=False)
                 if typ != MsgType.HELLO:
                     raise ProtocolError("expected HELLO")
+                if hello.get("proto") != PROTO_VERSION:
+                    # mixed wire formats are refused OUTRIGHT: a v2 peer
+                    # would misparse a v3 segment table as body bytes
+                    send_frame(conn, MsgType.ERR, {
+                        "error": "ProtocolVersionError",
+                        "message": f"this daemon speaks wire format "
+                                   f"v{PROTO_VERSION}; peer sent "
+                                   f"proto={hello.get('proto')!r}",
+                        "retryable": False})
+                    return
                 if self.token and hello.get("token") != self.token:
                     send_frame(conn, MsgType.ERR,
                                {"error": "AuthError", "message": "bad token"})
                     return
                 send_frame(conn, MsgType.OK, {"server": "netsdb_tpu",
-                                              "version": 2})
+                                              "version": PROTO_VERSION})
                 conn.settimeout(None)
             except (ProtocolError, ConnectionError, OSError):
                 return
             while not self._stop.is_set():
                 try:
-                    typ, codec_in, raw = recv_frame_raw(
+                    typ, codec_in, raw, segs = recv_frame_raw(
                         conn, chaos=self._chaos,
                         mid_frame_timeout=self.frame_timeout_s)
                 except (ProtocolError, ConnectionError, OSError):
                     return
                 try:
-                    payload = decode_body(raw, codec_in, self.allow_pickle)
+                    payload = decode_body(raw, codec_in, self.allow_pickle,
+                                          segments=segs)
                 except ProtocolError as e:
                     # refused codec — deterministic, fatal to retry
                     if not self._send_err(conn, e, retryable=False):
@@ -510,6 +651,12 @@ class ServeController:
                     send_frame(conn, MsgType.OK, {})
                     self.shutdown()
                     return
+                if typ == MsgType.BULK_BEGIN:
+                    # windowed streamed ingest: a multi-frame
+                    # conversation owned by this worker thread
+                    if not self._handle_bulk(conn, payload):
+                        return
+                    continue
                 if not self._dispatch_frame(conn, typ, codec_in, payload):
                     return
 
@@ -551,7 +698,6 @@ class ServeController:
         half of the client's retry contract."""
         token = payload.pop(IDEMPOTENCY_KEY, None) \
             if isinstance(payload, dict) else None
-        handler = self.handlers.get(typ)
         try:
             if token is not None:
                 cached = self._idem.claim(token, wait_s=self.frame_timeout_s)
@@ -559,35 +705,7 @@ class ServeController:
                     reply_type, reply, codec = cached
                     self._send_reply(conn, reply_type, reply, codec)
                     return True
-            try:
-                if handler is None:
-                    raise ProtocolError(f"no handler for {typ!r}")
-                if self._follower_addrs and typ in self.MIRRORED:
-                    out = self._run_mirrored(typ, payload, codec_in, handler,
-                                             token=token)
-                else:
-                    out = handler(payload)
-            except FollowerDegraded as e:
-                # the LOCAL mutation applied; only the mirror failed.
-                # Cache the local reply under the token so the client's
-                # retry returns success instead of double-applying,
-                # then surface the typed retryable error for THIS
-                # attempt (the ambiguous-outcome contract).
-                if token is not None:
-                    if e.local_result is not None:
-                        self._idem.finish(
-                            token, self._normalize_reply(e.local_result))
-                    else:
-                        self._idem.abort(token)
-                    token = None
-                raise
-            except BaseException:
-                if token is not None:
-                    # transient or handler failure: nothing durable to
-                    # replay — release waiters so a retry re-executes
-                    self._idem.abort(token)
-                    token = None
-                raise
+            out = self._execute_frame(typ, payload, codec_in, token)
             if inspect.isgenerator(out):
                 # streaming handler: each yielded (type, payload
                 # [, codec]) goes out as its own frame; TCP
@@ -599,9 +717,6 @@ class ServeController:
                 # connection stays frame-synchronized. Streams are
                 # not idempotency-cached (mutating frames never
                 # stream).
-                if token is not None:
-                    self._idem.abort(token)
-                    token = None
                 for frame in out:
                     if len(frame) == 3:
                         f_type, f_payload, f_codec = frame
@@ -609,21 +724,173 @@ class ServeController:
                         (f_type, f_payload), f_codec = frame, CODEC_MSGPACK
                     self._send_reply(conn, f_type, f_payload, f_codec)
                 return True
-            result = self._normalize_reply(out)
-            if token is not None:
-                self._idem.finish(token, result)
-            self._send_reply(conn, *result)
+            self._send_reply(conn, *out)
             return True
         except BrokenPipeError:
             return False
         except Exception as e:  # handler errors go back as typed ERR
             return self._send_err(conn, e, with_traceback=True)
 
+    def _execute_frame(self, typ, payload, codec_in, token):
+        """Run one request's handler with the idempotency-token
+        lifecycle (the caller has already claimed ``token``). Returns a
+        generator (streaming handlers) or the normalized ``(type,
+        payload, codec)`` reply; on every exit path the token has been
+        finished or aborted exactly once. Shared by the per-frame
+        dispatch and the bulk-ingest COMMIT."""
+        handler = self.handlers.get(typ)
+        try:
+            if handler is None:
+                raise ProtocolError(f"no handler for {typ!r}")
+            if self._follower_addrs and typ in self.MIRRORED:
+                out = self._run_mirrored(typ, payload, codec_in, handler,
+                                         token=token)
+            else:
+                out = handler(payload)
+        except FollowerDegraded as e:
+            # the LOCAL mutation applied; only the mirror failed.
+            # Cache the local reply under the token so the client's
+            # retry returns success instead of double-applying,
+            # then surface the typed retryable error for THIS
+            # attempt (the ambiguous-outcome contract).
+            if token is not None:
+                if e.local_result is not None:
+                    self._idem.finish(
+                        token, self._normalize_reply(e.local_result))
+                else:
+                    self._idem.abort(token)
+            raise
+        except BaseException:
+            if token is not None:
+                # transient or handler failure: nothing durable to
+                # replay — release waiters so a retry re-executes
+                self._idem.abort(token)
+            raise
+        if inspect.isgenerator(out):
+            # streams are not idempotency-cached (mutating frames
+            # never stream)
+            if token is not None:
+                self._idem.abort(token)
+            return out
+        result = self._normalize_reply(out)
+        if token is not None:
+            self._idem.finish(token, result)
+        return result
+
     @staticmethod
     def _normalize_reply(out) -> Tuple[MsgType, Any, int]:
         if len(out) == 3:  # handler picked the reply codec
             return out[0], out[1], out[2]
         return out[0], out[1], CODEC_MSGPACK
+
+    # --- windowed bulk ingest (BULK_BEGIN/CHUNK/COMMIT) ---------------
+
+    #: ops that accept the streamed-ingest conversation; anything else
+    #: in a BULK_BEGIN is a deterministic protocol violation
+    BULK_OPS = frozenset({MsgType.SEND_DATA, MsgType.RESYNC_FOLLOWER})
+
+    def _bulk_assembler(self, op: MsgType, meta: dict) -> "_BulkAssembler":
+        if op == MsgType.RESYNC_FOLLOWER:
+            return _BlobAssembler(meta)
+        if meta.get("mode") == "table":
+            return _TableAssembler(meta)
+        return _ItemsAssembler(meta, self.allow_pickle)
+
+    def _handle_bulk(self, conn, p) -> bool:
+        """One streamed-ingest conversation: BEGIN (already decoded in
+        ``p``) → N CHUNK frames, each acked AFTER it decodes so the
+        client pipelines ``window`` chunks deep → COMMIT, which
+        assembles the payload and dispatches it through the normal
+        handler path (mirroring + ordering locks + idempotency all
+        apply at commit — chunks decode OUTSIDE the per-set lock, the
+        apply runs under it). Returns False when the connection must
+        close (transport desync or a mid-stream fault: the chunk
+        stream cannot be resynchronized, so the typed ERR is sent and
+        the socket dropped — the client retries the whole conversation
+        under its idempotency token)."""
+        try:
+            op = MsgType(int(p.get("op", -1)))
+            if op not in self.BULK_OPS:
+                raise ProtocolError(
+                    f"op {p.get('op')!r} is not bulk-streamable")
+            meta = dict(p.get("meta") or {})
+        except (ProtocolError, ValueError) as e:
+            return self._send_err(conn, e, retryable=False)
+        token = p.get(IDEMPOTENCY_KEY)
+        if token is not None:
+            try:
+                cached = self._idem.claim(token, wait_s=self.frame_timeout_s)
+            except Exception as e:  # RequestInFlight → typed retryable
+                return self._send_err(conn, e)
+            if cached is not None:
+                # completed execution replay: the final reply goes out
+                # INSTEAD of "go" — the client skips streaming entirely
+                try:
+                    self._send_reply(conn, *cached)
+                    return True
+                except OSError:
+                    return False
+        owned = token is not None
+        try:
+            try:
+                asm = self._bulk_assembler(op, meta)
+            except ProtocolError as e:
+                # deterministic refusal (e.g. pickle chunks with
+                # allow_pickle off): typed fatal ERR instead of "go";
+                # the connection stays frame-synchronized
+                return self._send_err(conn, e, retryable=False)
+            self._send_reply(conn, MsgType.OK, {"go": True})
+            total_in = 0
+            while True:
+                typ, codec_in, raw, segs = recv_frame_raw(
+                    conn, chaos=self._chaos,
+                    mid_frame_timeout=self.frame_timeout_s)
+                total_in += len(raw) + sum(b.nbytes for b, _ in segs)
+                if total_in > MAX_FRAME_BYTES:
+                    # the streamed path keeps the single-frame sanity
+                    # cap — one conversation must not balloon daemon
+                    # RSS without bound before COMMIT validation
+                    self._send_err(conn, ProtocolError(
+                        f"bulk conversation exceeded the "
+                        f"{MAX_FRAME_BYTES}-byte cap"), retryable=False)
+                    return False
+                try:
+                    payload = decode_body(raw, codec_in, self.allow_pickle,
+                                          segments=segs)
+                except ProtocolError:
+                    raise
+                except Exception as e:
+                    raise CorruptFrame(f"{type(e).__name__}: {e}") from e
+                if typ == MsgType.BULK_CHUNK:
+                    asm.add(payload)  # decode work, outside any set lock
+                    self._send_reply(conn, MsgType.OK,
+                                     {"ack": payload.get("seq")})
+                elif typ == MsgType.BULK_COMMIT:
+                    if asm.chunks != int(payload.get("chunks", -1)):
+                        raise CorruptFrame(
+                            f"ingest stream torn: committed "
+                            f"{payload.get('chunks')} chunks, received "
+                            f"{asm.chunks}")
+                    final_payload, fwd_codec = asm.finish()
+                    owned = False  # _execute_frame consumes the token
+                    result = self._execute_frame(op, final_payload,
+                                                 fwd_codec, token)
+                    self._send_reply(conn, *result)
+                    return True
+                else:
+                    raise ProtocolError(
+                        f"unexpected frame {typ!r} inside a bulk-ingest "
+                        f"conversation")
+        except BrokenPipeError:
+            return False
+        except (ProtocolError, ConnectionError, OSError):
+            return False  # transport desync — client retries fresh
+        except Exception as e:
+            self._send_err(conn, e, with_traceback=True)
+            return False  # chunk stream unsynchronizable past a fault
+        finally:
+            if owned:
+                self._idem.abort(token)
 
     # --- multi-host mirroring (master → workers) ----------------------
     def _dial_follower(self, addr: str, timeout: Optional[float] = None):
@@ -769,7 +1036,13 @@ class ServeController:
         Reads never take these locks: the leader keeps serving them
         throughout (degraded mode is only a write-path pause). Old
         snapshot steps are pruned after success — a flapping follower
-        must not fill the leader's disk."""
+        must not fill the leader's disk.
+
+        The snapshot pickles ONCE, lands in the leader's local
+        checkpoint dir (durability), and STREAMS to the follower in
+        bounded frames over the wire (``RemoteClient.resync_follower``)
+        — no shared-filesystem assumption: leader and follower may run
+        with completely disjoint root dirs or on different hosts."""
         from netsdb_tpu.storage import checkpoint
 
         self._resync_idle.clear()
@@ -778,9 +1051,9 @@ class ServeController:
             with self._collective_lock:
                 step = next(self._resync_seq)
                 root = os.path.join(self.config.root_dir, "resync")
-                checkpoint.save_store(root, self._snapshot_state(), step)
-                fc._request(MsgType.RESYNC_FOLLOWER,
-                            {"path": root, "step": step})
+                blob = checkpoint.dumps_store(self._snapshot_state())
+                checkpoint.save_store_bytes(root, blob, step)
+                fc.resync_follower(blob, step)
                 # the resync client carries resync_timeout_s on every
                 # recv; the LINK must not (mirrored EXECUTEs may run
                 # for minutes) — so the readmitted link gets a fresh
@@ -852,16 +1125,25 @@ class ServeController:
 
     def _on_resync_follower(self, p):
         """Follower side: replace this daemon's store with the leader's
-        checkpoint snapshot (storage/checkpoint.py save_store). Loads
-        pickle from the given path — the codec-1 trust boundary, so it
-        requires allow_pickle (trusted-cluster control planes only)."""
+        snapshot. The primary form is ``snapshot_blob`` — the pickled
+        snapshot assembled from the wire-streamed bulk conversation
+        (no shared filesystem: the blob never touches this daemon's
+        disk); ``path`` remains as the legacy shared-fs form. Either
+        way the restore executes pickle — the codec-1 trust boundary,
+        so it requires allow_pickle (trusted-cluster control planes
+        only)."""
         if not self.allow_pickle:
             raise ProtocolError(
                 "RESYNC_FOLLOWER refused: snapshot restore executes "
                 "pickle and this daemon has allow_pickle off")
         from netsdb_tpu.storage import checkpoint
 
-        snap = checkpoint.load_store(p["path"], p.get("step"))
+        if "snapshot_blob" in p:
+            snap = checkpoint.loads_store(p["snapshot_blob"])
+            self.last_resync_mode = "wire"
+        else:
+            snap = checkpoint.load_store(p["path"], p.get("step"))
+            self.last_resync_mode = "path"
         for ident in list(self.library.store.list_sets()):
             self.library.remove_set(ident.db, ident.set)
         for db in snap["databases"]:
@@ -1430,8 +1712,11 @@ class ServeController:
                     "nchunks": max(1, -(-nbytes // chunk))}}
             seq = 1
             for off in range(0, max(nbytes, 1), chunk):
+                # uint8 view over the dense buffer: the chunk rides as
+                # an out-of-band segment — no per-chunk byte copy
                 yield MsgType.STREAM_ITEM, {
-                    "seq": seq, "b": bytes(view[off:off + chunk])}
+                    "seq": seq,
+                    "b": np.frombuffer(view[off:off + chunk], np.uint8)}
                 seq += 1
             yield MsgType.STREAM_END, {"frames": seq}
 

@@ -136,21 +136,43 @@ def restore(root: str, target: Any, step: Optional[int] = None) -> Any:
 _STORE_FILE = "store.pkl"
 
 
-def save_store(root: str, snapshot: Any, step: int) -> str:
-    """Persist ``snapshot`` (any picklable object — the serve layer
-    passes its databases/sets/types dump) as ``root/step_<step>``.
-    Atomic per step: the file lands via rename, so a reader never
-    observes a torn snapshot. Returns the step directory."""
+def dumps_store(snapshot: Any) -> bytes:
+    """Snapshot → one pickle blob. The serve layer's wire-streamed
+    follower resync pickles ONCE and both writes the blob locally
+    (:func:`save_store_bytes`, leader durability) and streams it to the
+    follower in bounded frames — no shared-filesystem assumption."""
     import pickle
 
+    return pickle.dumps(snapshot, protocol=pickle.HIGHEST_PROTOCOL)
+
+
+def loads_store(blob) -> Any:
+    """Inverse of :func:`dumps_store`; accepts any bytes-like buffer
+    (the resync handler passes the assembled chunk stream). Same
+    codec-1 trust boundary as :func:`load_store`."""
+    import pickle
+
+    return pickle.loads(blob)
+
+
+def save_store_bytes(root: str, blob, step: int) -> str:
+    """Persist an already-pickled snapshot blob as ``root/step_<step>``.
+    Atomic per step: the file lands via rename, so a reader never
+    observes a torn snapshot. Returns the step directory."""
     path = _step_dir(root, step)
     os.makedirs(path, exist_ok=True)
     final = os.path.join(path, _STORE_FILE)
     tmp = final + ".tmp"
     with open(tmp, "wb") as f:
-        pickle.dump(snapshot, f, protocol=pickle.HIGHEST_PROTOCOL)
+        f.write(blob)
     os.replace(tmp, final)
     return path
+
+
+def save_store(root: str, snapshot: Any, step: int) -> str:
+    """Persist ``snapshot`` (any picklable object — the serve layer
+    passes its databases/sets/types dump) as ``root/step_<step>``."""
+    return save_store_bytes(root, dumps_store(snapshot), step)
 
 
 def prune_steps(root: str, keep: int = 1) -> list:

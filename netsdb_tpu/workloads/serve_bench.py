@@ -287,6 +287,161 @@ def run_stream_bench(rows: int = 50_000, row_bytes: int = 2000,
     return out
 
 
+def run_data_plane_bench(table_mb: int = 64, chunk_mb: int = 8,
+                         window: int = 4,
+                         hedge_reads: int = 40) -> Dict[str, Any]:
+    """v3 data-plane numbers on loopback: bulk-table ingest MB/s for
+    the pre-change single-frame path (one pickled monolith) vs the
+    streamed pipelined path (row-range column slices riding out-of-band
+    segments, ``window`` chunks in flight), streamed scan MB/s, tensor
+    push/pull MB/s over the zero-copy framing, and hedged-read p99
+    against a tail-latency-injected primary.
+
+    The daemon runs as a REAL subprocess (like ``run_serve_bench``):
+    pipelining only overlaps client encode/send with server
+    decode/apply when the two sides don't share a GIL."""
+    import tempfile
+
+    import numpy as np
+
+    from netsdb_tpu.config import Configuration
+    from netsdb_tpu.relational.table import ColumnTable
+    from netsdb_tpu.serve.chaos import ChaosInjector
+    from netsdb_tpu.serve.client import RemoteClient, RetryPolicy
+    from netsdb_tpu.serve.server import ServeController
+
+    out: Dict[str, Any] = {"table_mb": table_mb, "chunk_mb": chunk_mb,
+                           "window": window}
+    nrows = table_mb * (1 << 20) // 8  # two f32/int32 columns per row
+    cols = {"a": np.arange(nrows, dtype=np.int32),
+            "b": np.random.default_rng(0).standard_normal(nrows)
+            .astype(np.float32)}
+    table = ColumnTable(dict(cols), {}, None)
+    payload_mb = sum(c.nbytes for c in cols.values()) / 2**20
+
+    host = "127.0.0.1"
+    port = _free_port()
+    env = dict(os.environ)
+    env.setdefault("JAX_PLATFORMS", "cpu")
+    daemon = subprocess.Popen(
+        [_python(), "-m", "netsdb_tpu", "serve", "--port", str(port),
+         "--root", tempfile.mkdtemp(prefix="dataplane_bench_")],
+        env=env, stdout=subprocess.DEVNULL, stderr=subprocess.DEVNULL,
+        cwd=os.path.dirname(os.path.dirname(os.path.dirname(
+            os.path.abspath(__file__)))),
+    )
+    try:
+        _wait_port(host, port)
+        c = RemoteClient(f"{host}:{port}", ingest_window=window,
+                         ingest_chunk_bytes=chunk_mb << 20)
+        c.create_database("b")
+
+        def ingest(set_name: str, pipeline: bool, repeats: int = 2) -> float:
+            """Best-of-N wall time of one full ingest (machine-load
+            noise on shared hosts dwarfs run-to-run variance)."""
+            best = None
+            for r in range(repeats):
+                name = f"{set_name}{r}"
+                c.create_set("b", name, type_name="table")
+                t0 = time.perf_counter()
+                c.send_table("b", name, table, pipeline=pipeline)
+                dt = time.perf_counter() - t0
+                best = dt if best is None else min(best, dt)
+            return best
+
+        ingest("warm", True, repeats=1)  # compile/alloc warmup, excluded
+        t_single = ingest("single", False)
+        t_stream = ingest("streamed", True)
+        out["ingest"] = {
+            "payload_mb": round(payload_mb, 1),
+            "single_frame_s": round(t_single, 3),
+            "single_frame_mb_per_s": round(payload_mb / t_single, 1),
+            "streamed_s": round(t_stream, 3),
+            "streamed_mb_per_s": round(payload_mb / t_stream, 1),
+            "speedup": round(t_single / t_stream, 2),
+        }
+
+        t0 = time.perf_counter()
+        back = c.get_table_streamed("b", "streamed0",
+                                    max_frame_bytes=chunk_mb << 20)
+        t_scan = time.perf_counter() - t0
+        assert back.num_rows == nrows
+        out["scan"] = {"streamed_s": round(t_scan, 3),
+                       "streamed_mb_per_s": round(payload_mb / t_scan, 1)}
+
+        side = int((table_mb * (1 << 20) / 4) ** 0.5) // 128 * 128
+        dense = np.random.default_rng(1).standard_normal(
+            (side, side)).astype(np.float32)
+        c.create_set("b", "w")
+        t0 = time.perf_counter()
+        c.send_matrix("b", "w", dense, (512, 512))
+        t_push = time.perf_counter() - t0
+        t0 = time.perf_counter()
+        got = c.get_tensor("b", "w").to_dense()
+        t_pull = time.perf_counter() - t0
+        assert got.shape == dense.shape
+        mb = dense.nbytes / 2**20
+        out["tensor"] = {
+            "payload_mb": round(mb, 1),
+            "push_mb_per_s": round(mb / t_push, 1),
+            "pull_mb_per_s": round(mb / t_pull, 1),
+        }
+        c.close()
+
+        # hedged reads: a replica daemon + a primary whose replies
+        # stall with seeded probability — p99 with hedging should sit
+        # near the replica RTT, not the injected stall
+        pchaos = ChaosInjector(seed=7, delay=0.25, delay_s=0.15)
+        slow = ServeController(Configuration(root_dir=tempfile.mkdtemp(
+            prefix="dataplane_slow_")), port=0, chaos=pchaos)
+        sport = slow.start()
+        try:
+            small = np.arange(64 * 64, dtype=np.float32).reshape(64, 64)
+            for p in (sport, port):
+                boot = RemoteClient(f"127.0.0.1:{p}")
+                boot.create_database("h")
+                boot.create_set("h", "w")
+                boot.send_matrix("h", "w", small, (32, 32))
+                boot.close()
+
+            def read_p99(client) -> Dict[str, float]:
+                lat = []
+                for _ in range(hedge_reads):
+                    t0 = time.perf_counter()
+                    client.get_tensor("h", "w")
+                    lat.append(time.perf_counter() - t0)
+                lat.sort()
+                return {"p50_ms": round(lat[len(lat) // 2] * 1e3, 2),
+                        "p99_ms": round(lat[int(0.99 * (len(lat) - 1))]
+                                        * 1e3, 2)}
+
+            plain = RemoteClient(f"127.0.0.1:{sport}",
+                                 retry=RetryPolicy(max_attempts=2))
+            unhedged = read_p99(plain)
+            plain.close()
+            hedged_c = RemoteClient(f"127.0.0.1:{sport}",
+                                    replicas=[f"127.0.0.1:{port}"],
+                                    hedge_delay_s=0.02,
+                                    retry=RetryPolicy(max_attempts=2))
+            hedged = read_p99(hedged_c)
+            out["hedged_reads"] = {
+                "injected_stall_ms": 150, "stall_rate": 0.25,
+                "unhedged": unhedged, "hedged": hedged,
+                "hedges_issued": hedged_c.hedges_issued,
+                "hedges_won": hedged_c.hedges_won,
+            }
+            hedged_c.close()
+        finally:
+            slow.shutdown()
+    finally:
+        daemon.terminate()
+        try:
+            daemon.wait(timeout=10)
+        except subprocess.TimeoutExpired:
+            daemon.kill()
+    return out
+
+
 def main(argv=None) -> int:
     import argparse
 
@@ -301,10 +456,17 @@ def main(argv=None) -> int:
     ap.add_argument("--stream", action="store_true",
                     help="transfer-path comparison: single-frame vs "
                          "streamed scan / chunked tensor")
+    ap.add_argument("--data-plane", action="store_true",
+                    help="v3 data-plane numbers: single-frame vs "
+                         "streamed pipelined ingest MB/s, scan MB/s, "
+                         "zero-copy tensor push/pull, hedged-read p99")
+    ap.add_argument("--table-mb", type=int, default=64)
     args = ap.parse_args(argv)
     if args.worker:
         out = run_client_worker(args.address, args.client_id, args.jobs,
                                 args.batch)
+    elif args.data_plane:
+        out = run_data_plane_bench(table_mb=args.table_mb)
     elif args.stream:
         out = run_stream_bench()
     else:

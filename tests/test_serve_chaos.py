@@ -292,6 +292,170 @@ def test_store_snapshot_roundtrip(tmp_path):
                                snap["sets"][1]["dense"])
 
 
+# --- v3 data plane: out-of-band segments + pipelined ingest ------------
+
+def test_corrupt_oob_segment_is_detected_and_retried(server):
+    """A bit flip INSIDE an out-of-band tensor segment — where msgpack's
+    own framing cannot see it — must fail the per-segment adler32 →
+    typed retryable CorruptFrame; the resend applies exactly once."""
+    from netsdb_tpu.serve.protocol import MsgType, OOB_MIN_BYTES
+
+    ctl, addr, _ = server
+    chaos = ChaosInjector()
+    c = RemoteClient(addr, retry=FAST, chaos=chaos)
+    c.create_database("d")
+    c.create_set("d", "w")
+    side = max(64, int((OOB_MIN_BYTES * 4 / 4) ** 0.5))
+    a = np.arange(side * side, dtype=np.float32).reshape(side, side)
+    chaos.arm("corrupt_seg", types=[MsgType.SEND_MATRIX])
+    c.send_matrix("d", "w", a, (32, 32))
+    assert c.last_attempts >= 2
+    assert any(f[0] == "corrupt_seg" for f in chaos.faults)
+    np.testing.assert_array_equal(
+        np.asarray(ctl.library.get_tensor("d", "w").to_dense()), a)
+    c.close()
+
+
+def test_corrupt_oob_reply_segment_is_typed_and_retried(server):
+    """Same fault on the REPLY direction: the tensor segment of a
+    GET_TENSOR reply flips mid-wire → client-side checksum failure →
+    typed retryable CorruptFrameError → the (idempotent) read retries
+    and returns intact data."""
+    from netsdb_tpu.serve.protocol import MsgType
+
+    ctl, addr, srv_chaos = server
+    c = RemoteClient(addr, retry=FAST)
+    c.create_database("d")
+    c.create_set("d", "w")
+    a = np.random.default_rng(0).standard_normal((128, 128)).astype(
+        np.float32)
+    c.send_matrix("d", "w", a, (64, 64))
+    srv_chaos.arm("corrupt_seg", types=[MsgType.OK])
+    t = c.get_tensor("d", "w")
+    assert c.last_attempts >= 2
+    np.testing.assert_array_equal(t.to_dense(), a)
+    c.close()
+
+
+def test_truncate_inside_oob_segment_is_retried_exactly_once(server):
+    """The chaos cut lands INSIDE a tensor segment (header, segment
+    table and body all arrived whole): the server sees EOF mid-frame,
+    never executes, and the retry applies the mutation exactly once."""
+    from netsdb_tpu.serve.protocol import MsgType
+
+    ctl, addr, _ = server
+    chaos = ChaosInjector()
+    c = RemoteClient(addr, retry=FAST, chaos=chaos)
+    c.create_database("d")
+    c.create_set("d", "w")
+    a = np.ones((256, 256), np.float32) * 3
+    chaos.arm("truncate", types=[MsgType.SEND_MATRIX])
+    c.send_matrix("d", "w", a, (64, 64))
+    assert c.last_attempts >= 2
+    np.testing.assert_array_equal(
+        np.asarray(ctl.library.get_tensor("d", "w").to_dense()), a)
+    c.close()
+
+
+def test_dropped_mid_pipeline_chunk_retries_whole_ingest_once(server):
+    """A chunk dropped MID-PIPELINE (frames already in flight behind
+    it) aborts the conversation server-side; the client re-streams the
+    whole logical ingest under the same idempotency token and the set
+    holds exactly one copy."""
+    from netsdb_tpu.serve.protocol import MsgType
+
+    ctl, addr, _ = server
+    chaos = ChaosInjector()
+    c = RemoteClient(addr, retry=FAST, chaos=chaos)
+    c.create_database("d")
+    c.create_set("d", "s", type_name="object")
+    items = [{"i": i, "pad": "x" * 256} for i in range(400)]
+    chaos.arm("drop", types=[MsgType.BULK_CHUNK])
+    c.send_data("d", "s", items, pipeline=True, chunk_bytes=4 << 10)
+    assert c.last_attempts >= 2
+    assert _content(ctl, "d", "s") == list(range(400))
+    c.close()
+
+
+def test_corrupt_mid_pipeline_chunk_is_typed_and_applies_once(server):
+    """A corrupted ingest chunk fails decode server-side → typed
+    retryable CorruptFrame, conversation torn down; the retried stream
+    applies exactly once (no partial batch ever lands — apply happens
+    only at COMMIT)."""
+    from netsdb_tpu.serve.protocol import MsgType
+
+    ctl, addr, _ = server
+    chaos = ChaosInjector()
+    c = RemoteClient(addr, retry=FAST, chaos=chaos)
+    c.create_database("d")
+    c.create_set("d", "s", type_name="object")
+    items = [{"i": i, "pad": "y" * 200} for i in range(300)]
+    chaos.arm("corrupt", types=[MsgType.BULK_CHUNK])
+    c.send_data("d", "s", items, pipeline=True, chunk_bytes=4 << 10)
+    assert c.last_attempts >= 2
+    assert _content(ctl, "d", "s") == list(range(300))
+    c.close()
+
+
+def test_truncated_commit_restreams_exactly_once(server):
+    """The COMMIT frame dies mid-wire: nothing applied (apply is
+    commit-time), the retry re-streams, exactly one batch lands."""
+    from netsdb_tpu.serve.protocol import MsgType
+
+    ctl, addr, _ = server
+    chaos = ChaosInjector()
+    c = RemoteClient(addr, retry=FAST, chaos=chaos)
+    c.create_database("d")
+    c.create_set("d", "s", type_name="object")
+    chaos.arm("truncate", types=[MsgType.BULK_COMMIT])
+    c.send_data("d", "s", [{"i": i} for i in range(200)], pipeline=True,
+                chunk_bytes=1 << 10)
+    assert c.last_attempts >= 2
+    assert _content(ctl, "d", "s") == list(range(200))
+    c.close()
+
+
+def test_bulk_duplicate_token_replays_cached_reply(server):
+    """The ambiguous-outcome contract for STREAMED ingest: a second
+    conversation carrying the same idempotency token (the retry after
+    a lost final ack) is answered from the completed-reply cache at
+    BEGIN — the client never streams, the server never re-applies."""
+    import pickle
+
+    import numpy as _np
+
+    from netsdb_tpu.serve.protocol import IDEMPOTENCY_KEY, MsgType
+
+    ctl, addr, _ = server
+    c1 = RemoteClient(addr)
+    c1.create_database("d")
+    c1.create_set("d", "s", type_name="object")
+    items = [{"i": i} for i in range(50)]
+    begin = {"op": int(MsgType.SEND_DATA),
+             "meta": {"db": "d", "set": "s", "mode": "items"},
+             IDEMPOTENCY_KEY: "tok-bulk-dup-1"}
+
+    def chunks():
+        blob = pickle.dumps(items, protocol=pickle.HIGHEST_PROTOCOL)
+        yield {"n": len(items), "blob": _np.frombuffer(blob, _np.uint8)}
+
+    s1 = c1._dial()
+    try:
+        r1 = c1._bulk_once(s1, begin, chunks)
+    finally:
+        s1.close()
+    c2 = RemoteClient(addr)
+    s2 = c2._dial()
+    try:
+        r2 = c2._bulk_once(s2, begin, chunks)
+    finally:
+        s2.close()
+    assert r1 == r2
+    assert _content(ctl, "d", "s") == list(range(50))  # exactly once
+    c1.close()
+    c2.close()
+
+
 # --- follower kill / hang mid-mirror ----------------------------------
 
 @pytest.fixture()

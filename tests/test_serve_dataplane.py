@@ -1,0 +1,444 @@
+"""v3 data plane: out-of-band tensor framing, windowed pipelined
+ingest, wire-streamed resync, hedged reads.
+
+The zero-copy contract under test: tensor payloads cross the wire as
+raw out-of-band segments (scatter-gather send, writable ``frombuffer``
+views on receive — never a ``tobytes()`` copy), bulk ingest streams
+bounded chunks ``ingest_window`` deep instead of one monolithic frame,
+RESYNC_FOLLOWER needs no shared filesystem, and a client with replica
+addresses hedges tail-latency reads.
+"""
+
+import socket
+import struct
+import time
+
+import numpy as np
+import pytest
+
+from netsdb_tpu.serve import protocol
+from netsdb_tpu.serve.chaos import ChaosInjector
+from netsdb_tpu.serve.client import (
+    ProtocolVersionError,
+    RemoteClient,
+    RetryPolicy,
+)
+from netsdb_tpu.serve.protocol import (
+    CODEC_MSGPACK_OOB,
+    MsgType,
+    OOB_MIN_BYTES,
+    PROTO_VERSION,
+    recv_frame,
+    send_frame,
+)
+from netsdb_tpu.serve.server import ServeController
+
+
+@pytest.fixture()
+def daemon(config):
+    ctl = ServeController(config, port=0)
+    port = ctl.start()
+    rc = RemoteClient(f"127.0.0.1:{port}")
+    yield ctl, rc
+    rc.close()
+    ctl.shutdown()
+
+
+# --- frame layout ------------------------------------------------------
+
+class _FakeSock:
+    """Records the vectored-send call pattern of ``send_frame``."""
+
+    def __init__(self):
+        self.sendmsg_calls = []
+        self.sendall_calls = 0
+
+    def sendmsg(self, buffers):
+        bufs = [bytes(b) for b in buffers]
+        self.sendmsg_calls.append(bufs)
+        return sum(len(b) for b in bufs)
+
+    def sendall(self, data):
+        self.sendall_calls += 1
+
+
+def test_send_frame_is_one_vectored_send_for_small_frames():
+    """Satellite: header + small body leave in ONE sendmsg — they can
+    never split across TCP segments under TCP_NODELAY."""
+    s = _FakeSock()
+    send_frame(s, MsgType.PING, {"x": 1})
+    assert s.sendall_calls == 0
+    assert len(s.sendmsg_calls) == 1
+    header = s.sendmsg_calls[0][0]
+    magic, codec, typ, body_len = struct.unpack("!HBIQ", header)
+    assert (magic, codec, typ) == (protocol.MAGIC, protocol.CODEC_MSGPACK,
+                                   int(MsgType.PING))
+    assert sum(len(b) for b in s.sendmsg_calls[0][1:]) == body_len
+
+
+def test_big_arrays_ride_out_of_band_without_copies():
+    """A payload with a big ndarray upgrades to codec 2 and the array's
+    own buffer is gathered into the same sendmsg — the body carries
+    only the descriptor."""
+    s = _FakeSock()
+    a = np.arange(64 * 64, dtype=np.float32).reshape(64, 64)
+    send_frame(s, MsgType.SEND_MATRIX, {"tensor": {"data": a}})
+    assert len(s.sendmsg_calls) == 1
+    parts = s.sendmsg_calls[0]
+    header = parts[0]
+    _, codec, _, body_len = struct.unpack("!HBIQ", header)
+    assert codec == CODEC_MSGPACK_OOB
+    assert body_len < a.nbytes // 4  # metadata only, no inline bytes
+    assert parts[-1] == bytes(memoryview(a).cast("B"))  # the raw buffer
+
+
+def test_oob_segment_checksum_guards_decode():
+    body, segments = protocol.encode_body_oob(
+        {"t": np.ones(OOB_MIN_BYTES, np.uint8)})
+    assert len(segments) == 1
+    crc = protocol.segment_checksum(segments[0])
+    good = [(bytearray(segments[0]), crc)]
+    out = protocol.decode_body(body, CODEC_MSGPACK_OOB, False,
+                               segments=good)
+    np.testing.assert_array_equal(out["t"], np.ones(OOB_MIN_BYTES, np.uint8))
+    bad_buf = bytearray(segments[0])
+    bad_buf[10] ^= 0xFF
+    bad = [(bad_buf, crc)]
+    with pytest.raises(ValueError, match="checksum"):
+        protocol.decode_body(body, CODEC_MSGPACK_OOB, False, segments=bad)
+
+
+def test_segment_checksum_catches_single_bit_flips():
+    rng = np.random.default_rng(3)
+    for size in (1, 7, 8, 9, 1000, 4097):
+        data = bytearray(rng.integers(0, 256, size=size,
+                                      dtype=np.uint8).tobytes())
+        c0 = protocol.segment_checksum(memoryview(data))
+        for _ in range(16):
+            i = int(rng.integers(0, size))
+            bit = 1 << int(rng.integers(0, 8))
+            data[i] ^= bit
+            assert protocol.segment_checksum(memoryview(data)) != c0
+            data[i] ^= bit  # restore
+
+
+def test_decoded_tensors_are_writable(daemon):
+    """Satellite: decoded arrays must be writable — a caller mutating a
+    fetched tensor must not hit 'assignment destination is read-only'.
+    Covers the out-of-band path (big), the inline path (small) and the
+    chunked pull."""
+    ctl, rc = daemon
+    rc.create_database("d")
+    rc.create_set("d", "big")
+    rc.create_set("d", "small")
+    big = np.random.default_rng(1).standard_normal((128, 96)).astype(
+        np.float32)
+    small = np.arange(6, dtype=np.float32).reshape(2, 3)  # < OOB_MIN_BYTES
+    rc.send_matrix("d", "big", big, (64, 64))
+    rc.send_matrix("d", "small", small, (2, 2))
+    for name, want in (("big", big), ("small", small)):
+        got = rc.get_tensor("d", name).to_dense()
+        np.testing.assert_array_equal(got, want)
+        got[0, 0] = -42.0  # must not raise
+        assert got[0, 0] == -42.0
+    chunked = rc.get_tensor_chunked("d", "big", chunk_bytes=16 << 10
+                                    ).to_dense()
+    np.testing.assert_array_equal(chunked, big)
+    chunked[-1, -1] = 7.0  # writable, zero-copy over the assembly buffer
+
+
+def test_version_mismatch_is_refused_typed(daemon):
+    """Satellite: a peer speaking another wire version is rejected at
+    HELLO with the typed fatal ProtocolVersionError — mixed-version
+    frames never flow."""
+    ctl, rc = daemon
+    s = socket.create_connection(("127.0.0.1", ctl.port), timeout=5)
+    try:
+        send_frame(s, MsgType.HELLO, {"token": None, "proto": 2})
+        typ, reply = recv_frame(s, allow_pickle=False)
+        assert typ == MsgType.ERR
+        assert reply["error"] == "ProtocolVersionError"
+        assert reply["retryable"] is False
+        assert str(PROTO_VERSION) in reply["message"]
+    finally:
+        s.close()
+
+
+# --- windowed pipelined ingest ----------------------------------------
+
+def test_pipelined_send_data_roundtrips(daemon):
+    ctl, rc = daemon
+    rc.create_database("d")
+    rc.create_set("d", "objs", type_name="object")
+    items = [{"i": i, "pad": "x" * 300} for i in range(500)]
+    rc.send_data("d", "objs", items, pipeline=True, chunk_bytes=8 << 10)
+    assert list(rc.get_set_iterator("d", "objs")) == items
+
+
+def test_pipelined_column_table_ingest_and_append(daemon):
+    """The zero-copy bulk-table path: a client-side ColumnTable streams
+    as row-range column slices riding out-of-band segments; append=True
+    adds a second batch instead of replacing."""
+    from netsdb_tpu.relational.table import ColumnTable
+
+    ctl, rc = daemon
+    rc.create_database("d")
+    rc.create_set("d", "t", type_name="table")
+    n = 60_000
+    t = ColumnTable({"a": np.arange(n, dtype=np.int32),
+                     "b": np.arange(n, dtype=np.float32) * 0.5}, {}, None)
+    info = rc.send_table("d", "t", t, pipeline=True, chunk_bytes=64 << 10)
+    assert info.num_rows == n
+    back = rc.get_table("d", "t")
+    np.testing.assert_array_equal(np.asarray(back["a"]),
+                                  np.arange(n, dtype=np.int32))
+    np.testing.assert_allclose(np.asarray(back["b"]),
+                               np.arange(n, dtype=np.float32) * 0.5)
+    t2 = ColumnTable({"a": np.arange(n, n + 100, dtype=np.int32),
+                      "b": np.zeros(100, np.float32)}, {}, None)
+    rc.send_table("d", "t", t2, append=True, pipeline=True,
+                  chunk_bytes=64 << 10)
+    back = rc.get_table("d", "t")
+    assert np.asarray(back["a"]).shape[0] == n + 100
+
+
+def test_pipelined_rows_ingest_matches_single_frame(daemon):
+    """Rows (dict) ingest streamed as adaptive pickled batches equals
+    the monolithic path — dictionary encoding still happens
+    daemon-side."""
+    ctl, rc = daemon
+    rc.create_database("d")
+    rc.create_set("d", "r1", type_name="table")
+    rc.create_set("d", "r2", type_name="table")
+    rows = [{"k": f"key{i % 7}", "v": float(i)} for i in range(400)]
+    a = rc.send_table("d", "r1", rows, pipeline=False)
+    b = rc.send_table("d", "r2", rows, pipeline=True, chunk_bytes=4 << 10)
+    assert (a.num_rows, sorted(a.columns)) == (b.num_rows, sorted(b.columns))
+    t1, t2 = rc.get_table("d", "r1"), rc.get_table("d", "r2")
+    np.testing.assert_array_equal(np.asarray(t1["v"]), np.asarray(t2["v"]))
+    assert t1.dicts == t2.dicts
+
+
+def test_chunked_send_data_during_scan_stream_no_deadlock(daemon):
+    """Satellite: the `_stream_owner` oneshot rule must hold for the
+    WHOLE multi-frame bulk conversation — a chunked send_data issued
+    from the thread consuming scan_stream rides its own side
+    connection, never the streaming socket."""
+    ctl, rc = daemon
+    rc.create_database("d")
+    rc.create_set("d", "src", type_name="object")
+    rc.create_set("d", "dst", type_name="object")
+    rc.send_data("d", "src", [{"i": i, "pad": "w" * 500}
+                              for i in range(40)])
+    moved = 0
+    for item in rc.scan_stream("d", "src", max_frame_bytes=4 << 10):
+        # chunked (pipeline=True forces the BULK conversation) while
+        # the main connection is mid-stream
+        rc.send_data("d", "dst", [item] * 70, pipeline=True,
+                     chunk_bytes=2 << 10)
+        moved += 1
+    assert moved == 40
+    assert len(list(rc.get_set_iterator("d", "dst"))) == 40 * 70
+    assert rc.ping()["sets"] == 2  # main connection still healthy
+
+
+def test_ingest_window_is_pipelined_not_stop_and_wait(daemon):
+    """The client keeps up to ``ingest_window`` chunks in flight: with
+    a window of 4 and N chunks, the number of recv round-trips the
+    client blocks on before COMMIT is N (acks) but they overlap sends —
+    observable as every ack arriving strictly later than its chunk's
+    send while > 1 chunk was unacked at some point."""
+    ctl, rc = daemon
+    rc.create_database("d")
+    rc.create_set("d", "s", type_name="object")
+    sent_before_first_ack = []
+    orig_recv = RemoteClient._recv_reply
+
+    sends = {"n": 0}
+    orig_send = protocol.send_frame
+
+    def counting_send(sock, msg_type, payload, codec=0, chaos=None):
+        if int(msg_type) == int(MsgType.BULK_CHUNK):
+            sends["n"] += 1
+        return orig_send(sock, msg_type, payload, codec=codec, chaos=chaos)
+
+    def counting_recv(sock):
+        if sends["n"] and not sent_before_first_ack:
+            sent_before_first_ack.append(sends["n"])
+        return orig_recv(sock)
+
+    import netsdb_tpu.serve.client as client_mod
+
+    old = client_mod.send_frame
+    client_mod.send_frame = counting_send
+    try:
+        RemoteClient._recv_reply = staticmethod(counting_recv)
+        items = [{"i": i, "pad": "z" * 900} for i in range(256)]
+        rc.send_data("d", "s", items, pipeline=True, chunk_bytes=1 << 10)
+    finally:
+        client_mod.send_frame = old
+        RemoteClient._recv_reply = staticmethod(orig_recv)
+    # with stop-and-wait the first recv would happen after ONE send;
+    # the windowed pipeline fires window-deep before blocking
+    assert sent_before_first_ack and \
+        sent_before_first_ack[0] >= rc.ingest_window
+    assert len(list(rc.get_set_iterator("d", "s"))) == 256
+
+
+def test_blob_assembler_refuses_overflow():
+    """A resync blob stream that delivers more bytes than its BEGIN
+    declared is refused (CorruptFrame) instead of growing daemon RSS
+    without bound."""
+    from netsdb_tpu.serve.errors import CorruptFrame
+    from netsdb_tpu.serve.server import _BlobAssembler
+
+    asm = _BlobAssembler({"nbytes": 8, "step": 1})
+    asm.add({"blob": b"12345678"})
+    with pytest.raises(CorruptFrame, match="overflowed"):
+        asm.add({"blob": b"9"})
+
+
+def test_bulk_ingest_refused_without_pickle_is_typed_fatal(tmp_path):
+    """A daemon with allow_pickle off refuses item-chunk ingest with a
+    typed FATAL error at BEGIN (never a silent connection drop that
+    would burn the whole retry budget)."""
+    from netsdb_tpu.config import Configuration
+    from netsdb_tpu.serve.client import RemoteError
+
+    ctl = ServeController(Configuration(root_dir=str(tmp_path / "np")),
+                          port=0, allow_pickle=False)
+    port = ctl.start()
+    try:
+        c = RemoteClient(f"127.0.0.1:{port}")
+        c.create_database("d")
+        c.create_set("d", "s", type_name="object")
+        with pytest.raises(RemoteError, match="allow_pickle") as ei:
+            c.send_data("d", "s", [1] * 200, pipeline=True)
+        assert not ei.value.retryable
+        assert c.last_attempts == 1  # fatal → no retries burned
+        c.close()
+    finally:
+        ctl.shutdown()
+
+
+# --- wire-streamed follower resync ------------------------------------
+
+def _wait_reattached(mctl, timeout_s=20.0):
+    deadline = time.monotonic() + timeout_s
+    while time.monotonic() < deadline:
+        st = mctl.follower_status()
+        if st["active"] and not st["degraded"]:
+            return
+        time.sleep(0.05)
+    raise AssertionError(
+        f"follower never reattached: {mctl.follower_status()}")
+
+
+def test_resync_streams_snapshot_over_wire_no_shared_fs(tmp_path,
+                                                        monkeypatch):
+    """Acceptance: leader and follower run with DISTINCT root dirs and
+    the follower restore never reads a checkpoint path — the snapshot
+    arrives purely over the wire in bounded frames."""
+    from netsdb_tpu.config import Configuration
+    from netsdb_tpu.storage import checkpoint
+
+    def no_fs_load(*a, **k):
+        raise AssertionError(
+            "resync must stream over the wire, not read a shared path")
+
+    monkeypatch.setattr(checkpoint, "load_store", no_fs_load)
+
+    fchaos = ChaosInjector()
+    fctl = ServeController(
+        Configuration(root_dir=str(tmp_path / "follower_root")), port=0)
+    fport = fctl.start()
+    mctl = ServeController(
+        Configuration(root_dir=str(tmp_path / "leader_root")), port=0,
+        followers=[f"127.0.0.1:{fport}"], follower_chaos=fchaos,
+        heartbeat_interval_s=0.1, heartbeat_timeout_s=0.5,
+        heartbeat_misses=2, mirror_ack_timeout_s=0.5, resync_grace_s=2.0)
+    mport = mctl.start()
+    try:
+        c = RemoteClient(f"127.0.0.1:{mport}",
+                         retry=RetryPolicy(max_attempts=5,
+                                           base_delay_s=0.01))
+        c.create_database("d")
+        c.create_set("d", "w")
+        a = np.random.default_rng(7).standard_normal((64, 64)).astype(
+            np.float32)
+        c.send_matrix("d", "w", a, (32, 32))
+        fchaos.arm("kill")
+        c.create_set("d", "other", type_name="object")  # mirror dies here
+        _wait_reattached(mctl)
+        assert fctl.last_resync_mode == "wire"
+        np.testing.assert_array_equal(
+            np.asarray(fctl.library.get_tensor("d", "w").to_dense()), a)
+        c.close()
+    finally:
+        mctl.shutdown()
+        fctl.shutdown()
+
+
+# --- hedged reads ------------------------------------------------------
+
+def test_hedged_read_fires_after_delay_and_wins(tmp_path):
+    """A slow primary reply (chaos delay) triggers a hedge to the
+    replica after the hedge delay; the caller gets the replica's answer
+    long before the primary's would land. Mutations never hedge."""
+    from netsdb_tpu.config import Configuration
+
+    pchaos = ChaosInjector()
+    primary = ServeController(
+        Configuration(root_dir=str(tmp_path / "p")), port=0, chaos=pchaos)
+    pport = primary.start()
+    replica = ServeController(
+        Configuration(root_dir=str(tmp_path / "r")), port=0)
+    rport = replica.start()
+    try:
+        a = np.arange(96 * 96, dtype=np.float32).reshape(96, 96)
+        for ctl in (primary, replica):
+            boot = RemoteClient(f"127.0.0.1:{ctl.port}")
+            boot.create_database("d")
+            boot.create_set("d", "w")
+            boot.send_matrix("d", "w", a, (32, 32))
+            boot.close()
+
+        c = RemoteClient(f"127.0.0.1:{pport}",
+                         replicas=[f"127.0.0.1:{rport}"],
+                         hedge_delay_s=0.05,
+                         retry=RetryPolicy(max_attempts=2,
+                                           base_delay_s=0.01))
+        pchaos.arm("delay", delay_s=1.5)  # next primary reply stalls
+        t0 = time.monotonic()
+        t = c.get_tensor("d", "w")
+        elapsed = time.monotonic() - t0
+        np.testing.assert_array_equal(t.to_dense(), a)
+        assert elapsed < 1.0, "hedge should beat the stalled primary"
+        assert c.hedges_issued == 1 and c.hedges_won == 1
+        # mutations must NOT hedge, even with a stalled primary
+        pchaos.arm("delay", delay_s=0.3)
+        c.create_set("d", "w2")
+        assert c.hedges_issued == 1
+        c.close()
+    finally:
+        primary.shutdown()
+        replica.shutdown()
+
+
+def test_hedge_delay_adapts_to_observed_p99(tmp_path):
+    from netsdb_tpu.config import Configuration
+
+    ctl = ServeController(Configuration(root_dir=str(tmp_path / "s")),
+                          port=0)
+    port = ctl.start()
+    try:
+        c = RemoteClient(f"127.0.0.1:{port}",
+                         replicas=[f"127.0.0.1:{port}"])
+        assert c.hedge_delay_s() == pytest.approx(0.05)  # cold start
+        for _ in range(16):
+            c.ping()
+        # warmed: the trigger tracks the observed tail, not the default
+        assert 0 < c.hedge_delay_s() < 0.05
+        c.close()
+    finally:
+        ctl.shutdown()

@@ -1,7 +1,6 @@
 """Static guards for the serve layer — runnable as a script or a test.
 
-Two regressions this PR's fault-tolerance work must never quietly
-reacquire:
+Regressions the serve layer must never quietly reacquire:
 
 1. **Wall-clock deadlines.** ``time.time()`` jumps (NTP steps, manual
    sets) once broke the 30 s follower dial-retry loop; every deadline
@@ -16,6 +15,14 @@ reacquire:
    erase the typed error taxonomy. AST-checked, so a bare ``raise``
    anywhere in the handler body counts as re-raising.
 
+3. **Zero-copy tensor framing.** The v3 data plane ships ndarray
+   buffers as out-of-band segments over ``memoryview``s; a single
+   ``.tobytes()`` on the serve path silently reintroduces the
+   full-payload copy the rework removed. Banned in every serve
+   module. Likewise, ``protocol.py`` may touch pickle/cloudpickle
+   ONLY inside the metadata codec (``encode_body``/``decode_body``)
+   — tensor bytes must never ride a pickle stream.
+
 Run standalone: ``python tests/test_static_checks.py`` (exit 1 on
 violations) — the CI-script form the pytest wrapper shares.
 """
@@ -26,6 +33,10 @@ import sys
 
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 SERVE_DIR = os.path.join(REPO, "netsdb_tpu", "serve")
+
+#: the metadata codec — the only functions in protocol.py allowed to
+#: name pickle/cloudpickle
+_PICKLE_OK_FUNCS = {"encode_body", "decode_body"}
 
 
 def _is_wall_clock_call(node: ast.Call) -> bool:
@@ -43,13 +54,55 @@ def _handler_reraises(handler: ast.ExceptHandler) -> bool:
     return False
 
 
+def _mentions_pickle(node: ast.AST) -> bool:
+    for sub in ast.walk(node):
+        if isinstance(sub, ast.Name) and sub.id in ("pickle", "cloudpickle"):
+            return True
+        if isinstance(sub, (ast.Import, ast.ImportFrom)):
+            names = [a.name for a in sub.names]
+            if isinstance(sub, ast.ImportFrom) and sub.module:
+                names.append(sub.module)
+            if any(n.split(".")[0] in ("pickle", "cloudpickle")
+                   for n in names):
+                return True
+    return False
+
+
+def _check_protocol_pickle(tree: ast.AST, rel: str) -> list:
+    """protocol.py only: pickle/cloudpickle confined to the metadata
+    codec functions — the zero-copy tensor path must never grow a
+    pickle round-trip."""
+    out = []
+    for node in tree.body:
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            if node.name in _PICKLE_OK_FUNCS:
+                continue
+            if _mentions_pickle(node):
+                out.append(f"{rel}:{node.lineno}: pickle use in "
+                           f"{node.name}() — allowed only in the metadata "
+                           f"codec ({', '.join(sorted(_PICKLE_OK_FUNCS))})")
+        elif _mentions_pickle(node):
+            out.append(f"{rel}:{node.lineno}: module-level pickle "
+                       f"reference in the wire protocol — allowed only "
+                       f"inside the metadata codec functions")
+    return out
+
+
 def _check_file(path: str) -> list:
     with open(path) as f:
         src = f.read()
     tree = ast.parse(src, filename=path)
     rel = os.path.relpath(path, REPO)
     out = []
+    if os.path.basename(path) == "protocol.py":
+        out.extend(_check_protocol_pickle(tree, rel))
     for node in ast.walk(tree):
+        if isinstance(node, ast.Call) \
+                and isinstance(node.func, ast.Attribute) \
+                and node.func.attr == "tobytes":
+            out.append(f"{rel}:{node.lineno}: .tobytes() on the serve "
+                       f"data path — ship the buffer as an out-of-band "
+                       f"segment (memoryview), never a copy")
         if isinstance(node, ast.Call) and _is_wall_clock_call(node):
             out.append(f"{rel}:{node.lineno}: time.time() in the serve "
                        f"layer — deadlines must be time.monotonic() "
