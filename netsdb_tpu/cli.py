@@ -602,6 +602,11 @@ def _cmd_attention_bench(args) -> int:
 def _cmd_micro_bench(args) -> int:
     from netsdb_tpu.workloads import micro_bench
 
+    if getattr(args, "staging", False):
+        import json
+
+        print(json.dumps(micro_bench.bench_staging(), indent=2))
+        return 0
     names = None
     if args.only is not None:
         names = [n.strip() for n in args.only.split(",") if n.strip()]
@@ -708,6 +713,9 @@ def main(argv=None) -> int:
                        help="runtime micro-benchmarks (serviceBenchmarks)")
     p.add_argument("--only", default=None,
                    help="comma-separated benchmark names")
+    p.add_argument("--staging", action="store_true",
+                   help="overlapped vs synchronous device staging on "
+                        "the out-of-core matmul and fold streams")
 
     sub.add_parser("selftest",
                    help="scripted integration sequence (integratedTests.py)")

@@ -48,6 +48,27 @@ class Configuration:
     # --- mesh defaults (data x model), overridden by parallel.mesh helpers ---
     mesh_shape: Optional[Tuple[int, ...]] = None
     mesh_axis_names: Tuple[str, ...] = ("data", "model")
+    # --- out-of-core staging pipeline (plan/staging.py) ---
+    # host page read-ahead depth for every block/chunk stream (the
+    # PageCircularBuffer between the arena reader and the consumer);
+    # 0 = synchronous reads. Replaces the executor's old hardwired
+    # prefetch=0 call sites.
+    stream_prefetch_pages: int = 2
+    # device staging double-buffer depth: how many blocks ahead the
+    # background thread runs jax.device_put (with the set's sharding)
+    # of the consumer's fold step; 0 = synchronous device_put (the
+    # baseline path `micro-bench --staging` compares against).
+    stage_depth: int = 2
+    # pad streamed row chunks up to the fixed bucket ladder
+    # (plan/staging.bucket_rows: powers of two and 1.5x powers of two)
+    # so ragged tails / differing ingest sizes reuse one compiled step
+    # per bucket instead of compiling per distinct shape. Padded rows
+    # ride the validity mask; False restores exact-shape padding.
+    shape_bucketing: bool = True
+    # donate fold-step accumulators to XLA (donate_argnums on arg 0) so
+    # per-block state updates reuse the same HBM buffer. None = auto:
+    # on for backends that implement donation (TPU/GPU), off for CPU.
+    donate_fold_buffers: Optional[bool] = None
     # --- execution ---
     num_threads: int = 4  # host-side IO/pipeline threads (not device parallelism)
     enable_compression: bool = True  # host spill compression (ref -DENABLE_COMPRESSION)

@@ -50,21 +50,49 @@ _compiled_cache: "OrderedDict[str, Any]" = OrderedDict()
 # reorder/insert/evict sequence must not interleave
 _cache_lock = threading.Lock()
 
+# observability for the shape-bucketing contract: ``traces`` counts XLA
+# (re)traces across every cached wrapper — with bucketed chunk shapes
+# it must stay CONSTANT across repeated executions over differing
+# ragged tails (the recompile-churn regression the buckets absorb)
+_compile_stats = {"hits": 0, "misses": 0, "traces": 0}
 
-def _cached_jit(key: str, fn) -> Any:
+
+def compile_stats() -> Dict[str, int]:
+    """Snapshot of the compiled-cache counters (hits/misses at the LRU,
+    traces at XLA). The staging tests assert ``traces`` is flat across
+    re-executions with different ragged tail sizes."""
+    with _cache_lock:
+        return dict(_compile_stats)
+
+
+def _cached_jit(key: str, fn, donate_argnums: tuple = ()) -> Any:
     """compiled-cache get-or-insert with the ONE LRU discipline (all
     three call sites: fold steps, eager traceable nodes, whole-plan
     programs). The wrapper is published BEFORE its first call, so
     concurrent serve-layer threads racing the same cold key all call
     ONE jitted wrapper (jax dedups the trace/compile internally)
-    instead of compiling N identical programs."""
+    instead of compiling N identical programs.
+
+    ``donate_argnums`` marks arguments XLA may consume in place — the
+    fold loops donate argument 0 (the carried accumulator) so each
+    step updates its state buffer instead of allocating a fresh one
+    per block (gated by ``staging.fold_donate_argnums``)."""
     with _cache_lock:
         cached = _compiled_cache.get(key)
         if cached is not None:
             _compiled_cache.move_to_end(key)
+            _compile_stats["hits"] += 1
             return cached
-    jfn = jax.jit(fn)
+
+    def counted(*args, **kwargs):
+        # body runs only when jax (re)traces — the recompile counter
+        with _cache_lock:
+            _compile_stats["traces"] += 1
+        return fn(*args, **kwargs)
+
+    jfn = jax.jit(counted, donate_argnums=tuple(donate_argnums))
     with _cache_lock:
+        _compile_stats["misses"] += 1
         jfn = _compiled_cache.setdefault(key, jfn)
         _compiled_cache.move_to_end(key)
         while len(_compiled_cache) > _COMPILED_CACHE_CAP:
@@ -139,13 +167,15 @@ def _pad_table_rows(t, rows: int):
 
 def _part_chunks(ppc, placement):
     """Stream one probe partition, restoring the ORIGINAL global
-    ``_rowid`` saved by the partitioner (folds arbitrate ties on it)."""
+    ``_rowid`` saved by the partitioner (folds arbitrate ties on it).
+    Prefetch/staging depth come from the store's config knobs (the old
+    hardwired ``prefetch=0`` defeated the overlap end-to-end)."""
     from netsdb_tpu.relational.table import ColumnTable
 
     if ppc is None:
         return
     with contextlib.closing(
-            ppc.stream_tables(prefetch=0, placement=placement)) as cs:
+            ppc.stream_tables(placement=placement)) as cs:
         for t in cs:
             if "_rowid0" in t.cols:
                 cols = dict(t.cols)
@@ -244,10 +274,11 @@ def _run_fold(node, fold, pc, resident, placement, step_jit):
             return _run_fold_grace(fold, pc, rest, bi, build_pc,
                                    placement, step_jit)
         # legacy discipline (no declared keys): outer loop over build
-        # blocks, full probe re-stream per block
+        # blocks, full probe re-stream per block (prefetch depth from
+        # the config knob, not hardwired off)
         out = None
         with contextlib.closing(
-                build_pc.stream_tables(prefetch=0)) as btabs:
+                build_pc.stream_tables()) as btabs:
             for btab in btabs:
                 part_res = list(rest)
                 part_res[bi] = btab
@@ -266,43 +297,73 @@ def _run_tensor_stream(node, tfold, in_vals, src, step_jit):
     over storage-managed weights (ref ``SimpleFF.cc:94-290``: FF
     scans its weight sets page-fed via ``FFMatrixBlockScanner`` +
     ``PageScanner.h:25-34``). Only one weight page (plus the node's
-    resident inputs and the assembled output) is device-resident at a
-    time.
+    resident inputs, the staged next block, and the assembled output)
+    is device-resident at a time; the upload of the NEXT block runs on
+    the staging thread while the current step computes
+    (``plan/staging.stage_stream`` — the host page readers feed the
+    device stage).
 
     mode "rows": evaluate the node's fn once per row block (the block
-    substituted for the paged input) and concatenate output rows;
-    ``out_block`` re-blocks the assembly so its meta — and downstream
-    padded shapes — match the resident path exactly.
+    substituted for the paged input) and concatenate output rows.
+    Blocks pad up to the row-block's shape bucket (zero rows — fn is
+    row-decomposable by the mode's contract, so padded output rows are
+    sliced back off before assembly) so a ragged tail reuses the
+    full-block compiled step's bucket instead of compiling per tail
+    size; ``out_block`` re-blocks the assembly so its meta — and
+    downstream padded shapes — match the resident path exactly.
     mode "reduce": blocks are contraction slices; ``partial``
-    accumulates, ``finalize`` applies the epilogue."""
+    accumulates (donated carry — in-place accumulator updates),
+    ``finalize`` applies the epilogue. Reduce blocks are staged but
+    NEVER bucket-padded: partials slice their co-factor by
+    ``start``+``block.shape[0]``, so padded rows would misalign the
+    contraction, not just waste it."""
     import jax.numpy as jnp
+    import numpy as np
+
+    from netsdb_tpu.plan import staging
 
     pt = in_vals[src]
     others = [v for i, v in enumerate(in_vals) if i != src]
     placement = pt.placement
+    cfg = pt.store.config
+    depth = getattr(cfg, "stage_depth", 2)
+    rb = pt.store.meta(pt.name)[1][0]  # nominal rows per block
+    bucketing = getattr(cfg, "shape_bucketing", True)
 
-    def place(block):
+    def to_device(block):
         b = jnp.asarray(block)
         if placement is not None:
             b = placement.apply(b)
         return b
 
     if tfold.mode == "rows":
+        def place(item):
+            _start, block = item
+            n = block.shape[0]
+            target = staging.pad_rows_target(max(n, rb), bucketing)
+            if target > n:
+                block = np.pad(block, ((0, target - n), (0, 0)))
+            return n, to_device(block)
+
         def step(block, *os):
             bt = BlockedTensor.from_dense(block, tuple(block.shape))
             args = list(os)
             args.insert(src, bt)
             return node.fn(*args)
 
-        jstep = step_jit(0, step)
+        jstep = step_jit(0, step, donate=())
         outs = []
         was_blocked = False
-        with contextlib.closing(pt.stream_blocks()) as blocks:
-            for _start, block in blocks:
-                out = jstep(place(block), *others)
+        with contextlib.closing(staging.stage_stream(
+                pt.stream_blocks(), place, depth,
+                name=f"trows:{pt.name}")) as blocks:
+            for n, block in blocks:
+                out = jstep(block, *others)
                 if isinstance(out, BlockedTensor):
                     was_blocked = True
                     out = out.to_dense()
+                if out.shape[0] != n:  # drop the bucket's padded rows
+                    out = out[:n]
                 outs.append(out)
         dense = jnp.concatenate(outs, axis=0)
         if tfold.out_block is not None:
@@ -312,15 +373,20 @@ def _run_tensor_stream(node, tfold, in_vals, src, step_jit):
         return dense
 
     # mode "reduce": carry accumulation over contraction slices
+    def place(item):
+        start, block = item
+        return jnp.asarray(start, jnp.int32), to_device(block)
+
     def step(carry, start, block, *os):
         return tfold.partial(carry, start, block, *os)
 
     jstep = step_jit(1, step)
     carry = None
-    with contextlib.closing(pt.stream_blocks()) as blocks:
+    with contextlib.closing(staging.stage_stream(
+            pt.stream_blocks(), place, depth,
+            name=f"treduce:{pt.name}")) as blocks:
         for start, block in blocks:
-            carry = jstep(carry, jnp.asarray(start, jnp.int32),
-                          place(block), *others)
+            carry = jstep(carry, start, block, *others)
     if tfold.finalize is not None:
         return tfold.finalize(carry, *others)
     return carry
@@ -356,11 +422,21 @@ def _execute_streamed(client, plan: LogicalPlan, scan_values: Dict[int, Any],
     # identical plans still share cache entries)
     topo_pos = {n.node_id: i for i, n in enumerate(plan.topo)}
 
+    # fold-step accumulators (argument 0 of every step) are donated so
+    # XLA updates the per-stream state in place instead of allocating a
+    # fresh HBM buffer every block; auto-gated to backends that
+    # implement donation (staging.fold_donate_argnums)
+    from netsdb_tpu.plan.staging import fold_donate_argnums
+
+    donate_default = fold_donate_argnums(client.store.config)
+
     def step_jit_for(node):
-        def step_jit(pidx, step):
+        def step_jit(pidx, step, donate=None):
             key = (f"fold::{job_name}::{plan_key}::"
                    f"n{topo_pos[node.node_id]}::{node.label}::{pidx}")
-            return _cached_jit(key, step)
+            return _cached_jit(
+                key, step,
+                donate_argnums=donate_default if donate is None else donate)
         return step_jit
 
     values: Dict[int, Any] = dict(scan_values)

@@ -1,0 +1,280 @@
+"""Overlapped device staging — the PageCircularBuffer for HBM uploads.
+
+The reference overlaps page IO with pipeline compute by putting a
+bounded ring buffer between the scan thread and the worker threads
+(``src/storage/headers/PageCircularBuffer.h``): the scan thread pins
+the NEXT page while the workers chew on the current one.  Our port had
+that for the HOST read stage (``PagedTensorStore.stream_blocks``
+prefetch readers) but not for the DEVICE stage: every out-of-core
+consumer ran ``jax.device_put`` synchronously per chunk, so the
+accelerator idled through every host→device copy.  On TPU-class
+hardware hiding transfer latency dominates out-of-core throughput
+(arxiv 2112.09017 §IV; arxiv 2301.13062) — this module is that hiding
+layer.
+
+:func:`stage_stream` wraps any host-side chunk iterator with a bounded
+double buffer: a background thread runs the caller's ``place`` function
+(pad + ``jax.device_put`` with the target sharding) ``depth`` items
+ahead of the consumer, so the next block lands in HBM while the current
+fold step computes.  The pipeline is therefore three stages deep
+end-to-end::
+
+    arena/disk --(prefetch readers)--> host chunk --(staging thread,
+    place: pad+device_put)--> HBM block --(consumer)--> fold step
+
+Discipline matches ``stream_blocks`` (the template this generalizes):
+
+- the staging thread OWNS the source iterator: it is advanced and
+  closed there, so read locks held by source generators are acquired
+  and released on one thread and an abandoned consumer can never leak
+  a lock until GC;
+- any death of the staging thread (source raised, ``place`` raised)
+  re-raises AT THE CONSUMER, never swallowed;
+- ``close()`` (idempotent, also via ``contextlib.closing`` /
+  ``__del__``) stops the thread, drains the queue and joins — the
+  ``active_count``/``active_stagers`` registry exists so tests can
+  assert no thread outlives its stream.
+
+Shape-bucketed compilation rides the same module: :func:`bucket_rows`
+rounds ragged row counts up to a small fixed set of bucket sizes
+(powers of two and 1.5× powers of two — <50% pad waste worst case,
+~20% typical), so a stream
+with a ragged tail — or repeated serve ``EXECUTE``s over different row
+counts — compiles once per bucket instead of once per distinct shape.
+Padded rows ride the validity mask exactly like the pad-and-mask idiom
+in ``parallel/placement.py`` (masks, not garbage rows).
+"""
+
+from __future__ import annotations
+
+import contextlib
+import queue
+import threading
+from typing import Any, Callable, Iterable, Iterator, Optional
+
+# ---------------------------------------------------------------------
+# shape buckets
+# ---------------------------------------------------------------------
+
+#: no bucket below this many rows — tiny chunks all share one shape
+BUCKET_FLOOR = 8
+
+
+def bucket_rows(n: int) -> int:
+    """Smallest bucket ≥ ``n`` from the fixed ladder {2^k, 3·2^(k-1)}
+    (…, 8, 12, 16, 24, 32, 48, 64, 96, 128, …): two buckets per octave,
+    so padding is <50% of a chunk worst case (~20% typical) and every
+    distinct row count in a bucket's span compiles to the SAME XLA
+    program.  Buckets ≥ 16 are multiples of 8, so mesh-sharded chunks
+    usually divide their shard count without a second padding round."""
+    if n <= BUCKET_FLOOR:
+        return BUCKET_FLOOR
+    p = 1 << (n - 1).bit_length()  # next power of two ≥ n
+    half = (3 * p) // 4            # the 1.5× step below it
+    return half if half >= n else p
+
+
+def pad_rows_target(n: int, bucketing: bool, multiple: int = 1) -> int:
+    """Row count a chunk of ``n`` valid rows pads to: its bucket when
+    ``bucketing``, else ``n`` itself; then rounded up to ``multiple``
+    (a placement's shard granularity) so placed chunks shard without a
+    second padding round."""
+    target = bucket_rows(n) if bucketing else n
+    if multiple > 1:
+        target += (-target) % multiple
+    return target
+
+
+# ---------------------------------------------------------------------
+# fold-buffer donation
+# ---------------------------------------------------------------------
+
+def fold_donate_argnums(config=None) -> tuple:
+    """``(0,)`` when fold-step accumulators should be donated to XLA
+    (``donate_argnums``), else ``()``.  Donating argument 0 — the
+    carried state of ``step(state, chunk, *resident)`` — lets XLA
+    update the per-stream accumulator in place instead of allocating a
+    fresh HBM buffer every block (the state is dead after each step by
+    construction: the loop immediately rebinds it).
+
+    ``config.donate_fold_buffers``: True/False pins it; None (default)
+    auto-enables only on backends that implement donation (TPU/GPU) —
+    CPU ignores donation with a per-compile warning, so tier-1 CPU runs
+    stay quiet.  Folds whose ``init`` returns a RESIDENT input array as
+    part of the state must pin this off (donation would invalidate the
+    resident for later steps)."""
+    flag = getattr(config, "donate_fold_buffers", None)
+    if flag is None:
+        import jax
+
+        flag = jax.default_backend() in ("tpu", "gpu")
+    return (0,) if flag else ()
+
+
+# ---------------------------------------------------------------------
+# the staged stream
+# ---------------------------------------------------------------------
+
+_SENT_END = "end"
+_SENT_ERR = "err"
+_SENT_ITEM = "item"
+
+# live staging threads — the leak registry tests assert on (the staging
+# analogue of PagedTensorStore._readers). Guarded by _stagers_lock.
+_stagers: list = []
+_stagers_lock = threading.Lock()
+
+
+def active_count() -> int:
+    """Number of staging threads still alive (dead ones are pruned) —
+    must be 0 once every stream is consumed or closed."""
+    with _stagers_lock:
+        _stagers[:] = [t for t in _stagers if t.is_alive()]
+        return len(_stagers)
+
+
+def _stage_put(q: "queue.Queue", stop: threading.Event, item) -> bool:
+    """Bounded put that gives up when the consumer closed the stream
+    (same pattern as ``stream_blocks``'s reader)."""
+    while not stop.is_set():
+        try:
+            q.put(item, timeout=0.1)
+            return True
+        except queue.Full:
+            continue
+    return False
+
+
+def _stage_worker(source, place, q: "queue.Queue",
+                  stop: threading.Event) -> None:
+    """The staging thread body. DELIBERATELY a free function over
+    explicit state, never a bound method: the Thread must not hold a
+    reference to the StagedStream, or an abandoned stream could never
+    be garbage-collected (its own worker would keep it alive) and the
+    worker would spin in ``put`` until process exit."""
+    try:
+        try:
+            for item in source:
+                if stop.is_set():
+                    return
+                if not _stage_put(q, stop, (_SENT_ITEM, place(item))):
+                    return  # consumer abandoned the stream
+        finally:
+            # the worker owns the source: close it HERE so read locks
+            # held by source generators release on the thread that
+            # acquired them, promptly, even when the consumer
+            # abandoned us mid-stream
+            close = getattr(source, "close", None)
+            if close is not None:
+                close()
+    except BaseException as e:  # ANY death must surface at consumer
+        _stage_put(q, stop, (_SENT_ERR, e))
+        return
+    _stage_put(q, stop, (_SENT_END, None))
+
+
+class StagedStream:
+    """Iterator over ``place(item)`` for each item of ``source``, with
+    ``place`` running up to ``depth`` items ahead on a background
+    thread.  ``depth <= 0`` degenerates to the synchronous inline path
+    (the baseline the staging bench compares against — no thread, no
+    overlap, same results)."""
+
+    def __init__(self, source: Iterable, place: Callable[[Any], Any],
+                 depth: int = 2, name: str = "stage"):
+        self._source = iter(source)
+        self._place = place
+        self._depth = int(depth)
+        self._name = name
+        self._closed = False
+        self._thread: Optional[threading.Thread] = None
+        if self._depth > 0:
+            self._q: "queue.Queue" = queue.Queue(maxsize=self._depth)
+            self._stop = threading.Event()
+            self._thread = threading.Thread(
+                target=_stage_worker,
+                args=(self._source, self._place, self._q, self._stop),
+                daemon=True, name=f"netsdb-stage-{name}")
+            with _stagers_lock:
+                _stagers[:] = [t for t in _stagers if t.is_alive()]
+                _stagers.append(self._thread)
+            self._thread.start()
+
+    # --- consumer side ------------------------------------------------
+    def __iter__(self) -> Iterator[Any]:
+        return self
+
+    def __next__(self):
+        if self._thread is None:  # synchronous inline mode
+            if self._closed:
+                raise StopIteration
+            try:
+                return self._place(next(self._source))
+            except StopIteration:
+                self.close()
+                raise
+        if self._closed:
+            raise StopIteration
+        while True:
+            try:
+                kind, val = self._q.get(timeout=0.5)
+            except queue.Empty:
+                if not self._thread.is_alive():  # died without a sentinel
+                    self._closed = True
+                    raise RuntimeError(
+                        f"staging thread {self._name!r} died")
+                continue
+            if kind is _SENT_ERR:
+                self._closed = True
+                raise val
+            if kind is _SENT_END:
+                self._closed = True
+                raise StopIteration
+            return val
+
+    def close(self) -> None:
+        """Stop + drain + join the staging thread (idempotent). After
+        this the source iterator has been closed on the worker thread
+        and no staging thread of this stream is alive."""
+        if self._thread is None:
+            if not self._closed:
+                self._closed = True
+                close = getattr(self._source, "close", None)
+                if close is not None:
+                    close()
+            return
+        self._closed = True
+        self._stop.set()
+        # drain so a worker blocked in put() observes the stop quickly
+        while True:
+            try:
+                self._q.get_nowait()
+            except queue.Empty:
+                break
+        self._thread.join(timeout=30)
+        with _stagers_lock:
+            _stagers[:] = [t for t in _stagers if t.is_alive()]
+
+    def __enter__(self) -> "StagedStream":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.close()
+
+    def __del__(self):
+        # best-effort: an abandoned stream must not leak its thread (or
+        # the read locks its source generator holds) until interpreter
+        # exit — mirrors generator finalization semantics
+        with contextlib.suppress(Exception):
+            self.close()
+
+
+def stage_stream(source: Iterable, place: Callable[[Any], Any],
+                 depth: int = 2, name: str = "stage") -> StagedStream:
+    """Wrap ``source`` so ``place`` (pad + ``jax.device_put``) runs up
+    to ``depth`` items ahead on a background thread.  The ONE
+    constructor every out-of-core consumer goes through — the static
+    check in ``tests/test_static_checks.py`` bans loose ``device_put``
+    loops in ``plan/`` and ``relational/outofcore.py`` so the overlap
+    cannot silently regress."""
+    return StagedStream(source, place, depth=depth, name=name)

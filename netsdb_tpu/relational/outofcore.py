@@ -194,28 +194,67 @@ class PagedColumns:
             self.num_rows += n_new
 
     # ------------------------------------------------------------ stream
-    def stream(self, prefetch: int = 2, device: bool = True
-               ) -> Iterator[Tuple[Dict[str, jnp.ndarray], jnp.ndarray, int]]:
-        """Yield (cols, valid, start_row) per chunk, every chunk padded
-        to ``row_block`` rows — the PageScanner loop feeding the
+    def pad_rows(self) -> int:
+        """Row count every streamed chunk pads to: ``row_block``'s
+        shape BUCKET when the config enables bucketing (so ragged
+        tails and differing ingest sizes reuse one compiled chunk step
+        per bucket — ``plan/staging.bucket_rows``), else ``row_block``
+        exactly. Padded rows ride the validity mask either way."""
+        from netsdb_tpu.plan.staging import pad_rows_target
+
+        return pad_rows_target(
+            self.row_block,
+            getattr(self.store.config, "shape_bucketing", True))
+
+    def stream(self, prefetch: Optional[int] = None, device: bool = True):
+        """Chunk stream of (cols, valid, start_row), every chunk padded
+        to :meth:`pad_rows` rows — the PageScanner loop feeding the
         compiled chunk step. Ragged blocks (appended batches' tails)
         are masked, never reshaped; ``start_row`` is the chunk's global
         row offset (exact even for ragged streams).
 
         ``device=False`` keeps the chunks as NUMPY columns (the serve
         wire streams pages to a client — the device must never see
-        them). Holds the relation's read lock for the generator's
-        lifetime, so a concurrent append/drop (write lock) cannot free
-        or grow pages mid-stream."""
+        them) and returns a plain generator.  ``device=True`` returns a
+        :class:`~netsdb_tpu.plan.staging.StagedStream`: the device
+        upload runs ``config.stage_depth`` chunks ahead on a background
+        thread, so the next chunk lands in HBM while the consumer's
+        step computes.  ``prefetch`` (None = the
+        ``config.stream_prefetch_pages`` knob) is the HOST read-ahead
+        depth underneath.  Either way the relation's read lock is held
+        for the stream's lifetime — on the staging thread for the
+        device path — so a concurrent append/drop (write lock) cannot
+        free or grow pages mid-stream; close() abandoned streams."""
+        if not device:
+            return self._host_stream(prefetch)
+        from netsdb_tpu.plan.staging import stage_stream
+
+        def place(item):
+            cols, valid, start = item
+            return ({k: jnp.asarray(v) for k, v in cols.items()},
+                    jnp.asarray(valid), start)
+
+        return stage_stream(
+            self._host_stream(prefetch), place,
+            depth=getattr(self.store.config, "stage_depth", 2),
+            name=f"cols:{self.name}")
+
+    def _host_stream(self, prefetch: Optional[int] = None
+                     ) -> Iterator[Tuple[Dict[str, np.ndarray],
+                                         np.ndarray, int]]:
+        """Locked host-side chunk generator (numpy columns). Runs —
+        lock acquisition included — on whichever thread iterates it:
+        the consumer directly (``device=False``) or the staging thread
+        (``device=True``)."""
         with self.rw.read():
             if self.dropped:
                 raise KeyError(f"paged relation {self.name!r} was "
                                f"dropped; cannot stream")
-            yield from self._stream_unlocked(prefetch, device)
+            yield from self._stream_unlocked(prefetch)
 
-    def _stream_unlocked(self, prefetch: int = 2, device: bool = True
-                         ) -> Iterator[Tuple[Dict[str, jnp.ndarray],
-                                             jnp.ndarray, int]]:
+    def _stream_unlocked(self, prefetch: Optional[int] = None
+                         ) -> Iterator[Tuple[Dict[str, np.ndarray],
+                                             np.ndarray, int]]:
         streams = []
         if self.int_names:
             streams.append((self.int_names,
@@ -254,16 +293,12 @@ class PagedColumns:
                         f"{exhausted} ended while {yielded} still had "
                         f"blocks")
                 return
-            pad = self.row_block - n
-            if pad:
+            pad = self.pad_rows() - n
+            if pad > 0:
                 chunk = {k: np.pad(v, (0, pad)) for k, v in chunk.items()}
-            valid = np.arange(self.row_block) < n
+            valid = np.arange(n + max(pad, 0)) < n
             self.pages_streamed += 1
-            if device:
-                yield ({k: jnp.asarray(v) for k, v in chunk.items()},
-                       jnp.asarray(valid), start)
-            else:
-                yield chunk, valid, start
+            yield chunk, valid, start
 
     def num_pages(self) -> int:
         """Row-chunk page count (the co-paged int/float streams share
@@ -280,41 +315,56 @@ class PagedColumns:
             for suffix in (".int", ".float"):
                 self.store.drop(self.name + suffix)
 
-    def stream_tables(self, prefetch: int = 2,
-                      placement=None) -> Iterator[ColumnTable]:
-        """The PageScanner feed for the set/DAG API: yield each chunk as
-        a ColumnTable (validity-masked, plus a ``_rowid`` global-row-
+    def stream_tables(self, prefetch: Optional[int] = None,
+                      placement=None):
+        """The PageScanner feed for the set/DAG API: a
+        :class:`~netsdb_tpu.plan.staging.StagedStream` of chunk
+        ColumnTables (validity-masked, plus a ``_rowid`` global-row-
         index column so key-range folds can recover absolute rows).
+        The whole device leg — pad, upload, mesh-shard — runs
+        ``config.stage_depth`` chunks ahead on the staging thread, so
+        the next chunk is HBM-resident while the consumer's fold step
+        computes; ``prefetch`` (None = the config knob) is the host
+        page read-ahead underneath.
 
         ``placement`` mesh-shards every chunk's rows before yielding —
         the streamed-pages-onto-mesh-shards path (each device folds its
         shard of every page; XLA inserts the per-chunk collectives the
         reference's workers-stream-local-partitions model implies,
         ``PipelineStage.cc:228-265``). Ingest rounds ``row_block`` to
-        the shard granularity, so placed chunks shard without a second
-        padding round."""
-        base_rowid = jnp.arange(self.row_block, dtype=jnp.int32)
-        inner = self.stream(prefetch)
-        try:
-            for cols, valid, start in inner:
-                cols = dict(cols)
-                # the stream's own start is exact even for ragged
-                # (appended) block sequences; invalid tail rows get bogus
-                # ids, masked like everything else
-                cols["_rowid"] = base_rowid + start
-                t = ColumnTable(cols, self.dicts, valid)
-                if placement is not None:
-                    from netsdb_tpu.parallel.placement import shard_table
+        the shard granularity (and buckets ≥ 16 are multiples of 8),
+        so placed chunks usually shard without a second padding round —
+        when a bucket doesn't divide, ``shard_table`` pads the
+        remainder (one deterministic final shape per bucket either
+        way)."""
+        from netsdb_tpu.plan.staging import stage_stream
 
-                    t = shard_table(t, placement)
-                yield t
-        finally:
-            # deterministic read-lock release: an abandoned/partially
-            # consumed stream_tables generator must not keep the inner
-            # stream (and its lock) alive until GC
-            inner.close()
+        base_rowid = np.arange(self.pad_rows(), dtype=np.int32)
+        dicts = self.dicts
 
-    def stream_host_tables(self, prefetch: int = 2
+        def place(item):
+            cols, valid, start = item
+            cols = dict(cols)
+            # the stream's own start is exact even for ragged
+            # (appended) block sequences; invalid tail rows get bogus
+            # ids, masked like everything else
+            cols["_rowid"] = base_rowid[:len(valid)] + start
+            if placement is not None:
+                from netsdb_tpu.parallel.placement import shard_table
+
+                # shard_table pads to the shard granularity and
+                # device_puts every column with the mesh sharding
+                return shard_table(ColumnTable(cols, dicts, valid),
+                                   placement)
+            return ColumnTable({k: jnp.asarray(v) for k, v in cols.items()},
+                               dicts, jnp.asarray(valid))
+
+        return stage_stream(
+            self._host_stream(prefetch), place,
+            depth=getattr(self.store.config, "stage_depth", 2),
+            name=f"tables:{self.name}")
+
+    def stream_host_tables(self, prefetch: Optional[int] = None
                            ) -> Iterator[ColumnTable]:
         """Yield each chunk as a COMPACT host-side ColumnTable (numpy
         columns, padding stripped, no ``_rowid``) — the serve wire's
@@ -440,9 +490,15 @@ def run_fold(fold, pc: PagedColumns, *resident, placement=None):
     per pass per call; call-site loops should go through the executor,
     whose compiled-step cache amortizes across jobs."""
     from netsdb_tpu.plan.executor import _run_fold_once
+    from netsdb_tpu.plan.staging import fold_donate_argnums
 
-    return _run_fold_once(fold, pc, resident, placement,
-                          lambda pidx, step: jax.jit(step))
+    donate_default = fold_donate_argnums(pc.store.config)
+
+    def step_jit(pidx, step, donate=None):
+        return jax.jit(step, donate_argnums=(
+            donate_default if donate is None else donate))
+
+    return _run_fold_once(fold, pc, resident, placement, step_jit)
 
 
 # ---------------------------------------------------------------- Q01

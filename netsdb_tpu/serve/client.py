@@ -936,6 +936,106 @@ class RemoteClient:
                 raise classify_remote(reply)
             yield reply
 
+    def _stream_hedged(self, msg_type: MsgType,
+                       payload: Any) -> Iterator[Any]:
+        """Streaming read with FIRST-ITEM hedging — ``_request_hedged``
+        extended to streams: the primary opens the stream on a
+        dedicated connection; if its first frame hasn't landed within
+        :meth:`hedge_delay_s`, the SAME request goes to the next
+        replica, and whichever connection delivers a first frame first
+        WINS — the loser's socket is closed immediately (cancelled),
+        so at most one duplicated first frame ever crosses the wire,
+        not a duplicated full scan. After the first item the winner's
+        stream is consumed inline (a half-read stream cannot switch
+        connections mid-flight), so hedging bounds time-to-first-item
+        — the metric that dominates interactive scans — while the
+        stream body rides ordinary TCP backpressure. Reads only, like
+        every hedge (mutations never stream)."""
+        first_q: "_queue.Queue" = _queue.Queue()
+        socks: Dict[str, socket.socket] = {}
+        cancelled: set = set()
+        state_lock = threading.Lock()
+
+        def opener(tag: str, address: Optional[str]) -> None:
+            s = None
+            try:
+                s = self._dial(address=address)
+                with state_lock:
+                    if tag in cancelled:
+                        s.close()
+                        return
+                    socks[tag] = s
+                send_frame(s, msg_type, payload, chaos=self._chaos)
+                typ, reply = self._recv_reply(s)
+                first_q.put((tag, typ, reply, None))
+            except BaseException as e:  # noqa: BLE001 — surfaced below
+                # a failed leg closes its own socket (the cancel sweep
+                # only covers the LOSING healthy leg)
+                with state_lock:
+                    socks.pop(tag, None)
+                if s is not None:
+                    s.close()
+                first_q.put((tag, None, None, e))
+
+        threading.Thread(target=opener, daemon=True,
+                         args=("primary", None)).start()
+        t0 = time.perf_counter()
+        try:
+            winner = first_q.get(timeout=self.hedge_delay_s())
+            legs = 1 if winner[0] == "primary" else 2
+        except _queue.Empty:
+            self.hedges_issued += 1
+            addr = self._replicas[self._hedge_rr % len(self._replicas)]
+            self._hedge_rr += 1
+            threading.Thread(target=opener, daemon=True,
+                             args=("hedge", addr)).start()
+            legs = 2
+            winner = first_q.get()
+            if winner[3] is not None:
+                # first responder failed — wait for the straggler; on a
+                # double failure prefer the primary's error
+                other = first_q.get()
+                legs = 0  # both legs reported; nothing left to cancel
+                if other[3] is None:
+                    winner = other
+                elif winner[0] == "hedge":
+                    winner = other
+        tag, typ, frame, err = winner
+        if legs:
+            # cancel the loser: close its socket (unblocks a parked
+            # recv) or poison its tag so a not-yet-dialed leg closes
+            # itself on arrival
+            with state_lock:
+                for other_tag in ("primary", "hedge"):
+                    if other_tag == tag:
+                        continue
+                    cancelled.add(other_tag)
+                    s = socks.pop(other_tag, None)
+                    if s is not None:
+                        try:
+                            s.shutdown(socket.SHUT_RDWR)
+                        except OSError:
+                            pass
+                        s.close()
+        if err is not None:
+            raise err
+        if tag == "hedge":
+            self.hedges_won += 1
+        self._read_lat.append(time.perf_counter() - t0)
+        with state_lock:
+            sock = socks.pop(tag)
+        try:
+            while True:
+                if typ == MsgType.STREAM_END:
+                    return
+                if typ == MsgType.ERR:
+                    raise classify_remote(frame)
+                yield frame
+                typ, frame = self._recv_reply(sock)
+        finally:
+            # dedicated connection: never resynchronized, always closed
+            sock.close()
+
     def _stream(self, msg_type: MsgType, payload: Any) -> Iterator[Any]:
         """Issue a streaming request; yield each STREAM_ITEM payload
         until STREAM_END. ERR aborts with RemoteError. If the consumer
@@ -944,7 +1044,14 @@ class RemoteClient:
         from a thread ALREADY mid-stream (nested iteration) runs over
         its own dedicated connection — like nested plain requests
         (`_oneshot_request`), it must neither wait on the held lock nor
-        interleave frames on the streaming socket."""
+        interleave frames on the streaming socket. With ``replicas``
+        configured, streams hedge their FIRST item over dedicated
+        connections (:meth:`_stream_hedged`) — the persistent
+        connection and its lock stay untouched, so nested requests
+        from the consuming thread need no special-casing."""
+        if self._replicas and self._stream_owner != threading.get_ident():
+            yield from self._stream_hedged(msg_type, payload)
+            return
         if self._stream_owner == threading.get_ident():
             s = self._dial()
             try:

@@ -200,13 +200,90 @@ class _IdempotencyCache:
     retry whose original is still executing parks on its event instead
     of re-running the handler (double-apply is the failure mode this
     whole class exists to prevent); a retry of a completed request gets
-    the cached reply frame verbatim."""
+    the cached reply frame verbatim.
 
-    def __init__(self, capacity: int = 4096):
+    ``persist_path`` (a sqlite file next to the catalog sqlite) makes
+    completed tokens survive a daemon RESTART: without it the cache is
+    in-memory only, so a client retrying a mutation across a restart
+    would re-execute it (the double-apply the ROADMAP open item names).
+    Replies persist pickled (the trusted-control-plane boundary, same
+    as the checkpoint snapshots); unpicklable replies simply stay
+    memory-only — the restart window then degrades to re-execution for
+    that one request, never a crash. Rows are pruned to ``capacity``
+    on the snapshot-prune path (:meth:`prune`)."""
+
+    def __init__(self, capacity: int = 4096,
+                 persist_path: Optional[str] = None):
         self._mu = threading.Lock()
         self._done: "OrderedDict[str, Tuple]" = OrderedDict()
         self._inflight: Dict[str, threading.Event] = {}
         self._capacity = capacity
+        self._db = None
+        #: tokens answered from the persisted table (observability for
+        #: the restart tests; memory hits don't count)
+        self.persist_hits = 0
+        self._since_prune = 0
+        if persist_path:
+            import sqlite3
+
+            parent = os.path.dirname(persist_path)
+            if parent:
+                os.makedirs(parent, exist_ok=True)
+            # one connection, shared across handler threads under _mu.
+            # WAL + synchronous=NORMAL: the per-mutation commit must
+            # not fsync on the request path (durable across clean
+            # restarts, which is the contract — a power loss losing the
+            # last tokens degrades to re-execution, same as no cache)
+            self._db = sqlite3.connect(persist_path,
+                                       check_same_thread=False)
+            try:
+                self._db.execute("PRAGMA journal_mode=WAL")
+                self._db.execute("PRAGMA synchronous=NORMAL")
+            except sqlite3.Error:
+                pass  # fall back to default journaling
+            self._db.execute("CREATE TABLE IF NOT EXISTS idem "
+                             "(token TEXT PRIMARY KEY, reply BLOB)")
+            self._db.commit()
+
+    def _load_persisted(self, token: str) -> Optional[Tuple]:
+        """Caller holds ``_mu``. None on any persistence trouble — the
+        worst case is re-execution, never a wedged request."""
+        import pickle
+        import sqlite3
+
+        if self._db is None:
+            return None
+        try:
+            row = self._db.execute(
+                "SELECT reply FROM idem WHERE token = ?",
+                (token,)).fetchone()
+            if row is None:
+                return None
+            result = pickle.loads(row[0])
+        except (sqlite3.Error, pickle.UnpicklingError, ValueError,
+                EOFError, AttributeError, ImportError):
+            return None
+        self.persist_hits += 1
+        self._done[token] = result
+        return result
+
+    def _persist(self, token: str, result: Tuple) -> None:
+        """Caller holds ``_mu``. Best-effort: replies that cannot
+        pickle (live buffers) or a busy sqlite stay memory-only."""
+        import pickle
+        import sqlite3
+
+        if self._db is None:
+            return
+        try:
+            blob = pickle.dumps(result, protocol=pickle.HIGHEST_PROTOCOL)
+            self._db.execute(
+                "INSERT OR REPLACE INTO idem (token, reply) VALUES (?, ?)",
+                (token, blob))
+            self._db.commit()
+        except (sqlite3.Error, pickle.PicklingError, TypeError,
+                ValueError):
+            return
 
     def claim(self, token: str, wait_s: float) -> Optional[Tuple]:
         """Returns the cached (reply_type, reply, codec) when ``token``
@@ -220,6 +297,9 @@ class _IdempotencyCache:
                 if token in self._done:
                     self._done.move_to_end(token)
                     return self._done[token]
+                cached = self._load_persisted(token)
+                if cached is not None:
+                    return cached
                 ev = self._inflight.get(token)
                 if ev is None:
                     self._inflight[token] = threading.Event()
@@ -234,11 +314,21 @@ class _IdempotencyCache:
     def finish(self, token: str, result: Tuple) -> None:
         with self._mu:
             self._done[token] = result
+            self._persist(token, result)
+            self._since_prune += 1
+            # a daemon with no followers never hits the snapshot-prune
+            # path, so the table must self-bound too (cheap: one DELETE
+            # per _capacity/4 inserts)
+            prune_now = self._since_prune >= max(self._capacity // 4, 64)
+            if prune_now:
+                self._since_prune = 0
             while len(self._done) > self._capacity:
                 self._done.popitem(last=False)
             ev = self._inflight.pop(token, None)
         if ev is not None:
             ev.set()
+        if prune_now:
+            self.prune()
 
     def abort(self, token: str) -> None:
         """The execution failed without a durable effect worth caching
@@ -247,6 +337,35 @@ class _IdempotencyCache:
             ev = self._inflight.pop(token, None)
         if ev is not None:
             ev.set()
+
+    def prune(self) -> None:
+        """Drop the oldest persisted tokens beyond ``capacity`` — runs
+        on the existing snapshot-prune path (a flapping follower must
+        not fill the leader's disk with either snapshots or tokens)."""
+        import sqlite3
+
+        with self._mu:
+            if self._db is None:
+                return
+            try:
+                self._db.execute(
+                    "DELETE FROM idem WHERE rowid NOT IN (SELECT rowid "
+                    "FROM idem ORDER BY rowid DESC LIMIT ?)",
+                    (self._capacity,))
+                self._db.commit()
+            except sqlite3.Error:
+                return
+
+    def close(self) -> None:
+        import sqlite3
+
+        with self._mu:
+            db, self._db = self._db, None
+            if db is not None:
+                try:
+                    db.close()
+                except sqlite3.Error:
+                    pass
 
 
 def _blob_view(b) -> memoryview:
@@ -469,7 +588,11 @@ class ServeController:
         #: how the last RESYNC_FOLLOWER restored ("wire" | "path") —
         #: observability for the no-shared-fs acceptance test
         self.last_resync_mode: Optional[str] = None
-        self._idem = _IdempotencyCache()
+        # completed-token cache persists NEXT TO the catalog sqlite so
+        # a daemon restart cannot double-apply a mutation retried
+        # across it (ROADMAP: idempotency across daemon restarts)
+        self._idem = _IdempotencyCache(persist_path=os.path.join(
+            os.path.dirname(config.catalog_path), "idempotency.sqlite"))
         self.library = Client(config)  # the resident state
         # ORDERING MODEL for mirrored frames (the SPMD argument):
         # - _mirror_lock is held only long enough to ENQUEUE a frame
@@ -574,6 +697,7 @@ class ServeController:
             links = list(self._links.values())
         for link in links:
             link.close()
+        self._idem.close()
         if self._listener is not None:
             try:
                 self._listener.close()
@@ -1064,6 +1188,8 @@ class ServeController:
                     self._degraded.pop(addr, None)
                     self._links[addr] = _FollowerLink(addr, link_client)
                 checkpoint.prune_steps(root, keep=1)
+                self._idem.prune()  # same disk-bounding moment: old
+                # persisted idempotency tokens go with old snapshots
         finally:
             self._order.release_write()
             self._resync_idle.set()

@@ -106,11 +106,13 @@ class PagedTensor:
     def num_blocks(self) -> int:
         return self.store.num_blocks(self.name)
 
-    def stream_blocks(self, prefetch: int = 2
+    def stream_blocks(self, prefetch: Optional[int] = None
                       ) -> Iterator[Tuple[int, np.ndarray]]:
         """Yield (start_row, block) holding the read lock for the
         generator's lifetime (a concurrent drop/replace must not free
-        pages mid-stream); consumers should close() abandoned streams."""
+        pages mid-stream); consumers should close() abandoned streams.
+        ``prefetch=None`` takes the ``config.stream_prefetch_pages``
+        read-ahead knob."""
         with self.rw.read():
             yield from self.store.stream_blocks(self.name, prefetch)
 
@@ -359,14 +361,18 @@ class PagedTensorStore:
         return len(self.backend.set_pages(self._ids[name]))
 
     def stream_blocks(self, name: str,
-                      prefetch: int = 2) -> Iterator[Tuple[int, np.ndarray]]:
+                      prefetch: Optional[int] = None
+                      ) -> Iterator[Tuple[int, np.ndarray]]:
         """Yield (start_row, block) in order — the PageScanner loop.
 
         ``prefetch`` pages are read ahead on a background thread (the
         reference's PageCircularBuffer between its scan thread and the
         pipeline threads — ``src/storage/headers/PageCircularBuffer.h``)
-        so disk/arena reads overlap the consumer's compute; 0 disables.
+        so disk/arena reads overlap the consumer's compute; 0 disables,
+        None takes the ``config.stream_prefetch_pages`` knob.
         """
+        if prefetch is None:
+            prefetch = getattr(self.config, "stream_prefetch_pages", 2)
         sid = self._ids[name]
         (rows, cols), _, dtype = self._meta[sid]
         pids = self.backend.set_pages(sid)
@@ -433,32 +439,58 @@ class PagedTensorStore:
 
     def to_device_blocked(self, name: str, block_shape=None):
         """Stream into HBM chunk-by-chunk and assemble a BlockedTensor —
-        the dense array never exists on host."""
+        the dense array never exists on host; uploads run a staging
+        depth ahead of the assembly (``plan/staging``)."""
+        import contextlib
+
         import jax
         import jax.numpy as jnp
 
         from netsdb_tpu.core.blocked import BlockMeta, BlockedTensor
+        from netsdb_tpu.plan.staging import stage_stream
 
         sid = self._ids[name]
         (rows, cols), _, dtype = self._meta[sid]
         block_shape = block_shape or self.config.default_block_shape
         meta = BlockMeta((rows, cols), tuple(block_shape))
         chunks = []
-        for r0, block in self.stream_blocks(name):
-            chunks.append(jax.device_put(block))
+        with contextlib.closing(stage_stream(
+                self.stream_blocks(name),
+                lambda item: jax.device_put(item[1]),
+                depth=getattr(self.config, "stage_depth", 2),
+                name=f"blocked:{name}")) as staged:
+            for chunk in staged:
+                chunks.append(chunk)
         data = jnp.concatenate(chunks, axis=0)
         pad = [(0, p - s) for s, p in zip((rows, cols), meta.padded_shape)]
         if any(p for _, p in pad):
             data = jnp.pad(data, pad)
         return BlockedTensor(data, meta)
 
-    def matmul_streamed(self, name: str, rhs: np.ndarray) -> np.ndarray:
-        """out = M @ rhs with M streamed page-by-page through the device —
-        the larger-than-HBM compute pattern (reference: pipelines over
-        pinned pages). Only one page + rhs live on device at a time."""
+    def matmul_streamed(self, name: str, rhs: np.ndarray,
+                        stage_depth: Optional[int] = None) -> np.ndarray:
+        """out = M @ rhs with M streamed page-by-page through the device
+        — the larger-than-HBM compute pattern (reference: pipelines over
+        pinned pages). Only one page + rhs (plus the staged NEXT page)
+        live on device at a time: the upload of block *i+1* runs on the
+        staging thread while block *i*'s matmul computes
+        (``plan/staging.stage_stream``), and ragged blocks pad up to
+        the row-block's shape bucket (zero rows, output rows sliced
+        back off — exact) so the whole stream runs ONE compiled
+        program. ``stage_depth`` pins the staging depth (None = the
+        ``config.stage_depth`` knob; 0 = the synchronous baseline the
+        staging bench measures against)."""
+        import contextlib
+
         import jax
         import jax.numpy as jnp
 
+        from netsdb_tpu.plan.staging import pad_rows_target, stage_stream
+
+        depth = getattr(self.config, "stage_depth", 2) \
+            if stage_depth is None else stage_depth
+        bucketing = getattr(self.config, "shape_bucketing", True)
+        rb = self._meta[self._ids[name]][1][0]
         rhs_dev = jax.device_put(rhs)
 
         @jax.jit
@@ -467,9 +499,21 @@ class PagedTensorStore:
                                        precision=jax.lax.Precision.HIGHEST,
                                        preferred_element_type=jnp.float32)
 
+        def place(item):
+            _start, block = item
+            n = block.shape[0]
+            target = pad_rows_target(max(n, rb), bucketing)
+            if target > n:
+                block = np.pad(block, ((0, target - n), (0, 0)))
+            return n, jax.device_put(block)
+
         outs = []
-        for _, block in self.stream_blocks(name):
-            outs.append(np.asarray(block_mm(jax.device_put(block), rhs_dev)))
+        with contextlib.closing(stage_stream(
+                self.stream_blocks(name), place, depth,
+                name=f"mm:{name}")) as staged:
+            for n, block in staged:
+                out = np.asarray(block_mm(block, rhs_dev))
+                outs.append(out[:n] if out.shape[0] != n else out)
         return np.concatenate(outs, axis=0)
 
     def drop(self, name: str) -> None:

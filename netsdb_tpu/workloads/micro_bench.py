@@ -169,6 +169,157 @@ def bench_planner(n: int = 2_000) -> Result:
     return _timed(n, run)
 
 
+def bench_staging(rows: int = 65_536, cols: int = 1024,
+                  rhs_cols: int = 256, page_rows: int = 4096,
+                  pool_mb: int = 32, fold_rows: int = 2_000_000,
+                  repeats: int = 3) -> Dict[str, object]:
+    """Overlapped vs synchronous device staging on the two out-of-core
+    hot paths (the ``--staging`` mode of the CLI):
+
+    * **blocked matmul** — ``PagedTensorStore.matmul_streamed`` with
+      the matrix spilling (pool < matrix), ``stage_depth=0`` (every
+      ``device_put`` synchronous, prefetch off — the pre-staging
+      executor) vs the configured staged pipeline (host read-ahead +
+      background device stage). Warms the compile once, then times the
+      best of ``repeats`` runs — pure steady-state overlap.
+    * **fold stream** — a masked segment-sum fold over a sequence of
+      paged relations with differing row counts. DELIBERATELY timed
+      cold per run (a fresh ``jax.jit`` per configuration, like a
+      fresh daemon's step cache): the exact-shape baseline re-traces
+      once per ingest size inside the timed region while the bucketed
+      path traces once — recompile churn is the cost being measured,
+      alongside the staging overlap. Best of ``repeats`` whole rounds.
+
+    ``*_speedup`` is sync/staged."""
+    import shutil
+    import tempfile
+    import time
+
+    import jax
+    import jax.numpy as jnp
+
+    from netsdb_tpu.config import Configuration
+    from netsdb_tpu.relational.outofcore import PagedColumns
+    from netsdb_tpu.storage.paged import PagedTensorStore
+
+    rng = np.random.default_rng(0)
+    root = tempfile.mkdtemp(prefix="staging_bench_")
+    out: Dict[str, object] = {"rows": rows, "cols": cols,
+                              "rhs_cols": rhs_cols,
+                              "fold_rows": fold_rows}
+    cfg = Configuration(root_dir=root,
+                        page_size_bytes=page_rows * cols * 4)
+    store = PagedTensorStore(cfg, pool_bytes=pool_mb << 20)
+    try:
+        m = rng.standard_normal((rows, cols)).astype(np.float32)
+        rhs = rng.standard_normal((cols, rhs_cols)).astype(np.float32)
+        store.put("m", m, row_block=page_rows)
+        out["matrix_mb"] = m.nbytes >> 20
+        out["pool_mb"] = pool_mb
+        del m
+
+        def timed_mm(depth: int, prefetch: int) -> float:
+            cfg.stream_prefetch_pages = prefetch
+            store.matmul_streamed("m", rhs, stage_depth=depth)  # warm
+            best = float("inf")
+            for _ in range(repeats):
+                t0 = time.perf_counter()
+                store.matmul_streamed("m", rhs, stage_depth=depth)
+                best = min(best, time.perf_counter() - t0)
+            return best
+
+        out["matmul_sync_s"] = round(timed_mm(0, 0), 4)
+        out["matmul_staged_s"] = round(timed_mm(2, 2), 4)
+        out["matmul_speedup"] = round(
+            out["matmul_sync_s"] / out["matmul_staged_s"], 2)
+
+        # --- fold stream: a q01-shaped multi-aggregate chunk step
+        # (five weighted segment-sums + a count) folded over a SEQUENCE
+        # of paged relations with DIFFERING row counts — the serve
+        # scenario the shape buckets exist for: every `EXECUTE` over a
+        # freshly ingested set used to present a new chunk shape to the
+        # one cached step (row_block = min(row_block, num_rows)), so
+        # the old pipeline recompiled per ingest size while the device
+        # idled through every synchronous upload. The baseline runs
+        # with bucketing off + stage/prefetch 0 (the pre-staging
+        # executor); the staged run with the defaults. ``*_traces``
+        # reports how many times XLA traced the shared step — the
+        # recompile-churn metric (bucketed: constant; exact shapes:
+        # one per distinct row count).
+        n_keys = 4096
+        from netsdb_tpu.plan.staging import bucket_rows
+
+        # 12 distinct ingest sizes spread ±8% around a base chosen so
+        # they all land in ONE bucket (the common serve case: traffic
+        # varies around a working size) — the exact-shape baseline
+        # traces once PER SIZE, the bucketed path once total
+        base = int(fold_rows * 0.1125)
+        bucket = bucket_rows(base)
+        sizes = sorted({min(int(base * (0.92 + 0.15 * i / 11)), bucket)
+                        for i in range(12)})
+        rels = []
+        for i, n in enumerate(sizes):
+            fc = {
+                "k": rng.integers(0, n_keys, n, dtype=np.int32),
+                "qty": rng.uniform(1.0, 50.0, n).astype(np.float32),
+                "price": rng.uniform(1.0, 100.0, n).astype(np.float32),
+                "disc": rng.uniform(0.0, 0.1, n).astype(np.float32),
+                "tax": rng.uniform(0.0, 0.08, n).astype(np.float32),
+            }
+            rels.append(PagedColumns.ingest(store, f"fold{i}", fc))
+        out["fold_sizes"] = sizes
+
+        def timed_fold(bucketing: bool, depth: int,
+                       prefetch: int) -> Tuple[float, int]:
+            import contextlib
+
+            cfg.shape_bucketing = bucketing
+            cfg.stage_depth = depth
+            cfg.stream_prefetch_pages = prefetch
+            traces = [0]
+
+            def raw_step(acc, k, qty, price, disc, tax, valid):
+                traces[0] += 1  # body runs only when XLA (re)traces
+                seg = jnp.where(valid, k, 0)
+                rev = price * (1.0 - disc)
+                vals = jnp.stack([qty, price, rev, rev * (1.0 + tax),
+                                  disc, jnp.ones_like(price)], axis=1)
+                vals = jnp.where(valid[:, None], vals, 0.0)
+                return acc + jax.ops.segment_sum(vals, seg,
+                                                 num_segments=n_keys)
+
+            step = jax.jit(raw_step)  # ONE cached step, like the
+            # executor's _cached_jit across serve EXECUTEs
+            t0 = time.perf_counter()
+            for pc in rels:
+                acc = jnp.zeros((n_keys, 6), jnp.float32)
+                with contextlib.closing(pc.stream()) as chunks:
+                    for ccols, valid, _start in chunks:
+                        acc = step(acc, ccols["k"], ccols["qty"],
+                                   ccols["price"], ccols["disc"],
+                                   ccols["tax"], valid)
+                np.asarray(acc)
+            return time.perf_counter() - t0, traces[0]
+
+        best_sync, best_staged = float("inf"), float("inf")
+        for _ in range(repeats):
+            s, tr_s = timed_fold(False, 0, 0)
+            g, tr_g = timed_fold(True, 2, 2)
+            best_sync, best_staged = min(best_sync, s), min(best_staged, g)
+        out["fold_sync_s"] = round(best_sync, 4)
+        out["fold_staged_s"] = round(best_staged, 4)
+        out["fold_sync_traces"] = tr_s
+        out["fold_staged_traces"] = tr_g
+        out["fold_speedup"] = round(
+            out["fold_sync_s"] / out["fold_staged_s"], 2)
+        out["store_stats"] = store.stats()
+        out["native"] = store.native
+    finally:
+        store.close()
+        shutil.rmtree(root, ignore_errors=True)
+    return out
+
+
 BENCHMARKS: Dict[str, Callable[[], Result]] = {
     "arena_alloc": bench_arena_alloc,
     "int_groupby": bench_int_groupby,

@@ -1,0 +1,173 @@
+"""Serve satellites of the staging PR: idempotency across daemon
+restarts (the persisted token cache next to the catalog sqlite) and
+hedged STREAMING reads (first-item hedging on ``scan_stream``).
+"""
+
+import numpy as np
+import pytest
+
+from netsdb_tpu.config import Configuration
+from netsdb_tpu.serve.chaos import ChaosInjector
+from netsdb_tpu.serve.client import RemoteClient
+from netsdb_tpu.serve.protocol import (
+    CODEC_PICKLE,
+    IDEMPOTENCY_KEY,
+    MsgType,
+)
+from netsdb_tpu.serve.server import ServeController, _IdempotencyCache
+
+
+# ------------------------------------------- idempotency across restarts
+def test_mutation_not_double_applied_across_restart(config):
+    """A client retrying a completed mutation across a daemon restart
+    must get the CACHED reply (persisted next to the catalog sqlite),
+    not a re-execution — the ROADMAP double-apply scenario."""
+    ctl = ServeController(config, port=0)
+    port = ctl.start()
+    rc = RemoteClient(f"127.0.0.1:{port}")
+    rc.create_database("d")
+    rc.create_set("d", "s", type_name="object")
+    token = "restart-retry-token"
+    payload = {"db": "d", "set": "s", "items": [1, 2, 3],
+               IDEMPOTENCY_KEY: token}
+    reply1 = rc._request(MsgType.SEND_DATA, dict(payload),
+                         codec=CODEC_PICKLE)
+    assert list(rc.get_set_iterator("d", "s")) == [1, 2, 3]
+    rc.close()
+    ctl.shutdown()
+
+    # fresh daemon, same root: in-memory token cache is gone, the
+    # persisted one is not
+    ctl2 = ServeController(config, port=0)
+    port2 = ctl2.start()
+    try:
+        rc2 = RemoteClient(f"127.0.0.1:{port2}")
+        # recreate the (transient) set in the restarted store so a
+        # RE-EXECUTED mutation would succeed — the dedupe, not an
+        # incidental store error, must be what prevents the apply
+        rc2.create_database("d")
+        rc2.create_set("d", "s", type_name="object")
+        reply2 = rc2._request(MsgType.SEND_DATA, dict(payload),
+                              codec=CODEC_PICKLE)
+        assert reply2 == reply1, "retry must replay the cached reply"
+        assert ctl2._idem.persist_hits == 1
+        # the handler never ran: transient items did not reappear (a
+        # double-apply would have re-added them)
+        assert list(rc2.get_set_iterator("d", "s")) == []
+        rc2.close()
+    finally:
+        ctl2.shutdown()
+
+
+def test_idempotency_cache_prunes_to_capacity(tmp_path):
+    path = str(tmp_path / "idem.sqlite")
+    cache = _IdempotencyCache(capacity=3, persist_path=path)
+    for i in range(6):
+        assert cache.claim(f"tok{i}", wait_s=0.1) is None
+        cache.finish(f"tok{i}", (MsgType.OK, {"i": i}, 0))
+    cache.prune()
+    cache.close()
+
+    # a fresh cache over the same file sees only the newest 3
+    fresh = _IdempotencyCache(capacity=3, persist_path=path)
+    assert fresh.claim("tok5", wait_s=0.1) == (MsgType.OK, {"i": 5}, 0)
+    assert fresh.persist_hits == 1
+    assert fresh.claim("tok0", wait_s=0.1) is None  # pruned → re-execute
+    fresh.abort("tok0")
+    fresh.close()
+
+
+def test_unpicklable_reply_stays_memory_only(tmp_path):
+    cache = _IdempotencyCache(capacity=4,
+                              persist_path=str(tmp_path / "i.sqlite"))
+    assert cache.claim("t", wait_s=0.1) is None
+    cache.finish("t", (MsgType.OK, {"mv": memoryview(b"x")}, 0))
+    # memory hit still works; persistence silently skipped
+    assert cache.claim("t", wait_s=0.1)[0] == MsgType.OK
+    cache.close()
+    fresh = _IdempotencyCache(capacity=4,
+                              persist_path=str(tmp_path / "i.sqlite"))
+    assert fresh.claim("t", wait_s=0.1) is None  # not persisted
+    fresh.abort("t")
+    fresh.close()
+
+
+# ------------------------------------------------- hedged streaming reads
+@pytest.fixture()
+def replica_pair(tmp_path):
+    """Two daemons holding the same data; the primary's chaos injector
+    is returned so tests can stall its stream frames."""
+    chaos = ChaosInjector()
+    cfg1 = Configuration(root_dir=str(tmp_path / "a"))
+    cfg2 = Configuration(root_dir=str(tmp_path / "b"))
+    ctl1 = ServeController(cfg1, port=0, chaos=chaos)
+    ctl2 = ServeController(cfg2, port=0)
+    p1, p2 = ctl1.start(), ctl2.start()
+    items = [{"i": i, "pad": "x" * 200} for i in range(50)]
+    for port in (p1, p2):
+        rc = RemoteClient(f"127.0.0.1:{port}")
+        rc.create_database("d")
+        rc.create_set("d", "s", type_name="object")
+        rc.send_data("d", "s", items, pipeline=False)
+        rc.close()
+    yield p1, p2, chaos, items
+    ctl1.shutdown()
+    ctl2.shutdown()
+
+
+def test_scan_stream_hedges_slow_first_item(replica_pair):
+    p1, p2, chaos, items = replica_pair
+    # stall the primary's FIRST stream frame well past the hedge delay
+    chaos.arm("delay", types=[int(MsgType.STREAM_ITEM)], delay_s=0.8)
+    rc = RemoteClient(f"127.0.0.1:{p1}",
+                      replicas=[f"127.0.0.1:{p2}"], hedge_delay_s=0.05)
+    got = list(rc.scan_stream("d", "s"))
+    assert got == items
+    assert rc.hedges_issued >= 1
+    assert rc.hedges_won >= 1, "replica should deliver the first item"
+    rc.close()
+
+
+def test_scan_stream_no_hedge_when_primary_fast(replica_pair):
+    p1, p2, _chaos, items = replica_pair
+    rc = RemoteClient(f"127.0.0.1:{p1}",
+                      replicas=[f"127.0.0.1:{p2}"], hedge_delay_s=2.0)
+    got = list(rc.scan_stream("d", "s"))
+    assert got == items
+    assert rc.hedges_issued == 0
+    rc.close()
+
+
+def test_hedged_stream_supports_nested_requests(replica_pair):
+    p1, p2, _chaos, items = replica_pair
+    rc = RemoteClient(f"127.0.0.1:{p1}",
+                      replicas=[f"127.0.0.1:{p2}"], hedge_delay_s=0.5)
+    seen = 0
+    for item in rc.scan_stream("d", "s"):
+        if seen == 0:
+            # hedged streams ride dedicated connections: the main
+            # connection (and a nested stream) stay usable mid-stream
+            rc.ping()
+            assert len(list(rc.scan_stream("d", "s"))) == len(items)
+        seen += 1
+    assert seen == len(items)
+    rc.close()
+
+
+def test_hedged_stream_both_replicas_down_raises(tmp_path):
+    cfg = Configuration(root_dir=str(tmp_path / "only"))
+    ctl = ServeController(cfg, port=0)
+    port = ctl.start()
+    rc = RemoteClient(f"127.0.0.1:{port}",
+                      replicas=["127.0.0.1:1"],  # dead replica
+                      hedge_delay_s=0.05)
+    try:
+        rc.create_database("d")
+        rc.create_set("d", "s", type_name="object")
+        rc.send_data("d", "s", [1], pipeline=False)
+        ctl.shutdown()  # primary gone too
+        with pytest.raises(Exception):
+            list(rc.scan_stream("d", "s"))
+    finally:
+        rc.close()
+        ctl.shutdown()

@@ -1,4 +1,5 @@
-"""Static guards for the serve layer — runnable as a script or a test.
+"""Static guards for the serve layer and the out-of-core execution
+pipeline — runnable as a script or a test.
 
 Regressions the serve layer must never quietly reacquire:
 
@@ -23,6 +24,14 @@ Regressions the serve layer must never quietly reacquire:
    ONLY inside the metadata codec (``encode_body``/``decode_body``)
    — tensor bytes must never ride a pickle stream.
 
+4. **Synchronous device staging.** The out-of-core hot paths
+   (``netsdb_tpu/plan/``, ``netsdb_tpu/relational/outofcore.py``)
+   stage host→device uploads through ``plan/staging.stage_stream`` so
+   the copy overlaps the consumer's compute; a bare ``jax.device_put``
+   inside a loop body (``for``/``while``/comprehension) silently
+   reintroduces the per-chunk upload stall the staging rework removed.
+   ``plan/staging.py`` itself owns the upload calls and is exempt.
+
 Run standalone: ``python tests/test_static_checks.py`` (exit 1 on
 violations) — the CI-script form the pytest wrapper shares.
 """
@@ -33,6 +42,11 @@ import sys
 
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 SERVE_DIR = os.path.join(REPO, "netsdb_tpu", "serve")
+PLAN_DIR = os.path.join(REPO, "netsdb_tpu", "plan")
+OOC_FILE = os.path.join(REPO, "netsdb_tpu", "relational", "outofcore.py")
+
+#: the staging module owns the (background-thread) device_put calls
+_STAGING_EXEMPT = {"staging.py"}
 
 #: the metadata codec — the only functions in protocol.py allowed to
 #: name pickle/cloudpickle
@@ -131,16 +145,58 @@ def check_serve_layer() -> list:
     return violations
 
 
+_LOOP_NODES = (ast.For, ast.While, ast.AsyncFor, ast.ListComp,
+               ast.SetComp, ast.DictComp, ast.GeneratorExp)
+
+
+def _check_device_put_in_loops(path: str) -> list:
+    """Ban bare ``<anything>.device_put(...)`` calls inside loop bodies
+    — per-chunk uploads must go through ``plan/staging.stage_stream``
+    so the copy overlaps compute instead of stalling the consumer."""
+    with open(path) as f:
+        tree = ast.parse(f.read(), filename=path)
+    rel = os.path.relpath(path, REPO)
+    out = []
+    for loop in ast.walk(tree):
+        if not isinstance(loop, _LOOP_NODES):
+            continue
+        for sub in ast.walk(loop):
+            if isinstance(sub, ast.Call) \
+                    and isinstance(sub.func, ast.Attribute) \
+                    and sub.func.attr == "device_put":
+                out.append(
+                    f"{rel}:{sub.lineno}: synchronous device_put inside "
+                    f"a loop body — stage uploads through "
+                    f"plan/staging.stage_stream so the copy overlaps "
+                    f"the consumer's compute")
+    return out
+
+
+def check_staging_discipline() -> list:
+    files = [os.path.join(PLAN_DIR, n) for n in sorted(os.listdir(PLAN_DIR))
+             if n.endswith(".py") and n not in _STAGING_EXEMPT]
+    files.append(OOC_FILE)
+    violations = []
+    for path in files:
+        violations.extend(_check_device_put_in_loops(path))
+    return violations
+
+
 def test_serve_layer_clock_and_exception_discipline():
     violations = check_serve_layer()
     assert not violations, "\n" + "\n".join(violations)
 
 
+def test_no_sync_device_put_in_stream_loops():
+    violations = check_staging_discipline()
+    assert not violations, "\n" + "\n".join(violations)
+
+
 def main() -> int:
-    violations = check_serve_layer()
+    violations = check_serve_layer() + check_staging_discipline()
     for v in violations:
         print(v, file=sys.stderr)
-    print(f"serve-layer static check: "
+    print(f"serve-layer + staging static check: "
           f"{'FAIL' if violations else 'ok'} "
           f"({len(violations)} violation(s))")
     return 1 if violations else 0
