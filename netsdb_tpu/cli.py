@@ -607,6 +607,11 @@ def _cmd_micro_bench(args) -> int:
 
         print(json.dumps(micro_bench.bench_staging(), indent=2))
         return 0
+    if getattr(args, "bucket_sweep", False):
+        import json
+
+        print(json.dumps(micro_bench.bench_bucket_sweep(), indent=2))
+        return 0
     names = None
     if args.only is not None:
         names = [n.strip() for n in args.only.split(",") if n.strip()]
@@ -640,7 +645,11 @@ def _cmd_serve(args) -> int:
 
 
 def _cmd_serve_bench(args) -> int:
-    if getattr(args, "data_plane", False):
+    if getattr(args, "device_cache", False):
+        from netsdb_tpu.workloads.serve_bench import run_device_cache_bench
+
+        out = run_device_cache_bench()
+    elif getattr(args, "data_plane", False):
         from netsdb_tpu.workloads.serve_bench import run_data_plane_bench
 
         out = run_data_plane_bench(table_mb=args.table_mb)
@@ -716,6 +725,10 @@ def main(argv=None) -> int:
     p.add_argument("--staging", action="store_true",
                    help="overlapped vs synchronous device staging on "
                         "the out-of-core matmul and fold streams")
+    p.add_argument("--bucket-sweep", action="store_true",
+                   help="pad-waste vs trace-count per shape-ladder "
+                        "density (the bucket_density knob: 2 vs 4 "
+                        "buckets per octave)")
 
     sub.add_parser("selftest",
                    help="scripted integration sequence (integratedTests.py)")
@@ -767,6 +780,10 @@ def main(argv=None) -> int:
                    "streamed pipelined ingest MB/s, scan MB/s, zero-copy "
                    "tensor push/pull, hedged-read p99")
     p.add_argument("--table-mb", type=int, default=64)
+    p.add_argument("--device-cache", action="store_true",
+                   help="cold vs warm EXECUTE latency over a "
+                        "device-cache-resident paged set instead "
+                        "(hit/miss counters included)")
 
     p = sub.add_parser("autotune",
                        help="measure physical-strategy crossovers "

@@ -65,6 +65,20 @@ class Configuration:
     # per bucket instead of compiling per distinct shape. Padded rows
     # ride the validity mask; False restores exact-shape padding.
     shape_bucketing: bool = True
+    # buckets per octave in the shape ladder: 2 (default — {2^k,
+    # 3*2^(k-1)}, <50% pad worst case) or 4 (2^(k-1)*{1.25,1.5,1.75}
+    # rungs added — <25% pad at twice the compiles per octave).
+    # `micro_bench --bucket-sweep` reports pad-waste vs trace-count
+    # per density (the ROADMAP ladder-tuning item).
+    bucket_density: int = 2
+    # --- cross-query device-resident set cache (storage/devcache.py) ---
+    # byte budget for placed set blocks kept DEVICE-RESIDENT across
+    # queries and serve requests (the buffer-pool role: the second
+    # query over a hot set performs zero host->device transfers).
+    # Entries key on (db, set, version, bucket, sharding); every write
+    # path bumps the set version, so the cache can never serve stale
+    # blocks. 0 disables. LRU-evicted under the budget.
+    device_cache_bytes: int = 256 * 1024 * 1024
     # donate fold-step accumulators to XLA (donate_argnums on arg 0) so
     # per-block state updates reuse the same HBM buffer. None = auto:
     # on for backends that implement donation (TPU/GPU), off for CPU.
@@ -85,6 +99,11 @@ class Configuration:
     compilation_cache_dir: Optional[str] = dataclasses.field(
         default_factory=lambda: os.environ.get(
             "NETSDB_TPU_COMPILE_CACHE", "auto"))
+
+    def __post_init__(self) -> None:
+        if self.bucket_density not in (2, 4):
+            raise ValueError(f"bucket_density must be 2 or 4, got "
+                             f"{self.bucket_density!r}")
 
     @property
     def catalog_path(self) -> str:

@@ -192,7 +192,16 @@ def _run_fold_grace(fold, pc, rest, bi, build_pc, placement, step_jit):
     read once for partitioning and each repartitioned row once for
     probing, instead of the whole probe stream once per build block
     (the reference partitions both sides the same way,
-    ``PipelineStage.cc:1652-1728`` + ``HashSetManager.h``)."""
+    ``PipelineStage.cc:1652-1728`` + ``HashSetManager.h``).
+
+    Partition pairs OVERLAP: while pair *i* probes, pair *i+1*'s build
+    block assembles and uploads on a bounded
+    :class:`~netsdb_tpu.plan.staging.StagedStream` (depth =
+    ``config.stage_depth``, same shutdown/leak discipline as every
+    other staged stream), so the device no longer idles between pairs
+    waiting for the next build side's host→device copy — the ROADMAP
+    "staged multi-stream joins" item."""
+    from netsdb_tpu.plan import staging
     from netsdb_tpu.relational.outofcore import partition_by_key
 
     nparts = build_pc.num_pages()
@@ -210,21 +219,37 @@ def _run_fold_grace(fold, pc, rest, bi, build_pc, placement, step_jit):
                                        columns=fold.probe_columns)
         maxr = max((bp.num_rows for bp in build_parts
                     if bp is not None), default=0)
-        for p in range(nparts):
-            if build_parts[p] is None:
-                continue  # no build rows: probes there can only miss
-            btab = _pad_table_rows(build_parts[p].to_table(), maxr)
-            part_res = list(rest)
-            part_res[bi] = btab
-            state = None
-            for pidx, (init, step) in enumerate(fold.passes):
-                jstep = step_jit(pidx, step)
-                state = init(state, pc, *part_res)
-                for chunk in _part_chunks(probe_parts[p], placement):
-                    state = jstep(state, chunk, *part_res)
-            part = fold.finalize(state, pc, *part_res)
-            out = part if out is None else fold.merge(out, part)
+
+        def pairs():
+            for p in range(nparts):
+                if build_parts[p] is not None:
+                    yield p
+                # no build rows: probes there can only miss
+
+        def stage_build(p):
+            # runs on the staging thread: pair p's build block pads to
+            # ONE uniform size (one compiled step for all pairs) and
+            # uploads while the previous pair still probes
+            return p, _pad_table_rows(build_parts[p].to_table(), maxr)
+
+        depth = getattr(build_pc.store.config, "stage_depth", 2)
+        with contextlib.closing(staging.stage_stream(
+                pairs(), stage_build, depth=depth,
+                name=f"grace-build:{build_pc.name}")) as staged_builds:
+            for p, btab in staged_builds:
+                part_res = list(rest)
+                part_res[bi] = btab
+                state = None
+                for pidx, (init, step) in enumerate(fold.passes):
+                    jstep = step_jit(pidx, step)
+                    state = init(state, pc, *part_res)
+                    for chunk in _part_chunks(probe_parts[p], placement):
+                        state = jstep(state, chunk, *part_res)
+                part = fold.finalize(state, pc, *part_res)
+                out = part if out is None else fold.merge(out, part)
     finally:
+        # after the closing() above joined the build stager — spill
+        # partitions must not be reclaimed under a live upload
         for lst in (build_parts, probe_parts):
             for prt in lst:
                 if prt is not None:
@@ -329,6 +354,27 @@ def _run_tensor_stream(node, tfold, in_vals, src, step_jit):
     depth = getattr(cfg, "stage_depth", 2)
     rb = pt.store.meta(pt.name)[1][0]  # nominal rows per block
     bucketing = getattr(cfg, "shape_bucketing", True)
+    density = getattr(cfg, "bucket_density", 2)
+
+    # cross-query device cache for the weight stream: store-owned
+    # handles carry (ident, write version) — a warm scan replays the
+    # staged blocks already in HBM (storage/devcache.py); cached
+    # blocks are never donated (the reduce carry is the only donated
+    # argument)
+    cache = getattr(pt, "devcache", None)
+    scope = getattr(pt, "cache_scope", None)
+    version_fn = getattr(pt, "cache_version_fn", None)
+
+    def cache_key(kind):
+        if cache is None or scope is None:
+            return None
+        pl = placement.label() if placement is not None else None
+        return (scope[0], scope[1], kind, rb, bucketing, density, pl)
+
+    def still_current():
+        # install-time currentness: a write racing the scan must not
+        # leave a dead (old-version) entry squatting on the budget
+        return version_fn is None or version_fn() == scope[1]
 
     def to_device(block):
         b = jnp.asarray(block)
@@ -340,7 +386,8 @@ def _run_tensor_stream(node, tfold, in_vals, src, step_jit):
         def place(item):
             _start, block = item
             n = block.shape[0]
-            target = staging.pad_rows_target(max(n, rb), bucketing)
+            target = staging.pad_rows_target(max(n, rb), bucketing,
+                                             density=density)
             if target > n:
                 block = np.pad(block, ((0, target - n), (0, 0)))
             return n, to_device(block)
@@ -356,7 +403,9 @@ def _run_tensor_stream(node, tfold, in_vals, src, step_jit):
         was_blocked = False
         with contextlib.closing(staging.stage_stream(
                 pt.stream_blocks(), place, depth,
-                name=f"trows:{pt.name}")) as blocks:
+                name=f"trows:{pt.name}",
+                cache=cache, cache_key=cache_key("trows"),
+                cache_validator=still_current)) as blocks:
             for n, block in blocks:
                 out = jstep(block, *others)
                 if isinstance(out, BlockedTensor):
@@ -384,7 +433,9 @@ def _run_tensor_stream(node, tfold, in_vals, src, step_jit):
     carry = None
     with contextlib.closing(staging.stage_stream(
             pt.stream_blocks(), place, depth,
-            name=f"treduce:{pt.name}")) as blocks:
+            name=f"treduce:{pt.name}",
+            cache=cache, cache_key=cache_key("treduce"),
+            cache_validator=still_current)) as blocks:
         for start, block in blocks:
             carry = jstep(carry, start, block, *others)
     if tfold.finalize is not None:
@@ -425,7 +476,11 @@ def _execute_streamed(client, plan: LogicalPlan, scan_values: Dict[int, Any],
     # fold-step accumulators (argument 0 of every step) are donated so
     # XLA updates the per-stream state in place instead of allocating a
     # fresh HBM buffer every block; auto-gated to backends that
-    # implement donation (staging.fold_donate_argnums)
+    # implement donation (staging.fold_donate_argnums). ONLY the
+    # carried state is ever donated: chunk and resident arguments may
+    # be device-cache-owned blocks reused by the next query, and a
+    # donated cache block would be freed out from under it — donation
+    # applies exclusively to buffers the cache does not own.
     from netsdb_tpu.plan.staging import fold_donate_argnums
 
     donate_default = fold_donate_argnums(client.store.config)
@@ -446,9 +501,27 @@ def _execute_streamed(client, plan: LogicalPlan, scan_values: Dict[int, Any],
     def table_of(pc: PagedColumns):
         # HOST-side assembly (numpy columns): the fold-less fallback
         # must not materialize a paged set in device memory — consumers
-        # that compute on it upload transiently as jit arguments
+        # that compute on it upload transiently as jit arguments.
+        # The per-EXECUTION memo consults the CROSS-QUERY cache first
+        # (same budget as the device blocks): a warm serve EXECUTE
+        # skips the re-assembly stream entirely, and any write bumps
+        # the version out from under the entry.
         if id(pc) not in materialized:
-            materialized[id(pc)] = pc.to_host_table()
+            cache, key = pc._cache_ref("host-table", None)
+            if cache is not None:
+                hit = cache.get(key)
+                if hit is not None:
+                    materialized[id(pc)] = hit[0]
+                    return hit[0]
+            t = pc.to_host_table()
+            if cache is not None:
+                # currentness re-checked INSIDE install's lock — a
+                # racing write must not leave a dead entry on the budget
+                cache.install(
+                    key, [t],
+                    validator=lambda: pc._cache_ref(
+                        "host-table", None)[1] == key)
+            materialized[id(pc)] = t
         return materialized[id(pc)]
 
     def demote(v):

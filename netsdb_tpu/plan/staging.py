@@ -60,26 +60,46 @@ from typing import Any, Callable, Iterable, Iterator, Optional
 BUCKET_FLOOR = 8
 
 
-def bucket_rows(n: int) -> int:
-    """Smallest bucket ≥ ``n`` from the fixed ladder {2^k, 3·2^(k-1)}
-    (…, 8, 12, 16, 24, 32, 48, 64, 96, 128, …): two buckets per octave,
-    so padding is <50% of a chunk worst case (~20% typical) and every
-    distinct row count in a bucket's span compiles to the SAME XLA
-    program.  Buckets ≥ 16 are multiples of 8, so mesh-sharded chunks
-    usually divide their shard count without a second padding round."""
+def bucket_rows(n: int, density: int = 2) -> int:
+    """Smallest bucket ≥ ``n`` from the fixed ladder. ``density`` is
+    the ``config.bucket_density`` knob — buckets per octave:
+
+    * ``2`` (default): {2^k, 3·2^(k-1)} (…, 8, 12, 16, 24, 32, 48, 64,
+      96, 128, …) — padding <50% worst case (~20% typical). Buckets
+      ≥ 16 are multiples of 8, so mesh-sharded chunks usually divide
+      their shard count without a second padding round.
+    * ``4``: 2^(k-1)·{1.25, 1.5, 1.75} plus 2^k — padding <25% worst
+      case at twice the compile count (one XLA program per bucket).
+      ``micro_bench --bucket-sweep`` measures the pad-waste vs
+      trace-count trade per density (the ROADMAP ladder-tuning item).
+
+    Every distinct row count inside a bucket's span compiles to the
+    SAME XLA program either way."""
+    if density not in (2, 4):
+        # a typo'd knob silently behaving as the default would fragment
+        # device-cache keys for no behavioral difference
+        raise ValueError(f"bucket_density must be 2 or 4, got {density!r}")
     if n <= BUCKET_FLOOR:
         return BUCKET_FLOOR
     p = 1 << (n - 1).bit_length()  # next power of two ≥ n
+    if density >= 4:
+        for mul in (10, 12, 14):   # (p/2)·{1.25, 1.5, 1.75} = p·mul/16
+            c = (p * mul) // 16
+            if c >= n:
+                return c
+        return p
     half = (3 * p) // 4            # the 1.5× step below it
     return half if half >= n else p
 
 
-def pad_rows_target(n: int, bucketing: bool, multiple: int = 1) -> int:
+def pad_rows_target(n: int, bucketing: bool, multiple: int = 1,
+                    density: int = 2) -> int:
     """Row count a chunk of ``n`` valid rows pads to: its bucket when
-    ``bucketing``, else ``n`` itself; then rounded up to ``multiple``
-    (a placement's shard granularity) so placed chunks shard without a
-    second padding round."""
-    target = bucket_rows(n) if bucketing else n
+    ``bucketing`` (``density`` = the config's buckets-per-octave knob),
+    else ``n`` itself; then rounded up to ``multiple`` (a placement's
+    shard granularity) so placed chunks shard without a second padding
+    round."""
+    target = bucket_rows(n, density) if bucketing else n
     if multiple > 1:
         target += (-target) % multiple
     return target
@@ -133,6 +153,40 @@ def active_count() -> int:
         return len(_stagers)
 
 
+# --- event trace (tests only; production pays one bool check) ---------
+# A flat ordered log of staging milestones: ("place", name, seq) when a
+# stream's Nth item finishes placing (i.e. its upload completed),
+# ("end", name) when a stream's source exhausts, ("close", name) when
+# the consumer closes it, ("cache_hit", name) when a run is served from
+# the device cache. The grace-hash overlap test asserts on the ORDER:
+# pair i+1's build "place" must precede pair i's probe "close".
+_events: list = []
+_events_on = False
+_events_lock = threading.Lock()
+
+
+def trace_events(on: bool) -> None:
+    """Enable/disable the staging event log (clearing it either way)."""
+    global _events_on
+    with _events_lock:
+        _events.clear()
+        _events_on = bool(on)
+
+
+def events() -> list:
+    """Snapshot of the event log in emission order."""
+    with _events_lock:
+        return list(_events)
+
+
+def _emit(kind: str, name: str, seq: Optional[int] = None) -> None:
+    if not _events_on:
+        return
+    with _events_lock:
+        if _events_on:
+            _events.append((kind, name, seq))
+
+
 def _stage_put(q: "queue.Queue", stop: threading.Event, item) -> bool:
     """Bounded put that gives up when the consumer closed the stream
     (same pattern as ``stream_blocks``'s reader)."""
@@ -146,18 +200,27 @@ def _stage_put(q: "queue.Queue", stop: threading.Event, item) -> bool:
 
 
 def _stage_worker(source, place, q: "queue.Queue",
-                  stop: threading.Event) -> None:
+                  stop: threading.Event, name: str,
+                  on_complete=None) -> None:
     """The staging thread body. DELIBERATELY a free function over
     explicit state, never a bound method: the Thread must not hold a
     reference to the StagedStream, or an abandoned stream could never
     be garbage-collected (its own worker would keep it alive) and the
-    worker would spin in ``put`` until process exit."""
+    worker would spin in ``put`` until process exit.
+
+    ``on_complete`` fires only on NATURAL source exhaustion (never on
+    error or abandonment) — the device-cache install hook: only a FULL
+    run may be installed, a truncated one never."""
+    seq = 0
     try:
         try:
             for item in source:
                 if stop.is_set():
                     return
-                if not _stage_put(q, stop, (_SENT_ITEM, place(item))):
+                placed = place(item)
+                _emit("place", name, seq)
+                seq += 1
+                if not _stage_put(q, stop, (_SENT_ITEM, placed)):
                     return  # consumer abandoned the stream
         finally:
             # the worker owns the source: close it HERE so read locks
@@ -170,6 +233,12 @@ def _stage_worker(source, place, q: "queue.Queue",
     except BaseException as e:  # ANY death must surface at consumer
         _stage_put(q, stop, (_SENT_ERR, e))
         return
+    _emit("end", name)
+    if on_complete is not None:
+        try:
+            on_complete()
+        except Exception:  # a failed cache install must not kill the
+            pass           # stream — the run simply stays uncached
     _stage_put(q, stop, (_SENT_END, None))
 
 
@@ -181,19 +250,23 @@ class StagedStream:
     overlap, same results)."""
 
     def __init__(self, source: Iterable, place: Callable[[Any], Any],
-                 depth: int = 2, name: str = "stage"):
+                 depth: int = 2, name: str = "stage",
+                 on_complete: Optional[Callable[[], None]] = None):
         self._source = iter(source)
         self._place = place
         self._depth = int(depth)
         self._name = name
         self._closed = False
+        self._on_complete = on_complete
+        self._sync_seq = 0
         self._thread: Optional[threading.Thread] = None
         if self._depth > 0:
             self._q: "queue.Queue" = queue.Queue(maxsize=self._depth)
             self._stop = threading.Event()
             self._thread = threading.Thread(
                 target=_stage_worker,
-                args=(self._source, self._place, self._q, self._stop),
+                args=(self._source, self._place, self._q, self._stop,
+                      name, on_complete),
                 daemon=True, name=f"netsdb-stage-{name}")
             with _stagers_lock:
                 _stagers[:] = [t for t in _stagers if t.is_alive()]
@@ -209,10 +282,20 @@ class StagedStream:
             if self._closed:
                 raise StopIteration
             try:
-                return self._place(next(self._source))
+                item = next(self._source)
             except StopIteration:
+                _emit("end", self._name)
+                if self._on_complete is not None:
+                    try:
+                        self._on_complete()
+                    except Exception:  # install failure ≠ stream failure
+                        pass
                 self.close()
                 raise
+            placed = self._place(item)
+            _emit("place", self._name, self._sync_seq)
+            self._sync_seq += 1
+            return placed
         if self._closed:
             raise StopIteration
         while True:
@@ -229,6 +312,9 @@ class StagedStream:
                 raise val
             if kind is _SENT_END:
                 self._closed = True
+                # the CONSUMER observed exhaustion — the "stream
+                # finished" moment the overlap tests anchor on
+                _emit("close", self._name)
                 raise StopIteration
             return val
 
@@ -239,10 +325,13 @@ class StagedStream:
         if self._thread is None:
             if not self._closed:
                 self._closed = True
+                _emit("close", self._name)
                 close = getattr(self._source, "close", None)
                 if close is not None:
                     close()
             return
+        if not self._closed:
+            _emit("close", self._name)
         self._closed = True
         self._stop.set()
         # drain so a worker blocked in put() observes the stop quickly
@@ -269,12 +358,113 @@ class StagedStream:
             self.close()
 
 
+class _CachedRun:
+    """Iterator over a device-cached run — what :func:`stage_stream`
+    returns on a cache hit: the blocks are ALREADY device-resident, so
+    there is no source, no staging thread, no transfer. Supports the
+    same ``close()`` discipline as :class:`StagedStream` so consumers
+    under ``contextlib.closing`` need not care which they got."""
+
+    def __init__(self, blocks, name: str):
+        self._it = iter(blocks)
+        self._name = name
+
+    def __iter__(self):
+        return self
+
+    def __next__(self):
+        return next(self._it)
+
+    def close(self) -> None:
+        self._it = iter(())
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.close()
+
+
+class _CacheRecorder:
+    """Wraps a ``place`` function so a completed run installs into the
+    device cache: every placed block is recorded, and ``complete`` —
+    fired by the stream only on NATURAL source exhaustion — installs
+    the full ordered run under ``key``. An abandoned or failed stream
+    never installs (a truncated run must not masquerade as the set).
+
+    Recording is BYTE-BOUNDED as it goes: the moment the accumulated
+    run exceeds the cache budget, every held block is dropped and
+    recording stops — a set bigger than the cache must stream with
+    only ``depth`` blocks live (the out-of-core discipline), never
+    hold its whole self device-resident waiting for an install that
+    would be rejected anyway."""
+
+    def __init__(self, cache, key, place, validator=None):
+        from netsdb_tpu.storage.devcache import _value_nbytes
+
+        self._cache = cache
+        self._key = key
+        self._place = place
+        self._validator = validator
+        self._nbytes_of = _value_nbytes
+        self._blocks: list = []
+        self._bytes = 0
+        self._cap = cache.budget_bytes
+        self._overflow = False
+
+    def __call__(self, item):
+        placed = self._place(item)
+        if not self._overflow:
+            self._bytes += self._nbytes_of(placed)
+            if self._bytes > self._cap:
+                self._overflow = True
+                self._blocks = []  # release NOW, not at stream end
+            else:
+                self._blocks.append(placed)
+                # evict AS the run grows: resident entries + this run
+                # must together stay ~one budget, not spike to two at
+                # install time
+                self._cache.make_room(self._bytes)
+        return placed
+
+    def complete(self) -> None:
+        if self._overflow:
+            self._cache.reject_oversized()
+            return
+        # the validator runs INSIDE install's critical section: a
+        # write racing this install either invalidates after it (normal
+        # eviction) or bumps the version before it (validator rejects)
+        # — either way no dead entry can squat on the budget
+        self._cache.install(self._key, self._blocks,
+                            validator=self._validator)
+
+
 def stage_stream(source: Iterable, place: Callable[[Any], Any],
-                 depth: int = 2, name: str = "stage") -> StagedStream:
-    """Wrap ``source`` so ``place`` (pad + ``jax.device_put``) runs up
-    to ``depth`` items ahead on a background thread.  The ONE
-    constructor every out-of-core consumer goes through — the static
-    check in ``tests/test_static_checks.py`` bans loose ``device_put``
-    loops in ``plan/`` and ``relational/outofcore.py`` so the overlap
-    cannot silently regress."""
+                 depth: int = 2, name: str = "stage",
+                 cache=None, cache_key=None, cache_validator=None):
+    """Wrap ``source`` so ``place`` (pad + upload via
+    ``storage/devcache.to_device``) runs up to ``depth`` items ahead on
+    a background thread.  The ONE constructor every out-of-core
+    consumer goes through — the static check in
+    ``tests/test_static_checks.py`` bans loose ``device_put`` call
+    sites in ``storage/``, ``plan/`` and ``relational/outofcore.py``
+    so neither the overlap nor the cache can silently regress.
+
+    ``cache``/``cache_key`` (a :class:`~netsdb_tpu.storage.devcache.
+    DeviceBlockCache` and its versioned key) make the stream
+    cache-aware: a hit replays the device-resident run with ZERO
+    host→device transfers (no thread, no arena reads); a miss streams
+    normally and installs the completed run on the way through — the
+    staged-uploads-install-into-the-cache leg of the tentpole.
+    ``cache_validator`` (no-arg callable → bool) re-checks at install
+    time that ``cache_key`` is still current — a write racing the
+    stream must not leave a dead entry squatting on the budget."""
+    if cache is not None and cache_key is not None and cache.enabled:
+        hit = cache.get(cache_key)
+        if hit is not None:
+            _emit("cache_hit", name)
+            return _CachedRun(hit, name)
+        rec = _CacheRecorder(cache, cache_key, place, cache_validator)
+        return StagedStream(source, rec, depth=depth, name=name,
+                            on_complete=rec.complete)
     return StagedStream(source, place, depth=depth, name=name)

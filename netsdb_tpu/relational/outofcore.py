@@ -76,6 +76,17 @@ class PagedColumns:
         # to re-stream the set (the reference's StorageCollectStats
         # moment, ``PangeaStorageServer.h:48``)
         self.stats = stats or {}
+        # device-cache binding (storage/devcache.py), set by
+        # ``SetStore._bind_cache`` for store-owned relations only —
+        # grace-hash spill partitions and bench temporaries stay
+        # uncached. ``_mutations`` is this handle's own append/drop
+        # counter: it rides every cache key so even direct
+        # ``pc.append`` callers (bypassing the store's version bump)
+        # can never leave a stale cached run matchable.
+        self.devcache = None
+        self.cache_scope = None
+        self.cache_version_fn = None
+        self._mutations = 0
 
     # ------------------------------------------------------------ ingest
     @staticmethod
@@ -192,6 +203,7 @@ class PagedColumns:
                     old.n_rows + new.n_rows, min(old.min_val, new.min_val),
                     max(old.max_val, new.max_val), -1))
             self.num_rows += n_new
+            self._mutations += 1  # cached runs of the old rows are dead
 
     # ------------------------------------------------------------ stream
     def pad_rows(self) -> int:
@@ -204,7 +216,8 @@ class PagedColumns:
 
         return pad_rows_target(
             self.row_block,
-            getattr(self.store.config, "shape_bucketing", True))
+            getattr(self.store.config, "shape_bucketing", True),
+            density=getattr(self.store.config, "bucket_density", 2))
 
     def stream(self, prefetch: Optional[int] = None, device: bool = True):
         """Chunk stream of (cols, valid, start_row), every chunk padded
@@ -306,14 +319,35 @@ class PagedColumns:
         suffix = ".int" if self.int_names else ".float"
         return self.store.num_blocks(self.name + suffix)
 
+    def _cache_ref(self, kind: str, placement):
+        """(cache, key) when this relation is store-owned and the
+        device cache is on, else (None, None). The key is the
+        tentpole's ``(db:set, version, bucket, sharding)`` — plus this
+        handle's own mutation counter and the stream kind — so a warm
+        stream of the SAME content/shape/sharding replays device-
+        resident blocks and any write anywhere unkeys every old run."""
+        cache = self.devcache
+        if (cache is None or not cache.enabled
+                or self.cache_scope is None or self.dropped):
+            return None, None
+        ver = (self.cache_version_fn()
+               if self.cache_version_fn is not None else 0)
+        key = (self.cache_scope, ver, self._mutations, kind,
+               self.pad_rows(),
+               placement.label() if placement is not None else None)
+        return cache, key
+
     def drop(self) -> None:
         """Free this relation's pages from the shared arena (both the
         int and float matrices). After this the PagedColumns is dead.
         Waits for in-flight streams (read lock holders) to drain."""
         with self.rw.write():
             self.dropped = True
+            self._mutations += 1
             for suffix in (".int", ".float"):
                 self.store.drop(self.name + suffix)
+        if self.devcache is not None and self.cache_scope is not None:
+            self.devcache.invalidate(self.cache_scope)
 
     def stream_tables(self, prefetch: Optional[int] = None,
                       placement=None):
@@ -336,9 +370,18 @@ class PagedColumns:
         so placed chunks usually shard without a second padding round —
         when a bucket doesn't divide, ``shard_table`` pads the
         remainder (one deterministic final shape per bucket either
-        way)."""
+        way).
+
+        Store-owned relations consult the cross-query DEVICE CACHE
+        first (``storage/devcache.py``): a warm stream replays the
+        placed chunk tables already in device memory — zero arena
+        reads, zero host→device transfers — and a cold stream installs
+        the completed run on the way through. Cached chunks are owned
+        by the cache, never donation targets (fold steps donate only
+        their carried accumulator)."""
         from netsdb_tpu.plan.staging import stage_stream
 
+        cache, cache_key = self._cache_ref("tables", placement)
         base_rowid = np.arange(self.pad_rows(), dtype=np.int32)
         dicts = self.dicts
 
@@ -362,7 +405,12 @@ class PagedColumns:
         return stage_stream(
             self._host_stream(prefetch), place,
             depth=getattr(self.store.config, "stage_depth", 2),
-            name=f"tables:{self.name}")
+            name=f"tables:{self.name}",
+            cache=cache, cache_key=cache_key,
+            cache_validator=(
+                None if cache is None else
+                lambda: self._cache_ref("tables", placement)[1]
+                == cache_key))
 
     def stream_host_tables(self, prefetch: Optional[int] = None
                            ) -> Iterator[ColumnTable]:

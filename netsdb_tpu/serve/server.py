@@ -1198,14 +1198,15 @@ class ServeController:
         """The leader's replayable state: databases, registered types,
         and every set as host values. Paged relations snapshot as their
         host-assembled form (chunk tables / records) and re-page on the
-        follower; only a paged MATRIX — which by design never
-        materializes (PAGED_MATMUL streams it) — is recreated empty,
-        so mirrored frames targeting it still find the set instead of
-        failing and re-evicting the follower forever (page-level
-        streaming resync is a ROADMAP follow-on)."""
+        follower; a paged MATRIX — which by design never materializes
+        densely (PAGED_MATMUL streams it) — snapshots as its ordered
+        arena PAGE BLOCKS and replays page by page on the follower
+        (``SetStore.restore_paged_matrix``), closing the PR 2 leftover
+        where it resynced as an empty set."""
         from netsdb_tpu.core.blocked import BlockedTensor
         from netsdb_tpu.relational.outofcore import PagedColumns
         from netsdb_tpu.storage.paged import PagedObjects
+        from netsdb_tpu.storage.store import _PagedMatrix
 
         cat = self.library.catalog
         types = []
@@ -1231,11 +1232,31 @@ class ServeController:
                 elif len(items) == 1 and isinstance(items[0], PagedObjects):
                     entry["kind"] = "paged-objects"
                     entry["items"] = list(items[0])
+                elif len(items) == 1 and isinstance(items[0],
+                                                    _PagedMatrix):
+                    # paged MATRIX: snapshot its arena pages in order
+                    # so the follower re-pages them block by block.
+                    # Peak: ALL pages host-resident in the snapshot at
+                    # once — the SAME whole-relation bound the
+                    # paged-table branch above pays (to_host_table) and
+                    # the one-blob resync wire format imposes anyway;
+                    # a bounded page-streamed resync is the ROADMAP
+                    # follow-on. The read lock pins the pages against a
+                    # concurrent replace; the snapshot itself already
+                    # holds the exclusive frame order.
+                    pm = items[0]
+                    ps = self.library.store.page_store()
+                    with pm.rw.read():
+                        blocks = [np.asarray(b) for _, b in
+                                  ps.stream_blocks(f"{pm.ident}.mat",
+                                                   prefetch=0)]
+                        rb = int(ps.meta(f"{pm.ident}.mat")[1][0])
+                    entry["kind"] = "paged-matrix"
+                    entry["blocks"] = blocks
+                    entry["row_block"] = rb
                 else:
-                    # _PagedMatrix: deliberately never materializes —
-                    # recreate the (empty) set so the follower keeps
-                    # accepting frames for it; content diverges until
-                    # re-ingest (documented ROADMAP follow-on)
+                    # unknown/empty paged content: recreate the (empty)
+                    # set so the follower keeps accepting frames for it
                     entry["kind"] = "paged-empty"
             elif len(items) == 1 and isinstance(items[0], BlockedTensor):
                 t = items[0]
@@ -1292,6 +1313,12 @@ class ServeController:
                 # host chunk table re-pages through the ingest path
                 self.library.send_table(entry["db"], entry["set"],
                                         entry["table"])
+            elif kind == "paged-matrix":
+                # leader arena pages replay page by page — the matrix
+                # never materializes densely on this side either
+                self.library.store.restore_paged_matrix(
+                    SetIdentifier(entry["db"], entry["set"]),
+                    entry["blocks"], int(entry.get("row_block") or 1))
             elif kind == "paged-empty":
                 pass  # set exists; content streams in on next ingest
             elif entry["items"]:
@@ -1301,6 +1328,11 @@ class ServeController:
                     SetIdentifier(entry["db"], entry["set"]),
                     list(entry["items"]))
             restored += 1
+        # the whole store was just replaced wholesale: every remove/
+        # re-ingest above already bumped its set's version, but the
+        # explicit clear returns the dead device blocks to the budget
+        # NOW (the resync invalidation hook the cache contract names)
+        self.library.store.device_cache().clear()
         return MsgType.OK, {"restored_sets": restored}
 
     #: mirrored frames scoped to ONE (db, set) target — these serialize
@@ -1968,8 +2000,13 @@ class ServeController:
             return MsgType.OK, {"jobs": [dict(j) for j in self._jobs.values()]}
 
     def _on_collect_stats(self, p):
+        # device_cache: the cross-query device-resident block cache's
+        # hit/miss/evict/bytes counters (storage/devcache.py) — the
+        # serve STATUS view of the warm-EXECUTE path
         return MsgType.OK, {"sets": self.library.collect_stats(),
-                            "cache": self.library.store.stats.as_dict()}
+                            "cache": self.library.store.stats.as_dict(),
+                            "device_cache":
+                                self.library.store.device_cache().stats()}
 
     def _on_analyze_set(self, p):
         """Planner statistics computed where the data lives — the

@@ -1,0 +1,259 @@
+"""Cross-query device-resident block cache — the buffer pool for HBM.
+
+netsDB's workers owe their repeat-query speed to the shared-memory
+buffer pool: pages of a hot set stay PINNED across jobs, so the second
+query over ``lineitem`` never touches storage again
+(``src/storage/headers/PageCache.h:106-118`` — pin/unpin + eviction
+under one memory budget). Our reproduction had no analogue: every
+serve ``EXECUTE`` re-read the arena, re-padded and re-``device_put``
+every chunk of a set that was device-resident milliseconds ago. The
+TPU literature says the same discipline is what makes pipelines fast —
+keep operands device-resident across calls and ship only deltas
+(arxiv 2112.09017 §IV); at this scale the avoided TRANSFERS dominate,
+not kernel tweaks.
+
+:class:`DeviceBlockCache` is that buffer pool for placed blocks:
+
+* **Keying** — entries key on
+  ``(scope, version, mutations, kind, bucket, sharding)`` where
+  ``scope`` is the set identity (``"db:set"``), ``version`` the
+  store's monotonic per-set write version (bumped by EVERY path that
+  can change a set: ingest, BULK COMMIT, mirrored frames, resync,
+  checkpoint restore — ``SetStore._touch``), ``mutations`` the
+  relation handle's own append/drop counter (covers direct
+  ``PagedColumns.append`` callers that bypass the store), ``bucket``
+  the chunk pad target and ``sharding`` the placement label. A write
+  moves the version, so a stale entry can never MATCH again — version
+  keying is the correctness mechanism; eviction is only about memory.
+* **Budget** — entries LRU-evict under ``config.device_cache_bytes``
+  (``PageCache::evict`` under one pool size). An entry bigger than the
+  whole budget is simply not installed.
+* **Introspection** — hit/miss/install/evict/invalidate counters plus
+  live bytes/entries, surfaced ``compile_stats()``-style via
+  :meth:`stats` and through the serve ``COLLECT_STATS`` frame.
+* **Ownership** — cached blocks are owned by the CACHE, not by any one
+  execution: they are never donation targets. Fold steps donate only
+  their carried accumulator (argument 0 — ``staging.
+  fold_donate_argnums``); a jit must never be handed a cached block
+  with ``donate_argnums`` covering it, or XLA would free a buffer the
+  next query expects to reuse.
+
+The one blessed upload helper, :func:`to_device`, lives here so the
+static check (``tests/test_static_checks.py``) can ban direct
+``device_put`` of store-owned set blocks everywhere else in
+``storage/``, ``plan/`` and the out-of-core engine — future call sites
+cannot silently bypass the cache/staging layer.
+"""
+
+from __future__ import annotations
+
+import threading
+from collections import OrderedDict
+from typing import Any, Dict, List, Optional, Tuple
+
+
+def to_device(x, sharding=None):
+    """The ONE sanctioned host→device upload for store-owned blocks
+    (everything else goes through ``plan/staging.stage_stream``, whose
+    ``place`` functions call this). Centralized so the static check can
+    ban loose ``device_put`` call sites."""
+    import jax
+
+    if sharding is not None:
+        return jax.device_put(x, sharding)
+    return jax.device_put(x)
+
+
+def _array_nbytes(arr) -> int:
+    """Bytes of one column/array WITHOUT touching its data: jax and
+    numpy arrays expose ``nbytes`` as shape×itemsize metadata — calling
+    ``np.asarray`` here would be a blocking device→host copy of the
+    whole buffer just for accounting."""
+    nbytes = getattr(arr, "nbytes", None)
+    if nbytes is not None:
+        return int(nbytes)
+    import numpy as np
+
+    return int(np.asarray(arr).nbytes)
+
+
+def _value_nbytes(value) -> int:
+    """Recursive byte accounting for a cached run: ColumnTables, jax
+    arrays, numpy arrays, (n, block) tuples — anything a ``place``
+    function yields. Metadata-only (never reads array data)."""
+    if isinstance(value, (bytes, bytearray)):
+        return len(value)
+    cols = getattr(value, "cols", None)
+    if cols is not None:  # ColumnTable-shaped
+        total = sum(_array_nbytes(v) for v in cols.values())
+        valid = getattr(value, "valid", None)
+        if valid is not None:
+            total += _array_nbytes(valid)
+        return total
+    if getattr(value, "nbytes", None) is not None:
+        return int(value.nbytes)
+    if isinstance(value, (tuple, list)):
+        return sum(_value_nbytes(v) for v in value)
+    return 64  # scalars / ints riding along with blocks
+
+
+class DeviceBlockCache:
+    """LRU cache of placed set-block runs under one byte budget.
+
+    A cache ENTRY is one whole run — the ordered list of placed chunks
+    one full stream of a set produces (matching the key's bucket and
+    sharding). Whole-run granularity matches the key the tentpole
+    names: ``(db, set, version, bucket, sharding)`` — a warm consumer
+    replays the run without touching the arena or the transfer path at
+    all, which is what makes the warm serve ``EXECUTE`` zero-copy.
+
+    Thread-safe: consults happen on consumer threads, installs on
+    staging threads, invalidations on serve handler threads.
+    """
+
+    def __init__(self, budget_bytes: int = 0):
+        self._mu = threading.Lock()
+        self._budget = int(budget_bytes or 0)
+        # key -> (blocks, nbytes); insertion order IS recency order
+        self._entries: "OrderedDict[Tuple, Tuple[List[Any], int]]" = \
+            OrderedDict()
+        # scope -> keys, for prompt invalidation (version keying alone
+        # already guarantees freshness; this returns the bytes NOW)
+        self._by_scope: Dict[str, set] = {}
+        self._bytes = 0
+        self._stats = {"hits": 0, "misses": 0, "installs": 0,
+                       "evictions": 0, "invalidations": 0,
+                       "rejected": 0}
+
+    # --- sizing -------------------------------------------------------
+    @property
+    def enabled(self) -> bool:
+        return self._budget > 0
+
+    @property
+    def budget_bytes(self) -> int:
+        return self._budget
+
+    def resize(self, budget_bytes: int) -> None:
+        """Re-point the budget (the serve knob path / the bench's
+        cache-off baseline). Shrinking evicts immediately."""
+        with self._mu:
+            self._budget = int(budget_bytes or 0)
+            self._evict_to_fit_locked(0)
+
+    # --- the data path ------------------------------------------------
+    def get(self, key: Tuple) -> Optional[List[Any]]:
+        """The run cached under ``key``, or None (counted as a miss).
+        Hits refresh LRU recency."""
+        with self._mu:
+            if not self.enabled:
+                return None
+            entry = self._entries.get(key)
+            if entry is None:
+                self._stats["misses"] += 1
+                return None
+            self._entries.move_to_end(key)
+            self._stats["hits"] += 1
+            return entry[0]
+
+    def make_room(self, nbytes: int) -> None:
+        """Evict LRU entries until ``nbytes`` of headroom exists under
+        the budget. Called INCREMENTALLY by the recorder while a cold
+        stream installs-in-progress (``staging._CacheRecorder``), so
+        peak device residency stays ~one budget — resident entries plus
+        the in-flight run together — instead of transiently doubling at
+        install time. Best-effort across concurrent recorders (two
+        simultaneous cold streams can still briefly sum above budget)."""
+        with self._mu:
+            if self.enabled:
+                self._evict_to_fit_locked(min(int(nbytes), self._budget))
+
+    def reject_oversized(self) -> None:
+        """Count a run the recorder refused to hold (it outgrew the
+        whole budget mid-stream — ``staging._CacheRecorder``)."""
+        with self._mu:
+            if self.enabled:
+                self._stats["rejected"] += 1
+
+    def install(self, key: Tuple, blocks: List[Any],
+                validator=None) -> bool:
+        """Insert one complete run. Returns False when the run exceeds
+        the whole budget (never installed — a set bigger than the cache
+        streams every time, it does not thrash everyone else out).
+
+        ``validator`` (no-arg → bool) is evaluated INSIDE the cache
+        lock: the write path bumps the set version BEFORE invalidating
+        (``SetStore._touch``), so a validator that re-derives the key
+        from the current version and runs after an invalidate always
+        sees the bump and rejects — check-then-install cannot race a
+        write into stranding a dead entry on the budget."""
+        nbytes = _value_nbytes(blocks)
+        with self._mu:
+            if not self.enabled or nbytes > self._budget:
+                if self.enabled:
+                    self._stats["rejected"] += 1
+                return False
+            if validator is not None and not validator():
+                return False
+            old = self._entries.pop(key, None)
+            if old is not None:
+                self._bytes -= old[1]
+            self._evict_to_fit_locked(nbytes)
+            self._entries[key] = (blocks, nbytes)
+            self._bytes += nbytes
+            self._by_scope.setdefault(str(key[0]), set()).add(key)
+            self._stats["installs"] += 1
+            return True
+
+    def _evict_to_fit_locked(self, incoming: int) -> None:
+        while self._entries and self._bytes + incoming > self._budget:
+            old_key, (_, old_bytes) = self._entries.popitem(last=False)
+            self._bytes -= old_bytes
+            scoped = self._by_scope.get(str(old_key[0]))
+            if scoped is not None:
+                scoped.discard(old_key)
+                if not scoped:
+                    self._by_scope.pop(str(old_key[0]), None)
+            self._stats["evictions"] += 1
+
+    # --- invalidation -------------------------------------------------
+    def invalidate(self, scope: str) -> int:
+        """Drop every entry of one set NOW (the write-path hook —
+        version keying already prevents stale reads; this returns the
+        dead bytes to the budget immediately). Returns entries
+        dropped."""
+        with self._mu:
+            keys = self._by_scope.pop(str(scope), None)
+            if not keys:
+                return 0
+            dropped = 0
+            for key in keys:
+                entry = self._entries.pop(key, None)
+                if entry is not None:
+                    self._bytes -= entry[1]
+                    dropped += 1
+            self._stats["invalidations"] += dropped
+            return dropped
+
+    def clear(self) -> int:
+        """Drop everything (the resync-restore hook: the whole store
+        was just replaced wholesale)."""
+        with self._mu:
+            dropped = len(self._entries)
+            self._entries.clear()
+            self._by_scope.clear()
+            self._bytes = 0
+            self._stats["invalidations"] += dropped
+            return dropped
+
+    # --- introspection ------------------------------------------------
+    def stats(self) -> Dict[str, int]:
+        """Counter snapshot (the ``compile_stats()`` analogue for the
+        transfer path) — also shipped in the serve COLLECT_STATS
+        reply."""
+        with self._mu:
+            out = dict(self._stats)
+            out["bytes"] = self._bytes
+            out["entries"] = len(self._entries)
+            out["budget_bytes"] = self._budget
+            return out

@@ -94,6 +94,13 @@ class PagedTensor:
         self.name = name
         self.rw = rw if rw is not None else RWLock()
         self.placement = placement
+        # device-cache binding (set by SetStore.paged_tensor for
+        # store-owned handles): scope = (ident str, write version) —
+        # the executor's tensor stream keys cached runs on it, and
+        # cache_version_fn re-checks currentness at install time
+        self.devcache = None
+        self.cache_scope = None
+        self.cache_version_fn = None
 
     @property
     def shape(self) -> Tuple[int, int]:
@@ -443,11 +450,11 @@ class PagedTensorStore:
         depth ahead of the assembly (``plan/staging``)."""
         import contextlib
 
-        import jax
         import jax.numpy as jnp
 
         from netsdb_tpu.core.blocked import BlockMeta, BlockedTensor
         from netsdb_tpu.plan.staging import stage_stream
+        from netsdb_tpu.storage.devcache import to_device
 
         sid = self._ids[name]
         (rows, cols), _, dtype = self._meta[sid]
@@ -456,7 +463,7 @@ class PagedTensorStore:
         chunks = []
         with contextlib.closing(stage_stream(
                 self.stream_blocks(name),
-                lambda item: jax.device_put(item[1]),
+                lambda item: to_device(item[1]),
                 depth=getattr(self.config, "stage_depth", 2),
                 name=f"blocked:{name}")) as staged:
             for chunk in staged:
@@ -486,12 +493,14 @@ class PagedTensorStore:
         import jax.numpy as jnp
 
         from netsdb_tpu.plan.staging import pad_rows_target, stage_stream
+        from netsdb_tpu.storage.devcache import to_device
 
         depth = getattr(self.config, "stage_depth", 2) \
             if stage_depth is None else stage_depth
         bucketing = getattr(self.config, "shape_bucketing", True)
+        density = getattr(self.config, "bucket_density", 2)
         rb = self._meta[self._ids[name]][1][0]
-        rhs_dev = jax.device_put(rhs)
+        rhs_dev = to_device(rhs)
 
         @jax.jit
         def block_mm(a, b):
@@ -502,10 +511,11 @@ class PagedTensorStore:
         def place(item):
             _start, block = item
             n = block.shape[0]
-            target = pad_rows_target(max(n, rb), bucketing)
+            target = pad_rows_target(max(n, rb), bucketing,
+                                     density=density)
             if target > n:
                 block = np.pad(block, ((0, target - n), (0, 0)))
-            return n, jax.device_put(block)
+            return n, to_device(block)
 
         outs = []
         with contextlib.closing(stage_stream(

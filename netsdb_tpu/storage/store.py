@@ -88,6 +88,13 @@ class _StoredSet:
     # pages in the shared PagedTensorStore; queries stream it — the
     # reference's PageScanner-fed sets, ``PageScanner.h:25-34``)
     storage: str = "memory"
+    # monotonic write version, drawn from the store-wide counter by
+    # EVERY mutating path (ingest, append, clear, resync restore,
+    # spill reload, …) — the freshness token the device block cache
+    # keys on (storage/devcache.py): a bumped version means no stale
+    # cached block can ever match again. Store-wide numbering means a
+    # removed-and-recreated set can never reuse an old version.
+    version: int = 0
 
 
 def _item_nbytes(item: Any) -> int:
@@ -163,6 +170,11 @@ class SetStore:
         import itertools
 
         self._gen = itertools.count()
+        # store-wide set-version counter + the cross-query device block
+        # cache (the buffer-pool role, storage/devcache.py) — lazy like
+        # the page store; most short-lived stores never touch it
+        self._version_ctr = itertools.count(1)
+        self._device_cache = None
 
     def page_store(self):
         """The shared :class:`PagedTensorStore` backing every
@@ -176,6 +188,44 @@ class SetStore:
                     self.config,
                     pool_bytes=self.config.page_pool_bytes)
             return self._page_store
+
+    def device_cache(self):
+        """The cross-query device block cache (``storage/devcache.py``)
+        backing warm repeat queries — one per store, budgeted by
+        ``config.device_cache_bytes``."""
+        with self._lock:
+            if self._device_cache is None:
+                from netsdb_tpu.storage.devcache import DeviceBlockCache
+
+                self._device_cache = DeviceBlockCache(
+                    getattr(self.config, "device_cache_bytes", 0) or 0)
+            return self._device_cache
+
+    def _touch(self, s: _StoredSet) -> None:
+        """Advance a set's write version and drop its cached device
+        blocks NOW. Called by EVERY path that can change the set's
+        content — direct ingest, appends, BULK COMMIT (which lands
+        through these same mutators), mirrored frames, resync restore,
+        checkpoint/spill reload — so the device cache can never serve a
+        stale block: the version is part of every cache key."""
+        s.version = next(self._version_ctr)
+        if self._device_cache is not None:
+            self._device_cache.invalidate(str(s.ident))
+
+    def version_of(self, ident: SetIdentifier) -> int:
+        """The set's current write version (0 = unknown set) — the
+        freshness token device-cache keys carry."""
+        s = self._sets.get(ident)
+        return s.version if s is not None else 0
+
+    def _bind_cache(self, pc, ident: SetIdentifier) -> None:
+        """Attach the device cache to a store-owned paged relation
+        handle so its streams consult/install cached runs. Direct
+        ``PagedColumns.ingest`` callers (grace-hash spill partitions,
+        benches) never get a binding — temporaries stay uncached."""
+        pc.devcache = self.device_cache()
+        pc.cache_scope = str(ident)
+        pc.cache_version_fn = functools.partial(self.version_of, ident)
 
     # --- set lifecycle ------------------------------------------------
     @_locked
@@ -195,11 +245,13 @@ class SetStore:
                 ident=ident, items=[], persistence=persistence, eviction=eviction,
                 last_access=time.time(), placement=placement, storage=storage,
             )
+            self._touch(self._sets[ident])
         elif placement is not None:
             s = self._sets[ident]
             s.placement = placement
             if s.items:  # re-place already-stored data under the new policy
                 s.items = [placement.apply(i) for i in s.items]
+            self._touch(s)  # resharded items: cached runs are stale
 
     def placement_of(self, ident: SetIdentifier) -> Optional[Any]:
         s = self._sets.get(ident)
@@ -218,6 +270,8 @@ class SetStore:
             detached = list(s.items or []) if s is not None else []
             if s is not None:
                 s.items = []
+            if self._device_cache is not None:
+                self._device_cache.invalidate(str(ident))
             path = self._spill_path(ident)
             if os.path.exists(path):
                 os.remove(path)
@@ -233,6 +287,7 @@ class SetStore:
             if s is not None:
                 s.items = []
                 s.nbytes = 0
+                self._touch(s)
         self._drop_detached(detached)
 
     def _drop_paged_items(self, s: Optional[_StoredSet]) -> None:
@@ -280,6 +335,7 @@ class SetStore:
                 s.nbytes += sum(_item_nbytes(i) for i in items)
                 s.last_access = time.time()
                 self._maybe_evict(exclude=ident)
+            self._touch(s)
         self._drop_detached(dead)  # replaced pages reclaim UNLOCKED
 
     def _ingest_paged(self, s: _StoredSet, items: List[Any],
@@ -315,6 +371,7 @@ class SetStore:
             if not (s.items and len(s.items) == 1 and s.items[0] is item):
                 dead = list(s.items or [])
             s.items = [item]
+            self._bind_cache(item, s.ident)
             return dead
         if isinstance(item, (np.ndarray, BlockedTensor)):
             if append:
@@ -382,6 +439,7 @@ class SetStore:
         pc = PagedColumns.ingest(self.page_store(),
                                  f"{s.ident}#g{next(self._gen)}", cols,
                                  row_block=row_block, dicts=dict(item.dicts))
+        self._bind_cache(pc, s.ident)
         s.items = [pc]
         s.nbytes = 0  # pages are accounted (and capped) by the arena
         s.last_access = time.time()
@@ -447,6 +505,7 @@ class SetStore:
         s.items = items
         s.nbytes = sum(_item_nbytes(i) for i in items)
         s.last_access = time.time()
+        self._touch(s)
         self._maybe_evict(exclude=ident)
 
     def paged_matmul(self, ident: SetIdentifier, rhs) -> np.ndarray:
@@ -483,8 +542,49 @@ class SetStore:
         if pm is None:
             raise ValueError(f"set {ident} holds no paged matrix")
         s.last_access = time.time()
-        return PagedTensor(self.page_store(), f"{pm.ident}.mat",
-                           rw=pm.rw, placement=s.placement)
+        pt = PagedTensor(self.page_store(), f"{pm.ident}.mat",
+                         rw=pm.rw, placement=s.placement)
+        # version-scoped device-cache binding: the tensor stream's
+        # staged uploads install under (ident, version) and a warm
+        # consumer replays them without touching the arena; the
+        # version_fn lets the install re-check currentness (a racing
+        # write must not strand a dead entry on the budget)
+        pt.devcache = self.device_cache()
+        pt.cache_scope = (str(ident), s.version)
+        pt.cache_version_fn = functools.partial(self.version_of, ident)
+        return pt
+
+    def restore_paged_matrix(self, ident: SetIdentifier, blocks,
+                             row_block: int) -> None:
+        """Rebuild a paged TENSOR set from its arena pages — the
+        RESYNC_FOLLOWER replay path (the PR 2 leftover: a paged MATRIX
+        used to resync as an empty set). ``blocks`` are the leader's
+        row-blocks in order; each is written as its own arena page
+        (ragged blocks fine — readers derive per-page row counts from
+        actual page sizes), so the matrix NEVER materializes densely on
+        the follower."""
+        dead = []
+        with self._lock:
+            s = self._require(ident)
+            dead = list(s.items or [])
+            if not blocks:
+                s.items = []
+                s.nbytes = 0
+                self._touch(s)
+            else:
+                arena_name = f"{s.ident}#g{next(self._gen)}"
+                ps = self.page_store()
+                first = True
+                for b in blocks:
+                    ps.put(f"{arena_name}.mat", np.ascontiguousarray(b),
+                           row_block=max(int(row_block), 1),
+                           append=not first)
+                    first = False
+                s.items = [_PagedMatrix(arena_name)]
+                s.nbytes = 0
+                s.last_access = time.time()
+                self._touch(s)
+        self._drop_detached(dead)
 
     def append_table(self, ident: SetIdentifier, table) -> None:
         """Append a batch of rows to a table set (the reference's
@@ -532,6 +632,8 @@ class SetStore:
                     # pc.append fail loudly instead of resurrecting)
                     self._append_paged_existing(s, pc, table)
                     dead = []
+                with self._lock:
+                    self._touch(s)
             self._drop_detached(dead)
             return
         self._append_table_memory(ident, table)
@@ -558,6 +660,7 @@ class SetStore:
         s.items = [new]
         s.nbytes = _item_nbytes(new)
         s.last_access = time.time()
+        self._touch(s)
         self._maybe_evict(exclude=ident)
 
     def put_tensor(self, ident: SetIdentifier, tensor: BlockedTensor) -> None:
@@ -579,6 +682,7 @@ class SetStore:
                 s.nbytes = _item_nbytes(tensor)
                 s.last_access = time.time()
                 self._maybe_evict(exclude=ident)
+            self._touch(s)
         self._drop_detached(dead)  # replaced pages reclaim UNLOCKED
 
     def get_tensor(self, ident: SetIdentifier) -> BlockedTensor:
@@ -637,6 +741,7 @@ class SetStore:
         s.shared_mapping = mapping or {}
         s.items = []
         s.nbytes = 0
+        self._touch(s)
 
     @_locked
     def set_pooled(self, ident: SetIdentifier, pooled: Any) -> None:
@@ -646,6 +751,7 @@ class SetStore:
         s = self._require(ident)
         s.items = [pooled]
         s.nbytes = _item_nbytes(pooled)
+        self._touch(s)
         self._pooled.add(ident)  # pool-bytes accounting registry
 
     # --- persistence (ref: flush threads → PartitionedFile) -----------
@@ -778,6 +884,7 @@ class SetStore:
         if paged_objs:
             # object-set snapshot: records re-page into the arena
             self._drop_detached(self._ingest_paged(s, paged_objs[0]))
+            self._touch(s)
             self.stats.misses += 1
             self.stats.loads += 1
             return
@@ -790,6 +897,7 @@ class SetStore:
             # replaces live paged items, so the dead list is empty —
             # still reclaimed for belt-and-braces.)
             self._drop_detached(self._ingest_paged(s, paged_tables))
+            self._touch(s)
             self.stats.misses += 1
             self.stats.loads += 1
             return
@@ -815,6 +923,8 @@ class SetStore:
             items = [s.placement.apply(i) for i in items]
         s.items = items
         s.nbytes = sum(_item_nbytes(i) for i in items)
+        self._touch(s)  # fresh objects: cached runs of the old
+        # incarnation must never match (checkpoint-restore freshness)
         self.stats.misses += 1
         self.stats.loads += 1
 
@@ -947,4 +1057,5 @@ class SetStore:
             "alias_of": str(s.alias_of) if s.alias_of else None,
             "placement": s.placement.label() if s.placement is not None else None,
             "storage": s.storage,
+            "version": s.version,
         }

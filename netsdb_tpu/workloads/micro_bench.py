@@ -320,6 +320,65 @@ def bench_staging(rows: int = 65_536, cols: int = 1024,
     return out
 
 
+def bench_bucket_sweep(base: int = 45_000, spread: float = 0.6,
+                       samples: int = 48, seed: int = 0,
+                       densities: Tuple[int, ...] = (2, 4)
+                       ) -> Dict[str, object]:
+    """Pad-waste vs trace-count per shape-ladder density — the ROADMAP
+    bucket-ladder tuning item, runnable as ``micro-bench
+    --bucket-sweep``.
+
+    Draws ``samples`` serve-style ingest sizes log-uniformly across
+    ±``spread`` octaves around ``base`` (traffic varying around a
+    working size — the scenario the buckets exist for), then for each
+    ``bucket_density``:
+
+    * **pad_waste_pct** — padded rows beyond the valid rows, as a
+      fraction of total valid rows (what every fold step wastes on
+      masked lanes);
+    * **buckets** — distinct bucket shapes the sizes land in;
+    * **traces** — ACTUAL XLA traces of one shared jitted step fed
+      each bucketed shape (must equal ``buckets``: one compile per
+      bucket, the cost a denser ladder pays for its smaller pad).
+
+    Density 2 is the default ladder {2^k, 3·2^(k-1)}; density 4 adds
+    the 1.25×/1.75× rungs (<25% worst-case pad, ~2× the compiles)."""
+    import jax
+    import jax.numpy as jnp
+
+    from netsdb_tpu.plan.staging import bucket_rows
+
+    rng = np.random.default_rng(seed)
+    sizes = sorted(int(base * (2.0 ** e)) for e in
+                   rng.uniform(-spread, spread, samples))
+    out: Dict[str, object] = {"base": base, "samples": samples,
+                              "spread_octaves": spread,
+                              "size_min": sizes[0], "size_max": sizes[-1]}
+    for d in densities:
+        buckets = [bucket_rows(n, d) for n in sizes]
+        valid = sum(sizes)
+        padded = sum(buckets)
+        distinct = sorted(set(buckets))
+        traces = [0]
+
+        def step(x):
+            traces[0] += 1  # body runs only when XLA (re)traces
+            return jnp.sum(x)
+
+        jstep = jax.jit(step)
+        for b in buckets:
+            # tiny 1-D probes with the REAL bucketed lengths: the trace
+            # count is shape-driven, not data-size-driven
+            float(jstep(jnp.zeros((b,), jnp.float32)))
+        out[f"density{d}"] = {
+            "buckets": len(distinct),
+            "traces": traces[0],
+            "pad_waste_pct": round(100.0 * (padded - valid) / valid, 2),
+            "bucket_shapes": distinct,
+        }
+    return out
+
+
 BENCHMARKS: Dict[str, Callable[[], Result]] = {
     "arena_alloc": bench_arena_alloc,
     "int_groupby": bench_int_groupby,

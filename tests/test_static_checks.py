@@ -32,6 +32,19 @@ Regressions the serve layer must never quietly reacquire:
    reintroduces the per-chunk upload stall the staging rework removed.
    ``plan/staging.py`` itself owns the upload calls and is exempt.
 
+5. **Cache-bypassing uploads.** The ``device_put`` IDIOM for
+   store-owned set blocks belongs to ``storage/devcache.to_device``
+   (called from ``stage_stream`` place functions): a direct
+   ``device_put`` in ``netsdb_tpu/storage/``, ``netsdb_tpu/plan/`` or
+   the out-of-core engine bypasses the cross-query device cache — the
+   blocks re-upload every query while the hit/miss counters lie.
+   ``devcache.py`` and ``staging.py`` own the sanctioned calls and are
+   exempt. Scope note: this is a guardrail on the explicit-upload
+   idiom, not a proof — ``jnp.asarray``/``jnp.concatenate`` also
+   commit arrays to the device and cannot be banned wholesale (they
+   pervade legitimate compute); those call sites are kept inside
+   ``place`` functions by review + the loop check above.
+
 Run standalone: ``python tests/test_static_checks.py`` (exit 1 on
 violations) — the CI-script form the pytest wrapper shares.
 """
@@ -43,10 +56,15 @@ import sys
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 SERVE_DIR = os.path.join(REPO, "netsdb_tpu", "serve")
 PLAN_DIR = os.path.join(REPO, "netsdb_tpu", "plan")
+STORAGE_DIR = os.path.join(REPO, "netsdb_tpu", "storage")
 OOC_FILE = os.path.join(REPO, "netsdb_tpu", "relational", "outofcore.py")
 
 #: the staging module owns the (background-thread) device_put calls
 _STAGING_EXEMPT = {"staging.py"}
+
+#: the two modules allowed to name device_put at all on the storage/
+#: plan paths — every other call site goes through devcache.to_device
+_UPLOAD_EXEMPT = {"staging.py", "devcache.py"}
 
 #: the metadata codec — the only functions in protocol.py allowed to
 #: name pickle/cloudpickle
@@ -182,6 +200,51 @@ def check_staging_discipline() -> list:
     return violations
 
 
+def _check_direct_device_put(path: str) -> list:
+    """Ban EVERY ``device_put`` mention — attribute call, bare name,
+    or import — so the explicit-upload idiom for store-owned set
+    blocks stays inside ``devcache.to_device``/``stage_stream`` (a
+    bypassing upload re-transfers what the cache holds and corrupts
+    the hit/miss accounting). Guardrail, not a proof: ``jnp.*``
+    constructors also commit to the device and are reviewed, not
+    banned (see module docstring, rule 5)."""
+    with open(path) as f:
+        tree = ast.parse(f.read(), filename=path)
+    rel = os.path.relpath(path, REPO)
+    out = []
+    for node in ast.walk(tree):
+        hit = None
+        if isinstance(node, ast.Call):
+            f_ = node.func
+            if isinstance(f_, ast.Attribute) and f_.attr == "device_put":
+                hit = "call"
+            elif isinstance(f_, ast.Name) and f_.id == "device_put":
+                hit = "call"
+        elif isinstance(node, ast.ImportFrom):
+            if any(a.name == "device_put" for a in node.names):
+                hit = "import"
+        if hit:
+            out.append(
+                f"{rel}:{node.lineno}: direct device_put ({hit}) on a "
+                f"store/plan path — upload set blocks via "
+                f"storage/devcache.to_device (inside a stage_stream "
+                f"place function) so the device cache cannot be "
+                f"silently bypassed")
+    return out
+
+
+def check_device_upload_discipline() -> list:
+    files = []
+    for d in (STORAGE_DIR, PLAN_DIR):
+        files.extend(os.path.join(d, n) for n in sorted(os.listdir(d))
+                     if n.endswith(".py") and n not in _UPLOAD_EXEMPT)
+    files.append(OOC_FILE)
+    violations = []
+    for path in files:
+        violations.extend(_check_direct_device_put(path))
+    return violations
+
+
 def test_serve_layer_clock_and_exception_discipline():
     violations = check_serve_layer()
     assert not violations, "\n" + "\n".join(violations)
@@ -192,8 +255,14 @@ def test_no_sync_device_put_in_stream_loops():
     assert not violations, "\n" + "\n".join(violations)
 
 
+def test_no_cache_bypassing_device_put():
+    violations = check_device_upload_discipline()
+    assert not violations, "\n" + "\n".join(violations)
+
+
 def main() -> int:
-    violations = check_serve_layer() + check_staging_discipline()
+    violations = (check_serve_layer() + check_staging_discipline()
+                  + check_device_upload_discipline())
     for v in violations:
         print(v, file=sys.stderr)
     print(f"serve-layer + staging static check: "
