@@ -612,6 +612,11 @@ def _cmd_micro_bench(args) -> int:
 
         print(json.dumps(micro_bench.bench_bucket_sweep(), indent=2))
         return 0
+    if getattr(args, "obs_overhead", False):
+        import json
+
+        print(json.dumps(micro_bench.bench_obs_overhead(), indent=2))
+        return 0
     names = None
     if args.only is not None:
         names = [n.strip() for n in args.only.split(",") if n.strip()]
@@ -642,6 +647,73 @@ def _cmd_serve(args) -> int:
     return run_daemon(config, host=args.host, port=args.port,
                       token=args.token, max_jobs=args.max_jobs,
                       followers=followers)
+
+
+def _print_obs(stats, traces) -> None:
+    """Human-readable observability readout (the --json flag skips
+    this and dumps the raw payloads)."""
+    m = stats.get("metrics") or {}
+    print("== metrics ==")
+    for k, v in sorted((m.get("counters") or {}).items()):
+        print(f"  {k:<44} {v}")
+    for k, v in sorted((m.get("gauges") or {}).items()):
+        print(f"  {k:<44} {v}")
+    for k, h in sorted((m.get("histograms") or {}).items()):
+        if not h.get("count"):
+            continue
+        print(f"  {k:<44} n={h['count']} mean={h['mean']:.4g} "
+              f"p50={h['p50']:.4g} p95={h['p95']:.4g} "
+              f"p99={h['p99']:.4g} max={h['max']:.4g}")
+    for section in ("compile", "staging", "stages"):
+        if m.get(section):
+            print(f"  -- {section}: {json.dumps(m[section])}")
+    if stats.get("device_cache"):
+        print(f"  -- device_cache: {json.dumps(stats['device_cache'])}")
+    for addr, f in sorted((stats.get("followers") or {}).items()):
+        dc = f.get("device_cache") if isinstance(f, dict) else None
+        print(f"  -- follower {addr}: "
+              f"{json.dumps(dc if dc is not None else f)}")
+
+    profiles = traces.get("profiles") or []
+    print(f"== traces ({len(profiles)} profile(s), newest last) ==")
+
+    def show(prof, indent=""):
+        total = prof.get("total_s") or 0.0
+        print(f"{indent}{prof.get('qid')} [{prof.get('origin')}] "
+              f"total={total * 1e3:.2f}ms "
+              f"counters={prof.get('counters') or {}}")
+        for sp in prof.get("spans") or ():
+            pad = indent + "  " * (sp.get("depth", 0) + 1)
+            extra = f"  {sp['counters']}" if sp.get("counters") else ""
+            print(f"{pad}{sp['name']} +{sp['start_s'] * 1e3:.2f}ms "
+                  f"{sp['duration_s'] * 1e3:.3f}ms{extra}")
+        for addr, fprofs in sorted((prof.get("followers") or {}).items()):
+            print(f"{indent}  follower {addr}:")
+            for fp in fprofs:
+                show(fp, indent + "    ")
+
+    for prof in profiles:
+        show(prof)
+
+
+def _cmd_obs(args) -> int:
+    """Pretty-print a running daemon's observability surface: the
+    COLLECT_STATS "metrics" section (central registry) and the last N
+    completed query profiles (GET_TRACE)."""
+    from netsdb_tpu.serve.client import RemoteClient
+
+    c = RemoteClient(args.addr, token=args.token)
+    try:
+        stats = c.collect_stats()
+        traces = c.get_trace(last=args.traces, qid=args.qid)
+    finally:
+        c.close()
+    if args.json:
+        print(json.dumps({"stats": stats, "traces": traces}, indent=2,
+                         default=str))
+        return 0
+    _print_obs(stats, traces)
+    return 0
 
 
 def _cmd_serve_bench(args) -> int:
@@ -729,6 +801,10 @@ def main(argv=None) -> int:
                    help="pad-waste vs trace-count per shape-ladder "
                         "density (the bucket_density knob: 2 vs 4 "
                         "buckets per octave)")
+    p.add_argument("--obs-overhead", action="store_true",
+                   help="cost of always-on query tracing on the staged "
+                        "fold stream (traced vs untraced; < 3%% is the "
+                        "budget)")
 
     sub.add_parser("selftest",
                    help="scripted integration sequence (integratedTests.py)")
@@ -785,6 +861,20 @@ def main(argv=None) -> int:
                         "device-cache-resident paged set instead "
                         "(hit/miss counters included)")
 
+    p = sub.add_parser("obs",
+                       help="observability readout of a running daemon: "
+                            "central metrics (COLLECT_STATS) + the last "
+                            "query trace profiles (GET_TRACE)")
+    p.add_argument("--addr", default="127.0.0.1:8108",
+                   help="daemon address host:port")
+    p.add_argument("--token", default=None, help="shared auth token")
+    p.add_argument("--traces", type=int, default=5,
+                   help="how many completed query profiles to show")
+    p.add_argument("--qid", default=None,
+                   help="show only the profile(s) of one query id")
+    p.add_argument("--json", action="store_true",
+                   help="raw JSON instead of the pretty readout")
+
     p = sub.add_parser("autotune",
                        help="measure physical-strategy crossovers "
                        "(dense-vs-scatter segments, LUT-vs-sort joins) on "
@@ -837,6 +927,7 @@ def main(argv=None) -> int:
             "lsh-bench": _cmd_lsh_bench,
             "ab-bench": _cmd_ab_bench,
             "serve": _cmd_serve, "serve-bench": _cmd_serve_bench,
+            "obs": _cmd_obs,
             "demo-ff": _cmd_demo_ff, "tpch": _cmd_tpch,
             "micro-bench": _cmd_micro_bench, "tpch-bench": _cmd_tpch_bench,
             "model-bench": _cmd_model_bench,

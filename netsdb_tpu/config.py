@@ -83,6 +83,19 @@ class Configuration:
     # per-block state updates reuse the same HBM buffer. None = auto:
     # on for backends that implement donation (TPU/GPU), off for CPU.
     donate_fold_buffers: Optional[bool] = None
+    # --- observability (netsdb_tpu/obs/) ---
+    # master switch for query-scoped tracing: on, every serve request
+    # carrying a query id records a span profile (the -DPROFILING spans,
+    # structured); off, span calls take the one-check fast path and
+    # GET_TRACE returns empty. Metrics counters stay live either way
+    # (they are integers, not allocations).
+    obs_enabled: bool = True
+    # completed query profiles retained for GET_TRACE (a bounded ring —
+    # a year-long daemon holds exactly this many profiles)
+    obs_trace_ring: int = 64
+    # per-histogram retained samples in the metrics registry (exact
+    # count/total/max are kept forever; quantiles come from the last N)
+    obs_hist_samples: int = 512
     # --- execution ---
     num_threads: int = 4  # host-side IO/pipeline threads (not device parallelism)
     enable_compression: bool = True  # host spill compression (ref -DENABLE_COMPRESSION)

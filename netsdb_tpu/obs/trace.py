@@ -1,0 +1,261 @@
+"""Query-scoped tracing — the ``-DPROFILING`` spans, structured.
+
+The reference answers "where did this query spend its time" with
+wall-clock spans around planning and every pipeline phase
+(``QuerySchedulerServer.cc:1336-1341``, ``PipelineStage.cc:1084-1101``)
+printed per stage. Here the same spans are STRUCTURED and query-scoped:
+a :class:`QueryTrace` — keyed by a query id minted client-side and
+carried in frame metadata (``serve/protocol.QUERY_ID_KEY``) — collects
+nested spans across client send → daemon dispatch → planner → executor
+chunk loops → staging upload waits → device-cache hits, each with a
+monotonic start offset, duration, category and counters (bytes staged,
+chunks, traces triggered, cache hits).
+
+Propagation is a ``contextvars.ContextVar``: the serve handler (or the
+client's request path) installs the trace, and every instrumented layer
+below reads it back with :func:`current_trace` — zero plumbing through
+call signatures. Worker threads (staging) don't inherit the context;
+they capture the trace at stream construction on the consumer's thread
+and add COUNTERS only (cross-thread span nesting would lie about
+concurrency).
+
+Cost discipline: tracing is ALWAYS ON (``config.obs_enabled`` is the
+kill switch). The no-trace fast path of :func:`span` is one context-var
+read and one ``is None`` check; with a trace active, a span is two
+``perf_counter`` reads and one list append under a lock.
+``micro_bench --obs-overhead`` pins the end-to-end cost on the staged
+fold stream (< 3% is the budget).
+
+Completed traces land in a bounded :class:`TraceRing` — the daemon
+keeps the last N query profiles for the ``GET_TRACE`` frame; client
+processes keep their own ring (:data:`DEFAULT_RING`) for local
+introspection. All clocks are ``time.perf_counter`` — monotonic, never
+wall (the serve clock discipline, enforced by the static checks).
+"""
+
+from __future__ import annotations
+
+import contextlib
+import contextvars
+import threading
+import time
+import uuid
+from typing import Any, Dict, Iterator, List, Optional
+
+from netsdb_tpu.obs import metrics as _metrics
+
+#: process-wide kill switch (config.obs_enabled mirrors into this via
+#: set_enabled at daemon/CLI startup); when off, no trace is ever
+#: installed so every span call takes the one-check fast path
+_enabled = True
+
+
+def set_enabled(on: bool) -> None:
+    global _enabled
+    _enabled = bool(on)
+
+
+def enabled() -> bool:
+    return _enabled
+
+
+def new_query_id() -> str:
+    """Client-side query-id mint — one per logical query, carried in
+    frame metadata so the daemon's spans join the client's."""
+    return uuid.uuid4().hex[:16]
+
+
+class Span:
+    """One timed region inside a trace. ``start_s`` is the offset from
+    the trace's own start (monotonic deltas — profile timelines line up
+    without any cross-process clock agreement)."""
+
+    __slots__ = ("name", "category", "start_s", "duration_s", "depth",
+                 "counters")
+
+    def __init__(self, name: str, category: str, start_s: float,
+                 depth: int):
+        self.name = name
+        self.category = category
+        self.start_s = start_s
+        self.duration_s = 0.0
+        self.depth = depth
+        self.counters: Dict[str, float] = {}
+
+    def as_dict(self) -> Dict[str, Any]:
+        d: Dict[str, Any] = {"name": self.name, "category": self.category,
+                             "start_s": self.start_s,
+                             "duration_s": self.duration_s,
+                             "depth": self.depth}
+        if self.counters:
+            d["counters"] = dict(self.counters)
+        return d
+
+
+class QueryTrace:
+    """All spans + counters of one logical query on one side of the
+    wire. ``origin`` says which side ("client"/"server"/"local").
+    Thread-safe for counter adds and span records (staging threads
+    report into the consumer's trace); span DEPTH tracks per-thread
+    nesting so concurrent reporters can't corrupt each other's
+    stacks."""
+
+    def __init__(self, qid: str, origin: str = "local",
+                 ring: Optional["TraceRing"] = None):
+        self.qid = qid
+        self.origin = origin
+        self._ring = ring
+        self._t0 = time.perf_counter()
+        self._mu = threading.Lock()
+        self._spans: List[Span] = []
+        self._counters: Dict[str, float] = {}
+        self._depth = threading.local()
+        self.total_s: Optional[float] = None  # set by finish()
+
+    # --- spans --------------------------------------------------------
+    @contextlib.contextmanager
+    def span(self, name: str, category: str = "") -> Iterator[Span]:
+        depth = getattr(self._depth, "v", 0)
+        self._depth.v = depth + 1
+        sp = Span(name, category, time.perf_counter() - self._t0, depth)
+        try:
+            yield sp
+        finally:
+            sp.duration_s = (time.perf_counter() - self._t0) - sp.start_s
+            self._depth.v = depth
+            with self._mu:
+                self._spans.append(sp)
+
+    def record(self, name: str, duration_s: float, category: str = "",
+               start_s: Optional[float] = None, **counters) -> None:
+        """Record an already-measured region (e.g. the frame decode
+        that finished before the trace could open)."""
+        if start_s is None:
+            start_s = (time.perf_counter() - self._t0) - duration_s
+        sp = Span(name, category, start_s, getattr(self._depth, "v", 0))
+        sp.duration_s = duration_s
+        if counters:
+            sp.counters.update(counters)
+        with self._mu:
+            self._spans.append(sp)
+
+    def backdate(self, seconds: float) -> None:
+        """Shift the trace start ``seconds`` earlier — for work that
+        finished before the trace could open (the serve frame decode):
+        a span then :meth:`record`-ed at offset 0 occupies real
+        timeline ahead of the first live span instead of overlapping
+        it, and ``total_s`` covers it."""
+        self._t0 -= float(seconds)
+
+    # --- counters -----------------------------------------------------
+    def add(self, counter: str, n: float = 1) -> None:
+        with self._mu:
+            self._counters[counter] = self._counters.get(counter, 0) + n
+
+    # --- lifecycle ----------------------------------------------------
+    def finish(self) -> Dict[str, Any]:
+        """Close the trace (idempotent on total_s) and push its profile
+        to the ring. Returns the profile."""
+        if self.total_s is None:
+            self.total_s = time.perf_counter() - self._t0
+        prof = self.profile()
+        if self._ring is not None:
+            self._ring.push(prof)
+        return prof
+
+    def profile(self) -> Dict[str, Any]:
+        """Msgpack-safe profile dict — what GET_TRACE ships."""
+        with self._mu:
+            spans = [s.as_dict() for s in
+                     sorted(self._spans, key=lambda s: s.start_s)]
+            counters = dict(self._counters)
+        return {"qid": self.qid, "origin": self.origin,
+                "total_s": self.total_s, "spans": spans,
+                "counters": counters}
+
+
+class TraceRing:
+    """Bounded ring of completed query profiles — the GET_TRACE
+    source. Push-side cheap; ``last(n)`` returns newest-last."""
+
+    def __init__(self, capacity: int = 64):
+        self._mu = threading.Lock()
+        self._cap = max(int(capacity), 1)
+        self._items: List[Dict[str, Any]] = []
+
+    def push(self, profile: Dict[str, Any]) -> None:
+        with self._mu:
+            self._items.append(profile)
+            if len(self._items) > self._cap:
+                del self._items[:len(self._items) - self._cap]
+
+    def last(self, n: Optional[int] = None) -> List[Dict[str, Any]]:
+        with self._mu:
+            items = list(self._items)
+        return items if n is None else items[-int(n):]
+
+    def find(self, qid: str) -> List[Dict[str, Any]]:
+        with self._mu:
+            return [p for p in self._items if p.get("qid") == qid]
+
+    def clear(self) -> None:
+        with self._mu:
+            self._items.clear()
+
+    def __len__(self) -> int:
+        with self._mu:
+            return len(self._items)
+
+
+#: ring for traces opened without an explicit ring (client-side
+#: requests, in-process queries) — daemons own a per-controller ring
+DEFAULT_RING = TraceRing()
+
+_current: "contextvars.ContextVar[Optional[QueryTrace]]" = \
+    contextvars.ContextVar("netsdb_obs_trace", default=None)
+
+
+def current_trace() -> Optional[QueryTrace]:
+    return _current.get()
+
+
+@contextlib.contextmanager
+def trace(qid: Optional[str] = None, origin: str = "local",
+          ring: Optional[TraceRing] = None) -> Iterator[Optional[QueryTrace]]:
+    """Install a :class:`QueryTrace` as the current context's trace for
+    the duration; finish (and ring-push) it on exit. Yields None — and
+    installs nothing — when tracing is disabled or a trace is already
+    active (a nested logical query joins the outer trace's spans
+    instead of shadowing it)."""
+    if not _enabled or _current.get() is not None:
+        yield None
+        return
+    tr = QueryTrace(qid or new_query_id(), origin,
+                    ring if ring is not None else DEFAULT_RING)
+    token = _current.set(tr)
+    try:
+        yield tr
+    finally:
+        _current.reset(token)
+        tr.finish()
+        _metrics.REGISTRY.counter(f"obs.traces.{origin}").inc()
+
+
+@contextlib.contextmanager
+def span(name: str, category: str = "") -> Iterator[Optional[Span]]:
+    """Span on the current trace, or a no-op when none is active — the
+    form every instrumented layer uses (executor loops, staging waits,
+    serve dispatch). The inactive path is one context-var read."""
+    tr = _current.get()
+    if tr is None:
+        yield None
+        return
+    with tr.span(name, category) as sp:
+        yield sp
+
+
+def add(counter: str, n: float = 1) -> None:
+    """Bump a counter on the current trace (no-op without one)."""
+    tr = _current.get()
+    if tr is not None:
+        tr.add(counter, n)

@@ -24,6 +24,7 @@ from typing import Any, Dict, List, Optional
 
 import jax
 
+from netsdb_tpu import obs
 from netsdb_tpu.core.blocked import BlockedTensor
 from netsdb_tpu.plan.computations import (
     Aggregate,
@@ -65,6 +66,12 @@ def compile_stats() -> Dict[str, int]:
         return dict(_compile_stats)
 
 
+# the central registry reports these SAME counters under "compile"
+# (obs/metrics.py absorption hook) — the accessor above keeps its
+# shape and callers; the registry snapshot never double-books
+obs.REGISTRY.register_collector("compile", compile_stats)
+
+
 def _cached_jit(key: str, fn, donate_argnums: tuple = ()) -> Any:
     """compiled-cache get-or-insert with the ONE LRU discipline (all
     three call sites: fold steps, eager traceable nodes, whole-plan
@@ -85,9 +92,12 @@ def _cached_jit(key: str, fn, donate_argnums: tuple = ()) -> Any:
             return cached
 
     def counted(*args, **kwargs):
-        # body runs only when jax (re)traces — the recompile counter
+        # body runs only when jax (re)traces — the recompile counter;
+        # the active query trace (if any) gets the same tick so a
+        # profile shows WHICH query paid a compile
         with _cache_lock:
             _compile_stats["traces"] += 1
+        obs.add("executor.traces")
         return fn(*args, **kwargs)
 
     jfn = jax.jit(counted, donate_argnums=tuple(donate_argnums))
@@ -139,10 +149,15 @@ def _run_fold_once(fold, pc, resident, placement, step_jit):
         # closing(): a step raising mid-stream must release the page
         # stream's read lock NOW, not at GC (a retained traceback would
         # otherwise hold the lock and block appends/drops indefinitely)
-        with contextlib.closing(
-                pc.stream_tables(placement=placement)) as chunks:
+        with obs.span("executor.fold_stream", "executor") as sp, \
+                contextlib.closing(
+                    pc.stream_tables(placement=placement)) as chunks:
+            n = 0
             for chunk in chunks:
                 state = jstep(state, chunk, *resident)
+                n += 1
+            if sp is not None:
+                sp.counters["chunks"] = n
     return fold.finalize(state, pc, *resident)
 
 
@@ -233,9 +248,11 @@ def _run_fold_grace(fold, pc, rest, bi, build_pc, placement, step_jit):
             return p, _pad_table_rows(build_parts[p].to_table(), maxr)
 
         depth = getattr(build_pc.store.config, "stage_depth", 2)
-        with contextlib.closing(staging.stage_stream(
-                pairs(), stage_build, depth=depth,
-                name=f"grace-build:{build_pc.name}")) as staged_builds:
+        with obs.span("executor.grace_pairs", "executor") as gsp, \
+                contextlib.closing(staging.stage_stream(
+                    pairs(), stage_build, depth=depth,
+                    name=f"grace-build:{build_pc.name}")) as staged_builds:
+            npairs = 0
             for p, btab in staged_builds:
                 part_res = list(rest)
                 part_res[bi] = btab
@@ -247,6 +264,9 @@ def _run_fold_grace(fold, pc, rest, bi, build_pc, placement, step_jit):
                         state = jstep(state, chunk, *part_res)
                 part = fold.finalize(state, pc, *part_res)
                 out = part if out is None else fold.merge(out, part)
+                npairs += 1
+            if gsp is not None:
+                gsp.counters["pairs"] = npairs
     finally:
         # after the closing() above joined the build stager — spill
         # partitions must not be reclaimed under a live upload
@@ -401,11 +421,12 @@ def _run_tensor_stream(node, tfold, in_vals, src, step_jit):
         jstep = step_jit(0, step, donate=())
         outs = []
         was_blocked = False
-        with contextlib.closing(staging.stage_stream(
-                pt.stream_blocks(), place, depth,
-                name=f"trows:{pt.name}",
-                cache=cache, cache_key=cache_key("trows"),
-                cache_validator=still_current)) as blocks:
+        with obs.span("executor.tensor_rows", "executor") as sp, \
+                contextlib.closing(staging.stage_stream(
+                    pt.stream_blocks(), place, depth,
+                    name=f"trows:{pt.name}",
+                    cache=cache, cache_key=cache_key("trows"),
+                    cache_validator=still_current)) as blocks:
             for n, block in blocks:
                 out = jstep(block, *others)
                 if isinstance(out, BlockedTensor):
@@ -414,6 +435,8 @@ def _run_tensor_stream(node, tfold, in_vals, src, step_jit):
                 if out.shape[0] != n:  # drop the bucket's padded rows
                     out = out[:n]
                 outs.append(out)
+            if sp is not None:
+                sp.counters["blocks"] = len(outs)
         dense = jnp.concatenate(outs, axis=0)
         if tfold.out_block is not None:
             return BlockedTensor.from_dense(dense, tfold.out_block)
@@ -431,13 +454,18 @@ def _run_tensor_stream(node, tfold, in_vals, src, step_jit):
 
     jstep = step_jit(1, step)
     carry = None
-    with contextlib.closing(staging.stage_stream(
-            pt.stream_blocks(), place, depth,
-            name=f"treduce:{pt.name}",
-            cache=cache, cache_key=cache_key("treduce"),
-            cache_validator=still_current)) as blocks:
+    with obs.span("executor.tensor_reduce", "executor") as sp, \
+            contextlib.closing(staging.stage_stream(
+                pt.stream_blocks(), place, depth,
+                name=f"treduce:{pt.name}",
+                cache=cache, cache_key=cache_key("treduce"),
+                cache_validator=still_current)) as blocks:
+        nblk = 0
         for start, block in blocks:
             carry = jstep(carry, start, block, *others)
+            nblk += 1
+        if sp is not None:
+            sp.counters["blocks"] = nblk
     if tfold.finalize is not None:
         return tfold.finalize(carry, *others)
     return carry
@@ -630,7 +658,8 @@ def execute_computations(
 ) -> Dict[SetIdentifier, Any]:
     """Plan and run; returns {output set ident: value} and (by default)
     materializes results into the store — the reference's OUTPUT sets."""
-    plan = plan_from_sinks(sinks)
+    with obs.span("planner.plan", "planner"):
+        plan = plan_from_sinks(sinks)
     t0 = time.perf_counter()
 
     from netsdb_tpu.relational.outofcore import PagedColumns
@@ -719,7 +748,8 @@ def execute_computations(
     num_scans = sum(isinstance(n, ScanSet) for n in plan.topo)
 
     if any_paged:
-        values = _execute_streamed(client, plan, scan_values, job_name)
+        with obs.span("executor.streamed", "executor"):
+            values = _execute_streamed(client, plan, scan_values, job_name)
         sink_vals = {s.node_id: values[s.inputs[0].node_id]
                      for s in plan.sinks}
     elif all_traceable and tensor_scans:
@@ -752,32 +782,35 @@ def execute_computations(
         topo_pos = {n.node_id: i for i, n in enumerate(plan.topo)}
         canon_args = {topo_pos[n.node_id]: scan_values[n.node_id]
                       for n in tensor_scans}
-        out_list = fn(canon_args)
+        with obs.span("executor.whole_plan_jit", "executor"):
+            out_list = fn(canon_args)
         sink_vals = {s.node_id: out_list[i] for i, s in enumerate(plan.sinks)}
     else:
-        values = _evaluate(plan, scan_values)
+        with obs.span("executor.eager", "executor"):
+            values = _evaluate(plan, scan_values)
         sink_vals = {s.node_id: values[s.inputs[0].node_id] for s in plan.sinks}
 
     results: Dict[SetIdentifier, Any] = {}
-    for sink in plan.sinks:
-        out = sink_vals[sink.node_id]
-        ident = SetIdentifier(sink.db, sink.set_name)
-        results[ident] = out
-        if materialize:
-            client.store.create_set(ident)
-            if isinstance(out, BlockedTensor):
-                client.store.put_tensor(ident, out)
-            elif isinstance(out, (ColumnTable, jax.Array)):
-                # one relation / one raw array IS the set's content
-                # (iterating a jax.Array into rows would be wrong)
-                client.store.clear_set(ident)
-                client.store.add_data(ident, [out])
-            elif isinstance(out, dict):
-                client.store.clear_set(ident)
-                client.store.add_data(ident, list(out.items()))
-            else:
-                client.store.clear_set(ident)
-                client.store.add_data(ident, list(out))
+    with obs.span("executor.materialize", "executor"):
+        for sink in plan.sinks:
+            out = sink_vals[sink.node_id]
+            ident = SetIdentifier(sink.db, sink.set_name)
+            results[ident] = out
+            if materialize:
+                client.store.create_set(ident)
+                if isinstance(out, BlockedTensor):
+                    client.store.put_tensor(ident, out)
+                elif isinstance(out, (ColumnTable, jax.Array)):
+                    # one relation / one raw array IS the set's content
+                    # (iterating a jax.Array into rows would be wrong)
+                    client.store.clear_set(ident)
+                    client.store.add_data(ident, [out])
+                elif isinstance(out, dict):
+                    client.store.clear_set(ident)
+                    client.store.add_data(ident, list(out.items()))
+                else:
+                    client.store.clear_set(ident)
+                    client.store.add_data(ident, list(out))
 
     elapsed = time.perf_counter() - t0
     # stage timing record — feeds the Lachesis-lite advisor (§2.4)

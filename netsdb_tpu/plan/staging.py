@@ -50,7 +50,10 @@ from __future__ import annotations
 import contextlib
 import queue
 import threading
+import time
 from typing import Any, Callable, Iterable, Iterator, Optional
+
+from netsdb_tpu import obs
 
 # ---------------------------------------------------------------------
 # shape buckets
@@ -151,6 +154,13 @@ def active_count() -> int:
     with _stagers_lock:
         _stagers[:] = [t for t in _stagers if t.is_alive()]
         return len(_stagers)
+
+
+# the leak registry, absorbed into the central metrics snapshot (the
+# accessor above keeps its callers; COLLECT_STATS "metrics" reports
+# the same number under "staging")
+obs.REGISTRY.register_collector(
+    "staging", lambda: {"active_stagers": active_count()})
 
 
 # --- event trace (tests only; production pays one bool check) ---------
@@ -259,6 +269,11 @@ class StagedStream:
         self._closed = False
         self._on_complete = on_complete
         self._sync_seq = 0
+        # query-scoped accounting: the trace is captured HERE, on the
+        # consumer's thread (context vars don't cross into the staging
+        # worker); the stream reports COUNTERS only — cross-thread
+        # spans would misrepresent the overlap this class exists for
+        self._trace = obs.current_trace()
         self._thread: Optional[threading.Thread] = None
         if self._depth > 0:
             self._q: "queue.Queue" = queue.Queue(maxsize=self._depth)
@@ -276,6 +291,21 @@ class StagedStream:
     # --- consumer side ------------------------------------------------
     def __iter__(self) -> Iterator[Any]:
         return self
+
+    def _account(self, placed, wait_s: float) -> None:
+        """Per-chunk bookkeeping: one registry tick always; bytes/wait
+        only onto an active query trace (the profile's "bytes staged"
+        and upload-wait counters)."""
+        obs.REGISTRY.counter("staging.chunks").inc()
+        tr = self._trace
+        if tr is None:
+            return
+        from netsdb_tpu.storage.devcache import _value_nbytes
+
+        tr.add("stage.chunks")
+        tr.add("stage.bytes", _value_nbytes(placed))
+        if wait_s > 0:
+            tr.add("stage.wait_s", wait_s)
 
     def __next__(self):
         if self._thread is None:  # synchronous inline mode
@@ -295,9 +325,11 @@ class StagedStream:
             placed = self._place(item)
             _emit("place", self._name, self._sync_seq)
             self._sync_seq += 1
+            self._account(placed, 0.0)
             return placed
         if self._closed:
             raise StopIteration
+        t_wait = time.perf_counter()
         while True:
             try:
                 kind, val = self._q.get(timeout=0.5)
@@ -316,6 +348,7 @@ class StagedStream:
                 # finished" moment the overlap tests anchor on
                 _emit("close", self._name)
                 raise StopIteration
+            self._account(val, time.perf_counter() - t_wait)
             return val
 
     def close(self) -> None:
@@ -463,6 +496,10 @@ def stage_stream(source: Iterable, place: Callable[[Any], Any],
         hit = cache.get(cache_key)
         if hit is not None:
             _emit("cache_hit", name)
+            # a whole run served device-resident: the query profile's
+            # zero-transfer marker (per-block hit ticks come from the
+            # cache itself)
+            obs.add("stage.cached_runs")
             return _CachedRun(hit, name)
         rec = _CacheRecorder(cache, cache_key, place, cache_validator)
         return StagedStream(source, rec, depth=depth, name=name,

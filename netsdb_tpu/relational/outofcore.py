@@ -30,6 +30,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from netsdb_tpu import obs
 from netsdb_tpu.relational.table import ColumnTable, date_to_int, int_to_date
 from netsdb_tpu.storage.paged import PagedTensorStore
 from netsdb_tpu.utils.locks import RWLock
@@ -429,6 +430,10 @@ class PagedColumns:
         (numpy columns, nothing touches the device) — the snapshot path
         (``SetStore.flush``): device memory stays bounded no matter how
         large the paged relation is."""
+        with obs.span(f"ooc.host_assemble:{self.name}", "storage"):
+            return self._to_host_table()
+
+    def _to_host_table(self) -> ColumnTable:
         parts: Dict[str, List[np.ndarray]] = {}
         n_done = 0
         # the consistency check compares against num_rows AS OF the
@@ -507,8 +512,9 @@ def partition_by_key(pc: PagedColumns, key: str, nparts: int,
 
     # pure HOST pass: hashing/routing never touches the device (the
     # chunks would only round-trip H2D→D2H for numpy bucketing)
-    with contextlib.closing(pc.stream(prefetch=2,
-                                      device=False)) as chunks:
+    with obs.span(f"ooc.partition:{pc.name}", "storage"), \
+            contextlib.closing(pc.stream(prefetch=2,
+                                         device=False)) as chunks:
         for ccols, valid, start in chunks:
             n = int(np.asarray(valid).sum())
             cols = {k: v[:n] for k, v in ccols.items()

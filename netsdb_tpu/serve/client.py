@@ -22,11 +22,11 @@ import socket
 import threading
 import time
 import uuid
-from collections import deque as _deque
 from typing import Any, Dict, Iterator, List, Optional, Sequence, Tuple
 
 import numpy as np
 
+from netsdb_tpu import obs
 from netsdb_tpu.serve.errors import (  # noqa: F401 — re-exported API
     AdmissionFullError,
     AuthError,
@@ -46,6 +46,7 @@ from netsdb_tpu.serve.protocol import (
     IDEMPOTENCY_KEY,
     MUTATING_TYPES,
     PROTO_VERSION,
+    QUERY_ID_KEY,
     MsgType,
     ProtocolError,
     recv_frame,
@@ -53,6 +54,12 @@ from netsdb_tpu.serve.protocol import (
     tensor_to_wire,
 )
 from netsdb_tpu.utils.timing import deadline_after, seconds_left
+
+#: frame types that open a client-side query trace (and mint the query
+#: id the daemon's trace joins on) — the query-shaped requests whose
+#: time decomposition GET_TRACE answers
+TRACED_TYPES = frozenset({MsgType.EXECUTE_COMPUTATIONS,
+                          MsgType.EXECUTE_PLAN})
 
 
 @dataclasses.dataclass
@@ -187,11 +194,16 @@ class RemoteClient:
         #: observability for tests and callers tuning policies
         self.last_attempts = 0
         self.total_retries = 0
-        # hedged-read state: replica ring + observed read latencies
-        # (the adaptive p99 hedge trigger) + counters for tests/tuning
+        # hedged-read state: replica ring + observed read latencies.
+        # The adaptive p99 hedge trigger and the metrics registry read
+        # the SAME numbers: latencies land in this client's bounded
+        # histogram (obs.Histogram — what hedge_delay_s quantiles over)
+        # and every observation is mirrored into the shared registry
+        # histogram "serve.client.read_latency_s" that COLLECT_STATS
+        # ships, so introspection and stats can never disagree.
         self._replicas = list(replicas or [])
         self._hedge_delay_s = hedge_delay_s
-        self._read_lat = _deque(maxlen=256)
+        self._read_hist = obs.Histogram(max_samples=256)
         self._hedge_rr = 0
         self.hedges_issued = 0
         self.hedges_won = 0
@@ -301,9 +313,11 @@ class RemoteClient:
             try:
                 if io_timeout is not None:
                     self._sock.settimeout(io_timeout)
-                send_frame(self._sock, msg_type, payload, codec,
-                           chaos=self._chaos)
-                typ, reply = self._recv_reply(self._sock)
+                with obs.span("client.send", "client"):
+                    send_frame(self._sock, msg_type, payload, codec,
+                               chaos=self._chaos)
+                with obs.span("client.wait", "client"):
+                    typ, reply = self._recv_reply(self._sock)
                 if io_timeout is not None:
                     self._sock.settimeout(self._timeout)
             except Exception:
@@ -366,12 +380,15 @@ class RemoteClient:
             time.sleep(delay)
             attempt += 1
             self.total_retries += 1
+            obs.REGISTRY.counter("serve.client.retries").inc()
 
     def _request(self, msg_type: MsgType, payload: Any,
                  codec: int = CODEC_MSGPACK,
                  deadline_s: Optional[float] = None) -> Any:
         """One logical request: attach an idempotency token to mutating
-        frames, then retry under :meth:`_retry_driver`."""
+        frames, mint a query id for query-shaped frames (the trace the
+        daemon's spans join on), then retry under
+        :meth:`_retry_driver`."""
         if msg_type in MUTATING_TYPES and isinstance(payload, dict) \
                 and IDEMPOTENCY_KEY not in payload:
             # one token per LOGICAL request: every retry resends the
@@ -379,6 +396,15 @@ class RemoteClient:
             # first reply was lost mid-wire
             payload = dict(payload)
             payload[IDEMPOTENCY_KEY] = uuid.uuid4().hex
+        qid = None
+        if msg_type in TRACED_TYPES and isinstance(payload, dict) \
+                and QUERY_ID_KEY not in payload and obs.enabled():
+            # one id per LOGICAL query (retries reuse it); a payload
+            # already carrying a qid is a forwarded frame (the leader's
+            # mirror path) — its originating client owns the trace
+            qid = obs.new_query_id()
+            payload = dict(payload)
+            payload[QUERY_ID_KEY] = qid
         oneshot = self._stream_owner == threading.get_ident()
 
         def attempt(io_timeout):
@@ -392,7 +418,10 @@ class RemoteClient:
             return self._request_once(msg_type, payload, codec,
                                       io_timeout=io_timeout)
 
-        return self._retry_driver(attempt, deadline_s)
+        if qid is None:
+            return self._retry_driver(attempt, deadline_s)
+        with obs.trace(qid, origin="client"):
+            return self._retry_driver(attempt, deadline_s)
 
     # --- windowed bulk ingest (BULK_BEGIN/CHUNK/COMMIT) ---------------
     def _bulk_once(self, sock: socket.socket, begin: dict,
@@ -479,17 +508,32 @@ class RemoteClient:
         return self._retry_driver(attempt, deadline_s)
 
     # --- hedged reads -------------------------------------------------
+    def _observe_read_latency(self, dt: float) -> None:
+        """One read's latency, recorded ONCE into both views: this
+        client's bounded histogram (what :meth:`hedge_delay_s`
+        quantiles over) and the process-shared registry histogram
+        (what COLLECT_STATS ships) — same observations, same numbers."""
+        self._read_hist.observe(dt)
+        obs.REGISTRY.histogram("serve.client.read_latency_s").observe(dt)
+
     def hedge_delay_s(self) -> float:
         """Current hedge trigger: the explicit knob when set, else the
         observed p99 of this client's recent read latencies (adaptive —
         a hedge should fire only when THIS request is already in the
-        tail), else a 50 ms cold-start default."""
+        tail; quantiled over the shared latency histogram), else a
+        50 ms cold-start default."""
         if self._hedge_delay_s is not None:
             return self._hedge_delay_s
-        if len(self._read_lat) >= 8:
-            lat = sorted(self._read_lat)
-            return lat[int(0.99 * (len(lat) - 1))]
+        if self._read_hist.sample_count >= 8:
+            p99 = self._read_hist.quantile(0.99)
+            if p99 is not None:
+                return p99
         return 0.05
+
+    def read_latency_stats(self) -> Dict[str, Any]:
+        """Summary of this client's observed read latencies — the same
+        histogram the hedge trigger quantiles over."""
+        return self._read_hist.summary()
 
     def _request_hedged(self, msg_type: MsgType, payload: Any, codec: int,
                         io_timeout: Optional[float] = None) -> Any:
@@ -527,6 +571,7 @@ class RemoteClient:
             tag, err, val = results.get(timeout=self.hedge_delay_s())
         except _queue.Empty:
             self.hedges_issued += 1
+            obs.REGISTRY.counter("serve.client.hedges_issued").inc()
             addr = self._replicas[self._hedge_rr % len(self._replicas)]
             self._hedge_rr += 1
             threading.Thread(
@@ -547,6 +592,7 @@ class RemoteClient:
             raise err
         if tag == "hedge":
             self.hedges_won += 1
+            obs.REGISTRY.counter("serve.client.hedges_won").inc()
             # release the primary (it holds _lock until its recv ends)
             self._force_close()
             # if the primary ALREADY finished and released the lock,
@@ -559,7 +605,7 @@ class RemoteClient:
                     self._drop_connection()
                 finally:
                     self._lock.release()
-        self._read_lat.append(time.perf_counter() - t0)
+        self._observe_read_latency(time.perf_counter() - t0)
         return val
 
     def _drop_connection(self) -> None:
@@ -985,6 +1031,7 @@ class RemoteClient:
             legs = 1 if winner[0] == "primary" else 2
         except _queue.Empty:
             self.hedges_issued += 1
+            obs.REGISTRY.counter("serve.client.hedges_issued").inc()
             addr = self._replicas[self._hedge_rr % len(self._replicas)]
             self._hedge_rr += 1
             threading.Thread(target=opener, daemon=True,
@@ -1021,7 +1068,8 @@ class RemoteClient:
             raise err
         if tag == "hedge":
             self.hedges_won += 1
-        self._read_lat.append(time.perf_counter() - t0)
+            obs.REGISTRY.counter("serve.client.hedges_won").inc()
+        self._observe_read_latency(time.perf_counter() - t0)
         with state_lock:
             sock = socks.pop(tag)
         try:
@@ -1171,3 +1219,13 @@ class RemoteClient:
     # --- stats --------------------------------------------------------
     def collect_stats(self) -> Dict[str, Any]:
         return self._request(MsgType.COLLECT_STATS, {})
+
+    def get_trace(self, last: Optional[int] = None,
+                  qid: Optional[str] = None) -> Dict[str, Any]:
+        """Completed query trace profiles from the daemon's ring
+        (newest last). ``qid`` filters to one query; ``last`` bounds
+        the count. On a leader, profiles carry ``followers`` sections
+        merged by query id (one logical query decomposed across every
+        daemon that ran it)."""
+        return self._request(MsgType.GET_TRACE,
+                             {"last": last, "qid": qid})

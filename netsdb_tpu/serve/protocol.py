@@ -135,6 +135,10 @@ class MsgType(IntEnum):
     # (suite_sink_for) never pull tables from a daemon (ref
     # StorageCollectStats → Statistics, PangeaStorageServer.h:48)
     ANALYZE_SET = 41
+    # query-scoped observability: the last N completed query trace
+    # profiles from the daemon's ring buffer (obs/trace.TraceRing);
+    # the leader merges follower sections by query id
+    GET_TRACE = 44
     # multi-host reads: a master assembling a mesh-spanning array asks
     # each follower for ITS addressable shards (index ranges + bytes) —
     # the reference streaming each node's local pages to the frontend
@@ -166,6 +170,13 @@ class MsgType(IntEnum):
 #: a retry after an ambiguous failure (reply lost mid-wire) returns the
 #: first execution's result instead of double-applying the mutation.
 IDEMPOTENCY_KEY = "__idem__"
+
+#: payload key carrying the client-minted query id (obs/trace.py) on
+#: traced frames. The server pops it before dispatch, opens a
+#: query-scoped trace under it, and re-attaches it to mirrored
+#: forwards — so one logical query's spans join up across the client,
+#: the leader and every follower (queryable via GET_TRACE).
+QUERY_ID_KEY = "__qid__"
 
 #: frame types that mutate daemon state or launch jobs — the set the
 #: client attaches idempotency tokens to before retrying. Reads are

@@ -33,6 +33,7 @@ from typing import Any, Callable, Dict, Optional, Tuple
 
 import numpy as np
 
+from netsdb_tpu import obs
 from netsdb_tpu.client import Client
 from netsdb_tpu.config import Configuration, DEFAULT_CONFIG
 from netsdb_tpu.serve.errors import (
@@ -47,6 +48,7 @@ from netsdb_tpu.serve.protocol import (
     IDEMPOTENCY_KEY,
     MAX_FRAME_BYTES,
     PROTO_VERSION,
+    QUERY_ID_KEY,
     MsgType,
     ProtocolError,
     decode_body,
@@ -296,9 +298,11 @@ class _IdempotencyCache:
             with self._mu:
                 if token in self._done:
                     self._done.move_to_end(token)
+                    obs.REGISTRY.counter("serve.idem.memory_hits").inc()
                     return self._done[token]
                 cached = self._load_persisted(token)
                 if cached is not None:
+                    obs.REGISTRY.counter("serve.idem.persist_hits").inc()
                     return cached
                 ev = self._inflight.get(token)
                 if ev is None:
@@ -593,6 +597,13 @@ class ServeController:
         # across it (ROADMAP: idempotency across daemon restarts)
         self._idem = _IdempotencyCache(persist_path=os.path.join(
             os.path.dirname(config.catalog_path), "idempotency.sqlite"))
+        # query-scoped observability: this daemon's completed trace
+        # profiles (GET_TRACE source) — per-controller, NOT the
+        # process-default ring, so leader/follower pairs in one test
+        # process keep distinct profiles
+        self._obs_enabled = bool(getattr(config, "obs_enabled", True))
+        self.trace_ring = obs.TraceRing(
+            getattr(config, "obs_trace_ring", 64) or 64)
         self.library = Client(config)  # the resident state
         # ORDERING MODEL for mirrored frames (the SPMD argument):
         # - _mirror_lock is held only long enough to ENQUEUE a frame
@@ -654,6 +665,7 @@ class ServeController:
             MsgType.EXECUTE_PLAN: self._on_execute_plan,
             MsgType.LIST_JOBS: self._on_list_jobs,
             MsgType.COLLECT_STATS: self._on_collect_stats,
+            MsgType.GET_TRACE: self._on_get_trace,
             MsgType.ANALYZE_SET: self._on_analyze_set,
             MsgType.LOCAL_SHARDS: self._on_local_shards,
             MsgType.PAGED_MATMUL: self._on_paged_matmul,
@@ -755,6 +767,7 @@ class ServeController:
                         mid_frame_timeout=self.frame_timeout_s)
                 except (ProtocolError, ConnectionError, OSError):
                     return
+                t_dec = time.perf_counter()
                 try:
                     payload = decode_body(raw, codec_in, self.allow_pickle,
                                           segments=segs)
@@ -771,6 +784,7 @@ class ServeController:
                     if not self._send_err(conn, fault, retryable=True):
                         return
                     continue
+                decode_s = time.perf_counter() - t_dec
                 if typ == MsgType.SHUTDOWN:
                     send_frame(conn, MsgType.OK, {})
                     self.shutdown()
@@ -781,7 +795,8 @@ class ServeController:
                     if not self._handle_bulk(conn, payload):
                         return
                     continue
-                if not self._dispatch_frame(conn, typ, codec_in, payload):
+                if not self._dispatch_frame(conn, typ, codec_in, payload,
+                                            decode_s=decode_s):
                     return
 
     def _send_reply(self, conn, typ, payload, codec=CODEC_MSGPACK) -> None:
@@ -813,13 +828,39 @@ class ServeController:
         except OSError:
             return False
 
-    def _dispatch_frame(self, conn, typ, codec_in, payload) -> bool:
-        """Execute one decoded request frame and send its reply (or
-        replies, for streams). Returns False when the connection is
-        dead. Mutating frames carrying an idempotency token are
-        deduplicated here: a retry of a COMPLETED request replays the
-        cached reply without re-running the handler — the at-most-once
-        half of the client's retry contract."""
+    def _dispatch_frame(self, conn, typ, codec_in, payload,
+                        decode_s: float = 0.0) -> bool:
+        """Execute one decoded request frame and send its reply. A
+        frame carrying a client-minted query id opens a query-scoped
+        trace first (``obs.trace``): the handler, the executor below
+        it, staging and the device cache all report spans/counters
+        into it, and the completed profile lands in this daemon's
+        GET_TRACE ring — the ``-DPROFILING`` decomposition, per query,
+        always on (``config.obs_enabled`` is the kill switch)."""
+        qid = payload.pop(QUERY_ID_KEY, None) \
+            if isinstance(payload, dict) else None
+        if qid is None or not self._obs_enabled:
+            return self._dispatch_traced(conn, typ, codec_in, payload, None)
+        with obs.trace(str(qid), origin="server",
+                       ring=self.trace_ring) as tr:
+            if tr is not None:
+                # the body decode finished before the trace could open:
+                # back-date the trace so the decode span occupies real
+                # timeline [0, decode_s] AHEAD of the dispatch span
+                # (and total_s covers it) instead of overlapping it
+                tr.backdate(decode_s)
+                tr.record("server.decode", decode_s, "serve", start_s=0.0)
+                tr.add("frame.decode_s", decode_s)
+            return self._dispatch_traced(conn, typ, codec_in, payload,
+                                         str(qid))
+
+    def _dispatch_traced(self, conn, typ, codec_in, payload, qid) -> bool:
+        """The dispatch body (trace context, if any, already
+        installed). Returns False when the connection is dead. Mutating
+        frames carrying an idempotency token are deduplicated here: a
+        retry of a COMPLETED request replays the cached reply without
+        re-running the handler — the at-most-once half of the client's
+        retry contract."""
         token = payload.pop(IDEMPOTENCY_KEY, None) \
             if isinstance(payload, dict) else None
         try:
@@ -829,7 +870,10 @@ class ServeController:
                     reply_type, reply, codec = cached
                     self._send_reply(conn, reply_type, reply, codec)
                     return True
-            out = self._execute_frame(typ, payload, codec_in, token)
+            with obs.span(f"server.dispatch:{getattr(typ, 'name', typ)}",
+                          "serve"):
+                out = self._execute_frame(typ, payload, codec_in, token,
+                                          qid=qid)
             if inspect.isgenerator(out):
                 # streaming handler: each yielded (type, payload
                 # [, codec]) goes out as its own frame; TCP
@@ -848,27 +892,30 @@ class ServeController:
                         (f_type, f_payload), f_codec = frame, CODEC_MSGPACK
                     self._send_reply(conn, f_type, f_payload, f_codec)
                 return True
-            self._send_reply(conn, *out)
+            with obs.span("server.reply", "serve"):
+                self._send_reply(conn, *out)
             return True
         except BrokenPipeError:
             return False
         except Exception as e:  # handler errors go back as typed ERR
             return self._send_err(conn, e, with_traceback=True)
 
-    def _execute_frame(self, typ, payload, codec_in, token):
+    def _execute_frame(self, typ, payload, codec_in, token, qid=None):
         """Run one request's handler with the idempotency-token
         lifecycle (the caller has already claimed ``token``). Returns a
         generator (streaming handlers) or the normalized ``(type,
         payload, codec)`` reply; on every exit path the token has been
         finished or aborted exactly once. Shared by the per-frame
-        dispatch and the bulk-ingest COMMIT."""
+        dispatch and the bulk-ingest COMMIT. ``qid`` (the client's
+        query id, already popped) rides mirrored forwards so follower
+        traces share the leader's id."""
         handler = self.handlers.get(typ)
         try:
             if handler is None:
                 raise ProtocolError(f"no handler for {typ!r}")
             if self._follower_addrs and typ in self.MIRRORED:
                 out = self._run_mirrored(typ, payload, codec_in, handler,
-                                         token=token)
+                                         token=token, qid=qid)
             else:
                 out = handler(payload)
         except FollowerDegraded as e:
@@ -1349,7 +1396,8 @@ class ServeController:
             return self._set_locks.setdefault((db, set_name),
                                               threading.Lock())
 
-    def _run_mirrored(self, typ, payload, codec, handler, token=None):
+    def _run_mirrored(self, typ, payload, codec, handler, token=None,
+                      qid=None):
         """Execute one mutating/job frame on EVERY process, holding the
         frame's ORDERING lock across both the follower enqueue and the
         local handler (see the ordering model in ``__init__`` — the
@@ -1373,30 +1421,41 @@ class ServeController:
         if jax.process_count() > 1:
             # true SPMD: one total order for everything mirrored
             with self._collective_lock:
-                return self._mirror_once(typ, payload, codec, handler, token)
+                return self._mirror_once(typ, payload, codec, handler,
+                                         token, qid)
         if typ in self.SET_SCOPED_FRAMES and "db" in payload \
                 and "set" in payload:
             self._order.acquire_read()
             try:
                 with self._set_lock(payload["db"], payload["set"]):
                     return self._mirror_once(typ, payload, codec, handler,
-                                             token)
+                                             token, qid)
             finally:
                 self._order.release_read()
         self._order.acquire_write()
         try:
-            return self._mirror_once(typ, payload, codec, handler, token)
+            return self._mirror_once(typ, payload, codec, handler, token,
+                                     qid)
         finally:
             self._order.release_write()
 
-    def _mirror_once(self, typ, payload, codec, handler, token=None):
+    def _mirror_once(self, typ, payload, codec, handler, token=None,
+                     qid=None):
         # forward the CLIENT's idempotency token (popped before
         # dispatch) so followers dedupe too: if the local handler fails
         # retryably AFTER the forward (e.g. AdmissionFull), the
         # client's retry re-forwards the frame — without the shared
-        # token each follower would apply it twice and diverge
-        fwd = payload if token is None \
-            else {**payload, IDEMPOTENCY_KEY: token}
+        # token each follower would apply it twice and diverge.
+        # The query id rides along for the same reason traces exist:
+        # one logical query's spans must join up across every daemon
+        # that executed it (GET_TRACE merges them by qid).
+        fwd = payload
+        if token is not None or qid is not None:
+            fwd = dict(payload)
+            if token is not None:
+                fwd[IDEMPOTENCY_KEY] = token
+            if qid is not None:
+                fwd[QUERY_ID_KEY] = qid
         with self._mirror_lock:  # short: dial + ordered enqueue only
             self._ensure_followers()
             with self._followers_mu:
@@ -1463,7 +1522,8 @@ class ServeController:
         rec["status"] = "running"
         t0 = time.perf_counter()
         try:
-            out = fn()
+            with obs.span(f"server.job:{job_name}", "job"):
+                out = fn()
             rec["status"] = "done"
             return out
         except Exception:
@@ -1999,14 +2059,83 @@ class ServeController:
         with self._jobs_lock:
             return MsgType.OK, {"jobs": [dict(j) for j in self._jobs.values()]}
 
+    def _fanout_read(self, typ, payload) -> Dict[str, Any]:
+        """Best-effort read fan-out to every ACTIVE follower over its
+        ordered link (stats/trace collection — the leader-merges-
+        follower-sections leg of COLLECT_STATS and GET_TRACE). One
+        shared deadline covers all followers; a follower that can't
+        answer in time reports ``{"error": ...}`` instead of being
+        evicted — liveness stays the health loop's job, a slow stats
+        read must never degrade the mirror set."""
+        with self._followers_mu:
+            links = dict(self._links)
+        if not links:
+            return {}
+        recs = [(addr, link.submit(typ, payload, CODEC_MSGPACK))
+                for addr, link in links.items()]
+        deadline = deadline_after(self.frame_timeout_s)
+        out: Dict[str, Any] = {}
+        for addr, rec in recs:
+            if not rec["done"].wait(max(0.0, seconds_left(deadline))):
+                out[addr] = {"error": f"no reply within "
+                                      f"{self.frame_timeout_s}s"}
+            elif rec.get("error"):
+                out[addr] = {"error": rec["error"]}
+            else:
+                out[addr] = rec["reply"]
+        return out
+
     def _on_collect_stats(self, p):
         # device_cache: the cross-query device-resident block cache's
         # hit/miss/evict/bytes counters (storage/devcache.py) — the
-        # serve STATUS view of the warm-EXECUTE path
-        return MsgType.OK, {"sets": self.library.collect_stats(),
-                            "cache": self.library.store.stats.as_dict(),
-                            "device_cache":
-                                self.library.store.device_cache().stats()}
+        # serve STATUS view of the warm-EXECUTE path.
+        # metrics: the central registry snapshot (obs/metrics.py) —
+        # compile stats, staging, devcache aggregates, serve counters
+        # and span-time histograms in ONE section.
+        out = {"sets": self.library.collect_stats(),
+               "cache": self.library.store.stats.as_dict(),
+               "device_cache": self.library.store.device_cache().stats(),
+               "metrics": obs.REGISTRY.snapshot()}
+        if not p.get("local_only"):
+            followers = self._fanout_read(MsgType.COLLECT_STATS,
+                                          {"local_only": True})
+            if followers:
+                out["followers"] = followers
+        return MsgType.OK, out
+
+    def _on_get_trace(self, p):
+        """The last N completed query profiles from this daemon's ring.
+        On a leader, each profile additionally carries the follower
+        sections that share its query id (``followers``: addr →
+        profiles) — mirrored EXECUTEs forward the qid, so one logical
+        query decomposes across every daemon that ran it."""
+        n = p.get("last")
+        qid = p.get("qid")
+        if qid:
+            profiles = self.trace_ring.find(str(qid))
+        else:
+            profiles = self.trace_ring.last(int(n) if n else None)
+        out: Dict[str, Any] = {"profiles": profiles,
+                               "enabled": self._obs_enabled}
+        if not p.get("local_only"):
+            freplies = self._fanout_read(
+                MsgType.GET_TRACE, {"local_only": True, "qid": qid,
+                                    "last": n})
+            if freplies:
+                merged = []
+                for prof in profiles:
+                    sections = {
+                        addr: [fp for fp in reply.get("profiles", ())
+                               if fp.get("qid") == prof.get("qid")]
+                        for addr, reply in freplies.items()
+                        if "error" not in reply}
+                    sections = {a: s for a, s in sections.items() if s}
+                    if sections:
+                        prof = {**prof, "followers": sections}
+                    merged.append(prof)
+                out["profiles"] = merged
+                out["followers"] = freplies
+        return MsgType.OK, out
 
     def _on_analyze_set(self, p):
         """Planner statistics computed where the data lives — the
@@ -2040,10 +2169,13 @@ def run_daemon(config: Configuration, host: str = "127.0.0.1",
     ``serve`` subcommand and :func:`main`. ``followers``: worker-daemon
     addresses for multi-host fan-out (one per other jax.distributed
     process; call ``parallel.distributed.initialize_cluster`` first)."""
+    from netsdb_tpu.utils.profiling import get_logger
+
     ctl = ServeController(config, host=host, port=port, token=token,
                           max_jobs=max_jobs, followers=followers)
     bound = ctl.start()
-    print(f"netsdb_tpu serving on {host}:{bound}", flush=True)
+    get_logger("netsdb_tpu.serve", level="INFO").info(
+        "netsdb_tpu serving on %s:%s", host, bound)
     ctl.serve_forever()
     return 0
 

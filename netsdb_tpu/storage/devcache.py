@@ -51,6 +51,8 @@ import threading
 from collections import OrderedDict
 from typing import Any, Dict, List, Optional, Tuple
 
+from netsdb_tpu import obs
+
 
 def to_device(x, sharding=None):
     """The ONE sanctioned host→device upload for store-owned blocks
@@ -92,6 +94,8 @@ def _value_nbytes(value) -> int:
         return total
     if getattr(value, "nbytes", None) is not None:
         return int(value.nbytes)
+    if isinstance(value, dict):  # raw column maps (PagedColumns.stream)
+        return sum(_value_nbytes(v) for v in value.values())
     if isinstance(value, (tuple, list)):
         return sum(_value_nbytes(v) for v in value)
     return 64  # scalars / ints riding along with blocks
@@ -144,17 +148,27 @@ class DeviceBlockCache:
     # --- the data path ------------------------------------------------
     def get(self, key: Tuple) -> Optional[List[Any]]:
         """The run cached under ``key``, or None (counted as a miss).
-        Hits refresh LRU recency."""
+        Hits refresh LRU recency. Per-store counters stay on this
+        instance (``stats()`` keeps its shape); the process-wide
+        registry and the active query trace get the same tick — the
+        profile's devcache hit/miss decomposition."""
         with self._mu:
             if not self.enabled:
                 return None
             entry = self._entries.get(key)
             if entry is None:
                 self._stats["misses"] += 1
-                return None
-            self._entries.move_to_end(key)
-            self._stats["hits"] += 1
-            return entry[0]
+                entry = None
+            else:
+                self._entries.move_to_end(key)
+                self._stats["hits"] += 1
+        if entry is None:
+            obs.REGISTRY.counter("devcache.misses").inc()
+            obs.add("devcache.misses")
+            return None
+        obs.REGISTRY.counter("devcache.hits").inc()
+        obs.add("devcache.hits")
+        return entry[0]
 
     def make_room(self, nbytes: int) -> None:
         """Evict LRU entries until ``nbytes`` of headroom exists under
@@ -203,7 +217,9 @@ class DeviceBlockCache:
             self._bytes += nbytes
             self._by_scope.setdefault(str(key[0]), set()).add(key)
             self._stats["installs"] += 1
-            return True
+        obs.REGISTRY.counter("devcache.installs").inc()
+        obs.add("devcache.installs")
+        return True
 
     def _evict_to_fit_locked(self, incoming: int) -> None:
         while self._entries and self._bytes + incoming > self._budget:
@@ -215,6 +231,7 @@ class DeviceBlockCache:
                 if not scoped:
                     self._by_scope.pop(str(old_key[0]), None)
             self._stats["evictions"] += 1
+            obs.REGISTRY.counter("devcache.evictions").inc()
 
     # --- invalidation -------------------------------------------------
     def invalidate(self, scope: str) -> int:
@@ -233,7 +250,8 @@ class DeviceBlockCache:
                     self._bytes -= entry[1]
                     dropped += 1
             self._stats["invalidations"] += dropped
-            return dropped
+        obs.REGISTRY.counter("devcache.invalidations").inc(dropped)
+        return dropped
 
     def clear(self) -> int:
         """Drop everything (the resync-restore hook: the whole store

@@ -7,23 +7,47 @@ pthread-safe ``PDBLogger`` file logger (``src/pdbServer/headers/
 PDBLogger.h``). Here: a StageTimer span collector (always on — spans
 are cheap), a ``jax.profiler`` trace context for real device profiles,
 and stdlib logging configured PDBLogger-style.
+
+Query-scoped structured tracing lives in ``netsdb_tpu/obs/`` — the
+StageTimer remains as the simple named-span aggregator (per-name
+distributions, no query identity) and reports into the central metrics
+registry alongside it.
 """
 
 from __future__ import annotations
 
 import contextlib
 import logging
+import threading
 import time
-from collections import defaultdict
-from typing import Dict, Iterator, List, Optional
+from typing import Dict, Iterator, Optional
+
+from netsdb_tpu.obs import metrics as _metrics
 
 
 class StageTimer:
-    """Named wall-clock spans with summary stats (the -DPROFILING spans,
-    queryable instead of printed)."""
+    """Named wall-clock spans with summary stats (the -DPROFILING
+    spans, queryable instead of printed).
 
-    def __init__(self):
-        self.spans: Dict[str, List[float]] = defaultdict(list)
+    BOUNDED per name: each name keeps exact ``count``/``total_s``/
+    ``max_s`` forever, plus a fixed-size sample ring for percentiles
+    (:class:`netsdb_tpu.obs.metrics.Histogram`). The old version
+    appended every duration to a list — a long-lived daemon timing its
+    per-request stages grew that without bound; now a year of spans
+    holds the same few KB per name."""
+
+    def __init__(self, max_samples: int = 512):
+        self._mu = threading.Lock()
+        self._max_samples = max_samples
+        self._hists: Dict[str, _metrics.Histogram] = {}
+
+    def _hist(self, name: str) -> _metrics.Histogram:
+        with self._mu:
+            h = self._hists.get(name)
+            if h is None:
+                h = self._hists[name] = _metrics.Histogram(
+                    self._max_samples)
+            return h
 
     @contextlib.contextmanager
     def span(self, name: str) -> Iterator[None]:
@@ -31,22 +55,38 @@ class StageTimer:
         try:
             yield
         finally:
-            self.spans[name].append(time.perf_counter() - t0)
+            self._hist(name).observe(time.perf_counter() - t0)
+
+    def sample_count(self, name: str) -> int:
+        """Retained samples for ``name`` (≤ ``max_samples`` no matter
+        how many spans ran) — the boundedness the tests pin."""
+        return self._hist(name).sample_count
 
     def summary(self) -> Dict[str, Dict[str, float]]:
+        """Same shape as always (``count``/``total_s``/``mean_s``/
+        ``max_s`` — exact), plus bounded-sample percentiles."""
+        with self._mu:
+            hists = dict(self._hists)
         out = {}
-        for name, times in self.spans.items():
-            out[name] = {"count": len(times), "total_s": sum(times),
-                         "mean_s": sum(times) / len(times),
-                         "max_s": max(times)}
+        for name, h in hists.items():
+            s = h.summary()
+            if not s["count"]:
+                continue
+            out[name] = {"count": s["count"], "total_s": s["total"],
+                         "mean_s": s["mean"], "max_s": s["max"],
+                         "p50_s": s["p50"], "p95_s": s["p95"],
+                         "p99_s": s["p99"]}
         return out
 
     def reset(self) -> None:
-        self.spans.clear()
+        with self._mu:
+            self._hists.clear()
 
 
-# process-global timer used by the executor
+# process-global timer used by the executor; its summary reports into
+# the central metrics registry (COLLECT_STATS "metrics" → "stages")
 GLOBAL_TIMER = StageTimer()
+_metrics.REGISTRY.register_collector("stages", GLOBAL_TIMER.summary)
 
 
 @contextlib.contextmanager

@@ -1,0 +1,247 @@
+"""Serve-side observability integration: GET_TRACE profiles over the
+wire, the COLLECT_STATS "metrics" section, query ids across the mirror
+hop, merged leader/follower stats, and the histogram-backed hedge
+estimator.
+
+Acceptance shape (ISSUE 5): one warm serve EXECUTE of a q01-style
+query yields a GET_TRACE profile whose spans cover client send →
+server decode → executor chunk loop → devcache hit, with span
+durations summing to within 20% of the measured wall time; existing
+stats accessors keep their shapes.
+"""
+
+import time
+
+import numpy as np
+import pytest
+
+from netsdb_tpu import obs
+from netsdb_tpu.config import Configuration
+from netsdb_tpu.relational import dag as rdag
+from netsdb_tpu.relational.table import ColumnTable
+from netsdb_tpu.serve.client import RemoteClient, RetryPolicy
+from netsdb_tpu.serve.server import ServeController
+
+
+def _remote(addr, **kw):
+    kw.setdefault("retry", RetryPolicy(max_attempts=1))
+    return RemoteClient(addr, **kw)
+
+
+def _li_cols(n, seed=0):
+    rng = np.random.default_rng(seed)
+    return {
+        "l_shipdate": rng.integers(19940101, 19950101, n, dtype=np.int32),
+        "l_discount": np.full(n, 0.06, np.float32),
+        "l_quantity": np.full(n, 10.0, np.float32),
+        "l_extendedprice": rng.uniform(1000, 2000, n).astype(np.float32),
+    }
+
+
+def _load_lineitem(c, n=20_000, seed=0):
+    c.create_database("d")
+    c.create_set("d", "lineitem", type_name="table", storage="paged")
+    c.send_table("d", "lineitem", ColumnTable(_li_cols(n, seed), {}))
+
+
+def _execute_q06(c):
+    c.execute_computations(rdag.q06_sink("d"), job_name="q06",
+                           fetch_results=False)
+
+
+@pytest.fixture()
+def daemon(tmp_path):
+    ctl = ServeController(
+        Configuration(root_dir=str(tmp_path / "obs"),
+                      page_size_bytes=1 << 16, page_pool_bytes=1 << 20),
+        port=0)
+    addr = f"127.0.0.1:{ctl.start()}"
+    yield ctl, addr
+    ctl.shutdown()
+
+
+def test_warm_execute_trace_covers_the_whole_path(daemon):
+    """The tentpole acceptance: client send → server decode → executor
+    chunk loop → devcache hit in ONE query's profile, span sums within
+    20% of the measured wall."""
+    ctl, addr = daemon
+    c = _remote(addr)
+    _load_lineitem(c)
+    _execute_q06(c)  # cold: compiles, installs into the device cache
+
+    seen = {p["qid"] for p in obs.DEFAULT_RING.last()}
+    t0 = time.perf_counter()
+    _execute_q06(c)  # WARM: the profile under test
+    wall = time.perf_counter() - t0
+
+    # client-side profile: send + wait spans covering the request
+    client_profs = [p for p in obs.DEFAULT_RING.last()
+                    if p["origin"] == "client" and p["qid"] not in seen]
+    assert len(client_profs) == 1
+    cp = client_profs[0]
+    cnames = {s["name"] for s in cp["spans"]}
+    assert {"client.send", "client.wait"} <= cnames
+    span_sum = sum(s["duration_s"] for s in cp["spans"]
+                   if s["depth"] == 0)
+    assert span_sum <= wall * 1.05
+    assert span_sum >= 0.8 * wall, (span_sum, wall)
+
+    # server-side profile under the SAME qid, fetched over the wire
+    reply = c.get_trace(qid=cp["qid"])
+    assert reply["enabled"]
+    (sp,) = reply["profiles"]
+    assert sp["origin"] == "server"
+    names = {s["name"]: s for s in sp["spans"]}
+    assert "server.decode" in names
+    assert "server.dispatch:EXECUTE_COMPUTATIONS" in names
+    fold = names["executor.fold_stream"]
+    assert fold["counters"]["chunks"] >= 1
+    # warm == served from the device cache, visible on the profile
+    assert sp["counters"]["devcache.hits"] >= 1
+    assert sp["counters"].get("stage.cached_runs", 0) >= 1
+    # server spans at depth 0 decompose the server's own total
+    server_sum = sum(s["duration_s"] for s in sp["spans"]
+                     if s["depth"] == 0)
+    assert server_sum <= sp["total_s"] * 1.05
+    c.close()
+
+
+def test_get_trace_last_n_and_ring_bound(daemon):
+    ctl, addr = daemon
+    c = _remote(addr)
+    _load_lineitem(c, n=2_000)
+    for _ in range(3):
+        _execute_q06(c)
+    reply = c.get_trace(last=2)
+    assert len(reply["profiles"]) == 2
+    assert all(p["origin"] == "server" for p in reply["profiles"])
+    # the ring is the controller's, bounded by config.obs_trace_ring
+    assert len(ctl.trace_ring) <= ctl.library.config.obs_trace_ring
+    c.close()
+
+
+def test_collect_stats_metrics_section_and_stable_shapes(daemon):
+    ctl, addr = daemon
+    c = _remote(addr)
+    _load_lineitem(c, n=2_000)
+    _execute_q06(c)
+    _execute_q06(c)
+    st = c.collect_stats()
+    # pre-existing sections keep their exact shapes
+    assert set(st["cache"]) == {"hits", "misses", "evictions", "spills",
+                                "loads"}
+    assert {"hits", "misses", "installs", "evictions", "invalidations",
+            "rejected", "bytes", "entries",
+            "budget_bytes"} <= set(st["device_cache"])
+    from netsdb_tpu.plan.executor import compile_stats
+
+    assert set(compile_stats()) == {"hits", "misses", "traces"}
+    # the new metrics section: registry + absorbed collectors
+    m = st["metrics"]
+    assert {"counters", "gauges", "histograms", "compile", "staging",
+            "stages"} <= set(m)
+    assert m["compile"] == compile_stats()
+    assert m["counters"]["devcache.hits"] >= 1
+    assert m["counters"]["staging.chunks"] >= 1
+    c.close()
+
+
+def test_obs_disable_switch(tmp_path):
+    ctl = ServeController(
+        Configuration(root_dir=str(tmp_path / "off"), obs_enabled=False,
+                      page_size_bytes=1 << 16, page_pool_bytes=1 << 20),
+        port=0)
+    addr = f"127.0.0.1:{ctl.start()}"
+    try:
+        c = _remote(addr)
+        _load_lineitem(c, n=2_000)
+        _execute_q06(c)
+        reply = c.get_trace()
+        assert reply["enabled"] is False
+        assert reply["profiles"] == []
+        c.close()
+    finally:
+        ctl.shutdown()
+
+
+# ---------------------------------------------- mirrored leader/follower
+def test_mirrored_pair_merged_stats_and_qid_across_the_hop(tmp_path):
+    """Satellite: COLLECT_STATS over a leader/follower pair merges the
+    follower's sections (a mirrored write's devcache invalidation on
+    the FOLLOWER is visible through the leader), and the query id
+    survives the mirror hop (the leader's GET_TRACE profile carries
+    the follower's section under the same qid)."""
+    fctl = ServeController(Configuration(root_dir=str(tmp_path / "f")),
+                           port=0)
+    fport = fctl.start()
+    faddr = f"127.0.0.1:{fport}"
+    mctl = ServeController(Configuration(root_dir=str(tmp_path / "m")),
+                           port=0, followers=[faddr])
+    addr = f"127.0.0.1:{mctl.start()}"
+    try:
+        c = _remote(addr)
+        _load_lineitem(c, n=800)
+        # mirrored EXECUTEs warm BOTH daemons' device caches
+        _execute_q06(c)
+        _execute_q06(c)
+        assert fctl.library.store.device_cache().stats()["installs"] >= 1
+
+        # qid across the hop: the leader's newest EXECUTE profile and
+        # the follower's, joined by one query id
+        reply = c.get_trace(last=1)
+        (prof,) = reply["profiles"]
+        assert prof["origin"] == "server"
+        assert faddr in reply["followers"]
+        fsections = prof.get("followers") or {}
+        assert faddr in fsections, prof
+        assert all(fp["qid"] == prof["qid"] for fp in fsections[faddr])
+        assert fctl.trace_ring.find(prof["qid"])
+
+        # a mirrored write invalidates the FOLLOWER's warm cache; the
+        # merged COLLECT_STATS shows it from the leader alone
+        c.send_table("d", "lineitem", ColumnTable(_li_cols(800, 7), {}))
+        st = c.collect_stats()
+        assert faddr in st["followers"]
+        fdc = st["followers"][faddr]["device_cache"]
+        assert fdc["invalidations"] >= 1
+        assert fdc == fctl.library.store.device_cache().stats()
+        assert "metrics" in st["followers"][faddr]
+        c.close()
+    finally:
+        mctl.shutdown()
+        fctl.shutdown()
+
+
+# --------------------------------------------------- hedge estimator
+def test_hedge_estimator_backed_by_shared_histogram(daemon):
+    """Satellite: hedge_delay_s quantiles over the client's bounded
+    latency histogram, whose every observation also lands in the
+    registry histogram COLLECT_STATS ships — one set of numbers."""
+    ctl, addr = daemon
+    before = obs.REGISTRY.histogram("serve.client.read_latency_s").count
+    c = _remote(addr, replicas=[addr])
+    # cold start: no samples yet → the documented 50 ms default
+    assert c.hedge_delay_s() == pytest.approx(0.05)
+    for i in range(20):
+        c._observe_read_latency(0.001 * (i + 1))
+    assert c.read_latency_stats()["count"] == 20
+    assert c.hedge_delay_s() == c._read_hist.quantile(0.99)
+    assert 0.015 <= c.hedge_delay_s() <= 0.020
+    shared = obs.REGISTRY.histogram("serve.client.read_latency_s")
+    assert shared.count - before == 20
+    # the explicit knob still wins
+    c._hedge_delay_s = 0.3
+    assert c.hedge_delay_s() == 0.3
+    c.close()
+
+
+def test_hedged_read_observes_latency_through_histogram(daemon):
+    """A real hedged read lands its latency in the SAME histogram the
+    trigger reads — the introspection loop closes end-to-end."""
+    ctl, addr = daemon
+    c = _remote(addr, replicas=[addr], hedge_delay_s=5.0)
+    _load_lineitem(c, n=500)
+    assert c.set_exists("d", "lineitem")  # an idempotent, hedgeable read
+    assert c._read_hist.count >= 1
+    assert c.read_latency_stats()["count"] == c._read_hist.count
+    c.close()

@@ -45,6 +45,17 @@ Regressions the serve layer must never quietly reacquire:
    pervade legitimate compute); those call sites are kept inside
    ``place`` functions by review + the loop check above.
 
+6. **Observability discipline.** The obs subsystem (``netsdb_tpu/
+   obs/``) measures deadline-adjacent time and runs inside daemons:
+   it inherits the serve layer's monotonic-clock ban (a span timed on
+   ``time.time()`` jumps with NTP). New counters must live in the
+   central registry, not module-level dicts — a bare module dict is
+   invisible to COLLECT_STATS and un-resettable (the scattered-stats
+   regression the obs subsystem exists to end). And ``print()`` is
+   banned everywhere in ``netsdb_tpu/`` outside ``cli.py`` and
+   ``workloads/`` — daemons and libraries report through the logger
+   or the registry, never stdout.
+
 Run standalone: ``python tests/test_static_checks.py`` (exit 1 on
 violations) — the CI-script form the pytest wrapper shares.
 """
@@ -54,9 +65,11 @@ import os
 import sys
 
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+PKG_DIR = os.path.join(REPO, "netsdb_tpu")
 SERVE_DIR = os.path.join(REPO, "netsdb_tpu", "serve")
 PLAN_DIR = os.path.join(REPO, "netsdb_tpu", "plan")
 STORAGE_DIR = os.path.join(REPO, "netsdb_tpu", "storage")
+OBS_DIR = os.path.join(REPO, "netsdb_tpu", "obs")
 OOC_FILE = os.path.join(REPO, "netsdb_tpu", "relational", "outofcore.py")
 
 #: the staging module owns the (background-thread) device_put calls
@@ -163,6 +176,80 @@ def check_serve_layer() -> list:
     return violations
 
 
+def check_obs_layer() -> list:
+    """The obs subsystem inherits the serve-layer discipline (monotonic
+    clocks, no opaque except) and adds its own: counters go through
+    the registry, never module-level dicts."""
+    violations = []
+    for name in sorted(os.listdir(OBS_DIR)):
+        if not name.endswith(".py"):
+            continue
+        path = os.path.join(OBS_DIR, name)
+        violations.extend(_check_file(path))
+        violations.extend(_check_module_dict_counters(path))
+    return violations
+
+
+def _check_module_dict_counters(path: str) -> list:
+    """Ban module-level dict-literal assignments in obs/ — every
+    counter belongs to the MetricsRegistry (named, snapshottable,
+    resettable), not a loose module dict the stats frames can't see."""
+    with open(path) as f:
+        tree = ast.parse(f.read(), filename=path)
+    rel = os.path.relpath(path, REPO)
+    out = []
+    for node in tree.body:
+        targets = []
+        if isinstance(node, ast.Assign):
+            targets, value = node.targets, node.value
+        elif isinstance(node, ast.AnnAssign) and node.value is not None:
+            targets, value = [node.target], node.value
+        else:
+            continue
+        if isinstance(value, (ast.Dict, ast.DictComp)):
+            names = ", ".join(getattr(t, "id", "?") for t in targets)
+            out.append(f"{rel}:{node.lineno}: module-level dict "
+                       f"{names!r} in obs/ — counters go through "
+                       f"MetricsRegistry, not bare module dicts")
+    return out
+
+
+#: modules allowed to call print(): the operator CLI and the bench
+#: scripts (their OUTPUT is stdout); everything else in netsdb_tpu/
+#: reports through the logger or the metrics registry
+_PRINT_EXEMPT_DIRS = {os.path.join(PKG_DIR, "workloads")}
+_PRINT_EXEMPT_FILES = {os.path.join(PKG_DIR, "cli.py"),
+                       os.path.join(PKG_DIR, "_reexec.py")}
+
+
+def check_no_prints() -> list:
+    violations = []
+    for dirpath, _dirnames, filenames in os.walk(PKG_DIR):
+        if "__pycache__" in dirpath:
+            continue
+        if any(os.path.commonpath([dirpath, d]) == d
+               for d in _PRINT_EXEMPT_DIRS):
+            continue
+        for name in sorted(filenames):
+            if not name.endswith(".py"):
+                continue
+            path = os.path.join(dirpath, name)
+            if path in _PRINT_EXEMPT_FILES:
+                continue
+            with open(path) as f:
+                tree = ast.parse(f.read(), filename=path)
+            rel = os.path.relpath(path, REPO)
+            for node in ast.walk(tree):
+                if isinstance(node, ast.Call) \
+                        and isinstance(node.func, ast.Name) \
+                        and node.func.id == "print":
+                    violations.append(
+                        f"{rel}:{node.lineno}: print() outside cli.py/"
+                        f"workloads/ — use utils.profiling.get_logger "
+                        f"or a registry counter")
+    return violations
+
+
 _LOOP_NODES = (ast.For, ast.While, ast.AsyncFor, ast.ListComp,
                ast.SetComp, ast.DictComp, ast.GeneratorExp)
 
@@ -260,9 +347,20 @@ def test_no_cache_bypassing_device_put():
     assert not violations, "\n" + "\n".join(violations)
 
 
+def test_obs_layer_clock_and_registry_discipline():
+    violations = check_obs_layer()
+    assert not violations, "\n" + "\n".join(violations)
+
+
+def test_no_prints_outside_cli_and_workloads():
+    violations = check_no_prints()
+    assert not violations, "\n" + "\n".join(violations)
+
+
 def main() -> int:
     violations = (check_serve_layer() + check_staging_discipline()
-                  + check_device_upload_discipline())
+                  + check_device_upload_discipline()
+                  + check_obs_layer() + check_no_prints())
     for v in violations:
         print(v, file=sys.stderr)
     print(f"serve-layer + staging static check: "
