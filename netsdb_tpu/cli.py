@@ -653,7 +653,8 @@ def _print_obs(stats, traces) -> None:
     """Human-readable observability readout (the --json flag skips
     this and dumps the raw payloads)."""
     m = stats.get("metrics") or {}
-    print("== metrics ==")
+    if m or stats.get("device_cache") or stats.get("followers"):
+        print("== metrics ==")
     for k, v in sorted((m.get("counters") or {}).items()):
         print(f"  {k:<44} {v}")
     for k, v in sorted((m.get("gauges") or {}).items()):
@@ -682,11 +683,23 @@ def _print_obs(stats, traces) -> None:
         print(f"{indent}{prof.get('qid')} [{prof.get('origin')}] "
               f"total={total * 1e3:.2f}ms "
               f"counters={prof.get('counters') or {}}")
+        hd = prof.get("host_device")
+        if hd:
+            print(f"{indent}  host/device: "
+                  f"host={hd['host_s'] * 1e3:.2f}ms "
+                  f"device_est={hd['device_est_s'] * 1e3:.2f}ms")
+        if prof.get("meta"):
+            print(f"{indent}  meta: {json.dumps(prof['meta'])}")
         for sp in prof.get("spans") or ():
             pad = indent + "  " * (sp.get("depth", 0) + 1)
             extra = f"  {sp['counters']}" if sp.get("counters") else ""
             print(f"{pad}{sp['name']} +{sp['start_s'] * 1e3:.2f}ms "
                   f"{sp['duration_s'] * 1e3:.3f}ms{extra}")
+        client_prof = prof.get("client")
+        if client_prof:
+            # the PUT_TRACE-shipped client half of the same qid
+            print(f"{indent}  client:")
+            show(client_prof, indent + "    ")
         for addr, fprofs in sorted((prof.get("followers") or {}).items()):
             print(f"{indent}  follower {addr}:")
             for fp in fprofs:
@@ -696,14 +709,70 @@ def _print_obs(stats, traces) -> None:
         show(prof)
 
 
+def _print_health(health) -> None:
+    """Human-readable SLO/health readout (the HEALTH frame)."""
+    def show_section(h, indent=""):
+        for o in h.get("objectives") or ():
+            state = "BREACHED" if o.get("breached") else "ok"
+            val = o.get("value")
+            val_s = f"{val:.4g}" if isinstance(val, (int, float)) else "-"
+            burn = o.get("worst_burn_rate")
+            burn_s = f"{burn:.3g}" if isinstance(burn, (int, float)) \
+                else "-"
+            print(f"{indent}  {o['name']:<24} [{state}] "
+                  f"value={val_s} target={o['target']} "
+                  f"worst_burn={burn_s}  ({o['kind']})")
+            for wname, w in sorted((o.get("windows") or {}).items()):
+                wv = w.get("value")
+                wv_s = f"{wv:.4g}" if isinstance(wv, (int, float)) else "-"
+                wb = w.get("burn_rate")
+                wb_s = f"{wb:.3g}" if isinstance(wb, (int, float)) else "-"
+                print(f"{indent}      {wname:<10} value={wv_s} "
+                      f"burn={wb_s} [{w.get('scope')}]")
+        for ev in (h.get("events") or ())[-5:]:
+            print(f"{indent}  event: {json.dumps(ev, default=str)}")
+        sl = h.get("slowlog") or {}
+        print(f"{indent}  slowlog: {sl.get('entries', 0)} entries "
+              f"(threshold {sl.get('threshold_s')}s, "
+              f"newest {sl.get('newest')})")
+
+    print("== health ==")
+    show_section(health)
+    for addr, f in sorted((health.get("followers") or {}).items()):
+        print(f"  follower {addr}:")
+        if isinstance(f, dict) and "objectives" in f:
+            show_section(f, "  ")
+        else:
+            print(f"    {json.dumps(f, default=str)}")
+
+
 def _cmd_obs(args) -> int:
     """Pretty-print a running daemon's observability surface: the
-    COLLECT_STATS "metrics" section (central registry) and the last N
-    completed query profiles (GET_TRACE)."""
+    COLLECT_STATS "metrics" section (central registry), the last N
+    completed query profiles (GET_TRACE), the SLO/health readout
+    (--health) or the persisted slow-query ring (--slowlog)."""
     from netsdb_tpu.serve.client import RemoteClient
 
     c = RemoteClient(args.addr, token=args.token)
     try:
+        if getattr(args, "health", False):
+            health = c.health()
+            if args.json:
+                print(json.dumps(health, indent=2, default=str))
+            else:
+                _print_health(health)
+            return 0
+        if getattr(args, "slowlog", False):
+            traces = c.get_trace(last=args.traces, qid=args.qid,
+                                 slow=True)
+            if args.json:
+                print(json.dumps(traces, indent=2, default=str))
+                return 0
+            sl = traces.get("slowlog") or {}
+            print(f"== slowlog ({sl.get('entries', 0)} persisted, "
+                  f"threshold {sl.get('threshold_s')}s) ==")
+            _print_obs({"metrics": {}}, traces)
+            return 0
         stats = c.collect_stats()
         traces = c.get_trace(last=args.traces, qid=args.qid)
     finally:
@@ -872,6 +941,15 @@ def main(argv=None) -> int:
                    help="how many completed query profiles to show")
     p.add_argument("--qid", default=None,
                    help="show only the profile(s) of one query id")
+    p.add_argument("--health", action="store_true",
+                   help="SLO/health readout instead (HEALTH frame): "
+                        "every objective with multi-window burn rates, "
+                        "recent breach/recovery events, slowlog "
+                        "summary; leaders merge follower sections")
+    p.add_argument("--slowlog", action="store_true",
+                   help="the persisted slow-query ring instead "
+                        "(<root>/slowlog/ — outliers that survived "
+                        "ring rotation and restarts)")
     p.add_argument("--json", action="store_true",
                    help="raw JSON instead of the pretty readout")
 

@@ -96,6 +96,22 @@ class Configuration:
     # per-histogram retained samples in the metrics registry (exact
     # count/total/max are kept forever; quantiles come from the last N)
     obs_hist_samples: int = 512
+    # 1-in-N query-id minting (obs.sample_qid): 1 traces every query
+    # (the PR 5 behavior); N>1 mints a qid — and therefore pays span
+    # recording, PUT_TRACE shipping and the optional device profile —
+    # for one request in N, so high-QPS serving traces at bounded cost
+    obs_trace_sample: int = 1
+    # queries whose trace total exceeds this many seconds persist their
+    # FULL profile to the bounded on-disk slowlog ring
+    # (<root>/slowlog/, obs/slowlog.py — survives restarts); 0/None
+    # disables
+    obs_slow_query_s: Optional[float] = 5.0
+    # slowlog files retained (oldest pruned beyond this)
+    obs_slowlog_entries: int = 64
+    # opt-in per-query jax.profiler sessions: a traced serve request
+    # captures a REAL device profile into <dir>/<qid> (one session at a
+    # time; concurrent traced queries skip, never queue). None = off.
+    obs_device_profile_dir: Optional[str] = None
     # --- execution ---
     num_threads: int = 4  # host-side IO/pipeline threads (not device parallelism)
     enable_compression: bool = True  # host spill compression (ref -DENABLE_COMPRESSION)
@@ -117,6 +133,9 @@ class Configuration:
         if self.bucket_density not in (2, 4):
             raise ValueError(f"bucket_density must be 2 or 4, got "
                              f"{self.bucket_density!r}")
+        if self.obs_trace_sample < 1:
+            raise ValueError(f"obs_trace_sample must be >= 1, got "
+                             f"{self.obs_trace_sample!r}")
 
     @property
     def catalog_path(self) -> str:

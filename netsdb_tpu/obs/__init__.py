@@ -14,6 +14,7 @@ registry instruments are always live. Stdlib-only by design: the
 JAX-free wire client imports this module.
 """
 
+from netsdb_tpu.obs import attrib  # noqa: F401 — registers "attribution"
 from netsdb_tpu.obs.metrics import (  # noqa: F401
     Counter,
     Gauge,
@@ -24,6 +25,7 @@ from netsdb_tpu.obs.metrics import (  # noqa: F401
 )
 from netsdb_tpu.obs.trace import (  # noqa: F401
     DEFAULT_RING,
+    QidSampler,
     QueryTrace,
     Span,
     TraceRing,
@@ -31,6 +33,7 @@ from netsdb_tpu.obs.trace import (  # noqa: F401
     current_trace,
     enabled,
     new_query_id,
+    sample_qid,
     set_enabled,
     span,
     trace,
@@ -38,7 +41,7 @@ from netsdb_tpu.obs.trace import (  # noqa: F401
 
 __all__ = [
     "Counter", "Gauge", "Histogram", "MetricsRegistry", "REGISTRY",
-    "registry", "DEFAULT_RING", "QueryTrace", "Span", "TraceRing",
-    "add", "current_trace", "enabled", "new_query_id", "set_enabled",
-    "span", "trace",
+    "registry", "DEFAULT_RING", "QidSampler", "QueryTrace", "Span",
+    "TraceRing", "add", "attrib", "current_trace", "enabled",
+    "new_query_id", "sample_qid", "set_enabled", "span", "trace",
 ]

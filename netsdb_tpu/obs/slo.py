@@ -1,0 +1,292 @@
+"""SLO / health engine — declarative objectives over the registry.
+
+PR 5 made the runtime *measurable* (central :class:`~netsdb_tpu.obs.
+metrics.MetricsRegistry`, query-scoped traces); this module makes it
+*judgeable*: a small set of declarative objectives (availability, p99
+request latency, device-cache hit rate, staging wait fraction) is
+evaluated against the registry with **multi-window burn rates** — the
+standard SRE alerting form (a short window catches a fast burn, a long
+window a slow leak; both must agree before a breach is real).
+
+The registry holds CUMULATIVE counters; objectives need RATES. The
+engine therefore keeps a bounded ring of timestamped readings (one
+reading = the few raw values the objectives reference) and computes
+each window's value from the delta between the newest reading and the
+oldest reading inside that window. Until a window has history, it
+falls back to the all-time value — a fresh daemon reports its lifetime
+ratio rather than "no data".
+
+Objective kinds:
+
+* ``ratio_min`` — good/total ≥ target (availability, devcache hit
+  rate). Burn rate = (1 − ratio) / (1 − target): 1.0 means the error
+  budget burns exactly at the sustainable pace, >1 means faster.
+* ``quantile_max`` — a registry histogram's q-quantile ≤ target (p99
+  request latency). Quantiles come from the histogram's bounded sample
+  ring (recent by construction), so they are already "windowed";
+  burn rate = value / target.
+* ``rate_max`` — a histogram's TOTAL-seconds delta per wall second ≤
+  target (staging wait fraction: how much of real time the consumers
+  spent blocked on device uploads). Burn rate = value / target.
+
+Everything is stdlib-only and monotonic-clocked (the obs layer
+inherits the serve clock discipline — static-checked). Breaches emit
+structured events into a bounded ring and tick
+``slo.breaches``/``slo.recoveries`` registry counters; the serve
+``HEALTH`` frame ships :meth:`SLOEngine.evaluate` plus the events, and
+a leader merges follower sections exactly like COLLECT_STATS.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import threading
+import time
+from collections import deque
+from typing import Any, Callable, Dict, List, Optional, Tuple
+
+from netsdb_tpu.obs import metrics as _metrics
+
+#: default evaluation windows (seconds): fast-burn, slow-burn
+DEFAULT_WINDOWS: Tuple[float, ...] = (60.0, 600.0)
+
+
+@dataclasses.dataclass(frozen=True)
+class Objective:
+    """One declarative objective. ``good``/``total``/``hist`` name
+    registry instruments; which are read depends on ``kind`` (module
+    docstring). ``quantile`` applies to ``quantile_max`` only."""
+
+    name: str
+    kind: str  # "ratio_min" | "quantile_max" | "rate_max"
+    target: float
+    description: str = ""
+    good: Optional[str] = None   # counter name (ratio_min numerator)
+    total: Optional[str] = None  # counter name (ratio_min denominator)
+    hist: Optional[str] = None   # histogram name (quantile_max/rate_max)
+    quantile: float = 0.99
+
+    def __post_init__(self):
+        if self.kind not in ("ratio_min", "quantile_max", "rate_max"):
+            raise ValueError(f"unknown objective kind {self.kind!r}")
+        if self.kind == "ratio_min" and not (self.good and self.total):
+            raise ValueError(f"{self.name}: ratio_min needs good+total")
+        if self.kind in ("quantile_max", "rate_max") and not self.hist:
+            raise ValueError(f"{self.name}: {self.kind} needs hist")
+
+
+def default_objectives() -> List[Objective]:
+    """The shipped objective set — the signals the ROADMAP scheduler
+    will admit against. Counters/histograms referenced here are all
+    maintained by the serve/staging/devcache layers."""
+    return [
+        Objective(
+            name="availability", kind="ratio_min", target=0.999,
+            good="serve.requests_ok", total="serve.requests",
+            description="fraction of dispatched frames answered "
+                        "without an ERR"),
+        Objective(
+            name="request_p99_s", kind="quantile_max", target=2.0,
+            hist="serve.request_s", quantile=0.99,
+            description="p99 server-side frame dispatch latency "
+                        "(time-to-first-frame for streams)"),
+        Objective(
+            name="devcache_hit_rate", kind="ratio_min", target=0.5,
+            good="devcache.hits", total="devcache.lookups",
+            description="device block cache hit rate (warm serving)"),
+        Objective(
+            name="staging_wait_fraction", kind="rate_max", target=0.25,
+            hist="staging.wait_s",
+            description="fraction of wall time consumers spent blocked "
+                        "on staged host->device uploads"),
+    ]
+
+
+class SLOEngine:
+    """Evaluates objectives over one registry with windowed burn
+    rates. One per daemon (the ServeController owns it); tests build
+    private ones over private registries.
+
+    ``evaluate()`` is cheap (a registry read + a few arithmetic ops)
+    and takes a reading as a side effect, so a daemon polled by
+    HEALTH frames accumulates exactly the history it needs — no
+    background thread."""
+
+    def __init__(self, registry: Optional[_metrics.MetricsRegistry] = None,
+                 objectives: Optional[List[Objective]] = None,
+                 windows: Tuple[float, ...] = DEFAULT_WINDOWS,
+                 max_readings: int = 256, max_events: int = 128,
+                 clock: Callable[[], float] = time.monotonic):
+        self.registry = registry if registry is not None \
+            else _metrics.REGISTRY
+        self.objectives = list(objectives if objectives is not None
+                               else default_objectives())
+        self.windows = tuple(sorted(windows))
+        self._clock = clock
+        self._mu = threading.Lock()
+        # (t, {counter_name: value, "ht:"+hist: total_seconds})
+        self._readings: "deque[Tuple[float, Dict[str, float]]]" = \
+            deque(maxlen=max(int(max_readings), 2))
+        self._events: "deque[Dict[str, Any]]" = \
+            deque(maxlen=max(int(max_events), 1))
+        self._breached: Dict[str, bool] = {}
+        self._take_reading()  # the t0 baseline every window deltas from
+
+    # --- readings -----------------------------------------------------
+    def _counter_names(self) -> List[str]:
+        names = []
+        for o in self.objectives:
+            if o.kind == "ratio_min":
+                names.extend((o.good, o.total))
+        return names
+
+    def _take_reading(self) -> Tuple[float, Dict[str, float]]:
+        vals: Dict[str, float] = {}
+        for name in self._counter_names():
+            vals[name] = float(self.registry.counter(name).value)
+        for o in self.objectives:
+            if o.kind == "rate_max":
+                vals[f"ht:{o.hist}"] = float(
+                    self.registry.histogram(o.hist).total)
+        reading = (self._clock(), vals)
+        with self._mu:
+            self._readings.append(reading)
+        return reading
+
+    def observe(self) -> None:
+        """Take one timestamped reading (HEALTH polls call evaluate,
+        which does this implicitly; call directly to densify)."""
+        self._take_reading()
+
+    # --- evaluation ---------------------------------------------------
+    def _window_delta(self, now: float, window: float, key: str,
+                      newest: Dict[str, float]
+                      ) -> Optional[Tuple[float, float]]:
+        """(delta_value, delta_seconds) between the newest reading and
+        the OLDEST reading inside ``window``; None when no prior
+        reading exists (caller falls back to all-time)."""
+        with self._mu:
+            base = None
+            for t, vals in self._readings:
+                if now - t <= window:
+                    base = (t, vals)
+                    break
+            if base is None or now - base[0] <= 0:
+                return None
+        dv = newest.get(key, 0.0) - base[1].get(key, 0.0)
+        return dv, now - base[0]
+
+    def _eval_ratio(self, o: Objective, now: float,
+                    newest: Dict[str, float]) -> Dict[str, Any]:
+        """``value`` is the WORST window's ratio (what an operator
+        wants to see first); ``breached`` requires EVERY window with
+        data to sit below target — the multi-window agreement rule
+        (module docstring): the short window alone flaps on bursts,
+        the long window alone lags a real outage."""
+        windows: Dict[str, Dict[str, Any]] = {}
+        worst_burn = 0.0
+        value = None
+        agree: List[bool] = []
+        for w in self.windows:
+            dg = self._window_delta(now, w, o.good, newest)
+            dt_ = self._window_delta(now, w, o.total, newest)
+            if dg is None or dt_ is None or dt_[0] <= 0:
+                # no traffic in the window (or no history): all-time
+                tot = newest.get(o.total, 0.0)
+                ratio = (newest.get(o.good, 0.0) / tot) if tot else None
+                scope = "all-time"
+            else:
+                ratio = dg[0] / dt_[0]
+                scope = "window"
+            burn = None
+            if ratio is not None:
+                budget = max(1.0 - o.target, 1e-9)
+                burn = max(0.0, (1.0 - ratio)) / budget
+                worst_burn = max(worst_burn, burn)
+                value = ratio if value is None else min(value, ratio)
+                agree.append(ratio < o.target)
+            windows[f"{int(w)}s"] = {"value": ratio, "burn_rate": burn,
+                                     "scope": scope}
+        breached = bool(agree) and all(agree)
+        return {"value": value, "windows": windows,
+                "worst_burn_rate": worst_burn if value is not None
+                else None, "breached": breached}
+
+    def _eval_quantile(self, o: Objective) -> Dict[str, Any]:
+        h = self.registry.histogram(o.hist)
+        q = h.quantile(o.quantile)
+        burn = (q / o.target) if q is not None and o.target > 0 else None
+        win = {"samples": {"value": q, "burn_rate": burn,
+                           "scope": f"last-{h.sample_count}-samples"}}
+        return {"value": q, "windows": win, "worst_burn_rate": burn,
+                "breached": q is not None and q > o.target}
+
+    def _eval_rate(self, o: Objective, now: float,
+                   newest: Dict[str, float]) -> Dict[str, Any]:
+        """Same agreement rule as :meth:`_eval_ratio`: ``value`` is
+        the worst window's rate, ``breached`` only when every window
+        with history exceeds target."""
+        key = f"ht:{o.hist}"
+        windows: Dict[str, Dict[str, Any]] = {}
+        worst = None
+        agree: List[bool] = []
+        for w in self.windows:
+            d = self._window_delta(now, w, key, newest)
+            if d is None:
+                windows[f"{int(w)}s"] = {"value": None, "burn_rate": None,
+                                         "scope": "no-history"}
+                continue
+            rate = max(d[0], 0.0) / d[1]
+            burn = (rate / o.target) if o.target > 0 else None
+            worst = rate if worst is None else max(worst, rate)
+            agree.append(rate > o.target)
+            windows[f"{int(w)}s"] = {"value": rate, "burn_rate": burn,
+                                     "scope": "window"}
+        return {"value": worst, "windows": windows,
+                "worst_burn_rate": (worst / o.target)
+                if worst is not None and o.target > 0 else None,
+                "breached": bool(agree) and all(agree)}
+
+    def evaluate(self) -> List[Dict[str, Any]]:
+        """Evaluate every objective (taking a fresh reading first).
+        Msgpack-safe list, one dict per objective; breach TRANSITIONS
+        emit structured events and tick registry counters."""
+        now, newest = self._take_reading()
+        out = []
+        for o in self.objectives:
+            if o.kind == "ratio_min":
+                res = self._eval_ratio(o, now, newest)
+            elif o.kind == "quantile_max":
+                res = self._eval_quantile(o)
+            else:
+                res = self._eval_rate(o, now, newest)
+            res.update(name=o.name, kind=o.kind, target=o.target,
+                       description=o.description)
+            self._transition(o, res)
+            out.append(res)
+        return out
+
+    # --- events -------------------------------------------------------
+    def _transition(self, o: Objective, res: Dict[str, Any]) -> None:
+        breached = bool(res.get("breached"))
+        with self._mu:
+            was = self._breached.get(o.name, False)
+            self._breached[o.name] = breached
+            if breached == was:
+                return
+            from netsdb_tpu.utils.timing import wall_now
+
+            self._events.append({
+                "at": wall_now(),  # display timestamp (sanctioned)
+                "objective": o.name,
+                "event": "breach" if breached else "recovery",
+                "value": res.get("value"),
+                "target": o.target,
+                "worst_burn_rate": res.get("worst_burn_rate")})
+        self.registry.counter(
+            "slo.breaches" if breached else "slo.recoveries").inc()
+
+    def events(self, last: Optional[int] = None) -> List[Dict[str, Any]]:
+        with self._mu:
+            evs = list(self._events)
+        return evs if last is None else evs[-int(last):]

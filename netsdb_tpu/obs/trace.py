@@ -61,8 +61,64 @@ def enabled() -> bool:
 
 def new_query_id() -> str:
     """Client-side query-id mint — one per logical query, carried in
-    frame metadata so the daemon's spans join the client's."""
+    frame metadata so the daemon's spans join the client's.
+
+    HOT-PATH callers must not call this directly: qid minting decides
+    whether a whole query gets traced end-to-end (client spans shipped
+    via PUT_TRACE, a server profile in the ring, optional device
+    profiling), and at high QPS that cost must be SAMPLED, not paid per
+    request. Mint through :func:`sample_qid` (``config.
+    obs_trace_sample``) — the static check in
+    ``tests/test_static_checks.py`` bans ``new_query_id`` outside
+    ``obs/``."""
     return uuid.uuid4().hex[:16]
+
+
+class QidSampler:
+    """Deterministic 1-in-N qid mint with its OWN round-robin phase.
+
+    One per caller (each ``RemoteClient`` owns one): a PROCESS-wide
+    counter phase-locks under interleaved callers — two clients
+    alternating at sample=4 would give one of them ``n % 4 == 0``
+    never (starved of tracing forever) and the other 1-in-2. Per-caller
+    phase keeps ``RemoteClient(trace_sample=N)`` meaning exactly
+    1-in-N of THAT client's requests."""
+
+    def __init__(self):
+        self._mu = threading.Lock()
+        self._n = 0
+
+    def sample(self, sample: int = 1) -> Optional[str]:
+        """A fresh query id for 1 in every ``sample`` calls
+        (deterministic round-robin, not random — tests and capacity
+        planning both want an exact rate), None otherwise.
+        ``sample <= 1`` traces everything (the PR 5 behavior); the
+        serve client threads ``config.obs_trace_sample`` through here
+        so high-QPS traffic traces at 1/N cost. Tracing disabled ⇒
+        always None."""
+        if not _enabled:
+            return None
+        if sample <= 1:
+            return new_query_id()
+        with self._mu:
+            self._n += 1
+            hit = self._n % int(sample) == 0
+        if not hit:
+            _metrics.REGISTRY.counter("obs.qid_sampled_out").inc()
+            return None
+        return new_query_id()
+
+
+# process-default sampler for callers without their own (module-level
+# sample_qid); clients mint through their own QidSampler
+_default_sampler = QidSampler()
+
+
+def sample_qid(sample: int = 1) -> Optional[str]:
+    """Module-level convenience over the process-default
+    :class:`QidSampler` — see its docstring; per-client callers hold
+    their own sampler so interleaving can't skew their rate."""
+    return _default_sampler.sample(sample)
 
 
 class Span:
@@ -109,6 +165,7 @@ class QueryTrace:
         self._mu = threading.Lock()
         self._spans: List[Span] = []
         self._counters: Dict[str, float] = {}
+        self._meta: Dict[str, Any] = {}
         self._depth = threading.local()
         self.total_s: Optional[float] = None  # set by finish()
 
@@ -152,6 +209,13 @@ class QueryTrace:
         with self._mu:
             self._counters[counter] = self._counters.get(counter, 0) + n
 
+    def annotate(self, key: str, value: Any) -> None:
+        """Attach a non-numeric fact to the profile (``meta`` section):
+        the device-profile directory, the client identity, a sampling
+        note — things counters cannot carry."""
+        with self._mu:
+            self._meta[str(key)] = value
+
     # --- lifecycle ----------------------------------------------------
     def finish(self) -> Dict[str, Any]:
         """Close the trace (idempotent on total_s) and push its profile
@@ -164,27 +228,57 @@ class QueryTrace:
         return prof
 
     def profile(self) -> Dict[str, Any]:
-        """Msgpack-safe profile dict — what GET_TRACE ships."""
+        """Msgpack-safe profile dict — what GET_TRACE ships.
+
+        ``host_device`` splits the query's total into an estimated
+        device share and the host remainder. The device share sums the
+        counters the instrumented layers already measure —
+        ``device.est_s`` (time inside dispatched jitted steps, the
+        ``scan_slope``-style wall timing around each fold/tensor step)
+        plus ``stage.wait_s`` (time the consumer blocked on a staged
+        host→device upload). It is an ESTIMATE (dispatch-inclusive;
+        exact device timelines come from the opt-in per-qid
+        ``jax.profiler`` session whose directory rides ``meta``)."""
         with self._mu:
             spans = [s.as_dict() for s in
                      sorted(self._spans, key=lambda s: s.start_s)]
             counters = dict(self._counters)
-        return {"qid": self.qid, "origin": self.origin,
-                "total_s": self.total_s, "spans": spans,
-                "counters": counters}
+            meta = dict(self._meta)
+        out: Dict[str, Any] = {"qid": self.qid, "origin": self.origin,
+                               "total_s": self.total_s, "spans": spans,
+                               "counters": counters}
+        if meta:
+            out["meta"] = meta
+        if self.total_s is not None:
+            dev = (counters.get("device.est_s", 0.0)
+                   + counters.get("stage.wait_s", 0.0))
+            dev = min(dev, self.total_s)
+            out["host_device"] = {
+                "device_est_s": dev,
+                "host_s": max(self.total_s - dev, 0.0)}
+        return out
 
 
 class TraceRing:
     """Bounded ring of completed query profiles — the GET_TRACE
     source. Push-side cheap; ``last(n)`` returns newest-last."""
 
-    def __init__(self, capacity: int = 64):
+    def __init__(self, capacity: int = 64, pending_capacity: int = 32):
         self._mu = threading.Lock()
         self._cap = max(int(capacity), 1)
         self._items: List[Dict[str, Any]] = []
+        # sections that arrived BEFORE their profile ringed (the
+        # reply-before-ring race, merge_section docstring); qid →
+        # {section: payload}, oldest evicted first
+        self._pending_cap = max(int(pending_capacity), 1)
+        self._pending: Dict[str, Dict[str, Any]] = {}
 
     def push(self, profile: Dict[str, Any]) -> None:
         with self._mu:
+            qid = profile.get("qid")
+            pend = self._pending.pop(qid, None) if qid else None
+            if pend:
+                profile = {**profile, **pend}
             self._items.append(profile)
             if len(self._items) > self._cap:
                 del self._items[:len(self._items) - self._cap]
@@ -197,6 +291,42 @@ class TraceRing:
     def find(self, qid: str) -> List[Dict[str, Any]]:
         with self._mu:
             return [p for p in self._items if p.get("qid") == qid]
+
+    def merge_section(self, qid: str, section: str, payload: Any) -> bool:
+        """Attach ``payload`` under ``section`` on every ringed profile
+        of ``qid`` — the PUT_TRACE merge: a client's shipped span
+        profile joins the daemon profile minted under the same query
+        id, so GET_TRACE returns ONE end-to-end decomposition. Returns
+        True when at least one ringed profile matched.
+
+        NO causal ordering protects this: the reply goes out INSIDE
+        the trace context (``_dispatch_traced``), the ring push happens
+        at trace finish AFTER it — so a fast client shipping on its
+        own connection can beat the push. An unmatched section is
+        therefore BUFFERED (bounded, oldest-evicted) and
+        :meth:`push` folds it into the profile when it lands; only a
+        qid that never rings (rotated out, never sampled) stays
+        unmatched.
+
+        COPY-ON-MERGE: ``last``/``find`` hand out the ringed dicts
+        themselves (a GET_TRACE reply may be mid-serialization on
+        another connection) — mutating one in place would change a
+        dict under iteration. The merge REPLACES the ring slot with an
+        extended shallow copy instead; readers holding the old dict
+        keep a consistent (pre-merge) profile."""
+        with self._mu:
+            hit = False
+            for i, p in enumerate(self._items):
+                if p.get("qid") == qid:
+                    merged = dict(p)
+                    merged[section] = payload
+                    self._items[i] = merged
+                    hit = True
+            if not hit:
+                self._pending.setdefault(qid, {})[section] = payload
+                while len(self._pending) > self._pending_cap:
+                    self._pending.pop(next(iter(self._pending)))
+            return hit
 
     def clear(self) -> None:
         with self._mu:

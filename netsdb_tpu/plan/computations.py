@@ -83,7 +83,21 @@ class Apply(Computation):
         ``tensor_fold`` (:class:`netsdb_tpu.plan.fold.TensorFold`) is
         the same for a paged TENSOR input: the executor streams the
         matrix's row-block pages through the node (in-DB inference over
-        storage-managed weights, ref ``SimpleFF.cc:94-290``)."""
+        storage-managed weights, ref ``SimpleFF.cc:94-290``).
+
+        **Label contract (jit-cache correctness).** On the streamed
+        executor path, a traceable node's ``fn`` is compiled ONCE per
+        ``(job_name, canonical plan, topo position, label)`` and
+        REUSED across executions. Parameters ``fn`` bakes into its
+        closure (thresholds, constants, captured arrays) are traced
+        into that first compilation as constants — so two DAGs that
+        differ ONLY in closure values but share job name, plan shape
+        and label will silently reuse the first DAG's stale constants.
+        Either reflect every closure parameter in ``label`` (what the
+        in-repo builders do: ``label=f"filter>{cutoff}"``) or vary
+        ``job_name`` per parameterization. Non-traceable
+        (``traceable=False``) nodes evaluate fresh every time and are
+        exempt. See README "Execution pipeline"."""
         super().__init__([input_])
         self.fold = fold
         self.tensor_fold = tensor_fold
@@ -180,7 +194,15 @@ class Join(Computation):
         gather — the automatic form of what round 3 exposed only as
         hand calls. ``take`` limits which right columns are gathered.
         Callable ``left_key``/``right_key`` stay the interpreter
-        fallback for keys no column expresses."""
+        fallback for keys no column expresses.
+
+        **Label contract**: a traceable ``fn``-bearing Join on the
+        streamed executor path shares one compiled program per
+        ``(job_name, plan shape, topo position, label)`` — closure
+        constants inside ``fn`` must be reflected in ``label`` (or a
+        distinct ``job_name``) or a structurally identical DAG reuses
+        this one's baked-in values. See :class:`Apply` for the full
+        contract."""
         super().__init__([left, right])
         self.fold = fold
         self.fold_src = fold_src

@@ -32,6 +32,7 @@ from netsdb_tpu.plan.computations import (
     Computation,
     Filter,
     Join,
+    MultiApply,
     ScanSet,
     WriteSet,
 )
@@ -122,6 +123,30 @@ def _is_traceable(node: Computation) -> bool:
     return getattr(node, "traceable", True)
 
 
+def _eval_node(node: Computation, in_vals: List[Any]) -> Any:
+    """``node.evaluate`` with :class:`PagedObjects` inputs iterated
+    under ``contextlib.closing`` for the node kinds that CONSUME
+    record iterables (eager Filter / Flatten / key-based Join /
+    key-based Aggregate): ``PagedObjects.__iter__`` holds the
+    relation's read lock for the generator's lifetime, and a predicate
+    raising mid-iteration — with the traceback frames retaining the
+    generator — would otherwise hold that lock until GC, blocking
+    appends and drops indefinitely (ADVICE round 5). Forwarding nodes
+    (WriteSet, passthrough gathers, fn-bearing Apply/Aggregate) keep
+    the raw handle — they may legitimately pass it downstream."""
+    consumes = (isinstance(node, (Filter, MultiApply))
+                or (isinstance(node, Join) and node.fn is None)
+                or (isinstance(node, Aggregate) and node.fn is None))
+    if not consumes or not any(isinstance(v, PagedObjects)
+                               for v in in_vals):
+        return node.evaluate(*in_vals)
+    with contextlib.ExitStack() as stack:
+        safe = [stack.enter_context(contextlib.closing(iter(v)))
+                if isinstance(v, PagedObjects) else v
+                for v in in_vals]
+        return node.evaluate(*safe)
+
+
 def _evaluate(plan: LogicalPlan, scan_values: Dict[int, Any]) -> Dict[int, Any]:
     """Replay the DAG in topo order, memoizing shared subgraphs (the
     reference would materialize these as intermediate per-job sets)."""
@@ -130,7 +155,7 @@ def _evaluate(plan: LogicalPlan, scan_values: Dict[int, Any]) -> Dict[int, Any]:
         if node.node_id in values:
             continue
         args = [values[i.node_id] for i in node.inputs]
-        values[node.node_id] = node.evaluate(*args)
+        values[node.node_id] = _eval_node(node, args)
     return values
 
 
@@ -153,11 +178,21 @@ def _run_fold_once(fold, pc, resident, placement, step_jit):
                 contextlib.closing(
                     pc.stream_tables(placement=placement)) as chunks:
             n = 0
+            dev_s = 0.0
             for chunk in chunks:
+                t0 = time.perf_counter()
                 state = jstep(state, chunk, *resident)
+                dev_s += time.perf_counter() - t0
                 n += 1
             if sp is not None:
+                # per-span device-time estimate (dispatch-inclusive
+                # wall around the jitted step) — the host-vs-device
+                # split the profile derives (obs/trace.profile)
                 sp.counters["chunks"] = n
+                sp.counters["device_est_s"] = dev_s
+            obs.add("device.est_s", dev_s)
+            obs.attrib.account("executor.chunks", n,
+                               scope=getattr(pc, "cache_scope", None))
     return fold.finalize(state, pc, *resident)
 
 
@@ -253,6 +288,8 @@ def _run_fold_grace(fold, pc, rest, bi, build_pc, placement, step_jit):
                     pairs(), stage_build, depth=depth,
                     name=f"grace-build:{build_pc.name}")) as staged_builds:
             npairs = 0
+            nchunks = 0
+            dev_s = 0.0
             for p, btab in staged_builds:
                 part_res = list(rest)
                 part_res[bi] = btab
@@ -261,12 +298,23 @@ def _run_fold_grace(fold, pc, rest, bi, build_pc, placement, step_jit):
                     jstep = step_jit(pidx, step)
                     state = init(state, pc, *part_res)
                     for chunk in _part_chunks(probe_parts[p], placement):
+                        t0 = time.perf_counter()
                         state = jstep(state, chunk, *part_res)
+                        dev_s += time.perf_counter() - t0
+                        nchunks += 1
                 part = fold.finalize(state, pc, *part_res)
                 out = part if out is None else fold.merge(out, part)
                 npairs += 1
             if gsp is not None:
                 gsp.counters["pairs"] = npairs
+                gsp.counters["chunks"] = nchunks
+                gsp.counters["device_est_s"] = dev_s
+            # same device-estimate + attribution feed as every other
+            # executor loop — grace joins must not read as 100% host
+            # time, and a join-heavy tenant's executor.chunks must book
+            obs.add("device.est_s", dev_s)
+            obs.attrib.account("executor.chunks", nchunks,
+                               scope=getattr(pc, "cache_scope", None))
     finally:
         # after the closing() above joined the build stager — spill
         # partitions must not be reclaimed under a live upload
@@ -421,6 +469,7 @@ def _run_tensor_stream(node, tfold, in_vals, src, step_jit):
         jstep = step_jit(0, step, donate=())
         outs = []
         was_blocked = False
+        dev_s = 0.0
         with obs.span("executor.tensor_rows", "executor") as sp, \
                 contextlib.closing(staging.stage_stream(
                     pt.stream_blocks(), place, depth,
@@ -428,7 +477,9 @@ def _run_tensor_stream(node, tfold, in_vals, src, step_jit):
                     cache=cache, cache_key=cache_key("trows"),
                     cache_validator=still_current)) as blocks:
             for n, block in blocks:
+                t0 = time.perf_counter()
                 out = jstep(block, *others)
+                dev_s += time.perf_counter() - t0
                 if isinstance(out, BlockedTensor):
                     was_blocked = True
                     out = out.to_dense()
@@ -437,6 +488,11 @@ def _run_tensor_stream(node, tfold, in_vals, src, step_jit):
                 outs.append(out)
             if sp is not None:
                 sp.counters["blocks"] = len(outs)
+                sp.counters["device_est_s"] = dev_s
+            obs.add("device.est_s", dev_s)
+            obs.attrib.account("executor.chunks", len(outs),
+                               scope=scope if scope is None
+                               else str(scope[0]))
         dense = jnp.concatenate(outs, axis=0)
         if tfold.out_block is not None:
             return BlockedTensor.from_dense(dense, tfold.out_block)
@@ -454,6 +510,7 @@ def _run_tensor_stream(node, tfold, in_vals, src, step_jit):
 
     jstep = step_jit(1, step)
     carry = None
+    dev_s = 0.0
     with obs.span("executor.tensor_reduce", "executor") as sp, \
             contextlib.closing(staging.stage_stream(
                 pt.stream_blocks(), place, depth,
@@ -462,10 +519,17 @@ def _run_tensor_stream(node, tfold, in_vals, src, step_jit):
                 cache_validator=still_current)) as blocks:
         nblk = 0
         for start, block in blocks:
+            t0 = time.perf_counter()
             carry = jstep(carry, start, block, *others)
+            dev_s += time.perf_counter() - t0
             nblk += 1
         if sp is not None:
             sp.counters["blocks"] = nblk
+            sp.counters["device_est_s"] = dev_s
+        obs.add("device.est_s", dev_s)
+        obs.attrib.account("executor.chunks", nblk,
+                           scope=scope if scope is None
+                           else str(scope[0]))
     if tfold.finalize is not None:
         return tfold.finalize(carry, *others)
     return carry
@@ -629,7 +693,7 @@ def _execute_streamed(client, plan: LogicalPlan, scan_values: Dict[int, Any],
                    f"n{topo_pos[node.node_id]}")
             values[node.node_id] = _cached_jit(key, fn)(*in_vals)
             continue
-        values[node.node_id] = node.evaluate(*in_vals)
+        values[node.node_id] = _eval_node(node, in_vals)
     return values
 
 
@@ -782,8 +846,13 @@ def execute_computations(
         topo_pos = {n.node_id: i for i, n in enumerate(plan.topo)}
         canon_args = {topo_pos[n.node_id]: scan_values[n.node_id]
                       for n in tensor_scans}
-        with obs.span("executor.whole_plan_jit", "executor"):
+        with obs.span("executor.whole_plan_jit", "executor") as sp:
+            t0_jit = time.perf_counter()
             out_list = fn(canon_args)
+            dev_s = time.perf_counter() - t0_jit
+            if sp is not None:
+                sp.counters["device_est_s"] = dev_s
+            obs.add("device.est_s", dev_s)
         sink_vals = {s.node_id: out_list[i] for i, s in enumerate(plan.sinks)}
     else:
         with obs.span("executor.eager", "executor"):

@@ -211,7 +211,7 @@ def _stage_put(q: "queue.Queue", stop: threading.Event, item) -> bool:
 
 def _stage_worker(source, place, q: "queue.Queue",
                   stop: threading.Event, name: str,
-                  on_complete=None) -> None:
+                  on_complete=None, want_nbytes: bool = False) -> None:
     """The staging thread body. DELIBERATELY a free function over
     explicit state, never a bound method: the Thread must not hold a
     reference to the StagedStream, or an abandoned stream could never
@@ -220,7 +220,15 @@ def _stage_worker(source, place, q: "queue.Queue",
 
     ``on_complete`` fires only on NATURAL source exhaustion (never on
     error or abandonment) — the device-cache install hook: only a FULL
-    run may be installed, a truncated one never."""
+    run may be installed, a truncated one never.
+
+    ``want_nbytes``: byte-size each placed chunk HERE (shipped to the
+    consumer alongside it) — device-array metadata reads cost ~4µs per
+    XLA property, so a multi-column chunk is tens of µs to measure;
+    on this thread the cost overlaps the consumer's compute instead of
+    stalling it (the accounting the trace/attribution paths need)."""
+    from netsdb_tpu.storage.devcache import _value_nbytes
+
     seq = 0
     try:
         try:
@@ -230,7 +238,8 @@ def _stage_worker(source, place, q: "queue.Queue",
                 placed = place(item)
                 _emit("place", name, seq)
                 seq += 1
-                if not _stage_put(q, stop, (_SENT_ITEM, placed)):
+                nb = _value_nbytes(placed) if want_nbytes else None
+                if not _stage_put(q, stop, (_SENT_ITEM, (placed, nb))):
                     return  # consumer abandoned the stream
         finally:
             # the worker owns the source: close it HERE so read locks
@@ -261,7 +270,8 @@ class StagedStream:
 
     def __init__(self, source: Iterable, place: Callable[[Any], Any],
                  depth: int = 2, name: str = "stage",
-                 on_complete: Optional[Callable[[], None]] = None):
+                 on_complete: Optional[Callable[[], None]] = None,
+                 scope: Optional[str] = None):
         self._source = iter(source)
         self._place = place
         self._depth = int(depth)
@@ -269,11 +279,22 @@ class StagedStream:
         self._closed = False
         self._on_complete = on_complete
         self._sync_seq = 0
-        # query-scoped accounting: the trace is captured HERE, on the
-        # consumer's thread (context vars don't cross into the staging
-        # worker); the stream reports COUNTERS only — cross-thread
-        # spans would misrepresent the overlap this class exists for
+        # query-scoped accounting: the trace AND the client identity
+        # are captured HERE, on the consumer's thread (context vars
+        # don't cross into the staging worker); the stream reports
+        # COUNTERS only — cross-thread spans would misrepresent the
+        # overlap this class exists for. ``scope`` is the set identity
+        # ("db:set") the per-client resource ledger attributes staged
+        # bytes to (None = unattributed temporaries).
         self._trace = obs.current_trace()
+        self._scope = scope
+        self._client = obs.attrib.current_client()
+        # byte-sizing placed chunks costs tens of µs of device-array
+        # metadata reads — decide ONCE whether any accounting consumer
+        # (ledger scope / active trace) needs it, and do it on the
+        # worker thread where it overlaps compute
+        want_nbytes = scope is not None or self._trace is not None
+        self._want_nbytes = want_nbytes
         self._thread: Optional[threading.Thread] = None
         if self._depth > 0:
             self._q: "queue.Queue" = queue.Queue(maxsize=self._depth)
@@ -281,7 +302,7 @@ class StagedStream:
             self._thread = threading.Thread(
                 target=_stage_worker,
                 args=(self._source, self._place, self._q, self._stop,
-                      name, on_complete),
+                      name, on_complete, want_nbytes),
                 daemon=True, name=f"netsdb-stage-{name}")
             with _stagers_lock:
                 _stagers[:] = [t for t in _stagers if t.is_alive()]
@@ -292,18 +313,28 @@ class StagedStream:
     def __iter__(self) -> Iterator[Any]:
         return self
 
-    def _account(self, placed, wait_s: float) -> None:
-        """Per-chunk bookkeeping: one registry tick always; bytes/wait
-        only onto an active query trace (the profile's "bytes staged"
-        and upload-wait counters)."""
+    def _account(self, nbytes: Optional[int], wait_s: float) -> None:
+        """Per-chunk bookkeeping: one registry tick always (plus the
+        wait histogram the staging-wait-fraction SLO reads and, for
+        store-owned streams, the per-(client, set) resource ledger);
+        bytes/wait additionally land on an active query trace (the
+        profile's "bytes staged" and upload-wait counters). ``nbytes``
+        was measured on the WORKER thread (overlapped, not here —
+        device-array metadata reads are µs-expensive)."""
         obs.REGISTRY.counter("staging.chunks").inc()
+        if wait_s > 0:
+            # total-seconds feed for obs/slo.py "staging_wait_fraction"
+            obs.REGISTRY.histogram("staging.wait_s").observe(wait_s)
+        if self._scope is not None:
+            obs.attrib.account("staged_chunks", 1, scope=self._scope,
+                               client=self._client)
+            obs.attrib.account("staged_bytes", nbytes or 0,
+                               scope=self._scope, client=self._client)
         tr = self._trace
         if tr is None:
             return
-        from netsdb_tpu.storage.devcache import _value_nbytes
-
         tr.add("stage.chunks")
-        tr.add("stage.bytes", _value_nbytes(placed))
+        tr.add("stage.bytes", nbytes or 0)
         if wait_s > 0:
             tr.add("stage.wait_s", wait_s)
 
@@ -325,7 +356,12 @@ class StagedStream:
             placed = self._place(item)
             _emit("place", self._name, self._sync_seq)
             self._sync_seq += 1
-            self._account(placed, 0.0)
+            if self._want_nbytes:
+                from netsdb_tpu.storage.devcache import _value_nbytes
+
+                self._account(_value_nbytes(placed), 0.0)
+            else:
+                self._account(None, 0.0)
             return placed
         if self._closed:
             raise StopIteration
@@ -348,8 +384,9 @@ class StagedStream:
                 # finished" moment the overlap tests anchor on
                 _emit("close", self._name)
                 raise StopIteration
-            self._account(val, time.perf_counter() - t_wait)
-            return val
+            placed, nbytes = val
+            self._account(nbytes, time.perf_counter() - t_wait)
+            return placed
 
     def close(self) -> None:
         """Stop + drain + join the staging thread (idempotent). After
@@ -444,6 +481,10 @@ class _CacheRecorder:
         self._bytes = 0
         self._cap = cache.budget_bytes
         self._overflow = False
+        # attribution identity, captured on the CONSUMER thread at
+        # construction: ``complete`` fires on the staging worker, which
+        # does not inherit the dispatch context var
+        self._client = obs.attrib.current_client()
 
     def __call__(self, item):
         placed = self._place(item)
@@ -469,12 +510,14 @@ class _CacheRecorder:
         # eviction) or bumps the version before it (validator rejects)
         # — either way no dead entry can squat on the budget
         self._cache.install(self._key, self._blocks,
-                            validator=self._validator)
+                            validator=self._validator,
+                            client=self._client)
 
 
 def stage_stream(source: Iterable, place: Callable[[Any], Any],
                  depth: int = 2, name: str = "stage",
-                 cache=None, cache_key=None, cache_validator=None):
+                 cache=None, cache_key=None, cache_validator=None,
+                 scope: Optional[str] = None):
     """Wrap ``source`` so ``place`` (pad + upload via
     ``storage/devcache.to_device``) runs up to ``depth`` items ahead on
     a background thread.  The ONE constructor every out-of-core
@@ -491,7 +534,14 @@ def stage_stream(source: Iterable, place: Callable[[Any], Any],
     staged-uploads-install-into-the-cache leg of the tentpole.
     ``cache_validator`` (no-arg callable → bool) re-checks at install
     time that ``cache_key`` is still current — a write racing the
-    stream must not leave a dead entry squatting on the budget."""
+    stream must not leave a dead entry squatting on the budget.
+
+    ``scope`` names the set ("db:set") the per-(client, set) resource
+    ledger attributes this stream's staged bytes to; defaults to the
+    cache key's scope component for cache-aware streams (store-bound
+    handles), None for uncached temporaries (grace-hash spills)."""
+    if scope is None and cache_key is not None:
+        scope = str(cache_key[0])
     if cache is not None and cache_key is not None and cache.enabled:
         hit = cache.get(cache_key)
         if hit is not None:
@@ -503,5 +553,6 @@ def stage_stream(source: Iterable, place: Callable[[Any], Any],
             return _CachedRun(hit, name)
         rec = _CacheRecorder(cache, cache_key, place, cache_validator)
         return StagedStream(source, rec, depth=depth, name=name,
-                            on_complete=rec.complete)
-    return StagedStream(source, place, depth=depth, name=name)
+                            on_complete=rec.complete, scope=scope)
+    return StagedStream(source, place, depth=depth, name=name,
+                        scope=scope)

@@ -472,15 +472,42 @@ class PagedColumns:
 # ----------------------------------------------- grace-hash partitioning
 _grace_ids = itertools.count()
 
+#: Fibonacci-multiply constant (golden-ratio reciprocal in 64 bits) —
+#: the splitmix64 first-stage multiplier
+_KEY_MIX_MULT = np.uint64(0x9E3779B97F4A7C15)
+
+
+def mix_partition_key(kv: np.ndarray) -> np.ndarray:
+    """Avalanche a key column before the partition modulus (uint64).
+
+    Bare ``key % nparts`` collapses clustered/strided key sets: keys
+    sharing a factor with ``nparts`` (every ``k*nparts``-strided id
+    column does) land in a handful of partitions, re-inflating the
+    per-partition build table that must be device-resident — the
+    grace-hash memory bound degrades toward the full build side. A
+    Fibonacci multiply + xor-shift (splitmix-style finalizer) spreads
+    any key structure uniformly; applied identically on BOTH the build
+    and the probe side (both stream through
+    :func:`partition_by_key`), so matching keys still meet in the same
+    partition — the reference hash-partitions both sides the same way
+    (``PipelineStage.cc`` partition stage)."""
+    h = np.asarray(kv).astype(np.int64).view(np.uint64) * _KEY_MIX_MULT
+    h ^= h >> np.uint64(29)
+    h *= np.uint64(0xBF58476D1CE4E5B9)
+    h ^= h >> np.uint64(32)
+    return h
+
 
 def partition_by_key(pc: PagedColumns, key: str, nparts: int,
                      keep_rowid: bool = False,
                      columns: Optional[Tuple[str, ...]] = None
                      ) -> List[Optional[PagedColumns]]:
     """ONE streaming pass over ``pc``, hash-partitioning its valid rows
-    by ``key % nparts`` into ``nparts`` spill relations in the SAME
-    arena — the reference's partition stage writing both join sides
-    through the partitioned hash-set manager
+    by ``mix(key) % nparts`` (:func:`mix_partition_key` — both join
+    sides mix identically, so clustered/strided keys keep the
+    per-partition memory bound) into ``nparts`` spill relations in the
+    SAME arena — the reference's partition stage writing both join
+    sides through the partitioned hash-set manager
     (``src/queryExecution/source/PipelineStage.cc:1652-1728``,
     ``HashSetManager.h``). Per-partition output buffers flush to arena
     pages at the relation's row_block (bounded host memory: nparts ×
@@ -523,7 +550,9 @@ def partition_by_key(pc: PagedColumns, key: str, nparts: int,
                 cols["_rowid0"] = np.arange(
                     start, start + n, dtype=np.int32)
             kv = cols[key]
-            pid = np.where(kv >= 0, kv % nparts, 0)
+            pid = np.where(kv >= 0,
+                           (mix_partition_key(kv)
+                            % np.uint64(nparts)).astype(np.int64), 0)
             for p in np.unique(pid):
                 sel = pid == p
                 for name, c in cols.items():

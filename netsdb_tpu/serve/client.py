@@ -41,6 +41,7 @@ from netsdb_tpu.serve.errors import (  # noqa: F401 — re-exported API
     classify_remote,
 )
 from netsdb_tpu.serve.protocol import (
+    CLIENT_ID_KEY,
     CODEC_MSGPACK,
     CODEC_PICKLE,
     IDEMPOTENCY_KEY,
@@ -149,7 +150,10 @@ class RemoteClient:
                  replicas: Optional[Sequence[str]] = None,
                  hedge_delay_s: Optional[float] = None,
                  ingest_window: int = 4,
-                 ingest_chunk_bytes: int = 8 << 20):
+                 ingest_chunk_bytes: int = 8 << 20,
+                 client_id: Optional[str] = None,
+                 trace_sample: Optional[int] = None,
+                 ship_traces: bool = True):
         """``timeout``: socket-level timeout applied to every blocking
         recv after the handshake (None = block; a hung server then
         surfaces as :class:`RemoteTimeoutError` instead of a wedged
@@ -176,7 +180,25 @@ class RemoteClient:
         pipeline knobs — ``send_data``/``send_table`` stream large
         payloads as ~``ingest_chunk_bytes`` chunks with up to
         ``ingest_window`` chunks in flight before waiting on acks
-        (depth-W pipelining, not stop-and-wait)."""
+        (depth-W pipelining, not stop-and-wait).
+
+        ``client_id``: the identity (tenant/service string) attached to
+        every frame (``protocol.CLIENT_ID_KEY``); the daemon aggregates
+        staged bytes, device-cache traffic and executor chunk counts
+        per (client, db:set) — visible in COLLECT_STATS'
+        ``attribution`` section. None = unattributed ("anon" daemon
+        bucket).
+
+        ``trace_sample``: mint a query id (and therefore pay
+        end-to-end tracing) for 1 in N query-shaped requests —
+        ``obs.sample_qid``. None takes ``DEFAULT_CONFIG.
+        obs_trace_sample``; 1 traces everything. ``ship_traces``: after
+        a traced request completes, ship the client's span profile to
+        the daemon (PUT_TRACE, on a background shipper thread over its
+        own connection — never the request critical path) so GET_TRACE
+        returns one merged client→leader→follower decomposition;
+        best-effort — a lost ship costs the client section, never the
+        request. :meth:`flush_traces` drains the queue."""
         host, _, port = address.rpartition(":")
         self.host = host or "127.0.0.1"
         self.port = int(port)
@@ -209,6 +231,24 @@ class RemoteClient:
         self.hedges_won = 0
         self.ingest_window = max(1, int(ingest_window))
         self.ingest_chunk_bytes = max(64 << 10, int(ingest_chunk_bytes))
+        self.client_id = client_id
+        if trace_sample is None:
+            from netsdb_tpu.config import DEFAULT_CONFIG
+
+            trace_sample = getattr(DEFAULT_CONFIG, "obs_trace_sample", 1)
+        self._trace_sample = max(1, int(trace_sample))
+        # own sampler phase: the process-default sampler would
+        # phase-lock under interleaved clients (obs.QidSampler
+        # docstring) — per-client state keeps trace_sample=N meaning
+        # exactly 1-in-N of THIS client's requests
+        self._qid_sampler = obs.QidSampler()
+        self.ship_traces = bool(ship_traces)
+        # background PUT_TRACE shipper (lazy): completed client traces
+        # queue here and ship over a dedicated connection OFF the
+        # request critical path
+        self._ship_mu = threading.Lock()
+        self._ship_q: Optional["_queue.Queue"] = None
+        self._ship_thread: Optional[threading.Thread] = None
         # thread id that currently drives a streaming reply (scan_stream
         # / chunked pulls) — a nested request from that thread must NOT
         # wait on the lock (self-deadlock) nor write to the streaming
@@ -386,25 +426,38 @@ class RemoteClient:
                  codec: int = CODEC_MSGPACK,
                  deadline_s: Optional[float] = None) -> Any:
         """One logical request: attach an idempotency token to mutating
-        frames, mint a query id for query-shaped frames (the trace the
-        daemon's spans join on), then retry under
-        :meth:`_retry_driver`."""
-        if msg_type in MUTATING_TYPES and isinstance(payload, dict) \
-                and IDEMPOTENCY_KEY not in payload:
-            # one token per LOGICAL request: every retry resends the
-            # same token, so the server can dedupe a mutation whose
-            # first reply was lost mid-wire
-            payload = dict(payload)
-            payload[IDEMPOTENCY_KEY] = uuid.uuid4().hex
+        frames and this client's identity to every frame, mint a
+        SAMPLED query id for query-shaped frames (the trace the
+        daemon's spans join on — 1 in ``trace_sample``), then retry
+        under :meth:`_retry_driver`. A traced request ships its client
+        span profile to the daemon afterwards (PUT_TRACE,
+        best-effort)."""
+        if isinstance(payload, dict):
+            extra = {}
+            if msg_type in MUTATING_TYPES \
+                    and IDEMPOTENCY_KEY not in payload:
+                # one token per LOGICAL request: every retry resends the
+                # same token, so the server can dedupe a mutation whose
+                # first reply was lost mid-wire
+                extra[IDEMPOTENCY_KEY] = uuid.uuid4().hex
+            if self.client_id is not None \
+                    and CLIENT_ID_KEY not in payload:
+                extra[CLIENT_ID_KEY] = str(self.client_id)
+            if extra:
+                payload = dict(payload)
+                payload.update(extra)
         qid = None
         if msg_type in TRACED_TYPES and isinstance(payload, dict) \
                 and QUERY_ID_KEY not in payload and obs.enabled():
-            # one id per LOGICAL query (retries reuse it); a payload
-            # already carrying a qid is a forwarded frame (the leader's
-            # mirror path) — its originating client owns the trace
-            qid = obs.new_query_id()
-            payload = dict(payload)
-            payload[QUERY_ID_KEY] = qid
+            # one id per LOGICAL query (retries reuse it), minted 1-in-N
+            # (config.obs_trace_sample via the constructor) so high-QPS
+            # traffic traces at bounded cost; a payload already carrying
+            # a qid is a forwarded frame (the leader's mirror path) —
+            # its originating client owns the trace
+            qid = self._qid_sampler.sample(self._trace_sample)
+            if qid is not None:
+                payload = dict(payload)
+                payload[QUERY_ID_KEY] = qid
         oneshot = self._stream_owner == threading.get_ident()
 
         def attempt(io_timeout):
@@ -420,8 +473,98 @@ class RemoteClient:
 
         if qid is None:
             return self._retry_driver(attempt, deadline_s)
-        with obs.trace(qid, origin="client"):
-            return self._retry_driver(attempt, deadline_s)
+        with obs.trace(qid, origin="client") as tr:
+            out = self._retry_driver(attempt, deadline_s)
+        if tr is not None and self.ship_traces:
+            # the trace closed on context exit (total_s final): ship
+            # the client half so the daemon's GET_TRACE returns one
+            # merged end-to-end profile
+            self._ship_trace(qid, tr)
+        return out
+
+    def _ship_trace(self, qid: str, tr) -> None:
+        """Queue a completed client trace for the background shipper —
+        NEVER on the caller's critical path: at ``trace_sample=1`` a
+        synchronous PUT_TRACE would add a full extra RPC to every
+        request (doubling client-observed latency for small warm
+        queries). Best-effort end to end: a full queue drops the
+        profile (``trace_ship_dropped``), ship failures are counted,
+        neither ever surfaces to the request that produced the trace.
+        :meth:`flush_traces` waits for the queue to drain (tests,
+        orderly shutdown)."""
+        with self._ship_mu:
+            if self._ship_q is None:
+                self._ship_q = _queue.Queue(maxsize=64)
+                self._ship_thread = threading.Thread(
+                    target=self._ship_loop, args=(self._ship_q,),
+                    daemon=True, name="netsdb-trace-ship")
+                self._ship_thread.start()
+            q = self._ship_q
+        try:
+            q.put_nowait({"qid": qid, "profile": tr.profile()})
+        except _queue.Full:
+            obs.REGISTRY.counter("serve.client.trace_ship_dropped").inc()
+
+    def _ship_loop(self, q: "_queue.Queue") -> None:
+        """Shipper thread body: drain queued profiles over its own
+        dedicated connection (the main connection and its lock stay
+        untouched — a ship can never interleave with a stream or block
+        a request). The socket persists across ships and re-dials
+        after any failure. ``q`` is bound at spawn — ``close()`` nulls
+        the instance attribute, and this loop must keep draining to
+        its sentinel regardless."""
+        sock = None
+        try:
+            while True:
+                item = q.get()
+                try:
+                    if item is None:
+                        return  # close() sentinel
+                    try:
+                        if sock is None:
+                            sock = self._dial()
+                        send_frame(sock, MsgType.PUT_TRACE, item,
+                                   CODEC_MSGPACK, chaos=self._chaos)
+                        typ, reply = self._recv_reply(sock)
+                        if typ == MsgType.ERR:
+                            raise classify_remote(reply)
+                        obs.REGISTRY.counter(
+                            "serve.client.traces_shipped").inc()
+                    except Exception as e:  # noqa: BLE001 — counted
+                        obs.REGISTRY.counter(
+                            "serve.client.trace_ship_failures").inc()
+                        del e
+                        if sock is not None:
+                            try:
+                                sock.close()
+                            except OSError:
+                                pass
+                            sock = None
+                finally:
+                    q.task_done()
+        finally:
+            if sock is not None:
+                try:
+                    sock.close()
+                except OSError:
+                    pass
+
+    def flush_traces(self, timeout_s: float = 5.0) -> bool:
+        """Wait until every queued client trace has shipped (or
+        failed), up to ``timeout_s``; True when the queue drained. The
+        request path never waits — this is for tests and orderly
+        shutdown."""
+        q = self._ship_q
+        if q is None:
+            return True
+        deadline = time.monotonic() + timeout_s
+        with q.all_tasks_done:
+            while q.unfinished_tasks:
+                left = deadline - time.monotonic()
+                if left <= 0:
+                    return False
+                q.all_tasks_done.wait(left)
+        return True
 
     # --- windowed bulk ingest (BULK_BEGIN/CHUNK/COMMIT) ---------------
     def _bulk_once(self, sock: socket.socket, begin: dict,
@@ -479,6 +622,8 @@ class RemoteClient:
         connection (same rule as nested plain requests)."""
         token = uuid.uuid4().hex
         begin = {"op": int(op), "meta": meta, IDEMPOTENCY_KEY: token}
+        if self.client_id is not None:
+            begin[CLIENT_ID_KEY] = str(self.client_id)
 
         def attempt(io_timeout):
             if self._stream_owner == threading.get_ident():
@@ -637,6 +782,20 @@ class RemoteClient:
                 pass
 
     def close(self) -> None:
+        with self._ship_mu:
+            q, t = self._ship_q, self._ship_thread
+            self._ship_q = None
+            self._ship_thread = None
+        if q is not None:
+            # give in-flight ships a bounded grace, then stop the
+            # shipper (daemon thread — an unreachable server can't
+            # wedge close)
+            try:
+                q.put_nowait(None)
+            except _queue.Full:
+                pass
+            if t is not None:
+                t.join(timeout=2.0)
         with self._lock:
             self._drop_connection()
 
@@ -1096,7 +1255,16 @@ class RemoteClient:
         configured, streams hedge their FIRST item over dedicated
         connections (:meth:`_stream_hedged`) — the persistent
         connection and its lock stay untouched, so nested requests
-        from the consuming thread need no special-casing."""
+        from the consuming thread need no special-casing.
+
+        Streams bypass :meth:`_request`, so the client identity is
+        attached HERE — the heaviest read path must attribute like any
+        other frame (scan batches book under this tenant's
+        ``requests``/scan work, not ``anon``)."""
+        if self.client_id is not None and isinstance(payload, dict) \
+                and CLIENT_ID_KEY not in payload:
+            payload = dict(payload)
+            payload[CLIENT_ID_KEY] = str(self.client_id)
         if self._replicas and self._stream_owner != threading.get_ident():
             yield from self._stream_hedged(msg_type, payload)
             return
@@ -1221,11 +1389,25 @@ class RemoteClient:
         return self._request(MsgType.COLLECT_STATS, {})
 
     def get_trace(self, last: Optional[int] = None,
-                  qid: Optional[str] = None) -> Dict[str, Any]:
+                  qid: Optional[str] = None,
+                  slow: bool = False) -> Dict[str, Any]:
         """Completed query trace profiles from the daemon's ring
         (newest last). ``qid`` filters to one query; ``last`` bounds
         the count. On a leader, profiles carry ``followers`` sections
         merged by query id (one logical query decomposed across every
-        daemon that ran it)."""
+        daemon that ran it) and — for queries whose client shipped its
+        spans via PUT_TRACE — a ``client`` section with the send/wait
+        decomposition. ``slow=True`` reads the persisted slow-query
+        ring (``<root>/slowlog/``) instead: the outliers that survived
+        ring rotation and daemon restarts."""
         return self._request(MsgType.GET_TRACE,
-                             {"last": last, "qid": qid})
+                             {"last": last, "qid": qid,
+                              "slow": bool(slow)})
+
+    def health(self) -> Dict[str, Any]:
+        """The daemon's SLO/health readout (obs/slo.py): evaluated
+        objectives with multi-window burn rates, recent
+        breach/recovery events and the slowlog summary; leaders merge
+        follower sections (best-effort — a slow follower reports an
+        error entry, never gets evicted by a health read)."""
+        return self._request(MsgType.HEALTH, {})

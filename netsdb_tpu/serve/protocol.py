@@ -139,6 +139,16 @@ class MsgType(IntEnum):
     # profiles from the daemon's ring buffer (obs/trace.TraceRing);
     # the leader merges follower sections by query id
     GET_TRACE = 44
+    # the CLIENT ships its side of a traced query (send/wait/hedge
+    # spans) to the daemon after the reply lands; the daemon merges it
+    # into the qid's ringed profile, so GET_TRACE returns ONE
+    # end-to-end client->leader->follower decomposition. Best-effort:
+    # a lost PUT_TRACE costs a client section, never the query.
+    PUT_TRACE = 45
+    # SLO/health readout (obs/slo.py): evaluated objectives with
+    # multi-window burn rates + breach events + slowlog summary;
+    # the leader merges follower sections like COLLECT_STATS
+    HEALTH = 46
     # multi-host reads: a master assembling a mesh-spanning array asks
     # each follower for ITS addressable shards (index ranges + bytes) —
     # the reference streaming each node's local pages to the frontend
@@ -177,6 +187,16 @@ IDEMPOTENCY_KEY = "__idem__"
 #: forwards — so one logical query's spans join up across the client,
 #: the leader and every follower (queryable via GET_TRACE).
 QUERY_ID_KEY = "__qid__"
+
+#: payload key carrying the client identity (an operator-chosen string,
+#: e.g. a tenant or service name) on every frame a RemoteClient built
+#: with ``client_id=...`` sends. The server pops it before dispatch and
+#: installs it for the handler's dynamic extent
+#: (``obs/attrib.client_context``), so staged bytes, device-cache
+#: traffic and executor chunk counts aggregate per (client, db:set) —
+#: the accounting the multi-tenant scheduler admits against. Mirrored
+#: forwards re-attach it so followers attribute the same way.
+CLIENT_ID_KEY = "__client__"
 
 #: frame types that mutate daemon state or launch jobs — the set the
 #: client attaches idempotency tokens to before retrying. Reads are

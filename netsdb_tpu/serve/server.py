@@ -19,6 +19,7 @@ overcommitting the controller.
 
 from __future__ import annotations
 
+import contextlib
 import importlib
 import importlib.util
 import inspect
@@ -43,6 +44,7 @@ from netsdb_tpu.serve.errors import (
     RequestInFlight,
 )
 from netsdb_tpu.serve.protocol import (
+    CLIENT_ID_KEY,
     CODEC_MSGPACK,
     CODEC_PICKLE,
     IDEMPOTENCY_KEY,
@@ -59,6 +61,13 @@ from netsdb_tpu.serve.protocol import (
 )
 from netsdb_tpu.storage.store import SetIdentifier
 from netsdb_tpu.utils.timing import deadline_after, seconds_left, wall_now
+
+#: introspection/meta frame types — excluded from the serve.requests/
+#: serve.requests_ok counters and the serve.request_s histogram the
+#: SLO engine evaluates (monitoring must not move the SLOs it reads)
+OBS_FRAMES = frozenset({MsgType.PING, MsgType.COLLECT_STATS,
+                        MsgType.GET_TRACE, MsgType.PUT_TRACE,
+                        MsgType.HEALTH})
 
 
 def resolve_entry_point(entry: str, source: Optional[str] = None) -> Any:
@@ -604,6 +613,22 @@ class ServeController:
         self._obs_enabled = bool(getattr(config, "obs_enabled", True))
         self.trace_ring = obs.TraceRing(
             getattr(config, "obs_trace_ring", 64) or 64)
+        # the ACTIVE observability layer (this PR): SLO/health engine
+        # over the registry (HEALTH frame), the bounded on-disk
+        # slow-query ring, and the opt-in per-qid device profiler
+        from netsdb_tpu.obs.slo import SLOEngine
+        from netsdb_tpu.obs.slowlog import SlowQueryLog
+
+        self.slo = SLOEngine()
+        self.slowlog = SlowQueryLog(
+            config.root_dir,
+            capacity=getattr(config, "obs_slowlog_entries", 64) or 64,
+            threshold_s=getattr(config, "obs_slow_query_s", None))
+        self._device_profile_dir = getattr(
+            config, "obs_device_profile_dir", None)
+        # one jax.profiler session at a time: concurrent traced queries
+        # SKIP (non-blocking acquire), never queue behind the profiler
+        self._profiler_mu = threading.Lock()
         self.library = Client(config)  # the resident state
         # ORDERING MODEL for mirrored frames (the SPMD argument):
         # - _mirror_lock is held only long enough to ENQUEUE a frame
@@ -666,6 +691,8 @@ class ServeController:
             MsgType.LIST_JOBS: self._on_list_jobs,
             MsgType.COLLECT_STATS: self._on_collect_stats,
             MsgType.GET_TRACE: self._on_get_trace,
+            MsgType.PUT_TRACE: self._on_put_trace,
+            MsgType.HEALTH: self._on_health,
             MsgType.ANALYZE_SET: self._on_analyze_set,
             MsgType.LOCAL_SHARDS: self._on_local_shards,
             MsgType.PAGED_MATMUL: self._on_paged_matmul,
@@ -836,31 +863,148 @@ class ServeController:
         it, staging and the device cache all report spans/counters
         into it, and the completed profile lands in this daemon's
         GET_TRACE ring — the ``-DPROFILING`` decomposition, per query,
-        always on (``config.obs_enabled`` is the kill switch)."""
+        always on (``config.obs_enabled`` is the kill switch).
+
+        Around the trace, the ACTIVE layer: every workload frame
+        (``OBS_FRAMES`` excluded) ticks the request counters at
+        OUTCOME time + the latency histogram the SLO engine evaluates;
+        a frame carrying a client identity attributes its handler's
+        resource use per (client, set); a traced query may capture an
+        opt-in ``jax.profiler`` session; and a trace whose total
+        exceeds ``obs_slow_query_s`` persists to the on-disk slowlog
+        ring after it closes."""
         qid = payload.pop(QUERY_ID_KEY, None) \
             if isinstance(payload, dict) else None
+        client = payload.pop(CLIENT_ID_KEY, None) \
+            if isinstance(payload, dict) else None
+        # introspection frames are EXCLUDED from the request counters
+        # and latency histogram (t0=None): the SLOs those instruments
+        # feed must measure the workload, not the monitoring of it —
+        # a 10s HEALTH poll plus a per-query PUT_TRACE shipper would
+        # otherwise flood the p99 sample ring with microsecond
+        # dispatches and mask real slow queries
+        t0 = None if typ in OBS_FRAMES else time.perf_counter()
         if qid is None or not self._obs_enabled:
-            return self._dispatch_traced(conn, typ, codec_in, payload, None)
+            return self._dispatch_traced(conn, typ, codec_in,
+                                         payload, None, client, t0)
         with obs.trace(str(qid), origin="server",
                        ring=self.trace_ring) as tr:
             if tr is not None:
-                # the body decode finished before the trace could open:
-                # back-date the trace so the decode span occupies real
-                # timeline [0, decode_s] AHEAD of the dispatch span
-                # (and total_s covers it) instead of overlapping it
+                # the body decode finished before the trace could
+                # open: back-date the trace so the decode span
+                # occupies real timeline [0, decode_s] AHEAD of the
+                # dispatch span (and total_s covers it) instead of
+                # overlapping it
                 tr.backdate(decode_s)
-                tr.record("server.decode", decode_s, "serve", start_s=0.0)
+                tr.record("server.decode", decode_s, "serve",
+                          start_s=0.0)
                 tr.add("frame.decode_s", decode_s)
-            return self._dispatch_traced(conn, typ, codec_in, payload,
-                                         str(qid))
+                if client is not None:
+                    tr.annotate("client", str(client))
+            with self._maybe_device_profile(tr):
+                ok = self._dispatch_traced(conn, typ, codec_in,
+                                           payload, str(qid), client,
+                                           t0)
+        if tr is not None:
+            # the trace closed on context exit — total_s is final
+            self._maybe_slowlog(tr)
+        return ok
 
-    def _dispatch_traced(self, conn, typ, codec_in, payload, qid) -> bool:
+    @contextlib.contextmanager
+    def _maybe_device_profile(self, tr):
+        """Opt-in per-qid ``jax.profiler`` session
+        (``config.obs_device_profile_dir``): the REAL device half of a
+        traced query, captured into ``<dir>/<qid>`` for
+        TensorBoard/XProf. One session at a time — a concurrent traced
+        query skips (non-blocking acquire) rather than queueing the
+        serve path behind the profiler; profiler failures annotate the
+        trace and never fail the query."""
+        if (tr is None or not self._device_profile_dir
+                or not self._profiler_mu.acquire(blocking=False)):
+            yield
+            return
+        sess = None
+        try:
+            try:
+                from netsdb_tpu.utils.profiling import qid_profile_session
+
+                sess = qid_profile_session(tr.qid,
+                                           self._device_profile_dir)
+                tr.annotate("device_profile", sess.__enter__())
+            except Exception as e:  # noqa: BLE001 — annotated, not fatal
+                tr.annotate("device_profile_error",
+                            f"{type(e).__name__}: {e}")
+                sess = None
+            try:
+                yield
+            finally:
+                if sess is not None:
+                    try:
+                        sess.__exit__(None, None, None)
+                    except Exception as e:  # noqa: BLE001 — annotated
+                        tr.annotate("device_profile_error",
+                                    f"{type(e).__name__}: {e}")
+        finally:
+            self._profiler_mu.release()
+
+    def _maybe_slowlog(self, tr) -> None:
+        """Persist a just-closed slow trace to the on-disk ring (the
+        structured slow-query log). Prefers the RINGED profile over
+        ``tr.profile()``: a client section shipped before the ring
+        push (TraceRing's pending buffer) is already folded into the
+        ringed copy but absent from a fresh profile(). Never fails
+        the request path."""
+        try:
+            # threshold gate FIRST: almost every traced request is
+            # fast, and the ring find is an O(capacity) scan under
+            # the ring mutex — don't pay it just to reject
+            thr = self.slowlog.threshold_s
+            if not thr or tr.total_s is None or tr.total_s < thr:
+                return
+            ringed = self.trace_ring.find(tr.qid)
+            self.slowlog.maybe_record(ringed[-1] if ringed
+                                      else tr.profile())
+        except Exception as e:  # noqa: BLE001 — counted, never fatal
+            obs.REGISTRY.counter("obs.slowlog_errors").inc()
+            del e
+
+    def _dispatch_traced(self, conn, typ, codec_in, payload, qid,
+                         client=None, t0=None) -> bool:
         """The dispatch body (trace context, if any, already
         installed). Returns False when the connection is dead. Mutating
         frames carrying an idempotency token are deduplicated here: a
         retry of a COMPLETED request replays the cached reply without
         re-running the handler — the at-most-once half of the client's
-        retry contract."""
+        retry contract.
+
+        ``t0`` anchors the ``serve.request_s`` histogram (the p99
+        SLO's feed): unary frames observe through the reply send,
+        streaming frames observe TIME TO FIRST FRAME — a multi-GB scan
+        drain rides the client's consumption rate, and folding tens of
+        seconds of TCP backpressure into "request latency" would make
+        the p99 objective breach on perfectly healthy bulk reads.
+        ``t0`` is None for introspection frames (``OBS_FRAMES``) —
+        they observe nothing and count nowhere."""
+        observed = [False]
+
+        def mark():
+            if not observed[0] and t0 is not None:
+                observed[0] = True
+                obs.REGISTRY.histogram("serve.request_s").observe(
+                    time.perf_counter() - t0)
+
+        def done(ok):
+            # availability counts BOTH sides at outcome time: ticking
+            # serve.requests at dispatch start read every in-flight
+            # request as a failure — one long EXECUTE in a low-QPS
+            # window pushed good/total under the 0.999 target and
+            # flapped breach events with zero real errors
+            if t0 is None:
+                return
+            obs.REGISTRY.counter("serve.requests").inc()
+            if ok:
+                obs.REGISTRY.counter("serve.requests_ok").inc()
+
         token = payload.pop(IDEMPOTENCY_KEY, None) \
             if isinstance(payload, dict) else None
         try:
@@ -869,11 +1013,13 @@ class ServeController:
                 if cached is not None:
                     reply_type, reply, codec = cached
                     self._send_reply(conn, reply_type, reply, codec)
+                    mark()
+                    done(True)
                     return True
             with obs.span(f"server.dispatch:{getattr(typ, 'name', typ)}",
                           "serve"):
                 out = self._execute_frame(typ, payload, codec_in, token,
-                                          qid=qid)
+                                          qid=qid, client=client)
             if inspect.isgenerator(out):
                 # streaming handler: each yielded (type, payload
                 # [, codec]) goes out as its own frame; TCP
@@ -891,16 +1037,26 @@ class ServeController:
                     else:
                         (f_type, f_payload), f_codec = frame, CODEC_MSGPACK
                     self._send_reply(conn, f_type, f_payload, f_codec)
+                    mark()  # first frame = the latency that matters
+                mark()  # empty stream: observe at STREAM_END
+                done(True)
                 return True
             with obs.span("server.reply", "serve"):
                 self._send_reply(conn, *out)
+            mark()
+            done(True)
             return True
         except BrokenPipeError:
+            mark()
+            done(False)  # died mid-reply: dispatched, not answered OK
             return False
         except Exception as e:  # handler errors go back as typed ERR
+            mark()
+            done(False)
             return self._send_err(conn, e, with_traceback=True)
 
-    def _execute_frame(self, typ, payload, codec_in, token, qid=None):
+    def _execute_frame(self, typ, payload, codec_in, token, qid=None,
+                       client=None):
         """Run one request's handler with the idempotency-token
         lifecycle (the caller has already claimed ``token``). Returns a
         generator (streaming handlers) or the normalized ``(type,
@@ -908,16 +1064,27 @@ class ServeController:
         finished or aborted exactly once. Shared by the per-frame
         dispatch and the bulk-ingest COMMIT. ``qid`` (the client's
         query id, already popped) rides mirrored forwards so follower
-        traces share the leader's id."""
+        traces share the leader's id; ``client`` (the frame's client
+        identity, already popped) likewise — and is installed for the
+        handler's dynamic extent so every instrumented layer below
+        attributes its resource use per (client, db:set)."""
         handler = self.handlers.get(typ)
+        if client is not None or isinstance(payload, dict):
+            scope = None
+            if isinstance(payload, dict) and payload.get("db") \
+                    and payload.get("set"):
+                scope = f"{payload['db']}:{payload['set']}"
+            obs.attrib.account("requests", 1, scope=scope, client=client)
         try:
             if handler is None:
                 raise ProtocolError(f"no handler for {typ!r}")
-            if self._follower_addrs and typ in self.MIRRORED:
-                out = self._run_mirrored(typ, payload, codec_in, handler,
-                                         token=token, qid=qid)
-            else:
-                out = handler(payload)
+            with obs.attrib.client_context(client):
+                if self._follower_addrs and typ in self.MIRRORED:
+                    out = self._run_mirrored(typ, payload, codec_in,
+                                             handler, token=token,
+                                             qid=qid, client=client)
+                else:
+                    out = handler(payload)
         except FollowerDegraded as e:
             # the LOCAL mutation applied; only the mirror failed.
             # Cache the local reply under the token so the client's
@@ -988,6 +1155,8 @@ class ServeController:
         except (ProtocolError, ValueError) as e:
             return self._send_err(conn, e, retryable=False)
         token = p.get(IDEMPOTENCY_KEY)
+        client = p.get(CLIENT_ID_KEY)  # one identity for the whole
+        # conversation — the COMMIT's apply attributes under it
         if token is not None:
             try:
                 cached = self._idem.claim(token, wait_s=self.frame_timeout_s)
@@ -1045,7 +1214,8 @@ class ServeController:
                     final_payload, fwd_codec = asm.finish()
                     owned = False  # _execute_frame consumes the token
                     result = self._execute_frame(op, final_payload,
-                                                 fwd_codec, token)
+                                                 fwd_codec, token,
+                                                 client=client)
                     self._send_reply(conn, *result)
                     return True
                 else:
@@ -1397,7 +1567,7 @@ class ServeController:
                                               threading.Lock())
 
     def _run_mirrored(self, typ, payload, codec, handler, token=None,
-                      qid=None):
+                      qid=None, client=None):
         """Execute one mutating/job frame on EVERY process, holding the
         frame's ORDERING lock across both the follower enqueue and the
         local handler (see the ordering model in ``__init__`` — the
@@ -1422,25 +1592,25 @@ class ServeController:
             # true SPMD: one total order for everything mirrored
             with self._collective_lock:
                 return self._mirror_once(typ, payload, codec, handler,
-                                         token, qid)
+                                         token, qid, client)
         if typ in self.SET_SCOPED_FRAMES and "db" in payload \
                 and "set" in payload:
             self._order.acquire_read()
             try:
                 with self._set_lock(payload["db"], payload["set"]):
                     return self._mirror_once(typ, payload, codec, handler,
-                                             token, qid)
+                                             token, qid, client)
             finally:
                 self._order.release_read()
         self._order.acquire_write()
         try:
             return self._mirror_once(typ, payload, codec, handler, token,
-                                     qid)
+                                     qid, client)
         finally:
             self._order.release_write()
 
     def _mirror_once(self, typ, payload, codec, handler, token=None,
-                     qid=None):
+                     qid=None, client=None):
         # forward the CLIENT's idempotency token (popped before
         # dispatch) so followers dedupe too: if the local handler fails
         # retryably AFTER the forward (e.g. AdmissionFull), the
@@ -1448,14 +1618,18 @@ class ServeController:
         # token each follower would apply it twice and diverge.
         # The query id rides along for the same reason traces exist:
         # one logical query's spans must join up across every daemon
-        # that executed it (GET_TRACE merges them by qid).
+        # that executed it (GET_TRACE merges them by qid) — and the
+        # client identity likewise, so follower-side attribution books
+        # the same tenant the leader does.
         fwd = payload
-        if token is not None or qid is not None:
+        if token is not None or qid is not None or client is not None:
             fwd = dict(payload)
             if token is not None:
                 fwd[IDEMPOTENCY_KEY] = token
             if qid is not None:
                 fwd[QUERY_ID_KEY] = qid
+            if client is not None:
+                fwd[CLIENT_ID_KEY] = client
         with self._mirror_lock:  # short: dial + ordered enqueue only
             self._ensure_followers()
             with self._followers_mu:
@@ -1792,8 +1966,13 @@ class ServeController:
             elif isinstance(item, PagedObjects):
                 # record pages stream as records (the handle is
                 # process-local; in the STREAMED scan these pack into
-                # adaptive bounded frames like any object items)
-                yield from item
+                # adaptive bounded frames like any object items).
+                # closing(): the record generator holds the relation's
+                # read lock — a client abandoning the scan mid-stream
+                # (this generator is then closed, not exhausted) must
+                # release it NOW, not when GC finds the frame
+                with contextlib.closing(iter(item)) as records:
+                    yield from records
             elif isinstance(item, _PagedMatrix):
                 # the handle is process-local (it wraps the native
                 # arena + a lock); the matrix itself deliberately never
@@ -2103,14 +2282,75 @@ class ServeController:
                 out["followers"] = followers
         return MsgType.OK, out
 
+    def _on_put_trace(self, p):
+        """Client half of a traced query arriving after its reply: the
+        RemoteClient ships its send/wait/hedge span profile once the
+        logical request completes, and it merges into the qid's ringed
+        profile as the ``client`` section — GET_TRACE then returns one
+        end-to-end client→leader→follower decomposition. Best-effort
+        by design (an unmatched qid — ring already rotated — is
+        counted, not an error)."""
+        prof = p.get("profile")
+        if not isinstance(prof, dict):
+            raise ProtocolError("PUT_TRACE needs a profile dict")
+        qid = str(p.get("qid") or prof.get("qid") or "")
+        merged = slow = False
+        if qid and self._obs_enabled:
+            merged = self.trace_ring.merge_section(qid, "client", prof)
+            try:
+                # a slow query persisted its profile when the trace
+                # closed — before this section could exist; rewrite it
+                slow = self.slowlog.merge_section(qid, "client", prof)
+            except Exception as e:  # noqa: BLE001 — counted, never fatal
+                obs.REGISTRY.counter("obs.slowlog_errors").inc()
+                del e
+        obs.REGISTRY.counter(
+            "obs.put_trace.merged" if merged
+            else "obs.put_trace.unmatched").inc()
+        return MsgType.OK, {"merged": merged, "slowlog_merged": slow}
+
+    def _on_health(self, p):
+        """The SLO/health readout: every objective evaluated with
+        multi-window burn rates (obs/slo.py), recent breach/recovery
+        events, and the slowlog summary. On a leader, follower
+        sections merge exactly like COLLECT_STATS — best-effort over
+        the ordered links, a slow follower reports an error entry and
+        is NEVER evicted by a health read."""
+        out = {"objectives": self.slo.evaluate(),
+               "events": self.slo.events(),
+               "slowlog": self.slowlog.summary(),
+               "followers_status": self.follower_status()
+               if self._follower_addrs else None}
+        if not p.get("local_only"):
+            followers = self._fanout_read(MsgType.HEALTH,
+                                          {"local_only": True})
+            if followers:
+                out["followers"] = followers
+        return MsgType.OK, out
+
     def _on_get_trace(self, p):
         """The last N completed query profiles from this daemon's ring.
         On a leader, each profile additionally carries the follower
         sections that share its query id (``followers``: addr →
         profiles) — mirrored EXECUTEs forward the qid, so one logical
-        query decomposes across every daemon that ran it."""
+        query decomposes across every daemon that ran it.
+        ``slow: true`` reads the persisted slow-query ring
+        (``<root>/slowlog/``) instead of the in-memory one."""
         n = p.get("last")
         qid = p.get("qid")
+        if p.get("slow"):
+            # qid filter BEFORE the last-N truncation (the in-memory
+            # path's semantics): a persisted slow query must stay
+            # findable by id even after N newer outliers landed
+            profiles = self.slowlog.entries()
+            if qid:
+                profiles = [pr for pr in profiles
+                            if pr.get("qid") == str(qid)]
+            if n:
+                profiles = profiles[-int(n):]
+            return MsgType.OK, {"profiles": profiles,
+                                "enabled": self._obs_enabled,
+                                "slowlog": self.slowlog.summary()}
         if qid:
             profiles = self.trace_ring.find(str(qid))
         else:

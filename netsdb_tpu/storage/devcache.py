@@ -150,8 +150,11 @@ class DeviceBlockCache:
         """The run cached under ``key``, or None (counted as a miss).
         Hits refresh LRU recency. Per-store counters stay on this
         instance (``stats()`` keeps its shape); the process-wide
-        registry and the active query trace get the same tick — the
-        profile's devcache hit/miss decomposition."""
+        registry, the active query trace and the per-(client, set)
+        resource ledger get the same tick — the profile's devcache
+        hit/miss decomposition and the attribution the scheduler
+        admits against. ``devcache.lookups`` (hits + misses in one
+        monotonic counter) feeds the hit-rate SLO (obs/slo.py)."""
         with self._mu:
             if not self.enabled:
                 return None
@@ -162,12 +165,16 @@ class DeviceBlockCache:
             else:
                 self._entries.move_to_end(key)
                 self._stats["hits"] += 1
+        obs.REGISTRY.counter("devcache.lookups").inc()
+        scope = str(key[0])
         if entry is None:
             obs.REGISTRY.counter("devcache.misses").inc()
             obs.add("devcache.misses")
+            obs.attrib.account("devcache.misses", scope=scope)
             return None
         obs.REGISTRY.counter("devcache.hits").inc()
         obs.add("devcache.hits")
+        obs.attrib.account("devcache.hits", scope=scope)
         return entry[0]
 
     def make_room(self, nbytes: int) -> None:
@@ -190,10 +197,15 @@ class DeviceBlockCache:
                 self._stats["rejected"] += 1
 
     def install(self, key: Tuple, blocks: List[Any],
-                validator=None) -> bool:
+                validator=None, client: Optional[str] = None) -> bool:
         """Insert one complete run. Returns False when the run exceeds
         the whole budget (never installed — a set bigger than the cache
         streams every time, it does not thrash everyone else out).
+
+        ``client``: the attributed identity for the per-(client, set)
+        ledger — installs run on STAGING threads, which don't inherit
+        the dispatch context var, so the recorder captures the identity
+        on the consumer thread and passes it here explicitly.
 
         ``validator`` (no-arg → bool) is evaluated INSIDE the cache
         lock: the write path bumps the set version BEFORE invalidating
@@ -219,6 +231,8 @@ class DeviceBlockCache:
             self._stats["installs"] += 1
         obs.REGISTRY.counter("devcache.installs").inc()
         obs.add("devcache.installs")
+        obs.attrib.account("devcache.installs", scope=str(key[0]),
+                           client=client)
         return True
 
     def _evict_to_fit_locked(self, incoming: int) -> None:

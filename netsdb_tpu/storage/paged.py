@@ -27,48 +27,61 @@ from netsdb_tpu.config import Configuration, DEFAULT_CONFIG
 
 
 class _PyPageBackend:
-    """Fallback backend with the same surface as NativePageStore."""
+    """Fallback backend with the same surface as NativePageStore.
+
+    Thread-safe like the native store (its C++ side is mutex-guarded):
+    concurrent writers — two object-set appends no longer serialized by
+    the store-wide lock — must not race the page-id allocation or the
+    per-set page lists."""
 
     def __init__(self):
+        self._mu = threading.Lock()
         self._pages: Dict[int, bytes] = {}
         self._sets: Dict[int, list] = {}
         self._next = 1
 
     def create_set(self, set_id, policy="lru"):
-        self._sets.setdefault(set_id, [])
+        with self._mu:
+            self._sets.setdefault(set_id, [])
 
     def write_page(self, set_id, payload) -> int:
         data = payload if isinstance(payload, bytes) else \
             np.ascontiguousarray(payload).tobytes()
-        pid = self._next
-        self._next += 1
-        self._pages[pid] = data
-        self._sets[set_id].append(pid)
+        with self._mu:
+            pid = self._next
+            self._next += 1
+            self._pages[pid] = data
+            self._sets[set_id].append(pid)
         return pid
 
     def read_page(self, page_id) -> bytes:
-        return self._pages[page_id]
+        with self._mu:
+            return self._pages[page_id]
 
     def free_page(self, page_id) -> None:
-        self._pages.pop(page_id, None)
-        for pages in self._sets.values():
-            if page_id in pages:
-                pages.remove(page_id)
+        with self._mu:
+            self._pages.pop(page_id, None)
+            for pages in self._sets.values():
+                if page_id in pages:
+                    pages.remove(page_id)
 
     def set_pages(self, set_id):
-        return list(self._sets[set_id])
+        with self._mu:
+            return list(self._sets[set_id])
 
     def page_size(self, page_id) -> int:
-        return len(self._pages[page_id])
+        with self._mu:
+            return len(self._pages[page_id])
 
     def flush_set(self, set_id):
         pass
 
     def stats(self):
+        with self._mu:
+            nbytes = sum(len(v) for v in self._pages.values())
         return {"hits": 0, "misses": 0, "evictions": 0, "spills": 0,
-                "loads": 0,
-                "bytes_allocated": sum(len(v) for v in self._pages.values()),
-                "bytes_in_use": sum(len(v) for v in self._pages.values())}
+                "loads": 0, "bytes_allocated": nbytes,
+                "bytes_in_use": nbytes}
 
     def close(self):
         pass
@@ -146,6 +159,14 @@ class PagedObjects:
         self.name = name
         self.num_items = num_items
         self.rw = RWLock()
+        # serializes concurrent appends against each other; appends
+        # hold rw.READ (not write — see append()) so they never wait
+        # for in-flight record streams to drain. Store-routed appends
+        # additionally hold the set's ``_StoredSet.append_mu`` — that
+        # one orders appends against the store's OTHER per-set
+        # mutations; this one is the handle's own guarantee, so a
+        # direct ``po.append`` (no store in sight) is still safe.
+        self._append_mu = threading.Lock()
         self.dropped = False
         store.backend.create_set(store._set_id(name))
 
@@ -158,12 +179,26 @@ class PagedObjects:
 
     def append(self, items: list) -> None:
         """Write records as additional pickled-batch pages (the
-        reference's addData continuously appending objects)."""
+        reference's addData continuously appending objects).
+
+        LOCKING (advisor round 5): appends only ADD pages — they never
+        touch pages a live record stream is reading (``__iter__``
+        snapshots the page list at its start, and freeing pages is
+        ``drop``'s job, which does take the write lock). So append
+        holds the relation's READ lock (drop exclusion only) plus a
+        per-handle append mutex (order among concurrent appenders),
+        NOT the write lock: it never waits for in-flight streams to
+        drain — a slow wire scan cannot stall ingest, and a consumer
+        appending while iterating the same set no longer
+        self-deadlocks (its own read lock would make ``rw.write()``
+        wait forever). A reader that starts mid-append may observe a
+        prefix of the batch's pages — the same visibility a reader
+        starting between two appends always had."""
         import pickle
 
         if not items:
             return
-        with self.rw.write():
+        with self._append_mu, self.rw.read():
             if self.dropped:
                 raise KeyError(f"paged object set {self.name!r} was "
                                f"dropped; cannot append")

@@ -318,14 +318,27 @@ class SetStore:
 
     # --- data path (ref: StorageAddData / UserSet::addObject) ---------
     def add_data(self, ident: SetIdentifier, items: List[Any]) -> None:
+        """Append/ingest ``items``. Paged OBJECT-set appends follow the
+        same lock discipline as paged-table appends (advisor round 5):
+        the store lock only LOCATES and pins the existing
+        :class:`PagedObjects`; ``po.append`` runs OUTSIDE it under the
+        set's ``append_mu`` — the append may wait on the relation's own
+        locks (a concurrent drop), and that wait must never freeze
+        every unrelated store operation. A concurrent remove/replace
+        drops the pinned handle, making ``po.append`` fail loudly
+        instead of resurrecting freed pages."""
         dead = []
+        po = None
         with self._lock:
             s = self._require(ident)
             if s.alias_of is not None:
                 raise ValueError(f"set {ident} aliases {s.alias_of}; "
                                  f"it is read-only")
             if s.storage == "paged":
-                dead = self._ingest_paged(s, items)
+                po = self._pin_paged_objects(s, items)
+                if po is None:
+                    dead = self._ingest_paged(s, items)
+                    self._touch(s)
             else:
                 if s.items is None:  # evicted: reload before appending
                     self._load_from_spill(s)
@@ -335,8 +348,33 @@ class SetStore:
                 s.nbytes += sum(_item_nbytes(i) for i in items)
                 s.last_access = time.time()
                 self._maybe_evict(exclude=ident)
-            self._touch(s)
+                self._touch(s)
+        if po is not None:
+            with s.append_mu:  # per-set order among concurrent appends
+                po.append(items)
+            with self._lock:
+                if self._sets.get(ident) is s:
+                    s.last_access = time.time()
+                    self._touch(s)
         self._drop_detached(dead)  # replaced pages reclaim UNLOCKED
+
+    @staticmethod
+    def _pin_paged_objects(s: _StoredSet, items: List[Any]):
+        """The existing :class:`PagedObjects` of ``s`` when ``items``
+        are host-object records appending to it, else None (fresh
+        ingest / relation-replace — handled under the store lock,
+        where no streams can exist on a relation that doesn't).
+        Caller holds the store lock."""
+        from netsdb_tpu.relational.outofcore import PagedColumns
+        from netsdb_tpu.relational.table import ColumnTable
+        from netsdb_tpu.storage.paged import PagedObjects
+
+        if not items or isinstance(
+                items[0], (PagedColumns, np.ndarray, BlockedTensor,
+                           ColumnTable)):
+            return None
+        return next((i for i in (s.items or [])
+                     if isinstance(i, PagedObjects)), None)
 
     def _ingest_paged(self, s: _StoredSet, items: List[Any],
                       append: bool = False) -> List[Any]:
@@ -397,15 +435,13 @@ class SetStore:
             # HOST-OBJECT records: pickled-batch pages (the reference's
             # pages hold arbitrary pdb::Objects, PDBPage.h:17-33).
             # Object add_data APPENDS, matching the memory object
-            # path's extend semantics (relations replace; see above)
+            # path's extend semantics (relations replace; see above) —
+            # but the append to an EXISTING PagedObjects never reaches
+            # here: add_data pins it under the store lock and runs
+            # po.append outside it (the round-5 lock-inversion fix),
+            # so this branch only ever does the fresh first ingest
             from netsdb_tpu.storage.paged import PagedObjects
 
-            po = next((i for i in (s.items or [])
-                       if isinstance(i, PagedObjects)), None)
-            if po is not None:
-                po.append(items)
-                s.last_access = time.time()
-                return []
             dead = list(s.items or [])
             po = PagedObjects.ingest(
                 self.page_store(), f"{s.ident}#g{next(self._gen)}",

@@ -99,6 +99,27 @@ def profile_trace(log_dir: str) -> Iterator[None]:
         yield
 
 
+@contextlib.contextmanager
+def qid_profile_session(qid: str, log_dir: str) -> Iterator[str]:
+    """Per-QUERY device profile: a ``jax.profiler`` session keyed by
+    the query id, written to ``<log_dir>/<qid>`` — the REAL device
+    half of one traced query, joinable with its ``GET_TRACE`` span
+    profile by directory name. Opt-in
+    (``config.obs_device_profile_dir``) and serialized by the caller
+    (jax supports one session per process at a time — the serve layer
+    skips, never queues, when one is live). Yields the session
+    directory (the value the trace's ``meta.device_profile``
+    carries)."""
+    import os
+
+    import jax
+
+    path = os.path.join(log_dir, str(qid))
+    os.makedirs(path, exist_ok=True)
+    with jax.profiler.trace(path):
+        yield path
+
+
 def get_logger(name: str = "netsdb_tpu", level: Optional[str] = None,
                log_file: Optional[str] = None) -> logging.Logger:
     """PDBLogger equivalent: per-component, optionally file-backed."""

@@ -398,11 +398,20 @@ def bench_obs_overhead(rows: int = 2_000_000, page_rows: int = 65_536,
       overhead within the noise band reads as "indistinguishable from
       zero" (verified against an A/A null run).
     * ``accounting_overhead_pct`` — DETERMINISTIC bound: the exact
-      per-chunk accounting a trace adds (three counter adds + the
-      metadata byte-count), timed in isolation over 20k iterations and
-      scaled to this stream's chunk count. This is the number the
-      < 3% budget is pinned on — it cannot be confounded by the
-      scheduler."""
+      per-chunk accounting a trace adds on the CONSUMER's critical
+      path (three trace-counter adds; the chunk byte-count is measured
+      on the staging worker where it overlaps compute), timed in
+      isolation and scaled to this stream's chunk count. This is the
+      number the < 3% budget is pinned on — it cannot be confounded by
+      the scheduler.
+
+    ``sampled`` section (this PR's 1-in-N qid minting,
+    ``obs.sample_qid`` / ``config.obs_trace_sample``): every request
+    pays only the mint DECISION (``sample_qid_us`` — a lock-guarded
+    counter increment); the full per-chunk accounting lands on 1 in
+    ``sample`` queries, so the amortized deterministic bound is
+    ``decision + accounting/sample`` — strictly below the sample=1
+    bound whenever sample > 1."""
     import contextlib
     import shutil
     import tempfile
@@ -493,23 +502,49 @@ def bench_obs_overhead(rows: int = 2_000_000, page_rows: int = 65_536,
             out["trace_counters"] = prof[-1].get("counters", {})
 
         # deterministic bound: the EXACT accounting StagedStream adds
-        # per chunk under a trace (plan/staging._account), isolated
-        # from scheduler noise and scaled to this stream's chunk count
-        from netsdb_tpu.storage.devcache import _value_nbytes
-
-        with contextlib.closing(pc.stream()) as chunks:
-            item = next(iter(chunks))
-        n_acct = 20_000
+        # per chunk on the consumer thread under a trace
+        # (plan/staging._account — the byte-count itself is measured
+        # on the staging worker, overlapped with compute, so it is NOT
+        # on this path), isolated from scheduler noise and scaled to
+        # this stream's chunk count
+        n_acct = 5_000
+        trials = []
         with obs.trace(origin="bench") as tr:
-            t0 = time.perf_counter()
-            for _ in range(n_acct):
-                tr.add("stage.chunks")
-                tr.add("stage.bytes", _value_nbytes(item))
-                tr.add("stage.wait_s", 1e-4)
-            per_chunk = (time.perf_counter() - t0) / n_acct
+            for _ in range(8):  # best-of-trials: the DETERMINISTIC
+                # cost is the floor; scheduler preemption only adds
+                t0 = time.perf_counter()
+                for _ in range(n_acct):
+                    tr.add("stage.chunks")
+                    tr.add("stage.bytes", 851968)
+                    tr.add("stage.wait_s", 1e-4)
+                trials.append((time.perf_counter() - t0) / n_acct)
+        per_chunk = min(trials)
         out["accounting_us_per_chunk"] = round(per_chunk * 1e6, 3)
         out["accounting_overhead_pct"] = round(
             100.0 * per_chunk * int(out["chunks"]) / untraced, 4)
+
+        # sampled minting (obs.sample_qid, config.obs_trace_sample):
+        # the per-request decision cost every query pays, then the
+        # full accounting amortized over 1-in-N traced queries
+        sample = 16
+        n_mint = 5_000
+        mint_trials = []
+        for _ in range(8):
+            t0 = time.perf_counter()
+            for _ in range(n_mint):
+                obs.sample_qid(sample)
+            mint_trials.append((time.perf_counter() - t0) / n_mint)
+        decision_s = min(mint_trials)
+        acct_s = per_chunk * int(out["chunks"])
+        out["sampled"] = {
+            "sample": sample,
+            "sample_qid_us": round(decision_s * 1e6, 3),
+            # deterministic amortized bounds per query, by sample rate
+            "accounting_overhead_pct_sample1": round(
+                100.0 * (decision_s + acct_s) / untraced, 4),
+            f"accounting_overhead_pct_sample{sample}": round(
+                100.0 * (decision_s + acct_s / sample) / untraced, 4),
+        }
     finally:
         store.close()
         shutil.rmtree(root, ignore_errors=True)

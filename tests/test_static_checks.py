@@ -56,6 +56,15 @@ Regressions the serve layer must never quietly reacquire:
    ``workloads/`` — daemons and libraries report through the logger
    or the registry, never stdout.
 
+7. **Sampled qid minting.** A query id decides whether a WHOLE query
+   is traced end-to-end (client spans shipped via PUT_TRACE, a server
+   profile ringed, an optional device-profiler session) — at high QPS
+   that cost must be paid 1-in-N, not per request. The only mint on a
+   hot path is ``obs.sample_qid`` (which reads
+   ``config.obs_trace_sample``); a direct ``new_query_id()`` call
+   anywhere outside ``netsdb_tpu/obs/`` reintroduces unsampled
+   always-on tracing and fails this check.
+
 Run standalone: ``python tests/test_static_checks.py`` (exit 1 on
 violations) — the CI-script form the pytest wrapper shares.
 """
@@ -332,6 +341,46 @@ def check_device_upload_discipline() -> list:
     return violations
 
 
+def _check_unsampled_qid_mint(path: str) -> list:
+    """Ban ``new_query_id`` (call, attribute call, or import) outside
+    ``netsdb_tpu/obs/`` — hot paths mint through ``obs.sample_qid`` so
+    tracing cost follows ``config.obs_trace_sample``."""
+    with open(path) as f:
+        tree = ast.parse(f.read(), filename=path)
+    rel = os.path.relpath(path, REPO)
+    out = []
+    for node in ast.walk(tree):
+        hit = False
+        if isinstance(node, ast.Call):
+            f_ = node.func
+            hit = (isinstance(f_, ast.Name)
+                   and f_.id == "new_query_id") \
+                or (isinstance(f_, ast.Attribute)
+                    and f_.attr == "new_query_id")
+        elif isinstance(node, ast.ImportFrom):
+            hit = any(a.name == "new_query_id" for a in node.names)
+        if hit:
+            out.append(
+                f"{rel}:{node.lineno}: new_query_id outside obs/ — "
+                f"unsampled qid minting pays full tracing per request; "
+                f"mint through obs.sample_qid "
+                f"(config.obs_trace_sample)")
+    return out
+
+
+def check_sampled_qid_discipline() -> list:
+    violations = []
+    for dirpath, _dirnames, filenames in os.walk(PKG_DIR):
+        if "__pycache__" in dirpath \
+                or os.path.commonpath([dirpath, OBS_DIR]) == OBS_DIR:
+            continue
+        for name in sorted(filenames):
+            if name.endswith(".py"):
+                violations.extend(_check_unsampled_qid_mint(
+                    os.path.join(dirpath, name)))
+    return violations
+
+
 def test_serve_layer_clock_and_exception_discipline():
     violations = check_serve_layer()
     assert not violations, "\n" + "\n".join(violations)
@@ -357,10 +406,16 @@ def test_no_prints_outside_cli_and_workloads():
     assert not violations, "\n" + "\n".join(violations)
 
 
+def test_no_unsampled_qid_minting_on_hot_paths():
+    violations = check_sampled_qid_discipline()
+    assert not violations, "\n" + "\n".join(violations)
+
+
 def main() -> int:
     violations = (check_serve_layer() + check_staging_discipline()
                   + check_device_upload_discipline()
-                  + check_obs_layer() + check_no_prints())
+                  + check_obs_layer() + check_no_prints()
+                  + check_sampled_qid_discipline())
     for v in violations:
         print(v, file=sys.stderr)
     print(f"serve-layer + staging static check: "
