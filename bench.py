@@ -81,6 +81,60 @@ def _cpu_reference_rows_per_sec() -> float:
     return batch / dt
 
 
+# headline metrics and which direction is good — the --compare gate
+# fails on a >REGRESSION_PCT move the WRONG way for any of these
+HEADLINE_METRICS = {"ff_inference_rows_per_sec_per_chip": "higher"}
+REGRESSION_PCT = 15.0
+
+
+def _normalize_snapshot(obj):
+    """{metric: record} from any BENCH snapshot shape: the raw
+    one-line result dict, the BENCH_rNN.json wrapper (its ``parsed``
+    field), or a list of result dicts."""
+    if isinstance(obj, dict) and "parsed" in obj:
+        obj = obj["parsed"]
+    records = obj if isinstance(obj, list) else [obj]
+    out = {}
+    for rec in records:
+        if isinstance(rec, dict) and "metric" in rec and "value" in rec:
+            out[rec["metric"]] = rec
+    return out
+
+
+def compare_runs(current, prior, threshold_pct: float = REGRESSION_PCT):
+    """Diff two bench results metric by metric. Returns ``(lines,
+    regressed)``: human-readable per-metric deltas, and True when any
+    HEADLINE metric moved more than ``threshold_pct`` the wrong way —
+    the exit-nonzero gate that turns the BENCH trajectory from an
+    archive into a regression fence."""
+    cur = _normalize_snapshot(current)
+    pri = _normalize_snapshot(prior)
+    lines, regressed = [], False
+    for metric in sorted(set(cur) | set(pri)):
+        c, p = cur.get(metric), pri.get(metric)
+        if c is None or p is None:
+            lines.append(f"{metric}: only in the "
+                         f"{'prior' if c is None else 'current'} run "
+                         f"— not compared")
+            continue
+        cv, pv = float(c["value"]), float(p["value"])
+        if pv == 0:
+            lines.append(f"{metric}: prior value 0 — not compared")
+            continue
+        delta_pct = 100.0 * (cv - pv) / pv
+        direction = HEADLINE_METRICS.get(metric, "higher")
+        bad = (delta_pct < -threshold_pct if direction == "higher"
+               else delta_pct > threshold_pct)
+        verdict = "REGRESSION" if bad and metric in HEADLINE_METRICS \
+            else ("regressed (non-headline)" if bad else "ok")
+        lines.append(f"{metric}: {pv:.6g} -> {cv:.6g} "
+                     f"({delta_pct:+.1f}%, {direction} is better) "
+                     f"[{verdict}]")
+        if bad and metric in HEADLINE_METRICS:
+            regressed = True
+    return lines, regressed
+
+
 def main():
     if "--cpu-baseline" in sys.argv:
         rps = _cpu_reference_rows_per_sec()
@@ -88,6 +142,15 @@ def main():
             json.dump({"cpu_ff_rows_per_sec": rps}, f)
         print(json.dumps({"metric": "cpu_ff_rows_per_sec", "value": rps}))
         return
+
+    compare_path = None
+    if "--compare" in sys.argv:
+        idx = sys.argv.index("--compare")
+        if idx + 1 >= len(sys.argv):
+            print("--compare needs a prior BENCH_rNN.json path",
+                  file=sys.stderr)
+            raise SystemExit(2)
+        compare_path = sys.argv[idx + 1]
 
     import jax
 
@@ -171,12 +234,25 @@ def main():
         with open(_CPU_BASELINE_FILE, "w") as f:
             json.dump({"cpu_ff_rows_per_sec": cpu_rps}, f)
 
-    print(json.dumps({
+    result = {
         "metric": "ff_inference_rows_per_sec_per_chip",
         "value": round(rows_per_sec, 1),
         "unit": "rows/s",
         "vs_baseline": round(rows_per_sec / cpu_rps, 2),
-    }))
+    }
+    print(json.dumps(result))
+
+    if compare_path is not None:
+        with open(compare_path) as f:
+            prior = json.load(f)
+        lines, regressed = compare_runs(result, prior)
+        print(f"-- compare vs {compare_path} "
+              f"(gate: >{REGRESSION_PCT:.0f}% headline regression):",
+              file=sys.stderr)
+        for line in lines:
+            print(f"   {line}", file=sys.stderr)
+        if regressed:
+            raise SystemExit(1)
 
 
 if __name__ == "__main__":

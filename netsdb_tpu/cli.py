@@ -617,6 +617,11 @@ def _cmd_micro_bench(args) -> int:
 
         print(json.dumps(micro_bench.bench_obs_overhead(), indent=2))
         return 0
+    if getattr(args, "explain_overhead", False):
+        import json
+
+        print(json.dumps(micro_bench.bench_explain_overhead(), indent=2))
+        return 0
     names = None
     if args.only is not None:
         names = [n.strip() for n in args.only.split(",") if n.strip()]
@@ -746,15 +751,133 @@ def _print_health(health) -> None:
             print(f"    {json.dumps(f, default=str)}")
 
 
+def _render_explain(prof) -> None:
+    """Render one profile's per-operator tree — the classic EXPLAIN
+    ANALYZE readout, per-node % of the plan total."""
+    from netsdb_tpu.obs import operators
+
+    tree = prof.get("operators")
+    qid = prof.get("qid")
+    if not tree:
+        print(f"{qid}: profile has no operator tree (obs_explain off, "
+              f"or the plan ran before this daemon enabled it)")
+        return
+    print(f"qid={qid} [{prof.get('origin')}] "
+          f"total={1e3 * (prof.get('total_s') or 0.0):.2f}ms")
+    print(operators.render_tree(tree, total_s=prof.get("total_s")))
+    for addr, fprofs in sorted((prof.get("followers") or {}).items()):
+        for fp in fprofs:
+            if fp.get("operators"):
+                print(f"-- follower {addr}:")
+                print(operators.render_tree(
+                    fp["operators"], total_s=fp.get("total_s")))
+
+
+def _cmd_obs_explain(c, args) -> int:
+    """`obs --explain <qid>`: the per-operator EXPLAIN ANALYZE tree of
+    one traced query — in-memory ring first, slowlog fallback."""
+    reply = c.get_trace(qid=args.explain)
+    profiles = [p for p in reply.get("profiles") or ()]
+    if not profiles:
+        reply = c.get_trace(qid=args.explain, slow=True)
+        profiles = [p for p in reply.get("profiles") or ()]
+    if not profiles:
+        print(f"no profile for qid {args.explain!r} (ring rotated, or "
+              f"the query was never traced)", file=sys.stderr)
+        return 1
+    if args.json:
+        print(json.dumps(profiles, indent=2, default=str))
+        return 0
+    for prof in profiles:
+        _render_explain(prof)
+    return 0
+
+
+def _render_top(payload) -> str:
+    """One `obs --top` frame: derived rates from the daemon's
+    telemetry history plus the busiest (client, set) attribution rows.
+    Pure text-in/text-out so tests can pin the shape."""
+    lines = []
+    hist = payload.get("history") or {}
+    deltas = payload.get("deltas") or {}
+    lines.append(f"== top (history: {hist.get('readings', 0)} readings"
+                 f" / {hist.get('span_s', 0.0):.0f}s span, "
+                 f"window {deltas.get('dt_s', 0.0):.1f}s) ==")
+    derived = deltas.get("derived") or {}
+    for k in ("qps", "staged_mb_s", "staged_chunks_s",
+              "devcache_hit_rate", "availability",
+              "devcache_installs_s"):
+        v = derived.get(k)
+        v_s = f"{v:.4g}" if isinstance(v, (int, float)) else "-"
+        lines.append(f"  {k:<22} {v_s}")
+    rates = deltas.get("rates") or {}
+    moving = sorted(rates.items(), key=lambda kv: -abs(kv[1]))[:8]
+    if moving:
+        lines.append("  -- moving counters (per second):")
+        for name, rate in moving:
+            lines.append(f"     {name:<40} {rate:.4g}/s")
+    attribution = ((payload.get("metrics") or {})
+                   .get("attribution") or {})
+    rows = []
+    for client, scopes in attribution.items():
+        if not isinstance(scopes, dict):
+            continue
+        for scope, metrics in scopes.items():
+            rows.append((client, scope,
+                         metrics.get("requests", 0),
+                         metrics.get("staged_bytes", 0)))
+    rows.sort(key=lambda r: (-r[2], -r[3]))
+    if rows:
+        lines.append("  -- clients (requests / staged MB):")
+        for client, scope, reqs, sb in rows[:8]:
+            lines.append(f"     {client:<16} {scope:<24} "
+                         f"{int(reqs):>8} {sb / 1e6:>10.1f}")
+    return "\n".join(lines)
+
+
+def _cmd_obs_top(c, args) -> int:
+    """`obs --top`: live terminal view refreshing from the daemon's
+    history deltas (bounded iterations for scripting/tests; default
+    runs until interrupted)."""
+    import time as _time
+
+    n = args.iterations
+    i = 0
+    try:
+        while True:
+            payload = c.get_metrics(window_s=args.interval * 5)
+            if args.json:
+                print(json.dumps({"history": payload.get("history"),
+                                  "deltas": payload.get("deltas")},
+                                 indent=2, default=str))
+            else:
+                print(_render_top(payload))
+            i += 1
+            if n and i >= n:
+                return 0
+            _time.sleep(args.interval)
+    except KeyboardInterrupt:
+        return 0
+
+
 def _cmd_obs(args) -> int:
     """Pretty-print a running daemon's observability surface: the
     COLLECT_STATS "metrics" section (central registry), the last N
     completed query profiles (GET_TRACE), the SLO/health readout
-    (--health) or the persisted slow-query ring (--slowlog)."""
+    (--health), the persisted slow-query ring (--slowlog), one
+    query's per-operator tree (--explain), the Prometheus scrape text
+    (--openmetrics), or the live rate view (--top)."""
     from netsdb_tpu.serve.client import RemoteClient
 
     c = RemoteClient(args.addr, token=args.token)
     try:
+        if getattr(args, "explain", None):
+            return _cmd_obs_explain(c, args)
+        if getattr(args, "openmetrics", False):
+            print(c.get_metrics(format="openmetrics")["text"], end="")
+            return 0
+        if getattr(args, "top", False):
+            return _cmd_obs_top(c, args)
         if getattr(args, "health", False):
             health = c.health()
             if args.json:
@@ -874,6 +997,10 @@ def main(argv=None) -> int:
                    help="cost of always-on query tracing on the staged "
                         "fold stream (traced vs untraced; < 3%% is the "
                         "budget)")
+    p.add_argument("--explain-overhead", action="store_true",
+                   help="cost of per-node operator attribution on the "
+                        "staged fold stream (explain on vs off; < 1%% "
+                        "budget, ~0 when off)")
 
     sub.add_parser("selftest",
                    help="scripted integration sequence (integratedTests.py)")
@@ -950,6 +1077,24 @@ def main(argv=None) -> int:
                    help="the persisted slow-query ring instead "
                         "(<root>/slowlog/ — outliers that survived "
                         "ring rotation and restarts)")
+    p.add_argument("--explain", default=None, metavar="QID",
+                   help="render one traced query's per-operator "
+                        "EXPLAIN ANALYZE tree (per-node wall/device "
+                        "time, rows, cache + compile counters, %% of "
+                        "total); falls back to the slowlog when the "
+                        "ring rotated")
+    p.add_argument("--openmetrics", action="store_true",
+                   help="print the Prometheus text exposition "
+                        "(GET_METRICS format=openmetrics) — the "
+                        "scrape-endpoint payload, leader-merged")
+    p.add_argument("--top", action="store_true",
+                   help="live rate view refreshing from the daemon's "
+                        "telemetry history deltas (QPS, staged MB/s, "
+                        "hit-rate trend, busiest clients)")
+    p.add_argument("--iterations", type=int, default=0,
+                   help="--top refresh count (0 = until interrupted)")
+    p.add_argument("--interval", type=float, default=2.0,
+                   help="--top refresh period seconds")
     p.add_argument("--json", action="store_true",
                    help="raw JSON instead of the pretty readout")
 

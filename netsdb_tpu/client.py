@@ -483,11 +483,17 @@ class Client:
 
     # --- query execution ----------------------------------------------
     def execute_computations(self, *sinks, job_name: str = "job",
-                             materialize: bool = True):
+                             materialize: bool = True,
+                             explain: bool = False):
         """Plan + run a Computation DAG — ``QueryClient::executeComputations``
         (reference ``src/queries/headers/QueryClient.h:160-224``) without the
         client→master RPC hop. ``sinks`` are Write computations from
         :mod:`netsdb_tpu.plan.computations`.
+
+        ``explain=True`` is the in-process EXPLAIN ANALYZE: the
+        executor records every plan node's wall/device time, rows and
+        cache/compile counters (``obs/operators.py``) and the return
+        becomes ``(results, operators_tree)``.
 
         With a placement advisor installed, the job's elapsed time is
         recorded against the arm whose placement this session's DDL
@@ -495,20 +501,29 @@ class Client:
         arm that was merely chosen, so per-arm means measure real
         physical configurations (the scheduler-side self-learning hook,
         ``QuerySchedulerServer.cc:246-330``)."""
+        from netsdb_tpu import obs
         from netsdb_tpu.plan.executor import execute_computations
 
-        if self._advisor is not None and self._advisor_arm is not None:
-            from netsdb_tpu.learning.history import set_config_label
+        def run():
+            if self._advisor is not None and self._advisor_arm is not None:
+                from netsdb_tpu.learning.history import set_config_label
 
-            set_config_label(self._advisor_arm.label)
-            try:
-                return execute_computations(self, list(sinks),
-                                            job_name=job_name,
-                                            materialize=materialize)
-            finally:
-                set_config_label("")  # no stale-arm tagging
-        return execute_computations(self, list(sinks), job_name=job_name,
-                                    materialize=materialize)
+                set_config_label(self._advisor_arm.label)
+                try:
+                    return execute_computations(self, list(sinks),
+                                                job_name=job_name,
+                                                materialize=materialize)
+                finally:
+                    set_config_label("")  # no stale-arm tagging
+            return execute_computations(self, list(sinks),
+                                        job_name=job_name,
+                                        materialize=materialize)
+
+        if not explain:
+            return run()
+        with obs.operators.explain_capture() as cap:
+            results = run()
+        return results, cap.get("operators")
 
     # --- stats --------------------------------------------------------
     def collect_stats(self) -> Dict[str, Any]:

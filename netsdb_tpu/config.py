@@ -112,6 +112,21 @@ class Configuration:
     # captures a REAL device profile into <dir>/<qid> (one session at a
     # time; concurrent traced queries skip, never queue). None = off.
     obs_device_profile_dir: Optional[str] = None
+    # per-operator plan profiling (obs/operators.py): on, every TRACED
+    # query additionally records an EXPLAIN ANALYZE tree (per-node
+    # wall/device time, rows, chunk + cache/compile counters) into its
+    # profile and the cross-query operator ledger; off, only explicit
+    # EXECUTE(explain=True) requests record. Cost rides the trace
+    # sampling knob — `micro_bench --explain-overhead` pins it < 1%.
+    obs_explain: bool = True
+    # continuous telemetry history (obs/history.py): the daemon
+    # snapshots the registry's numeric surface every
+    # obs_history_interval_s seconds into a ring of obs_history_len
+    # readings (bounded: ring length x snapshot size), from which
+    # GET_METRICS/`cli obs --top` derive rates (QPS, staged MB/s,
+    # hit-rate trends). interval <= 0 or len < 2 disables the thread.
+    obs_history_interval_s: float = 5.0
+    obs_history_len: int = 120
     # --- execution ---
     num_threads: int = 4  # host-side IO/pipeline threads (not device parallelism)
     enable_compression: bool = True  # host spill compression (ref -DENABLE_COMPRESSION)

@@ -15,6 +15,7 @@ JAX-free wire client imports this module.
 """
 
 from netsdb_tpu.obs import attrib  # noqa: F401 — registers "attribution"
+from netsdb_tpu.obs import operators  # noqa: F401 — registers "operators"
 from netsdb_tpu.obs.metrics import (  # noqa: F401
     Counter,
     Gauge,
@@ -43,5 +44,6 @@ __all__ = [
     "Counter", "Gauge", "Histogram", "MetricsRegistry", "REGISTRY",
     "registry", "DEFAULT_RING", "QidSampler", "QueryTrace", "Span",
     "TraceRing", "add", "attrib", "current_trace", "enabled",
-    "new_query_id", "sample_qid", "set_enabled", "span", "trace",
+    "new_query_id", "operators", "sample_qid", "set_enabled", "span",
+    "trace",
 ]

@@ -1,0 +1,364 @@
+"""OpenMetrics / Prometheus text exposition of the registry.
+
+``GET_METRICS format=openmetrics`` turns one registry snapshot (plus
+leader-merged follower snapshots and the per-(client, set) attribution
+ledger) into the Prometheus text format every scraper understands::
+
+    # HELP netsdb_serve_requests_total frames dispatched ...
+    # TYPE netsdb_serve_requests_total counter
+    netsdb_serve_requests_total 1042
+    netsdb_serve_requests_total{follower="127.0.0.1:9001"} 310
+    netsdb_attrib_staged_bytes_total{client="tenant-a",set="d:lineitem"} 83886080
+
+Rules this module enforces:
+
+* **Stable names.** Every exported family maps 1:1 to a catalogued
+  registry metric (:data:`CATALOG` — the machine-readable twin of
+  ``docs/METRICS.md``; the static check in ``tests/test_static_checks
+  .py`` keeps code ↔ catalog ↔ docs drift-free). A registry
+  instrument NOT in the catalog is skipped and counted
+  (``obs.export.uncatalogued``) — the exporter can never leak an
+  unreviewed name into a scrape.
+* **Typed exposition.** Counters export as ``*_total`` counter
+  families; gauges as gauges; registry histograms as ``summary``
+  families (``_sum``/``_count`` exact forever, ``quantile`` lines
+  from the bounded sample ring).
+* **Labels.** Follower sections ride a ``follower`` label; the
+  attribution ledger exports per-``client``/``set`` sample lines under
+  ``netsdb_attrib_*`` families — the multi-tenant view a Prometheus
+  alert can group by.
+
+:func:`parse_openmetrics` is the small in-repo grammar checker the
+acceptance tests run over every scrape — names, label syntax, sample
+types and float values all validated, so "parses under the Prometheus
+text-format grammar" is a tested property, not a hope.
+"""
+
+from __future__ import annotations
+
+import math
+import re
+from typing import Any, Dict, List, Optional, Tuple
+
+from netsdb_tpu.obs import metrics as _metrics
+
+#: metric families of the ATTRIBUTION ledger (obs/attrib.py accounts
+#: these per (client, scope); they are not registry instruments, so
+#: they are catalogued here and in docs/METRICS.md explicitly)
+ATTRIB_METRICS = (
+    "requests", "staged_bytes", "staged_chunks", "devcache.hits",
+    "devcache.misses", "devcache.installs", "executor.chunks",
+)
+
+
+def _catalog() -> Dict[str, Tuple[str, str]]:
+    """name → (type, help) for every exported metric. Built by a
+    function (obs/ bans module-level dict literals — the static
+    counter-discipline check); the docs twin is ``docs/METRICS.md``."""
+    counters = (
+        ("serve.requests", "workload frames dispatched (outcome time; "
+                           "OBS frames excluded)"),
+        ("serve.requests_ok", "workload frames answered without an ERR"),
+        ("serve.idem.memory_hits", "idempotent retries answered from "
+                                   "the in-memory reply cache"),
+        ("serve.idem.persist_hits", "idempotent retries answered from "
+                                    "the persisted sqlite cache"),
+        ("serve.client.retries", "client-side request retries"),
+        ("serve.client.hedges_issued", "hedged reads issued"),
+        ("serve.client.hedges_won", "hedged reads won by the hedge"),
+        ("serve.client.traces_shipped", "client trace profiles shipped "
+                                        "via PUT_TRACE"),
+        ("serve.client.trace_ship_failures", "PUT_TRACE ship failures "
+                                             "(best-effort, counted)"),
+        ("serve.client.trace_ship_dropped", "client trace profiles "
+                                            "dropped on a full ship "
+                                            "queue"),
+        ("devcache.lookups", "device block cache lookups (hits+misses)"),
+        ("devcache.hits", "device block cache hits"),
+        ("devcache.misses", "device block cache misses"),
+        ("devcache.installs", "complete runs installed into the device "
+                              "cache"),
+        ("devcache.evictions", "device cache LRU evictions"),
+        ("devcache.invalidations", "device cache entries dropped by "
+                                   "write-path invalidation"),
+        ("staging.chunks", "chunks staged host->device"),
+        ("staging.bytes", "bytes staged host->device (accounted "
+                          "streams)"),
+        ("obs.traces.client", "completed client-origin query traces"),
+        ("obs.traces.server", "completed server-origin query traces"),
+        ("obs.traces.local", "completed local-origin query traces"),
+        ("obs.traces.bench", "completed bench-origin query traces"),
+        ("obs.qid_sampled_out", "requests that skipped tracing under "
+                                "1-in-N qid sampling"),
+        ("obs.slow_queries", "profiles persisted to the slowlog ring"),
+        ("obs.slowlog_errors", "slowlog persistence failures (counted, "
+                               "never fatal)"),
+        ("obs.put_trace.merged", "PUT_TRACE sections merged into a "
+                                 "ringed profile"),
+        ("obs.put_trace.unmatched", "PUT_TRACE sections whose qid never "
+                                    "ringed"),
+        ("obs.operators_overflow", "operator-ledger rows folded into "
+                                   "the overflow bucket"),
+        ("obs.export.uncatalogued", "registry instruments skipped by "
+                                    "the OpenMetrics exporter for "
+                                    "missing a catalog entry"),
+        ("attrib.overflow", "attribution rows folded into the overflow "
+                            "bucket"),
+        ("slo.breaches", "SLO objective breach transitions"),
+        ("slo.recoveries", "SLO objective recovery transitions"),
+    )
+    hists = (
+        ("serve.request_s", "server-side frame latency seconds "
+                            "(time-to-first-frame for streams)"),
+        ("serve.client.read_latency_s", "client-observed read latency "
+                                        "seconds (the hedge trigger "
+                                        "feed)"),
+        ("staging.wait_s", "consumer seconds blocked on a staged "
+                           "host->device upload"),
+    )
+    out: Dict[str, Tuple[str, str]] = {}
+    for name, help_ in counters:
+        out[name] = ("counter", help_)
+    for name, help_ in hists:
+        out[name] = ("histogram", help_)
+    for name in ATTRIB_METRICS:
+        out[f"attrib.{name}"] = (
+            "counter", f"per-(client, set) attributed {name}")
+    return out
+
+
+#: the machine-readable metric catalog (docs/METRICS.md is the twin)
+CATALOG = _catalog()
+
+_QUANTILES = (0.5, 0.95, 0.99)
+
+
+def metric_name(raw: str, suffix: str = "") -> str:
+    """Registry name → Prometheus family name: ``netsdb_`` prefix,
+    dots/dashes to underscores, counter families get ``_total``."""
+    return "netsdb_" + re.sub(r"[^a-zA-Z0-9_:]", "_", raw) + suffix
+
+
+def _escape_label(v: str) -> str:
+    return str(v).replace("\\", r"\\").replace("\"", r"\"") \
+        .replace("\n", r"\n")
+
+
+def _labels(pairs: Dict[str, str]) -> str:
+    if not pairs:
+        return ""
+    inner = ",".join(f'{k}="{_escape_label(v)}"'
+                     for k, v in sorted(pairs.items()))
+    return "{" + inner + "}"
+
+
+def _fmt(v: Any) -> str:
+    f = float(v)
+    if math.isnan(f):
+        return "NaN"
+    if math.isinf(f):
+        return "+Inf" if f > 0 else "-Inf"
+    if f == int(f) and abs(f) < 1e15:
+        return str(int(f))
+    return repr(f)
+
+
+class _Writer:
+    """Accumulates one exposition: families declared once (# HELP/
+    # TYPE), samples appended under them in declaration order."""
+
+    def __init__(self):
+        self._order: List[str] = []
+        self._fams: Dict[str, Dict[str, Any]] = {}
+
+    def family(self, fam: str, typ: str, help_: str) -> None:
+        if fam not in self._fams:
+            self._order.append(fam)
+            self._fams[fam] = {"type": typ, "help": help_,
+                               "samples": []}
+
+    def sample(self, fam: str, name: str, labels: Dict[str, str],
+               value: Any) -> None:
+        self._fams[fam]["samples"].append(
+            f"{name}{_labels(labels)} {_fmt(value)}")
+
+    def render(self) -> str:
+        lines: List[str] = []
+        for fam in self._order:
+            info = self._fams[fam]
+            lines.append(f"# HELP {fam} {info['help']}")
+            lines.append(f"# TYPE {fam} {info['type']}")
+            lines.extend(info["samples"])
+        return "\n".join(lines) + "\n"
+
+
+def _emit_numeric(w: _Writer, snapshot: Dict[str, Any],
+                  labels: Dict[str, str], skipped: List[str]) -> None:
+    """Counters + gauges + histogram summaries of one registry
+    snapshot (``MetricsRegistry.snapshot()`` shape) under ``labels``."""
+    for name, value in sorted((snapshot.get("counters") or {}).items()):
+        cat = CATALOG.get(name)
+        if cat is None or cat[0] != "counter":
+            skipped.append(name)
+            continue
+        fam = metric_name(name, "_total")
+        w.family(fam, "counter", cat[1])
+        w.sample(fam, fam, labels, value)
+    for name, value in sorted((snapshot.get("gauges") or {}).items()):
+        cat = CATALOG.get(name)
+        if cat is None or cat[0] != "gauge":
+            skipped.append(name)
+            continue
+        fam = metric_name(name)
+        w.family(fam, "gauge", cat[1])
+        w.sample(fam, fam, labels, value)
+    for name, h in sorted((snapshot.get("histograms") or {}).items()):
+        cat = CATALOG.get(name)
+        if cat is None or cat[0] != "histogram":
+            skipped.append(name)
+            continue
+        fam = metric_name(name)
+        w.family(fam, "summary", cat[1])
+        for q in _QUANTILES:
+            qv = h.get(f"p{int(q * 100)}")
+            if qv is not None:
+                w.sample(fam, fam, {**labels, "quantile": str(q)}, qv)
+        w.sample(fam, fam + "_sum", labels, h.get("total") or 0.0)
+        w.sample(fam, fam + "_count", labels, h.get("count") or 0)
+
+
+def _emit_attribution(w: _Writer, attribution: Dict[str, Any],
+                      labels: Dict[str, str],
+                      skipped: List[str]) -> None:
+    """The per-(client, set) ledger as labelled counter families."""
+    for client, scopes in sorted((attribution or {}).items()):
+        if not isinstance(scopes, dict):
+            continue
+        for scope, metrics in sorted(scopes.items()):
+            for name, value in sorted((metrics or {}).items()):
+                cat = CATALOG.get(f"attrib.{name}")
+                if cat is None:
+                    skipped.append(f"attrib.{name}")
+                    continue
+                fam = metric_name(f"attrib.{name}", "_total")
+                w.family(fam, "counter", cat[1])
+                w.sample(fam, fam,
+                         {**labels, "client": client, "set": scope},
+                         value)
+
+
+def to_openmetrics(snapshot: Dict[str, Any],
+                   followers: Optional[Dict[str, Dict[str, Any]]] = None
+                   ) -> str:
+    """One Prometheus text exposition from a local registry snapshot
+    (``MetricsRegistry.snapshot()`` — the COLLECT_STATS "metrics"
+    shape) plus optional follower snapshots (addr → same shape),
+    merged under a ``follower`` label. Only catalogued names are
+    emitted; skipped instruments tick ``obs.export.uncatalogued``."""
+    w = _Writer()
+    skipped: List[str] = []
+    _emit_numeric(w, snapshot, {}, skipped)
+    _emit_attribution(w, snapshot.get("attribution") or {}, {}, skipped)
+    for addr, fsnap in sorted((followers or {}).items()):
+        if not isinstance(fsnap, dict) or "error" in fsnap:
+            continue
+        labels = {"follower": str(addr)}
+        _emit_numeric(w, fsnap, labels, skipped)
+        _emit_attribution(w, fsnap.get("attribution") or {}, labels,
+                          skipped)
+    if skipped:
+        _metrics.REGISTRY.counter("obs.export.uncatalogued").inc(
+            len(skipped))
+    return w.render()
+
+
+# ---------------------------------------------------------------------
+# the in-repo Prometheus text-format parser (the acceptance oracle)
+# ---------------------------------------------------------------------
+
+_NAME_RE = re.compile(r"^[a-zA-Z_:][a-zA-Z0-9_:]*$")
+_LABEL_RE = re.compile(r'([a-zA-Z_][a-zA-Z0-9_]*)="((?:[^"\\]|\\.)*)"')
+_SAMPLE_RE = re.compile(
+    r"^([a-zA-Z_:][a-zA-Z0-9_:]*)"
+    r"(\{.*\})?\s+"
+    r"([+-]?(?:[0-9]+\.?[0-9]*|\.[0-9]+)(?:[eE][+-]?[0-9]+)?"
+    r"|[+-]?Inf|NaN)"
+    r"(?:\s+(-?[0-9]+))?$")
+_TYPES = {"counter", "gauge", "summary", "histogram", "untyped"}
+#: sample-name suffixes each family type may emit beyond the bare name
+#: (dict() call, not a literal — the obs/ static check reserves
+#: module-level dict literals for registry-counter vigilance)
+_SUFFIXES = dict(summary=("_sum", "_count"),
+                 histogram=("_sum", "_count", "_bucket"),
+                 counter=(), gauge=(), untyped=())
+
+
+def parse_openmetrics(text: str) -> Dict[str, Dict[str, Any]]:
+    """Strict-enough Prometheus text-format parse: validates family
+    declarations, metric/label naming, sample grammar and the
+    type/suffix contract; raises ``ValueError`` (with line number) on
+    any violation. Returns {family: {"type", "help", "samples":
+    [(name, labels, value)]}} — what the acceptance tests assert
+    over."""
+    fams: Dict[str, Dict[str, Any]] = {}
+
+    def fam_of(sample_name: str) -> Optional[str]:
+        if sample_name in fams:
+            return sample_name
+        for fam, info in fams.items():
+            if sample_name.startswith(fam) and \
+                    sample_name[len(fam):] in _SUFFIXES[info["type"]]:
+                return fam
+        return None
+
+    for i, line in enumerate(text.splitlines(), 1):
+        if not line.strip():
+            continue
+        if line.startswith("# HELP "):
+            parts = line[len("# HELP "):].split(" ", 1)
+            if not parts or not _NAME_RE.match(parts[0]):
+                raise ValueError(f"line {i}: bad HELP name: {line!r}")
+            fams.setdefault(parts[0], {"type": "untyped", "help": "",
+                                       "samples": []})
+            fams[parts[0]]["help"] = parts[1] if len(parts) > 1 else ""
+            continue
+        if line.startswith("# TYPE "):
+            parts = line[len("# TYPE "):].split()
+            if len(parts) != 2 or not _NAME_RE.match(parts[0]):
+                raise ValueError(f"line {i}: bad TYPE line: {line!r}")
+            if parts[1] not in _TYPES:
+                raise ValueError(f"line {i}: unknown type {parts[1]!r}")
+            fams.setdefault(parts[0], {"type": parts[1], "help": "",
+                                       "samples": []})
+            fams[parts[0]]["type"] = parts[1]
+            continue
+        if line.startswith("#"):
+            continue  # free comment
+        m = _SAMPLE_RE.match(line)
+        if not m:
+            raise ValueError(f"line {i}: bad sample line: {line!r}")
+        name, labelstr, value = m.group(1), m.group(2), m.group(3)
+        labels: Dict[str, str] = {}
+        if labelstr:
+            body = labelstr[1:-1].rstrip(",")
+            if body:
+                consumed = 0
+                for lm in _LABEL_RE.finditer(body):
+                    labels[lm.group(1)] = lm.group(2)
+                    consumed = lm.end()
+                rest = body[consumed:].strip(", ")
+                if rest:
+                    raise ValueError(
+                        f"line {i}: bad label syntax near {rest!r}")
+        fam = fam_of(name)
+        if fam is None:
+            raise ValueError(
+                f"line {i}: sample {name!r} has no declared family "
+                f"(or an illegal suffix for its family type)")
+        info = fams[fam]
+        if info["type"] == "counter" and name == fam \
+                and not fam.endswith("_total"):
+            raise ValueError(
+                f"line {i}: counter family {fam!r} must end in _total")
+        fams[fam]["samples"].append((name, labels, float(value)))
+    return fams

@@ -220,6 +220,20 @@ class MetricsRegistry:
                 out[name] = {"error": f"{type(e).__name__}: {e}"}
         return out
 
+    def numeric_snapshot(self) -> Dict[str, Any]:
+        """Bounded NUMERIC-ONLY readout: counters, gauges, and
+        per-histogram ``(count, total)`` pairs — no quantile samples,
+        no collector sections. This is the reading the telemetry
+        history rings (``obs/history.py``): its size is bounded by the
+        instrument count alone, never by traffic."""
+        with self._mu:
+            counters = list(self._counters.items())
+            gauges = list(self._gauges.items())
+            hists = list(self._hists.items())
+        return {"counters": {k: v.value for k, v in counters},
+                "gauges": {k: v.value for k, v in gauges},
+                "hists": {k: (h.count, h.total) for k, h in hists}}
+
     def reset(self) -> None:
         """Drop every instrument and collector (tests)."""
         with self._mu:

@@ -166,6 +166,7 @@ class QueryTrace:
         self._spans: List[Span] = []
         self._counters: Dict[str, float] = {}
         self._meta: Dict[str, Any] = {}
+        self._sections: Dict[str, Any] = {}
         self._depth = threading.local()
         self.total_s: Optional[float] = None  # set by finish()
 
@@ -216,6 +217,15 @@ class QueryTrace:
         with self._mu:
             self._meta[str(key)] = value
 
+    def attach_section(self, name: str, payload: Any) -> None:
+        """Attach a structured top-level profile section BEFORE the
+        trace finishes — the in-process form of
+        :meth:`TraceRing.merge_section` (which handles sections that
+        arrive after the push, e.g. PUT_TRACE). The executor's
+        per-operator tree rides here as ``operators``."""
+        with self._mu:
+            self._sections[str(name)] = payload
+
     # --- lifecycle ----------------------------------------------------
     def finish(self) -> Dict[str, Any]:
         """Close the trace (idempotent on total_s) and push its profile
@@ -244,9 +254,11 @@ class QueryTrace:
                      sorted(self._spans, key=lambda s: s.start_s)]
             counters = dict(self._counters)
             meta = dict(self._meta)
+            sections = dict(self._sections)
         out: Dict[str, Any] = {"qid": self.qid, "origin": self.origin,
                                "total_s": self.total_s, "spans": spans,
                                "counters": counters}
+        out.update(sections)
         if meta:
             out["meta"] = meta
         if self.total_s is not None:

@@ -95,10 +95,12 @@ def _cached_jit(key: str, fn, donate_argnums: tuple = ()) -> Any:
     def counted(*args, **kwargs):
         # body runs only when jax (re)traces — the recompile counter;
         # the active query trace (if any) gets the same tick so a
-        # profile shows WHICH query paid a compile
+        # profile shows WHICH query paid a compile, and the current
+        # operator (if any) so the explain tree shows WHICH NODE did
         with _cache_lock:
             _compile_stats["traces"] += 1
         obs.add("executor.traces")
+        obs.operators.op_add("traces")
         return fn(*args, **kwargs)
 
     jfn = jax.jit(counted, donate_argnums=tuple(donate_argnums))
@@ -147,15 +149,43 @@ def _eval_node(node: Computation, in_vals: List[Any]) -> Any:
         return node.evaluate(*safe)
 
 
-def _evaluate(plan: LogicalPlan, scan_values: Dict[int, Any]) -> Dict[int, Any]:
+def _evaluate(plan: LogicalPlan, scan_values: Dict[int, Any],
+              recorder=None) -> Dict[int, Any]:
     """Replay the DAG in topo order, memoizing shared subgraphs (the
-    reference would materialize these as intermediate per-job sets)."""
+    reference would materialize these as intermediate per-job sets).
+
+    ``recorder`` (an :class:`obs.operators.OperatorRecorder`) times
+    each node into the per-operator explain tree — passed ONLY by the
+    eager execution branch: inside the whole-plan jit this function
+    runs under trace (node values are tracers, wall times would be
+    trace-time lies), so that caller leaves it None and the fused
+    program records via ``mark_fused`` instead."""
     values: Dict[int, Any] = dict(scan_values)
+    if recorder is None:
+        for node in plan.topo:
+            if node.node_id in values:
+                continue
+            args = [values[i.node_id] for i in node.inputs]
+            values[node.node_id] = _eval_node(node, args)
+        return values
+    base = recorder.reserve(len(plan.topo))
+    recorder.mode = "eager" if base == 0 else "mixed"
+    pos = {n.node_id: base + i for i, n in enumerate(plan.topo)}
     for node in plan.topo:
         if node.node_id in values:
+            # scans (and memoized shared subgraphs): register the node
+            # so the tree keeps the plan's shape, no time attributed
+            opr = recorder.node(pos[node.node_id], node,
+                                [pos[i.node_id] for i in node.inputs])
+            opr.rows_out = obs.operators.rows_of(values[node.node_id])
             continue
         args = [values[i.node_id] for i in node.inputs]
-        values[node.node_id] = _eval_node(node, args)
+        with recorder.op(pos[node.node_id], node,
+                         [pos[i.node_id] for i in node.inputs],
+                         args) as opr:
+            out = _eval_node(node, args)
+            opr.rows_out = obs.operators.rows_of(out)
+        values[node.node_id] = out
     return values
 
 
@@ -191,6 +221,8 @@ def _run_fold_once(fold, pc, resident, placement, step_jit):
                 sp.counters["chunks"] = n
                 sp.counters["device_est_s"] = dev_s
             obs.add("device.est_s", dev_s)
+            obs.operators.op_add("device_est_s", dev_s)
+            obs.operators.op_add("chunks", n)
             obs.attrib.account("executor.chunks", n,
                                scope=getattr(pc, "cache_scope", None))
     return fold.finalize(state, pc, *resident)
@@ -313,6 +345,9 @@ def _run_fold_grace(fold, pc, rest, bi, build_pc, placement, step_jit):
             # executor loop — grace joins must not read as 100% host
             # time, and a join-heavy tenant's executor.chunks must book
             obs.add("device.est_s", dev_s)
+            obs.operators.op_add("device_est_s", dev_s)
+            obs.operators.op_add("chunks", nchunks)
+            obs.operators.op_add("pairs", npairs)
             obs.attrib.account("executor.chunks", nchunks,
                                scope=getattr(pc, "cache_scope", None))
     finally:
@@ -490,6 +525,8 @@ def _run_tensor_stream(node, tfold, in_vals, src, step_jit):
                 sp.counters["blocks"] = len(outs)
                 sp.counters["device_est_s"] = dev_s
             obs.add("device.est_s", dev_s)
+            obs.operators.op_add("device_est_s", dev_s)
+            obs.operators.op_add("blocks", len(outs))
             obs.attrib.account("executor.chunks", len(outs),
                                scope=scope if scope is None
                                else str(scope[0]))
@@ -527,6 +564,8 @@ def _run_tensor_stream(node, tfold, in_vals, src, step_jit):
             sp.counters["blocks"] = nblk
             sp.counters["device_est_s"] = dev_s
         obs.add("device.est_s", dev_s)
+        obs.operators.op_add("device_est_s", dev_s)
+        obs.operators.op_add("blocks", nblk)
         obs.attrib.account("executor.chunks", nblk,
                            scope=scope if scope is None
                            else str(scope[0]))
@@ -625,10 +664,9 @@ def _execute_streamed(client, plan: LogicalPlan, scan_values: Dict[int, Any],
             return tuple(demote(x) for x in v)
         return v
 
-    for node in plan.topo:
-        if node.node_id in values:
-            continue
-        in_vals = [values[i.node_id] for i in node.inputs]
+    def dispatch(node, in_vals):
+        """One node's streamed-path evaluation — extracted so the
+        per-operator recorder can time it inclusively."""
         fold = getattr(node, "fold", None)
         src = getattr(node, "fold_src", 0)
         if (fold is not None and len(in_vals) > src
@@ -636,10 +674,8 @@ def _execute_streamed(client, plan: LogicalPlan, scan_values: Dict[int, Any],
             resident = flatten_resident(
                 tuple(v for i, v in enumerate(in_vals) if i != src))
             placement = placements.get(node.inputs[src].node_id)
-            values[node.node_id] = _run_fold(
-                node, fold, in_vals[src], resident, placement,
-                step_jit_for(node))
-            continue
+            return _run_fold(node, fold, in_vals[src], resident,
+                             placement, step_jit_for(node))
         tsrcs = [i for i, v in enumerate(in_vals)
                  if isinstance(v, PagedTensor)]
         if tsrcs:
@@ -667,9 +703,8 @@ def _execute_streamed(client, plan: LogicalPlan, scan_values: Dict[int, Any],
             # documented fold-less fallback) — it cannot ride into the
             # jitted tensor step as a raw stream handle
             in_vals = [demote(v) for v in in_vals]
-            values[node.node_id] = _run_tensor_stream(
-                node, tfold, in_vals, tsrcs[0], step_jit_for(node))
-            continue
+            return _run_tensor_stream(node, tfold, in_vals, tsrcs[0],
+                                      step_jit_for(node))
         if not getattr(node, "passthrough", False):
             # gather-chain nodes forward paged handles untouched so a
             # downstream fold can stream them; real consumers get the
@@ -691,9 +726,37 @@ def _execute_streamed(client, plan: LogicalPlan, scan_values: Dict[int, Any],
             # discipline the host fallbacks exist for.
             key = (f"eager::{job_name}::{plan_key}::"
                    f"n{topo_pos[node.node_id]}")
-            values[node.node_id] = _cached_jit(key, fn)(*in_vals)
+            return _cached_jit(key, fn)(*in_vals)
+        return _eval_node(node, in_vals)
+
+    # per-operator explain recording (obs/operators.py): op ids are
+    # RESERVED per plan component so auto-split jobs record every
+    # component into one collision-free tree; scans register untimed
+    # so the rendered tree keeps the plan's full shape
+    recorder = obs.operators.current_recorder()
+    op_base = recorder.reserve(len(plan.topo)) if recorder else 0
+    if recorder is not None and op_base != 0:
+        recorder.mode = "mixed"  # an auto-split job's later component
+    op_pos = {n.node_id: op_base + i for i, n in enumerate(plan.topo)}
+    for node in plan.topo:
+        if node.node_id in values:
+            if recorder is not None:
+                opr = recorder.node(
+                    op_pos[node.node_id], node,
+                    [op_pos[i.node_id] for i in node.inputs])
+                opr.rows_out = obs.operators.rows_of(
+                    values[node.node_id])
             continue
-        values[node.node_id] = _eval_node(node, in_vals)
+        in_vals = [values[i.node_id] for i in node.inputs]
+        if recorder is None:
+            values[node.node_id] = dispatch(node, in_vals)
+            continue
+        with recorder.op(op_pos[node.node_id], node,
+                         [op_pos[i.node_id] for i in node.inputs],
+                         in_vals) as opr:
+            out_val = dispatch(node, in_vals)
+            opr.rows_out = obs.operators.rows_of(out_val)
+        values[node.node_id] = out_val
     return values
 
 
@@ -721,7 +784,25 @@ def execute_computations(
     materialize: bool = True,
 ) -> Dict[SetIdentifier, Any]:
     """Plan and run; returns {output set ident: value} and (by default)
-    materializes results into the store — the reference's OUTPUT sets."""
+    materializes results into the store — the reference's OUTPUT sets.
+
+    Recorded per operator when the query is traced (or an
+    ``obs.operators.explain_capture`` is active): every node's wall
+    time, device estimate, chunk/row counts and cache/compile ticks
+    land in the explain tree (``obs/operators.py``). The recursion for
+    auto-split jobs joins the outer recording — one tree per logical
+    job."""
+    with obs.operators.recording(job_name, client.store.config):
+        return _execute_computations(client, sinks, job_name,
+                                     materialize)
+
+
+def _execute_computations(
+    client,
+    sinks: List[WriteSet],
+    job_name: str = "job",
+    materialize: bool = True,
+) -> Dict[SetIdentifier, Any]:
     with obs.span("planner.plan", "planner"):
         plan = plan_from_sinks(sinks)
     t0 = time.perf_counter()
@@ -853,10 +934,17 @@ def execute_computations(
             if sp is not None:
                 sp.counters["device_est_s"] = dev_s
             obs.add("device.est_s", dev_s)
+        rec = obs.operators.current_recorder()
+        if rec is not None:
+            # XLA fused the whole component: the tree keeps the plan's
+            # SHAPE (nodes marked fused) with one root carrying the
+            # program's measured wall/device time
+            rec.mark_fused(plan.topo, dev_s, dev_s)
         sink_vals = {s.node_id: out_list[i] for i, s in enumerate(plan.sinks)}
     else:
         with obs.span("executor.eager", "executor"):
-            values = _evaluate(plan, scan_values)
+            values = _evaluate(plan, scan_values,
+                               recorder=obs.operators.current_recorder())
         sink_vals = {s.node_id: values[s.inputs[0].node_id] for s in plan.sinks}
 
     results: Dict[SetIdentifier, Any] = {}

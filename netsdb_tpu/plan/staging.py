@@ -289,11 +289,16 @@ class StagedStream:
         self._trace = obs.current_trace()
         self._scope = scope
         self._client = obs.attrib.current_client()
+        # the per-operator explain record, likewise captured on the
+        # consumer's thread (the plan node whose dispatch built this
+        # stream): chunk/byte/wait ticks attribute to that node
+        self._op = obs.operators.current_op()
         # byte-sizing placed chunks costs tens of µs of device-array
         # metadata reads — decide ONCE whether any accounting consumer
-        # (ledger scope / active trace) needs it, and do it on the
-        # worker thread where it overlaps compute
-        want_nbytes = scope is not None or self._trace is not None
+        # (ledger scope / active trace / explain op record) needs it,
+        # and do it on the worker thread where it overlaps compute
+        want_nbytes = (scope is not None or self._trace is not None
+                       or self._op is not None)
         self._want_nbytes = want_nbytes
         self._thread: Optional[threading.Thread] = None
         if self._depth > 0:
@@ -322,9 +327,19 @@ class StagedStream:
         was measured on the WORKER thread (overlapped, not here —
         device-array metadata reads are µs-expensive)."""
         obs.REGISTRY.counter("staging.chunks").inc()
+        if nbytes:
+            # cumulative staged bytes: the MB/s-staged rate feed the
+            # telemetry history derives (obs/history.py)
+            obs.REGISTRY.counter("staging.bytes").inc(int(nbytes))
         if wait_s > 0:
             # total-seconds feed for obs/slo.py "staging_wait_fraction"
             obs.REGISTRY.histogram("staging.wait_s").observe(wait_s)
+        if self._op is not None:
+            self._op.add("stage.chunks")
+            if nbytes:
+                self._op.add("stage.bytes", nbytes)
+            if wait_s > 0:
+                self._op.add("stage.wait_s", wait_s)
         if self._scope is not None:
             obs.attrib.account("staged_chunks", 1, scope=self._scope,
                                client=self._client)
@@ -548,8 +563,9 @@ def stage_stream(source: Iterable, place: Callable[[Any], Any],
             _emit("cache_hit", name)
             # a whole run served device-resident: the query profile's
             # zero-transfer marker (per-block hit ticks come from the
-            # cache itself)
+            # cache itself), attributed to the consuming plan node too
             obs.add("stage.cached_runs")
+            obs.operators.op_add("stage.cached_runs")
             return _CachedRun(hit, name)
         rec = _CacheRecorder(cache, cache_key, place, cache_validator)
         return StagedStream(source, rec, depth=depth, name=name,

@@ -1340,30 +1340,46 @@ class RemoteClient:
     # --- query execution ----------------------------------------------
     def execute_computations(self, *sinks, job_name: str = "remote-job",
                              materialize: bool = True,
-                             fetch_results: bool = True):
+                             fetch_results: bool = True,
+                             explain: bool = False):
         """Ship the Computation DAG (cloudpickle — the analogue of
         shipping serialized Computations + registered UDF code) and run
         it on the daemon. Returns {ident: value} like the library
         client; ``fetch_results=False`` skips pulling result payloads
-        (they stay resident server-side, the common serving pattern)."""
+        (they stay resident server-side, the common serving pattern).
+
+        ``explain=True`` is EXPLAIN ANALYZE: the daemon records every
+        plan node's wall/device time, rows, chunk and cache/compile
+        counters and round-trips the annotated tree — the return
+        becomes ``(results, operators_tree)``. Render it with
+        ``obs.operators.render_tree`` (what ``cli obs --explain``
+        does)."""
         reply = self._request(
             MsgType.EXECUTE_COMPUTATIONS,
             {"sinks": list(sinks), "job_name": job_name,
-             "materialize": materialize},
+             "materialize": materialize, "explain": bool(explain)},
             codec=CODEC_PICKLE)
-        return self._collect_results(reply["results"], fetch_results)
+        results = self._collect_results(reply["results"], fetch_results)
+        if explain:
+            return results, reply.get("operators")
+        return results
 
     def execute_plan(self, plan_text: str, registry: Dict[str, Any],
                      job_name: str = "remote-plan", materialize: bool = True,
-                     fetch_results: bool = True):
+                     fetch_results: bool = True, explain: bool = False):
         """Pickle-free execution: ship plan text + label→entry-point
         registry; the daemon rebinds labels to registered types
-        (``ParsedPlan.to_computations``). The TCAP path."""
+        (``ParsedPlan.to_computations``). The TCAP path.
+        ``explain=True`` returns ``(results, operators_tree)`` — see
+        :meth:`execute_computations`."""
         reply = self._request(
             MsgType.EXECUTE_PLAN,
             {"plan": plan_text, "registry": registry, "job_name": job_name,
-             "materialize": materialize})
-        return self._collect_results(reply["results"], fetch_results)
+             "materialize": materialize, "explain": bool(explain)})
+        results = self._collect_results(reply["results"], fetch_results)
+        if explain:
+            return results, reply.get("operators")
+        return results
 
     def _collect_results(self, summaries: Dict[str, Any],
                          fetch: bool) -> Dict[RemoteIdent, Any]:
@@ -1411,3 +1427,18 @@ class RemoteClient:
         follower sections (best-effort — a slow follower reports an
         error entry, never gets evicted by a health read)."""
         return self._request(MsgType.HEALTH, {})
+
+    def get_metrics(self, format: Optional[str] = None,
+                    window_s: Optional[float] = None) -> Dict[str, Any]:
+        """Continuous telemetry (obs/history.py). Default: the
+        registry snapshot + history summary + derived rates over
+        ``window_s`` (QPS, staged MB/s, hit-rate trend — what ``cli
+        obs --top`` refreshes from). ``format="openmetrics"``: the
+        Prometheus text exposition instead (reply ``{"text": ...}``),
+        with leader-merged follower samples."""
+        payload: Dict[str, Any] = {}
+        if format:
+            payload["format"] = format
+        if window_s is not None:
+            payload["window_s"] = float(window_s)
+        return self._request(MsgType.GET_METRICS, payload)

@@ -149,6 +149,14 @@ class MsgType(IntEnum):
     # multi-window burn rates + breach events + slowlog summary;
     # the leader merges follower sections like COLLECT_STATS
     HEALTH = 46
+    # continuous telemetry export (obs/history.py + obs/export.py):
+    # format=openmetrics returns the Prometheus text exposition of the
+    # central registry (stable catalogued names, client/set labels
+    # from the attribution ledger, leader-merged follower samples);
+    # the default structured form carries the registry snapshot plus
+    # the history ring's derived rates (QPS, staged MB/s, hit-rate
+    # trends) that `cli obs --top` refreshes from
+    GET_METRICS = 47
     # multi-host reads: a master assembling a mesh-spanning array asks
     # each follower for ITS addressable shards (index ranges + bytes) —
     # the reference streaming each node's local pages to the frontend

@@ -67,7 +67,7 @@ from netsdb_tpu.utils.timing import deadline_after, seconds_left, wall_now
 #: SLO engine evaluates (monitoring must not move the SLOs it reads)
 OBS_FRAMES = frozenset({MsgType.PING, MsgType.COLLECT_STATS,
                         MsgType.GET_TRACE, MsgType.PUT_TRACE,
-                        MsgType.HEALTH})
+                        MsgType.HEALTH, MsgType.GET_METRICS})
 
 
 def resolve_entry_point(entry: str, source: Optional[str] = None) -> Any:
@@ -620,6 +620,15 @@ class ServeController:
         from netsdb_tpu.obs.slowlog import SlowQueryLog
 
         self.slo = SLOEngine()
+        # continuous telemetry: the bounded registry-snapshot ring the
+        # GET_METRICS deltas and `cli obs --top` refresh from; the
+        # thread starts with the listener and is JOINED at shutdown
+        from netsdb_tpu.obs.history import TelemetryHistory
+
+        self.history = TelemetryHistory(
+            capacity=getattr(config, "obs_history_len", 120) or 0,
+            interval_s=getattr(config, "obs_history_interval_s", 5.0)
+            or 0.0)
         self.slowlog = SlowQueryLog(
             config.root_dir,
             capacity=getattr(config, "obs_slowlog_entries", 64) or 64,
@@ -693,6 +702,7 @@ class ServeController:
             MsgType.GET_TRACE: self._on_get_trace,
             MsgType.PUT_TRACE: self._on_put_trace,
             MsgType.HEALTH: self._on_health,
+            MsgType.GET_METRICS: self._on_get_metrics,
             MsgType.ANALYZE_SET: self._on_analyze_set,
             MsgType.LOCAL_SHARDS: self._on_local_shards,
             MsgType.PAGED_MATMUL: self._on_paged_matmul,
@@ -712,6 +722,8 @@ class ServeController:
                              name="netsdb-serve-accept")
         t.start()
         self._threads.append(t)
+        if (getattr(self.config, "obs_history_len", 120) or 0) >= 2:
+            self.history.start()
         if self._follower_addrs:
             h = threading.Thread(target=self._health_loop, daemon=True,
                                  name="netsdb-serve-health")
@@ -732,6 +744,10 @@ class ServeController:
 
     def shutdown(self) -> None:
         self._stop.set()
+        # the telemetry snapshot thread is JOINED, not abandoned — no
+        # history thread may outlive its daemon (the leak-registry
+        # discipline every obs thread follows)
+        self.history.stop()
         with self._followers_mu:
             links = list(self._links.values())
         for link in links:
@@ -2183,7 +2199,13 @@ class ServeController:
         """Body (pickle codec): {sinks: [WriteSet...], job_name}. The
         DAG's callables were cloudpickled by the client — the analogue of
         ``executeComputations`` shipping serialized Computation objects
-        whose code the worker loads from registered .so files."""
+        whose code the worker loads from registered .so files.
+
+        ``explain: true`` runs the job with per-operator recording
+        FORCED (``obs.operators.explain_capture``) and round-trips the
+        annotated plan tree in the reply — EXPLAIN ANALYZE over the
+        wire; the same tree also rides the query's GET_TRACE profile
+        when the frame carried a qid."""
         sinks = p["sinks"]
         job_name = p.get("job_name", "remote-job")
 
@@ -2195,6 +2217,18 @@ class ServeController:
                 self._sync_results(results)
             return results
 
+        return self._execute_with_explain(p, job_name, run)
+
+    def _execute_with_explain(self, p, job_name, run):
+        """Shared EXECUTE tail: run the job (under an explain capture
+        when asked) and shape the reply."""
+        if p.get("explain"):
+            with obs.operators.explain_capture() as cap:
+                results = self._run_job(job_name, run)
+            out = {"results": self._result_summaries(results)}
+            if cap.get("operators") is not None:
+                out["operators"] = cap["operators"]
+            return MsgType.OK, out
         results = self._run_job(job_name, run)
         return MsgType.OK, {"results": self._result_summaries(results)}
 
@@ -2231,8 +2265,7 @@ class ServeController:
                 self._sync_results(results)
             return results
 
-        results = self._run_job(job_name, run)
-        return MsgType.OK, {"results": self._result_summaries(results)}
+        return self._execute_with_explain(p, job_name, run)
 
     def _on_list_jobs(self, p):
         with self._jobs_lock:
@@ -2375,6 +2408,47 @@ class ServeController:
                     merged.append(prof)
                 out["profiles"] = merged
                 out["followers"] = freplies
+        return MsgType.OK, out
+
+    def _on_get_metrics(self, p):
+        """Continuous telemetry export. Two forms:
+
+        * ``format="openmetrics"`` — the Prometheus text exposition
+          (``obs/export.py``): stable catalogued family names,
+          ``client``/``set`` labels from the attribution ledger, and —
+          on a leader — every follower's samples merged under a
+          ``follower`` label. The scrape endpoint's payload.
+        * default (structured) — the registry snapshot plus the
+          telemetry history's summary and derived rates (QPS, staged
+          MB/s, hit-rate trend over ``window_s``), the feed ``cli obs
+          --top`` refreshes from.
+
+        Either way a reading is taken first, so a poller gets deltas
+        exactly as fresh as its own cadence even when the snapshot
+        thread is disabled."""
+        from netsdb_tpu.obs import export as _export
+
+        self.history.observe()
+        snapshot = obs.REGISTRY.snapshot()
+        followers: Dict[str, Any] = {}
+        if not p.get("local_only"):
+            followers = self._fanout_read(MsgType.GET_METRICS,
+                                          {"local_only": True})
+        if p.get("format") == "openmetrics":
+            text = _export.to_openmetrics(
+                snapshot,
+                followers={a: (r.get("metrics") if isinstance(r, dict)
+                               else {"error": "bad reply"})
+                           for a, r in followers.items()})
+            return MsgType.OK, {"format": "openmetrics", "text": text}
+        window = p.get("window_s")
+        out: Dict[str, Any] = {
+            "metrics": snapshot,
+            "history": self.history.summary(),
+            "deltas": self.history.deltas(
+                float(window) if window else None)}
+        if followers:
+            out["followers"] = followers
         return MsgType.OK, out
 
     def _on_analyze_set(self, p):

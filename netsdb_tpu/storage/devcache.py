@@ -170,10 +170,12 @@ class DeviceBlockCache:
         if entry is None:
             obs.REGISTRY.counter("devcache.misses").inc()
             obs.add("devcache.misses")
+            obs.operators.op_add("devcache.misses")
             obs.attrib.account("devcache.misses", scope=scope)
             return None
         obs.REGISTRY.counter("devcache.hits").inc()
         obs.add("devcache.hits")
+        obs.operators.op_add("devcache.hits")
         obs.attrib.account("devcache.hits", scope=scope)
         return entry[0]
 

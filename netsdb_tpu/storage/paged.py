@@ -194,6 +194,7 @@ class PagedObjects:
         wait forever). A reader that starts mid-append may observe a
         prefix of the batch's pages — the same visibility a reader
         starting between two appends always had."""
+        import io
         import pickle
 
         if not items:
@@ -204,24 +205,47 @@ class PagedObjects:
                                f"dropped; cannot append")
             sid = self.store._set_id(self.name)
             target = max(self.store.config.page_size_bytes, 4096)
-            # records-per-page adapts to the measured bytes-per-record
-            # of the previous batch (same discipline as the serve
-            # stream's frame packing; per-record pickling for exact
-            # sizing measured far slower)
-            per_rec = 256
+            # page packing tracks CUMULATIVE PICKLED BYTES as the batch
+            # fills: an incremental Pickler measures each record on
+            # append, and the batch flushes the moment the measured
+            # payload reaches the page target. The old scheme sized the
+            # FIRST batch from a 256-byte seed estimate with an
+            # 8-record floor, so eight multi-MB records landed on one
+            # page — transiently blowing the arena far past
+            # page_size_bytes before the estimate could adapt (ADVICE
+            # round 5). The measuring stream shares the batch's object
+            # graph, so its tell() tracks the real page size; each
+            # flushed page stays ONE pickled list — the format
+            # ``__iter__`` and the resync replay expect.
             batch: list = []
-            for it in items:
-                batch.append(it)
-                if len(batch) >= max(target // per_rec, 8):
-                    blob = pickle.dumps(batch,
-                                        protocol=pickle.HIGHEST_PROTOCOL)
-                    self.store.backend.write_page(sid, blob)
-                    per_rec = max(len(blob) // len(batch), 1)
-                    batch = []
-            if batch:
+            buf = io.BytesIO()
+            measurer = pickle.Pickler(buf,
+                                      protocol=pickle.HIGHEST_PROTOCOL)
+
+            def flush():
+                nonlocal buf, measurer
+                if not batch:
+                    return
                 self.store.backend.write_page(
                     sid, pickle.dumps(batch,
                                       protocol=pickle.HIGHEST_PROTOCOL))
+                batch.clear()
+                buf = io.BytesIO()
+                measurer = pickle.Pickler(
+                    buf, protocol=pickle.HIGHEST_PROTOCOL)
+
+            for it in items:
+                batch.append(it)
+                try:
+                    measurer.dump(it)
+                    full = buf.tell() >= target
+                except Exception:  # noqa: BLE001 — an unpicklable
+                    # record must surface on the REAL dumps in flush()
+                    # with the whole batch's context, not here
+                    full = True
+                if full:
+                    flush()
+            flush()
             self.num_items += len(items)
 
     def __iter__(self):

@@ -551,6 +551,151 @@ def bench_obs_overhead(rows: int = 2_000_000, page_rows: int = 65_536,
     return out
 
 
+def bench_explain_overhead(rows: int = 2_000_000,
+                           page_rows: int = 65_536,
+                           repeats: int = 15) -> Dict[str, object]:
+    """Cost of PER-NODE attribution (obs/operators.py) on the staged
+    fold stream — the ``--explain-overhead`` mode, structured exactly
+    like ``--obs-overhead``: the same warmed q01-shaped fold runs with
+    an operator record installed (every staged chunk then ticks
+    chunk/byte/wait counters on the current node — the explain-on arm)
+    vs bare (explain off).
+
+    * ``overhead_pct``/``noise_pct`` — END-TO-END paired A/B, arms
+      alternating within each repeat so drift cancels;
+    * ``accounting_overhead_pct`` — DETERMINISTIC bound: the exact
+      three ``OpRecord.add`` calls ``plan/staging._account`` pays per
+      chunk with an op captured, timed in isolation and scaled to this
+      stream's chunk count. The < 1% budget is pinned on this number.
+    * ``off_path_ns`` — what EVERY uninstrumented query pays per
+      ``op_add`` call when no recorder is installed: one context-var
+      read + an ``is None`` check (the "~0 when off" claim)."""
+    import contextlib
+    import shutil
+    import tempfile
+
+    import jax
+    import jax.numpy as jnp
+
+    from netsdb_tpu import obs
+    from netsdb_tpu.config import Configuration
+    from netsdb_tpu.relational.outofcore import PagedColumns
+    from netsdb_tpu.storage.paged import PagedTensorStore
+
+    rng = np.random.default_rng(0)
+    n_keys = 4096
+    root = tempfile.mkdtemp(prefix="explain_bench_")
+    cfg = Configuration(root_dir=root)
+    store = PagedTensorStore(cfg, pool_bytes=256 << 20)
+    out: Dict[str, object] = {"rows": rows, "page_rows": page_rows,
+                              "repeats": repeats}
+
+    class _BenchNode:
+        op_kind = "Apply"
+        label = "explain-bench"
+
+        def plan_atom(self):
+            return "bench <= APPLY(scan, 'explain-bench')"
+
+    try:
+        fc = {
+            "k": rng.integers(0, n_keys, rows, dtype=np.int32),
+            "qty": rng.uniform(1.0, 50.0, rows).astype(np.float32),
+            "price": rng.uniform(1.0, 100.0, rows).astype(np.float32),
+        }
+        pc = PagedColumns.ingest(store, "explbench", fc,
+                                 row_block=page_rows)
+        out["chunks"] = pc.num_pages()
+
+        def raw_step(acc, k, qty, price, valid):
+            seg = jnp.where(valid, k, 0)
+            vals = jnp.stack([qty, price, jnp.ones_like(price)], axis=1)
+            vals = jnp.where(valid[:, None], vals, 0.0)
+            return acc + jax.ops.segment_sum(vals, seg,
+                                             num_segments=n_keys)
+
+        step = jax.jit(raw_step)
+
+        def run_once():
+            acc = jnp.zeros((n_keys, 3), jnp.float32)
+            with contextlib.closing(pc.stream()) as chunks:
+                for ccols, valid, _start in chunks:
+                    acc = step(acc, ccols["k"], ccols["qty"],
+                               ccols["price"], valid)
+            np.asarray(acc)
+
+        run_once()  # compile
+        run_once()  # warm the page cache / spill state
+
+        def one(explained: bool) -> float:
+            t0 = time.perf_counter()
+            if explained:
+                rec = obs.operators.OperatorRecorder("explain-bench")
+                with rec.op(0, _BenchNode(), []):
+                    run_once()
+            else:
+                run_once()
+            return time.perf_counter() - t0
+
+        pairs = []
+        for i in range(repeats):
+            if i % 2 == 0:
+                off = one(False)
+                on = one(True)
+            else:
+                on = one(True)
+                off = one(False)
+            pairs.append((off, on))
+
+        def med(vals):
+            s = sorted(vals)
+            n = len(s)
+            return s[n // 2] if n % 2 else (s[n // 2 - 1] + s[n // 2]) / 2
+
+        off_med = med([u for u, _ in pairs])
+        deltas = sorted(t - u for u, t in pairs)
+        d_med = med(deltas)
+        q1 = med(deltas[:len(deltas) // 2 + 1])
+        q3 = med(deltas[len(deltas) // 2:])
+        out["explain_off_s"] = round(off_med, 4)
+        out["explain_on_s"] = round(off_med + d_med, 4)
+        out["overhead_pct"] = round(100.0 * d_med / off_med, 2)
+        out["noise_pct"] = round(100.0 * abs(q3 - q1) / off_med, 2)
+
+        # deterministic bound: the exact per-chunk op ticks
+        # staging._account adds with an op record captured
+        n_acct = 5_000
+        trials = []
+        rec = obs.operators.OperatorRecorder("explain-bench")
+        with rec.op(1, _BenchNode(), []) as opr:
+            for _ in range(8):
+                t0 = time.perf_counter()
+                for _ in range(n_acct):
+                    opr.add("stage.chunks")
+                    opr.add("stage.bytes", 851968)
+                    opr.add("stage.wait_s", 1e-4)
+                trials.append((time.perf_counter() - t0) / n_acct)
+        per_chunk = min(trials)
+        out["accounting_us_per_chunk"] = round(per_chunk * 1e6, 3)
+        out["accounting_overhead_pct"] = round(
+            100.0 * per_chunk * int(out["chunks"]) / off_med, 4)
+
+        # the off path: op_add with NO recorder — one context-var read
+        off_trials = []
+        for _ in range(8):
+            t0 = time.perf_counter()
+            for _ in range(n_acct):
+                obs.operators.op_add("stage.chunks")
+            off_trials.append((time.perf_counter() - t0) / n_acct)
+        out["off_path_ns"] = round(min(off_trials) * 1e9, 1)
+        out["off_path_overhead_pct"] = round(
+            100.0 * min(off_trials) * int(out["chunks"]) / off_med, 6)
+    finally:
+        store.close()
+        shutil.rmtree(root, ignore_errors=True)
+    return out
+
+
 BENCHMARKS: Dict[str, Callable[[], Result]] = {
     "arena_alloc": bench_arena_alloc,
     "int_groupby": bench_int_groupby,

@@ -1,0 +1,290 @@
+"""Continuous telemetry (ISSUE 7 tentpole 2): the TelemetryHistory
+snapshot ring, rate derivation, the OpenMetrics exporter + in-repo
+grammar parser, the GET_METRICS frame (leader-merged), and the
+`obs --top` renderer.
+
+Acceptance shape: `GET_METRICS format=openmetrics` output parses under
+the Prometheus text-format grammar (checked with the in-repo parser),
+with leader-merged follower samples; the history thread is provably
+bounded (ring length × snapshot size) and shuts down cleanly with the
+daemon.
+"""
+
+import numpy as np
+import pytest
+
+from netsdb_tpu import obs
+from netsdb_tpu.config import Configuration
+from netsdb_tpu.obs.export import (
+    ATTRIB_METRICS,
+    CATALOG,
+    parse_openmetrics,
+    to_openmetrics,
+)
+from netsdb_tpu.obs.history import TelemetryHistory
+from netsdb_tpu.obs.metrics import MetricsRegistry
+from netsdb_tpu.relational.table import ColumnTable
+from netsdb_tpu.serve.client import RemoteClient, RetryPolicy
+from netsdb_tpu.serve.server import ServeController
+
+
+def _remote(addr, **kw):
+    kw.setdefault("retry", RetryPolicy(max_attempts=1))
+    return RemoteClient(addr, **kw)
+
+
+# ------------------------------------------------------------ history
+def test_history_ring_is_bounded_and_numeric_only():
+    reg = MetricsRegistry()
+    reg.counter("serve.requests").inc(5)
+    reg.histogram("serve.request_s").observe(0.25)
+    hist = TelemetryHistory(registry=reg, capacity=4, interval_s=0)
+    for _ in range(20):
+        hist.observe()
+    assert hist.summary()["readings"] == 4  # ring, not a log
+    # a reading holds counters/gauges/(count,total) pairs ONLY — no
+    # quantile samples, no collector sections: bounded by instrument
+    # count, never by traffic
+    snap = reg.numeric_snapshot()
+    assert snap["counters"]["serve.requests"] == 5
+    assert snap["hists"]["serve.request_s"] == (1, 0.25)
+    assert "histograms" not in snap and "attribution" not in snap
+
+
+def test_history_deltas_derive_rates():
+    reg = MetricsRegistry()
+    clock = [100.0]
+    hist = TelemetryHistory(registry=reg, capacity=16, interval_s=0,
+                            clock=lambda: clock[0])
+    reg.counter("serve.requests").inc(10)
+    reg.counter("serve.requests_ok").inc(10)
+    hist.observe()
+    clock[0] += 10.0
+    reg.counter("serve.requests").inc(40)
+    reg.counter("serve.requests_ok").inc(30)
+    reg.counter("staging.bytes").inc(20_000_000)
+    reg.counter("devcache.hits").inc(3)
+    reg.counter("devcache.lookups").inc(4)
+    hist.observe()
+    d = hist.deltas()
+    assert d["dt_s"] == pytest.approx(10.0)
+    assert d["rates"]["serve.requests"] == pytest.approx(4.0)
+    assert d["derived"]["qps"] == pytest.approx(4.0)
+    assert d["derived"]["staged_mb_s"] == pytest.approx(2.0)
+    assert d["derived"]["devcache_hit_rate"] == pytest.approx(0.75)
+    assert d["derived"]["availability"] == pytest.approx(0.75)
+
+
+def test_history_thread_starts_and_stops_cleanly():
+    reg = MetricsRegistry()
+    hist = TelemetryHistory(registry=reg, capacity=8, interval_s=0.05)
+    hist.start()
+    assert hist.running
+    import time
+
+    deadline = time.monotonic() + 5.0
+    while hist.summary()["readings"] < 3:
+        assert time.monotonic() < deadline, "no snapshots taken"
+        time.sleep(0.02)
+    hist.stop()
+    assert not hist.running
+    n = hist.summary()["readings"]
+    time.sleep(0.15)
+    assert hist.summary()["readings"] == n  # really stopped
+    hist.stop()  # idempotent
+
+
+def test_history_interval_zero_disables_thread():
+    hist = TelemetryHistory(registry=MetricsRegistry(), interval_s=0)
+    hist.start()
+    assert not hist.running
+
+
+# ----------------------------------------------------------- exporter
+def _snapshot_with_traffic():
+    reg = MetricsRegistry()
+    reg.counter("serve.requests").inc(12)
+    reg.counter("serve.requests_ok").inc(11)
+    reg.counter("staging.bytes").inc(1 << 20)
+    reg.histogram("serve.request_s").observe(0.1)
+    reg.histogram("serve.request_s").observe(0.3)
+    snap = reg.snapshot()
+    snap["attribution"] = {
+        "tenant-a": {"d:lineitem": {"requests": 7,
+                                    "staged_bytes": 4096}},
+        "anon": {"*": {"requests": 5}},
+    }
+    return snap
+
+
+def test_openmetrics_parses_under_the_grammar_with_labels():
+    text = to_openmetrics(
+        _snapshot_with_traffic(),
+        followers={"127.0.0.1:9001": _snapshot_with_traffic()})
+    fams = parse_openmetrics(text)  # the acceptance oracle
+    reqs = fams["netsdb_serve_requests_total"]
+    assert reqs["type"] == "counter"
+    by_labels = {tuple(sorted(l.items())): v
+                 for _n, l, v in reqs["samples"]}
+    assert by_labels[()] == 12.0
+    assert by_labels[(("follower", "127.0.0.1:9001"),)] == 12.0
+    # histogram -> summary family with quantiles + _sum/_count
+    lat = fams["netsdb_serve_request_s"]
+    assert lat["type"] == "summary"
+    names = {n for n, _l, _v in lat["samples"]}
+    assert "netsdb_serve_request_s_sum" in names
+    assert "netsdb_serve_request_s_count" in names
+    quantiles = {l.get("quantile") for _n, l, _v in lat["samples"]
+                 if "quantile" in l}
+    assert {"0.5", "0.95", "0.99"} <= quantiles
+    # attribution ledger -> client/set labelled counters
+    att = fams["netsdb_attrib_requests_total"]
+    rows = {(l.get("client"), l.get("set")): v
+            for _n, l, v in att["samples"] if "follower" not in l}
+    assert rows[("tenant-a", "d:lineitem")] == 7.0
+    assert rows[("anon", "*")] == 5.0
+
+
+def test_exporter_emits_only_catalogued_names():
+    reg = MetricsRegistry()
+    reg.counter("serve.requests").inc()
+    reg.counter("rogue.uncatalogued_thing").inc()
+    before = obs.REGISTRY.counter("obs.export.uncatalogued").value
+    text = to_openmetrics(reg.snapshot())
+    assert "rogue" not in text
+    assert obs.REGISTRY.counter("obs.export.uncatalogued").value \
+        > before
+    for fam in parse_openmetrics(text):
+        raw = fam[len("netsdb_"):]
+        raw = raw[:-len("_total")] if raw.endswith("_total") else raw
+        assert any(
+            raw == k.replace(".", "_").replace("-", "_")
+            or raw == f"attrib_{k.replace('.', '_')}"
+            for k in CATALOG), fam
+
+
+def test_attrib_metric_families_are_catalogued():
+    for name in ATTRIB_METRICS:
+        assert f"attrib.{name}" in CATALOG
+
+
+@pytest.mark.parametrize("bad", [
+    "# TYPE netsdb_x bogus_type\n",
+    "netsdb_orphan_sample 1\n",                       # no family
+    "# TYPE netsdb_a counter\nnetsdb_a{open 1\n",     # torn labels
+    "# TYPE netsdb_a counter\nnetsdb_a notanumber\n",
+    "# TYPE netsdb_c counter\nnetsdb_c_bucket 1\n",   # bad suffix
+])
+def test_parser_rejects_grammar_violations(bad):
+    with pytest.raises(ValueError):
+        parse_openmetrics(bad)
+
+
+# -------------------------------------------------------- serve layer
+def _li_cols(n, seed=0):
+    rng = np.random.default_rng(seed)
+    return {
+        "l_shipdate": rng.integers(19940101, 19950101, n, dtype=np.int32),
+        "l_discount": np.full(n, 0.06, np.float32),
+        "l_quantity": np.full(n, 10.0, np.float32),
+        "l_extendedprice": rng.uniform(1000, 2000, n).astype(np.float32),
+    }
+
+
+def test_get_metrics_over_the_wire_and_clean_daemon_stop(tmp_path):
+    from netsdb_tpu.relational import dag as rdag
+
+    ctl = ServeController(
+        Configuration(root_dir=str(tmp_path / "gm"),
+                      page_size_bytes=1 << 16,
+                      page_pool_bytes=1 << 20,
+                      obs_history_interval_s=0.1), port=0)
+    addr = f"127.0.0.1:{ctl.start()}"
+    assert ctl.history.running
+    try:
+        c = _remote(addr, client_id="tenant-x")
+        c.create_database("d")
+        c.create_set("d", "lineitem", type_name="table",
+                     storage="paged")
+        c.send_table("d", "lineitem", ColumnTable(_li_cols(6_000), {}))
+        c.execute_computations(rdag.q06_sink("d"), job_name="q06",
+                               fetch_results=False)
+        # structured form: snapshot + history + deltas
+        m = c.get_metrics()
+        assert m["history"]["readings"] >= 1
+        assert "deltas" in m and "metrics" in m
+        # openmetrics form parses, carries the client's attribution
+        text = c.get_metrics(format="openmetrics")["text"]
+        fams = parse_openmetrics(text)
+        att = fams["netsdb_attrib_requests_total"]
+        assert any(l.get("client") == "tenant-x"
+                   for _n, l, _v in att["samples"])
+        c.close()
+    finally:
+        ctl.shutdown()
+    # clean shutdown joined the snapshot thread — provably stopped
+    assert not ctl.history.running
+
+
+def test_get_metrics_leader_merges_follower_samples(tmp_path):
+    fctl = ServeController(
+        Configuration(root_dir=str(tmp_path / "f")), port=0)
+    faddr = f"127.0.0.1:{fctl.start()}"
+    mctl = ServeController(
+        Configuration(root_dir=str(tmp_path / "m")),
+        port=0, followers=[faddr])
+    addr = f"127.0.0.1:{mctl.start()}"
+    try:
+        c = _remote(addr)
+        c.create_database("d")  # mirrored -> dials the follower
+        text = c.get_metrics(format="openmetrics")["text"]
+        fams = parse_openmetrics(text)
+        follower_samples = [
+            (n, l, v) for fam in fams.values()
+            for (n, l, v) in fam["samples"]
+            if l.get("follower") == faddr]
+        assert follower_samples, "no follower-labelled samples merged"
+        c.close()
+    finally:
+        mctl.shutdown()
+        fctl.shutdown()
+
+
+def test_cli_render_top_shape():
+    from netsdb_tpu.cli import _render_top
+
+    payload = {
+        "history": {"readings": 9, "span_s": 40.0},
+        "deltas": {"dt_s": 10.0,
+                   "rates": {"serve.requests": 4.0},
+                   "derived": {"qps": 4.0, "staged_mb_s": 2.5,
+                               "devcache_hit_rate": 0.75}},
+        "metrics": {"attribution": {
+            "tenant-a": {"d:li": {"requests": 70,
+                                  "staged_bytes": 2e6}}}},
+    }
+    text = _render_top(payload)
+    assert "qps" in text and "4" in text
+    assert "staged_mb_s" in text
+    assert "tenant-a" in text and "d:li" in text
+
+
+def test_cli_obs_top_iterations(tmp_path, capsys):
+    from netsdb_tpu import cli
+
+    ctl = ServeController(
+        Configuration(root_dir=str(tmp_path / "top"),
+                      obs_history_interval_s=0.1), port=0)
+    addr = f"127.0.0.1:{ctl.start()}"
+    try:
+        rc = cli.main(["obs", "--addr", addr, "--top",
+                       "--iterations", "2", "--interval", "0.05"])
+        out = capsys.readouterr().out
+        assert rc == 0
+        assert out.count("== top") == 2
+        rc = cli.main(["obs", "--addr", addr, "--openmetrics"])
+        out = capsys.readouterr().out
+        assert rc == 0
+        parse_openmetrics(out)
+    finally:
+        ctl.shutdown()

@@ -643,3 +643,51 @@ def test_q02_with_only_supplier_paged_takes_host_fallback(
     for a, b in zip(rm, rp):
         np.testing.assert_allclose(np.asarray(a), np.asarray(b),
                                    rtol=1e-4, atol=1e-3)
+
+
+def test_paged_objects_first_batch_respects_page_size(tmp_path):
+    """ADVICE round-5 carry-over (ISSUE 7 satellite): the old append
+    sized its FIRST batch from a 256-byte seed estimate with an
+    8-record floor, so large records transiently blew past
+    page_size_bytes (8 × 1 MB records on one "64 KB" page). Packing
+    now tracks cumulative pickled bytes while the batch fills — every
+    written page stays within the target plus at most ONE record's
+    overshoot (the record that crossed the bound)."""
+    import pickle
+
+    from netsdb_tpu.storage.paged import PagedObjects, PagedTensorStore
+
+    page = 1 << 16  # 64 KB target
+    cfg = Configuration(root_dir=str(tmp_path / "po"),
+                        page_size_bytes=page,
+                        page_pool_bytes=64 << 20)
+    store = PagedTensorStore(cfg, pool_bytes=64 << 20)
+    try:
+        # ~20 KB pickled each: the old floor packed 8+ per first page
+        # (>160 KB); the byte-tracked packing flushes at ~3-4
+        records = [{"blob": bytes(20_000), "i": i} for i in range(40)]
+        rec_bytes = len(pickle.dumps(records[0],
+                                     protocol=pickle.HIGHEST_PROTOCOL))
+        po = PagedObjects.ingest(store, "bigrecs", records)
+        sid = store._set_id("bigrecs")
+        sizes = [store.backend.page_size(pid)
+                 for pid in store.backend.set_pages(sid)]
+        assert len(sizes) >= 8, sizes  # genuinely split across pages
+        assert max(sizes) <= page + 2 * rec_bytes, sizes
+        # round-trip intact, order preserved
+        out = list(po)
+        assert [r["i"] for r in out] == list(range(40))
+
+        # a record BIGGER than the page lands alone on its own page
+        # (can't do better), not batched with neighbours
+        po2 = PagedObjects.ingest(
+            store, "huge", [{"x": bytes(3 * page)}, {"y": 1}, {"z": 2}])
+        sid2 = store._set_id("huge")
+        sizes2 = sorted(store.backend.page_size(pid)
+                        for pid in store.backend.set_pages(sid2))
+        assert len(sizes2) == 2, sizes2
+        assert sizes2[0] < page          # the two small trailers
+        assert sizes2[-1] >= 3 * page    # the oversized loner
+        assert len(list(po2)) == 3
+    finally:
+        store.close()

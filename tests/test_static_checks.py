@@ -56,7 +56,16 @@ Regressions the serve layer must never quietly reacquire:
    ``workloads/`` — daemons and libraries report through the logger
    or the registry, never stdout.
 
-7. **Sampled qid minting.** A query id decides whether a WHOLE query
+7. **Metric-name drift.** Every metric name minted in code (string
+   literals passed to ``registry().counter/gauge/histogram``) must
+   appear in the exporter catalog (``obs/export.CATALOG``) and in
+   ``docs/METRICS.md``, and vice versa — so the OpenMetrics scrape
+   surface, the docs and the code can never silently diverge. The
+   exporter itself emits ONLY catalogued names (skips + counts the
+   rest), which this check makes equivalent to "only documented
+   names".
+
+8. **Sampled qid minting.** A query id decides whether a WHOLE query
    is traced end-to-end (client spans shipped via PUT_TRACE, a server
    profile ringed, an optional device-profiler session) — at high QPS
    that cost must be paid 1-in-N, not per request. The only mint on a
@@ -381,6 +390,92 @@ def check_sampled_qid_discipline() -> list:
     return violations
 
 
+_INSTRUMENT_METHODS = {"counter", "gauge", "histogram"}
+METRICS_DOC = os.path.join(REPO, "docs", "METRICS.md")
+
+
+def _minted_metric_names() -> "tuple[set, set]":
+    """(exact names, f-string prefixes) of every string literal passed
+    to a ``counter()``/``gauge()``/``histogram()`` call in
+    ``netsdb_tpu/``. IfExp branches contribute both constants;
+    f-strings contribute their leading constant part as a PREFIX
+    (``f"obs.traces.{origin}"`` → ``obs.traces.``)."""
+    names, prefixes = set(), set()
+    for dirpath, _dirnames, filenames in os.walk(PKG_DIR):
+        if "__pycache__" in dirpath:
+            continue
+        for fname in sorted(filenames):
+            if not fname.endswith(".py"):
+                continue
+            with open(os.path.join(dirpath, fname)) as f:
+                tree = ast.parse(f.read(), filename=fname)
+            for node in ast.walk(tree):
+                if not (isinstance(node, ast.Call) and node.args
+                        and isinstance(node.func, ast.Attribute)
+                        and node.func.attr in _INSTRUMENT_METHODS):
+                    continue
+                arg = node.args[0]
+                consts = []
+                if isinstance(arg, ast.Constant):
+                    consts = [arg]
+                elif isinstance(arg, ast.IfExp):
+                    consts = [b for b in (arg.body, arg.orelse)
+                              if isinstance(b, ast.Constant)]
+                elif isinstance(arg, ast.JoinedStr) and arg.values \
+                        and isinstance(arg.values[0], ast.Constant):
+                    prefixes.add(str(arg.values[0].value))
+                    continue
+                for c in consts:
+                    if isinstance(c.value, str):
+                        names.add(c.value)
+    return names, prefixes
+
+
+def _documented_metric_names() -> set:
+    """Backticked names in the first column of docs/METRICS.md table
+    rows (lines starting with ``| `name```)."""
+    import re
+
+    out = set()
+    try:
+        with open(METRICS_DOC) as f:
+            for line in f:
+                m = re.match(r"^\|\s*`([^`]+)`", line)
+                if m:
+                    out.add(m.group(1))
+    except OSError:
+        pass
+    return out
+
+
+def check_metric_catalog() -> list:
+    """Code ↔ exporter catalog ↔ docs/METRICS.md, drift-free in every
+    direction that can rot silently."""
+    if REPO not in sys.path:  # standalone-script mode
+        sys.path.insert(0, REPO)
+    from netsdb_tpu.obs.export import CATALOG
+
+    minted, prefixes = _minted_metric_names()
+    documented = _documented_metric_names()
+    out = []
+    for name in sorted(minted - set(CATALOG)):
+        out.append(f"metric {name!r} is minted in code but missing "
+                   f"from obs/export.CATALOG — the OpenMetrics scrape "
+                   f"would silently skip it")
+    for prefix in sorted(prefixes):
+        if not any(k.startswith(prefix) for k in CATALOG):
+            out.append(f"f-string metric family {prefix!r}* has no "
+                       f"catalogued member in obs/export.CATALOG")
+    for name in sorted(set(CATALOG) - documented):
+        out.append(f"metric {name!r} is in obs/export.CATALOG but not "
+                   f"documented in docs/METRICS.md")
+    for name in sorted(documented - set(CATALOG)):
+        out.append(f"metric {name!r} is documented in docs/METRICS.md "
+                   f"but absent from obs/export.CATALOG (stale docs "
+                   f"or a missing catalog entry)")
+    return out
+
+
 def test_serve_layer_clock_and_exception_discipline():
     violations = check_serve_layer()
     assert not violations, "\n" + "\n".join(violations)
@@ -411,11 +506,17 @@ def test_no_unsampled_qid_minting_on_hot_paths():
     assert not violations, "\n" + "\n".join(violations)
 
 
+def test_metric_names_code_catalog_docs_agree():
+    violations = check_metric_catalog()
+    assert not violations, "\n" + "\n".join(violations)
+
+
 def main() -> int:
     violations = (check_serve_layer() + check_staging_discipline()
                   + check_device_upload_discipline()
                   + check_obs_layer() + check_no_prints()
-                  + check_sampled_qid_discipline())
+                  + check_sampled_qid_discipline()
+                  + check_metric_catalog())
     for v in violations:
         print(v, file=sys.stderr)
     print(f"serve-layer + staging static check: "
