@@ -1,0 +1,15 @@
+"""Concurrency-correctness toolchain: AST lint + runtime lock witness.
+
+Static half: :func:`run_lint` runs typed, pluggable AST rules
+(``analysis/rules/``) over the package tree — lock-ordering cycles,
+holds-across-blocking-calls, resource discipline, and every ported
+pre-framework check — surfaced through ``python -m netsdb_tpu.cli
+lint``.  Dynamic half: ``utils/locks.LockWitness`` (lockdep-style)
+records the cross-thread acquisition-order graph at runtime and flags
+cycles that never fired.  ``docs/ANALYSIS.md`` is the human catalog;
+the ``analysis-docs-drift`` rule keeps it honest.
+"""
+
+from netsdb_tpu.analysis.lint import (  # noqa: F401
+    Diagnostic, Module, Project, Rule, all_rules, render, rule_ids,
+    run_lint, to_json)

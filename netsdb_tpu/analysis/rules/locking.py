@@ -1,0 +1,510 @@
+"""Lock-ordering and holds-across-blocking-call rules.
+
+The pass the regex scanners could never do: extract every ``with
+<lock>:`` statement, normalize the lock expression to a *rank token*
+(which class/module owns it), build the nesting graph — lexical
+nesting plus same-module call-through — union it with the seeded
+known hierarchy, and fail on any cycle.  A cycle in this graph is a
+potential AB/BA deadlock that may never have fired in a test; the
+runtime twin (``utils/locks.LockWitness``) catches the orders that
+only materialize dynamically.
+
+Rank tokens, not instances: every per-set serve lock is one rank
+(``ServeController._set_locks[]``), every relation ``RWLock`` is one
+rank PER OWNER CLASS (``PagedObjects.rw``, ``PagedColumns.rw``,
+``_PagedMatrix.rw``) — lock *levels* order, instances don't, and
+collapsing distinct rw families would mix their usage modes.
+
+Token normalization:
+
+* ``self.X`` inside class ``C`` → ``C.X``;
+* module-level ``X`` in module ``m.py`` → ``m.py:X``;
+* ``other.X`` (attribute on a non-self base) → resolved through the
+  project-wide *lock attribute index* (which classes assign a lock to
+  ``self.X``): a unique owner gives ``C.X``; an ambiguous name stays
+  the wildcard ``*.X`` and contributes NO cross-class edges (no false
+  cycles from coincidental attribute names);
+* ``base.rw.read()`` / ``.write()`` → the shared ``RWLock`` rank (the
+  storage layer's leaf — many relations, one level);
+* a local alias (``lk = self._set_lock(db, s)``; ``with lk:``)
+  resolves to the aliased expression's token.
+
+The blocking rule flags calls that can wait on another thread or on
+I/O made while a lock is lexically held: socket ``recv``/``accept``,
+``device_put`` (a host→device copy on the consumer's critical path),
+``queue.get()`` without a timeout, and the seeded site-specific
+patterns (``po.append`` — a ``PagedObjects`` append waits on the
+relation's stream locks).
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+from typing import Dict, Iterable, List, Optional, Set, Tuple
+
+from netsdb_tpu.analysis.lint import (Diagnostic, Module, Project, Rule,
+                                      enclosing_functions, register,
+                                      terminal_name)
+
+#: terminal names that denote a lock when used as ``with <expr>:``
+_LOCK_NAME_RE = re.compile(
+    r"(^|_)(lock|lk|mu|mutex)$|_mu$|_lock$|^mu$|^lock$")
+
+#: constructor call names whose assignment marks ``self.X`` as a lock
+_LOCK_CTORS = {"Lock", "RLock", "RWLock", "TrackedLock", "TrackedRLock",
+               "witness_lock"}
+
+#: the seeded known hierarchy (audited this PR — note the direction:
+#: ``append_table`` nests append_mu -> store lock, and the ingest /
+#: replace paths nest store lock -> relation RWLock; the PRE-PR-6
+#: order (store lock held across PagedObjects.append) is exactly the
+#: inversion this rule exists to catch)
+SEED_EDGES: Tuple[Tuple[str, str], ...] = (
+    ("_StoredSet.append_mu", "SetStore._lock"),
+    # relation rw ranks are per owner class (fresh-ingest appends and
+    # the paged-matmul read both run under the store lock)
+    ("SetStore._lock", "PagedObjects.rw"),
+    ("SetStore._lock", "PagedColumns.rw"),
+    ("SetStore._lock", "_PagedMatrix.rw"),
+    ("_StoredSet.append_mu", "PagedObjects.rw"),
+    ("_StoredSet.append_mu", "PagedColumns.rw"),
+    ("PagedObjects._append_mu", "PagedObjects.rw"),
+    ("SetStore._lock", "DeviceBlockCache._mu"),
+    ("SetStore._lock", "_PyPageBackend._mu"),
+    # serve/server.py mirrored-frame ordering (audited: _run_mirrored
+    # holds the per-set lock across _mirror_once, which takes
+    # _mirror_lock then _followers_mu; SPMD topologies serialize the
+    # whole thing under _collective_lock first)
+    ("ServeController._collective_lock", "ServeController._mirror_lock"),
+    ("ServeController._mirror_lock", "ServeController._followers_mu"),
+    ("ServeController._set_locks_mu", "ServeController._set_locks[]"),
+    ("ServeController._set_locks[]", "ServeController._mirror_lock"),
+)
+
+#: method names that block on I/O or another thread
+_BLOCKING_METHODS = {"recv", "recv_into", "recvmsg", "accept",
+                     "device_put"}
+#: seeded site-specific blocking patterns: (receiver terminal, method)
+_BLOCKING_SEEDED = {("po", "append")}
+#: receiver terminal names treated as queues for the .get() check
+_QUEUE_RECV_RE = re.compile(r"(^|_)q(ueue)?s?$|queue")
+
+#: modules that IMPLEMENT the primitives (their internals necessarily
+#: wait under their own locks)
+_BLOCKING_EXEMPT = ("netsdb_tpu/utils/locks.py",)
+
+
+def _is_lock_name(name: Optional[str]) -> bool:
+    return bool(name) and bool(_LOCK_NAME_RE.search(name))
+
+
+def _lock_attr_index(project: Project) -> Dict[str, Set[str]]:
+    """attr name → set of class names assigning a lock to ``self.X``
+    (constructor calls and ``dataclasses.field(default_factory=
+    threading.Lock)`` defaults)."""
+    def build() -> Dict[str, Set[str]]:
+        idx: Dict[str, Set[str]] = {}
+        for mod in project.modules:
+            if mod.tree is None:
+                continue
+            for cls_name, fn in mod.functions():
+                if cls_name is None:
+                    continue
+                for node in ast.walk(fn):
+                    if not isinstance(node, ast.Assign):
+                        continue
+                    if not _assigns_lock(node.value):
+                        continue
+                    for t in node.targets:
+                        if isinstance(t, ast.Attribute) \
+                                and isinstance(t.value, ast.Name) \
+                                and t.value.id == "self":
+                            idx.setdefault(t.attr, set()).add(cls_name)
+            # dataclass fields: append_mu: Any = field(
+            #     default_factory=threading.Lock)
+            for node in ast.walk(mod.tree):
+                if not isinstance(node, ast.ClassDef):
+                    continue
+                for stmt in node.body:
+                    if isinstance(stmt, ast.AnnAssign) \
+                            and stmt.value is not None \
+                            and isinstance(stmt.target, ast.Name) \
+                            and _field_factory_is_lock(stmt.value):
+                        idx.setdefault(stmt.target.id,
+                                       set()).add(node.name)
+        return idx
+
+    return project.cached("lock_attr_index", build)
+
+
+def _assigns_lock(value: ast.AST) -> bool:
+    if isinstance(value, ast.Call):
+        t = terminal_name(value.func)
+        if t in _LOCK_CTORS:
+            return True
+        return _field_factory_is_lock(value)
+    return False
+
+
+def _field_factory_is_lock(value: ast.AST) -> bool:
+    if not (isinstance(value, ast.Call)
+            and terminal_name(value.func) == "field"):
+        return False
+    for kw in value.keywords:
+        if kw.arg != "default_factory":
+            continue
+        target = kw.value
+        # field(default_factory=lambda: TrackedLock("rank"))
+        if isinstance(target, ast.Lambda) \
+                and isinstance(target.body, ast.Call):
+            target = target.body.func
+        if terminal_name(target) in _LOCK_CTORS:
+            return True
+    return False
+
+
+class _FnLocks:
+    """Per-function lock facts: tokens acquired lexically, plus the
+    ``with``-nesting edges found inside."""
+
+    def __init__(self):
+        self.acquired: Set[str] = set()
+        # (outer, inner, line) lexical nesting edges
+        self.edges: List[Tuple[str, str, int]] = []
+        # (held_token, callee_key, line) same-module call-through;
+        # callee_key = (class_or_None, name) so same-named methods on
+        # DIFFERENT classes cannot collide
+        self.calls_under: List[Tuple[str, Tuple[Optional[str], str],
+                                     int]] = []
+
+
+def _local_aliases(fn: ast.AST) -> Dict[str, ast.AST]:
+    """name → RHS for single-target simple assignments in ``fn`` —
+    the one-hop alias resolver (``lk = self._set_lock(...)``)."""
+    out: Dict[str, ast.AST] = {}
+    for node in ast.walk(fn):
+        if isinstance(node, ast.Assign) and len(node.targets) == 1 \
+                and isinstance(node.targets[0], ast.Name) \
+                and isinstance(node.value, (ast.Attribute, ast.Call)):
+            name = node.targets[0].id
+            # a name assigned twice is not a stable alias
+            out[name] = None if name in out else node.value
+    return {k: v for k, v in out.items() if v is not None}
+
+
+def _lock_token(expr: ast.AST, cls: Optional[str], mod: Module,
+                aliases: Dict[str, ast.AST],
+                attr_index: Dict[str, Set[str]],
+                _depth: int = 0) -> Optional[str]:
+    """Normalize a ``with`` context expression to a rank token, or
+    None when it doesn't look like a lock."""
+    if _depth > 3:
+        return None
+    # rw.read() / rw.write() → the owner class's rw rank (each
+    # relation class is its own lock level; collapsing them all into
+    # one "RWLock" rank mixes read-only and write-append usage of
+    # DIFFERENT lock families and manufactures cycles)
+    if isinstance(expr, ast.Call) and isinstance(expr.func, ast.Attribute):
+        if expr.func.attr in ("read", "write"):
+            base = expr.func.value
+            bt = terminal_name(base)
+            if not (bt == "rw" or (bt or "").endswith("rw")):
+                return None
+            if isinstance(base, ast.Attribute) \
+                    and isinstance(base.value, ast.Name) \
+                    and base.value.id == "self" and cls:
+                return f"{cls}.rw"
+            owners = attr_index.get("rw", set())
+            if len(owners) == 1:
+                return f"{next(iter(owners))}.rw"
+            return "*.rw"  # ambiguous owner: contributes no edges
+        # self._set_lock(db, s) style: a method returning a lock
+        if _is_lock_name(expr.func.attr) or expr.func.attr.endswith(
+                ("_lock", "_mu")):
+            owner = None
+            if isinstance(expr.func.value, ast.Name) \
+                    and expr.func.value.id == "self" and cls:
+                owner = cls
+            name = expr.func.attr
+            # the per-set-lock idiom: a getter named _set_lock maps to
+            # the instance-family rank C._set_locks[]
+            if name.startswith("_set_lock"):
+                return f"{owner or '*'}._set_locks[]"
+            return f"{owner or '*'}.{name}()"
+        return None
+    if isinstance(expr, ast.Call):  # Lock() inline — anonymous, skip
+        return None
+    if isinstance(expr, ast.Attribute):
+        name = expr.attr
+        if not _is_lock_name(name):
+            return None
+        base = expr.value
+        if isinstance(base, ast.Name) and base.id == "self" and cls:
+            return f"{cls}.{name}"
+        owners = attr_index.get(name, set())
+        if len(owners) == 1:
+            return f"{next(iter(owners))}.{name}"
+        return f"*.{name}"
+    if isinstance(expr, ast.Name):
+        if expr.id in aliases:
+            return _lock_token(aliases[expr.id], cls, mod, aliases,
+                               attr_index, _depth + 1)
+        if _is_lock_name(expr.id):
+            return f"{mod.rel}:{expr.id}"
+        return None
+    return None
+
+
+def _collect_fn_locks(mod: Module, cls: Optional[str], fn: ast.AST,
+                      attr_index: Dict[str, Set[str]]) -> _FnLocks:
+    facts = _FnLocks()
+    aliases = _local_aliases(fn)
+
+    def tok(expr: ast.AST) -> Optional[str]:
+        return _lock_token(expr, cls, mod, aliases, attr_index)
+
+    def visit(node: ast.AST, held: List[Tuple[str, int]]):
+        if node is not fn and isinstance(
+                node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                       ast.ClassDef)):
+            return  # nested defs get their own pass (own alias scope)
+        if isinstance(node, (ast.With, ast.AsyncWith)):
+            new_held = list(held)
+            for item in node.items:
+                visit(item.context_expr, held)  # evaluated under OUTER
+                t = tok(item.context_expr)
+                if t is None:
+                    continue
+                facts.acquired.add(t)
+                for outer, _line in new_held:
+                    if outer != t:  # re-entrant same-rank: no edge
+                        facts.edges.append(
+                            (outer, t, item.context_expr.lineno))
+                new_held.append((t, item.context_expr.lineno))
+            for sub in node.body:
+                visit(sub, new_held)
+            return
+        if held and isinstance(node, ast.Call):
+            callee = _same_module_callee(node, cls)
+            if callee is not None:
+                for outer, _line in held:
+                    facts.calls_under.append(
+                        (outer, callee, node.lineno))
+        for child in ast.iter_child_nodes(node):
+            visit(child, held)
+
+    visit(fn, [])
+    return facts
+
+
+def _same_module_callee(call: ast.Call, cls: Optional[str]
+                        ) -> Optional[Tuple[Optional[str], str]]:
+    """``self.m(...)`` → ``(enclosing_class, m)``; bare ``f(...)`` →
+    ``(None, f)``; else None."""
+    f = call.func
+    if isinstance(f, ast.Attribute) and isinstance(f.value, ast.Name) \
+            and f.value.id == "self":
+        return (cls, f.attr)
+    if isinstance(f, ast.Name):
+        return (None, f.id)
+    return None
+
+
+@register
+class LockOrderRule(Rule):
+    """Cross-module lock-acquisition-order cycles (potential AB/BA
+    deadlocks), from lexical nesting + same-module call-through +
+    the seeded hierarchy."""
+
+    id = "lock-order"
+    rationale = ("a cycle in the with-lock nesting graph is a potential "
+                 "deadlock even if no test ever interleaves it")
+
+    def check_project(self, project: Project) -> Iterable[Diagnostic]:
+        attr_index = _lock_attr_index(project)
+        # edge → (path, line) of first sighting; seeds carry none
+        edges: Dict[Tuple[str, str], Optional[Tuple[str, int]]] = {
+            e: None for e in SEED_EDGES}
+        def note_edge(key: Tuple[str, str], site: Tuple[str, int]):
+            # first CODE sighting wins; it also upgrades a seed's
+            # None site so cycle reports name real file:line anchors
+            if edges.get(key) is None:
+                edges[key] = site
+
+        for mod in project.modules:
+            if mod.tree is None:
+                continue
+            # keyed (class, name): same-named methods on different
+            # classes in one module must not collide
+            fn_facts: Dict[Tuple[Optional[str], str], _FnLocks] = {}
+            ordered: List[Tuple[_FnLocks, Module]] = []
+            for cls, fn in mod.functions():
+                facts = _collect_fn_locks(mod, cls, fn, attr_index)
+                fn_facts[(cls, fn.name)] = facts
+                ordered.append((facts, mod))
+            # transitive acquires through same-module calls (bounded)
+            for _ in range(3):
+                changed = False
+                for facts, _m in ordered:
+                    for _outer, callee, _line in facts.calls_under:
+                        callee_facts = fn_facts.get(callee)
+                        if callee_facts and not (
+                                callee_facts.acquired
+                                <= facts.acquired):
+                            facts.acquired |= callee_facts.acquired
+                            changed = True
+                if not changed:
+                    break
+            for facts, m in ordered:
+                for outer, inner, line in facts.edges:
+                    note_edge((outer, inner), (m.rel, line))
+                for outer, callee, line in facts.calls_under:
+                    callee_facts = fn_facts.get(callee)
+                    if not callee_facts:
+                        continue
+                    for inner in callee_facts.acquired:
+                        if inner != outer and not inner.startswith("*."):
+                            note_edge((outer, inner), (m.rel, line))
+        # wildcard tokens never join the graph (ambiguous owners would
+        # manufacture cycles out of coincidental attribute names)
+        graph: Dict[str, Set[str]] = {}
+        for (a, b), _site in edges.items():
+            if a.startswith("*.") or b.startswith("*."):
+                continue
+            graph.setdefault(a, set()).add(b)
+        for cycle in _find_cycles(graph):
+            # anchor the report at the first code-sighted edge in the
+            # cycle (a pure-seed cycle anchors at line 1 of this file)
+            anchor = None
+            for i in range(len(cycle)):
+                e = (cycle[i], cycle[(i + 1) % len(cycle)])
+                if edges.get(e) is not None:
+                    anchor = edges[e]
+                    break
+            path, line = anchor if anchor else ("netsdb_tpu", 1)
+            chain = " -> ".join(cycle + [cycle[0]])
+            sites = "; ".join(
+                f"{a}->{b} at {edges[(a, b)][0]}:{edges[(a, b)][1]}"
+                for a, b in zip(cycle, cycle[1:] + [cycle[0]])
+                if edges.get((a, b)) is not None) or "seeded edges only"
+            yield Diagnostic(
+                rule=self.id, path=path, line=line, col=0,
+                message=f"lock-order cycle {chain} ({sites}) — a "
+                        f"thread taking these in one order can "
+                        f"deadlock a thread taking the other")
+
+
+def _find_cycles(graph: Dict[str, Set[str]]) -> List[List[str]]:
+    """Elementary cycles via DFS back-edges; each cycle reported once
+    (canonical rotation)."""
+    seen: Set[Tuple[str, ...]] = set()
+    out: List[List[str]] = []
+
+    def dfs(node: str, stack: List[str], on_stack: Set[str],
+            visited: Set[str]):
+        visited.add(node)
+        on_stack.add(node)
+        stack.append(node)
+        for nxt in sorted(graph.get(node, ())):
+            if nxt in on_stack:
+                i = stack.index(nxt)
+                cycle = stack[i:]
+                k = cycle.index(min(cycle))
+                canon = tuple(cycle[k:] + cycle[:k])
+                if canon not in seen:
+                    seen.add(canon)
+                    out.append(list(canon))
+            elif nxt not in visited:
+                dfs(nxt, stack, on_stack, visited)
+        stack.pop()
+        on_stack.discard(node)
+
+    visited: Set[str] = set()
+    for node in sorted(graph):
+        if node not in visited:
+            dfs(node, [], set(), visited)
+    return out
+
+
+@register
+class LockBlockingCallRule(Rule):
+    """Blocking calls (socket recv/accept, device_put, queue.get
+    without timeout, seeded patterns) made while a lock is lexically
+    held — the stall-the-world shape of the PR 6 inversion."""
+
+    id = "lock-blocking-call"
+    rationale = ("a blocking call under a lock turns one slow peer "
+                 "into a whole-subsystem stall")
+
+    def select(self, mod: Module) -> bool:
+        return mod.rel not in _BLOCKING_EXEMPT
+
+    def check_module(self, mod: Module) -> Iterable[Diagnostic]:
+        attr_index: Dict[str, Set[str]] = {}
+        for cls, fn in mod.functions():
+            aliases = _local_aliases(fn)
+            yield from self._check_fn(mod, cls, fn, aliases, attr_index)
+
+    def _check_fn(self, mod: Module, cls, fn, aliases, attr_index):
+        def tok(expr):
+            return _lock_token(expr, cls, mod, aliases, attr_index)
+
+        def walk_with(node, held: List[str]):
+            for child in ast.iter_child_nodes(node):
+                if isinstance(child, (ast.FunctionDef,
+                                      ast.AsyncFunctionDef,
+                                      ast.ClassDef)):
+                    continue
+                if isinstance(child, (ast.With, ast.AsyncWith)):
+                    toks = [t for t in (tok(i.context_expr)
+                                        for i in child.items)
+                            if t is not None]
+                    for sub in child.body:
+                        yield from walk_with(sub, held + toks)
+                    # with-item expressions themselves checked under
+                    # the OUTER held set
+                    for i in child.items:
+                        yield from walk_with(i, held)
+                    continue
+                if held and isinstance(child, ast.Call):
+                    d = self._blocking(mod, child, held)
+                    if d is not None:
+                        yield d
+                yield from walk_with(child, held)
+
+        yield from walk_with(fn, [])
+
+    def _blocking(self, mod: Module, call: ast.Call,
+                  held: List[str]) -> Optional[Diagnostic]:
+        f = call.func
+        name = terminal_name(f)
+        if name is None:
+            return None
+        recv = terminal_name(f.value) if isinstance(f, ast.Attribute) \
+            else None
+        what = None
+        if name in _BLOCKING_METHODS:
+            what = f"{name}()"
+        elif recv is not None and (recv, name) in _BLOCKING_SEEDED:
+            what = f"{recv}.{name}() (PagedObjects.append waits on "\
+                   f"the relation's stream locks)"
+        elif name == "get" and recv is not None \
+                and _QUEUE_RECV_RE.search(recv):
+            kws = {kw.arg for kw in call.keywords}
+            nonblocking = "timeout" in kws or any(
+                kw.arg == "block" and isinstance(kw.value, ast.Constant)
+                and kw.value.value is False for kw in call.keywords) \
+                or len(call.args) >= 2 \
+                or (len(call.args) == 1 and isinstance(
+                    call.args[0], ast.Constant)
+                    and call.args[0].value is False)
+            if not nonblocking:
+                what = f"{recv}.get() without a timeout"
+        if what is None:
+            return None
+        return self.diag(
+            mod, call,
+            f"blocking call {what} while holding "
+            f"{', '.join(held)} — a slow peer stalls every waiter on "
+            f"the lock; move the wait outside or bound it")

@@ -622,6 +622,11 @@ def _cmd_micro_bench(args) -> int:
 
         print(json.dumps(micro_bench.bench_explain_overhead(), indent=2))
         return 0
+    if getattr(args, "lint_overhead", False):
+        import json
+
+        print(json.dumps(micro_bench.bench_lint_overhead(), indent=2))
+        return 0
     names = None
     if args.only is not None:
         names = [n.strip() for n in args.only.split(",") if n.strip()]
@@ -908,6 +913,34 @@ def _cmd_obs(args) -> int:
     return 0
 
 
+def _cmd_lint(args) -> int:
+    """``cli lint`` — the one static-analysis entry point CI and
+    humans share (netsdb_tpu/analysis/): file:line:col diagnostics,
+    ``--json`` for scripting, exit 1 on any finding. Runs without
+    importing jax, so a lint gate costs seconds."""
+    from netsdb_tpu.analysis import lint as L
+
+    if args.list_rules:
+        for rule in L.all_rules():
+            print(f"{rule.id:<22} {rule.rationale}")
+        return 0
+    try:
+        diags = L.run_lint(paths=args.paths or None,
+                           rules=args.rule or None)
+    except ValueError as e:  # unknown rule id
+        print(str(e), file=sys.stderr)
+        return 2
+    if args.json:
+        print(json.dumps(L.to_json(diags), indent=2))
+    else:
+        for d in diags:
+            print(str(d))
+        print(f"lint: {'FAIL' if diags else 'ok'} "
+              f"({len(diags)} finding(s), "
+              f"{len(L.rule_ids())} rule(s))")
+    return 1 if diags else 0
+
+
 def _cmd_serve_bench(args) -> int:
     if getattr(args, "device_cache", False):
         from netsdb_tpu.workloads.serve_bench import run_device_cache_bench
@@ -929,9 +962,6 @@ def _cmd_serve_bench(args) -> int:
 
 
 def main(argv=None) -> int:
-    from netsdb_tpu.config import enable_compilation_cache
-
-    enable_compilation_cache()  # every CLI path shares the plan cache
     parser = argparse.ArgumentParser(prog="netsdb_tpu")
     sub = parser.add_subparsers(dest="cmd", required=True)
 
@@ -1001,6 +1031,10 @@ def main(argv=None) -> int:
                    help="cost of per-node operator attribution on the "
                         "staged fold stream (explain on vs off; < 1%% "
                         "budget, ~0 when off)")
+    p.add_argument("--lint-overhead", action="store_true",
+                   help="cost of the runtime lock-order witness on "
+                        "the staged fold stream (witness on vs off; "
+                        "< 2%% budget, ~0 when off)")
 
     sub.add_parser("selftest",
                    help="scripted integration sequence (integratedTests.py)")
@@ -1098,6 +1132,24 @@ def main(argv=None) -> int:
     p.add_argument("--json", action="store_true",
                    help="raw JSON instead of the pretty readout")
 
+    p = sub.add_parser("lint",
+                       help="static concurrency-correctness analysis "
+                            "(netsdb_tpu/analysis/): AST rules — lock "
+                            "ordering, blocking-under-lock, resource "
+                            "discipline, and every ported guard — "
+                            "over the package tree; exit 1 on any "
+                            "finding")
+    p.add_argument("paths", nargs="*",
+                   help="explicit files to lint (default: the whole "
+                        "netsdb_tpu/ package; per-rule directory "
+                        "scoping applies either way)")
+    p.add_argument("--rule", action="append", metavar="RULE_ID",
+                   help="run only this rule (repeatable)")
+    p.add_argument("--list-rules", action="store_true",
+                   help="print the rule catalog (id + rationale)")
+    p.add_argument("--json", action="store_true",
+                   help="machine-readable diagnostics")
+
     p = sub.add_parser("autotune",
                        help="measure physical-strategy crossovers "
                        "(dense-vs-scatter segments, LUT-vs-sort joins) on "
@@ -1141,7 +1193,12 @@ def main(argv=None) -> int:
                    help="rule-based bandit or live actor-critic (DRL)")
 
     args = parser.parse_args(argv)
+    if args.cmd != "lint":  # lint must not import jax (speed + CI)
+        from netsdb_tpu.config import enable_compilation_cache
+
+        enable_compilation_cache()  # every CLI path shares the plan cache
     return {"info": _cmd_info, "bench": _cmd_bench, "pdml": _cmd_pdml,
+            "lint": _cmd_lint,
             "autotune": _cmd_autotune,
             "transformer-bench": _cmd_transformer_bench,
             "reddit-bench": _cmd_reddit_bench,

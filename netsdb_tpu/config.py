@@ -127,6 +127,15 @@ class Configuration:
     # hit-rate trends). interval <= 0 or len < 2 disables the thread.
     obs_history_interval_s: float = 5.0
     obs_history_len: int = 120
+    # --- concurrency correctness (netsdb_tpu/analysis/ + utils/locks) ---
+    # lockdep-style runtime lock-order witness: on, every TrackedLock/
+    # named-RWLock acquisition records rank edges (held -> acquired)
+    # into one bounded process graph and flags cycles — potential
+    # AB/BA deadlocks that never fired. The tier-1 suite enables it via
+    # conftest; production defaults off (disabled cost: one global
+    # read + is-None check per acquisition; enabled cost pinned < 2%
+    # by `micro_bench --lint-overhead`).
+    lock_witness: bool = False
     # --- execution ---
     num_threads: int = 4  # host-side IO/pipeline threads (not device parallelism)
     enable_compression: bool = True  # host spill compression (ref -DENABLE_COMPRESSION)

@@ -106,6 +106,12 @@ def _catalog() -> Dict[str, Tuple[str, str]]:
                             "bucket"),
         ("slo.breaches", "SLO objective breach transitions"),
         ("slo.recoveries", "SLO objective recovery transitions"),
+        ("analysis.violations", "runtime lock-order cycles detected "
+                                "by the lockdep witness"),
+    )
+    gauges = (
+        ("analysis.lock_edges", "distinct lock-rank acquisition-order "
+                                "edges observed by the witness"),
     )
     hists = (
         ("serve.request_s", "server-side frame latency seconds "
@@ -119,6 +125,8 @@ def _catalog() -> Dict[str, Tuple[str, str]]:
     out: Dict[str, Tuple[str, str]] = {}
     for name, help_ in counters:
         out[name] = ("counter", help_)
+    for name, help_ in gauges:
+        out[name] = ("gauge", help_)
     for name, help_ in hists:
         out[name] = ("histogram", help_)
     for name in ATTRIB_METRICS:

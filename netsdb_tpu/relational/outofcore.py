@@ -63,7 +63,7 @@ class PagedColumns:
         # run OUTSIDE the SetStore lock, so a concurrent append/drop
         # could free or grow pages mid-stream; streams hold read, the
         # mutators hold write (the arena pin, Python-side)
-        self.rw = RWLock()
+        self.rw = RWLock(name="PagedColumns.rw")
         self.dropped = False  # set by drop(); appends must not
         # resurrect freed arena names (a fresh put under a dead name
         # would leak unreferenced pages)
@@ -420,10 +420,15 @@ class PagedColumns:
         page feed (``FrontendQueryTestServer.cc:785-890`` streams each
         node's local pages to the client page by page): per-frame bytes
         bounded by one page, and the device never sees the data."""
-        for cols, valid, _start in self.stream(prefetch, device=False):
-            n = int(np.asarray(valid).sum())
-            yield ColumnTable({k: v[:n] for k, v in cols.items()},
-                              dict(self.dicts), None)
+        # closing: an abandoned OUTER iterator (the serve wire loop
+        # stops early / errors) must close the inner locked stream NOW,
+        # not at GC — GeneratorExit propagates through the with
+        with contextlib.closing(
+                self.stream(prefetch, device=False)) as chunks:
+            for cols, valid, _start in chunks:
+                n = int(np.asarray(valid).sum())
+                yield ColumnTable({k: v[:n] for k, v in cols.items()},
+                                  dict(self.dicts), None)
 
     def to_host_table(self) -> ColumnTable:
         """Materialize the relation as one HOST-resident ColumnTable

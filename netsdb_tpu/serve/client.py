@@ -15,6 +15,7 @@ unchanged against either client.
 
 from __future__ import annotations
 
+import contextlib
 import dataclasses
 import queue as _queue
 import random
@@ -1110,17 +1111,21 @@ class RemoteClient:
         parts: dict = {}
         dicts: dict = {}
         got = False
-        for item in self.scan_stream(db, set_name, max_frame_bytes):
-            if not isinstance(item, ColumnTable):
-                raise TypeError(
-                    f"set {db}:{set_name} holds "
-                    f"{type(item).__name__} items, not tables")
-            got = True
-            dicts.update(item.dicts)
-            cols = item.compact().cols if item.valid is not None \
-                else item.cols
-            for k, v in cols.items():
-                parts.setdefault(k, []).append(np.asarray(v))
+        # closing: the TypeError below abandons the stream mid-scan —
+        # the generator (and its socket) must close NOW, not at GC
+        with contextlib.closing(
+                self.scan_stream(db, set_name, max_frame_bytes)) as items:
+            for item in items:
+                if not isinstance(item, ColumnTable):
+                    raise TypeError(
+                        f"set {db}:{set_name} holds "
+                        f"{type(item).__name__} items, not tables")
+                got = True
+                dicts.update(item.dicts)
+                cols = item.compact().cols if item.valid is not None \
+                    else item.cols
+                for k, v in cols.items():
+                    parts.setdefault(k, []).append(np.asarray(v))
         if not got:
             raise ValueError(f"set {db}:{set_name} is empty")
         return ColumnTable({k: np.concatenate(v)

@@ -60,6 +60,7 @@ from netsdb_tpu.serve.protocol import (
     tensor_from_wire,
 )
 from netsdb_tpu.storage.store import SetIdentifier
+from netsdb_tpu.utils.locks import TrackedLock
 from netsdb_tpu.utils.timing import deadline_after, seconds_left, wall_now
 
 #: introspection/meta frame types — excluded from the serve.requests/
@@ -592,7 +593,13 @@ class ServeController:
         # of {undialed, active, degraded}.
         self._links: Dict[str, _FollowerLink] = {}
         self._degraded: Dict[str, str] = {}
-        self._followers_mu = threading.Lock()
+        self._followers_mu = TrackedLock("ServeController._followers_mu")
+        # the runtime lock-order witness (utils/locks.py): config-
+        # gated so a production daemon can run lockdep-style checks
+        if getattr(config, "lock_witness", False):
+            from netsdb_tpu.utils.locks import enable_witness
+
+            enable_witness()
         # set while a follower resync holds the mutation path; mutating
         # frames wait for it (bounded by resync_grace_s) then fail typed
         self._resync_idle = threading.Event()
@@ -637,7 +644,7 @@ class ServeController:
             config, "obs_device_profile_dir", None)
         # one jax.profiler session at a time: concurrent traced queries
         # SKIP (non-blocking acquire), never queue behind the profiler
-        self._profiler_mu = threading.Lock()
+        self._profiler_mu = TrackedLock("ServeController._profiler_mu")
         self.library = Client(config)  # the resident state
         # ORDERING MODEL for mirrored frames (the SPMD argument):
         # - _mirror_lock is held only long enough to ENQUEUE a frame
@@ -662,15 +669,18 @@ class ServeController:
         #   sets — the common ingest pattern — run concurrently, which
         #   is the round-4 concurrency win; reads never block on any
         #   of this.
-        self._mirror_lock = threading.Lock()
-        self._collective_lock = threading.Lock()
+        self._mirror_lock = TrackedLock("ServeController._mirror_lock")
+        self._collective_lock = TrackedLock(
+            "ServeController._collective_lock")
         self._order = _RWOrder()
-        self._set_locks: Dict[Tuple[str, str], threading.Lock] = {}
-        self._set_locks_mu = threading.Lock()
+        # per-set locks share ONE witness rank: lock LEVELS order, not
+        # instances (two different sets' locks never nest)
+        self._set_locks: Dict[Tuple[str, str], TrackedLock] = {}
+        self._set_locks_mu = TrackedLock("ServeController._set_locks_mu")
         self._jobs_sem = threading.Semaphore(max_jobs or config.num_threads)
         self._job_seq = itertools.count(1)
         self._jobs: Dict[int, Dict[str, Any]] = {}
-        self._jobs_lock = threading.Lock()
+        self._jobs_lock = TrackedLock("ServeController._jobs_lock")
         self._started = time.monotonic()  # uptime only — never wall
         self._stop = threading.Event()
         self._listener: Optional[socket.socket] = None
@@ -1577,10 +1587,11 @@ class ServeController:
         MsgType.SEND_DATA, MsgType.SEND_MATRIX, MsgType.LOAD_SET,
     })
 
-    def _set_lock(self, db: str, set_name: str) -> threading.Lock:
+    def _set_lock(self, db: str, set_name: str) -> TrackedLock:
         with self._set_locks_mu:
-            return self._set_locks.setdefault((db, set_name),
-                                              threading.Lock())
+            return self._set_locks.setdefault(
+                (db, set_name),
+                TrackedLock("ServeController._set_locks[]"))
 
     def _run_mirrored(self, typ, payload, codec, handler, token=None,
                       qid=None, client=None):

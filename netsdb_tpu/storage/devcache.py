@@ -52,6 +52,7 @@ from collections import OrderedDict
 from typing import Any, Dict, List, Optional, Tuple
 
 from netsdb_tpu import obs
+from netsdb_tpu.utils.locks import TrackedLock
 
 
 def to_device(x, sharding=None):
@@ -116,7 +117,7 @@ class DeviceBlockCache:
     """
 
     def __init__(self, budget_bytes: int = 0):
-        self._mu = threading.Lock()
+        self._mu = TrackedLock("DeviceBlockCache._mu")
         self._budget = int(budget_bytes or 0)
         # key -> (blocks, nbytes); insertion order IS recency order
         self._entries: "OrderedDict[Tuple, Tuple[List[Any], int]]" = \

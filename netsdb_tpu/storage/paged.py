@@ -24,6 +24,7 @@ from typing import Dict, Iterator, Optional, Tuple
 import numpy as np
 
 from netsdb_tpu.config import Configuration, DEFAULT_CONFIG
+from netsdb_tpu.utils.locks import TrackedLock
 
 
 class _PyPageBackend:
@@ -35,7 +36,7 @@ class _PyPageBackend:
     per-set page lists."""
 
     def __init__(self):
-        self._mu = threading.Lock()
+        self._mu = TrackedLock("_PyPageBackend._mu")
         self._pages: Dict[int, bytes] = {}
         self._sets: Dict[int, list] = {}
         self._next = 1
@@ -158,7 +159,7 @@ class PagedObjects:
         self.store = store
         self.name = name
         self.num_items = num_items
-        self.rw = RWLock()
+        self.rw = RWLock(name="PagedObjects.rw")
         # serializes concurrent appends against each other; appends
         # hold rw.READ (not write — see append()) so they never wait
         # for in-flight record streams to drain. Store-routed appends
@@ -166,7 +167,7 @@ class PagedObjects:
         # one orders appends against the store's OTHER per-set
         # mutations; this one is the handle's own guarantee, so a
         # direct ``po.append`` (no store in sight) is still safe.
-        self._append_mu = threading.Lock()
+        self._append_mu = TrackedLock("PagedObjects._append_mu")
         self.dropped = False
         store.backend.create_set(store._set_id(name))
 
@@ -298,7 +299,7 @@ class PagedTensorStore:
         # so concurrent streams can't interleave the prune/append and
         # drop a tracked reader
         self._readers: list = []
-        self._readers_lock = threading.Lock()
+        self._readers_lock = TrackedLock("PagedTensorStore._readers_lock")
         self._closed = False
         if force_python:
             self.backend = _PyPageBackend()

@@ -36,6 +36,7 @@ import numpy as np
 
 from netsdb_tpu.config import Configuration, DEFAULT_CONFIG
 from netsdb_tpu.core.blocked import BlockedTensor, BlockMeta
+from netsdb_tpu.utils.locks import TrackedLock, TrackedRLock
 
 
 class SetIdentifier(NamedTuple):
@@ -71,7 +72,8 @@ class _StoredSet:
     # serializes PAGED appends per set OUTSIDE the global store lock
     # (an append must wait for in-flight streams to drain — rw.write —
     # and that wait must not freeze every unrelated store operation)
-    append_mu: Any = dataclasses.field(default_factory=threading.Lock)
+    append_mu: Any = dataclasses.field(
+        default_factory=lambda: TrackedLock("_StoredSet.append_mu"))
     persistence: str = "transient"  # ref PersistenceType (DataTypes.h:53)
     eviction: str = "lru"  # ref LocalitySet replacement policy
     last_access: float = 0.0
@@ -124,7 +126,7 @@ class _PagedMatrix:
         if self.rw is None:
             from netsdb_tpu.utils.locks import RWLock
 
-            self.rw = RWLock()
+            self.rw = RWLock(name="_PagedMatrix.rw")
 
 
 def _locked(method):
@@ -156,7 +158,13 @@ class SetStore:
         # serve-layer handler threads mutate sets concurrently (the
         # reference guards Pangea's set maps with pthread mutexes);
         # reentrant because e.g. add_data -> _maybe_evict -> flush
-        self._lock = threading.RLock()
+        self._lock = TrackedRLock("SetStore._lock")
+        # the runtime lock-order witness (utils/locks.py): config-
+        # gated so a production daemon can run lockdep-style checks
+        if getattr(config, "lock_witness", False):
+            from netsdb_tpu.utils.locks import enable_witness
+
+            enable_witness()
         # sets whose items include a shared-pool tensor (dedup/pool.py)
         # — keeps pool-bytes accounting O(pooled sets)
         self._pooled: set = set()
@@ -351,6 +359,7 @@ class SetStore:
                 self._touch(s)
         if po is not None:
             with s.append_mu:  # per-set order among concurrent appends
+                # lint: disable=lock-blocking-call -- append_mu exists to order THIS set's appends behind the relation locks; the global store lock stays released
                 po.append(items)
             with self._lock:
                 if self._sets.get(ident) is s:

@@ -17,6 +17,7 @@ subcommand) and smoke-tested in ``tests/test_micro_bench.py``.
 
 from __future__ import annotations
 
+import threading
 import time
 from typing import Callable, Dict, List, Tuple
 
@@ -691,6 +692,167 @@ def bench_explain_overhead(rows: int = 2_000_000,
         out["off_path_overhead_pct"] = round(
             100.0 * min(off_trials) * int(out["chunks"]) / off_med, 6)
     finally:
+        store.close()
+        shutil.rmtree(root, ignore_errors=True)
+    return out
+
+
+def bench_lint_overhead(rows: int = 2_000_000, page_rows: int = 65_536,
+                        repeats: int = 15) -> Dict[str, object]:
+    """Cost of the runtime lock-order witness on the staged fold
+    stream — the ``--lint-overhead`` mode, structured exactly like
+    ``--obs-overhead``: the same warmed q01-shaped fold runs with the
+    witness installed (every TrackedLock / named-RWLock acquisition
+    then pays stack + edge bookkeeping) vs bare.
+
+    * ``overhead_pct``/``noise_pct`` — END-TO-END paired A/B, arms
+      alternating within each repeat so drift cancels; the < 2%
+      acceptance budget reads against this (and against the
+      deterministic bound below, which scheduler noise can't touch).
+    * ``accounting_overhead_pct`` — DETERMINISTIC bound: the exact
+      enabled-path cost of one acquire+release pair (site capture,
+      held-stack push/pop, edge-set consult), timed in isolation and
+      scaled by the stream's MEASURED acquisition count.
+    * ``off_path_ns`` — what every acquisition pays with the witness
+      disabled: one module-global read + an is-None check (the "~0
+      when off" claim)."""
+    import contextlib
+    import shutil
+    import tempfile
+
+    import jax
+    import jax.numpy as jnp
+
+    from netsdb_tpu.config import Configuration
+    from netsdb_tpu.relational.outofcore import PagedColumns
+    from netsdb_tpu.storage.paged import PagedTensorStore
+    from netsdb_tpu.utils import locks
+
+    rng = np.random.default_rng(0)
+    n_keys = 4096
+    root = tempfile.mkdtemp(prefix="lint_bench_")
+    cfg = Configuration(root_dir=root)
+    store = PagedTensorStore(cfg, pool_bytes=256 << 20)
+    out: Dict[str, object] = {"rows": rows, "page_rows": page_rows,
+                              "repeats": repeats}
+    prev_witness = locks.witness()
+    locks.disable_witness()
+    try:
+        fc = {
+            "k": rng.integers(0, n_keys, rows, dtype=np.int32),
+            "qty": rng.uniform(1.0, 50.0, rows).astype(np.float32),
+            "price": rng.uniform(1.0, 100.0, rows).astype(np.float32),
+        }
+        pc = PagedColumns.ingest(store, "lintbench", fc,
+                                 row_block=page_rows)
+        out["chunks"] = pc.num_pages()
+
+        def raw_step(acc, k, qty, price, valid):
+            seg = jnp.where(valid, k, 0)
+            vals = jnp.stack([qty, price, jnp.ones_like(price)], axis=1)
+            vals = jnp.where(valid[:, None], vals, 0.0)
+            return acc + jax.ops.segment_sum(vals, seg,
+                                             num_segments=n_keys)
+
+        step = jax.jit(raw_step)
+
+        def run_once():
+            acc = jnp.zeros((n_keys, 3), jnp.float32)
+            with contextlib.closing(pc.stream()) as chunks:
+                for ccols, valid, _start in chunks:
+                    acc = step(acc, ccols["k"], ccols["qty"],
+                               ccols["price"], valid)
+            np.asarray(acc)
+
+        run_once()  # compile
+        run_once()  # warm the page cache / spill state
+
+        def one(witnessed: bool) -> float:
+            if witnessed:
+                with locks.witness_scope():
+                    t0 = time.perf_counter()
+                    run_once()
+                    return time.perf_counter() - t0
+            t0 = time.perf_counter()
+            run_once()
+            return time.perf_counter() - t0
+
+        pairs = []
+        for i in range(repeats):
+            if i % 2 == 0:
+                u = one(False)
+                t = one(True)
+            else:
+                t = one(True)
+                u = one(False)
+            pairs.append((u, t))
+
+        def med(vals):
+            s = sorted(vals)
+            n = len(s)
+            return s[n // 2] if n % 2 else (s[n // 2 - 1] + s[n // 2]) / 2
+
+        off_med = med([u for u, _ in pairs])
+        deltas = sorted(t - u for u, t in pairs)
+        d_med = med(deltas)
+        q1 = med(deltas[:len(deltas) // 2 + 1])
+        q3 = med(deltas[len(deltas) // 2:])
+        out["witness_off_s"] = round(off_med, 4)
+        out["witness_on_s"] = round(off_med + d_med, 4)
+        out["overhead_pct"] = round(100.0 * d_med / off_med, 2)
+        out["noise_pct"] = round(100.0 * abs(q3 - q1) / off_med, 2)
+
+        # the stream's tracked-acquisition count (one witnessed run)
+        with locks.witness_scope() as w:
+            run_once()
+            out["acquisitions_per_run"] = int(w.report()["acquisitions"])
+            out["rank_edges"] = int(w.report()["edges"])
+
+        # deterministic bound: one enabled acquire+release pair in
+        # isolation (a held outer lock so the edge path runs), scaled
+        # by the measured acquisition count
+        n_acct = 5_000
+        trials = []
+        with locks.witness_scope():
+            outer = locks.TrackedLock("lintbench.outer")
+            inner = locks.TrackedLock("lintbench.inner")
+            with outer:
+                for _ in range(8):
+                    t0 = time.perf_counter()
+                    for _ in range(n_acct):
+                        with inner:
+                            pass
+                    trials.append((time.perf_counter() - t0) / n_acct)
+        per_acq = min(trials)
+        out["enabled_us_per_acquire"] = round(per_acq * 1e6, 3)
+        out["accounting_overhead_pct"] = round(
+            100.0 * per_acq * int(out["acquisitions_per_run"])
+            / off_med, 4)
+
+        # the off path: the same pair with NO witness installed, minus
+        # the raw threading.Lock floor = the is-None check cost
+        bare = threading.Lock()
+        off_trials, floor_trials = [], []
+        probe = locks.TrackedLock("lintbench.off")
+        for _ in range(8):
+            t0 = time.perf_counter()
+            for _ in range(n_acct):
+                with probe:
+                    pass
+            off_trials.append((time.perf_counter() - t0) / n_acct)
+            t0 = time.perf_counter()
+            for _ in range(n_acct):
+                with bare:
+                    pass
+            floor_trials.append((time.perf_counter() - t0) / n_acct)
+        off_ns = max(0.0, (min(off_trials) - min(floor_trials)) * 1e9)
+        out["off_path_ns"] = round(off_ns, 1)
+        out["off_path_overhead_pct"] = round(
+            100.0 * (off_ns / 1e9)
+            * int(out["acquisitions_per_run"]) / off_med, 6)
+    finally:
+        if prev_witness is not None:
+            locks._WITNESS = prev_witness
         store.close()
         shutil.rmtree(root, ignore_errors=True)
     return out

@@ -51,3 +51,31 @@ def pytest_configure(config):
     config.addinivalue_line(
         "markers", "chaos: seeded-deterministic fault-injection tests for "
         "the serve control plane (fast, CPU-only — these stay in tier-1)")
+    # lockdep-style runtime witness (utils/locks.py): record the
+    # cross-thread lock acquisition-order graph for the WHOLE suite —
+    # an AB/BA inversion that never actually interleaves still gets
+    # caught, and pytest_sessionfinish fails the run on any cycle
+    from netsdb_tpu.utils import locks
+
+    locks.enable_witness()
+
+
+def pytest_sessionfinish(session, exitstatus):
+    from netsdb_tpu.utils import locks
+
+    w = locks.witness()
+    if w is None or not w.violations:
+        return
+    rep = w.report()
+    tr = session.config.pluginmanager.get_plugin("terminalreporter")
+    out = (tr._tw.line if tr is not None else
+           lambda s, **k: print(s))  # noqa: T201 — terminal fallback
+    out("")
+    out(f"LOCK WITNESS: {len(rep['violations'])} lock-order "
+        f"violation(s) recorded during the suite "
+        f"({rep['edges']} rank edges observed):", red=True)
+    for v in rep["violations"]:
+        cyc = " -> ".join(v["cycle"])
+        sites = "; ".join(f"{r} at {s}" for r, s in v["sites"].items())
+        out(f"  cycle {cyc} [{v['thread']}] ({sites})", red=True)
+    session.exitstatus = 1

@@ -1,0 +1,17 @@
+"""KNOWN-BAD fixture: blocking calls made while a lock is held —
+socket recv, device_put, and an unbounded queue get.
+
+Parsed by the lint tests, never imported.
+"""
+
+import threading
+
+state_mu = threading.Lock()
+
+
+def pump(sock, jax, chunk, work_queue):
+    with state_mu:
+        frame = sock.recv(65536)  # slow peer stalls every waiter
+        block = jax.device_put(chunk)  # upload stall under the lock
+        item = work_queue.get()  # unbounded wait under the lock
+    return frame, block, item

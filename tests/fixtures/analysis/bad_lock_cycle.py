@@ -1,0 +1,21 @@
+"""KNOWN-BAD fixture: AB/BA lock-order cycle across two functions.
+
+Parsed by the lint tests, never imported.
+"""
+
+import threading
+
+pool_mu = threading.Lock()
+index_mu = threading.Lock()
+
+
+def ingest():
+    with pool_mu:
+        with index_mu:
+            pass
+
+
+def compact():
+    with index_mu:
+        with pool_mu:  # reverse order: the classic AB/BA inversion
+            pass

@@ -1,0 +1,30 @@
+"""KNOWN-BAD fixture: a three-rank cycle through self-attribute locks
+plus a same-module call made while a lock is held (the call-through
+edge the lexical pass alone would miss), plus a local alias.
+
+Parsed by the lint tests, never imported.
+"""
+
+import threading
+
+
+class Engine:
+    def __init__(self):
+        self._sched_lock = threading.Lock()
+        self._table_mu = threading.Lock()
+        self._wal_mu = threading.Lock()
+
+    def admit(self):
+        with self._sched_lock:
+            self._flush()  # call-through: acquires _table_mu inside
+
+    def _flush(self):
+        with self._table_mu:
+            with self._wal_mu:
+                pass
+
+    def checkpoint(self):
+        lk = self._wal_mu  # alias: the rule must see through it
+        with lk:
+            with self._sched_lock:  # wal -> sched closes the cycle
+                pass

@@ -1,0 +1,28 @@
+"""KNOWN-BAD fixture: a suppression without a reason (must surface as
+``bad-suppression`` and must NOT silence the finding), plus a stale
+suppression that matches nothing (``unused-suppression`` on full
+runs).
+
+Parsed by the lint tests, never imported.
+"""
+
+import threading
+
+mu = threading.Lock()
+
+
+def request(sock):
+    with mu:
+        # lint: disable=lock-blocking-call
+        return sock.recv(65536)
+
+
+def fine():
+    # lint: disable=lock-blocking-call -- nothing here ever blocked; this comment is stale on purpose
+    return 7
+
+
+def typoed(pc):
+    # lint: disable=iter-closs -- typo'd rule id: must be flagged, not silently dead
+    for chunk in pc.stream():
+        pass

@@ -1,0 +1,24 @@
+"""KNOWN-BAD fixture: stream iterators consumed without close
+discipline — direct iteration and a never-closed assignment.
+
+Parsed by the lint tests, never imported.
+"""
+
+
+def drain_direct(pc):
+    total = 0
+    for chunk, valid, _start in pc.stream():  # direct: leak on break
+        total += int(valid.sum())
+        if total > 100:
+            break
+    return total
+
+
+def drain_assigned(pc):
+    it = pc.stream_tables()  # assigned, never closed
+    return next(iter(it))
+
+
+def drain_module_attr(staging, src, place):
+    st = staging.stage_stream(src, place)  # attribute form, unclosed
+    return next(iter(st))

@@ -1,0 +1,32 @@
+"""KNOWN-GOOD fixture: every ownership-transfer and close pattern the
+iter-close rule must accept.
+
+Parsed by the lint tests, never imported.
+"""
+
+import contextlib
+
+
+def drain_closing(pc):
+    with contextlib.closing(pc.stream()) as chunks:
+        return sum(1 for _ in chunks)
+
+
+def drain_try_finally(pc):
+    it = pc.stream_tables()
+    try:
+        return next(iter(it))
+    finally:
+        it.close()
+
+
+def handoff(pc, stage_stream, place):
+    return stage_stream(pc.stream(), place)  # ownership transferred
+
+
+def delegate(pc):
+    yield from pc.stream()  # the caller owns the composite
+
+
+def comprehension(store):
+    return [b for _, b in store.stream_blocks("w.mat")]  # drains fully

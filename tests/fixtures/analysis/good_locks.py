@@ -1,0 +1,27 @@
+"""KNOWN-GOOD fixture: consistent lock ordering, bounded waits, and
+re-entrant same-rank nesting (no self-edge false positives).
+
+Parsed by the lint tests, never imported.
+"""
+
+import threading
+
+pool_mu = threading.Lock()
+index_mu = threading.Lock()
+
+
+def ingest():
+    with pool_mu:
+        with index_mu:
+            pass
+
+
+def compact():
+    with pool_mu:  # same order everywhere: acyclic
+        with index_mu:
+            pass
+
+
+def drain(q):
+    with index_mu:
+        return q.get(timeout=1.0)  # bounded wait: not flagged

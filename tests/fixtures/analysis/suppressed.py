@@ -1,0 +1,22 @@
+"""Fixture: violations with VALID suppressions (reason given) — the
+lint run over this file must come back clean for the suppressed
+rules.
+
+Parsed by the lint tests, never imported.
+"""
+
+import threading
+
+conn_mu = threading.Lock()
+
+
+def request(sock):
+    with conn_mu:
+        # lint: disable=lock-blocking-call -- the conn lock exists to serialize one in-flight request; holding it across the reply IS the protocol
+        return sock.recv(65536)
+
+
+def drain(pc):
+    # lint: disable=iter-close -- fixture: consumer guarantees exhaustion
+    for chunk in pc.stream():
+        pass

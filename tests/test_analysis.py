@@ -1,0 +1,205 @@
+"""Tests for the AST lint framework itself (netsdb_tpu/analysis/):
+known-bad fixtures must be detected, known-good fixtures must pass,
+suppressions must be honored only when documented, and the CLI
+surface must behave (json shape, exit codes, rule listing)."""
+
+import json
+import os
+
+import pytest
+
+from netsdb_tpu.analysis import lint as L
+from netsdb_tpu.analysis import run_lint
+
+FIXTURES = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                        "fixtures", "analysis")
+
+
+def fx(*names):
+    return [os.path.join(FIXTURES, n) for n in names]
+
+
+def rules_of(diags):
+    return {d.rule for d in diags}
+
+
+# --- lock-order -------------------------------------------------------
+
+def test_lock_order_detects_module_level_ab_ba_cycle():
+    diags = run_lint(paths=fx("bad_lock_cycle.py"), rules=["lock-order"])
+    assert len(diags) == 1
+    d = diags[0]
+    assert d.rule == "lock-order"
+    assert "pool_mu" in d.message and "index_mu" in d.message
+    # both edges' sites are named
+    assert d.message.count("bad_lock_cycle.py") >= 2
+
+
+def test_lock_order_sees_call_through_and_alias():
+    diags = run_lint(paths=fx("bad_lock_cycle_methods.py"),
+                     rules=["lock-order"])
+    assert diags, "cycle through call-through + alias went undetected"
+    msg = " ".join(d.message for d in diags)
+    assert "Engine._sched_lock" in msg
+    assert "Engine._wal_mu" in msg
+
+
+def test_lock_order_passes_consistent_ordering():
+    diags = run_lint(paths=fx("good_locks.py"), rules=["lock-order"])
+    assert diags == []
+
+
+def test_lock_order_clean_tree_with_seeds():
+    # the REAL tree against the seeded hierarchy: any regression that
+    # reintroduces the PR 6 inversion (store lock held across a paged
+    # append) becomes a failing edge here
+    diags = run_lint(rules=["lock-order"])
+    assert diags == [], "\n".join(str(d) for d in diags)
+
+
+# --- lock-blocking-call ----------------------------------------------
+
+def test_blocking_calls_under_lock_detected():
+    diags = run_lint(paths=fx("bad_blocking.py"),
+                     rules=["lock-blocking-call"])
+    msgs = [d.message for d in diags]
+    assert len(diags) == 3
+    assert any("recv" in m for m in msgs)
+    assert any("device_put" in m for m in msgs)
+    assert any("get() without a timeout" in m for m in msgs)
+    assert all("state_mu" in m for m in msgs)
+
+
+def test_bounded_queue_get_not_flagged():
+    diags = run_lint(paths=fx("good_locks.py"),
+                     rules=["lock-blocking-call"])
+    assert diags == []
+
+
+# --- iter-close -------------------------------------------------------
+
+def test_unclosed_stream_iterators_detected():
+    diags = run_lint(paths=fx("bad_unclosed.py"), rules=["iter-close"])
+    assert len(diags) == 3
+    assert any("stream()" in d.message for d in diags)
+    assert any("never closed" in d.message for d in diags)
+    # the attribute form (staging.stage_stream) counts as a producer
+    assert any("stage_stream" in d.message for d in diags)
+
+
+def test_ownership_transfer_patterns_pass():
+    diags = run_lint(paths=fx("good_closed.py"), rules=["iter-close"])
+    assert diags == []
+
+
+# --- suppressions -----------------------------------------------------
+
+def test_documented_suppressions_silence_findings():
+    diags = run_lint(paths=fx("suppressed.py"),
+                     rules=["lock-blocking-call", "iter-close"])
+    assert diags == [], "\n".join(str(d) for d in diags)
+
+
+def test_reasonless_suppression_is_a_finding_and_does_not_silence():
+    diags = run_lint(paths=fx("bad_suppression.py"))
+    got = rules_of(diags)
+    assert "bad-suppression" in got  # the reason-less comment itself
+    assert "lock-blocking-call" in got  # ... and it silenced nothing
+
+
+def test_stale_suppression_flagged_on_full_runs_only():
+    full = run_lint(paths=fx("bad_suppression.py"))
+    assert "unused-suppression" in rules_of(full)
+    single = run_lint(paths=fx("bad_suppression.py"),
+                      rules=["iter-close"])
+    assert "unused-suppression" not in rules_of(single)
+
+
+def test_typoed_suppression_id_is_flagged_not_silently_dead():
+    diags = run_lint(paths=fx("bad_suppression.py"),
+                     rules=["iter-close"])
+    msgs = [d.message for d in diags if d.rule == "bad-suppression"]
+    assert any("iter-closs" in m and "unknown rule" in m for m in msgs)
+    # ... and the typo silenced nothing: the finding still fires
+    assert any(d.rule == "iter-close" for d in diags)
+
+
+# --- framework surface ------------------------------------------------
+
+def test_unknown_rule_id_raises():
+    with pytest.raises(ValueError, match="unknown rule"):
+        run_lint(rules=["no-such-rule"])
+
+
+def test_diagnostics_sorted_and_json_shape():
+    diags = run_lint(paths=fx("bad_blocking.py", "bad_unclosed.py"))
+    keys = [(d.path, d.line, d.col, d.rule) for d in diags]
+    assert keys == sorted(keys)
+    payload = L.to_json(diags)
+    assert all(set(d) == {"rule", "path", "line", "col", "message"}
+               for d in payload)
+    json.dumps(payload)  # round-trips
+
+
+def test_every_rule_has_id_and_rationale():
+    rules = L.all_rules()
+    assert len(rules) >= 14
+    for rule in rules:
+        assert rule.id and rule.rationale, rule
+
+
+def test_parse_error_is_a_diagnostic(tmp_path):
+    bad = tmp_path / "broken.py"
+    bad.write_text("def broken(:\n")
+    diags = run_lint(paths=[str(bad)], repo=str(tmp_path))
+    assert [d.rule for d in diags].count("parse-error") == 1
+
+
+# --- cli --------------------------------------------------------------
+
+def test_cli_lint_json_and_exit_codes(capsys):
+    from netsdb_tpu.cli import main
+
+    rc = main(["lint", "--json",
+               os.path.join(FIXTURES, "bad_blocking.py")])
+    out = capsys.readouterr().out
+    assert rc == 1
+    payload = json.loads(out)
+    assert any(d["rule"] == "lock-blocking-call" for d in payload)
+
+    rc = main(["lint", "--json",
+               os.path.join(FIXTURES, "good_locks.py"),
+               "--rule", "lock-order", "--rule", "lock-blocking-call"])
+    assert rc == 0
+    assert json.loads(capsys.readouterr().out) == []
+
+    assert main(["lint", "--rule", "bogus"]) == 2
+
+
+def test_cli_list_rules(capsys):
+    from netsdb_tpu.cli import main
+
+    assert main(["lint", "--list-rules"]) == 0
+    out = capsys.readouterr().out
+    assert "lock-order" in out and "iter-close" in out
+
+
+# --- docs drift -------------------------------------------------------
+
+def test_analysis_docs_catalog_in_sync():
+    diags = run_lint(rules=["analysis-docs-drift"])
+    assert diags == [], "\n".join(str(d) for d in diags)
+
+
+def test_docs_drift_detects_missing_row(tmp_path, monkeypatch):
+    # a repo whose ANALYSIS.md lacks every row: one finding per rule
+    (tmp_path / "docs").mkdir()
+    (tmp_path / "docs" / "ANALYSIS.md").write_text(
+        "| id |\n|---|\n| `lock-order` |\n| `ghost-rule` |\n")
+    src = tmp_path / "empty.py"
+    src.write_text("x = 1\n")
+    diags = run_lint(paths=[str(src)], rules=["analysis-docs-drift"],
+                     repo=str(tmp_path))
+    msgs = " ".join(d.message for d in diags)
+    assert "ghost-rule" in msgs  # documented but unregistered
+    assert "iter-close" in msgs  # registered but undocumented

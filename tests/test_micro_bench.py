@@ -36,3 +36,19 @@ def test_planner_bench_runs():
 
     ops, secs, rate = bench_planner(n=50)
     assert ops == 50 and rate > 0
+
+
+def test_lint_overhead_bench_smoke():
+    # tiny sizes: the shape of the payload and the deterministic
+    # bound's sanity, not the real numbers (those are the CLI's job)
+    out = mb.bench_lint_overhead(rows=20_000, page_rows=4096,
+                                 repeats=3)
+    assert out["chunks"] > 0
+    assert out["acquisitions_per_run"] >= 1
+    assert out["enabled_us_per_acquire"] > 0
+    # the witness budget the acceptance pins: the deterministic bound
+    # must sit far inside 2%, and the off path must be ~0
+    assert out["accounting_overhead_pct"] < 2.0
+    assert out["off_path_overhead_pct"] < 0.1
+    # the A/B arms both ran (medians are positive wall times)
+    assert out["witness_off_s"] > 0 and out["witness_on_s"] > 0

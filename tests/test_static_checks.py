@@ -1,528 +1,80 @@
-"""Static guards for the serve layer and the out-of-core execution
-pipeline — runnable as a script or a test.
+"""Static guards, migrated onto the AST lint framework.
 
-Regressions the serve layer must never quietly reacquire:
-
-1. **Wall-clock deadlines.** ``time.time()`` jumps (NTP steps, manual
-   sets) once broke the 30 s follower dial-retry loop; every deadline
-   in ``netsdb_tpu/serve/`` must use ``time.monotonic()`` (display
-   timestamps go through ``utils.timing.wall_now`` so the intent is
-   explicit). Any ``time.time()`` call — or ``from time import time``
-   — in the serve layer fails this check.
-
-2. **Opaque exception swallowing.** ``except:`` / ``except Exception:``
-   / ``except BaseException:`` handlers that neither bind the
-   exception (``as e`` — it gets typed/forwarded) nor re-raise it
-   erase the typed error taxonomy. AST-checked, so a bare ``raise``
-   anywhere in the handler body counts as re-raising.
-
-3. **Zero-copy tensor framing.** The v3 data plane ships ndarray
-   buffers as out-of-band segments over ``memoryview``s; a single
-   ``.tobytes()`` on the serve path silently reintroduces the
-   full-payload copy the rework removed. Banned in every serve
-   module. Likewise, ``protocol.py`` may touch pickle/cloudpickle
-   ONLY inside the metadata codec (``encode_body``/``decode_body``)
-   — tensor bytes must never ride a pickle stream.
-
-4. **Synchronous device staging.** The out-of-core hot paths
-   (``netsdb_tpu/plan/``, ``netsdb_tpu/relational/outofcore.py``)
-   stage host→device uploads through ``plan/staging.stage_stream`` so
-   the copy overlaps the consumer's compute; a bare ``jax.device_put``
-   inside a loop body (``for``/``while``/comprehension) silently
-   reintroduces the per-chunk upload stall the staging rework removed.
-   ``plan/staging.py`` itself owns the upload calls and is exempt.
-
-5. **Cache-bypassing uploads.** The ``device_put`` IDIOM for
-   store-owned set blocks belongs to ``storage/devcache.to_device``
-   (called from ``stage_stream`` place functions): a direct
-   ``device_put`` in ``netsdb_tpu/storage/``, ``netsdb_tpu/plan/`` or
-   the out-of-core engine bypasses the cross-query device cache — the
-   blocks re-upload every query while the hit/miss counters lie.
-   ``devcache.py`` and ``staging.py`` own the sanctioned calls and are
-   exempt. Scope note: this is a guardrail on the explicit-upload
-   idiom, not a proof — ``jnp.asarray``/``jnp.concatenate`` also
-   commit arrays to the device and cannot be banned wholesale (they
-   pervade legitimate compute); those call sites are kept inside
-   ``place`` functions by review + the loop check above.
-
-6. **Observability discipline.** The obs subsystem (``netsdb_tpu/
-   obs/``) measures deadline-adjacent time and runs inside daemons:
-   it inherits the serve layer's monotonic-clock ban (a span timed on
-   ``time.time()`` jumps with NTP). New counters must live in the
-   central registry, not module-level dicts — a bare module dict is
-   invisible to COLLECT_STATS and un-resettable (the scattered-stats
-   regression the obs subsystem exists to end). And ``print()`` is
-   banned everywhere in ``netsdb_tpu/`` outside ``cli.py`` and
-   ``workloads/`` — daemons and libraries report through the logger
-   or the registry, never stdout.
-
-7. **Metric-name drift.** Every metric name minted in code (string
-   literals passed to ``registry().counter/gauge/histogram``) must
-   appear in the exporter catalog (``obs/export.CATALOG``) and in
-   ``docs/METRICS.md``, and vice versa — so the OpenMetrics scrape
-   surface, the docs and the code can never silently diverge. The
-   exporter itself emits ONLY catalogued names (skips + counts the
-   rest), which this check makes equivalent to "only documented
-   names".
-
-8. **Sampled qid minting.** A query id decides whether a WHOLE query
-   is traced end-to-end (client spans shipped via PUT_TRACE, a server
-   profile ringed, an optional device-profiler session) — at high QPS
-   that cost must be paid 1-in-N, not per request. The only mint on a
-   hot path is ``obs.sample_qid`` (which reads
-   ``config.obs_trace_sample``); a direct ``new_query_id()`` call
-   anywhere outside ``netsdb_tpu/obs/`` reintroduces unsampled
-   always-on tracing and fails this check.
+Every scanner that used to live here as a bespoke ~60-line AST walk is
+now a typed rule in ``netsdb_tpu/analysis/rules/`` (same scope, same
+intent, plus per-rule inline suppressions); each test below is the
+one-line invocation the migration promised.  The full rule catalog —
+including the NEW rules the bespoke scanners could never express
+(lock-ordering cycles, holds-across-blocking-calls, stream-iterator
+close discipline) — is documented in ``docs/ANALYSIS.md`` and gated
+end-to-end by ``tests/test_lint_gate.py`` through ``cli lint``.
 
 Run standalone: ``python tests/test_static_checks.py`` (exit 1 on
-violations) — the CI-script form the pytest wrapper shares.
+violations) — delegates to the same entry point CI uses.
 """
 
-import ast
 import os
 import sys
 
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
-PKG_DIR = os.path.join(REPO, "netsdb_tpu")
-SERVE_DIR = os.path.join(REPO, "netsdb_tpu", "serve")
-PLAN_DIR = os.path.join(REPO, "netsdb_tpu", "plan")
-STORAGE_DIR = os.path.join(REPO, "netsdb_tpu", "storage")
-OBS_DIR = os.path.join(REPO, "netsdb_tpu", "obs")
-OOC_FILE = os.path.join(REPO, "netsdb_tpu", "relational", "outofcore.py")
-
-#: the staging module owns the (background-thread) device_put calls
-_STAGING_EXEMPT = {"staging.py"}
-
-#: the two modules allowed to name device_put at all on the storage/
-#: plan paths — every other call site goes through devcache.to_device
-_UPLOAD_EXEMPT = {"staging.py", "devcache.py"}
-
-#: the metadata codec — the only functions in protocol.py allowed to
-#: name pickle/cloudpickle
-_PICKLE_OK_FUNCS = {"encode_body", "decode_body"}
+if REPO not in sys.path:  # standalone-script mode
+    sys.path.insert(0, REPO)
 
 
-def _is_wall_clock_call(node: ast.Call) -> bool:
-    f = node.func
-    if isinstance(f, ast.Attribute) and f.attr == "time" \
-            and isinstance(f.value, ast.Name) and f.value.id == "time":
-        return True  # time.time()
-    return False
+def _clean(*rule_ids: str) -> None:
+    from netsdb_tpu.analysis import render, run_lint
 
-
-def _handler_reraises(handler: ast.ExceptHandler) -> bool:
-    for sub in ast.walk(handler):
-        if isinstance(sub, ast.Raise):
-            return True
-    return False
-
-
-def _mentions_pickle(node: ast.AST) -> bool:
-    for sub in ast.walk(node):
-        if isinstance(sub, ast.Name) and sub.id in ("pickle", "cloudpickle"):
-            return True
-        if isinstance(sub, (ast.Import, ast.ImportFrom)):
-            names = [a.name for a in sub.names]
-            if isinstance(sub, ast.ImportFrom) and sub.module:
-                names.append(sub.module)
-            if any(n.split(".")[0] in ("pickle", "cloudpickle")
-                   for n in names):
-                return True
-    return False
-
-
-def _check_protocol_pickle(tree: ast.AST, rel: str) -> list:
-    """protocol.py only: pickle/cloudpickle confined to the metadata
-    codec functions — the zero-copy tensor path must never grow a
-    pickle round-trip."""
-    out = []
-    for node in tree.body:
-        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
-            if node.name in _PICKLE_OK_FUNCS:
-                continue
-            if _mentions_pickle(node):
-                out.append(f"{rel}:{node.lineno}: pickle use in "
-                           f"{node.name}() — allowed only in the metadata "
-                           f"codec ({', '.join(sorted(_PICKLE_OK_FUNCS))})")
-        elif _mentions_pickle(node):
-            out.append(f"{rel}:{node.lineno}: module-level pickle "
-                       f"reference in the wire protocol — allowed only "
-                       f"inside the metadata codec functions")
-    return out
-
-
-def _check_file(path: str) -> list:
-    with open(path) as f:
-        src = f.read()
-    tree = ast.parse(src, filename=path)
-    rel = os.path.relpath(path, REPO)
-    out = []
-    if os.path.basename(path) == "protocol.py":
-        out.extend(_check_protocol_pickle(tree, rel))
-    for node in ast.walk(tree):
-        if isinstance(node, ast.Call) \
-                and isinstance(node.func, ast.Attribute) \
-                and node.func.attr == "tobytes":
-            out.append(f"{rel}:{node.lineno}: .tobytes() on the serve "
-                       f"data path — ship the buffer as an out-of-band "
-                       f"segment (memoryview), never a copy")
-        if isinstance(node, ast.Call) and _is_wall_clock_call(node):
-            out.append(f"{rel}:{node.lineno}: time.time() in the serve "
-                       f"layer — deadlines must be time.monotonic() "
-                       f"(display timestamps: utils.timing.wall_now)")
-        if isinstance(node, ast.ImportFrom) and node.module == "time":
-            if any(a.name == "time" for a in node.names):
-                out.append(f"{rel}:{node.lineno}: 'from time import "
-                           f"time' hides wall-clock reads from review")
-        if isinstance(node, ast.ExceptHandler):
-            broad = node.type is None or (
-                isinstance(node.type, ast.Name)
-                and node.type.id in ("Exception", "BaseException"))
-            if broad and node.name is None \
-                    and not _handler_reraises(node):
-                out.append(f"{rel}:{node.lineno}: broad except that "
-                           f"neither binds ('as e') nor re-raises — "
-                           f"type it or forward it (serve/errors.py)")
-    return out
-
-
-def check_serve_layer() -> list:
-    violations = []
-    for name in sorted(os.listdir(SERVE_DIR)):
-        if name.endswith(".py"):
-            violations.extend(_check_file(os.path.join(SERVE_DIR, name)))
-    return violations
-
-
-def check_obs_layer() -> list:
-    """The obs subsystem inherits the serve-layer discipline (monotonic
-    clocks, no opaque except) and adds its own: counters go through
-    the registry, never module-level dicts."""
-    violations = []
-    for name in sorted(os.listdir(OBS_DIR)):
-        if not name.endswith(".py"):
-            continue
-        path = os.path.join(OBS_DIR, name)
-        violations.extend(_check_file(path))
-        violations.extend(_check_module_dict_counters(path))
-    return violations
-
-
-def _check_module_dict_counters(path: str) -> list:
-    """Ban module-level dict-literal assignments in obs/ — every
-    counter belongs to the MetricsRegistry (named, snapshottable,
-    resettable), not a loose module dict the stats frames can't see."""
-    with open(path) as f:
-        tree = ast.parse(f.read(), filename=path)
-    rel = os.path.relpath(path, REPO)
-    out = []
-    for node in tree.body:
-        targets = []
-        if isinstance(node, ast.Assign):
-            targets, value = node.targets, node.value
-        elif isinstance(node, ast.AnnAssign) and node.value is not None:
-            targets, value = [node.target], node.value
-        else:
-            continue
-        if isinstance(value, (ast.Dict, ast.DictComp)):
-            names = ", ".join(getattr(t, "id", "?") for t in targets)
-            out.append(f"{rel}:{node.lineno}: module-level dict "
-                       f"{names!r} in obs/ — counters go through "
-                       f"MetricsRegistry, not bare module dicts")
-    return out
-
-
-#: modules allowed to call print(): the operator CLI and the bench
-#: scripts (their OUTPUT is stdout); everything else in netsdb_tpu/
-#: reports through the logger or the metrics registry
-_PRINT_EXEMPT_DIRS = {os.path.join(PKG_DIR, "workloads")}
-_PRINT_EXEMPT_FILES = {os.path.join(PKG_DIR, "cli.py"),
-                       os.path.join(PKG_DIR, "_reexec.py")}
-
-
-def check_no_prints() -> list:
-    violations = []
-    for dirpath, _dirnames, filenames in os.walk(PKG_DIR):
-        if "__pycache__" in dirpath:
-            continue
-        if any(os.path.commonpath([dirpath, d]) == d
-               for d in _PRINT_EXEMPT_DIRS):
-            continue
-        for name in sorted(filenames):
-            if not name.endswith(".py"):
-                continue
-            path = os.path.join(dirpath, name)
-            if path in _PRINT_EXEMPT_FILES:
-                continue
-            with open(path) as f:
-                tree = ast.parse(f.read(), filename=path)
-            rel = os.path.relpath(path, REPO)
-            for node in ast.walk(tree):
-                if isinstance(node, ast.Call) \
-                        and isinstance(node.func, ast.Name) \
-                        and node.func.id == "print":
-                    violations.append(
-                        f"{rel}:{node.lineno}: print() outside cli.py/"
-                        f"workloads/ — use utils.profiling.get_logger "
-                        f"or a registry counter")
-    return violations
-
-
-_LOOP_NODES = (ast.For, ast.While, ast.AsyncFor, ast.ListComp,
-               ast.SetComp, ast.DictComp, ast.GeneratorExp)
-
-
-def _check_device_put_in_loops(path: str) -> list:
-    """Ban bare ``<anything>.device_put(...)`` calls inside loop bodies
-    — per-chunk uploads must go through ``plan/staging.stage_stream``
-    so the copy overlaps compute instead of stalling the consumer."""
-    with open(path) as f:
-        tree = ast.parse(f.read(), filename=path)
-    rel = os.path.relpath(path, REPO)
-    out = []
-    for loop in ast.walk(tree):
-        if not isinstance(loop, _LOOP_NODES):
-            continue
-        for sub in ast.walk(loop):
-            if isinstance(sub, ast.Call) \
-                    and isinstance(sub.func, ast.Attribute) \
-                    and sub.func.attr == "device_put":
-                out.append(
-                    f"{rel}:{sub.lineno}: synchronous device_put inside "
-                    f"a loop body — stage uploads through "
-                    f"plan/staging.stage_stream so the copy overlaps "
-                    f"the consumer's compute")
-    return out
-
-
-def check_staging_discipline() -> list:
-    files = [os.path.join(PLAN_DIR, n) for n in sorted(os.listdir(PLAN_DIR))
-             if n.endswith(".py") and n not in _STAGING_EXEMPT]
-    files.append(OOC_FILE)
-    violations = []
-    for path in files:
-        violations.extend(_check_device_put_in_loops(path))
-    return violations
-
-
-def _check_direct_device_put(path: str) -> list:
-    """Ban EVERY ``device_put`` mention — attribute call, bare name,
-    or import — so the explicit-upload idiom for store-owned set
-    blocks stays inside ``devcache.to_device``/``stage_stream`` (a
-    bypassing upload re-transfers what the cache holds and corrupts
-    the hit/miss accounting). Guardrail, not a proof: ``jnp.*``
-    constructors also commit to the device and are reviewed, not
-    banned (see module docstring, rule 5)."""
-    with open(path) as f:
-        tree = ast.parse(f.read(), filename=path)
-    rel = os.path.relpath(path, REPO)
-    out = []
-    for node in ast.walk(tree):
-        hit = None
-        if isinstance(node, ast.Call):
-            f_ = node.func
-            if isinstance(f_, ast.Attribute) and f_.attr == "device_put":
-                hit = "call"
-            elif isinstance(f_, ast.Name) and f_.id == "device_put":
-                hit = "call"
-        elif isinstance(node, ast.ImportFrom):
-            if any(a.name == "device_put" for a in node.names):
-                hit = "import"
-        if hit:
-            out.append(
-                f"{rel}:{node.lineno}: direct device_put ({hit}) on a "
-                f"store/plan path — upload set blocks via "
-                f"storage/devcache.to_device (inside a stage_stream "
-                f"place function) so the device cache cannot be "
-                f"silently bypassed")
-    return out
-
-
-def check_device_upload_discipline() -> list:
-    files = []
-    for d in (STORAGE_DIR, PLAN_DIR):
-        files.extend(os.path.join(d, n) for n in sorted(os.listdir(d))
-                     if n.endswith(".py") and n not in _UPLOAD_EXEMPT)
-    files.append(OOC_FILE)
-    violations = []
-    for path in files:
-        violations.extend(_check_direct_device_put(path))
-    return violations
-
-
-def _check_unsampled_qid_mint(path: str) -> list:
-    """Ban ``new_query_id`` (call, attribute call, or import) outside
-    ``netsdb_tpu/obs/`` — hot paths mint through ``obs.sample_qid`` so
-    tracing cost follows ``config.obs_trace_sample``."""
-    with open(path) as f:
-        tree = ast.parse(f.read(), filename=path)
-    rel = os.path.relpath(path, REPO)
-    out = []
-    for node in ast.walk(tree):
-        hit = False
-        if isinstance(node, ast.Call):
-            f_ = node.func
-            hit = (isinstance(f_, ast.Name)
-                   and f_.id == "new_query_id") \
-                or (isinstance(f_, ast.Attribute)
-                    and f_.attr == "new_query_id")
-        elif isinstance(node, ast.ImportFrom):
-            hit = any(a.name == "new_query_id" for a in node.names)
-        if hit:
-            out.append(
-                f"{rel}:{node.lineno}: new_query_id outside obs/ — "
-                f"unsampled qid minting pays full tracing per request; "
-                f"mint through obs.sample_qid "
-                f"(config.obs_trace_sample)")
-    return out
-
-
-def check_sampled_qid_discipline() -> list:
-    violations = []
-    for dirpath, _dirnames, filenames in os.walk(PKG_DIR):
-        if "__pycache__" in dirpath \
-                or os.path.commonpath([dirpath, OBS_DIR]) == OBS_DIR:
-            continue
-        for name in sorted(filenames):
-            if name.endswith(".py"):
-                violations.extend(_check_unsampled_qid_mint(
-                    os.path.join(dirpath, name)))
-    return violations
-
-
-_INSTRUMENT_METHODS = {"counter", "gauge", "histogram"}
-METRICS_DOC = os.path.join(REPO, "docs", "METRICS.md")
-
-
-def _minted_metric_names() -> "tuple[set, set]":
-    """(exact names, f-string prefixes) of every string literal passed
-    to a ``counter()``/``gauge()``/``histogram()`` call in
-    ``netsdb_tpu/``. IfExp branches contribute both constants;
-    f-strings contribute their leading constant part as a PREFIX
-    (``f"obs.traces.{origin}"`` → ``obs.traces.``)."""
-    names, prefixes = set(), set()
-    for dirpath, _dirnames, filenames in os.walk(PKG_DIR):
-        if "__pycache__" in dirpath:
-            continue
-        for fname in sorted(filenames):
-            if not fname.endswith(".py"):
-                continue
-            with open(os.path.join(dirpath, fname)) as f:
-                tree = ast.parse(f.read(), filename=fname)
-            for node in ast.walk(tree):
-                if not (isinstance(node, ast.Call) and node.args
-                        and isinstance(node.func, ast.Attribute)
-                        and node.func.attr in _INSTRUMENT_METHODS):
-                    continue
-                arg = node.args[0]
-                consts = []
-                if isinstance(arg, ast.Constant):
-                    consts = [arg]
-                elif isinstance(arg, ast.IfExp):
-                    consts = [b for b in (arg.body, arg.orelse)
-                              if isinstance(b, ast.Constant)]
-                elif isinstance(arg, ast.JoinedStr) and arg.values \
-                        and isinstance(arg.values[0], ast.Constant):
-                    prefixes.add(str(arg.values[0].value))
-                    continue
-                for c in consts:
-                    if isinstance(c.value, str):
-                        names.add(c.value)
-    return names, prefixes
-
-
-def _documented_metric_names() -> set:
-    """Backticked names in the first column of docs/METRICS.md table
-    rows (lines starting with ``| `name```)."""
-    import re
-
-    out = set()
-    try:
-        with open(METRICS_DOC) as f:
-            for line in f:
-                m = re.match(r"^\|\s*`([^`]+)`", line)
-                if m:
-                    out.add(m.group(1))
-    except OSError:
-        pass
-    return out
-
-
-def check_metric_catalog() -> list:
-    """Code ↔ exporter catalog ↔ docs/METRICS.md, drift-free in every
-    direction that can rot silently."""
-    if REPO not in sys.path:  # standalone-script mode
-        sys.path.insert(0, REPO)
-    from netsdb_tpu.obs.export import CATALOG
-
-    minted, prefixes = _minted_metric_names()
-    documented = _documented_metric_names()
-    out = []
-    for name in sorted(minted - set(CATALOG)):
-        out.append(f"metric {name!r} is minted in code but missing "
-                   f"from obs/export.CATALOG — the OpenMetrics scrape "
-                   f"would silently skip it")
-    for prefix in sorted(prefixes):
-        if not any(k.startswith(prefix) for k in CATALOG):
-            out.append(f"f-string metric family {prefix!r}* has no "
-                       f"catalogued member in obs/export.CATALOG")
-    for name in sorted(set(CATALOG) - documented):
-        out.append(f"metric {name!r} is in obs/export.CATALOG but not "
-                   f"documented in docs/METRICS.md")
-    for name in sorted(documented - set(CATALOG)):
-        out.append(f"metric {name!r} is documented in docs/METRICS.md "
-                   f"but absent from obs/export.CATALOG (stale docs "
-                   f"or a missing catalog entry)")
-    return out
+    diags = run_lint(rules=list(rule_ids))
+    assert not diags, "\n" + render(diags)
 
 
 def test_serve_layer_clock_and_exception_discipline():
-    violations = check_serve_layer()
-    assert not violations, "\n" + "\n".join(violations)
+    _clean("wall-clock", "broad-except")
+
+
+def test_zero_copy_framing_and_pickle_confinement():
+    _clean("tobytes", "pickle-protocol")
 
 
 def test_no_sync_device_put_in_stream_loops():
-    violations = check_staging_discipline()
-    assert not violations, "\n" + "\n".join(violations)
+    _clean("device-put-loop")
 
 
 def test_no_cache_bypassing_device_put():
-    violations = check_device_upload_discipline()
-    assert not violations, "\n" + "\n".join(violations)
+    _clean("device-put-direct")
 
 
-def test_obs_layer_clock_and_registry_discipline():
-    violations = check_obs_layer()
-    assert not violations, "\n" + "\n".join(violations)
+def test_obs_layer_registry_discipline():
+    _clean("module-dict-counter")
 
 
 def test_no_prints_outside_cli_and_workloads():
-    violations = check_no_prints()
-    assert not violations, "\n" + "\n".join(violations)
+    _clean("print-ban")
 
 
 def test_no_unsampled_qid_minting_on_hot_paths():
-    violations = check_sampled_qid_discipline()
-    assert not violations, "\n" + "\n".join(violations)
+    _clean("qid-mint")
 
 
 def test_metric_names_code_catalog_docs_agree():
-    violations = check_metric_catalog()
-    assert not violations, "\n" + "\n".join(violations)
+    _clean("metrics-drift")
+
+
+def test_lock_order_and_blocking_discipline():
+    # the rules the regex era could not write: the with-lock nesting
+    # graph is acyclic, and nothing blocks while holding a lock
+    # without a documented suppression
+    _clean("lock-order", "lock-blocking-call")
+
+
+def test_stream_iterators_closed():
+    _clean("iter-close")
 
 
 def main() -> int:
-    violations = (check_serve_layer() + check_staging_discipline()
-                  + check_device_upload_discipline()
-                  + check_obs_layer() + check_no_prints()
-                  + check_sampled_qid_discipline()
-                  + check_metric_catalog())
-    for v in violations:
-        print(v, file=sys.stderr)
-    print(f"serve-layer + staging static check: "
-          f"{'FAIL' if violations else 'ok'} "
-          f"({len(violations)} violation(s))")
-    return 1 if violations else 0
+    from netsdb_tpu.cli import main as cli_main
+
+    return cli_main(["lint"])
 
 
 if __name__ == "__main__":
