@@ -82,8 +82,13 @@ def _cpu_reference_rows_per_sec() -> float:
 
 
 # headline metrics and which direction is good — the --compare gate
-# fails on a >REGRESSION_PCT move the WRONG way for any of these
-HEADLINE_METRICS = {"ff_inference_rows_per_sec_per_chip": "higher"}
+# fails on a >REGRESSION_PCT move the WRONG way for any of these.
+# serve_sched_p99_speedup (the --sched section: N concurrent identical
+# cold EXECUTEs, query scheduler on vs off) is only present in
+# snapshots taken with --sched; absent-in-one-run metrics are never
+# gated (compare_runs reports "not compared").
+HEADLINE_METRICS = {"ff_inference_rows_per_sec_per_chip": "higher",
+                    "serve_sched_p99_speedup": "higher"}
 REGRESSION_PCT = 15.0
 
 
@@ -240,12 +245,39 @@ def main():
         "unit": "rows/s",
         "vs_baseline": round(rows_per_sec / cpu_rps, 2),
     }
-    print(json.dumps(result))
+    records = [result]
+    if "--sched" in sys.argv:
+        # query-scheduler A/B (serve_bench --scheduler): 8 concurrent
+        # byte-identical cold EXECUTEs over one paged set, scheduler
+        # on vs off — the serve-concurrency headline
+        from netsdb_tpu.workloads.serve_bench import run_scheduler_bench
+
+        sched = run_scheduler_bench()
+        if sched.get("p99_speedup"):
+            records.append({
+                "metric": "serve_sched_p99_speedup",
+                "value": sched["p99_speedup"],
+                "unit": "x (p99, 8 identical cold EXECUTEs on vs off)",
+                "detail": {
+                    "on": sched.get("scheduler_on"),
+                    "off": sched.get("scheduler_off"),
+                },
+            })
+        else:
+            # a broken A/B phase must OMIT the record (absent metrics
+            # are never gated), not poison the snapshot with a 0.0
+            # that reads as a -100% regression
+            print(f"-- sched A/B produced no speedup figure; metric "
+                  f"omitted: {json.dumps(sched)}", file=sys.stderr)
+    # one JSON line: a single record stays the historical shape; with
+    # --sched the line is a list (compare_runs accepts both)
+    print(json.dumps(records if len(records) > 1 else result))
 
     if compare_path is not None:
         with open(compare_path) as f:
             prior = json.load(f)
-        lines, regressed = compare_runs(result, prior)
+        lines, regressed = compare_runs(
+            records if len(records) > 1 else result, prior)
         print(f"-- compare vs {compare_path} "
               f"(gate: >{REGRESSION_PCT:.0f}% headline regression):",
               file=sys.stderr)

@@ -865,12 +865,58 @@ def _cmd_obs_top(c, args) -> int:
         return 0
 
 
+def _sched_view(stats) -> dict:
+    """The scheduler slice of one COLLECT_STATS reply — the ONE
+    extractor both `obs --sched` renderings (pretty and --json)
+    consume, so the two outputs cannot drift."""
+    m = stats.get("metrics") or {}
+    return {
+        "sched": m.get("sched") or {},
+        "counters": {k: v for k, v in (m.get("counters") or {}).items()
+                     if k.startswith("sched.")},
+        "queue_wait_s": (m.get("histograms") or {})
+        .get("sched.queue_wait_s"),
+    }
+
+
+def _print_sched(view) -> None:
+    """The `obs --sched` readout: the scheduler's lane table (the
+    registry's "sched" collector section) plus every sched.*
+    instrument — admissions, rejections, coalesce and affinity
+    decisions, queue-wait distribution."""
+    sched = view["sched"]
+    print(f"== scheduler (slots {sched.get('slots')}, free "
+          f"{sched.get('free_slots')}, queued {sched.get('queued')}, "
+          f"quota {sched.get('quota') or 'off'}, aging every "
+          f"{sched.get('aging_every') or 'off'}, coalesce "
+          f"{'on' if sched.get('coalesce_enabled') else 'off'}, "
+          f"affinity "
+          f"{'on' if sched.get('affinity_enabled') else 'off'}) ==")
+    lanes = sched.get("lanes") or {}
+    for name, ln in sorted(lanes.items()):
+        w = ln.get("wait") or {}
+        line = (f"  lane {name:<20} weight={ln.get('weight'):<6} "
+                f"depth={ln.get('depth'):<4} served={ln.get('served')}")
+        if w.get("p50") is not None:
+            line += (f" wait_p50={w['p50'] * 1e3:.2f}ms"
+                     f" wait_p99={w['p99'] * 1e3:.2f}ms")
+        print(line)
+    for k, v in sorted(view["counters"].items()):
+        print(f"  {k:<44} {v}")
+    h = view["queue_wait_s"]
+    if h and h.get("count"):
+        print(f"  sched.queue_wait_s  n={h['count']} "
+              f"mean={h['mean'] * 1e3:.2f}ms p50={h['p50'] * 1e3:.2f}ms "
+              f"p99={h['p99'] * 1e3:.2f}ms max={h['max'] * 1e3:.2f}ms")
+
+
 def _cmd_obs(args) -> int:
     """Pretty-print a running daemon's observability surface: the
     COLLECT_STATS "metrics" section (central registry), the last N
     completed query profiles (GET_TRACE), the SLO/health readout
-    (--health), the persisted slow-query ring (--slowlog), one
-    query's per-operator tree (--explain), the Prometheus scrape text
+    (--health), the scheduler's lane/coalesce/affinity view (--sched),
+    the persisted slow-query ring (--slowlog), one query's
+    per-operator tree (--explain), the Prometheus scrape text
     (--openmetrics), or the live rate view (--top)."""
     from netsdb_tpu.serve.client import RemoteClient
 
@@ -878,6 +924,13 @@ def _cmd_obs(args) -> int:
     try:
         if getattr(args, "explain", None):
             return _cmd_obs_explain(c, args)
+        if getattr(args, "sched", False):
+            view = _sched_view(c.collect_stats())
+            if args.json:
+                print(json.dumps(view, indent=2, default=str))
+            else:
+                _print_sched(view)
+            return 0
         if getattr(args, "openmetrics", False):
             print(c.get_metrics(format="openmetrics")["text"], end="")
             return 0
@@ -942,7 +995,12 @@ def _cmd_lint(args) -> int:
 
 
 def _cmd_serve_bench(args) -> int:
-    if getattr(args, "device_cache", False):
+    if getattr(args, "scheduler", False):
+        from netsdb_tpu.workloads.serve_bench import run_scheduler_bench
+
+        out = run_scheduler_bench(
+            clients=args.clients if args.clients is not None else 8)
+    elif getattr(args, "device_cache", False):
         from netsdb_tpu.workloads.serve_bench import run_device_cache_bench
 
         out = run_device_cache_bench()
@@ -953,7 +1011,8 @@ def _cmd_serve_bench(args) -> int:
     else:
         from netsdb_tpu.workloads.serve_bench import run_serve_bench
 
-        out = run_serve_bench(clients=args.clients,
+        out = run_serve_bench(clients=args.clients
+                              if args.clients is not None else 2,
                               jobs_per_client=args.jobs,
                               batch=args.batch, port=args.port,
                               platform=args.platform)
@@ -1073,7 +1132,9 @@ def main(argv=None) -> int:
     p = sub.add_parser("serve-bench",
                        help="FF inference throughput over the RPC hop, "
                        "concurrent client processes against one daemon")
-    p.add_argument("--clients", type=int, default=2)
+    p.add_argument("--clients", type=int, default=None,
+                   help="concurrent clients (default: 2, or 8 for "
+                        "--scheduler); explicit values always win")
     p.add_argument("--jobs", type=int, default=8,
                    help="inference jobs per client")
     p.add_argument("--batch", type=int, default=16384)
@@ -1090,6 +1151,11 @@ def main(argv=None) -> int:
                    help="cold vs warm EXECUTE latency over a "
                         "device-cache-resident paged set instead "
                         "(hit/miss counters included)")
+    p.add_argument("--scheduler", action="store_true",
+                   help="query-scheduler paired A/B instead: N "
+                        "concurrent identical cold EXECUTEs, "
+                        "scheduler on vs off (executions run, "
+                        "devcache installs, coalesce hits, p50/p99)")
 
     p = sub.add_parser("obs",
                        help="observability readout of a running daemon: "
@@ -1107,6 +1173,11 @@ def main(argv=None) -> int:
                         "every objective with multi-window burn rates, "
                         "recent breach/recovery events, slowlog "
                         "summary; leaders merge follower sections")
+    p.add_argument("--sched", action="store_true",
+                   help="the query scheduler's view instead: lane "
+                        "table (weights, depths, queue-wait "
+                        "percentiles) + admission/coalesce/affinity "
+                        "counters")
     p.add_argument("--slowlog", action="store_true",
                    help="the persisted slow-query ring instead "
                         "(<root>/slowlog/ — outliers that survived "

@@ -12,7 +12,7 @@ from __future__ import annotations
 
 import dataclasses
 import os
-from typing import Optional, Tuple
+from typing import Dict, Optional, Tuple
 
 
 @dataclasses.dataclass
@@ -127,6 +127,36 @@ class Configuration:
     # hit-rate trends). interval <= 0 or len < 2 disables the thread.
     obs_history_interval_s: float = 5.0
     obs_history_len: int = 120
+    # --- serve-side query scheduler (netsdb_tpu/serve/sched/) ---
+    # lane name -> weight for the weighted-deficit admission policy
+    # (serve/sched/queue.py). Lanes not listed here get weight 1.0 on
+    # first use. The daemon keys lanes by the frame's LANE_KEY hint,
+    # falling back to its CLIENT_ID_KEY identity — per-client lanes
+    # with zero client changes. None = every lane weight 1 (pure FIFO
+    # fairness with aging).
+    sched_lanes: Optional[Dict[str, float]] = None
+    # max requests QUEUED per lane before the typed LaneSaturated
+    # rejection (distinct from AdmissionFull: "this tenant is over its
+    # share", not "the daemon is drowning"); 0 = unbounded lanes
+    sched_lane_quota: int = 0
+    # anti-starvation aging: every N grants, the lane whose head
+    # waiter has waited longest is served regardless of weights — a
+    # saturated low-priority lane admits within a bounded number of
+    # high-priority admissions. 0 disables aging (pure deficit).
+    sched_aging_every: int = 8
+    # collapse byte-identical idempotent EXECUTE frames into ONE
+    # execution fanned out to all waiters (serve/sched/coalesce.py);
+    # each waiter keeps its own qid/trace/idempotency attribution
+    sched_coalesce: bool = True
+    # cache-aware hot-set admission (serve/sched/policy.py): when a
+    # cold hot-set installer is already streaming, sibling queries on
+    # the same placed sets queue behind it and wake into the warm
+    # device cache instead of racing cold streams through the arena
+    sched_affinity: bool = True
+    # bound on how long an affinity sibling waits for the installer
+    # before proceeding cold anyway (correctness never depends on the
+    # wait — it is purely a thrash-avoidance window)
+    sched_affinity_wait_s: float = 30.0
     # --- concurrency correctness (netsdb_tpu/analysis/ + utils/locks) ---
     # lockdep-style runtime lock-order witness: on, every TrackedLock/
     # named-RWLock acquisition records rank edges (held -> acquired)

@@ -43,6 +43,7 @@ import threading
 from typing import Any, Dict, Iterator, Optional
 
 from netsdb_tpu.obs import metrics as _metrics
+from netsdb_tpu.utils.locks import TrackedLock
 
 #: identity for frames that carried no client id — attribution must
 #: stay COMPLETE (sum over clients == global counters), so anonymous
@@ -82,7 +83,7 @@ class ResourceLedger:
     snapshot-table msgpack-safe."""
 
     def __init__(self, max_keys: int = MAX_KEYS):
-        self._mu = threading.Lock()
+        self._mu = TrackedLock("ResourceLedger._mu")
         self._max = int(max_keys)
         self._counts: Dict[Any, Dict[str, float]] = {}
 

@@ -104,6 +104,26 @@ def _catalog() -> Dict[str, Tuple[str, str]]:
                                     "missing a catalog entry"),
         ("attrib.overflow", "attribution rows folded into the overflow "
                             "bucket"),
+        ("sched.admits", "jobs granted an admission slot by the query "
+                         "scheduler"),
+        ("sched.quota_rejects", "jobs refused because their lane's "
+                                "queue quota was full (typed "
+                                "LaneSaturated)"),
+        ("sched.timeouts", "jobs refused after waiting out the "
+                           "admission timeout (typed AdmissionFull)"),
+        ("sched.aged_grants", "admissions granted by the "
+                              "anti-starvation aging rule instead of "
+                              "lane weights"),
+        ("sched.coalesce_hits", "EXECUTE frames coalesced behind an "
+                                "identical in-flight execution"),
+        ("sched.coalesce_failures", "coalesced waiters aborted by a "
+                                    "failed or overlong leader "
+                                    "(typed CoalesceAborted)"),
+        ("sched.affinity_hits", "queries that waited behind a cold "
+                                "hot-set installer and woke into the "
+                                "warm device cache"),
+        ("sched.affinity_installs", "cold-set installer executions "
+                                    "registered by the affinity gate"),
         ("slo.breaches", "SLO objective breach transitions"),
         ("slo.recoveries", "SLO objective recovery transitions"),
         ("analysis.violations", "runtime lock-order cycles detected "
@@ -112,8 +132,13 @@ def _catalog() -> Dict[str, Tuple[str, str]]:
     gauges = (
         ("analysis.lock_edges", "distinct lock-rank acquisition-order "
                                 "edges observed by the witness"),
+        ("sched.queue_depth", "requests currently queued across all "
+                              "scheduler lanes"),
     )
     hists = (
+        ("sched.queue_wait_s", "seconds a job waited in its scheduler "
+                               "lane before admission (the "
+                               "retry_after_s hint's feed)"),
         ("serve.request_s", "server-side frame latency seconds "
                             "(time-to-first-frame for streams)"),
         ("serve.client.read_latency_s", "client-observed read latency "

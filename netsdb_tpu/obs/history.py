@@ -27,6 +27,7 @@ from collections import deque
 from typing import Any, Dict, Optional, Tuple
 
 from netsdb_tpu.obs import metrics as _metrics
+from netsdb_tpu.utils.locks import TrackedLock
 
 #: counter/histogram names with a human meaning as a rate — the
 #: derived section `deltas()` computes (name → (feed, kind, scale)):
@@ -54,7 +55,7 @@ class TelemetryHistory:
         self.capacity = max(int(capacity), 2)
         self.interval_s = float(interval_s)
         self._clock = clock
-        self._mu = threading.Lock()
+        self._mu = TrackedLock("TelemetryHistory._mu")
         self._ring: "deque[Tuple[float, Dict[str, Any]]]" = \
             deque(maxlen=self.capacity)
         self._stop = threading.Event()

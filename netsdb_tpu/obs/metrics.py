@@ -200,6 +200,18 @@ class MetricsRegistry:
         with self._mu:
             self._collectors[name] = fn
 
+    def unregister_collector(self, name: str, fn: Callable = None
+                             ) -> None:
+        """Drop a collector section. With ``fn`` given, only when the
+        registered collector equals it (``==``: bound methods compare
+        by instance + function, and each attribute access builds a
+        fresh bound-method object) — an object tearing itself down
+        (ServeController.shutdown) must not remove a successor that
+        already replaced it."""
+        with self._mu:
+            if fn is None or self._collectors.get(name) == fn:
+                self._collectors.pop(name, None)
+
     # --- readout ------------------------------------------------------
     def snapshot(self) -> Dict[str, Any]:
         """Msgpack-safe point-in-time readout: counters, gauges,

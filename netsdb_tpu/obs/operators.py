@@ -47,6 +47,7 @@ import time
 from typing import Any, Dict, Iterator, List, Optional, Tuple
 
 from netsdb_tpu.obs import metrics as _metrics
+from netsdb_tpu.utils.locks import TrackedLock
 
 
 class OpRecord:
@@ -296,7 +297,7 @@ class OperatorLedger:
                       "devcache.misses", "stage.wait_s", "stage.bytes")
 
     def __init__(self, max_keys: int = 2048):
-        self._mu = threading.Lock()
+        self._mu = TrackedLock("OperatorLedger._mu")
         self._max = int(max_keys)
         self._rows: Dict[Tuple[str, str], Dict[str, float]] = {}
 

@@ -46,6 +46,7 @@ from collections import deque
 from typing import Any, Callable, Dict, List, Optional, Tuple
 
 from netsdb_tpu.obs import metrics as _metrics
+from netsdb_tpu.utils.locks import TrackedLock
 
 #: default evaluation windows (seconds): fast-burn, slow-burn
 DEFAULT_WINDOWS: Tuple[float, ...] = (60.0, 600.0)
@@ -123,7 +124,7 @@ class SLOEngine:
                                else default_objectives())
         self.windows = tuple(sorted(windows))
         self._clock = clock
-        self._mu = threading.Lock()
+        self._mu = TrackedLock("SLOEngine._mu")
         # (t, {counter_name: value, "ht:"+hist: total_seconds})
         self._readings: "deque[Tuple[float, Dict[str, float]]]" = \
             deque(maxlen=max(int(max_readings), 2))

@@ -29,6 +29,7 @@ import threading
 from typing import Any, Dict, List, Optional
 
 from netsdb_tpu.obs import metrics as _metrics
+from netsdb_tpu.utils.locks import TrackedLock
 
 _PREFIX = "slow-"
 _SUFFIX = ".json"
@@ -42,7 +43,7 @@ class SlowQueryLog:
         self.dir = os.path.join(root_dir, "slowlog")
         self.capacity = max(int(capacity), 1)
         self.threshold_s = threshold_s
-        self._mu = threading.Lock()
+        self._mu = TrackedLock("SlowQueryLog._mu")
         os.makedirs(self.dir, exist_ok=True)
         # restart continuity: the next sequence number follows the
         # newest file already on disk

@@ -43,6 +43,7 @@ import uuid
 from typing import Any, Dict, Iterator, List, Optional
 
 from netsdb_tpu.obs import metrics as _metrics
+from netsdb_tpu.utils.locks import TrackedLock
 
 #: process-wide kill switch (config.obs_enabled mirrors into this via
 #: set_enabled at daemon/CLI startup); when off, no trace is ever
@@ -276,7 +277,7 @@ class TraceRing:
     source. Push-side cheap; ``last(n)`` returns newest-last."""
 
     def __init__(self, capacity: int = 64, pending_capacity: int = 32):
-        self._mu = threading.Lock()
+        self._mu = TrackedLock("TraceRing._mu")
         self._cap = max(int(capacity), 1)
         self._items: List[Dict[str, Any]] = []
         # sections that arrived BEFORE their profile ringed (the

@@ -48,6 +48,8 @@ import threading
 import time
 from typing import Any, List, Optional, Tuple
 
+from netsdb_tpu.utils.locks import TrackedLock
+
 _ACTIONS = ("drop", "delay", "corrupt", "corrupt_seg", "truncate", "kill")
 
 
@@ -61,7 +63,7 @@ class ChaosInjector:
                        ("corrupt", corrupt), ("truncate", truncate))
         self.delay_s = delay_s
         self.max_faults = max_faults
-        self._mu = threading.Lock()
+        self._mu = TrackedLock("ChaosInjector._mu")
         # scripted queue: (action, where, types-or-None, delay_s)
         self._script: List[Tuple[str, str, Optional[frozenset], float]] = []
         self.faults: List[Tuple[str, str, Any]] = []  # (action, where, typ)

@@ -31,10 +31,12 @@ from netsdb_tpu import obs
 from netsdb_tpu.serve.errors import (  # noqa: F401 — re-exported API
     AdmissionFullError,
     AuthError,
+    CoalesceAbortedError,
     ConnectionLostError,
     CorruptFrameError,
     DeadlineExceededError,
     FollowerDegradedError,
+    LaneSaturatedError,
     ProtocolVersionError,
     RemoteError,
     RemoteTimeoutError,
@@ -46,6 +48,7 @@ from netsdb_tpu.serve.protocol import (
     CODEC_MSGPACK,
     CODEC_PICKLE,
     IDEMPOTENCY_KEY,
+    LANE_KEY,
     MUTATING_TYPES,
     PROTO_VERSION,
     QUERY_ID_KEY,
@@ -55,6 +58,7 @@ from netsdb_tpu.serve.protocol import (
     send_frame,
     tensor_to_wire,
 )
+from netsdb_tpu.utils.locks import TrackedLock
 from netsdb_tpu.utils.timing import deadline_after, seconds_left
 
 #: frame types that open a client-side query trace (and mint the query
@@ -153,6 +157,7 @@ class RemoteClient:
                  ingest_window: int = 4,
                  ingest_chunk_bytes: int = 8 << 20,
                  client_id: Optional[str] = None,
+                 lane: Optional[str] = None,
                  trace_sample: Optional[int] = None,
                  ship_traces: bool = True):
         """``timeout``: socket-level timeout applied to every blocking
@@ -190,6 +195,14 @@ class RemoteClient:
         ``attribution`` section. None = unattributed ("anon" daemon
         bucket).
 
+        ``lane``: optional scheduler lane hint
+        (``protocol.LANE_KEY``) attached to every frame — the daemon
+        admits this client's jobs through that priority lane of its
+        query scheduler (``serve/sched/``). Absent, jobs ride the
+        client-identity lane. Lane *weights* are server configuration
+        (``config.sched_lanes``) — naming a lane grants no priority
+        the operator didn't configure.
+
         ``trace_sample``: mint a query id (and therefore pay
         end-to-end tracing) for 1 in N query-shaped requests —
         ``obs.sample_qid``. None takes ``DEFAULT_CONFIG.
@@ -204,7 +217,9 @@ class RemoteClient:
         self.host = host or "127.0.0.1"
         self.port = int(port)
         self.token = token
-        self._lock = threading.Lock()  # one in-flight request per conn
+        # one in-flight request per conn; tracked rank (the witness
+        # coverage the PR 8 carry-over asked for)
+        self._lock = TrackedLock("RemoteClient._lock")
         self._sock: Optional[socket.socket] = None
         self._timeout = timeout
         self._connect_timeout = (connect_timeout if connect_timeout
@@ -233,6 +248,7 @@ class RemoteClient:
         self.ingest_window = max(1, int(ingest_window))
         self.ingest_chunk_bytes = max(64 << 10, int(ingest_chunk_bytes))
         self.client_id = client_id
+        self.lane = lane
         if trace_sample is None:
             from netsdb_tpu.config import DEFAULT_CONFIG
 
@@ -247,7 +263,7 @@ class RemoteClient:
         # background PUT_TRACE shipper (lazy): completed client traces
         # queue here and ship over a dedicated connection OFF the
         # request critical path
-        self._ship_mu = threading.Lock()
+        self._ship_mu = TrackedLock("RemoteClient._ship_mu")
         self._ship_q: Optional["_queue.Queue"] = None
         self._ship_thread: Optional[threading.Thread] = None
         # thread id that currently drives a streaming reply (scan_stream
@@ -412,6 +428,18 @@ class RemoteClient:
             if attempt >= policy.max_attempts:
                 raise failure
             delay = policy.backoff_s(attempt, self._rng)
+            hint = getattr(failure, "retry_after_s", None)
+            if hint is not None and hint > 0:
+                # the server computed this from its lane's observed
+                # queue-wait histogram (serve/sched/) — honor it when
+                # it says to wait LONGER than the exponential policy
+                # would. The policy stays the floor: a near-zero
+                # historical median during a fresh saturation spike
+                # must not collapse backoff into a retry storm. Small
+                # multiplicative jitter keeps a rejected herd from
+                # re-synchronizing on the exact same instant.
+                delay = max(delay, float(hint)
+                            * (1.0 + 0.25 * self._rng.random()))
             if deadline is not None and delay > seconds_left(deadline):
                 raise DeadlineExceededError(
                     "DeadlineExceeded",
@@ -444,6 +472,8 @@ class RemoteClient:
             if self.client_id is not None \
                     and CLIENT_ID_KEY not in payload:
                 extra[CLIENT_ID_KEY] = str(self.client_id)
+            if self.lane is not None and LANE_KEY not in payload:
+                extra[LANE_KEY] = str(self.lane)
             if extra:
                 payload = dict(payload)
                 payload.update(extra)

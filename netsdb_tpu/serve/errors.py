@@ -44,10 +44,53 @@ class ServeFault(Exception):
 
 
 class AdmissionFull(ServeFault):
-    """The bounded job-admission queue did not free a slot within the
+    """The job-admission layer did not free a slot within the
     admission timeout — back off and retry (the reference's
     QuerySchedulerServer would park the job; we refuse typed instead of
-    wedging a handler thread)."""
+    wedging a handler thread). ``retry_after_s`` is the scheduler's
+    OWN backoff hint — the lane's observed queue-wait median, which a
+    client honors instead of blind exponential jitter; ``queue_depth``
+    and ``lane`` identify how deep behind which lane the request was
+    parked. All three ride the ERR payload."""
+
+    retryable = True
+
+    def __init__(self, *args, retry_after_s=None, queue_depth=None,
+                 lane=None):
+        super().__init__(*args)
+        self.retry_after_s = retry_after_s
+        self.queue_depth = queue_depth
+        self.lane = lane
+
+
+class LaneSaturated(ServeFault):
+    """One client lane's admission QUOTA is full — distinct from
+    :class:`AdmissionFull` (the whole daemon saturated) by design: the
+    right client reaction is per-tenant backoff, not failover, and an
+    operator alerting on quota rejections must be able to tell "this
+    tenant is over its share" from "the daemon is drowning". Carries
+    the lane's observed queue depth and the scheduler's
+    ``retry_after_s`` hint (the lane's queue-wait median)."""
+
+    retryable = True
+
+    def __init__(self, *args, lane=None, queue_depth=None,
+                 retry_after_s=None):
+        super().__init__(*args)
+        self.lane = lane
+        self.queue_depth = queue_depth
+        self.retry_after_s = retry_after_s
+
+
+class CoalesceAborted(ServeFault):
+    """A coalesced waiter's leader execution died (or outlived the
+    coalesce wait bound) before producing a reply. The waiter's own
+    request never ran and nothing was applied under its token — a
+    retry re-executes from scratch: a FAILED leader's flight leaves
+    the table before waiters release, and an over-age (still-running)
+    flight is never re-joined, so the retry runs solo. Never carries
+    a partial reply: a waiter gets the leader's COMPLETE result or
+    this typed retryable error."""
 
     retryable = True
 
@@ -95,6 +138,11 @@ class RemoteError(RuntimeError):
         super().__init__(f"{kind}: {message}")
         self.kind = kind
         self.remote_traceback = remote_traceback
+        # scheduler backpressure details (populated by classify_remote
+        # when the ERR frame carried them — AdmissionFull/LaneSaturated)
+        self.retry_after_s = None
+        self.queue_depth = None
+        self.lane = None
 
 
 class RetryableRemoteError(RemoteError):
@@ -116,7 +164,25 @@ class RemoteTimeoutError(RetryableRemoteError):
 
 
 class AdmissionFullError(RetryableRemoteError):
-    """Server-side :class:`AdmissionFull` — job queue saturated."""
+    """Server-side :class:`AdmissionFull` — job queue saturated. When
+    the frame carried one, ``retry_after_s`` is the scheduler's
+    backoff hint (the lane's observed queue-wait median) and the
+    client's retry loop sleeps THAT instead of blind exponential
+    jitter."""
+
+
+class LaneSaturatedError(RetryableRemoteError):
+    """Server-side :class:`LaneSaturated` — THIS client's lane quota
+    is full (the daemon may be otherwise idle). ``lane``,
+    ``queue_depth`` and ``retry_after_s`` carry the scheduler's view;
+    back off per-tenant, don't fail over."""
+
+
+class CoalesceAbortedError(RetryableRemoteError):
+    """Server-side :class:`CoalesceAborted` — this request was
+    coalesced behind an identical in-flight execution whose leader
+    died mid-run. Nothing executed under this request; a retry
+    re-executes from scratch."""
 
 
 class FollowerDegradedError(RetryableRemoteError):
@@ -149,22 +215,35 @@ class DeadlineExceededError(RemoteError):
 
 _KIND_MAP: Dict[str, type] = {
     "AdmissionFull": AdmissionFullError,
+    "LaneSaturated": LaneSaturatedError,
+    "CoalesceAborted": CoalesceAbortedError,
     "FollowerDegraded": FollowerDegradedError,
     "CorruptFrame": CorruptFrameError,
     "AuthError": AuthError,
     "ProtocolVersionError": ProtocolVersionError,
 }
 
+#: scheduler-backpressure detail fields that cross the wire inside the
+#: ERR payload (server ``_send_err`` includes them when the fault
+#: carries them; ``classify_remote`` rebuilds them on the error)
+BACKPRESSURE_FIELDS = ("retry_after_s", "queue_depth", "lane")
+
 
 def classify_remote(reply: Dict[str, Any]) -> RemoteError:
     """ERR frame payload → the matching typed error. Known kinds map to
     their dedicated class; unknown kinds fall back on the frame's
     ``retryable`` flag (so new server faults degrade gracefully to the
-    right *family* on old clients)."""
+    right *family* on old clients). Scheduler backpressure details
+    (``retry_after_s``/``queue_depth``/``lane``) are rebuilt onto the
+    error so the retry loop can honor the server's hint."""
     kind = reply.get("error", "Error")
     message = reply.get("message", "")
     tb = reply.get("traceback", "")
     cls = _KIND_MAP.get(kind)
     if cls is None:
         cls = RetryableRemoteError if reply.get("retryable") else RemoteError
-    return cls(kind, message, tb)
+    err = cls(kind, message, tb)
+    for field in BACKPRESSURE_FIELDS:
+        if reply.get(field) is not None:
+            setattr(err, field, reply[field])
+    return err

@@ -206,6 +206,16 @@ QUERY_ID_KEY = "__qid__"
 #: forwards re-attach it so followers attribute the same way.
 CLIENT_ID_KEY = "__client__"
 
+#: OPTIONAL payload key carrying a scheduler lane hint (a priority
+#: class name, e.g. "interactive"/"batch"). The server pops it before
+#: dispatch and admits the frame's job through that lane of the query
+#: scheduler (``serve/sched/``); absent, the lane defaults to the
+#: frame's client identity — per-client lanes with no client change.
+#: Lane WEIGHTS are server configuration (``config.sched_lanes``): a
+#: client can only name a lane, never grant itself priority the
+#: operator didn't configure.
+LANE_KEY = "__lane__"
+
 #: frame types that mutate daemon state or launch jobs — the set the
 #: client attaches idempotency tokens to before retrying. Reads are
 #: naturally idempotent and retried bare. (BULK_BEGIN carries its

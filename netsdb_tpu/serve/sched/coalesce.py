@@ -1,0 +1,146 @@
+"""Identical-query coalescing — single-flight EXECUTE frames.
+
+N concurrent byte-identical idempotent ``EXECUTE_COMPUTATIONS`` /
+``EXECUTE_PLAN`` frames used to race N cold streams through one arena;
+the idempotency-token cache already proves reply REUSE is safe for
+these frames (a retry replays the cached reply verbatim), so running
+the execution more than once concurrently buys nothing and thrashes
+the device cache. This table collapses them: the first frame with a
+given fingerprint becomes the *leader* and executes normally
+(mirroring, ordering locks, admission — all of it); every concurrent
+duplicate becomes a *waiter* that parks on the leader's completion
+event and fans the leader's reply out under its OWN query id, trace
+and idempotency token (each waiter's dispatch opened its own trace;
+the coalesce decision is annotated into it with the leader's qid so
+GET_TRACE joins the fan-out).
+
+Failure contract (``tests/test_sched.py`` chaos coverage): a waiter
+whose leader dies mid-run gets the typed retryable
+:class:`~netsdb_tpu.serve.errors.CoalesceAborted` — never a wrong or
+half-written reply — and nothing ran under the waiter's token, so its
+retry re-executes from scratch (the dead flight is gone from the
+table before the event fires).
+
+The fingerprint is computed by ``policy.frame_fingerprint`` over the
+decoded payload AFTER the per-request metadata (qid, client id,
+idempotency token, lane hint) was popped — "byte-identical" means
+identical in every byte the execution can observe.
+
+Failover scope note: a WAITER's idempotency token is finished in the
+LEADER DAEMON's reply cache only — the mirror hop forwards the
+coalesce leader's token, not the N−1 waiter tokens (they would need a
+token-alias frame; ROADMAP follow-on). After a leader-daemon loss, a
+waiter client's retry against the promoted follower therefore
+re-executes instead of replaying — safe by the same argument that
+makes coalescing sound at all (these frames are idempotent: same
+sinks, same values), but at-most-once degrades to
+at-least-once-same-result across that one failover edge.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Any, Callable, Dict, Optional
+
+from netsdb_tpu import obs
+from netsdb_tpu.serve.errors import CoalesceAborted
+from netsdb_tpu.utils.locks import TrackedLock
+
+
+class _Flight:
+    __slots__ = ("done", "result", "error", "leader_qid", "waiters",
+                 "t0")
+
+    def __init__(self, leader_qid: Optional[str]):
+        self.done = threading.Event()
+        self.result: Any = None
+        self.error: Optional[BaseException] = None
+        self.leader_qid = leader_qid
+        self.waiters = 0
+        self.t0 = time.perf_counter()
+
+
+class CoalesceTable:
+    """fingerprint → in-flight execution; single-flight semantics."""
+
+    def __init__(self):
+        self._mu = TrackedLock("sched.CoalesceTable._mu")
+        self._inflight: Dict[str, _Flight] = {}
+
+    def waiters(self, key: str) -> int:
+        """How many requests are currently coalesced behind ``key``'s
+        leader (0 when nothing is in flight) — test/observability
+        probe."""
+        with self._mu:
+            fl = self._inflight.get(key)
+            return fl.waiters if fl is not None else 0
+
+    def run(self, key: str, fn: Callable[[], Any],
+            wait_s: Optional[float]) -> Any:
+        """Single-flight ``fn`` under ``key``. The leader runs ``fn``
+        OUTSIDE the table lock; waiters park on its event (bounded by
+        ``wait_s``) and return the leader's result verbatim. Leader
+        exceptions propagate unchanged to the leader and surface to
+        every waiter as the typed retryable :class:`CoalesceAborted`."""
+        tr = obs.current_trace()
+        with self._mu:
+            fl = self._inflight.get(key)
+            if fl is None:
+                fl = self._inflight[key] = _Flight(
+                    tr.qid if tr is not None else None)
+                leader = True
+            elif wait_s is not None \
+                    and time.perf_counter() - fl.t0 >= wait_s:
+                # the in-flight leader has already outlived the wait
+                # bound: parking behind it can only time out (and a
+                # waiter that ALREADY timed out would retry straight
+                # back into the same flight, failing every attempt of
+                # a request that would succeed solo) — run this one
+                # uncoalesced instead
+                fl = None
+                leader = False
+            else:
+                fl.waiters += 1
+                leader = False
+        if fl is None:
+            return fn()
+        if leader:
+            try:
+                out = fn()
+            except BaseException as e:
+                fl.error = e
+                raise
+            else:
+                fl.result = out
+                return out
+            finally:
+                # the flight leaves the table BEFORE the event fires:
+                # a waiter released by a FAILED leader retries into a
+                # fresh execution, never onto the same dead flight
+                with self._mu:
+                    self._inflight.pop(key, None)
+                fl.done.set()
+        # waiter path
+        obs.REGISTRY.counter("sched.coalesce_hits").inc()
+        if tr is not None:
+            tr.annotate("sched.coalesced_into", fl.leader_qid or "?")
+            tr.add("sched.coalesce_hits")
+        with obs.span("server.sched.coalesce_wait", "serve"):
+            completed = fl.done.wait(wait_s)
+        if not completed:
+            with self._mu:
+                fl.waiters -= 1  # departed — keep the probe honest
+            obs.REGISTRY.counter("sched.coalesce_failures").inc()
+            raise CoalesceAborted(
+                f"coalesced leader {fl.leader_qid or '?'} still "
+                f"executing after {wait_s}s — this request never ran; "
+                f"a retry will execute solo (over-age flights are "
+                f"not re-joined)")
+        if fl.error is not None:
+            obs.REGISTRY.counter("sched.coalesce_failures").inc()
+            raise CoalesceAborted(
+                f"coalesced leader {fl.leader_qid or '?'} failed "
+                f"({type(fl.error).__name__}: {fl.error}) — this "
+                f"request never ran; retry re-executes")
+        return fl.result

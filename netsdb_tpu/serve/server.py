@@ -37,10 +37,13 @@ import numpy as np
 from netsdb_tpu import obs
 from netsdb_tpu.client import Client
 from netsdb_tpu.config import Configuration, DEFAULT_CONFIG
+from netsdb_tpu.serve import sched as _sched
 from netsdb_tpu.serve.errors import (
+    BACKPRESSURE_FIELDS,
     AdmissionFull,
     CorruptFrame,
     FollowerDegraded,
+    LaneSaturated,
     RequestInFlight,
 )
 from netsdb_tpu.serve.protocol import (
@@ -48,6 +51,7 @@ from netsdb_tpu.serve.protocol import (
     CODEC_MSGPACK,
     CODEC_PICKLE,
     IDEMPOTENCY_KEY,
+    LANE_KEY,
     MAX_FRAME_BYTES,
     PROTO_VERSION,
     QUERY_ID_KEY,
@@ -154,7 +158,7 @@ class _FollowerLink:
         # submit/close are atomic under this lock, so every real item
         # precedes the close sentinel in the queue — nothing can be
         # enqueued behind it and wait forever on its "done" event
-        self._lk = threading.Lock()
+        self._lk = TrackedLock("_FollowerLink._lk")
         self._closed = False
         self.thread = threading.Thread(target=self._drain, daemon=True)
         self.thread.start()
@@ -226,7 +230,7 @@ class _IdempotencyCache:
 
     def __init__(self, capacity: int = 4096,
                  persist_path: Optional[str] = None):
-        self._mu = threading.Lock()
+        self._mu = TrackedLock("_IdempotencyCache._mu")
         self._done: "OrderedDict[str, Tuple]" = OrderedDict()
         self._inflight: Dict[str, threading.Event] = {}
         self._capacity = capacity
@@ -677,7 +681,24 @@ class ServeController:
         # instances (two different sets' locks never nest)
         self._set_locks: Dict[Tuple[str, str], TrackedLock] = {}
         self._set_locks_mu = TrackedLock("ServeController._set_locks_mu")
-        self._jobs_sem = threading.Semaphore(max_jobs or config.num_threads)
+        # the query scheduler (serve/sched/): policy-driven admission
+        # replacing the old bare bounded semaphore — per-client lanes
+        # with quotas/aging, identical-EXECUTE coalescing, and
+        # cache-aware hot-set affinity driven by the devcache probe
+        self.sched = _sched.QueryScheduler(
+            slots=max_jobs or config.num_threads,
+            lanes=getattr(config, "sched_lanes", None),
+            quota=getattr(config, "sched_lane_quota", 0),
+            aging_every=getattr(config, "sched_aging_every", 8),
+            coalesce=getattr(config, "sched_coalesce", True),
+            affinity=getattr(config, "sched_affinity", True),
+            affinity_wait_s=getattr(config, "sched_affinity_wait_s",
+                                    30.0),
+            # a coalesced waiter waits out the same bound a mirror ack
+            # gets: EXECUTEs may legitimately run for minutes, but a
+            # hung leader must never wedge waiter handler threads
+            coalesce_wait_s=mirror_ack_timeout_s or 300.0,
+            cache_probe=self._devcache_warm)
         self._job_seq = itertools.count(1)
         self._jobs: Dict[int, Dict[str, Any]] = {}
         self._jobs_lock = TrackedLock("ServeController._jobs_lock")
@@ -758,6 +779,10 @@ class ServeController:
         # history thread may outlive its daemon (the leak-registry
         # discipline every obs thread follows)
         self.history.stop()
+        # drop this scheduler's registry collector (only if it is
+        # still the registered one — a newer controller in the same
+        # process may have replaced it)
+        obs.REGISTRY.unregister_collector("sched", self.sched.snapshot)
         with self._followers_mu:
             links = list(self._links.values())
         for link in links:
@@ -873,6 +898,13 @@ class ServeController:
             retryable = bool(getattr(exc, "retryable", False))
         body = {"error": type(exc).__name__, "message": str(exc),
                 "retryable": retryable}
+        # scheduler backpressure details ride the frame so the client's
+        # backoff can honor the server's own hint (the same field list
+        # classify_remote rebuilds client-side)
+        for field in BACKPRESSURE_FIELDS:
+            value = getattr(exc, field, None)
+            if value is not None:
+                body[field] = value
         if with_traceback:
             body["traceback"] = traceback.format_exc(limit=20)
         try:
@@ -903,6 +935,8 @@ class ServeController:
             if isinstance(payload, dict) else None
         client = payload.pop(CLIENT_ID_KEY, None) \
             if isinstance(payload, dict) else None
+        lane = payload.pop(LANE_KEY, None) \
+            if isinstance(payload, dict) else None
         # introspection frames are EXCLUDED from the request counters
         # and latency histogram (t0=None): the SLOs those instruments
         # feed must measure the workload, not the monitoring of it —
@@ -912,7 +946,8 @@ class ServeController:
         t0 = None if typ in OBS_FRAMES else time.perf_counter()
         if qid is None or not self._obs_enabled:
             return self._dispatch_traced(conn, typ, codec_in,
-                                         payload, None, client, t0)
+                                         payload, None, client, t0,
+                                         lane=lane)
         with obs.trace(str(qid), origin="server",
                        ring=self.trace_ring) as tr:
             if tr is not None:
@@ -930,7 +965,7 @@ class ServeController:
             with self._maybe_device_profile(tr):
                 ok = self._dispatch_traced(conn, typ, codec_in,
                                            payload, str(qid), client,
-                                           t0)
+                                           t0, lane=lane)
         if tr is not None:
             # the trace closed on context exit — total_s is final
             self._maybe_slowlog(tr)
@@ -995,7 +1030,7 @@ class ServeController:
             del e
 
     def _dispatch_traced(self, conn, typ, codec_in, payload, qid,
-                         client=None, t0=None) -> bool:
+                         client=None, t0=None, lane=None) -> bool:
         """The dispatch body (trace context, if any, already
         installed). Returns False when the connection is dead. Mutating
         frames carrying an idempotency token are deduplicated here: a
@@ -1045,7 +1080,8 @@ class ServeController:
             with obs.span(f"server.dispatch:{getattr(typ, 'name', typ)}",
                           "serve"):
                 out = self._execute_frame(typ, payload, codec_in, token,
-                                          qid=qid, client=client)
+                                          qid=qid, client=client,
+                                          lane=lane)
             if inspect.isgenerator(out):
                 # streaming handler: each yielded (type, payload
                 # [, codec]) goes out as its own frame; TCP
@@ -1081,8 +1117,35 @@ class ServeController:
             done(False)
             return self._send_err(conn, e, with_traceback=True)
 
+    #: frame types eligible for identical-query coalescing: idempotent
+    #: job launches whose reply reuse the idempotency-token cache
+    #: already proves safe (serve/sched/coalesce.py)
+    COALESCED_FRAMES = frozenset({MsgType.EXECUTE_COMPUTATIONS,
+                                  MsgType.EXECUTE_PLAN})
+
+    def _devcache_warm(self, scope: str) -> bool:
+        """The scheduler's cache probe: is ``scope`` ("db:set") warm in
+        the device cache? Answers warm (= no gating) for a disabled
+        cache AND for non-paged sets: resident sets never enter the
+        devcache, so an affinity gate keyed on them could only
+        serialize concurrent queries with no warm cache to wake into.
+        Only a COLD PAGED set — the one whose first stream installs
+        the run every later sibling replays — is worth queueing
+        behind."""
+        cache = self.library.store.device_cache()
+        if not cache.enabled or cache.has_scope(scope):
+            return True
+        db, _, set_name = scope.partition(":")
+        try:
+            storage = self.library.store.storage_of(
+                SetIdentifier(db, set_name))
+        except Exception as e:  # noqa: BLE001 — unknown set → ungated
+            del e
+            return True
+        return storage != "paged"
+
     def _execute_frame(self, typ, payload, codec_in, token, qid=None,
-                       client=None):
+                       client=None, lane=None):
         """Run one request's handler with the idempotency-token
         lifecycle (the caller has already claimed ``token``). Returns a
         generator (streaming handlers) or the normalized ``(type,
@@ -1093,7 +1156,17 @@ class ServeController:
         traces share the leader's id; ``client`` (the frame's client
         identity, already popped) likewise — and is installed for the
         handler's dynamic extent so every instrumented layer below
-        attributes its resource use per (client, db:set)."""
+        attributes its resource use per (client, db:set). ``lane``
+        (the frame's scheduler hint, already popped) installs the same
+        way and steers the job's admission lane.
+
+        EXECUTE frames additionally pass the scheduler's COALESCE
+        point here — BEFORE mirroring and admission: a byte-identical
+        in-flight execution absorbs this frame entirely (no slot, no
+        mirror forward, no handler run) and its reply fans out under
+        this frame's own token/trace; a waiter whose leader dies gets
+        the typed retryable CoalesceAborted and this token is aborted,
+        so the retry re-executes."""
         handler = self.handlers.get(typ)
         if client is not None or isinstance(payload, dict):
             scope = None
@@ -1104,13 +1177,20 @@ class ServeController:
         try:
             if handler is None:
                 raise ProtocolError(f"no handler for {typ!r}")
-            with obs.attrib.client_context(client):
+
+            def invoke():
                 if self._follower_addrs and typ in self.MIRRORED:
-                    out = self._run_mirrored(typ, payload, codec_in,
-                                             handler, token=token,
-                                             qid=qid, client=client)
+                    return self._run_mirrored(typ, payload, codec_in,
+                                              handler, token=token,
+                                              qid=qid, client=client)
+                return handler(payload)
+
+            with obs.attrib.client_context(client), \
+                    _sched.lane_context(lane):
+                if typ in self.COALESCED_FRAMES:
+                    out = self.sched.coalesced(typ, payload, invoke)
                 else:
-                    out = handler(payload)
+                    out = invoke()
         except FollowerDegraded as e:
             # the LOCAL mutation applied; only the mirror failed.
             # Cache the local reply under the token so the client's
@@ -1649,7 +1729,10 @@ class ServeController:
         # client identity likewise, so follower-side attribution books
         # the same tenant the leader does.
         fwd = payload
-        if token is not None or qid is not None or client is not None:
+        lane = _sched.current_lane()  # the frame's hint, if any —
+        # followers admit their mirrored copy through the same lane
+        if token is not None or qid is not None or client is not None \
+                or lane is not None:
             fwd = dict(payload)
             if token is not None:
                 fwd[IDEMPOTENCY_KEY] = token
@@ -1657,6 +1740,8 @@ class ServeController:
                 fwd[QUERY_ID_KEY] = qid
             if client is not None:
                 fwd[CLIENT_ID_KEY] = client
+            if lane is not None:
+                fwd[LANE_KEY] = lane
         with self._mirror_lock:  # short: dial + ordered enqueue only
             self._ensure_followers()
             with self._followers_mu:
@@ -1700,31 +1785,45 @@ class ServeController:
         return failures
 
     # --- job bookkeeping ----------------------------------------------
-    def _run_job(self, job_name: str, fn: Callable[[], Any]) -> Any:
+    def _run_job(self, job_name: str, fn: Callable[[], Any],
+                 scopes=()) -> Any:
+        """Admit + run one job under the query scheduler. Admission is
+        lane-keyed (the frame's LANE_KEY hint, else its client
+        identity, else the default lane) and bounded: a saturated lane
+        refuses typed-retryable (LaneSaturated on quota, AdmissionFull
+        with the lane's retry_after_s hint on timeout) instead of
+        parking the handler thread forever. ``scopes`` ("db:set" scan
+        leaves) then pass the cache-aware affinity gate: siblings of a
+        cold-set installer wait (bounded) and wake into the warm
+        device cache instead of racing cold streams."""
         job_id = next(self._job_seq)
         # "submitted" is a display timestamp (list_jobs), never compared
         # against a deadline — the one sanctioned wall-clock read
         rec = {"id": job_id, "name": job_name, "status": "queued",
-               "submitted": wall_now(), "elapsed": None}
+               "submitted": wall_now(), "elapsed": None, "lane": None}
         with self._jobs_lock:
             self._jobs[job_id] = rec
             # bounded history so a long-lived daemon cannot grow this
             while len(self._jobs) > 1024:
                 self._jobs.pop(next(iter(self._jobs)))
-        # bounded admission: a saturated queue refuses typed-retryable
-        # instead of parking the handler thread forever (the client
-        # backs off and re-asks — the reference's job queue would just
-        # grow; ours must never wedge a worker thread)
-        if not self._jobs_sem.acquire(timeout=self.admission_timeout_s):
+        lane = _sched.current_lane() or obs.attrib.current_client()
+        try:
+            with obs.span("server.sched.admit", "serve"):
+                ticket = self.sched.acquire(
+                    lane, timeout_s=self.admission_timeout_s)
+        except (AdmissionFull, LaneSaturated):
             rec["status"] = "rejected"
-            raise AdmissionFull(
-                f"job {job_name!r} found no admission slot within "
-                f"{self.admission_timeout_s}s — back off and retry")
+            raise
         rec["status"] = "running"
+        rec["lane"] = ticket.lane
+        tr = obs.current_trace()
+        if tr is not None:
+            tr.annotate("sched.lane", ticket.lane)
         t0 = time.perf_counter()
         try:
-            with obs.span(f"server.job:{job_name}", "job"):
-                out = fn()
+            with self.sched.affinity(scopes):
+                with obs.span(f"server.job:{job_name}", "job"):
+                    out = fn()
             rec["status"] = "done"
             return out
         except Exception:
@@ -1732,7 +1831,7 @@ class ServeController:
             raise
         finally:
             rec["elapsed"] = time.perf_counter() - t0
-            self._jobs_sem.release()
+            self.sched.release(ticket)
 
     # --- handlers -----------------------------------------------------
     def _on_ping(self, p) -> Tuple[MsgType, Any]:
@@ -2228,19 +2327,22 @@ class ServeController:
                 self._sync_results(results)
             return results
 
-        return self._execute_with_explain(p, job_name, run)
+        return self._execute_with_explain(
+            p, job_name, run,
+            scopes=_sched.sets_touched(MsgType.EXECUTE_COMPUTATIONS, p))
 
-    def _execute_with_explain(self, p, job_name, run):
+    def _execute_with_explain(self, p, job_name, run, scopes=()):
         """Shared EXECUTE tail: run the job (under an explain capture
-        when asked) and shape the reply."""
+        when asked) and shape the reply. ``scopes`` are the plan's
+        scan-leaf sets — the affinity gate's key."""
         if p.get("explain"):
             with obs.operators.explain_capture() as cap:
-                results = self._run_job(job_name, run)
+                results = self._run_job(job_name, run, scopes=scopes)
             out = {"results": self._result_summaries(results)}
             if cap.get("operators") is not None:
                 out["operators"] = cap["operators"]
             return MsgType.OK, out
-        results = self._run_job(job_name, run)
+        results = self._run_job(job_name, run, scopes=scopes)
         return MsgType.OK, {"results": self._result_summaries(results)}
 
     def _on_execute_plan(self, p):
@@ -2276,7 +2378,9 @@ class ServeController:
                 self._sync_results(results)
             return results
 
-        return self._execute_with_explain(p, job_name, run)
+        return self._execute_with_explain(
+            p, job_name, run,
+            scopes=_sched.sets_touched(MsgType.EXECUTE_PLAN, p))
 
     def _on_list_jobs(self, p):
         with self._jobs_lock:

@@ -180,6 +180,14 @@ class DeviceBlockCache:
         obs.attrib.account("devcache.hits", scope=scope)
         return entry[0]
 
+    def has_scope(self, scope: str) -> bool:
+        """True when ANY run of ``scope`` ("db:set") is resident — the
+        cache-aware admission probe (serve/sched/policy.AffinityGate):
+        "is this set warm?", without touching the hit/miss counters
+        (an admission decision must not move the SLO feeds it reads)."""
+        with self._mu:
+            return bool(self._by_scope.get(str(scope)))
+
     def make_room(self, nbytes: int) -> None:
         """Evict LRU entries until ``nbytes`` of headroom exists under
         the budget. Called INCREMENTALLY by the recorder while a cold

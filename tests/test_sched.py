@@ -1,0 +1,820 @@
+"""Query-scheduler suite (serve/sched/): lanes, coalescing, affinity.
+
+Deterministic by construction, chaos-style where the contract is a
+failure mode (test_serve_chaos.py pattern): coalesce leaders are gated
+on events the test controls, lane grant orders are fixed by enqueueing
+every waiter before the first release, and leader-death scenarios
+script the failure instead of racing for it.
+"""
+
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from netsdb_tpu import obs
+from netsdb_tpu.config import Configuration
+from netsdb_tpu.serve.client import RemoteClient, RetryPolicy
+from netsdb_tpu.serve.errors import (
+    AdmissionFull,
+    CoalesceAborted,
+    CoalesceAbortedError,
+    LaneSaturated,
+    LaneSaturatedError,
+    RemoteError,
+)
+from netsdb_tpu.serve.protocol import MsgType
+from netsdb_tpu.serve.sched import frame_fingerprint, sets_touched
+from netsdb_tpu.serve.sched.coalesce import CoalesceTable
+from netsdb_tpu.serve.sched.policy import AffinityGate
+from netsdb_tpu.serve.sched.queue import LaneScheduler
+from netsdb_tpu.serve.server import ServeController
+
+FAST = RetryPolicy(max_attempts=5, base_delay_s=0.01, max_delay_s=0.1)
+
+
+def _wait_for(pred, timeout_s=15.0):
+    deadline = time.monotonic() + timeout_s
+    while time.monotonic() < deadline:
+        if pred():
+            return True
+        time.sleep(0.01)
+    return False
+
+
+def _counter(name):
+    return obs.REGISTRY.counter(name).value
+
+
+# --- lanes: weighted deficit, aging, quotas ---------------------------
+
+def _grant_order(sched, jobs, timeout_s=10.0):
+    """Enqueue ``jobs`` (lane names) as parked waiters behind one
+    occupant, then release the occupant and record the grant order.
+    Deterministic: every waiter is queued before the first grant, and
+    slots=1 serializes grants one at a time."""
+    occupant = sched.acquire("occupant", timeout_s)
+    order = []
+    order_mu = threading.Lock()
+
+    def worker(lane):
+        t = sched.acquire(lane, timeout_s)
+        with order_mu:
+            order.append(lane)
+        sched.release(t)
+
+    threads = []
+    for lane in jobs:
+        th = threading.Thread(target=worker, args=(lane,))
+        th.start()
+        threads.append(th)
+        # enqueue IN ORDER (aging keys on head wait time)
+        assert _wait_for(
+            lambda n=len(threads): sched.snapshot()["queued"] == n)
+    sched.release(occupant)
+    for th in threads:
+        th.join(timeout=timeout_s)
+    return order
+
+
+def test_weighted_deficit_shares_grants_by_weight():
+    """weight 3 vs 1, aging off: grants interleave at the weighted
+    share (3 hi per lo over any window), not first-come
+    monopolization."""
+    sched = LaneScheduler(slots=1, lanes={"hi": 3.0, "lo": 1.0},
+                          aging_every=0)
+    order = _grant_order(sched, ["lo", "lo"] + ["hi"] * 6)
+    # virtual time served/weight, name breaks ties: hi, then lo (vtime
+    # 0), then hi catches up to vtime 1, lo's second grant lands at
+    # vtime parity, remaining hi drain
+    assert order == ["hi", "lo", "hi", "hi", "hi", "lo", "hi", "hi"]
+
+
+def test_aging_bounds_starvation_deterministically():
+    """The acceptance property: a saturated low-priority lane admits
+    within a bounded number of high-priority admissions. The lo lane
+    is pre-served past its deficit share (virtual time 5 vs hi's 0 at
+    a 1000x weight disadvantage — pure deficit would owe hi ~5000
+    grants first); aging_every=3 force-grants the longest-waiting head
+    within 3 admissions regardless."""
+    sched = LaneScheduler(slots=1, lanes={"hi": 1000.0, "lo": 1.0},
+                          aging_every=3)
+    for _ in range(5):  # burn lo's deficit share
+        sched.release(sched.acquire("lo", 5.0))
+    aged0 = _counter("sched.aged_grants")
+    order = _grant_order(sched, ["lo"] + ["hi"] * 9)
+    assert "lo" in order
+    assert order.index("lo") < 3, \
+        f"lo starved past the aging bound: {order}"
+    assert _counter("sched.aged_grants") > aged0
+
+
+def test_lane_quota_rejects_typed_with_depth():
+    sched = LaneScheduler(slots=1, quota=2)
+    occupant = sched.acquire("t", 5.0)
+    threads = [threading.Thread(
+        target=lambda: sched.release(sched.acquire("t", 10.0)))
+        for _ in range(2)]
+    for th in threads:
+        th.start()
+    assert _wait_for(lambda: sched.snapshot()["queued"] == 2)
+    rejects0 = _counter("sched.quota_rejects")
+    with pytest.raises(LaneSaturated) as ei:
+        sched.acquire("t", 1.0)
+    assert ei.value.retryable
+    assert ei.value.lane == "t"
+    assert ei.value.queue_depth == 2
+    assert _counter("sched.quota_rejects") == rejects0 + 1
+    # other lanes are unaffected by one lane's quota (that is the
+    # whole point of the typed split)
+    sched.release(occupant)
+    t2 = sched.acquire("other", 5.0)
+    sched.release(t2)
+    for th in threads:
+        th.join(timeout=10)
+
+
+def test_admission_timeout_carries_lane_wait_hint():
+    sched = LaneScheduler(slots=1)
+    first = sched.acquire("a", 5.0)  # instant — seeds the wait hist
+    sched.release(first)
+    occupant = sched.acquire("a", 5.0)
+    with pytest.raises(AdmissionFull) as ei:
+        sched.acquire("a", 0.05)
+    assert ei.value.retryable
+    assert ei.value.lane == "a"
+    # the hint is the lane's observed queue-wait median — present
+    # because the lane admitted before
+    assert ei.value.retry_after_s is not None
+    assert ei.value.retry_after_s >= 0.0
+    sched.release(occupant)
+
+
+# --- coalescing -------------------------------------------------------
+
+def test_coalesce_table_single_flight_fans_out():
+    ct = CoalesceTable()
+    gate = threading.Event()
+    calls = []
+
+    def leader_fn():
+        calls.append("leader")
+        gate.wait(10)
+        return {"answer": 41}
+
+    def never_runs():
+        calls.append("waiter-ran")  # must never happen
+        return {"answer": -1}
+
+    hits0 = _counter("sched.coalesce_hits")
+    results = [None] * 4
+
+    def leader():
+        results[0] = ct.run("k", leader_fn, 10.0)
+
+    def waiter(i):
+        results[i] = ct.run("k", never_runs, 10.0)
+
+    threads = [threading.Thread(target=leader)]
+    threads[0].start()
+    assert _wait_for(lambda: "k" in ct._inflight)
+    for i in (1, 2, 3):
+        threads.append(threading.Thread(target=waiter, args=(i,)))
+        threads[-1].start()
+    assert _wait_for(lambda: ct.waiters("k") == 3)
+    gate.set()
+    for th in threads:
+        th.join(timeout=10)
+    assert calls == ["leader"]
+    assert all(r == {"answer": 41} for r in results)
+    assert _counter("sched.coalesce_hits") == hits0 + 3
+
+
+def test_coalesce_leader_failure_aborts_waiters_typed():
+    ct = CoalesceTable()
+    gate = threading.Event()
+
+    def failing_leader():
+        gate.wait(10)
+        raise RuntimeError("leader died mid-run")
+
+    errs = {}
+
+    def leader():
+        with pytest.raises(RuntimeError):
+            ct.run("k", failing_leader, 10.0)
+
+    def waiter():
+        try:
+            ct.run("k", failing_leader, 10.0)
+        except CoalesceAborted as e:
+            errs["waiter"] = e
+
+    t1 = threading.Thread(target=leader)
+    t1.start()
+    assert _wait_for(lambda: "k" in ct._inflight)
+    t2 = threading.Thread(target=waiter)
+    t2.start()
+    assert _wait_for(lambda: ct.waiters("k") == 1)
+    gate.set()
+    t1.join(timeout=10)
+    t2.join(timeout=10)
+    # typed retryable, names the leader's failure, and the flight is
+    # GONE — a retry starts a fresh execution
+    assert errs["waiter"].retryable
+    assert "leader died mid-run" in str(errs["waiter"])
+    assert "k" not in ct._inflight
+
+
+def test_coalesce_over_age_flight_is_not_rejoined():
+    """A flight older than the wait bound is never re-joined: the
+    late arrival (e.g. the retry of a waiter that already timed out)
+    runs solo and succeeds instead of timing out against the same
+    long leader on every attempt."""
+    ct = CoalesceTable()
+    gate = threading.Event()
+    out = {}
+
+    def long_leader():
+        gate.wait(10)
+        return "leader"
+
+    t = threading.Thread(
+        target=lambda: out.setdefault("leader",
+                                      ct.run("k", long_leader, 0.05)))
+    t.start()
+    assert _wait_for(lambda: "k" in ct._inflight)
+    time.sleep(0.1)  # age the flight past the 0.05s wait bound
+    hits0 = _counter("sched.coalesce_hits")
+    assert ct.run("k", lambda: "solo", 0.05) == "solo"
+    assert _counter("sched.coalesce_hits") == hits0  # not coalesced
+    gate.set()
+    t.join(timeout=10)
+    assert out["leader"] == "leader"
+
+
+def test_new_lane_joins_at_current_virtual_time():
+    """WFQ join rule: a lane created on a long-lived scheduler starts
+    at the current minimum virtual time, not zero — a new tenant
+    cannot monopolize grants until its served count 'catches up'."""
+    sched = LaneScheduler(slots=1)
+    for _ in range(6):
+        sched.release(sched.acquire("a", 5.0))
+    sched.release(sched.acquire("b", 5.0))
+    lanes = sched.snapshot()["lanes"]
+    # b joined at a's virtual time (6.0) and then served once
+    assert lanes["b"]["served"] == pytest.approx(7.0)
+    assert lanes["a"]["served"] == 6
+
+
+def test_frame_fingerprint_is_canonical():
+    p1 = {"plan": "x <= SCAN('d', 's')", "job_name": "j",
+          "materialize": True}
+    p2 = {"plan": "x <= SCAN('d', 's')", "job_name": "j",
+          "materialize": True}
+    p3 = {"plan": "x <= SCAN('d', 's')", "job_name": "OTHER",
+          "materialize": True}
+    f1 = frame_fingerprint(MsgType.EXECUTE_PLAN, p1)
+    assert f1 is not None
+    assert f1 == frame_fingerprint(MsgType.EXECUTE_PLAN, p2)
+    assert f1 != frame_fingerprint(MsgType.EXECUTE_PLAN, p3)
+    # the frame TYPE is part of the key
+    assert f1 != frame_fingerprint(MsgType.EXECUTE_COMPUTATIONS, p1)
+
+
+def test_sets_touched_from_dag_and_plan_text():
+    from netsdb_tpu.plan.computations import (Apply, ScanSet,
+                                              WriteSet)
+
+    sink = WriteSet(Apply(ScanSet("d", "in"), lambda x: x,
+                          traceable=False), "d", "out")
+    assert sets_touched(MsgType.EXECUTE_COMPUTATIONS,
+                        {"sinks": [sink]}) == frozenset({"d:in"})
+    plan = "a <= SCAN('db1', 'left')\nb <= SCAN('db1', 'right')\n"
+    assert sets_touched(MsgType.EXECUTE_PLAN, {"plan": plan}) \
+        == frozenset({"db1:left", "db1:right"})
+    # unparseable payloads gate nothing (never raise)
+    assert sets_touched(MsgType.EXECUTE_PLAN, {"plan": 42}) \
+        == frozenset()
+
+
+# --- affinity ---------------------------------------------------------
+
+def test_affinity_gate_single_installer_siblings_wait():
+    warm = set()
+    gate = AffinityGate(lambda s: s in warm, wait_s=10.0)
+    installs0 = _counter("sched.affinity_installs")
+    hits0 = _counter("sched.affinity_hits")
+    inside = threading.Event()
+    finish = threading.Event()
+    order = []
+
+    def installer():
+        with gate.admit(["d:x"]):
+            order.append("installer-in")
+            inside.set()
+            finish.wait(10)
+            warm.add("d:x")  # the run installed into the devcache
+        order.append("installer-out")
+
+    def sibling():
+        with gate.admit(["d:x"]):
+            order.append("sibling-in")
+
+    t1 = threading.Thread(target=installer)
+    t1.start()
+    assert inside.wait(10)
+    t2 = threading.Thread(target=sibling)
+    t2.start()
+    # the sibling is parked behind the installer, not running cold
+    time.sleep(0.1)
+    assert order == ["installer-in"]
+    finish.set()
+    t1.join(timeout=10)
+    t2.join(timeout=10)
+    assert order[0] == "installer-in"
+    assert "sibling-in" in order and "installer-out" in order
+    assert order.index("sibling-in") > order.index("installer-in")
+    assert _counter("sched.affinity_installs") == installs0 + 1
+    assert _counter("sched.affinity_hits") == hits0 + 1
+    # warm now: nobody gates
+    with gate.admit(["d:x"]):
+        pass
+    assert _counter("sched.affinity_installs") == installs0 + 1
+
+
+def test_affinity_gate_overlapping_cold_sets_share_one_installer():
+    """Membership is per SCOPE, not per cold-set key: a query whose
+    cold sets merely overlap an in-progress installer's waits behind
+    it instead of racing a second cold stream over the shared set."""
+    warm = set()
+    gate = AffinityGate(lambda s: s in warm, wait_s=10.0)
+    inside = threading.Event()
+    finish = threading.Event()
+    order = []
+
+    def installer():
+        with gate.admit(["d:a", "d:b"]):
+            inside.set()
+            finish.wait(10)
+            warm.update(("d:a", "d:b"))
+        order.append("installer-out")
+
+    def overlapping():
+        with gate.admit(["d:a"]):  # different key, shared cold scope
+            order.append("overlap-in")
+
+    hits0 = _counter("sched.affinity_hits")
+    t1 = threading.Thread(target=installer)
+    t1.start()
+    assert inside.wait(10)
+    t2 = threading.Thread(target=overlapping)
+    t2.start()
+    time.sleep(0.1)
+    assert order == []  # the overlapping query is parked, not racing
+    finish.set()
+    t1.join(timeout=10)
+    t2.join(timeout=10)
+    assert set(order) == {"installer-out", "overlap-in"}
+    assert _counter("sched.affinity_hits") == hits0 + 1
+
+
+# --- integration: the acceptance scenario -----------------------------
+
+def _lineitem_cols(rows, seed=0):
+    rng = np.random.default_rng(seed)
+    return {
+        "l_shipdate": rng.integers(19920101, 19981231, rows,
+                                   dtype=np.int32),
+        "l_returnflag": rng.integers(0, 3, rows, dtype=np.int32),
+        "l_linestatus": rng.integers(0, 2, rows, dtype=np.int32),
+        "l_quantity": rng.integers(1, 51, rows,
+                                   dtype=np.int32).astype(np.float32),
+        "l_extendedprice": rng.uniform(1000, 100000,
+                                       rows).astype(np.float32),
+        "l_discount": rng.uniform(0, 0.1, rows).astype(np.float32),
+        "l_tax": rng.uniform(0, 0.08, rows).astype(np.float32),
+    }
+
+
+@pytest.fixture()
+def paged_server(tmp_path):
+    """Daemon over a cold PAGED lineitem set with the device cache on
+    — the hot-set serving shape the scheduler exists for."""
+    from netsdb_tpu.relational.table import ColumnTable
+
+    cfg = Configuration(root_dir=str(tmp_path / "srv"),
+                        page_size_bytes=16384 * 4,
+                        page_pool_bytes=1 << 20,
+                        device_cache_bytes=64 << 20)
+    ctl = ServeController(cfg, port=0, max_jobs=8)
+    port = ctl.start()
+    addr = f"127.0.0.1:{port}"
+    boot = RemoteClient(addr)
+    boot.create_database("d")
+    boot.create_set("d", "lineitem", type_name="table", storage="paged")
+    boot.send_table("d", "lineitem",
+                    ColumnTable(_lineitem_cols(60_000),
+                                {"l_returnflag": ["A", "N", "R"],
+                                 "l_linestatus": ["F", "O"]}))
+    boot.close()
+    yield ctl, addr
+    ctl.shutdown()
+
+
+def test_n_identical_cold_executes_run_exactly_once(paged_server):
+    """The acceptance criterion: N=8 concurrent byte-identical
+    idempotent EXECUTEs over one cold paged set produce exactly ONE
+    execution — one devcache install, sched.coalesce_hits = N-1 — and
+    every waiter receives a correct reply under its OWN qid."""
+    from netsdb_tpu.relational import dag as rdag
+
+    ctl, addr = paged_server
+    sink = rdag.q01_sink("d")
+    n = 8
+
+    # gate the real handler so the leader provably stays in flight
+    # until every sibling has coalesced behind it — deterministic, not
+    # a race on execution time
+    orig = ctl.handlers[MsgType.EXECUTE_COMPUTATIONS]
+    release = threading.Event()
+
+    def gated(p):
+        release.wait(30)
+        return orig(p)
+
+    ctl.handlers[MsgType.EXECUTE_COMPUTATIONS] = gated
+
+    hits0 = _counter("sched.coalesce_hits")
+    installs0 = ctl.library.store.device_cache().stats()["installs"]
+    results = [None] * n
+    errors = [None] * n
+
+    def worker(i):
+        c = RemoteClient(addr, client_id=f"tenant-{i}")
+        try:
+            results[i] = c.execute_computations(
+                sink, job_name="q01-coalesce", fetch_results=False)
+        except Exception as e:  # noqa: BLE001 — asserted below
+            errors[i] = e
+        finally:
+            c.close()
+
+    threads = [threading.Thread(target=worker, args=(i,))
+               for i in range(n)]
+    for t in threads:
+        t.start()
+    # all N-1 siblings must be parked behind the leader before it runs
+    assert _wait_for(
+        lambda: _counter("sched.coalesce_hits") - hits0 == n - 1), \
+        f"only {_counter('sched.coalesce_hits') - hits0} coalesced"
+    release.set()
+    for t in threads:
+        t.join(timeout=120)
+
+    assert errors == [None] * n, f"waiter failed: {errors}"
+    # every waiter got the leader's (correct) reply
+    assert all(r == results[0] for r in results)
+    assert results[0]  # non-empty summaries
+    # exactly ONE execution server-side
+    with ctl._jobs_lock:
+        runs = [j for j in ctl._jobs.values()
+                if j["name"] == "q01-coalesce"]
+    assert len(runs) == 1 and runs[0]["status"] == "done"
+    # the devcache install counter ticked ONCE
+    assert ctl.library.store.device_cache().stats()["installs"] \
+        == installs0 + 1
+    assert _counter("sched.coalesce_hits") - hits0 == n - 1
+
+    # every waiter kept its own qid: n distinct server-side profiles,
+    # n-1 of them annotated with the leader's qid
+    profiles = ctl.trace_ring.last(None)
+    qids = {p["qid"] for p in profiles}
+    coalesced = [p for p in profiles
+                 if (p.get("meta") or {}).get("sched.coalesced_into")]
+    assert len(coalesced) == n - 1
+    leader_qids = {(p.get("meta") or {}).get("sched.coalesced_into")
+                   for p in coalesced}
+    assert len(leader_qids) == 1
+    assert leader_qids.pop() in qids
+
+    # the warm follow-up EXECUTE rides the installed cache: no second
+    # install, and the scheduler leaves it alone (affinity probe warm)
+    c = RemoteClient(addr)
+    c.execute_computations(sink, job_name="q01-warm",
+                           fetch_results=False)
+    c.close()
+    assert ctl.library.store.device_cache().stats()["installs"] \
+        == installs0 + 1
+
+    # sched.* families reach the OpenMetrics scrape with stable names
+    from netsdb_tpu.obs.export import parse_openmetrics
+
+    c = RemoteClient(addr)
+    fams = parse_openmetrics(
+        c.get_metrics(format="openmetrics")["text"])
+    c.close()
+    assert "netsdb_sched_coalesce_hits_total" in fams
+    assert "netsdb_sched_admits_total" in fams
+
+
+def test_coalesced_waiter_survives_leader_death(paged_server):
+    """Chaos contract: the leader dies mid-run. The waiter gets the
+    typed retryable CoalesceAborted — never a wrong or half-written
+    reply — and its RETRY re-executes successfully."""
+    from netsdb_tpu.relational import dag as rdag
+
+    ctl, addr = paged_server
+    sink = rdag.q01_sink("d")
+    orig = ctl.handlers[MsgType.EXECUTE_COMPUTATIONS]
+    release = threading.Event()
+    calls = {"n": 0}
+
+    def dies_once(p):
+        calls["n"] += 1
+        if calls["n"] == 1:
+            release.wait(30)
+            raise RuntimeError("injected leader death")
+        return orig(p)
+
+    ctl.handlers[MsgType.EXECUTE_COMPUTATIONS] = dies_once
+    hits0 = _counter("sched.coalesce_hits")
+    fails0 = _counter("sched.coalesce_failures")
+    leader_err = {}
+
+    def leader():
+        c = RemoteClient(addr, retry=RetryPolicy(max_attempts=1))
+        try:
+            c.execute_computations(sink, job_name="dies",
+                                   fetch_results=False)
+        except RemoteError as e:
+            leader_err["e"] = e
+        finally:
+            c.close()
+
+    t1 = threading.Thread(target=leader)
+    t1.start()
+    assert _wait_for(lambda: calls["n"] == 1)
+
+    # waiter WITH retries: first attempt is aborted typed-retryable by
+    # the leader's death, the retry re-executes and succeeds
+    waiter_out = {}
+
+    def waiter():
+        c = RemoteClient(addr, retry=FAST)
+        try:
+            waiter_out["r"] = c.execute_computations(
+                sink, job_name="dies", fetch_results=False)
+            waiter_out["attempts"] = c.last_attempts
+        finally:
+            c.close()
+
+    t2 = threading.Thread(target=waiter)
+    t2.start()
+    assert _wait_for(
+        lambda: _counter("sched.coalesce_hits") - hits0 >= 1)
+    release.set()
+    t1.join(timeout=60)
+    t2.join(timeout=60)
+
+    # the leader saw its own (fatal) handler error
+    assert "injected leader death" in str(leader_err["e"])
+    # the waiter's first attempt died typed-retryable and counted...
+    assert _counter("sched.coalesce_failures") > fails0
+    assert waiter_out["attempts"] >= 2
+    # ...and the retry produced a real, correct reply
+    assert waiter_out["r"]
+
+    # with retries DISABLED the waiter surfaces the typed error itself
+    calls["n"] = 0
+    release.clear()
+    t1 = threading.Thread(target=leader)
+    t1.start()
+    assert _wait_for(lambda: calls["n"] == 1)
+    c = RemoteClient(addr, retry=RetryPolicy(max_attempts=1))
+    err = {}
+
+    def bare_waiter():
+        try:
+            c.execute_computations(sink, job_name="dies",
+                                   fetch_results=False)
+        except CoalesceAbortedError as e:
+            err["e"] = e
+
+    t2 = threading.Thread(target=bare_waiter)
+    t2.start()
+    assert _wait_for(lambda: _counter("sched.coalesce_hits") - hits0 >= 2)
+    release.set()
+    t1.join(timeout=60)
+    t2.join(timeout=60)
+    c.close()
+    assert err["e"].retryable
+    assert isinstance(err["e"], CoalesceAbortedError)
+
+
+def test_one_logical_qid_across_coalesce_and_mirror(tmp_path):
+    """A mirrored-follower EXECUTE keeps ONE logical qid across the
+    coalesce + mirror hop: two identical client EXECUTEs coalesce on
+    the leader, the follower receives (and executes) exactly one
+    forwarded frame, and its trace carries the LEADER's qid — the
+    waiter's qid never crosses the wire."""
+    fctl = ServeController(Configuration(root_dir=str(tmp_path / "f")),
+                           port=0)
+    fport = fctl.start()
+    mctl = ServeController(Configuration(root_dir=str(tmp_path / "m")),
+                           port=0, followers=[f"127.0.0.1:{fport}"])
+    mport = mctl.start()
+    addr = f"127.0.0.1:{mport}"
+    try:
+        from netsdb_tpu.plan.computations import (Apply, ScanSet,
+                                                  WriteSet)
+
+        boot = RemoteClient(addr)
+        boot.create_database("d")
+        boot.create_set("d", "in", type_name="object")
+        boot.send_data("d", "in", [{"i": 1}, {"i": 2}])
+        boot.close()
+        sink = WriteSet(Apply(ScanSet("d", "in"), lambda x: x,
+                              traceable=False), "d", "out")
+
+        orig = mctl.handlers[MsgType.EXECUTE_COMPUTATIONS]
+        release = threading.Event()
+
+        def gated(p):
+            release.wait(30)
+            return orig(p)
+
+        mctl.handlers[MsgType.EXECUTE_COMPUTATIONS] = gated
+        hits0 = _counter("sched.coalesce_hits")
+        outs = [None, None]
+
+        def worker(i):
+            c = RemoteClient(addr, client_id="tenant")
+            try:
+                outs[i] = c.execute_computations(
+                    sink, job_name="mirror-coalesce",
+                    fetch_results=False)
+            finally:
+                c.close()
+
+        t0 = threading.Thread(target=worker, args=(0,))
+        t1 = threading.Thread(target=worker, args=(1,))
+        t0.start()
+        t1.start()
+        assert _wait_for(
+            lambda: _counter("sched.coalesce_hits") - hits0 == 1)
+        release.set()
+        t0.join(timeout=60)
+        t1.join(timeout=60)
+        assert outs[0] == outs[1] and outs[0]
+
+        # the follower executed exactly once
+        with fctl._jobs_lock:
+            fruns = [j for j in fctl._jobs.values()
+                     if j["name"] == "mirror-coalesce"]
+        assert len(fruns) == 1
+        # and under exactly the leader's qid: the leader ran 1 of the
+        # 2 client qids; the follower's ring holds only that one
+        leader_qids = {p["qid"] for p in mctl.trace_ring.last(None)
+                       if not (p.get("meta") or {})
+                       .get("sched.coalesced_into")}
+        follower_qids = {p["qid"] for p in fctl.trace_ring.last(None)}
+        assert len(follower_qids) == 1
+        assert follower_qids <= leader_qids
+    finally:
+        mctl.shutdown()
+        fctl.shutdown()
+
+
+# --- typed backpressure over the wire ---------------------------------
+
+def test_lane_quota_rejection_crosses_wire_typed(tmp_path):
+    """A saturated LANE rejects with LaneSaturatedError (not blanket
+    AdmissionFull), carrying the lane's observed queue depth."""
+    from netsdb_tpu.plan.computations import (Apply, ScanSet,
+                                              WriteSet)
+
+    cfg = Configuration(root_dir=str(tmp_path / "q"),
+                        sched_lane_quota=1, sched_coalesce=False)
+    ctl = ServeController(cfg, port=0, max_jobs=1,
+                          admission_timeout_s=10.0)
+    port = ctl.start()
+    addr = f"127.0.0.1:{port}"
+    try:
+        boot = RemoteClient(addr)
+        boot.create_database("d")
+        boot.create_set("d", "in", type_name="object")
+        boot.send_data("d", "in", [1, 2, 3])
+        boot.close()
+
+        def slow(x):
+            # closures ship over the wire — stdlib sleep only (an
+            # Event would not pickle); the polls below make the
+            # ordering deterministic before the clock matters
+            time.sleep(2.0)
+            return x
+
+        def sink(tag):
+            return WriteSet(Apply(ScanSet("d", "in"), slow,
+                                  traceable=False), "d", tag)
+
+        def fire(tag):
+            c = RemoteClient(addr, retry=RetryPolicy(max_attempts=1))
+            try:
+                c.execute_computations(sink(tag), job_name=f"job-{tag}",
+                                       fetch_results=False)
+            finally:
+                c.close()
+
+        t_run = threading.Thread(target=fire, args=("a",))
+        t_run.start()  # takes the only slot (runs until released)
+        assert _wait_for(lambda: any(
+            j["status"] == "running" for j in ctl._jobs.values()))
+        t_q = threading.Thread(target=fire, args=("b",))
+        t_q.start()  # parks in the default lane (depth 1 == quota)
+        assert _wait_for(
+            lambda: ctl.sched.lanes.snapshot()["queued"] == 1)
+
+        c = RemoteClient(addr, retry=RetryPolicy(max_attempts=1))
+        with pytest.raises(LaneSaturatedError) as ei:
+            c.execute_computations(sink("c"), job_name="job-c",
+                                   fetch_results=False)
+        c.close()
+        assert ei.value.retryable
+        assert ei.value.queue_depth == 1
+        assert ei.value.lane == "default"
+        t_run.join(timeout=30)
+        t_q.join(timeout=30)
+    finally:
+        ctl.shutdown()
+
+
+def test_client_backoff_honors_server_retry_after_hint(tmp_path):
+    """The satellite contract: a retryable failure carrying
+    retry_after_s makes the client sleep the SERVER's hint, not its
+    exponential schedule."""
+    ctl = ServeController(Configuration(root_dir=str(tmp_path / "h")),
+                          port=0)
+    port = ctl.start()
+    try:
+        c = RemoteClient(f"127.0.0.1:{port}",
+                         retry=RetryPolicy(max_attempts=3,
+                                           base_delay_s=0.001,
+                                           max_delay_s=0.002))
+        calls = {"n": 0}
+
+        def attempt(io_timeout):
+            calls["n"] += 1
+            if calls["n"] == 1:
+                e = LaneSaturatedError("LaneSaturated", "quota full")
+                e.retry_after_s = 0.25
+                raise e
+            return "ok"
+
+        t0 = time.perf_counter()
+        out = c._retry_driver(attempt)
+        dt = time.perf_counter() - t0
+        assert out == "ok" and calls["n"] == 2
+        # exponential would sleep <= 2ms; the hint is 250ms (+<=25%
+        # jitter)
+        assert 0.2 <= dt < 1.0, f"hint not honored: slept {dt}s"
+        c.close()
+    finally:
+        ctl.shutdown()
+
+
+def test_lane_hint_and_client_identity_key_lanes(tmp_path):
+    """LANE_KEY steers admission when present; CLIENT_ID_KEY is the
+    fallback lane — per-client lanes with zero client changes."""
+    from netsdb_tpu.plan.computations import (Apply, ScanSet,
+                                              WriteSet)
+
+    ctl = ServeController(Configuration(root_dir=str(tmp_path / "l")),
+                          port=0)
+    port = ctl.start()
+    addr = f"127.0.0.1:{port}"
+    try:
+        boot = RemoteClient(addr)
+        boot.create_database("d")
+        boot.create_set("d", "in", type_name="object")
+        boot.send_data("d", "in", [1])
+        boot.close()
+        sink = WriteSet(Apply(ScanSet("d", "in"), lambda x: x,
+                              traceable=False), "d", "out")
+
+        c1 = RemoteClient(addr, client_id="tenant-a", lane="gold")
+        c1.execute_computations(sink, job_name="hinted",
+                                fetch_results=False)
+        c1.close()
+        c2 = RemoteClient(addr, client_id="tenant-b")
+        c2.execute_computations(sink, job_name="fallback",
+                                fetch_results=False)
+        c2.close()
+        lanes = {j["name"]: j["lane"] for j in ctl._jobs.values()}
+        assert lanes["hinted"] == "gold"
+        assert lanes["fallback"] == "tenant-b"
+        snap = ctl.sched.lanes.snapshot()["lanes"]
+        assert "gold" in snap and "tenant-b" in snap
+    finally:
+        ctl.shutdown()
