@@ -88,7 +88,8 @@ def _cpu_reference_rows_per_sec() -> float:
 # snapshots taken with --sched; absent-in-one-run metrics are never
 # gated (compare_runs reports "not compared").
 HEADLINE_METRICS = {"ff_inference_rows_per_sec_per_chip": "higher",
-                    "serve_sched_p99_speedup": "higher"}
+                    "serve_sched_p99_speedup": "higher",
+                    "plan_fusion_speedup": "higher"}
 REGRESSION_PCT = 15.0
 
 
@@ -269,6 +270,30 @@ def main():
             # that reads as a -100% regression
             print(f"-- sched A/B produced no speedup figure; metric "
                   f"omitted: {json.dumps(sched)}", file=sys.stderr)
+    if "--fusion" in sys.argv:
+        # fusion-aware plan compilation A/B (micro_bench --fusion):
+        # a mixed paged/resident plan with a 12-node resident spine,
+        # plan_fusion on vs off through the real executor — the
+        # raw-dispatch headline (the fold-stream arm rides along as
+        # detail; its CPU number reflects no transfer overlap to hide,
+        # same caveat as BENCH_r06)
+        from netsdb_tpu.workloads.micro_bench import bench_fusion
+
+        fz = bench_fusion()
+        if fz.get("plan_fusion_speedup"):
+            records.append({
+                "metric": "plan_fusion_speedup",
+                "value": fz["plan_fusion_speedup"],
+                "unit": "x (resident-spine mixed plan, plan_fusion "
+                        "on vs off)",
+                "detail": {
+                    "spine": fz.get("spine"),
+                    "fold_stream": fz.get("fold_stream"),
+                },
+            })
+        else:
+            print(f"-- fusion A/B produced no speedup figure; metric "
+                  f"omitted: {json.dumps(fz)}", file=sys.stderr)
     # one JSON line: a single record stays the historical shape; with
     # --sched the line is a list (compare_runs accepts both)
     print(json.dumps(records if len(records) > 1 else result))

@@ -87,13 +87,20 @@ class _Suppression:
 
 
 class Module:
-    """One parsed source file, shared by every rule in a run."""
+    """One parsed source file, shared by every rule in a run.
 
-    def __init__(self, path: str, repo: str = REPO):
+    ``source`` overrides the file read — the ``lint --fix`` rewriter
+    re-lints its in-memory rewrite between passes without a disk
+    round-trip (one construction path either way)."""
+
+    def __init__(self, path: str, repo: str = REPO,
+                 source: Optional[str] = None):
         self.path = os.path.abspath(path)
         self.rel = os.path.relpath(self.path, repo).replace(os.sep, "/")
-        with open(self.path, encoding="utf-8") as f:
-            self.source = f.read()
+        if source is None:
+            with open(self.path, encoding="utf-8") as f:
+                source = f.read()
+        self.source = source
         self.lines = self.source.splitlines()
         self.tree: Optional[ast.AST] = None
         self.parse_error: Optional[str] = None

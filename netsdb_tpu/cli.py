@@ -627,6 +627,11 @@ def _cmd_micro_bench(args) -> int:
 
         print(json.dumps(micro_bench.bench_lint_overhead(), indent=2))
         return 0
+    if getattr(args, "fusion", False):
+        import json
+
+        print(json.dumps(micro_bench.bench_fusion(), indent=2))
+        return 0
     names = None
     if args.only is not None:
         names = [n.strip() for n in args.only.split(",") if n.strip()]
@@ -977,6 +982,24 @@ def _cmd_lint(args) -> int:
         for rule in L.all_rules():
             print(f"{rule.id:<22} {rule.rationale}")
         return 0
+    if getattr(args, "fix", False):
+        from netsdb_tpu.analysis import fix as F
+
+        res = F.run_fix(paths=args.paths or None,
+                        dry_run=getattr(args, "dry_run", False))
+        if getattr(args, "dry_run", False):
+            if res["diff"]:
+                print(res["diff"], end="")
+            print(f"lint --fix --dry-run: {res['fixed']} fix(es) in "
+                  f"{len(res['files'])} file(s), {res['skipped']} "
+                  f"skipped (safety gates)")
+            return 0
+        print(f"lint --fix: applied {res['fixed']} fix(es) in "
+              f"{len(res['files'])} file(s), {res['skipped']} "
+              f"skipped (safety gates)")
+        for rel in res["files"]:
+            print(f"  fixed: {rel}")
+        # fall through: report what remains after the rewrite
     try:
         diags = L.run_lint(paths=args.paths or None,
                            rules=args.rule or None)
@@ -1094,6 +1117,11 @@ def main(argv=None) -> int:
                    help="cost of the runtime lock-order witness on "
                         "the staged fold stream (witness on vs off; "
                         "< 2%% budget, ~0 when off)")
+    p.add_argument("--fusion", action="store_true",
+                   help="fusion-aware plan compilation paired A/B "
+                        "(plan_fusion on vs off on the staged fold "
+                        "stream + a resident-spine mixed plan; "
+                        "reports plan_fusion_speedup + trace counts)")
 
     sub.add_parser("selftest",
                    help="scripted integration sequence (integratedTests.py)")
@@ -1220,6 +1248,14 @@ def main(argv=None) -> int:
                    help="print the rule catalog (id + rationale)")
     p.add_argument("--json", action="store_true",
                    help="machine-readable diagnostics")
+    p.add_argument("--fix", action="store_true",
+                   help="auto-apply the mechanical iter-close fixes "
+                        "(wrap directly-iterated stream producers in "
+                        "contextlib.closing) before reporting; "
+                        "idempotent — a second run changes nothing")
+    p.add_argument("--dry-run", action="store_true",
+                   help="with --fix: print the unified diff instead "
+                        "of writing files")
 
     p = sub.add_parser("autotune",
                        help="measure physical-strategy crossovers "

@@ -71,6 +71,24 @@ class Configuration:
     # `micro_bench --bucket-sweep` reports pad-waste vs trace-count
     # per density (the ROADMAP ladder-tuning item).
     bucket_density: int = 2
+    # --- fusion-aware plan compilation (plan/fusion.py) ---
+    # master switch for the region mapper: on, the streamed executor
+    # compiles maximal traceable resident subgraphs as ONE XLA program
+    # per region (replacing per-node jit entries) and fuses streamed
+    # folds' rowwise pre-chains / traceable epilogues into the fold's
+    # compiled loop. Off byte-for-byte restores the per-node paths
+    # (same jit-cache keys, trace counts and EXPLAIN shape) — the safe
+    # rollback the acceptance gate pins.
+    plan_fusion: bool = True
+    # smallest node count worth compiling as one spine region (a
+    # 1-node "region" is exactly today's per-node jit; floor 2)
+    fusion_min_region: int = 2
+    # cost feed for fusion decisions: "ledger" reads the per-(job,
+    # node-label) OperatorLedger means (wall vs device gap = dispatch
+    # overhead, retrace rates veto churn-prone labels), falling back
+    # to a static estimate for never-seen labels; "static" forces the
+    # fallback everywhere (cold daemons, deterministic tests)
+    fusion_cost_source: str = "ledger"
     # --- cross-query device-resident set cache (storage/devcache.py) ---
     # byte budget for placed set blocks kept DEVICE-RESIDENT across
     # queries and serve requests (the buffer-pool role: the second
@@ -148,6 +166,20 @@ class Configuration:
     # execution fanned out to all waiters (serve/sched/coalesce.py);
     # each waiter keeps its own qid/trace/idempotency attribution
     sched_coalesce: bool = True
+    # completed-fingerprint retention window (serve/sched/coalesce.py):
+    # a byte-identical idempotent EXECUTE arriving within this many
+    # seconds AFTER its coalesce leader finished still hits — the
+    # retained reply is served under the late waiter's own qid/token
+    # (sched.coalesce_late_hits). Staleness is bounded by the TTL (the
+    # same window a client retry of a just-completed request would
+    # observe). Default 0 = OFF: retention dedupes DISTINCT back-to-
+    # back identical queries, not just concurrent ones — a visible
+    # freshness trade the operator opts into per deployment (thundering
+    # retry herds, dashboard fan-out), not a universal default.
+    sched_coalesce_done_ttl_s: float = 0.0
+    # completed-fingerprint entries retained (oldest evicted beyond
+    # this — replies can be large, the bound is entries not bytes)
+    sched_coalesce_done_max: int = 32
     # cache-aware hot-set admission (serve/sched/policy.py): when a
     # cold hot-set installer is already streaming, sibling queries on
     # the same placed sets queue behind it and wake into the warm
@@ -190,6 +222,10 @@ class Configuration:
         if self.obs_trace_sample < 1:
             raise ValueError(f"obs_trace_sample must be >= 1, got "
                              f"{self.obs_trace_sample!r}")
+        if self.fusion_cost_source not in ("ledger", "static"):
+            raise ValueError(f"fusion_cost_source must be 'ledger' or "
+                             f"'static', got "
+                             f"{self.fusion_cost_source!r}")
 
     @property
     def catalog_path(self) -> str:

@@ -40,6 +40,22 @@ class PlacementCandidate:
     specs: Dict[str, object]  # set-role → block tuple or Placement
 
 
+def fusion_candidates() -> tuple:
+    """Fusion on/off as advisor ARMS — the plan-compilation decision
+    exposed to the same explore/exploit bandit that learns placements
+    (``arm.specs["plan_fusion"]`` is applied to the executing client's
+    ``config.plan_fusion`` by the A/B harness,
+    :func:`~netsdb_tpu.learning.ab_bench.bench_fusion_ab`).  The cost
+    model in ``plan/fusion.py`` decides WHICH regions fuse; these arms
+    let measured wall time decide WHETHER fusing pays for a given job
+    at all — the never-fuse/always-fuse comparison *Fast and Fusiest*
+    (arxiv 2602.15166) shows a mapper must win against."""
+    return (
+        PlacementCandidate("fusion_on", (1,), {"plan_fusion": True}),
+        PlacementCandidate("fusion_off", (1,), {"plan_fusion": False}),
+    )
+
+
 class PlacementAdvisor:
     def __init__(self, candidates: Sequence[PlacementCandidate],
                  db: Optional[HistoryDB] = None,

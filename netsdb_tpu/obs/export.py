@@ -116,6 +116,10 @@ def _catalog() -> Dict[str, Tuple[str, str]]:
                               "lane weights"),
         ("sched.coalesce_hits", "EXECUTE frames coalesced behind an "
                                 "identical in-flight execution"),
+        ("sched.coalesce_late_hits", "EXECUTE frames served from the "
+                                     "completed-fingerprint retention "
+                                     "window just after their leader "
+                                     "finished"),
         ("sched.coalesce_failures", "coalesced waiters aborted by a "
                                     "failed or overlong leader "
                                     "(typed CoalesceAborted)"),
@@ -124,6 +128,15 @@ def _catalog() -> Dict[str, Tuple[str, str]]:
                                 "warm device cache"),
         ("sched.affinity_installs", "cold-set installer executions "
                                     "registered by the affinity gate"),
+        ("fusion.regions_formed", "fusion regions formed by the plan "
+                                  "mapper (plan/fusion.py)"),
+        ("fusion.nodes_fused", "plan nodes compiled inside a fusion "
+                               "region"),
+        ("fusion.fallbacks", "fusion regions abandoned at execution "
+                             "time (non-jit-safe values) — the nodes "
+                             "ran per-node instead"),
+        ("fusion.cost_estimates", "per-node cost-model estimates "
+                                  "computed by the fusion mapper"),
         ("slo.breaches", "SLO objective breach transitions"),
         ("slo.recoveries", "SLO objective recovery transitions"),
         ("analysis.violations", "runtime lock-order cycles detected "

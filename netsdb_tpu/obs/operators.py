@@ -58,7 +58,8 @@ class OpRecord:
     workers report into the consumer's record."""
 
     __slots__ = ("op_id", "kind", "label", "atom", "inputs", "wall_s",
-                 "rows_in", "rows_out", "fused", "_mu", "_counters")
+                 "rows_in", "rows_out", "fused", "region", "_mu",
+                 "_counters")
 
     def __init__(self, op_id: int, kind: str, label: str, atom: str,
                  inputs: List[int]):
@@ -71,6 +72,10 @@ class OpRecord:
         self.rows_in: Optional[int] = None
         self.rows_out: Optional[int] = None
         self.fused = False
+        #: fusion region id (plan/fusion.py) this node compiled into,
+        #: None outside any region — the explain tree renders region
+        #: membership and boundaries from this
+        self.region: Optional[int] = None
         self._mu = threading.Lock()
         self._counters: Dict[str, float] = {}
 
@@ -94,6 +99,8 @@ class OpRecord:
             out["rows_out"] = self.rows_out
         if self.fused:
             out["fused"] = True
+        if self.region is not None:
+            out["region"] = self.region
         if counters:
             out["counters"] = counters
         return out
@@ -330,6 +337,17 @@ class OperatorLedger:
                 out.setdefault(job, {})[label] = dict(row)
             return out
 
+    def job_rows(self, job: str) -> Dict[str, Dict[str, float]]:
+        """ONE job's {label: {field: total}} rows — the fusion cost
+        model's per-execution read (copying only the queried job's
+        rows keeps the contended section O(labels-of-one-job), not
+        O(whole ledger), on the serve hot path)."""
+        job = str(job)
+        with self._mu:
+            return {label: dict(row)
+                    for (j, label), row in self._rows.items()
+                    if j == job}
+
     def reset(self) -> None:
         with self._mu:
             self._rows.clear()
@@ -448,29 +466,41 @@ def render_tree(tree: Dict[str, Any],
             bits.append(f"rows_in={n['rows_in']}")
         if n.get("rows_out") is not None:
             bits.append(f"rows_out={n['rows_out']}")
-        if n.get("fused"):
+        if n.get("region") is not None:
+            # fusion region membership (plan/fusion.py): every node of
+            # region rN compiled into ONE XLA program
+            bits.append(f"region=r{n['region']}"
+                        + ("" if n.get("fused") else "*"))
+        elif n.get("fused"):
             bits.append("fused")
         c = n.get("counters") or {}
         keep = {k: v for k, v in c.items()
                 if k in ("chunks", "blocks", "pairs", "traces",
-                         "devcache.hits", "devcache.misses",
-                         "stage.chunks", "stage.bytes")}
+                         "region_nodes", "devcache.hits",
+                         "devcache.misses", "stage.chunks",
+                         "stage.bytes")}
         if keep:
             bits.append(" ".join(f"{k}={int(v)}" for k, v in
                                  sorted(keep.items())))
         return "  ".join(bits)
 
-    def walk(op_id: int, depth: int, seen: set) -> None:
+    def walk(op_id: int, depth: int, seen: set,
+             parent_region=None) -> None:
         n = nodes.get(op_id)
         if n is None:
             return
         marker = "-> " if depth else ""
+        region = n.get("region")
+        if depth and region != parent_region:
+            # fusion-region boundary: the edge crosses out of (or
+            # into) a fused program — the materialization point
+            marker = "=> " if region is None else f"┆r{region} "
         lines.append(f"{'  ' * depth}{marker}{fmt(n)}")
         if op_id in seen:  # shared subgraph: print once per parent,
             return         # recurse once
         seen.add(op_id)
         for i in n.get("inputs") or ():
-            walk(i, depth + 1, seen)
+            walk(i, depth + 1, seen, region)
 
     seen: set = set()
     for r in roots:

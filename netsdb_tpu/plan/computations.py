@@ -69,7 +69,7 @@ class Apply(Computation):
 
     def __init__(self, input_: Computation, fn: Optional[Callable[[Any], Any]] = None,
                  label: str = "", traceable: bool = True, fold=None,
-                 tensor_fold=None):
+                 tensor_fold=None, rowwise: bool = False):
         """``traceable=False`` marks a host-side projection (numpy / Python
         object work) that must run eagerly outside jit — the reference
         analogue is a C++ lambda that touches non-tensor state.
@@ -97,10 +97,33 @@ class Apply(Computation):
         in-repo builders do: ``label=f"filter>{cutoff}"``) or vary
         ``job_name`` per parameterization. Non-traceable
         (``traceable=False``) nodes evaluate fresh every time and are
-        exempt. See README "Execution pipeline"."""
+        exempt. See README "Execution pipeline".
+
+        ``rowwise=True`` declares ``fn`` ROW-DECOMPOSABLE: applied to
+        any row-slice of its input it produces exactly the matching
+        row-slice of the whole-input result, and it preserves the
+        chunk contract (a ColumnTable in → a ColumnTable out, row for
+        row, validity mask and ``_rowid`` untouched or forwarded) AND
+        the table's SCHEMA SURFACE — column names and dictionary
+        encodings a downstream fold's ``init``/``finalize`` may read.
+        The fusion mapper (``plan/fusion.py``) uses the declaration to
+        fuse the node into a downstream fold's per-chunk step when the
+        scanned set is paged — the chunk is transformed and reduced in
+        one compiled program instead of materializing the whole set
+        for the transform. Under that fusion only the STEPS see
+        transformed chunks; ``init(state, src, ...)`` and
+        ``finalize(state, src, ...)`` still receive the raw scan
+        handle, which is why a rename or dictionary re-encoding
+        (schema the fold could observe via ``src``) disqualifies the
+        declaration. Declaring ``rowwise`` for a fn that mixes rows
+        (sorts, global statistics, cross-row joins) or reshapes the
+        schema surface silently computes the wrong answer on paged
+        inputs — the same class of contract as a FoldSpec's
+        decomposition."""
         super().__init__([input_])
         self.fold = fold
         self.tensor_fold = tensor_fold
+        self.rowwise = rowwise
         if fn is None:
             if fold is None:
                 raise ValueError("Apply needs fn or fold")

@@ -57,14 +57,28 @@ _cache_lock = threading.Lock()
 # it must stay CONSTANT across repeated executions over differing
 # ragged tails (the recompile-churn regression the buckets absorb)
 _compile_stats = {"hits": 0, "misses": 0, "traces": 0}
+# per-FUSION-REGION trace counters ("job:fingerprint" → XLA traces of
+# that region's one compiled program) — the fused-path analogue of
+# ``traces``: flat across ragged-tail re-executions, one tick per
+# region program per bucketed shape (plan/fusion.py). Bounded: a
+# serving loop rebuilding distinct plans must not grow this without
+# limit (oldest-inserted entries drop past the cap — dict preserves
+# insertion order), and ``clear_compiled_cache`` resets it with the
+# LRU it shadows.
+_REGION_TRACES_CAP = 1024
+_region_traces: Dict[str, int] = {}
 
 
-def compile_stats() -> Dict[str, int]:
+def compile_stats() -> Dict[str, Any]:
     """Snapshot of the compiled-cache counters (hits/misses at the LRU,
-    traces at XLA). The staging tests assert ``traces`` is flat across
-    re-executions with different ragged tail sizes."""
+    traces at XLA, plus the per-fusion-region trace map under
+    ``region_traces``). The staging tests assert ``traces`` is flat
+    across re-executions with different ragged tail sizes; the fusion
+    tests assert the same of every ``region_traces`` entry."""
     with _cache_lock:
-        return dict(_compile_stats)
+        out: Dict[str, Any] = dict(_compile_stats)
+        out["region_traces"] = dict(_region_traces)
+        return out
 
 
 # the central registry reports these SAME counters under "compile"
@@ -73,18 +87,24 @@ def compile_stats() -> Dict[str, int]:
 obs.REGISTRY.register_collector("compile", compile_stats)
 
 
-def _cached_jit(key: str, fn, donate_argnums: tuple = ()) -> Any:
+def _cached_jit(key: str, fn, donate_argnums: tuple = (),
+                region: Optional[str] = None) -> Any:
     """compiled-cache get-or-insert with the ONE LRU discipline (all
-    three call sites: fold steps, eager traceable nodes, whole-plan
-    programs). The wrapper is published BEFORE its first call, so
-    concurrent serve-layer threads racing the same cold key all call
-    ONE jitted wrapper (jax dedups the trace/compile internally)
-    instead of compiling N identical programs.
+    call sites: fold steps, eager traceable nodes, fusion-region
+    programs, whole-plan programs). The wrapper is published BEFORE
+    its first call, so concurrent serve-layer threads racing the same
+    cold key all call ONE jitted wrapper (jax dedups the trace/compile
+    internally) instead of compiling N identical programs.
 
     ``donate_argnums`` marks arguments XLA may consume in place — the
     fold loops donate argument 0 (the carried accumulator) so each
     step updates its state buffer instead of allocating a fresh one
-    per block (gated by ``staging.fold_donate_argnums``)."""
+    per block (gated by ``staging.fold_donate_argnums``).
+
+    ``region`` names the fusion region this program compiles
+    (``"job:fingerprint"``) — its retraces tick the per-region map
+    ``compile_stats()["region_traces"]`` alongside the global
+    ``traces`` counter."""
     with _cache_lock:
         cached = _compiled_cache.get(key)
         if cached is not None:
@@ -99,6 +119,11 @@ def _cached_jit(key: str, fn, donate_argnums: tuple = ()) -> Any:
         # operator (if any) so the explain tree shows WHICH NODE did
         with _cache_lock:
             _compile_stats["traces"] += 1
+            if region is not None:
+                _region_traces[region] = \
+                    _region_traces.get(region, 0) + 1
+                while len(_region_traces) > _REGION_TRACES_CAP:
+                    _region_traces.pop(next(iter(_region_traces)))
         obs.add("executor.traces")
         obs.operators.op_add("traces")
         return fn(*args, **kwargs)
@@ -604,6 +629,29 @@ def _execute_streamed(client, plan: LogicalPlan, scan_values: Dict[int, Any],
     # identical plans still share cache entries)
     topo_pos = {n.node_id: i for i, n in enumerate(plan.topo)}
 
+    # fusion-aware region mapping (plan/fusion.py): spine regions
+    # compile as ONE program each, graft regions weave rowwise
+    # pre-chains into fold steps and traceable epilogues onto fold
+    # outputs. plan_fusion=False takes the per-node paths byte-for-byte
+    # (same keys, same trace counts — the rollback contract).
+    from netsdb_tpu.plan import fusion
+
+    cfg = client.store.config
+    regions = None
+    graft_at: Dict[int, Any] = {}
+    consumers: Dict[int, Any] = {}
+    if getattr(cfg, "plan_fusion", True):
+        consumers = plan.consumers()  # ONE reverse-edge build, shared
+        rmap = fusion.map_regions(plan, scan_values, cfg, job_name,
+                                  traceable=_is_traceable,
+                                  consumers=consumers)
+        if rmap.regions:
+            regions = rmap
+            graft_at = {r.anchor: r for r in rmap.regions
+                        if r.kind == "graft"}
+    node_by_id = {n.node_id: n for n in plan.topo}
+    skip = set(regions.fused_away) if regions is not None else set()
+
     # fold-step accumulators (argument 0 of every step) are donated so
     # XLA updates the per-stream state in place instead of allocating a
     # fresh HBM buffer every block; auto-gated to backends that
@@ -616,10 +664,14 @@ def _execute_streamed(client, plan: LogicalPlan, scan_values: Dict[int, Any],
 
     donate_default = fold_donate_argnums(client.store.config)
 
-    def step_jit_for(node):
+    def step_jit_for(node, fz: str = ""):
+        # ``fz`` carries the graft region's fingerprint when the fold's
+        # steps were rewritten with a fused pre-chain: the wrapped step
+        # is a DIFFERENT program and must never share a cache entry
+        # with the bare fold's (plan_fusion=off keys stay unchanged)
         def step_jit(pidx, step, donate=None):
             key = (f"fold::{job_name}::{plan_key}::"
-                   f"n{topo_pos[node.node_id]}::{node.label}::{pidx}")
+                   f"n{topo_pos[node.node_id]}::{node.label}::{pidx}{fz}")
             return _cached_jit(
                 key, step,
                 donate_argnums=donate_default if donate is None else donate)
@@ -664,18 +716,60 @@ def _execute_streamed(client, plan: LogicalPlan, scan_values: Dict[int, Any],
             return tuple(demote(x) for x in v)
         return v
 
+    def graft_epilogue(greg, out):
+        """Apply a graft region's fused downstream chain to the fold's
+        merged output as ONE compiled program (fold→materialize→
+        per-node dispatch becomes fold→one program). Non-jit-safe fold
+        outputs run the chain eagerly — a counted fallback, never a
+        failure."""
+        if greg is None or not greg.post_ids:
+            return out
+        chain = fusion.compose_chain(
+            [node_by_id[i].fn for i in greg.post_ids])
+        if not _jit_safe_values([out]):
+            fusion.fallback("graft epilogue input not jit-safe")
+            return chain(out)
+        key = (f"region::{job_name}::{plan_key}::r{greg.rid}"
+               f"::{greg.fingerprint}::epi")
+        return _cached_jit(key, chain,
+                           region=f"{job_name}:{greg.fingerprint}")(out)
+
     def dispatch(node, in_vals):
         """One node's streamed-path evaluation — extracted so the
-        per-operator recorder can time it inclusively."""
+        per-operator recorder can time it inclusively. A graft
+        anchor's fused epilogue applies to EVERY return path (the
+        anchor may dispatch off the streaming branch — e.g. a
+        demoted-at-runtime stream input — and its skipped post-chain
+        nodes must still run)."""
+        greg = graft_at.get(node.node_id)
+        return graft_epilogue(greg, _dispatch_inner(node, in_vals,
+                                                    greg))
+
+    def _dispatch_inner(node, in_vals, greg):
         fold = getattr(node, "fold", None)
         src = getattr(node, "fold_src", 0)
+        if greg is not None and greg.pre_ids:
+            # the fused pre-chain was skipped by the topo loop: its
+            # paged SCAN handle replaces the chain's (never computed)
+            # output, and the chunk transforms run inside the fold's
+            # compiled step instead
+            in_vals = list(in_vals)
+            in_vals[src] = values[greg.stream_src]
         if (fold is not None and len(in_vals) > src
                 and isinstance(in_vals[src], PagedColumns)):
             resident = flatten_resident(
                 tuple(v for i, v in enumerate(in_vals) if i != src))
-            placement = placements.get(node.inputs[src].node_id)
-            return _run_fold(node, fold, in_vals[src], resident,
-                             placement, step_jit_for(node))
+            if greg is not None and greg.pre_ids:
+                placement = placements.get(greg.stream_src)
+                run_fold = fusion.wrap_fold_prechain(
+                    fold, [node_by_id[i].fn for i in greg.pre_ids])
+                sj = step_jit_for(node, fz=f"::fz{greg.fingerprint}")
+            else:
+                placement = placements.get(node.inputs[src].node_id)
+                run_fold = fold
+                sj = step_jit_for(node)
+            return _run_fold(node, run_fold, in_vals[src], resident,
+                             placement, sj)
         tsrcs = [i for i, v in enumerate(in_vals)
                  if isinstance(v, PagedTensor)]
         if tsrcs:
@@ -738,7 +832,90 @@ def _execute_streamed(client, plan: LogicalPlan, scan_values: Dict[int, Any],
     if recorder is not None and op_base != 0:
         recorder.mode = "mixed"  # an auto-split job's later component
     op_pos = {n.node_id: op_base + i for i, n in enumerate(plan.topo)}
+
+    def run_spine(reg) -> bool:
+        """Execute one spine region as ONE compiled program (all its
+        nodes replayed under a single trace — the region analogue of
+        the whole-plan jit). False = runtime fallback: the caller
+        un-skips the region's nodes and they dispatch per-node
+        exactly as with fusion off (counted, never an error)."""
+        nodes = [node_by_id[i] for i in reg.node_ids]
+        rset = set(reg.node_ids)
+        in_ids: List[int] = []
+        for n in nodes:
+            for i in n.inputs:
+                if i.node_id not in rset and i.node_id not in in_ids:
+                    in_ids.append(i.node_id)
+        args = [values[i] for i in in_ids]
+        if not _jit_safe_values(args):
+            fusion.fallback("spine inputs not jit-safe")
+            return False
+        out_ids = [nid for nid in reg.node_ids
+                   if not consumers.get(nid)
+                   or any(c.node_id not in rset
+                          for c in consumers.get(nid, ()))]
+
+        def region_fn(*fargs, _nodes=tuple(nodes), _in=tuple(in_ids),
+                      _out=tuple(out_ids)):
+            vals = dict(zip(_in, fargs))
+            for n in _nodes:
+                vals[n.node_id] = n.evaluate(
+                    *[vals[i.node_id] for i in n.inputs])
+            return tuple(vals[o] for o in _out)
+
+        key = (f"region::{job_name}::{plan_key}::r{reg.rid}"
+               f"::{reg.fingerprint}")
+        jfn = _cached_jit(key, region_fn,
+                          region=f"{job_name}:{reg.fingerprint}")
+        tail = nodes[-1]
+        ctx = (recorder.op(op_pos[tail.node_id], tail,
+                           [op_pos[i.node_id] for i in tail.inputs],
+                           args)
+               if recorder is not None else contextlib.nullcontext())
+        with obs.span("executor.fusion_region", "executor") as sp, \
+                ctx as opr:
+            t0 = time.perf_counter()
+            outs = jfn(*args)
+            dev_s = time.perf_counter() - t0
+            if sp is not None:
+                sp.counters["nodes"] = len(nodes)
+                sp.counters["device_est_s"] = dev_s
+            obs.add("device.est_s", dev_s)
+            if opr is not None:
+                opr.add("device_est_s", dev_s)
+                opr.add("region_nodes", len(nodes))
+        for nid, v in zip(out_ids, outs):
+            values[nid] = v
+        if recorder is not None:
+            # the whole region executed as one program: every member
+            # keeps its place in the tree, marked fused with its
+            # region id; the tail carries the measured wall time
+            for n in nodes:
+                rec = recorder.node(op_pos[n.node_id], n,
+                                    [op_pos[i.node_id]
+                                     for i in n.inputs])
+                rec.fused = True
+                rec.region = reg.rid
+                if n.node_id in values:
+                    rec.rows_out = obs.operators.rows_of(
+                        values[n.node_id])
+        return True
+
     for node in plan.topo:
+        if node.node_id in skip:
+            # subsumed by a fusion region (spine body or graft
+            # pre/post chain): no evaluation here — register the node
+            # so the explain tree keeps the plan's full shape
+            if recorder is not None:
+                opr = recorder.node(
+                    op_pos[node.node_id], node,
+                    [op_pos[i.node_id] for i in node.inputs])
+                opr.fused = True
+                opr.region = regions.region_of(node.node_id)
+                if node.node_id in values:
+                    opr.rows_out = obs.operators.rows_of(
+                        values[node.node_id])
+            continue
         if node.node_id in values:
             if recorder is not None:
                 opr = recorder.node(
@@ -747,16 +924,36 @@ def _execute_streamed(client, plan: LogicalPlan, scan_values: Dict[int, Any],
                 opr.rows_out = obs.operators.rows_of(
                     values[node.node_id])
             continue
-        in_vals = [values[i.node_id] for i in node.inputs]
+        sreg = (regions.spine_at.get(node.node_id)
+                if regions is not None else None)
+        if sreg is not None:
+            if run_spine(sreg):
+                continue
+            skip.difference_update(sreg.node_ids)  # per-node fallback
+        # a fused-away input (a graft pre-chain member) has no value —
+        # dispatch substitutes the chain's paged scan handle; every
+        # other input must exist (KeyError here would be a real bug)
+        in_vals = [values.get(i.node_id) if i.node_id in skip
+                   else values[i.node_id] for i in node.inputs]
+        greg = graft_at.get(node.node_id)
         if recorder is None:
-            values[node.node_id] = dispatch(node, in_vals)
-            continue
-        with recorder.op(op_pos[node.node_id], node,
-                         [op_pos[i.node_id] for i in node.inputs],
-                         in_vals) as opr:
             out_val = dispatch(node, in_vals)
-            opr.rows_out = obs.operators.rows_of(out_val)
+        else:
+            with recorder.op(op_pos[node.node_id], node,
+                             [op_pos[i.node_id] for i in node.inputs],
+                             in_vals) as opr:
+                out_val = dispatch(node, in_vals)
+                opr.rows_out = obs.operators.rows_of(out_val)
+                if regions is not None:
+                    rid = regions.region_of(node.node_id)
+                    if rid is not None:
+                        opr.region = rid
         values[node.node_id] = out_val
+        if greg is not None and greg.post_ids:
+            # the graft epilogue already ran inside dispatch: the
+            # chain's tail carries the fused result (its members were
+            # skipped above)
+            values[greg.post_ids[-1]] = out_val
     return values
 
 
@@ -983,3 +1180,4 @@ def _execute_computations(
 def clear_compiled_cache() -> None:
     with _cache_lock:
         _compiled_cache.clear()
+        _region_traces.clear()

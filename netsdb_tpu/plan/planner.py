@@ -48,6 +48,16 @@ class LogicalPlan:
         lines = [n.plan_atom() for n in self.topo]
         return "\n".join(lines)
 
+    def consumers(self) -> Dict[int, List[Computation]]:
+        """node_id → consumer nodes in topo order — the reverse edges
+        the fusion mapper walks (a node with exactly one consumer can
+        fuse into it without materializing its output)."""
+        out: Dict[int, List[Computation]] = {}
+        for n in self.topo:
+            for i in n.inputs:
+                out.setdefault(i.node_id, []).append(n)
+        return out
+
     def cache_key(self) -> str:
         """Canonical structural key: node names renumbered by topo
         position so two independently-built DAGs of the same shape share

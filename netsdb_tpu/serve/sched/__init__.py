@@ -79,12 +79,15 @@ class QueryScheduler:
                  coalesce: bool = True, affinity: bool = True,
                  affinity_wait_s: float = 30.0,
                  coalesce_wait_s: Optional[float] = 300.0,
+                 coalesce_done_ttl_s: float = 0.0,
+                 coalesce_done_max: int = 32,
                  cache_probe=None):
         self.lanes = LaneScheduler(slots, lanes=lanes, quota=quota,
                                    aging_every=aging_every)
         self.coalesce_enabled = bool(coalesce)
         self.coalesce_wait_s = coalesce_wait_s
-        self._coalesce = CoalesceTable()
+        self._coalesce = CoalesceTable(
+            done_ttl_s=coalesce_done_ttl_s, done_max=coalesce_done_max)
         self.affinity_enabled = bool(affinity) \
             and cache_probe is not None
         self._affinity = AffinityGate(cache_probe or (lambda s: True),

@@ -41,7 +41,8 @@ from __future__ import annotations
 
 import threading
 import time
-from typing import Any, Callable, Dict, Optional
+from collections import OrderedDict
+from typing import Any, Callable, Dict, Optional, Tuple
 
 from netsdb_tpu import obs
 from netsdb_tpu.serve.errors import CoalesceAborted
@@ -62,11 +63,55 @@ class _Flight:
 
 
 class CoalesceTable:
-    """fingerprint → in-flight execution; single-flight semantics."""
+    """fingerprint → in-flight execution; single-flight semantics.
 
-    def __init__(self):
+    ``done_ttl_s``/``done_max`` arm the COMPLETED-fingerprint cache: a
+    byte-identical EXECUTE arriving just after its coalesce leader
+    finished (the near-miss the in-flight table cannot catch) still
+    hits — the retained reply is served under the late waiter's own
+    qid/token, counted as ``sched.coalesce_late_hits``.  The window is
+    deliberately tight and doubly bounded (TTL + entry count, oldest
+    evicted): correctness rests on the same idempotency argument as
+    coalescing itself — these frames replay verbatim under a retried
+    token — but a long retention would serve ever-staler reads, so the
+    TTL caps the staleness exactly like a retry of a just-completed
+    request would experience.  ``done_ttl_s=0`` disables retention
+    (PR 9 behavior)."""
+
+    def __init__(self, done_ttl_s: float = 0.0, done_max: int = 32):
         self._mu = TrackedLock("sched.CoalesceTable._mu")
         self._inflight: Dict[str, _Flight] = {}
+        self._done_ttl_s = float(done_ttl_s or 0.0)
+        self._done_max = int(done_max)
+        # fingerprint → (result, finished_at); LRU-ordered, TTL-pruned
+        # on every touch (monotonic clock — the serve discipline)
+        self._done: "OrderedDict[str, Tuple[Any, float]]" = OrderedDict()
+
+    def _prune_done(self, now: float) -> None:
+        """Drop expired/overflow entries (caller holds ``_mu``)."""
+        ttl = self._done_ttl_s
+        while self._done:
+            _k, (_v, t) = next(iter(self._done.items()))
+            if now - t <= ttl and len(self._done) <= self._done_max:
+                break
+            self._done.popitem(last=False)
+
+    def _retain(self, key: str, result: Any) -> None:
+        """Record a leader's completed reply for the late-hit window
+        (no-op when retention is disabled)."""
+        if self._done_ttl_s <= 0:
+            return
+        now = time.monotonic()
+        with self._mu:
+            self._done[key] = (result, now)
+            self._done.move_to_end(key)
+            self._prune_done(now)
+
+    def done_entries(self) -> int:
+        """Live completed-fingerprint entries (observability probe)."""
+        with self._mu:
+            self._prune_done(time.monotonic())
+            return len(self._done)
 
     def waiters(self, key: str) -> int:
         """How many requests are currently coalesced behind ``key``'s
@@ -85,7 +130,29 @@ class CoalesceTable:
         every waiter as the typed retryable :class:`CoalesceAborted`."""
         tr = obs.current_trace()
         with self._mu:
+            if self._done_ttl_s > 0:
+                # prune on EVERY run, not just retention touches: a
+                # retained large reply must not outlive its TTL by
+                # more than the daemon's idle gap between any two
+                # coalescable requests
+                self._prune_done(time.monotonic())
             fl = self._inflight.get(key)
+            if fl is None and self._done_ttl_s > 0:
+                # the near-miss window: an identical frame whose
+                # leader JUST finished replays the retained reply
+                # under this request's own qid/token
+                hit = self._done.get(key)
+                if hit is not None:
+                    result, t_done = hit
+                    if time.monotonic() - t_done <= self._done_ttl_s:
+                        self._done.move_to_end(key)
+                        obs.REGISTRY.counter(
+                            "sched.coalesce_late_hits").inc()
+                        if tr is not None:
+                            tr.annotate("sched.coalesce_late_hit", key[:16])
+                            tr.add("sched.coalesce_late_hits")
+                        return result
+                    self._done.pop(key, None)
             if fl is None:
                 fl = self._inflight[key] = _Flight(
                     tr.qid if tr is not None else None)
@@ -113,6 +180,7 @@ class CoalesceTable:
                 raise
             else:
                 fl.result = out
+                self._retain(key, out)
                 return out
             finally:
                 # the flight leaves the table BEFORE the event fires:

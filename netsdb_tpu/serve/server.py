@@ -698,6 +698,10 @@ class ServeController:
             # gets: EXECUTEs may legitimately run for minutes, but a
             # hung leader must never wedge waiter handler threads
             coalesce_wait_s=mirror_ack_timeout_s or 300.0,
+            coalesce_done_ttl_s=getattr(
+                config, "sched_coalesce_done_ttl_s", 0.0),
+            coalesce_done_max=getattr(
+                config, "sched_coalesce_done_max", 32),
             cache_probe=self._devcache_warm)
         self._job_seq = itertools.count(1)
         self._jobs: Dict[int, Dict[str, Any]] = {}

@@ -203,3 +203,197 @@ def test_docs_drift_detects_missing_row(tmp_path, monkeypatch):
     msgs = " ".join(d.message for d in diags)
     assert "ghost-rule" in msgs  # documented but unregistered
     assert "iter-close" in msgs  # registered but undocumented
+
+
+# ------------------------------------------------- lint --fix (ISSUE 11)
+def _copy_fixture(tmp_path, name="bad_unclosed.py"):
+    import shutil
+
+    dst = tmp_path / name
+    shutil.copy(os.path.join(FIXTURES, name), dst)
+    return str(dst)
+
+
+def test_fix_wraps_direct_for_in_closing(tmp_path):
+    from netsdb_tpu.analysis import fix as F
+    from netsdb_tpu.analysis.lint import run_lint
+
+    path = _copy_fixture(tmp_path)
+    res = F.run_fix(paths=[path])
+    assert res["fixed"] == 1 and res["files"]
+    src = open(path, encoding="utf-8").read()
+    assert "with contextlib.closing(pc.stream()) as _closing_stream:" \
+        in src
+    assert "import contextlib" in src
+    import py_compile
+
+    py_compile.compile(path, doraise=True)
+    # the direct-for finding is gone; the assignment findings (which
+    # need a human-chosen try/finally extent) remain reported
+    diags = run_lint(paths=[path], rules=["iter-close"],
+                     select_all=True)
+    assert all("iterating" not in d.message for d in diags)
+    assert len(diags) == 2
+
+
+def test_fix_is_idempotent(tmp_path):
+    from netsdb_tpu.analysis import fix as F
+
+    path = _copy_fixture(tmp_path)
+    first = F.run_fix(paths=[path])
+    assert first["fixed"] == 1
+    src1 = open(path, encoding="utf-8").read()
+    second = F.run_fix(paths=[path])
+    assert second["fixed"] == 0 and not second["files"]
+    assert open(path, encoding="utf-8").read() == src1
+
+
+def test_fix_dry_run_prints_diff_touches_nothing(tmp_path):
+    from netsdb_tpu.analysis import fix as F
+
+    path = _copy_fixture(tmp_path)
+    before = open(path, encoding="utf-8").read()
+    res = F.run_fix(paths=[path], dry_run=True)
+    assert res["fixed"] == 1
+    assert "+    with contextlib.closing(pc.stream())" in res["diff"]
+    assert "-    for chunk, valid, _start in pc.stream():" in res["diff"]
+    assert open(path, encoding="utf-8").read() == before
+
+
+def test_fix_skips_multiline_string_bodies(tmp_path):
+    from netsdb_tpu.analysis import fix as F
+
+    path = tmp_path / "ml.py"
+    path.write_text(
+        "def f(pc):\n"
+        "    for c in pc.stream():\n"
+        "        s = \"\"\"a\n"
+        "multi-line literal the rewriter must not re-indent\n"
+        "\"\"\"\n"
+        "        print(s, c)\n")
+    res = F.run_fix(paths=[str(path)])
+    assert res["fixed"] == 0 and res["skipped"] == 1
+
+
+def test_cli_lint_fix_dry_run(tmp_path, capsys):
+    from netsdb_tpu import cli
+
+    path = _copy_fixture(tmp_path)
+    rc = cli.main(["lint", "--fix", "--dry-run", path])
+    out_text = capsys.readouterr().out
+    assert "lint --fix --dry-run: 1 fix(es)" in out_text
+    assert "+    with contextlib.closing" in out_text
+    assert rc == 0
+
+
+def test_whole_tree_has_no_fixable_findings():
+    """The package tree itself must stay clean under the fixer — a
+    flagged direct-for would mean a regression the gate (and --fix)
+    would both catch."""
+    from netsdb_tpu.analysis import fix as F
+
+    res = F.run_fix(dry_run=True)
+    assert res["fixed"] == 0, res["files"]
+
+
+def test_fix_nested_flagged_loops_inside_out(tmp_path):
+    """Review regression: a flagged producer-for nested inside another
+    flagged producer-for fixes inside-out across passes — the outer
+    rewrite must never slice with stale line numbers."""
+    from netsdb_tpu.analysis import fix as F
+    from netsdb_tpu.analysis.lint import run_lint
+
+    path = tmp_path / "nested.py"
+    path.write_text(
+        "def f(pc, qc):\n"
+        "    total = 0\n"
+        "    for a in pc.stream_tables():\n"
+        "        for b in qc.stream_tables():\n"
+        "            total += 1\n"
+        "        total += 10\n"
+        "    return total\n")
+    res = F.run_fix(paths=[str(path)])
+    assert res["fixed"] == 2, res
+    import py_compile
+
+    py_compile.compile(str(path), doraise=True)
+    src = path.read_text()
+    # the outer body's trailing statement stayed inside the loop
+    assert src.count("with contextlib.closing(") == 2
+    diags = run_lint(paths=[str(path)], rules=["iter-close"],
+                     select_all=True)
+    assert diags == []
+    ns = {}
+    exec(compile(src, str(path), "exec"), ns)
+
+    class _It:
+        def __init__(self, n):
+            self._it = iter(range(n))
+
+        def __iter__(self):
+            return self._it
+
+        def close(self):
+            pass
+
+    class _S:
+        def __init__(self, n):
+            self._n = n
+
+        def stream_tables(self):
+            return _It(self._n)
+
+    # semantics preserved: 3 outer x (2 inner + 10)
+    assert ns["f"](_S(3), _S(2)) == 36
+
+
+def test_fix_skips_multiline_bytes_and_fstrings(tmp_path):
+    from netsdb_tpu.analysis import fix as F
+
+    path = tmp_path / "mlb.py"
+    path.write_text(
+        "def f(pc):\n"
+        "    for c in pc.stream():\n"
+        "        payload = b\"\"\"ab\n"
+        "cd\"\"\"\n"
+        "        print(payload, c)\n")
+    res = F.run_fix(paths=[str(path)])
+    assert res["fixed"] == 0 and res["skipped"] == 1
+
+
+def test_fix_import_check_is_module_scope(tmp_path):
+    """A function-local `import contextlib` (or docstring text) must
+    not satisfy the module-level import the rewrite references."""
+    from netsdb_tpu.analysis import fix as F
+
+    path = tmp_path / "localimp.py"
+    path.write_text(
+        '"""docstring mentioning import contextlib in prose."""\n'
+        "def g():\n"
+        "    import contextlib\n"
+        "    return contextlib\n"
+        "def f(pc):\n"
+        "    for c in pc.stream():\n"
+        "        print(c)\n")
+    res = F.run_fix(paths=[str(path)])
+    assert res["fixed"] == 1
+    src = path.read_text()
+    lines = src.splitlines()
+    # a top-level import was inserted (after the docstring)
+    assert "import contextlib" in [ln.strip() for ln in lines
+                                   if not ln.startswith((" ", "\t"))]
+    ns = {}
+    exec(compile(src, str(path), "exec"), ns)
+
+    class _It:
+        def __iter__(self):
+            return iter([1])
+
+        def close(self):
+            pass
+
+    class _S:
+        def stream(self):
+            return _It()
+
+    ns["f"](_S())  # no NameError

@@ -1,0 +1,417 @@
+"""Fusion-aware plan compilation (plan/fusion.py) — ISSUE 11.
+
+Pins the four acceptance properties:
+
+* the mapper forms spine regions over traceable resident subgraphs of
+  a MIXED paged/resident plan and the executor compiles each as ONE
+  program (N per-node jit entries → 1 region program);
+* ``compile_stats()`` — including the new per-region trace counters —
+  stays flat across ragged-tail re-executions and settles after one
+  bucket transition (the fused path inherits the bucket contract);
+* fused and unfused executions produce exactly equal results on mixed
+  plans, including the grace-hash join path (q03 over paged sets);
+* ``plan_fusion=off`` restores the per-node behavior (no regions, no
+  region traces, no region ids in the explain tree).
+"""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from netsdb_tpu import obs
+from netsdb_tpu.client import Client
+from netsdb_tpu.config import Configuration
+from netsdb_tpu.plan import executor, fusion
+from netsdb_tpu.plan.computations import Apply, Join, ScanSet, WriteSet
+from netsdb_tpu.plan.fold import single_pass
+from netsdb_tpu.plan.planner import plan_from_sinks
+from netsdb_tpu.relational import dag as rdag
+from netsdb_tpu.relational.table import ColumnTable
+
+
+@pytest.fixture()
+def fz_client(tmp_path):
+    cfg = Configuration(root_dir=str(tmp_path / "fz"),
+                        fusion_cost_source="static")
+    c = Client(cfg)
+    c.create_database("d")
+    return c
+
+
+def _ingest_lineitem(c, n, seed=2):
+    rng = np.random.default_rng(seed)
+    if c.set_exists("d", "lineitem"):
+        c.remove_set("d", "lineitem")
+    c.create_set("d", "lineitem", type_name="table", storage="paged")
+    c.send_table("d", "lineitem", ColumnTable({
+        "l_shipdate": rng.integers(19940101, 19950101, n,
+                                   dtype=np.int32),
+        "l_discount": np.full(n, 0.06, np.float32),
+        "l_quantity": np.full(n, 10.0, np.float32),
+        "l_extendedprice": rng.uniform(1000, 2000, n
+                                       ).astype(np.float32)}, {}))
+
+
+def _ingest_dim(c, m=512, seed=0):
+    rng = np.random.default_rng(seed)
+    if not c.set_exists("d", "dim"):
+        c.create_set("d", "dim", type_name="table")
+    c.send_table("d", "dim", ColumnTable(
+        {"x": rng.standard_normal(m).astype(np.float32)}, {}))
+
+
+def _mixed_sink(spine=4):
+    """q06 paged fold joined against a ``spine``-node resident Apply
+    chain — the canonical mixed paged/resident plan."""
+    node = ScanSet("d", "dim")
+    for i in range(spine):
+        node = Apply(node, lambda t, _i=i: ColumnTable(
+            {"x": t["x"] * (1.0 + 1e-6 * _i)}, t.dicts, t.valid),
+            label=f"sp{i}")
+    z = Apply(node, lambda t: jnp.sum(t["x"]) * 1e-9, label="zsum")
+    q06 = rdag.q06_sink("d")
+    j = Join(q06.inputs[0], z, fn=lambda rev, v: ColumnTable(
+        {"revenue": rev["revenue"] + v}, rev.dicts, rev.valid),
+        label="combine")
+    return WriteSet(j, "d", "out")
+
+
+def _run(c, sink, job="fztest"):
+    out = c.execute_computations(sink, job_name=job)
+    return np.asarray(next(iter(out.values()))["revenue"])
+
+
+# ------------------------------------------------------- mapper units
+def test_mapper_forms_spine_region_on_mixed_plan(fz_client):
+    _ingest_lineitem(fz_client, 900)
+    _ingest_dim(fz_client)
+    sink = _mixed_sink(spine=4)
+    plan = plan_from_sinks([sink])
+    from netsdb_tpu.storage.store import SetIdentifier
+
+    scan_values = {}
+    for n in plan.topo:
+        if isinstance(n, ScanSet):
+            items = fz_client.store.get_items(
+                SetIdentifier(n.db, n.set_name))
+            scan_values[n.node_id] = items[0]
+    rmap = fusion.map_regions(plan, scan_values, fz_client.store.config,
+                              "unit", traceable=executor._is_traceable)
+    spines = [r for r in rmap.regions if r.kind == "spine"]
+    assert len(spines) == 1
+    # sp0..sp3 + zsum + combine fuse into one region; the fold node
+    # and the scans stay out
+    assert len(spines[0].node_ids) == 6
+    labels = {getattr(n, "label", "") for n in plan.topo
+              if n.node_id in spines[0].node_ids}
+    assert labels == {"sp0", "sp1", "sp2", "sp3", "zsum", "combine"}
+
+
+def test_mapper_min_region_floor(fz_client):
+    _ingest_lineitem(fz_client, 900)
+    _ingest_dim(fz_client)
+    fz_client.store.config.fusion_min_region = 99
+    sink = _mixed_sink(spine=4)
+    plan = plan_from_sinks([sink])
+    from netsdb_tpu.storage.store import SetIdentifier
+
+    scan_values = {
+        n.node_id: fz_client.store.get_items(
+            SetIdentifier(n.db, n.set_name))[0]
+        for n in plan.topo if isinstance(n, ScanSet)}
+    rmap = fusion.map_regions(plan, scan_values, fz_client.store.config,
+                              "unit", traceable=executor._is_traceable)
+    assert [r for r in rmap.regions if r.kind == "spine"] == []
+
+
+# --------------------------------------- N programs -> 1 region program
+def test_spine_compiles_one_program_replacing_n(fz_client):
+    _ingest_lineitem(fz_client, 900)
+    _ingest_dim(fz_client)
+    t0 = executor.compile_stats()
+    v_on = _run(fz_client, _mixed_sink(spine=4), job="fz-n1")
+    t1 = executor.compile_stats()
+    fused_new = t1["misses"] - t0["misses"]
+    assert fused_new == 2  # ONE region program + the q06 fold step
+    assert len(t1["region_traces"]) - len(t0["region_traces"]) == 1
+
+    fz_client.store.config.plan_fusion = False
+    t2 = executor.compile_stats()
+    v_off = _run(fz_client, _mixed_sink(spine=4), job="fz-n1-off")
+    t3 = executor.compile_stats()
+    # per-node: sp0..sp3 + zsum + combine eager entries + fold step
+    assert t3["misses"] - t2["misses"] == 7
+    assert t3["region_traces"] == t2["region_traces"]
+    np.testing.assert_array_equal(v_on, v_off)
+
+
+# ------------------------------------------------- recompile stability
+def test_fused_traces_flat_across_ragged_tails(fz_client):
+    _ingest_dim(fz_client)
+
+    def run(n):
+        _ingest_lineitem(fz_client, n)
+        return _run(fz_client, _mixed_sink(spine=4), job="fz-ragged")
+
+    run(1100)  # all three sizes share one bucket (1536)
+    t1 = executor.compile_stats()
+    run(1300)
+    run(1233)
+    t3 = executor.compile_stats()
+    assert t3["traces"] == t1["traces"], (t1, t3)
+    assert t3["region_traces"] == t1["region_traces"]
+
+
+def test_fused_traces_settle_across_bucket_transitions(fz_client):
+    _ingest_dim(fz_client)
+
+    def run(n):
+        _ingest_lineitem(fz_client, n)
+        return _run(fz_client, _mixed_sink(spine=4), job="fz-bucket")
+
+    run(1100)   # bucket 1536
+    run(3000)   # bucket 3072: the fold step retraces ONCE
+    t1 = executor.compile_stats()
+    run(2900)   # same bucket as 3000
+    run(1200)   # back to 1536 — both shapes already traced
+    t2 = executor.compile_stats()
+    assert t2["traces"] == t1["traces"], (t1, t2)
+    # the region program never depends on the streamed side's bucket
+    assert t2["region_traces"] == t1["region_traces"]
+
+
+# -------------------------------------------------- graft pre + post
+def test_graft_streams_rowwise_chain_and_epilogue(fz_client):
+    rng = np.random.default_rng(0)
+    n, nk = 5000, 64
+    fz_client.create_set("d", "fact", type_name="table",
+                         storage="paged")
+    cols = {"k": rng.integers(0, nk, n, dtype=np.int32),
+            "v": rng.uniform(0.0, 10.0, n).astype(np.float32)}
+    fz_client.send_table("d", "fact", ColumnTable(cols, {}))
+
+    def build():
+        s = ScanSet("d", "fact")
+        pre = Apply(s, lambda t: ColumnTable(
+            {"k": t["k"], "v": t["v"] * 1.5}, t.dicts, t.valid),
+            label="pre", rowwise=True)
+
+        def step(state, chunk):
+            seg = jnp.where(chunk.mask(), chunk["k"], 0)
+            vals = jnp.where(chunk.mask(), chunk["v"], 0.0)
+            return state + jax.ops.segment_sum(vals, seg,
+                                               num_segments=nk)
+
+        agg = Apply(pre, fold=single_pass(
+            lambda prev, src: jnp.zeros((nk,), jnp.float32),
+            step, lambda st, src: st), label="seg")
+        e1 = Apply(agg, lambda v: v + 1.0, label="e1")
+        e2 = Apply(e1, lambda v: v * 0.5, label="e2")
+        return WriteSet(e2, "d", "graft_out")
+
+    t0 = executor.compile_stats()
+    out = fz_client.execute_computations(build(), job_name="fz-graft")
+    v_on = np.asarray(next(iter(out.values())))
+    t1 = executor.compile_stats()
+    # fused: wrapped fold step + ONE epilogue program
+    assert t1["misses"] - t0["misses"] == 2
+
+    fz_client.store.config.plan_fusion = False
+    out = fz_client.execute_computations(build(), job_name="fz-graft2")
+    v_off = np.asarray(next(iter(out.values())))
+    t2 = executor.compile_stats()
+    # per-node: pre eager jit + bare fold step + e1 + e2
+    assert t2["misses"] - t1["misses"] == 4
+    np.testing.assert_allclose(v_on, v_off, rtol=1e-6)
+
+    ref = np.zeros(nk, np.float32)
+    np.add.at(ref, cols["k"], cols["v"] * 1.5)
+    np.testing.assert_allclose(v_on, (ref + 1.0) * 0.5, rtol=1e-5)
+
+
+# ------------------------------- fused == unfused, grace-hash included
+def test_fused_equals_unfused_on_grace_hash_q03(tmp_path):
+    from netsdb_tpu.relational.queries import tables_from_rows
+    from netsdb_tpu.workloads import tpch
+
+    tables = tables_from_rows(tpch.generate(scale=6, seed=3))
+
+    def run(fused: bool):
+        cfg = Configuration(
+            root_dir=str(tmp_path / f"g{int(fused)}"),
+            page_size_bytes=4096, page_pool_bytes=16384,
+            fusion_cost_source="static")
+        cfg.plan_fusion = fused
+        c = Client(cfg)
+        c.create_database("d")
+        for name, t in tables.items():
+            paged = name in ("lineitem", "orders", "customer")
+            c.create_set("d", name, type_name="table",
+                         storage="paged" if paged else "memory")
+            c.send_table("d", name, t)
+        out = rdag.run_query(c, rdag.q03_sink_for(c, "d"))
+        return rdag.q03_rows(out)
+
+    rows_on = run(True)
+    rows_off = run(False)
+    assert [r["okey"] for r in rows_on] == [r["okey"] for r in rows_off]
+    assert [r["revenue"] for r in rows_on] == \
+        [r["revenue"] for r in rows_off]
+
+
+# -------------------------------------------------- EXPLAIN stability
+def test_explain_regions_cold_warm_shape_identical(fz_client):
+    _ingest_lineitem(fz_client, 900)
+    _ingest_dim(fz_client)
+
+    def tree_once():
+        with obs.operators.explain_capture() as holder:
+            _run(fz_client, _mixed_sink(spine=4), job="fz-explain")
+        return holder["operators"]
+
+    cold = tree_once()
+    warm = tree_once()
+    shape = lambda t: [(n["id"], n["kind"], n["label"], n["inputs"],
+                        n.get("region"), bool(n.get("fused")))
+                       for n in t["nodes"]]  # noqa: E731
+    assert shape(cold) == shape(warm)
+    regions = {n.get("region") for n in cold["nodes"]
+               if n.get("region") is not None}
+    assert len(regions) == 1  # the one spine region, rendered per node
+    rendered = obs.operators.render_tree(cold)
+    assert "region=r" in rendered
+
+
+def test_plan_fusion_off_explain_has_no_regions(fz_client):
+    _ingest_lineitem(fz_client, 900)
+    _ingest_dim(fz_client)
+    fz_client.store.config.plan_fusion = False
+    with obs.operators.explain_capture() as holder:
+        _run(fz_client, _mixed_sink(spine=4), job="fz-off")
+    assert all(n.get("region") is None
+               for n in holder["operators"]["nodes"])
+
+
+# ----------------------------------------------- counters + advisor arms
+def test_fusion_counters_on_scrape(fz_client):
+    _ingest_lineitem(fz_client, 900)
+    _ingest_dim(fz_client)
+    before = obs.REGISTRY.counter("fusion.regions_formed").value
+    _run(fz_client, _mixed_sink(spine=4), job="fz-counters")
+    assert obs.REGISTRY.counter("fusion.regions_formed").value > before
+    from netsdb_tpu.obs.export import parse_openmetrics, to_openmetrics
+
+    fams = parse_openmetrics(to_openmetrics(obs.REGISTRY.snapshot()))
+    assert "netsdb_fusion_regions_formed_total" in fams
+    assert "netsdb_fusion_nodes_fused_total" in fams
+
+
+def test_fusion_candidates_are_advisor_arms():
+    from netsdb_tpu.learning.advisor import (PlacementAdvisor,
+                                             fusion_candidates)
+    from netsdb_tpu.learning.history import HistoryDB
+
+    cands = list(fusion_candidates())
+    assert {c.specs["plan_fusion"] for c in cands} == {True, False}
+    adv = PlacementAdvisor(cands, HistoryDB(":memory:"))
+    # explore both arms, then exploit the measured winner
+    adv.record("fz-ab", cands[0], 0.5)
+    adv.record("fz-ab", cands[1], 0.1)
+    assert adv.choose("fz-ab").label == cands[1].label
+
+
+def test_cost_model_vetoes_chronic_retracers():
+    ledger = obs.operators.LEDGER
+    ledger.add("fz-cost", "Apply:hot", {
+        "wall_s": 1.0, "device_est_s": 0.2,
+        "counters": {"traces": 10.0}})
+    cm = fusion.CostModel("fz-cost", source="ledger")
+
+    class _N:
+        op_kind = "Apply"
+        label = "hot"
+
+    assert cm.retrace_rate(_N()) == 10.0
+    assert not cm.region_profitable([_N(), _N()])
+    # the measured wall-device gap feeds the dispatch estimate
+    assert cm.dispatch_overhead_s(_N()) >= fusion.STATIC_DISPATCH_S
+
+
+def test_fusion_ab_harness_live_loop():
+    """The fusion arms drive the LIVE A/B harness end to end: both
+    arms explored, measurements recorded, a winner chosen from the
+    measured means (the placement-advisor loop, reused verbatim for
+    the plan-compilation decision)."""
+    from netsdb_tpu.learning.ab_bench import bench_fusion_ab
+
+    out = bench_fusion_ab(rows=20_000, spine=3, rounds=2, reps=1)
+    assert {r[0] for r in out["rounds"]} <= {"fusion_on", "fusion_off"}
+    assert out["winner"] in ("fusion_on", "fusion_off")
+    measured = [v for v in out["mean_s"].values() if v is not None]
+    assert len(measured) == 2  # every arm has a recorded mean
+
+
+def test_graft_epilogue_applies_off_the_streaming_path(fz_client):
+    """Review regression: a post-only graft region whose anchor does
+    NOT take the fold streaming branch at runtime (its stream input
+    was demoted by an ungrafted rowwise chain — grace-capable fold
+    keys block the pre-graft) must still run its fused epilogue: the
+    skipped post-chain nodes' fns apply on every dispatch path."""
+    rng = np.random.default_rng(1)
+    n, nk = 3000, 32
+    fz_client.create_set("d", "gfact", type_name="table",
+                         storage="paged")
+    cols = {"k": rng.integers(0, nk, n, dtype=np.int32),
+            "v": rng.uniform(0.0, 10.0, n).astype(np.float32)}
+    fz_client.send_table("d", "gfact", ColumnTable(cols, {}))
+
+    def build():
+        from netsdb_tpu.plan.fold import FoldSpec
+
+        s = ScanSet("d", "gfact")
+        pre = Apply(s, lambda t: ColumnTable(
+            {"k": t["k"], "v": t["v"] * 2.0}, t.dicts, t.valid),
+            label="gpre", rowwise=True)
+
+        def step(state, chunk):
+            seg = jnp.where(chunk.mask(), chunk["k"], 0)
+            vals = jnp.where(chunk.mask(), chunk["v"], 0.0)
+            return state + jax.ops.segment_sum(vals, seg,
+                                               num_segments=nk)
+
+        # probe/build keys make the fold grace-CAPABLE: the mapper
+        # must not pre-graft the rowwise chain, so at runtime the
+        # chain demotes and the anchor dispatches OFF the fold branch
+        fold = FoldSpec(
+            ((lambda prev, src: jnp.zeros((nk,), jnp.float32),
+              step),),
+            lambda st, src: st,
+            merge=lambda a, b: a + b, probe_key="k", build_key="k")
+        agg = Apply(pre, fold=fold, label="gseg")
+        epi = Apply(agg, lambda v: v * 10.0, label="gepi")
+        return WriteSet(epi, "d", "g_out")
+
+    out = fz_client.execute_computations(build(), job_name="fz-gpath")
+    got = np.asarray(next(iter(out.values())))
+    ref = np.zeros(nk, np.float32)
+    np.add.at(ref, cols["k"], cols["v"] * 2.0)
+    np.testing.assert_allclose(got, ref * 10.0, rtol=1e-5)
+
+
+def test_region_trace_map_bounded_and_cleared():
+    from netsdb_tpu.plan.executor import (_REGION_TRACES_CAP,
+                                          _cache_lock, _region_traces,
+                                          clear_compiled_cache)
+
+    with _cache_lock:
+        for i in range(_REGION_TRACES_CAP + 50):
+            _region_traces[f"synthetic:{i}"] = 1
+            while len(_region_traces) > _REGION_TRACES_CAP:
+                _region_traces.pop(next(iter(_region_traces)))
+        assert len(_region_traces) <= _REGION_TRACES_CAP
+    clear_compiled_cache()
+    from netsdb_tpu.plan.executor import compile_stats
+
+    assert compile_stats()["region_traces"] == {}

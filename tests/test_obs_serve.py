@@ -135,7 +135,8 @@ def test_collect_stats_metrics_section_and_stable_shapes(daemon):
             "budget_bytes"} <= set(st["device_cache"])
     from netsdb_tpu.plan.executor import compile_stats
 
-    assert set(compile_stats()) == {"hits", "misses", "traces"}
+    assert set(compile_stats()) == {"hits", "misses", "traces",
+                                    "region_traces"}
     # the new metrics section: registry + absorbed collectors
     m = st["metrics"]
     assert {"counters", "gauges", "histograms", "compile", "staging",

@@ -818,3 +818,66 @@ def test_lane_hint_and_client_identity_key_lanes(tmp_path):
         assert "gold" in snap and "tenant-b" in snap
     finally:
         ctl.shutdown()
+
+
+# ------------------------------------- completed-fingerprint late hits
+def test_coalesce_late_hit_serves_retained_reply():
+    """A byte-identical frame arriving just AFTER its leader finished
+    hits the completed-fingerprint cache: the retained reply returns
+    without executing, counted as sched.coalesce_late_hits."""
+    ct = CoalesceTable(done_ttl_s=5.0, done_max=8)
+    calls = []
+
+    def fn():
+        calls.append(1)
+        return {"answer": 41}
+
+    late0 = _counter("sched.coalesce_late_hits")
+    assert ct.run("k", fn, 10.0) == {"answer": 41}
+    assert ct.done_entries() == 1
+    # the near-miss: same fingerprint, leader already gone from the
+    # in-flight table — served from retention, fn never runs again
+    assert ct.run("k", fn, 10.0) == {"answer": 41}
+    assert calls == [1]
+    assert _counter("sched.coalesce_late_hits") == late0 + 1
+
+
+def test_coalesce_late_hit_expires_with_ttl():
+    ct = CoalesceTable(done_ttl_s=0.05, done_max=8)
+    calls = []
+
+    def fn():
+        calls.append(1)
+        return {"n": len(calls)}
+
+    assert ct.run("k", fn, 10.0) == {"n": 1}
+    time.sleep(0.08)
+    # past the TTL: the retained reply is stale by contract — the
+    # frame re-executes (and re-arms the window)
+    assert ct.run("k", fn, 10.0) == {"n": 2}
+    assert calls == [1, 1]
+
+
+def test_coalesce_done_cache_is_size_bounded():
+    ct = CoalesceTable(done_ttl_s=30.0, done_max=3)
+    for i in range(6):
+        ct.run(f"k{i}", lambda i=i: i, 10.0)
+    assert ct.done_entries() <= 3
+    # the OLDEST fingerprints were evicted; the newest still hit
+    calls = []
+    assert ct.run("k5", lambda: calls.append(1) or -1, 10.0) == 5
+    assert calls == []
+
+
+def test_coalesce_done_ttl_zero_disables_retention():
+    ct = CoalesceTable()  # PR 9 behavior: no retention
+    calls = []
+
+    def fn():
+        calls.append(1)
+        return len(calls)
+
+    assert ct.run("k", fn, 10.0) == 1
+    assert ct.done_entries() == 0
+    assert ct.run("k", fn, 10.0) == 2
+    assert calls == [1, 1]
