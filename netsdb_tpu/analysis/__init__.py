@@ -2,12 +2,19 @@
 
 Static half: :func:`run_lint` runs typed, pluggable AST rules
 (``analysis/rules/``) over the package tree — lock-ordering cycles,
-holds-across-blocking-calls, resource discipline, and every ported
-pre-framework check — surfaced through ``python -m netsdb_tpu.cli
-lint``.  Dynamic half: ``utils/locks.LockWitness`` (lockdep-style)
-records the cross-thread acquisition-order graph at runtime and flags
-cycles that never fired.  ``docs/ANALYSIS.md`` is the human catalog;
-the ``analysis-docs-drift`` rule keeps it honest.
+holds-across-blocking-calls, shared-state races, resource discipline,
+and every ported pre-framework check — surfaced through ``python -m
+netsdb_tpu.cli lint``.  The concurrency rules are INTERPROCEDURAL:
+``analysis/callgraph.py`` resolves a project-wide call graph (module
+imports, methods, attribute types, aliases, thread roots) and
+``analysis/summaries.py`` folds it into transitive per-function lock
+and blocking summaries.  Dynamic half: ``utils/locks.LockWitness``
+(lockdep-style) records the cross-thread acquisition-order graph at
+runtime and flags cycles that never fired; ``analysis/witnesscov.py``
+reconciles the two graphs (``cli lint --witness-coverage``).
+``analysis/baseline.py`` is the shrink-only findings ratchet.
+``docs/ANALYSIS.md`` is the human catalog; the
+``analysis-docs-drift`` rule keeps it honest.
 """
 
 from netsdb_tpu.analysis.lint import (  # noqa: F401

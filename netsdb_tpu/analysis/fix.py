@@ -204,6 +204,139 @@ def fix_module(mod: Module, repo: str = REPO
     return cur.source, total_fixed, skipped
 
 
+#: builtins that EAGERLY drain whatever iterator chain they are
+#: handed — a value they produce cannot keep the handle alive
+_EAGER = {"next", "list", "tuple", "set", "dict", "sorted", "sum",
+          "min", "max", "any", "all", "len"}
+#: builtins that LAZILY rewrap an iterator — the wrapper holds the
+#: live handle, so returning/storing one IS an escape of the handle
+_LAZY = {"iter", "enumerate", "zip", "map", "filter", "reversed"}
+
+
+def _escapes(stmt: ast.AST, var: str) -> bool:
+    """Whether the live handle ``var`` escapes through ``stmt``:
+    returned/yielded/stored directly, inside a container literal,
+    inside a LAZY rewrapper (``return enumerate(var)``, a generator
+    expression over it), or passed bare to a non-builtin call
+    (``register(var)``, ``self.cache.append(var)``).  Values whose
+    outermost operation eagerly drains the chain (``return
+    next(iter(var))``, ``rows = list(var)``) — or method calls on the
+    handle itself — are consumption, not escape."""
+    from netsdb_tpu.analysis.lint import terminal_name
+
+    def contains_var(node: ast.AST) -> bool:
+        return any(isinstance(n, ast.Name) and n.id == var
+                   for n in ast.walk(node))
+
+    def derives_safely(value: ast.AST) -> bool:
+        # list/set/dict comprehensions drain eagerly (a GENERATOR
+        # expression stays lazy and falls through to escape)
+        if isinstance(value, (ast.ListComp, ast.SetComp,
+                              ast.DictComp)):
+            return True
+        if not isinstance(value, ast.Call):
+            return False
+        if terminal_name(value.func) in _EAGER:
+            return True
+        # a method call ON the handle (var.read(), var.close())
+        # returns derived data, not the handle
+        f = value.func
+        return isinstance(f, ast.Attribute) \
+            and isinstance(f.value, ast.Name) and f.value.id == var
+
+    def is_bare_var(node: ast.AST) -> bool:
+        if isinstance(node, ast.Name) and node.id == var:
+            return True
+        if isinstance(node, ast.Starred):
+            return is_bare_var(node.value)
+        return False
+
+    for node in ast.walk(stmt):
+        value = None
+        if isinstance(node, (ast.Return, ast.Yield, ast.YieldFrom)):
+            value = node.value
+        elif isinstance(node, (ast.Assign, ast.AnnAssign,
+                               ast.AugAssign)):
+            value = node.value
+        if value is not None and contains_var(value) \
+                and not derives_safely(value):
+            return True
+        if isinstance(node, ast.Call):
+            fname = terminal_name(node.func)
+            f = node.func
+            on_var = isinstance(f, ast.Attribute) \
+                and isinstance(f.value, ast.Name) and f.value.id == var
+            if fname not in _EAGER and fname not in _LAZY \
+                    and not on_var:
+                args = list(node.args) + [kw.value
+                                          for kw in node.keywords]
+                if any(is_bare_var(a) for a in args):
+                    return True
+    return False
+
+
+def suggest_close(mod: Module, var: str,
+                  call: ast.AST) -> Optional[str]:
+    """Render a SUGGESTED ``try/finally`` diff for the iter-close
+    rule's assigned-never-closed shape::
+
+        it = pc.stream()          →    it = pc.stream()
+        <rest of block>                try:
+                                           <rest of block>
+                                       finally:
+                                           it.close()
+
+    The extent (rest of the enclosing block) is a best-effort default
+    a human still reviews — which is exactly why this renders a diff
+    in the report instead of rewriting the file (the ``--fix`` safety
+    gate).  Returns a unified diff, or None when a safety gate
+    (multi-line statements, nothing after the assignment) says a
+    mechanical suggestion would be wrong."""
+    if mod.tree is None:
+        return None
+    assign = None
+    body: Optional[List[ast.stmt]] = None
+    for node in ast.walk(mod.tree):
+        for field in ("body", "orelse", "finalbody"):
+            stmts = getattr(node, field, None)
+            if not isinstance(stmts, list):
+                continue
+            for i, stmt in enumerate(stmts):
+                if isinstance(stmt, ast.Assign) \
+                        and stmt.value is call:
+                    assign, body, idx = stmt, stmts, i
+    if assign is None or body is None:
+        return None
+    rest = body[idx + 1:]
+    if not rest:
+        return None  # created and never used: closing extent unclear
+    if assign.end_lineno != assign.lineno:
+        return None
+    if any(_has_multiline_string(stmt) for stmt in rest):
+        return None
+    if any(_escapes(stmt, var) for stmt in rest):
+        # the handle itself leaves the function (returned, yielded,
+        # aliased, stored) — a finally: close() here would hand the
+        # caller a closed iterator; no mechanical suggestion is right
+        return None
+    indent = " " * assign.col_offset
+    lines = list(mod.lines)
+    start = rest[0].lineno - 1
+    end = rest[-1].end_lineno  # exclusive slice bound
+    block = [indent + "try:"]
+    for bl in lines[start:end]:
+        block.append("    " + bl if bl.strip() else bl)
+    block += [indent + "finally:", indent + f"    {var}.close()"]
+    new_lines = lines[:start] + block + lines[end:]
+    new_source = "\n".join(new_lines)
+    if mod.source.endswith("\n"):
+        new_source += "\n"
+    return "".join(difflib.unified_diff(
+        mod.source.splitlines(keepends=True),
+        new_source.splitlines(keepends=True),
+        fromfile=f"a/{mod.rel}", tofile=f"b/{mod.rel}"))
+
+
 def run_fix(paths: Optional[List[str]] = None, repo: str = REPO,
             dry_run: bool = False) -> Dict[str, object]:
     """Apply (or preview) the iter-close fixes over ``paths`` (default:

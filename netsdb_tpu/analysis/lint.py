@@ -58,24 +58,33 @@ _SUPPRESS_RE = re.compile(
 BAD_SUPPRESSION = "bad-suppression"
 UNUSED_SUPPRESSION = "unused-suppression"
 PARSE_ERROR = "parse-error"
+STALE_BASELINE = "stale-baseline"
 
 
 @dataclasses.dataclass(frozen=True)
 class Diagnostic:
-    """One finding: where, which rule, what."""
+    """One finding: where, which rule, what — plus, for findings with
+    a mechanical-but-human-applied remedy, a rendered ``suggestion``
+    diff (the iter-close assigned-never-closed shape)."""
 
     rule: str
     path: str  # repo-relative
     line: int
     col: int
     message: str
+    suggestion: Optional[str] = dataclasses.field(
+        default=None, compare=False)
 
     def __str__(self) -> str:
         return f"{self.path}:{self.line}:{self.col}: " \
                f"[{self.rule}] {self.message}"
 
     def to_dict(self) -> Dict[str, Any]:
-        return dataclasses.asdict(self)
+        out = {"rule": self.rule, "path": self.path, "line": self.line,
+               "col": self.col, "message": self.message}
+        if self.suggestion is not None:
+            out["suggestion"] = self.suggestion
+        return out
 
 
 @dataclasses.dataclass
@@ -138,6 +147,13 @@ class Module:
         except tokenize.TokenError:
             pass  # the parse-error diagnostic already covers this file
         return out
+
+    def reset_run_state(self) -> None:
+        """Clear per-run mutable state (suppression hit flags) so a
+        cached Module can be reused by the next ``run_lint`` without
+        carrying the previous run's usage accounting."""
+        for sup in self.suppressions:
+            sup.used = False
 
     def suppressed(self, rule: str, line: int) -> bool:
         """True (and mark used) when a VALID suppression for ``rule``
@@ -256,29 +272,67 @@ def _default_files() -> List[str]:
     return out
 
 
+#: parse-once cache: abs path → ((repo, mtime_ns, size), Module).
+#: Parsing + the cached AST walks dominate a lint run; the tier-1
+#: gate, the conftest sessionfinish re-run and every fixture test in
+#: one process share parses as long as the file on disk is unchanged.
+_MODULE_CACHE: Dict[str, Tuple[Tuple[str, int, int], Module]] = {}
+
+
+def _load_module(path: str, repo: str) -> Module:
+    abspath = os.path.abspath(path)
+    try:
+        st = os.stat(abspath)
+        key = (repo, st.st_mtime_ns, st.st_size)
+    except OSError:
+        return Module(abspath, repo)  # unreadable: let open() report
+    cached = _MODULE_CACHE.get(abspath)
+    if cached is not None and cached[0] == key:
+        # the stat key has a granularity hole: a same-size rewrite
+        # within the filesystem timestamp resolution keeps the key.
+        # Re-reading the source closes it — a read is ~free next to
+        # the parse + AST walks the cache exists to skip
+        try:
+            with open(abspath, encoding="utf-8") as f:
+                if f.read() == cached[1].source:
+                    cached[1].reset_run_state()
+                    return cached[1]
+        except OSError:
+            pass
+    mod = Module(abspath, repo)
+    _MODULE_CACHE[abspath] = (key, mod)
+    return mod
+
+
 def load_project(paths: Optional[Iterable[str]] = None,
                  repo: str = REPO) -> Project:
     files = list(paths) if paths is not None else _default_files()
-    return Project([Module(p, repo) for p in files], repo)
+    return Project([_load_module(p, repo) for p in files], repo)
 
 
 def run_lint(paths: Optional[Iterable[str]] = None,
              rules: Optional[Iterable[str]] = None,
              repo: str = REPO,
-             select_all: bool = False) -> List[Diagnostic]:
+             select_all: bool = False,
+             project: Optional[Project] = None) -> List[Diagnostic]:
     """Run lint rules and return the surviving diagnostics, sorted.
 
     ``paths`` — explicit files (default: the whole ``netsdb_tpu/``
     package).  ``rules`` — rule ids to run (default: all).
     ``select_all`` — bypass every rule's scope filter (fixture tests run
-    serve-scoped rules over files outside ``serve/``).
+    serve-scoped rules over files outside ``serve/``).  ``project`` —
+    reuse an already-loaded :class:`Project` (and everything cached on
+    it: call graph, summaries, static lock edges) instead of loading
+    one; the conftest sessionfinish shares one project between the
+    witness-coverage report and the lint re-run.
 
     Suppression accounting: ``bad-suppression`` fires on any
     suppression comment without a reason; ``unused-suppression`` fires
     only on FULL-rule-set runs (running one rule must not flag another
     rule's suppressions as stale).
     """
-    project = load_project(paths, repo)
+    if project is None:
+        project = load_project(paths, repo)
     available = {r.id: r for r in all_rules()}
     if rules is None:
         chosen = list(available.values())
@@ -342,6 +396,19 @@ def run_lint(paths: Optional[Iterable[str]] = None,
                                 f"a diagnostic — stale; remove it"))
     diags.sort(key=lambda d: (d.path, d.line, d.col, d.rule))
     return diags
+
+
+def set_gauge(name: str, value: float) -> None:
+    """Export one analysis gauge through the central obs registry —
+    never fatal (lint must work in environments where obs can't
+    import), and in ONE place so every analysis.* gauge shares the
+    same policy."""
+    try:
+        from netsdb_tpu.obs.metrics import registry
+
+        registry().gauge(name).set(value)
+    except Exception:  # noqa: BLE001 — obs must never break lint
+        pass
 
 
 def render(diags: List[Diagnostic]) -> str:

@@ -7,4 +7,5 @@ lazily so the framework core stays import-cheap.
 from netsdb_tpu.analysis.rules import discipline  # noqa: F401
 from netsdb_tpu.analysis.rules import drift  # noqa: F401
 from netsdb_tpu.analysis.rules import locking  # noqa: F401
+from netsdb_tpu.analysis.rules import races  # noqa: F401
 from netsdb_tpu.analysis.rules import resources  # noqa: F401

@@ -111,13 +111,15 @@ class AnalysisDocsRule(Rule):
     def check_project(self, project: Project) -> Iterable[Diagnostic]:
         from netsdb_tpu.analysis.lint import (BAD_SUPPRESSION,
                                               PARSE_ERROR,
+                                              STALE_BASELINE,
                                               UNUSED_SUPPRESSION,
                                               rule_ids)
 
         doc_path = os.path.join(project.repo, "docs", "ANALYSIS.md")
         documented = _doc_table_names(doc_path)
         registered = set(rule_ids()) | {BAD_SUPPRESSION,
-                                        UNUSED_SUPPRESSION, PARSE_ERROR}
+                                        UNUSED_SUPPRESSION, PARSE_ERROR,
+                                        STALE_BASELINE}
         anchor = "netsdb_tpu/analysis/lint.py"
 
         def d(message: str) -> Diagnostic:

@@ -1,61 +1,37 @@
-"""Lock-ordering and holds-across-blocking-call rules.
+"""Lock-ordering and holds-across-blocking-call rules — now
+INTERPROCEDURAL over the project call graph.
 
-The pass the regex scanners could never do: extract every ``with
-<lock>:`` statement, normalize the lock expression to a *rank token*
-(which class/module owns it), build the nesting graph — lexical
-nesting plus same-module call-through — union it with the seeded
-known hierarchy, and fail on any cycle.  A cycle in this graph is a
-potential AB/BA deadlock that may never have fired in a test; the
-runtime twin (``utils/locks.LockWitness``) catches the orders that
-only materialize dynamically.
+The PR 8 versions of these rules saw lexical nesting plus same-module
+call-through.  This rewrite stands them on
+``analysis/callgraph.py`` + ``analysis/summaries.py``: a ``with`` in
+``serve/server.py`` that calls into ``storage/devcache.py`` which
+takes another tracked lock now contributes a lock-order edge naming
+BOTH sites (the holding call site and the callee's acquisition line),
+and a call chain that reaches ``recv``/``queue.get()``/``device_put``
+while any caller up-stack holds a lock is flagged at the holding call
+site — not just when the blocking call is lexically visible under the
+``with``.
 
 Rank tokens, not instances: every per-set serve lock is one rank
 (``ServeController._set_locks[]``), every relation ``RWLock`` is one
 rank PER OWNER CLASS (``PagedObjects.rw``, ``PagedColumns.rw``,
-``_PagedMatrix.rw``) — lock *levels* order, instances don't, and
-collapsing distinct rw families would mix their usage modes.
-
-Token normalization:
-
-* ``self.X`` inside class ``C`` → ``C.X``;
-* module-level ``X`` in module ``m.py`` → ``m.py:X``;
-* ``other.X`` (attribute on a non-self base) → resolved through the
-  project-wide *lock attribute index* (which classes assign a lock to
-  ``self.X``): a unique owner gives ``C.X``; an ambiguous name stays
-  the wildcard ``*.X`` and contributes NO cross-class edges (no false
-  cycles from coincidental attribute names);
-* ``base.rw.read()`` / ``.write()`` → the shared ``RWLock`` rank (the
-  storage layer's leaf — many relations, one level);
-* a local alias (``lk = self._set_lock(db, s)``; ``with lk:``)
-  resolves to the aliased expression's token.
-
-The blocking rule flags calls that can wait on another thread or on
-I/O made while a lock is lexically held: socket ``recv``/``accept``,
-``device_put`` (a host→device copy on the consumer's critical path),
-``queue.get()`` without a timeout, and the seeded site-specific
-patterns (``po.append`` — a ``PagedObjects`` append waits on the
-relation's stream locks).
+``_PagedMatrix.rw``) — lock *levels* order, instances don't.  Token
+normalization lives in ``analysis/summaries.py`` and deliberately
+matches the runtime witness rank strings, so the static graph and the
+witness's dynamic graph diff cleanly (``cli lint
+--witness-coverage``).
 """
 
 from __future__ import annotations
 
-import ast
-import re
 from typing import Dict, Iterable, List, Optional, Set, Tuple
 
-from netsdb_tpu.analysis.lint import (Diagnostic, Module, Project, Rule,
-                                      enclosing_functions, register,
-                                      terminal_name)
+from netsdb_tpu.analysis.callgraph import fmt_key
+from netsdb_tpu.analysis.lint import (Diagnostic, Project, Rule,
+                                      register)
+from netsdb_tpu.analysis.summaries import summaries
 
-#: terminal names that denote a lock when used as ``with <expr>:``
-_LOCK_NAME_RE = re.compile(
-    r"(^|_)(lock|lk|mu|mutex)$|_mu$|_lock$|^mu$|^lock$")
-
-#: constructor call names whose assignment marks ``self.X`` as a lock
-_LOCK_CTORS = {"Lock", "RLock", "RWLock", "TrackedLock", "TrackedRLock",
-               "witness_lock"}
-
-#: the seeded known hierarchy (audited this PR — note the direction:
+#: the seeded known hierarchy (audited in PR 8 — note the direction:
 #: ``append_table`` nests append_mu -> store lock, and the ingest /
 #: replace paths nest store lock -> relation RWLock; the PRE-PR-6
 #: order (store lock held across PagedObjects.append) is exactly the
@@ -82,239 +58,78 @@ SEED_EDGES: Tuple[Tuple[str, str], ...] = (
     ("ServeController._set_locks[]", "ServeController._mirror_lock"),
 )
 
-#: method names that block on I/O or another thread
-_BLOCKING_METHODS = {"recv", "recv_into", "recvmsg", "accept",
-                     "device_put"}
-#: seeded site-specific blocking patterns: (receiver terminal, method)
-_BLOCKING_SEEDED = {("po", "append")}
-#: receiver terminal names treated as queues for the .get() check
-_QUEUE_RECV_RE = re.compile(r"(^|_)q(ueue)?s?$|queue")
-
 #: modules that IMPLEMENT the primitives (their internals necessarily
 #: wait under their own locks)
-_BLOCKING_EXEMPT = ("netsdb_tpu/utils/locks.py",)
+BLOCKING_EXEMPT = ("netsdb_tpu/utils/locks.py",)
 
 
-def _is_lock_name(name: Optional[str]) -> bool:
-    return bool(name) and bool(_LOCK_NAME_RE.search(name))
+class EdgeSite:
+    """Where one lock-order edge was sighted in code."""
+
+    __slots__ = ("rel", "line", "inner_rel", "inner_line", "via")
+
+    def __init__(self, rel: str, line: int,
+                 inner_rel: Optional[str] = None,
+                 inner_line: Optional[int] = None,
+                 via: Optional[str] = None):
+        self.rel = rel
+        self.line = line
+        # for call-through edges: the callee acquisition site
+        self.inner_rel = inner_rel
+        self.inner_line = inner_line
+        self.via = via  # callee key string, for the report
+
+    def describe(self) -> str:
+        s = f"{self.rel}:{self.line}"
+        if self.inner_rel is not None:
+            s += f" (acquired in {self.via} at " \
+                 f"{self.inner_rel}:{self.inner_line})"
+        return s
 
 
-def _lock_attr_index(project: Project) -> Dict[str, Set[str]]:
-    """attr name → set of class names assigning a lock to ``self.X``
-    (constructor calls and ``dataclasses.field(default_factory=
-    threading.Lock)`` defaults)."""
-    def build() -> Dict[str, Set[str]]:
-        idx: Dict[str, Set[str]] = {}
-        for mod in project.modules:
-            if mod.tree is None:
-                continue
-            for cls_name, fn in mod.functions():
-                if cls_name is None:
+def static_lock_edges(project: Project
+                      ) -> Dict[Tuple[str, str], Optional[EdgeSite]]:
+    """The full static lock-order edge set: seeds (site None until a
+    code sighting upgrades them), lexical nesting, and cross-module
+    call-through edges derived from the transitive lock summaries.
+    Shared by the lock-order rule and the witness-coverage report."""
+    def build() -> Dict[Tuple[str, str], Optional[EdgeSite]]:
+        S = summaries(project)
+        edges: Dict[Tuple[str, str], Optional[EdgeSite]] = {
+            e: None for e in SEED_EDGES}
+
+        def note(key: Tuple[str, str], site: EdgeSite) -> None:
+            # first CODE sighting wins; it also upgrades a seed's
+            # None site so cycle reports name real file:line anchors
+            if edges.get(key) is None:
+                edges[key] = site
+
+        for key, facts in S.facts.items():
+            for outer, inner, line in facts.lex_edges:
+                note((outer, inner), EdgeSite(key[0], line))
+            for site in facts.calls:
+                if not site.held:
                     continue
-                for node in ast.walk(fn):
-                    if not isinstance(node, ast.Assign):
+                callee_locks = S.trans_locks.get(site.callee, {})
+                for inner, (irel, iline) in callee_locks.items():
+                    if inner.startswith("*."):
                         continue
-                    if not _assigns_lock(node.value):
-                        continue
-                    for t in node.targets:
-                        if isinstance(t, ast.Attribute) \
-                                and isinstance(t.value, ast.Name) \
-                                and t.value.id == "self":
-                            idx.setdefault(t.attr, set()).add(cls_name)
-            # dataclass fields: append_mu: Any = field(
-            #     default_factory=threading.Lock)
-            for node in ast.walk(mod.tree):
-                if not isinstance(node, ast.ClassDef):
-                    continue
-                for stmt in node.body:
-                    if isinstance(stmt, ast.AnnAssign) \
-                            and stmt.value is not None \
-                            and isinstance(stmt.target, ast.Name) \
-                            and _field_factory_is_lock(stmt.value):
-                        idx.setdefault(stmt.target.id,
-                                       set()).add(node.name)
-        return idx
+                    for outer in site.held:
+                        if inner != outer:
+                            note((outer, inner),
+                                 EdgeSite(key[0], site.line,
+                                          inner_rel=irel,
+                                          inner_line=iline,
+                                          via=fmt_key(site.callee)))
+        return edges
 
-    return project.cached("lock_attr_index", build)
-
-
-def _assigns_lock(value: ast.AST) -> bool:
-    if isinstance(value, ast.Call):
-        t = terminal_name(value.func)
-        if t in _LOCK_CTORS:
-            return True
-        return _field_factory_is_lock(value)
-    return False
-
-
-def _field_factory_is_lock(value: ast.AST) -> bool:
-    if not (isinstance(value, ast.Call)
-            and terminal_name(value.func) == "field"):
-        return False
-    for kw in value.keywords:
-        if kw.arg != "default_factory":
-            continue
-        target = kw.value
-        # field(default_factory=lambda: TrackedLock("rank"))
-        if isinstance(target, ast.Lambda) \
-                and isinstance(target.body, ast.Call):
-            target = target.body.func
-        if terminal_name(target) in _LOCK_CTORS:
-            return True
-    return False
-
-
-class _FnLocks:
-    """Per-function lock facts: tokens acquired lexically, plus the
-    ``with``-nesting edges found inside."""
-
-    def __init__(self):
-        self.acquired: Set[str] = set()
-        # (outer, inner, line) lexical nesting edges
-        self.edges: List[Tuple[str, str, int]] = []
-        # (held_token, callee_key, line) same-module call-through;
-        # callee_key = (class_or_None, name) so same-named methods on
-        # DIFFERENT classes cannot collide
-        self.calls_under: List[Tuple[str, Tuple[Optional[str], str],
-                                     int]] = []
-
-
-def _local_aliases(fn: ast.AST) -> Dict[str, ast.AST]:
-    """name → RHS for single-target simple assignments in ``fn`` —
-    the one-hop alias resolver (``lk = self._set_lock(...)``)."""
-    out: Dict[str, ast.AST] = {}
-    for node in ast.walk(fn):
-        if isinstance(node, ast.Assign) and len(node.targets) == 1 \
-                and isinstance(node.targets[0], ast.Name) \
-                and isinstance(node.value, (ast.Attribute, ast.Call)):
-            name = node.targets[0].id
-            # a name assigned twice is not a stable alias
-            out[name] = None if name in out else node.value
-    return {k: v for k, v in out.items() if v is not None}
-
-
-def _lock_token(expr: ast.AST, cls: Optional[str], mod: Module,
-                aliases: Dict[str, ast.AST],
-                attr_index: Dict[str, Set[str]],
-                _depth: int = 0) -> Optional[str]:
-    """Normalize a ``with`` context expression to a rank token, or
-    None when it doesn't look like a lock."""
-    if _depth > 3:
-        return None
-    # rw.read() / rw.write() → the owner class's rw rank (each
-    # relation class is its own lock level; collapsing them all into
-    # one "RWLock" rank mixes read-only and write-append usage of
-    # DIFFERENT lock families and manufactures cycles)
-    if isinstance(expr, ast.Call) and isinstance(expr.func, ast.Attribute):
-        if expr.func.attr in ("read", "write"):
-            base = expr.func.value
-            bt = terminal_name(base)
-            if not (bt == "rw" or (bt or "").endswith("rw")):
-                return None
-            if isinstance(base, ast.Attribute) \
-                    and isinstance(base.value, ast.Name) \
-                    and base.value.id == "self" and cls:
-                return f"{cls}.rw"
-            owners = attr_index.get("rw", set())
-            if len(owners) == 1:
-                return f"{next(iter(owners))}.rw"
-            return "*.rw"  # ambiguous owner: contributes no edges
-        # self._set_lock(db, s) style: a method returning a lock
-        if _is_lock_name(expr.func.attr) or expr.func.attr.endswith(
-                ("_lock", "_mu")):
-            owner = None
-            if isinstance(expr.func.value, ast.Name) \
-                    and expr.func.value.id == "self" and cls:
-                owner = cls
-            name = expr.func.attr
-            # the per-set-lock idiom: a getter named _set_lock maps to
-            # the instance-family rank C._set_locks[]
-            if name.startswith("_set_lock"):
-                return f"{owner or '*'}._set_locks[]"
-            return f"{owner or '*'}.{name}()"
-        return None
-    if isinstance(expr, ast.Call):  # Lock() inline — anonymous, skip
-        return None
-    if isinstance(expr, ast.Attribute):
-        name = expr.attr
-        if not _is_lock_name(name):
-            return None
-        base = expr.value
-        if isinstance(base, ast.Name) and base.id == "self" and cls:
-            return f"{cls}.{name}"
-        owners = attr_index.get(name, set())
-        if len(owners) == 1:
-            return f"{next(iter(owners))}.{name}"
-        return f"*.{name}"
-    if isinstance(expr, ast.Name):
-        if expr.id in aliases:
-            return _lock_token(aliases[expr.id], cls, mod, aliases,
-                               attr_index, _depth + 1)
-        if _is_lock_name(expr.id):
-            return f"{mod.rel}:{expr.id}"
-        return None
-    return None
-
-
-def _collect_fn_locks(mod: Module, cls: Optional[str], fn: ast.AST,
-                      attr_index: Dict[str, Set[str]]) -> _FnLocks:
-    facts = _FnLocks()
-    aliases = _local_aliases(fn)
-
-    def tok(expr: ast.AST) -> Optional[str]:
-        return _lock_token(expr, cls, mod, aliases, attr_index)
-
-    def visit(node: ast.AST, held: List[Tuple[str, int]]):
-        if node is not fn and isinstance(
-                node, (ast.FunctionDef, ast.AsyncFunctionDef,
-                       ast.ClassDef)):
-            return  # nested defs get their own pass (own alias scope)
-        if isinstance(node, (ast.With, ast.AsyncWith)):
-            new_held = list(held)
-            for item in node.items:
-                visit(item.context_expr, held)  # evaluated under OUTER
-                t = tok(item.context_expr)
-                if t is None:
-                    continue
-                facts.acquired.add(t)
-                for outer, _line in new_held:
-                    if outer != t:  # re-entrant same-rank: no edge
-                        facts.edges.append(
-                            (outer, t, item.context_expr.lineno))
-                new_held.append((t, item.context_expr.lineno))
-            for sub in node.body:
-                visit(sub, new_held)
-            return
-        if held and isinstance(node, ast.Call):
-            callee = _same_module_callee(node, cls)
-            if callee is not None:
-                for outer, _line in held:
-                    facts.calls_under.append(
-                        (outer, callee, node.lineno))
-        for child in ast.iter_child_nodes(node):
-            visit(child, held)
-
-    visit(fn, [])
-    return facts
-
-
-def _same_module_callee(call: ast.Call, cls: Optional[str]
-                        ) -> Optional[Tuple[Optional[str], str]]:
-    """``self.m(...)`` → ``(enclosing_class, m)``; bare ``f(...)`` →
-    ``(None, f)``; else None."""
-    f = call.func
-    if isinstance(f, ast.Attribute) and isinstance(f.value, ast.Name) \
-            and f.value.id == "self":
-        return (cls, f.attr)
-    if isinstance(f, ast.Name):
-        return (None, f.id)
-    return None
+    return project.cached("static_lock_edges", build)
 
 
 @register
 class LockOrderRule(Rule):
     """Cross-module lock-acquisition-order cycles (potential AB/BA
-    deadlocks), from lexical nesting + same-module call-through +
+    deadlocks), from lexical nesting + call-graph call-through +
     the seeded hierarchy."""
 
     id = "lock-order"
@@ -322,50 +137,7 @@ class LockOrderRule(Rule):
                  "deadlock even if no test ever interleaves it")
 
     def check_project(self, project: Project) -> Iterable[Diagnostic]:
-        attr_index = _lock_attr_index(project)
-        # edge → (path, line) of first sighting; seeds carry none
-        edges: Dict[Tuple[str, str], Optional[Tuple[str, int]]] = {
-            e: None for e in SEED_EDGES}
-        def note_edge(key: Tuple[str, str], site: Tuple[str, int]):
-            # first CODE sighting wins; it also upgrades a seed's
-            # None site so cycle reports name real file:line anchors
-            if edges.get(key) is None:
-                edges[key] = site
-
-        for mod in project.modules:
-            if mod.tree is None:
-                continue
-            # keyed (class, name): same-named methods on different
-            # classes in one module must not collide
-            fn_facts: Dict[Tuple[Optional[str], str], _FnLocks] = {}
-            ordered: List[Tuple[_FnLocks, Module]] = []
-            for cls, fn in mod.functions():
-                facts = _collect_fn_locks(mod, cls, fn, attr_index)
-                fn_facts[(cls, fn.name)] = facts
-                ordered.append((facts, mod))
-            # transitive acquires through same-module calls (bounded)
-            for _ in range(3):
-                changed = False
-                for facts, _m in ordered:
-                    for _outer, callee, _line in facts.calls_under:
-                        callee_facts = fn_facts.get(callee)
-                        if callee_facts and not (
-                                callee_facts.acquired
-                                <= facts.acquired):
-                            facts.acquired |= callee_facts.acquired
-                            changed = True
-                if not changed:
-                    break
-            for facts, m in ordered:
-                for outer, inner, line in facts.edges:
-                    note_edge((outer, inner), (m.rel, line))
-                for outer, callee, line in facts.calls_under:
-                    callee_facts = fn_facts.get(callee)
-                    if not callee_facts:
-                        continue
-                    for inner in callee_facts.acquired:
-                        if inner != outer and not inner.startswith("*."):
-                            note_edge((outer, inner), (m.rel, line))
+        edges = static_lock_edges(project)
         # wildcard tokens never join the graph (ambiguous owners would
         # manufacture cycles out of coincidental attribute names)
         graph: Dict[str, Set[str]] = {}
@@ -382,10 +154,11 @@ class LockOrderRule(Rule):
                 if edges.get(e) is not None:
                     anchor = edges[e]
                     break
-            path, line = anchor if anchor else ("netsdb_tpu", 1)
+            path, line = (anchor.rel, anchor.line) if anchor \
+                else ("netsdb_tpu", 1)
             chain = " -> ".join(cycle + [cycle[0]])
             sites = "; ".join(
-                f"{a}->{b} at {edges[(a, b)][0]}:{edges[(a, b)][1]}"
+                f"{a}->{b} at {edges[(a, b)].describe()}"
                 for a, b in zip(cycle, cycle[1:] + [cycle[0]])
                 if edges.get((a, b)) is not None) or "seeded edges only"
             yield Diagnostic(
@@ -430,81 +203,51 @@ def _find_cycles(graph: Dict[str, Set[str]]) -> List[List[str]]:
 @register
 class LockBlockingCallRule(Rule):
     """Blocking calls (socket recv/accept, device_put, queue.get
-    without timeout, seeded patterns) made while a lock is lexically
-    held — the stall-the-world shape of the PR 6 inversion."""
+    without timeout, seeded patterns) reached while a lock is held —
+    lexically under the ``with``, or through any resolved call chain
+    (the interprocedural extension)."""
 
     id = "lock-blocking-call"
     rationale = ("a blocking call under a lock turns one slow peer "
                  "into a whole-subsystem stall")
 
-    def select(self, mod: Module) -> bool:
-        return mod.rel not in _BLOCKING_EXEMPT
-
-    def check_module(self, mod: Module) -> Iterable[Diagnostic]:
-        attr_index: Dict[str, Set[str]] = {}
-        for cls, fn in mod.functions():
-            aliases = _local_aliases(fn)
-            yield from self._check_fn(mod, cls, fn, aliases, attr_index)
-
-    def _check_fn(self, mod: Module, cls, fn, aliases, attr_index):
-        def tok(expr):
-            return _lock_token(expr, cls, mod, aliases, attr_index)
-
-        def walk_with(node, held: List[str]):
-            for child in ast.iter_child_nodes(node):
-                if isinstance(child, (ast.FunctionDef,
-                                      ast.AsyncFunctionDef,
-                                      ast.ClassDef)):
+    def check_project(self, project: Project) -> Iterable[Diagnostic]:
+        S = summaries(project)
+        for key, facts in S.facts.items():
+            rel = key[0]
+            if rel in BLOCKING_EXEMPT:
+                continue
+            # lexical: a blocking call textually under the with
+            for what, line, held in facts.blocking:
+                if not held:
                     continue
-                if isinstance(child, (ast.With, ast.AsyncWith)):
-                    toks = [t for t in (tok(i.context_expr)
-                                        for i in child.items)
-                            if t is not None]
-                    for sub in child.body:
-                        yield from walk_with(sub, held + toks)
-                    # with-item expressions themselves checked under
-                    # the OUTER held set
-                    for i in child.items:
-                        yield from walk_with(i, held)
+                yield Diagnostic(
+                    rule=self.id, path=rel, line=line, col=0,
+                    message=f"blocking call {what} while holding "
+                            f"{', '.join(held)} — a slow peer stalls "
+                            f"every waiter on the lock; move the "
+                            f"wait outside or bound it")
+            # interprocedural: a locked call site whose callee
+            # transitively reaches a blocking call
+            reported: Set[Tuple[int, str]] = set()
+            for site in facts.calls:
+                if not site.held:
                     continue
-                if held and isinstance(child, ast.Call):
-                    d = self._blocking(mod, child, held)
-                    if d is not None:
-                        yield d
-                yield from walk_with(child, held)
-
-        yield from walk_with(fn, [])
-
-    def _blocking(self, mod: Module, call: ast.Call,
-                  held: List[str]) -> Optional[Diagnostic]:
-        f = call.func
-        name = terminal_name(f)
-        if name is None:
-            return None
-        recv = terminal_name(f.value) if isinstance(f, ast.Attribute) \
-            else None
-        what = None
-        if name in _BLOCKING_METHODS:
-            what = f"{name}()"
-        elif recv is not None and (recv, name) in _BLOCKING_SEEDED:
-            what = f"{recv}.{name}() (PagedObjects.append waits on "\
-                   f"the relation's stream locks)"
-        elif name == "get" and recv is not None \
-                and _QUEUE_RECV_RE.search(recv):
-            kws = {kw.arg for kw in call.keywords}
-            nonblocking = "timeout" in kws or any(
-                kw.arg == "block" and isinstance(kw.value, ast.Constant)
-                and kw.value.value is False for kw in call.keywords) \
-                or len(call.args) >= 2 \
-                or (len(call.args) == 1 and isinstance(
-                    call.args[0], ast.Constant)
-                    and call.args[0].value is False)
-            if not nonblocking:
-                what = f"{recv}.get() without a timeout"
-        if what is None:
-            return None
-        return self.diag(
-            mod, call,
-            f"blocking call {what} while holding "
-            f"{', '.join(held)} — a slow peer stalls every waiter on "
-            f"the lock; move the wait outside or bound it")
+                blk = S.trans_blocking.get(site.callee, {})
+                for what, (brel, bline, depth) in sorted(blk.items()):
+                    if brel in BLOCKING_EXEMPT:
+                        continue
+                    if (site.line, what) in reported:
+                        continue
+                    reported.add((site.line, what))
+                    hops = f"{depth + 1} call hop" \
+                           f"{'s' if depth else ''} down"
+                    yield Diagnostic(
+                        rule=self.id, path=rel, line=site.line, col=0,
+                        message=f"call into {fmt_key(site.callee)} "
+                                f"reaches blocking {what} at "
+                                f"{brel}:{bline} ({hops}) while "
+                                f"holding {', '.join(site.held)} — "
+                                f"a slow peer stalls every waiter on "
+                                f"the lock; move the wait outside "
+                                f"the lock or bound it")

@@ -33,6 +33,7 @@ What gets flagged:
 from __future__ import annotations
 
 import ast
+import dataclasses
 from typing import Iterable, List, Optional, Set
 
 from netsdb_tpu.analysis.lint import (Diagnostic, Module, Rule,
@@ -146,8 +147,18 @@ class IterCloseRule(Rule):
             if id(call) in owned or var in closed_vars:
                 continue
             name = terminal_name(call.func)
-            yield self.diag(
+            # render the suggested try/finally as a diff riding the
+            # diagnostic (--json "suggestion") — still human-applied,
+            # which is the --fix safety gate for this shape (lazy
+            # import: fix.py imports this module at top level)
+            from netsdb_tpu.analysis.fix import suggest_close
+
+            d = self.diag(
                 mod, call,
                 f"{var} = {name}() is never closed in this function — "
                 f"close() it (try/finally or contextlib.closing) or "
                 f"hand ownership to the caller")
+            suggestion = suggest_close(mod, var, call)
+            if suggestion:
+                d = dataclasses.replace(d, suggestion=suggestion)
+            yield d

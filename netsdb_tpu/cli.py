@@ -982,6 +982,21 @@ def _cmd_lint(args) -> int:
         for rule in L.all_rules():
             print(f"{rule.id:<22} {rule.rationale}")
         return 0
+    if getattr(args, "witness_coverage", None):
+        from netsdb_tpu.analysis import witnesscov as W
+
+        try:
+            dyn = W.load_witness_dump(args.witness_coverage)
+        except (OSError, ValueError, json.JSONDecodeError) as e:
+            print(f"witness-coverage: cannot read "
+                  f"{args.witness_coverage}: {e}", file=sys.stderr)
+            return 2
+        report = W.coverage(dyn)
+        if args.json:
+            print(json.dumps(report, indent=2))
+        else:
+            print(W.render(report))
+        return 0  # a coverage REPORT, not a gate: no false failures
     if getattr(args, "fix", False):
         from netsdb_tpu.analysis import fix as F
 
@@ -1006,14 +1021,30 @@ def _cmd_lint(args) -> int:
     except ValueError as e:  # unknown rule id
         print(str(e), file=sys.stderr)
         return 2
+    accepted = []
+    if getattr(args, "write_baseline", False) \
+            and not getattr(args, "baseline", None):
+        print("--write-baseline requires --baseline FILE (where to "
+              "record the accepted findings)", file=sys.stderr)
+        return 2
+    if getattr(args, "baseline", None):
+        from netsdb_tpu.analysis import baseline as B
+
+        if getattr(args, "write_baseline", False):
+            n = B.write(diags, args.baseline)
+            print(f"lint: wrote {n} accepted finding(s) to "
+                  f"{args.baseline}")
+            return 0
+        diags, accepted = B.apply(diags, args.baseline)
     if args.json:
         print(json.dumps(L.to_json(diags), indent=2))
     else:
         for d in diags:
             print(str(d))
+        tail = f", {len(accepted)} baselined" if accepted else ""
         print(f"lint: {'FAIL' if diags else 'ok'} "
               f"({len(diags)} finding(s), "
-              f"{len(L.rule_ids())} rule(s))")
+              f"{len(L.rule_ids())} rule(s){tail})")
     return 1 if diags else 0
 
 
@@ -1256,6 +1287,24 @@ def main(argv=None) -> int:
     p.add_argument("--dry-run", action="store_true",
                    help="with --fix: print the unified diff instead "
                         "of writing files")
+    p.add_argument("--baseline", metavar="FILE", default=None,
+                   help="findings ratchet (docs/lint_baseline.json): "
+                        "findings recorded there are accepted, new "
+                        "findings fail, and a stale entry is itself "
+                        "a finding — the file only shrinks")
+    p.add_argument("--write-baseline", action="store_true",
+                   help="with --baseline: record the current "
+                        "findings as the new accepted baseline and "
+                        "exit")
+    p.add_argument("--witness-coverage", metavar="DUMP", default=None,
+                   help="reconcile the static lock-order graph with "
+                        "a runtime witness dump (utils/locks."
+                        "LockWitness.dump, written by the tier-1 "
+                        "conftest under NETSDB_WITNESS_DUMP): "
+                        "statically-possible-but-never-exercised "
+                        "edges report as untested concurrency, "
+                        "runtime edges the static graph missed as "
+                        "blind spots; always exits 0")
 
     p = sub.add_parser("autotune",
                        help="measure physical-strategy crossovers "

@@ -145,6 +145,15 @@ def _catalog() -> Dict[str, Tuple[str, str]]:
     gauges = (
         ("analysis.lock_edges", "distinct lock-rank acquisition-order "
                                 "edges observed by the witness"),
+        ("analysis.callgraph_edges", "resolved call edges in the "
+                                     "interprocedural lint rules' "
+                                     "project call graph"),
+        ("analysis.race_findings", "static shared-state race findings "
+                                   "on the last lint run"),
+        ("analysis.witness_uncovered_edges", "static lock-order edges "
+                                             "the runtime witness has "
+                                             "never exercised "
+                                             "(untested concurrency)"),
         ("sched.queue_depth", "requests currently queued across all "
                               "scheduler lanes"),
     )

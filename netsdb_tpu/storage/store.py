@@ -345,10 +345,12 @@ class SetStore:
             if s.storage == "paged":
                 po = self._pin_paged_objects(s, items)
                 if po is None:
+                    # lint: disable=lock-blocking-call -- fresh ingest: the relation doesn't exist yet, so no stream can hold its rw lock and the append wait cannot occur
                     dead = self._ingest_paged(s, items)
                     self._touch(s)
             else:
                 if s.items is None:  # evicted: reload before appending
+                    # lint: disable=lock-blocking-call -- reload of an evicted set: its relation was spilled with no live streams, so the rebuild's appends cannot wait
                     self._load_from_spill(s)
                 if s.placement is not None:
                     items = [s.placement.apply(i) for i in items]
@@ -668,6 +670,7 @@ class SetStore:
                         # relation that doesn't, so no rw wait — and a
                         # concurrent replace can no longer interleave
                         # and orphan one relation's pages
+                        # lint: disable=lock-blocking-call -- first batch of a fresh relation (comment above): no streams exist, the append wait cannot occur
                         dead = self._ingest_paged(s, [table],
                                                   append=True)
                 if pc is not None:
@@ -719,6 +722,7 @@ class SetStore:
                 raise ValueError(f"set {ident} aliases {s.alias_of}; "
                                  f"it is read-only")
             if s.storage == "paged":
+                # lint: disable=lock-blocking-call -- replace builds a FRESH relation (the old one is dropped after the swap); no stream can hold the new relation's rw lock yet
                 dead = self._ingest_paged(s, [tensor])
             else:
                 if s.placement is not None:

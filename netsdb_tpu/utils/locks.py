@@ -242,6 +242,40 @@ class LockWitness:
                 "violations": list(self.violations),
             }
 
+    def _export_edges_locked(self) -> List[dict]:
+        return [{"held": a, "acquired": b,
+                 "sites": list(rec["sites"]),
+                 "modes": sorted("".join(m) for m in rec["modes"])}
+                for (a, b), rec in sorted(self.edges.items())]
+
+    def export_edges(self) -> List[dict]:
+        """The recorded rank edges as plain JSON-safe records — the
+        input half of ``cli lint --witness-coverage``, which diffs
+        this dynamic graph against the static lock-order graph
+        (ranks here and tokens there share one grammar, so the diff
+        is a set comparison)."""
+        with self._mu:
+            return self._export_edges_locked()
+
+    def dump(self, path: str) -> None:
+        """Write the edge graph (plus run totals) as JSON. The tier-1
+        conftest writes one per run when ``NETSDB_WITNESS_DUMP`` is
+        set; ``cli lint --witness-coverage <path>`` reads it back."""
+        import json
+
+        # one _mu extent for edges AND totals: a dump taken while a
+        # live thread still acquires must be self-consistent (the
+        # reconciliation report treats it as ground truth)
+        with self._mu:
+            payload = {
+                "edges": self._export_edges_locked(),
+                "acquisitions": self.acquisitions,
+                "dropped_edges": self.dropped_edges,
+                "violations": len(self.violations),
+            }
+        with open(path, "w", encoding="utf-8") as f:
+            json.dump(payload, f, indent=1, sort_keys=True)
+
 
 #: the process-wide witness; None = disabled (the common case — every
 #: tracked acquisition pays exactly this read + an is-None check)

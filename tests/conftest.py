@@ -63,19 +63,73 @@ def pytest_configure(config):
 def pytest_sessionfinish(session, exitstatus):
     from netsdb_tpu.utils import locks
 
-    w = locks.witness()
-    if w is None or not w.violations:
-        return
-    rep = w.report()
     tr = session.config.pluginmanager.get_plugin("terminalreporter")
     out = (tr._tw.line if tr is not None else
            lambda s, **k: print(s))  # noqa: T201 — terminal fallback
-    out("")
-    out(f"LOCK WITNESS: {len(rep['violations'])} lock-order "
-        f"violation(s) recorded during the suite "
-        f"({rep['edges']} rank edges observed):", red=True)
-    for v in rep["violations"]:
-        cyc = " -> ".join(v["cycle"])
-        sites = "; ".join(f"{r} at {s}" for r, s in v["sites"].items())
-        out(f"  cycle {cyc} [{v['thread']}] ({sites})", red=True)
-    session.exitstatus = 1
+
+    w = locks.witness()
+    if w is not None and w.violations:
+        rep = w.report()
+        out("")
+        out(f"LOCK WITNESS: {len(rep['violations'])} lock-order "
+            f"violation(s) recorded during the suite "
+            f"({rep['edges']} rank edges observed):", red=True)
+        for v in rep["violations"]:
+            cyc = " -> ".join(v["cycle"])
+            sites = "; ".join(f"{r} at {s}"
+                              for r, s in v["sites"].items())
+            out(f"  cycle {cyc} [{v['thread']}] ({sites})", red=True)
+        session.exitstatus = 1
+
+    # static↔witness reconciliation + the fast-path lint gate, both
+    # riding the session summary (best-effort: a reporting failure
+    # must never mask the suite's own result). Skipped for small
+    # inner-loop runs — rebuilding the interprocedural analysis costs
+    # ~2-4 s, which is gate-money on a suite run but pure tax on
+    # `pytest tests/x.py::test_one` (an explicit witness-dump request
+    # always runs it)
+    if session.testscollected < 50 \
+            and not os.environ.get("NETSDB_WITNESS_DUMP"):
+        return
+    try:
+        _report_static_analysis(session, out, w)
+    except Exception as e:  # noqa: BLE001 — summary-only path
+        out(f"static-analysis summary unavailable: "
+            f"{type(e).__name__}: {e}")
+
+
+def _report_static_analysis(session, out, w):
+    """Session-end static-analysis readout: witness edge dump (when
+    NETSDB_WITNESS_DUMP is set), the static-vs-dynamic lock-edge
+    coverage line, and a cache-warm full-tree lint re-run (cheap
+    after test_lint_gate parsed the tree) so deselecting the gate
+    test cannot silently skip the gate."""
+    from netsdb_tpu.analysis import baseline as B
+    from netsdb_tpu.analysis import lint as L
+    from netsdb_tpu.analysis import witnesscov as W
+
+    dump_path = os.environ.get("NETSDB_WITNESS_DUMP")
+    if w is not None and dump_path:
+        w.dump(dump_path)
+        out(f"lock witness: edge dump written to {dump_path}")
+    # ONE project shared by the coverage report and the lint re-run
+    # (call graph / summaries / static edges are cached per Project)
+    project = L.load_project()
+    if w is not None:
+        report = W.coverage(w.export_edges(), project=project)
+        out(W.render(report).splitlines()[0])
+
+    diags = L.run_lint(project=project)
+    baseline_path = os.path.join(L.REPO, "docs", "lint_baseline.json")
+    if os.path.exists(baseline_path):
+        diags, accepted = B.apply(diags, baseline_path)
+    else:
+        accepted = []
+    tail = f", {len(accepted)} baselined" if accepted else ""
+    out(f"cli lint: {'FAIL' if diags else 'ok'} "
+        f"({len(diags)} finding(s){tail})")
+    if diags:
+        for d in diags[:20]:
+            out(f"  {d}", red=True)
+        if session.exitstatus == 0:
+            session.exitstatus = 1

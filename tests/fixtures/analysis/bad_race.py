@@ -1,0 +1,30 @@
+"""KNOWN-BAD fixture: shared-state race across two thread roots.
+
+``Pump`` owns a lock (which marks its instances as shared), spawns
+two daemon loops, and mutates ``processed`` from both — but the
+ingest path reaches the mutation with no ``Pump`` lock held anywhere
+on the call chain.  ``good_race.py`` is the lock-protected twin.
+
+Parsed by the lint tests, never imported.
+"""
+
+import threading
+
+
+class Pump:
+    def __init__(self):
+        self._mu = threading.Lock()
+        self.processed = 0
+        threading.Thread(target=self._ingest_loop,
+                         daemon=True).start()
+        threading.Thread(target=self._drain_loop, daemon=True).start()
+
+    def _ingest_loop(self):
+        self._bump()  # lock-free path to the shared counter
+
+    def _drain_loop(self):
+        with self._mu:
+            self._bump()  # same mutation, correctly covered
+
+    def _bump(self):
+        self.processed += 1

@@ -1,0 +1,30 @@
+"""KNOWN-GOOD fixture: the lock-protected twin of ``bad_race.py``.
+
+Same two thread roots, same shared counter — but every mutating path
+holds the owner's lock, either lexically at the mutation site or at a
+call site up-stack.  The race rule must stay silent.
+
+Parsed by the lint tests, never imported.
+"""
+
+import threading
+
+
+class Pump:
+    def __init__(self):
+        self._mu = threading.Lock()
+        self.processed = 0
+        threading.Thread(target=self._ingest_loop,
+                         daemon=True).start()
+        threading.Thread(target=self._drain_loop, daemon=True).start()
+
+    def _ingest_loop(self):
+        with self._mu:
+            self.processed += 1  # covered lexically
+
+    def _drain_loop(self):
+        with self._mu:
+            self._bump()  # covered at the call site
+
+    def _bump(self):
+        self.processed += 1

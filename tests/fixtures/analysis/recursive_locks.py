@@ -1,0 +1,26 @@
+"""Fixture: mutual recursion through a lock — the summary fixpoint
+must terminate and must NOT manufacture a self-cycle out of
+re-entrant same-rank nesting.
+
+Parsed by the lint tests, never imported.
+"""
+
+import threading
+
+
+class Walker:
+    def __init__(self):
+        self._mu = threading.Lock()
+
+    def descend(self, n):
+        with self._mu:
+            self.helper(n)
+
+    def helper(self, n):
+        if n:
+            self.descend(n - 1)  # mutual recursion through the lock
+        self.ascend(n)
+
+    def ascend(self, n):
+        if n:
+            self.helper(n - 1)

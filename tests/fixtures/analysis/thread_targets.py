@@ -1,0 +1,24 @@
+"""Fixture: thread-root resolution through a one-hop local alias and
+``functools.partial`` — plus the races those roots expose.
+
+Parsed by the lint tests, never imported.
+"""
+
+import functools
+import threading
+
+
+class Loader:
+    def __init__(self):
+        self._mu = threading.Lock()
+        self.batches = 0
+        fn = self._pull  # one-hop alias: the resolver sees through it
+        threading.Thread(target=fn, daemon=True).start()
+        threading.Thread(target=functools.partial(self._push, 1),
+                         daemon=True).start()
+
+    def _pull(self):
+        self.batches += 1  # racy: no Loader lock on this root's path
+
+    def _push(self, n):
+        self.batches += n  # racy: ditto, via the partial-wrapped root

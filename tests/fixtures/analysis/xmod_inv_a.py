@@ -1,0 +1,26 @@
+"""KNOWN-BAD fixture (half A): cross-MODULE lock inversion.
+
+``refresh`` holds this module's lock across a call into
+``xmod_inv_b.flush``, which takes that module's lock — while
+``xmod_inv_b.rebalance`` nests the two the other way around.  Only an
+interprocedural pass that resolves the import and carries lock
+summaries across modules can see the cycle.
+
+Parsed by the lint tests, never imported.
+"""
+
+import threading
+
+import xmod_inv_b as b
+
+a_mu = threading.Lock()
+
+
+def refresh():
+    with a_mu:
+        b.flush()  # call-through: b_mu acquired under a_mu
+
+
+def refill():
+    with a_mu:
+        pass

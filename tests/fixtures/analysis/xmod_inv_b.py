@@ -1,0 +1,23 @@
+"""KNOWN-BAD fixture (half B): cross-MODULE lock inversion.
+
+``rebalance`` holds this module's lock across a call into
+``xmod_inv_a.refill``, closing the AB/BA cycle that half A opens.
+
+Parsed by the lint tests, never imported.
+"""
+
+import threading
+
+import xmod_inv_a as a
+
+b_mu = threading.Lock()
+
+
+def flush():
+    with b_mu:
+        pass
+
+
+def rebalance():
+    with b_mu:
+        a.refill()  # reverse order: a_mu acquired under b_mu

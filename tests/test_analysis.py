@@ -136,7 +136,9 @@ def test_diagnostics_sorted_and_json_shape():
     keys = [(d.path, d.line, d.col, d.rule) for d in diags]
     assert keys == sorted(keys)
     payload = L.to_json(diags)
-    assert all(set(d) == {"rule", "path", "line", "col", "message"}
+    base = {"rule", "path", "line", "col", "message"}
+    # "suggestion" rides only findings with a rendered remedy diff
+    assert all(set(d) in (base, base | {"suggestion"})
                for d in payload)
     json.dumps(payload)  # round-trips
 
@@ -345,6 +347,230 @@ def test_fix_nested_flagged_loops_inside_out(tmp_path):
 
     # semantics preserved: 3 outer x (2 inner + 10)
     assert ns["f"](_S(3), _S(2)) == 36
+
+
+# ------------------------------------------ suggestion diffs (ISSUE 12)
+def test_assigned_never_closed_carries_suggestion_diff():
+    diags = run_lint(paths=fx("bad_unclosed.py"), rules=["iter-close"],
+                     select_all=True)
+    assigned = [d for d in diags if "never closed" in d.message]
+    assert assigned and all(d.suggestion for d in assigned)
+    sug = next(d.suggestion for d in assigned
+               if "it = " in d.suggestion)
+    # the rendered remedy: try around the rest of the block, close in
+    # a finally — a unified diff a human applies, not an auto-fix
+    assert "+    try:" in sug
+    assert "+    finally:" in sug
+    assert "+        it.close()" in sug
+    assert "-    return next(iter(it))" in sug
+    assert "+        return next(iter(it))" in sug
+
+
+def test_suggestion_rides_json_not_text_output(capsys):
+    from netsdb_tpu.cli import main
+
+    rc = main(["lint", "--json", "--rule", "iter-close",
+               os.path.join(FIXTURES, "bad_unclosed.py")])
+    assert rc == 1
+    payload = json.loads(capsys.readouterr().out)
+    assert any("suggestion" in d and "finally:" in d["suggestion"]
+               for d in payload)
+
+
+def test_suggestion_skips_when_nothing_follows(tmp_path):
+    from netsdb_tpu.analysis.fix import suggest_close
+    from netsdb_tpu.analysis.lint import Module
+
+    p = tmp_path / "tail.py"
+    p.write_text("def f(pc):\n    it = pc.stream()\n")
+    mod = Module(str(p), repo=str(tmp_path))
+    import ast
+
+    call = next(n for n in ast.walk(mod.tree)
+                if isinstance(n, ast.Call))
+    assert suggest_close(mod, "it", call) is None
+
+
+def test_suggestion_skips_when_handle_escapes(tmp_path):
+    """Review regression: closing a RETURNED iterator in a finally
+    would hand the caller a dead handle — no suggestion for escaping
+    handles (returned, yielded, aliased), while derived-value returns
+    (`return next(iter(it))`) still get one."""
+    from netsdb_tpu.analysis.fix import suggest_close
+    from netsdb_tpu.analysis.lint import Module
+    import ast
+
+    def first_call(mod):
+        return next(n for n in ast.walk(mod.tree)
+                    if isinstance(n, ast.Call))
+
+    for body in ("    return it\n",
+                 "    yield it\n",
+                 "    alias = it\n",
+                 "    self.it = it\n",
+                 "    return {'k': it}\n",
+                 "    register(it)\n",
+                 "    self.cache.append(it)\n",
+                 "    return enumerate(it)\n",   # lazy rewrapper
+                 "    return map(str, it)\n",
+                 "    return (x for x in it)\n",  # lazy genexp
+                 "    wrapped = iter(it)\n"):
+        p = tmp_path / "esc.py"
+        p.write_text("def f(self, pc):\n    it = pc.stream()\n"
+                     + body)
+        mod = Module(str(p), repo=str(tmp_path))
+        assert suggest_close(mod, "it", first_call(mod)) is None, body
+    for body in ("    return next(iter(it))\n",   # eager outermost
+                 "    return list(map(str, it))\n",
+                 "    rows = [r for r in it]\n"
+                 "    print(len(rows))\n"):       # eager comprehension
+        p = tmp_path / "esc.py"
+        p.write_text("def f(pc):\n    it = pc.stream()\n" + body)
+        mod = Module(str(p), repo=str(tmp_path))
+        assert suggest_close(mod, "it", first_call(mod)) \
+            is not None, body
+
+
+# ------------------------------------------ baseline ratchet (ISSUE 12)
+def test_baseline_accepts_recorded_findings(tmp_path, capsys):
+    from netsdb_tpu.cli import main
+
+    bad = os.path.join(FIXTURES, "bad_blocking.py")
+    base = str(tmp_path / "baseline.json")
+    rc = main(["lint", bad, "--baseline", base, "--write-baseline"])
+    assert rc == 0
+    capsys.readouterr()
+    # recorded findings are accepted → clean exit, reported as such
+    rc = main(["lint", bad, "--baseline", base])
+    out = capsys.readouterr().out
+    assert rc == 0
+    assert "baselined" in out
+
+
+def test_baseline_new_findings_still_fail(tmp_path, capsys):
+    from netsdb_tpu.cli import main
+
+    bad = os.path.join(FIXTURES, "bad_blocking.py")
+    base = str(tmp_path / "baseline.json")
+    main(["lint", bad, "--baseline", base, "--write-baseline"])
+    capsys.readouterr()
+    # a file with findings NOT in the baseline: the ratchet holds
+    rc = main(["lint", bad, os.path.join(FIXTURES, "bad_unclosed.py"),
+               "--baseline", base, "--json"])
+    payload = json.loads(capsys.readouterr().out)
+    assert rc == 1
+    assert all(d["path"].endswith("bad_unclosed.py")
+               for d in payload), payload
+
+
+def test_baseline_stale_entry_is_itself_a_finding(tmp_path, capsys):
+    from netsdb_tpu.cli import main
+
+    bad = os.path.join(FIXTURES, "bad_blocking.py")
+    good = os.path.join(FIXTURES, "good_locks.py")
+    base = str(tmp_path / "baseline.json")
+    main(["lint", bad, "--baseline", base, "--write-baseline"])
+    capsys.readouterr()
+    # the debt was "fixed" (finding gone) but the baseline still
+    # records it: stale entries fail until the file shrinks
+    rc = main(["lint", good, "--baseline", base, "--json"])
+    payload = json.loads(capsys.readouterr().out)
+    assert rc == 1
+    assert payload and all(d["rule"] == "stale-baseline"
+                           for d in payload)
+    # ... and --write-baseline shrinks it back to empty
+    rc = main(["lint", good, "--baseline", base, "--write-baseline"])
+    assert rc == 0
+    capsys.readouterr()
+    assert main(["lint", good, "--baseline", base]) == 0
+
+
+def test_baseline_acceptance_is_counted(tmp_path):
+    """Review regression: one baseline entry must not absorb an
+    unlimited number of same-shape findings — the Nth+1 duplicate is
+    NEW debt and fails the ratchet."""
+    from netsdb_tpu.analysis import baseline as B
+    from netsdb_tpu.analysis.lint import Diagnostic
+
+    d = Diagnostic(rule="lock-blocking-call", path="m.py", line=10,
+                   col=0, message="blocking call recv() at m.py:10")
+    base = str(tmp_path / "b.json")
+    B.write([d], base)
+    dup = Diagnostic(rule="lock-blocking-call", path="m.py", line=90,
+                     col=0, message="blocking call recv() at m.py:90")
+    surviving, accepted = B.apply([d, dup], base)
+    assert len(accepted) == 1 and len(surviving) == 1
+    # ... and fixing one of N recorded occurrences goes stale
+    B.write([d, dup], base)
+    surviving, accepted = B.apply([d], base)
+    assert len(accepted) == 1
+    assert [s.rule for s in surviving] == ["stale-baseline"]
+    assert "only 1 remain" in surviving[0].message
+
+
+def test_write_baseline_requires_baseline_flag(capsys):
+    from netsdb_tpu.cli import main
+
+    rc = main(["lint", "--write-baseline",
+               os.path.join(FIXTURES, "good_locks.py")])
+    assert rc == 2
+    assert "--write-baseline requires --baseline" \
+        in capsys.readouterr().err
+
+
+def test_baseline_survives_line_drift(tmp_path):
+    from netsdb_tpu.analysis import baseline as B
+    from netsdb_tpu.analysis.lint import Diagnostic
+
+    d1 = Diagnostic(rule="lock-blocking-call", path="m.py", line=10,
+                    col=0, message="blocking call recv() at m.py:10")
+    base = str(tmp_path / "b.json")
+    B.write([d1], base)
+    drifted = Diagnostic(rule="lock-blocking-call", path="m.py",
+                         line=14, col=0,
+                         message="blocking call recv() at m.py:14")
+    surviving, accepted = B.apply([drifted], base)
+    assert surviving == [] and accepted == [drifted]
+
+
+def test_checked_in_baseline_is_empty():
+    # the goal state: the ratchet mechanism ships, the debt does not
+    from netsdb_tpu.analysis import baseline as B
+    from netsdb_tpu.analysis.lint import REPO
+
+    assert B.load(os.path.join(REPO, "docs",
+                               "lint_baseline.json")) == []
+
+
+# ------------------------------------------ parse-once cache (ISSUE 12)
+def test_project_cache_reuses_unchanged_modules():
+    from netsdb_tpu.analysis.lint import load_project
+
+    p1 = load_project(paths=fx("good_locks.py"))
+    p2 = load_project(paths=fx("good_locks.py"))
+    assert p1.modules[0] is p2.modules[0]  # same parsed Module
+
+
+def test_project_cache_invalidates_on_content_change(tmp_path):
+    # deliberately NO sleep: a same-size rewrite inside the
+    # filesystem timestamp granularity must still invalidate (the
+    # cache verifies content on a stat-key hit)
+    from netsdb_tpu.analysis.lint import load_project
+
+    p = tmp_path / "m.py"
+    p.write_text("x = 1\n")
+    m1 = load_project(paths=[str(p)], repo=str(tmp_path)).modules[0]
+    p.write_text("x = 2\n")
+    m2 = load_project(paths=[str(p)], repo=str(tmp_path)).modules[0]
+    assert m1 is not m2 and m2.source == "x = 2\n"
+
+
+def test_cached_module_resets_suppression_accounting():
+    # run 1 marks the fixture's suppressions used; a cached reuse must
+    # start clean or unused-suppression accounting would lie
+    first = run_lint(paths=fx("suppressed.py"))
+    second = run_lint(paths=fx("suppressed.py"))
+    assert rules_of(first) == rules_of(second)
 
 
 def test_fix_skips_multiline_bytes_and_fstrings(tmp_path):

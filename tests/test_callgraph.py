@@ -1,0 +1,244 @@
+"""Tests for the interprocedural layer (ISSUE 12): the project call
+graph, the transitive summaries it feeds, the rewritten cross-module
+concurrency rules, the static race rule, and the static↔witness
+reconciliation report."""
+
+import json
+import os
+
+from netsdb_tpu.analysis import run_lint
+from netsdb_tpu.analysis.lint import load_project
+
+FIXTURES = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                        "fixtures", "analysis")
+
+
+def fx(*names):
+    return [os.path.join(FIXTURES, n) for n in names]
+
+
+# --- call graph resolution -------------------------------------------
+
+def test_cross_module_call_through_inversion_detected():
+    diags = run_lint(paths=fx("xmod_inv_a.py", "xmod_inv_b.py"),
+                     rules=["lock-order"])
+    assert len(diags) == 1
+    msg = diags[0].message
+    # the cycle names both modules' lock tokens ...
+    assert "xmod_inv_a.py:a_mu" in msg and "xmod_inv_b.py:b_mu" in msg
+    # ... and BOTH sites of each call-through edge: the holding call
+    # site and the callee acquisition line
+    assert "acquired in" in msg
+    assert "xmod_inv_b.py:flush" in msg
+    assert "xmod_inv_a.py:refill" in msg
+
+
+def test_single_module_halves_are_clean_alone():
+    # each half orders consistently on its own — only the cross-module
+    # view exposes the cycle (the PR 8 blind spot this layer closes)
+    assert run_lint(paths=fx("xmod_inv_a.py"),
+                    rules=["lock-order"]) == []
+    assert run_lint(paths=fx("xmod_inv_b.py"),
+                    rules=["lock-order"]) == []
+
+
+def test_thread_roots_resolved_through_alias_and_partial():
+    from netsdb_tpu.analysis.callgraph import callgraph
+
+    project = load_project(paths=fx("thread_targets.py"))
+    graph = callgraph(project)
+    names = {key[2] for key in graph.thread_roots}
+    assert names == {"_pull", "_push"}
+    for root in graph.thread_roots.values():
+        assert root.sites, "spawn site lost"
+
+
+def test_attribute_type_resolution_crosses_modules(tmp_path):
+    from netsdb_tpu.analysis.callgraph import callgraph
+
+    (tmp_path / "stor.py").write_text(
+        "class Store:\n"
+        "    def add(self, x):\n"
+        "        return x\n")
+    (tmp_path / "srv.py").write_text(
+        "from stor import Store\n\n"
+        "class Srv:\n"
+        "    def __init__(self):\n"
+        "        self.store = Store()\n"
+        "    def go(self):\n"
+        "        return self.store.add(1)\n")
+    project = load_project(paths=[str(tmp_path / "stor.py"),
+                                  str(tmp_path / "srv.py")],
+                           repo=str(tmp_path))
+    graph = callgraph(project)
+    edges = graph.calls[("srv.py", "Srv", "go")]
+    assert (("stor.py", "Store", "add") in
+            {callee for callee, _line in edges})
+
+
+def test_recursion_terminates_with_correct_summary():
+    from netsdb_tpu.analysis.summaries import summaries
+
+    project = load_project(paths=fx("recursive_locks.py"))
+    S = summaries(project)  # must not loop forever
+    helper = next(k for k in S.trans_locks if k[2] == "helper")
+    assert "Walker._mu" in S.trans_locks[helper]
+    # re-entrant same-rank recursion is not a cycle
+    assert run_lint(paths=fx("recursive_locks.py"),
+                    rules=["lock-order"]) == []
+
+
+def test_interprocedural_blocking_across_modules(tmp_path):
+    (tmp_path / "waiter.py").write_text(
+        "def drain(work_queue):\n"
+        "    return work_queue.get()\n")
+    (tmp_path / "holder.py").write_text(
+        "import threading\n"
+        "import waiter\n\n"
+        "state_mu = threading.Lock()\n\n\n"
+        "def pump(q):\n"
+        "    with state_mu:\n"
+        "        return waiter.drain(q)\n")
+    diags = run_lint(paths=[str(tmp_path / "waiter.py"),
+                            str(tmp_path / "holder.py")],
+                     rules=["lock-blocking-call"],
+                     repo=str(tmp_path))
+    assert len(diags) == 1
+    d = diags[0]
+    assert d.path == "holder.py"  # flagged at the HOLDING call site
+    assert "waiter.py:drain" in d.message
+    assert "waiter.py:2" in d.message  # ... naming the blocking line
+    assert "state_mu" in d.message
+
+
+# --- static race rule -------------------------------------------------
+
+def test_known_bad_race_detected_with_roots_named():
+    diags = run_lint(paths=fx("bad_race.py"),
+                     rules=["shared-state-race"])
+    assert len(diags) == 1
+    msg = diags[0].message
+    assert "Pump.processed" in msg
+    assert "_ingest_loop" in msg and "_drain_loop" in msg
+    assert "2 thread roots" in msg
+
+
+def test_race_detected_through_tuple_unpacking(tmp_path):
+    """Review regression: 'self.a, self.b = ...' is a mutation of
+    both attributes — tuple targets must not slip past the rule."""
+    src = open(os.path.join(FIXTURES, "bad_race.py")).read()
+    src = src.replace("self.processed += 1",
+                      "self.processed, other = self.processed + 1, 2")
+    p = tmp_path / "bad_race_tuple.py"
+    p.write_text(src)
+    diags = run_lint(paths=[str(p)], rules=["shared-state-race"],
+                     repo=str(tmp_path))
+    assert len(diags) == 1 and "Pump.processed" in diags[0].message
+
+
+def test_lock_protected_twin_is_clean():
+    assert run_lint(paths=fx("good_race.py"),
+                    rules=["shared-state-race"]) == []
+
+
+def test_race_via_alias_and_partial_roots():
+    diags = run_lint(paths=fx("thread_targets.py"),
+                     rules=["shared-state-race"])
+    assert len(diags) == 2
+    assert all("Loader.batches" in d.message for d in diags)
+
+
+def test_real_tree_race_rule_is_clean():
+    # the acceptance bar: every real finding fixed or suppressed with
+    # a documented reason — regressions land here
+    diags = run_lint(rules=["shared-state-race"])
+    assert diags == [], "\n".join(str(d) for d in diags)
+
+
+def test_real_tree_lock_rules_clean_interprocedurally():
+    diags = run_lint(rules=["lock-order", "lock-blocking-call"])
+    assert diags == [], "\n".join(str(d) for d in diags)
+
+
+# --- witness reconciliation ------------------------------------------
+
+def test_witness_coverage_classifies_edges():
+    from netsdb_tpu.analysis import witnesscov as W
+
+    project = load_project(paths=fx("good_locks.py"))
+    dynamic = [
+        # the fixture's real edge: exercised → covered
+        {"held": "tests/fixtures/analysis/good_locks.py:pool_mu",
+         "acquired": "tests/fixtures/analysis/good_locks.py:index_mu",
+         "sites": ["good_locks.py:14", "good_locks.py:15"],
+         "modes": ["ww"]},
+        # an edge the static graph never derived → blind spot
+        {"held": "Phantom._mu", "acquired": "Phantom._other",
+         "sites": ["x.py:1", "x.py:2"], "modes": ["ww"]},
+    ]
+    report = W.coverage(dynamic, project=project)
+    covered = {tuple(r["edge"]) for r in report["covered"]}
+    assert ("tests/fixtures/analysis/good_locks.py:pool_mu",
+            "tests/fixtures/analysis/good_locks.py:index_mu") in covered
+    unpredicted = {tuple(r["edge"])
+                   for r in report["dynamic_unpredicted"]}
+    assert ("Phantom._mu", "Phantom._other") in unpredicted
+    # the seeded hierarchy is uncovered in this tiny project — that is
+    # a REPORT (untested concurrency), never a failure
+    uncovered = {tuple(r["edge"])
+                 for r in report["static_uncovered"]}
+    assert ("_StoredSet.append_mu", "SetStore._lock") in uncovered
+    assert 0.0 <= report["coverage"] <= 1.0
+    text = W.render(report)
+    assert "untested concurrency" in text
+    assert "static blind spots" in text
+
+
+def test_witness_dump_roundtrip_through_cli(tmp_path, capsys):
+    from netsdb_tpu.cli import main
+    from netsdb_tpu.utils.locks import LockWitness, witness_scope
+
+    with witness_scope() as w:
+        # record SetStore._lock -> PagedObjects.rw (a seeded edge)
+        w.note_acquire("SetStore._lock", "store.py:100")
+        w.note_acquire("PagedObjects.rw", "paged.py:50", mode="r")
+        w.note_release("PagedObjects.rw")
+        w.note_release("SetStore._lock")
+        dump = tmp_path / "witness.json"
+        w.dump(str(dump))
+    rc = main(["lint", "--witness-coverage", str(dump)])
+    out = capsys.readouterr().out
+    assert rc == 0  # a report, not a gate
+    assert "witness coverage:" in out
+    assert "untested concurrency" in out  # plenty of unexercised edges
+
+    rc = main(["lint", "--witness-coverage", str(dump), "--json"])
+    payload = json.loads(capsys.readouterr().out)
+    covered = {tuple(r["edge"]) for r in payload["covered"]}
+    assert ("SetStore._lock", "PagedObjects.rw") in covered
+
+
+def test_witness_export_edges_shape():
+    from netsdb_tpu.utils.locks import witness_scope
+
+    with witness_scope() as w:
+        w.note_acquire("A._mu", "a.py:1")
+        w.note_acquire("B._mu", "b.py:2")
+        w.note_release("B._mu")
+        w.note_release("A._mu")
+        edges = w.export_edges()
+    assert edges == [{"held": "A._mu", "acquired": "B._mu",
+                      "sites": ["a.py:1", "b.py:2"],
+                      "modes": ["ww"]}]
+
+
+# --- metrics export ---------------------------------------------------
+
+def test_analysis_gauges_exported_on_lint_run():
+    from netsdb_tpu.obs.metrics import registry
+
+    run_lint(rules=["lock-order", "shared-state-race"])
+    snap = registry().snapshot()
+    gauges = snap.get("gauges") or {}
+    assert gauges.get("analysis.callgraph_edges", 0) > 100
+    assert gauges.get("analysis.race_findings") == 0

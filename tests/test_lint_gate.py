@@ -1,17 +1,21 @@
 """The tier-1 lint gate: ``cli lint`` must run CLEAN over the whole
 package tree — every rule passes or carries an inline, documented
-suppression — inside a wall-clock budget, so the gate is cheap enough
-that no future PR is tempted to drop it."""
+suppression (or, transitionally, a baseline entry) — inside a
+wall-clock budget, so the gate is cheap enough that no future PR is
+tempted to drop it."""
 
 import json
+import os
 import time
 
 
 def test_cli_lint_clean_on_full_tree_within_budget(capsys):
     from netsdb_tpu.cli import main
+    from netsdb_tpu.analysis.lint import REPO
 
+    baseline = os.path.join(REPO, "docs", "lint_baseline.json")
     t0 = time.perf_counter()
-    rc = main(["lint", "--json"])
+    rc = main(["lint", "--json", "--baseline", baseline])
     elapsed = time.perf_counter() - t0
     out = capsys.readouterr().out
     diags = json.loads(out)
@@ -22,6 +26,24 @@ def test_cli_lint_clean_on_full_tree_within_budget(capsys):
     assert elapsed < 10.0, \
         f"full-tree lint took {elapsed:.1f}s — over the 10s budget " \
         f"the gate promises CI"
+
+    # the parse-once cache (keyed on path/mtime/size) must make a
+    # same-process re-run cheap — the conftest sessionfinish re-runs
+    # the gate on the warm cache, and the interprocedural rules only
+    # stay inside the 10 s budget as the tree grows because parses
+    # are shared (no ratio vs the first run: earlier tests in the
+    # same process may already have warmed the cache)
+    from netsdb_tpu.analysis import lint as L
+
+    t1 = time.perf_counter()
+    rc = main(["lint", "--json", "--baseline", baseline])
+    warm = time.perf_counter() - t1
+    capsys.readouterr()
+    assert rc == 0
+    assert warm < 6.0, \
+        f"warm-cache lint re-run took {warm:.1f}s — the parse-once " \
+        f"cache is not being hit"
+    assert len(L._MODULE_CACHE) >= 100  # the tree is actually cached
 
 
 def test_lint_covers_the_whole_package():
@@ -34,6 +56,32 @@ def test_lint_covers_the_whole_package():
                      "netsdb_tpu/serve/server.py",
                      "netsdb_tpu/plan/executor.py",
                      "netsdb_tpu/obs/metrics.py",
-                     "netsdb_tpu/analysis/lint.py"):
+                     "netsdb_tpu/analysis/lint.py",
+                     "netsdb_tpu/analysis/callgraph.py",
+                     "netsdb_tpu/analysis/summaries.py"):
         assert expected in rels
     assert all(m.parse_error is None for m in project.modules)
+
+
+def test_callgraph_resolves_the_layers_that_matter():
+    # the interprocedural promise: serve/ calls resolve into storage/
+    # (the attribute-type edge) — if this breaks, cross-module rules
+    # silently degrade to the PR 8 per-module view
+    from netsdb_tpu.analysis.callgraph import callgraph
+    from netsdb_tpu.analysis.lint import load_project
+
+    graph = callgraph(load_project())
+    assert graph.edge_count() > 500
+    serve_to_storage = [
+        (caller, callee)
+        for caller, edges in graph.calls.items()
+        if caller[0].startswith("netsdb_tpu/serve/")
+        for callee, _line in edges
+        if callee[0].startswith("netsdb_tpu/storage/")]
+    assert serve_to_storage, \
+        "no serve/ -> storage/ call edges resolved"
+    # the thread population the race rule reasons over: the serve
+    # accept loop and connection handlers at minimum
+    root_names = {k[2] for k in graph.thread_roots}
+    assert "_accept_loop" in root_names
+    assert "_serve_connection" in root_names
