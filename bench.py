@@ -89,7 +89,8 @@ def _cpu_reference_rows_per_sec() -> float:
 # gated (compare_runs reports "not compared").
 HEADLINE_METRICS = {"ff_inference_rows_per_sec_per_chip": "higher",
                     "serve_sched_p99_speedup": "higher",
-                    "plan_fusion_speedup": "higher"}
+                    "plan_fusion_speedup": "higher",
+                    "serve_scaleout_throughput_x": "higher"}
 REGRESSION_PCT = 15.0
 
 
@@ -294,6 +295,32 @@ def main():
         else:
             print(f"-- fusion A/B produced no speedup figure; metric "
                   f"omitted: {json.dumps(fz)}", file=sys.stderr)
+    if "--scale" in sys.argv:
+        # horizontal scale-out (serve_bench --scale): paired 1 vs
+        # 4-daemon arm over the q01-style paged workload — aggregate
+        # routed-ingest MB/s and cold scatter-gather QPS; the headline
+        # is the MIN of the two scale factors (both must scale), and
+        # the byte-equality checks ride as detail. CPU-container
+        # caveat: all daemons share one machine's cores, so the number
+        # is a lower bound on a real multi-host pool.
+        from netsdb_tpu.workloads.serve_bench import run_scaleout_bench
+
+        sc = run_scaleout_bench()
+        if sc.get("scaleout_throughput_x") \
+                and sc.get("q01_byte_equal") \
+                and sc.get("join_byte_equal"):
+            records.append({
+                "metric": "serve_scaleout_throughput_x",
+                "value": sc["scaleout_throughput_x"],
+                "unit": "x (min of ingest MB/s and cold-query QPS "
+                        "scale, 4 daemons vs 1)",
+                "detail": dict(sc),
+            })
+        else:
+            # a broken arm (or an equality failure — which is a BUG,
+            # not noise) omits the record rather than snapshotting it
+            print(f"-- scale arm unusable; metric omitted: "
+                  f"{json.dumps(sc)}", file=sys.stderr)
     # one JSON line: a single record stays the historical shape; with
     # --sched the line is a list (compare_runs accepts both)
     print(json.dumps(records if len(records) > 1 else result))

@@ -656,12 +656,23 @@ def _cmd_serve(args) -> int:
     from netsdb_tpu.config import Configuration, DEFAULT_CONFIG
     from netsdb_tpu.serve.server import run_daemon
 
-    config = Configuration(root_dir=args.root) if args.root else DEFAULT_CONFIG
+    overrides = {}
+    if args.root:
+        overrides["root_dir"] = args.root
+    if getattr(args, "device_cache_mb", None) is not None:
+        overrides["device_cache_bytes"] = args.device_cache_mb << 20
+    if getattr(args, "page_pool_mb", None) is not None:
+        overrides["page_pool_bytes"] = args.page_pool_mb << 20
+    if getattr(args, "page_kb", None) is not None:
+        overrides["page_size_bytes"] = args.page_kb << 10
+    config = Configuration(**overrides) if overrides else DEFAULT_CONFIG
     followers = ([a.strip() for a in args.followers.split(",") if a.strip()]
                  if getattr(args, "followers", None) else None)
+    workers = ([a.strip() for a in args.workers.split(",") if a.strip()]
+               if getattr(args, "workers", None) else None)
     return run_daemon(config, host=args.host, port=args.port,
                       token=args.token, max_jobs=args.max_jobs,
-                      followers=followers)
+                      followers=followers, workers=workers)
 
 
 def _print_obs(stats, traces) -> None:
@@ -1049,7 +1060,11 @@ def _cmd_lint(args) -> int:
 
 
 def _cmd_serve_bench(args) -> int:
-    if getattr(args, "scheduler", False):
+    if getattr(args, "scale", False):
+        from netsdb_tpu.workloads.serve_bench import run_scaleout_bench
+
+        out = run_scaleout_bench(daemons=getattr(args, "daemons", 4))
+    elif getattr(args, "scheduler", False):
         from netsdb_tpu.workloads.serve_bench import run_scheduler_bench
 
         out = run_scheduler_bench(
@@ -1183,6 +1198,20 @@ def main(argv=None) -> int:
                    help="comma-separated worker daemon addresses: fan "
                         "every mutating/job frame out for multi-host "
                         "SPMD (init jax.distributed in every process)")
+    p.add_argument("--workers", default=None,
+                   help="comma-separated shard daemon addresses "
+                        "forming this leader's partitioned worker "
+                        "pool (horizontal scale-out: sets created "
+                        "with placement='hash'/'range' partition "
+                        "across the pool)")
+    p.add_argument("--device-cache-mb", type=int, default=None,
+                   help="override config.device_cache_bytes (MB); "
+                        "0 disables the device cache")
+    p.add_argument("--page-pool-mb", type=int, default=None,
+                   help="override config.page_pool_bytes (MB) — the "
+                        "paged-set arena cap")
+    p.add_argument("--page-kb", type=int, default=None,
+                   help="override config.page_size_bytes (KB)")
     p.add_argument("--platform", default=None,
                    help="force a jax platform (e.g. cpu) — env overrides "
                    "are ignored by the ambient plugin, only jax.config "
@@ -1215,6 +1244,14 @@ def main(argv=None) -> int:
                         "concurrent identical cold EXECUTEs, "
                         "scheduler on vs off (executions run, "
                         "devcache installs, coalesce hits, p50/p99)")
+    p.add_argument("--scale", action="store_true",
+                   help="horizontal scale-out instead: paired 1 vs N "
+                        "daemon arm — aggregate routed-ingest MB/s, "
+                        "cold scatter-gather q01 QPS and "
+                        "byte-equality incl. a distributed-shuffle "
+                        "join")
+    p.add_argument("--daemons", type=int, default=4,
+                   help="pool size for --scale (leader + N-1 shards)")
 
     p = sub.add_parser("obs",
                        help="observability readout of a running daemon: "

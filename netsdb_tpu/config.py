@@ -189,6 +189,25 @@ class Configuration:
     # before proceeding cold anyway (correctness never depends on the
     # wait — it is purely a thrash-avoidance window)
     sched_affinity_wait_s: float = 30.0
+    # --- sharded worker pool (serve/placement.py + serve/shard.py) ---
+    # byte bound on the leader's handoff buffers: ingest routed to a
+    # DEGRADED shard slot buffers at the leader (typed retryable
+    # refusal beyond the bound) and drains — only those pages — when
+    # the shard readmits. The shard-scoped resync's memory ceiling.
+    shard_handoff_bytes: int = 256 * 1024 * 1024
+    # --- scheduler feedback loop (serve/sched/) ---
+    # seed lane weights (and per-lane quotas, when sched_lane_quota is
+    # set) from observed behavior instead of the static sched_lanes
+    # table: the per-(client, set) attribution ledger supplies each
+    # lane's request/chunk/staged-byte volumes, the OperatorLedger's
+    # cost rows supply the seconds-per-chunk conversion, and lanes
+    # whose historical cost-per-request is LIGHT earn proportionally
+    # more weight (clamped 0.25x-4x; the documented formula in
+    # serve/sched/feedback.py, pinned by test). Re-seeded every
+    # sched_feedback_every admissions. Opt-in: static lanes stay the
+    # default.
+    sched_feedback: bool = False
+    sched_feedback_every: int = 64
     # --- concurrency correctness (netsdb_tpu/analysis/ + utils/locks) ---
     # lockdep-style runtime lock-order witness: on, every TrackedLock/
     # named-RWLock acquisition records rank edges (held -> acquired)

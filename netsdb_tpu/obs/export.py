@@ -73,6 +73,36 @@ def _catalog() -> Dict[str, Tuple[str, str]]:
         ("serve.client.trace_ship_dropped", "client trace profiles "
                                             "dropped on a full ship "
                                             "queue"),
+        ("serve.client.placement_refreshes", "placement-map re-fetches "
+                                             "after a stale-map "
+                                             "rejection"),
+        ("serve.client.routed_ingests", "logical ingests routed "
+                                        "directly to owning shards"),
+        ("shard.scatter_queries", "queries executed scatter-gather "
+                                  "across the shard pool by this "
+                                  "coordinator"),
+        ("shard.subplans", "pushed subplans executed over this "
+                           "daemon's local pages"),
+        ("shard.partials_merged", "per-slot partial results merged by "
+                                  "the coordinator (all-or-nothing)"),
+        ("shard.shuffle_parts", "distributed-shuffle buckets received "
+                                "from peer shards"),
+        ("shard.shuffle_bytes", "bytes received over the distributed "
+                                "shuffle (out-of-band v3 segments)"),
+        ("shard.epoch_rejects", "frames rejected for a stale placement "
+                                "epoch (typed PlacementStale)"),
+        ("shard.handoff_batches", "ingest batches buffered for a "
+                                  "degraded shard slot at the leader"),
+        ("shard.handoff_drained", "buffered handoff batches shipped to "
+                                  "a readmitted shard (its own pages "
+                                  "only)"),
+        ("shard.evictions", "shard daemons degraded out of the pool "
+                            "(slots flip to handoff, epochs bump)"),
+        ("shard.readmits", "shard daemons readmitted after a "
+                           "shard-scoped resync"),
+        ("sched.feedback_reseeds", "lane weight/quota reseeds applied "
+                                   "from the attribution + operator "
+                                   "ledgers (sched_feedback)"),
         ("devcache.lookups", "device block cache lookups (hits+misses)"),
         ("devcache.hits", "device block cache hits"),
         ("devcache.misses", "device block cache misses"),

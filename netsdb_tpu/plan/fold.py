@@ -76,6 +76,21 @@ class FoldSpec:
     # carry only the tuple attributes the TCAP computation lists.
     # None = carry everything.
     probe_columns: Optional[Tuple[str, ...]] = None
+    # state_merge(state_a, state_b) -> state: combines the FINAL-pass
+    # states of two independent row partitions of the source — the
+    # declaration that makes the fold SCATTERABLE across a sharded
+    # worker pool (serve-level scatter-gather: each shard folds its
+    # local pages, the coordinator merges the bounded partial states
+    # in slot order and runs ``finalize`` once). Contract: the merge
+    # must be associative over row partitions, and ``finalize`` may
+    # read only the source's SCHEMA surface (``src.dicts`` /
+    # ``src.num_rows``) — the coordinator holds no local pages, so it
+    # passes a schema proxy, never a table. Float accumulators merge
+    # in a different addition order than the single-stream fold —
+    # exact for integer-valued states, last-ulp reassociation for
+    # floats (same caveat class as XLA reduction reordering). None =
+    # not scatterable; queries over sharded sets then refuse typed.
+    state_merge: Optional[Callable] = None
 
     def whole(self, table: Any, *resident: Any) -> Any:
         """Whole-table evaluation — the resident-set path. Runs the
@@ -141,11 +156,22 @@ def single_pass(init: Callable, step: Callable,
                 finalize: Callable, merge: Optional[Callable] = None,
                 probe_key: Optional[str] = None,
                 build_key: Optional[str] = None,
-                probe_columns: Optional[Tuple[str, ...]] = None
-                ) -> FoldSpec:
+                probe_columns: Optional[Tuple[str, ...]] = None,
+                state_merge: Optional[Callable] = None) -> FoldSpec:
     return FoldSpec(((init, step),), finalize, merge,
                     probe_key=probe_key, build_key=build_key,
-                    probe_columns=probe_columns)
+                    probe_columns=probe_columns,
+                    state_merge=state_merge)
+
+
+def tree_add_states(a: Any, b: Any) -> Any:
+    """Elementwise-add ``state_merge`` for folds whose state is a
+    pytree of additive accumulators (sums/counts/histograms — the q01
+    family). Associative by construction; see the float-reassociation
+    caveat on :attr:`FoldSpec.state_merge`."""
+    import jax
+
+    return jax.tree_util.tree_map(lambda x, y: x + y, a, b)
 
 
 def flatten_resident(values: Tuple[Any, ...]) -> Tuple[Any, ...]:

@@ -1,0 +1,268 @@
+"""Scatter-gather decomposition of a Computation DAG over sharded sets.
+
+The reference's master never executes a pipeline itself: TCAPAnalyzer
+cuts the plan into JobStages and ``QuerySchedulerServer`` schedules
+each stage across the workers holding the set's partitions, merging
+bounded aggregation state at the master
+(``QuerySchedulerServer.cc:216-330``; the partial-merge shape also
+follows *Large Scale Distributed Linear Algebra With TPUs*, arXiv
+2112.09017 — each worker computes over only its panel and the
+coordinator merges bounded partials). This module is that analysis
+for the serve layer's sharded worker pool: given a sink DAG and a
+predicate "is this set partitioned?", it recognizes the pushable
+shapes and produces a :class:`ScatterSpec` the coordinator
+(``serve/shard.py``) executes:
+
+* ``fold_state`` — ``Scan(sharded) → [rowwise chain] → Apply(fold)``
+  where the single-pass fold declares ``state_merge``: every shard
+  folds its LOCAL pages to the bounded partial state (running the
+  shipped subplan through its own executor, so staging, the devcache
+  and PR 10's fusion regions all apply per shard), the coordinator
+  merges states in slot order and runs ``finalize`` once. The q01/q06
+  family.
+* ``group_partial`` — ``Scan(sharded) → {Filter|Flatten|rowwise
+  Apply}* → Aggregate(key, value, combine)``: shards return partial
+  group dicts, the coordinator merges them with the node's own
+  ``combine`` (associative by the Aggregate contract).
+* ``shuffle_join`` — ``Join(Scan(sharded), Scan(sharded), fold with
+  probe_key/build_key/merge)``: the grace-hash partition step becomes
+  a genuine DISTRIBUTED shuffle — every shard hash-partitions both
+  local sides by the join key and ships bucket *j* to the daemon
+  owning slot *j* over the v3 vectored wire, then folds its own
+  bucket; the coordinator merges the per-slot outputs with the fold's
+  declared ``merge``. Keys co-locate whole, so no group is ever split
+  across partials.
+
+Anything else touching a sharded set is refused typed (the
+coordinator raises; mirrored/local sets are untouched by all of
+this). Determinism: shards are always visited in slot order and every
+merge is a left fold over that order, so repeated runs merge in one
+canonical order.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Callable, Dict, List, Optional, Tuple
+
+from netsdb_tpu.plan.computations import (
+    Aggregate,
+    Apply,
+    Computation,
+    Filter,
+    Join,
+    MultiApply,
+    ScanSet,
+    WriteSet,
+)
+from netsdb_tpu.plan.fold import FoldSpec
+
+
+@dataclasses.dataclass
+class ScatterSpec:
+    """One sink's scatter decomposition (see module docstring)."""
+
+    kind: str  # "fold_state" | "group_partial" | "shuffle_join"
+    sink: WriteSet
+    node: Computation
+    #: sharded (db, set) leaves the spec scans, in deterministic order
+    scan_sets: Tuple[Tuple[str, str], ...]
+    fold: Optional[FoldSpec] = None
+    #: shuffle_join: (db, set) of the streamed/probe and build sides
+    probe: Optional[Tuple[str, str]] = None
+    build: Optional[Tuple[str, str]] = None
+
+
+#: node types that are row-decomposable over object/table partitions —
+#: a chain of these between the sharded scan and the aggregating node
+#: ships to the shards unchanged
+def _rowwise_chain_ok(node: Computation) -> bool:
+    if isinstance(node, (Filter, MultiApply)):
+        return True
+    return isinstance(node, Apply) and getattr(node, "rowwise", False) \
+        and node.fold is None
+
+
+def _scan_leaf(node: Computation) -> Optional[ScanSet]:
+    """Follow a pure rowwise chain down to its scan leaf (None when
+    the chain holds anything else)."""
+    while not isinstance(node, ScanSet):
+        if not _rowwise_chain_ok(node) or len(node.inputs) != 1:
+            return None
+        node = node.inputs[0]
+    return node
+
+
+def sharded_scan_sets(sinks, is_sharded: Callable[[str, str], bool]
+                      ) -> List[Tuple[str, str]]:
+    """Every sharded (db, set) any sink's DAG scans, sorted."""
+    out = set()
+    seen = set()
+    stack = list(sinks)
+    while stack:
+        node = stack.pop()
+        if id(node) in seen:
+            continue
+        seen.add(id(node))
+        if isinstance(node, ScanSet) and is_sharded(node.db,
+                                                    node.set_name):
+            out.add((node.db, node.set_name))
+        stack.extend(node.inputs)
+    return sorted(out)
+
+
+def analyze_sinks(sinks, is_sharded: Callable[[str, str], bool]
+                  ) -> Optional[ScatterSpec]:
+    """The scatter decomposition of ``sinks``, or None when the DAG
+    either touches no sharded set (callers then run the unchanged
+    local path) or touches one in a shape this module cannot push
+    (callers raise typed — a sharded set's pages live only on its
+    shards, so there is no local fallback)."""
+    touched = sharded_scan_sets(sinks, is_sharded)
+    if not touched:
+        return None
+    if len(sinks) != 1:
+        return None
+    sink = sinks[0]
+    if not isinstance(sink, WriteSet):
+        return None
+    node = sink.inputs[0]
+
+    # shuffle_join: Join over two sharded scans with a grace-capable
+    # fold (declared keys + output merge)
+    if isinstance(node, Join) and node.fold is not None \
+            and node.fold.probe_key and node.fold.build_key \
+            and node.fold.merge is not None \
+            and len(node.fold.passes) == 1:
+        probe_in = node.inputs[node.fold_src]
+        build_in = node.inputs[1 - node.fold_src]
+        if isinstance(probe_in, ScanSet) and isinstance(build_in, ScanSet) \
+                and is_sharded(probe_in.db, probe_in.set_name) \
+                and is_sharded(build_in.db, build_in.set_name):
+            return ScatterSpec(
+                kind="shuffle_join", sink=sink, node=node,
+                scan_sets=tuple(touched), fold=node.fold,
+                probe=(probe_in.db, probe_in.set_name),
+                build=(build_in.db, build_in.set_name))
+
+    # fold_state: single-pass fold with a declared state_merge over a
+    # (possibly rowwise-prefixed) sharded scan
+    if isinstance(node, Apply) and node.fold is not None \
+            and node.fold.state_merge is not None \
+            and len(node.fold.passes) == 1:
+        scan = _scan_leaf(node.inputs[0])
+        if scan is not None and is_sharded(scan.db, scan.set_name):
+            return ScatterSpec(kind="fold_state", sink=sink, node=node,
+                               scan_sets=tuple(touched), fold=node.fold)
+
+    # group_partial: dict group-by whose combine IS the partial merge
+    if isinstance(node, Aggregate) and node.fn is None \
+            and node.combine is not None:
+        scan = _scan_leaf(node.inputs[0])
+        if scan is not None and is_sharded(scan.db, scan.set_name):
+            return ScatterSpec(kind="group_partial", sink=sink,
+                               node=node, scan_sets=tuple(touched))
+
+    return None
+
+
+# --- shard-side sink construction ------------------------------------
+
+def _state_finalize(state, src, *resident):
+    """The partial sink's finalize: return the fold state itself (the
+    bounded partial the coordinator merges)."""
+    del src, resident
+    return state
+
+
+def _max_node_id(root: Computation) -> int:
+    out = root.node_id
+    seen = set()
+    stack = [root]
+    while stack:
+        node = stack.pop()
+        if id(node) in seen:
+            continue
+        seen.add(id(node))
+        out = max(out, node.node_id)
+        stack.extend(node.inputs)
+    return out
+
+
+def partial_sink(spec: ScatterSpec) -> WriteSet:
+    """The sink a shard executes for a ``fold_state``/``group_partial``
+    spec: identical plan, but a fold's finalize is replaced with the
+    state-returning stub (distinct label — the jit cache must never
+    alias the partial step with the full fold's).
+
+    The wrapper nodes take ids ABOVE the decoded DAG's maximum: the
+    original nodes carry the CLIENT's process-local ids, and a
+    coordinator-minted id colliding with one of them would corrupt
+    the id-keyed topo sort (a false cycle — the cross-process hazard
+    the in-process tests can never see)."""
+    node = spec.node
+    if spec.kind == "group_partial":
+        # the Aggregate chain runs unchanged over the shard's local
+        # rows; its dict output IS the partial
+        sink = WriteSet(node, spec.sink.db, "__scatter_partial__")
+        sink.node_id = _max_node_id(node) + 1
+        sink.output_name = f"{sink.op_kind}_{sink.node_id}"
+        return sink
+    fold = spec.fold
+    pf = FoldSpec(fold.passes, _state_finalize,
+                  probe_columns=fold.probe_columns)
+    partial = Apply(node.inputs[0], fold=pf,
+                    label=f"{node.label}::partial",
+                    traceable=node.traceable)
+    partial.node_id = _max_node_id(node.inputs[0]) + 1
+    partial.output_name = f"{partial.op_kind}_{partial.node_id}"
+    sink = WriteSet(partial, spec.sink.db, "__scatter_partial__")
+    sink.node_id = partial.node_id + 1
+    sink.output_name = f"{sink.op_kind}_{sink.node_id}"
+    return sink
+
+
+# --- coordinator-side merges -----------------------------------------
+
+class SchemaProxy:
+    """What a scatterable fold's ``finalize`` may read of its source:
+    the schema surface (dictionaries + total row count), never pages —
+    the coordinator holds none."""
+
+    __slots__ = ("dicts", "num_rows")
+
+    def __init__(self, dicts: Dict[str, list], num_rows: int):
+        self.dicts = dict(dicts)
+        self.num_rows = int(num_rows)
+
+
+def merge_fold_states(fold: FoldSpec, states: List[Any],
+                      dicts: Dict[str, list], num_rows: int) -> Any:
+    """Left-fold the per-slot states in slot order, then finalize over
+    the schema proxy — ONE canonical merge order, so repeated runs
+    are bit-identical to each other."""
+    merged = states[0]
+    for s in states[1:]:
+        merged = fold.state_merge(merged, s)
+    return fold.finalize(merged, SchemaProxy(dicts, num_rows))
+
+
+def merge_group_dicts(node: Aggregate, parts: List[dict]) -> dict:
+    """Merge per-slot group dicts with the Aggregate's own combine
+    (slot order; first occurrence seeds the key, like the single-node
+    fold's first item)."""
+    out: dict = {}
+    for part in parts:
+        for k, v in part.items():
+            out[k] = node.combine(out[k], v) if k in out else v
+    return out
+
+
+def merge_join_outputs(fold: FoldSpec, parts: List[Any]) -> Any:
+    """Merge per-slot shuffle-join outputs with the fold's declared
+    output merge (the grace-hash partition-merge rule, applied across
+    daemons instead of arena spill partitions)."""
+    merged = parts[0]
+    for p in parts[1:]:
+        merged = fold.merge(merged, p)
+    return merged

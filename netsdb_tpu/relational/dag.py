@@ -99,8 +99,15 @@ def q01_sink(db: str, lineitem_set: str = "lineitem",
                    "l_linestatus": src.dicts["l_linestatus"]},
             valid=counts > 0)
 
+    from netsdb_tpu.plan.fold import tree_add_states
+
+    # state = per-group (sums, counts) — additive over row partitions,
+    # so the fold scatters across a sharded worker pool (each shard
+    # folds its local pages, the coordinator tree-adds the states and
+    # finalizes once; float sums reassociate — see FoldSpec.state_merge)
     return WriteSet(Apply(ScanSet(db, lineitem_set),
-                          fold=FoldSpec(base.passes, fin),
+                          fold=FoldSpec(base.passes, fin,
+                                        state_merge=tree_add_states),
                           label=f"cq01:{delta}"),
                     db, output_set)
 
@@ -120,8 +127,11 @@ def q06_sink(db: str, lineitem_set: str = "lineitem",
     def fin(state, src) -> ColumnTable:
         return ColumnTable(cols={"revenue": state[None]})
 
+    from netsdb_tpu.plan.fold import tree_add_states
+
     return WriteSet(Apply(ScanSet(db, lineitem_set),
-                          fold=FoldSpec(base.passes, fin),
+                          fold=FoldSpec(base.passes, fin,
+                                        state_merge=tree_add_states),
                           label=f"cq06:{a}:{b}:{disc}:{qty}"),
                     db, output_set)
 

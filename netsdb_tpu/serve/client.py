@@ -37,10 +37,12 @@ from netsdb_tpu.serve.errors import (  # noqa: F401 — re-exported API
     DeadlineExceededError,
     FollowerDegradedError,
     LaneSaturatedError,
+    PlacementStaleError,
     ProtocolVersionError,
     RemoteError,
     RemoteTimeoutError,
     RetryableRemoteError,
+    ShardUnavailableError,
     classify_remote,
 )
 from netsdb_tpu.serve.protocol import (
@@ -50,8 +52,10 @@ from netsdb_tpu.serve.protocol import (
     IDEMPOTENCY_KEY,
     LANE_KEY,
     MUTATING_TYPES,
+    PLACEMENT_EPOCH_KEY,
     PROTO_VERSION,
     QUERY_ID_KEY,
+    SHARD_SLOT_KEY,
     MsgType,
     ProtocolError,
     recv_frame,
@@ -271,6 +275,19 @@ class RemoteClient:
         # wait on the lock (self-deadlock) nor write to the streaming
         # socket (frame corruption); it gets a one-shot side connection
         self._stream_owner: Optional[int] = None
+        # placement-aware routing state: the daemon's sharded-set map
+        # (shipped in the handshake ONLY when sharded sets exist —
+        # un-sharded clients never pay a frame), per-shard connection
+        # cache, and the stale-map refresh guard. A PlacementStale
+        # rejection refreshes the cache between retry attempts.
+        self._placement_mu = TrackedLock("RemoteClient._placement_mu")
+        self._placement_wire: Optional[Dict[str, Any]] = None
+        self._shard_clients: Dict[str, "RemoteClient"] = {}
+        # serializes the PLACEMENT fetch: concurrent refreshers wait
+        # for the in-flight result; owner thread id breaks re-entry
+        self._placement_fetch_mu = TrackedLock(
+            "RemoteClient._placement_fetch_mu")
+        self._refreshing_placement: Optional[int] = None
         self._connect()
 
     # --- transport ----------------------------------------------------
@@ -310,6 +327,12 @@ class RemoteClient:
                     f"daemon at {host}:{port} speaks wire format "
                     f"v{reply.get('version')}; this client is "
                     f"v{PROTO_VERSION} — mixed versions are refused")
+            if isinstance(reply.get("placement"), dict):
+                # v3 handshake placement shipping: cache the sharded-
+                # set map so ingest routes to owning shards without an
+                # extra fetch
+                with self._placement_mu:
+                    self._placement_wire = reply["placement"]
             s.settimeout(self._timeout)  # steady-state I/O bound
         except BaseException:
             s.close()
@@ -428,6 +451,16 @@ class RemoteClient:
                 failure = ConnectionLostError(type(e).__name__, str(e))
             if attempt >= policy.max_attempts:
                 raise failure
+            if isinstance(failure, PlacementStaleError):
+                # the frame rode an out-of-date placement map: refresh
+                # the cache and retry IMMEDIATELY — the rejection is
+                # deterministic (not congestion), so exponential
+                # backoff would only delay the re-route
+                self._refresh_placement()
+                attempt += 1
+                self.total_retries += 1
+                obs.REGISTRY.counter("serve.client.retries").inc()
+                continue
             delay = policy.backoff_s(attempt, self._rng)
             hint = getattr(failure, "retry_after_s", None)
             if hint is not None and hint > 0:
@@ -641,7 +674,8 @@ class RemoteClient:
         return reply
 
     def _bulk_request(self, op: MsgType, meta: dict, chunk_fn,
-                      deadline_s: Optional[float] = None) -> Any:
+                      deadline_s: Optional[float] = None,
+                      token: Optional[str] = None) -> Any:
         """One LOGICAL bulk ingest: stream ``chunk_fn()``'s chunks under
         the windowed-ack protocol, retrying the whole conversation on
         retryable failures under the client's :class:`RetryPolicy`.
@@ -651,8 +685,11 @@ class RemoteClient:
         after a lost COMMIT reply replays the cached result instead of
         double-applying. From a thread that is mid-stream on the main
         connection the whole conversation rides a one-shot side
-        connection (same rule as nested plain requests)."""
-        token = uuid.uuid4().hex
+        connection (same rule as nested plain requests). ``token``
+        overrides the minted idempotency token — routed shard ingest
+        passes its slot-stable token so retries across placement
+        refreshes stay at-most-once."""
+        token = token or uuid.uuid4().hex
         begin = {"op": int(op), "meta": meta, IDEMPOTENCY_KEY: token}
         if self.client_id is not None:
             begin[CLIENT_ID_KEY] = str(self.client_id)
@@ -828,6 +865,11 @@ class RemoteClient:
                 pass
             if t is not None:
                 t.join(timeout=2.0)
+        with self._placement_mu:
+            shard_clients = list(self._shard_clients.values())
+            self._shard_clients.clear()
+        for sc in shard_clients:
+            sc.close()
         with self._lock:
             self._drop_connection()
 
@@ -869,11 +911,23 @@ class RemoteClient:
         daemon's page arena (out-of-core as a set property)."""
         if placement is not None and hasattr(placement, "to_meta"):
             placement = placement.to_meta()
-        self._request(MsgType.CREATE_SET, {
+        reply = self._request(MsgType.CREATE_SET, {
             "db": db, "set": set_name, "type_name": type_name,
             "persistence": persistence, "eviction": eviction,
             "partition_lambda": partition_lambda,
             "placement": placement, "storage": storage})
+        entry = reply.get("placement") if isinstance(reply, dict) \
+            else None
+        if isinstance(entry, dict):
+            # a SHARDED create returns its placement entry — cache it
+            # now so the very first ingest routes instead of paying a
+            # stale-map rejection round-trip
+            with self._placement_mu:
+                wire = self._placement_wire or {"epoch": 0, "sets": {}}
+                wire.setdefault("sets", {})[f"{db}:{set_name}"] = entry
+                wire["epoch"] = max(int(wire.get("epoch") or 0),
+                                    int(entry.get("epoch") or 0))
+                self._placement_wire = wire
         return RemoteIdent(db, set_name)
 
     def remove_set(self, db: str, set_name: str) -> None:
@@ -905,6 +959,221 @@ class RemoteClient:
         self._request(MsgType.REGISTER_TYPE,
                       {"type_name": type_name, "entry_point": entry_point,
                        "source": source})
+
+    # --- placement-aware routing (sharded worker pools) ---------------
+    def _refresh_placement(self) -> None:
+        """Re-fetch the daemon's placement map (best-effort: a refresh
+        failure leaves the old cache — the next routed attempt then
+        rejects typed again and retries). Concurrent callers WAIT for
+        the in-flight fetch and use its result (returning immediately
+        would hand them the known-stale map for another doomed
+        round); same-thread re-entry (the PLACEMENT request's own
+        retry path) is a no-op."""
+        me = threading.get_ident()
+        if self._refreshing_placement == me:
+            return
+        if not self._placement_fetch_mu.acquire(blocking=False):
+            # another thread is fetching: park until ITS result lands
+            self._placement_fetch_mu.acquire()
+            self._placement_fetch_mu.release()
+            return
+        self._refreshing_placement = me
+        try:
+            wire = self._request(MsgType.PLACEMENT, {})
+            with self._placement_mu:
+                self._placement_wire = wire
+            obs.REGISTRY.counter(
+                "serve.client.placement_refreshes").inc()
+        except Exception as e:  # noqa: BLE001 — best-effort by contract
+            del e
+        finally:
+            self._refreshing_placement = None
+            self._placement_fetch_mu.release()
+
+    def placement_map(self) -> Optional[Dict[str, Any]]:
+        """The cached placement map (tests/tooling probe)."""
+        with self._placement_mu:
+            return self._placement_wire
+
+    def _placement_entry(self, db: str, set_name: str,
+                         refresh: bool = False) -> Optional[Dict]:
+        """One set's shard entry from the CACHED map — no wire traffic
+        unless ``refresh`` (the default path stays frame-identical for
+        clients of un-sharded daemons, whose cache is None)."""
+        from netsdb_tpu.serve.placement import PlacementMap
+
+        if refresh:
+            self._refresh_placement()
+        with self._placement_mu:
+            wire = self._placement_wire
+        if not wire:
+            return None
+        return PlacementMap.entry_from_wire(wire, db, set_name)
+
+    def _shard_client(self, addr: str) -> "RemoteClient":
+        """Cached direct connection to one shard daemon. Single
+        attempt per request — the ROUTED retry loop owns retries (it
+        must refresh the map between attempts, which a nested
+        exponential retry would just delay)."""
+        with self._placement_mu:
+            sc = self._shard_clients.get(addr)
+        if sc is not None:
+            return sc
+        sc = RemoteClient(addr, token=self.token, timeout=self._timeout,
+                          retry=RetryPolicy(max_attempts=1),
+                          connect_timeout=self._connect_timeout,
+                          ingest_window=self.ingest_window,
+                          ingest_chunk_bytes=self.ingest_chunk_bytes,
+                          client_id=self.client_id, lane=self.lane,
+                          ship_traces=False)
+        with self._placement_mu:
+            other = self._shard_clients.setdefault(addr, sc)
+        if other is not sc:
+            sc.close()
+        return other
+
+    def _drop_shard_client(self, addr: str) -> None:
+        with self._placement_mu:
+            sc = self._shard_clients.pop(addr, None)
+        if sc is not None:
+            sc.close()
+
+    def _send_partition(self, addr: str, db: str, set_name: str,
+                        part, as_table: bool, date_cols, epoch: int,
+                        slot: int, token: str,
+                        chunk_bytes: int) -> Any:
+        """One slot's partition to its owning daemon (or the leader,
+        for a handoff slot): big payloads stream under the windowed-ack
+        pipeline with the placement epoch in the BEGIN meta, small ones
+        ride one frame. ``token`` is the slot's STABLE idempotency
+        token — every retry of this logical ingest re-sends it, so a
+        partition whose first apply succeeded (reply lost) deduplicates
+        instead of double-appending."""
+        from netsdb_tpu.relational.table import ColumnTable
+
+        sc = self._shard_client(addr)
+        if isinstance(part, ColumnTable):
+            nbytes = sum(np.asarray(v).nbytes
+                         for v in part.cols.values())
+            if nbytes >= chunk_bytes:
+                return sc._bulk_request(
+                    MsgType.SEND_DATA,
+                    {"db": db, "set": set_name, "mode": "table",
+                     "date_cols": list(date_cols), "append": True,
+                     "dicts": {k: list(v)
+                               for k, v in part.dicts.items()},
+                     "nrows": part.num_rows,
+                     "pepoch": int(epoch), "slot": int(slot)},
+                    sc._table_chunks(part, chunk_bytes), token=token)
+            payload: Dict[str, Any] = {
+                "db": db, "set": set_name, "items": part,
+                "as_table": True, "date_cols": list(date_cols),
+                "append": True}
+        elif as_table:
+            if len(part) >= self.PIPELINE_MIN_ITEMS:
+                return sc._bulk_request(
+                    MsgType.SEND_DATA,
+                    {"db": db, "set": set_name, "mode": "items",
+                     "as_table": True, "date_cols": list(date_cols),
+                     "append": True,
+                     "pepoch": int(epoch), "slot": int(slot)},
+                    sc._item_chunks(list(part), chunk_bytes),
+                    token=token)
+            payload = {"db": db, "set": set_name, "items": list(part),
+                       "as_table": True, "date_cols": list(date_cols),
+                       "append": True}
+        else:
+            if len(part) >= self.PIPELINE_MIN_ITEMS:
+                return sc._bulk_request(
+                    MsgType.SEND_DATA,
+                    {"db": db, "set": set_name, "mode": "items",
+                     "pepoch": int(epoch), "slot": int(slot)},
+                    sc._item_chunks(list(part), chunk_bytes),
+                    token=token)
+            payload = {"db": db, "set": set_name, "items": list(part)}
+        payload[PLACEMENT_EPOCH_KEY] = int(epoch)
+        payload[SHARD_SLOT_KEY] = int(slot)
+        payload[IDEMPOTENCY_KEY] = token
+        return sc._request(MsgType.SEND_DATA, payload,
+                           codec=CODEC_PICKLE)
+
+    def _routed_ingest(self, db: str, set_name: str,
+                       parts: Dict[int, Any], as_table: bool,
+                       date_cols, chunk_bytes: int) -> Dict[int, Any]:
+        """One logical ingest fanned out to the owning shards in
+        parallel — aggregate bandwidth scales with pool size. Failed
+        slots retry under the client's RetryPolicy with the placement
+        map REFRESHED between rounds (an evicted slot's partition then
+        re-routes to the leader's handoff buffer under the new epoch);
+        per-slot idempotency tokens make every retry at-most-once."""
+        tokens = {slot: uuid.uuid4().hex for slot in parts}
+        remaining = dict(parts)
+        replies: Dict[int, Any] = {}
+        policy = self._retry
+        attempt = 1
+        obs.REGISTRY.counter("serve.client.routed_ingests").inc()
+        while True:
+            entry = self._placement_entry(db, set_name,
+                                          refresh=attempt > 1)
+            if entry is None:
+                raise PlacementStaleError(
+                    "PlacementStale",
+                    f"{db}:{set_name} vanished from the placement map")
+            errors: Dict[int, BaseException] = {}
+            lock = threading.Lock()
+
+            def send_slot(slot, part, entry=entry, errors=errors,
+                          lock=lock):
+                sl = entry["slots"][slot]
+                addr = (f"{self.host}:{self.port}"
+                        if sl["state"] != "live" else sl["addr"])
+                try:
+                    reply = self._send_partition(
+                        addr, db, set_name, part, as_table, date_cols,
+                        entry["epoch"], slot, tokens[slot],
+                        chunk_bytes)
+                    with lock:
+                        replies[slot] = reply
+                except Exception as e:  # noqa: BLE001 — EVERY failure
+                    # must land in `errors`: a slot in neither dict
+                    # would be dropped from `remaining` and its
+                    # partition silently lost while the ingest
+                    # reports success
+                    self._drop_shard_client(addr)
+                    with lock:
+                        errors[slot] = e
+            threads = []
+            for slot, part in remaining.items():
+                t = threading.Thread(target=send_slot,
+                                     args=(slot, part), daemon=True)
+                t.start()
+                threads.append(t)
+            for t in threads:
+                t.join()
+            remaining = {slot: part for slot, part in remaining.items()
+                         if slot in errors}
+            if not remaining:
+                return replies
+            # a deterministic (non-retryable) slot failure wins
+            # immediately — retrying the whole round against it would
+            # burn the backoff schedule on a hopeless slot and could
+            # surface a different slot's transient error instead
+            fatal = next((e for e in errors.values()
+                          if isinstance(e, RemoteError)
+                          and not e.retryable), None)
+            if fatal is not None:
+                raise fatal
+            if attempt >= policy.max_attempts:
+                raise next(iter(errors.values()))
+            if not all(isinstance(e, PlacementStaleError)
+                       for e in errors.values()):
+                # transient transport faults back off; pure stale-map
+                # rejections are deterministic — the refresh at the
+                # top of the next round resolves them instantly
+                time.sleep(policy.backoff_s(attempt, self._rng))
+            attempt += 1
+            self.total_retries += 1
+            obs.REGISTRY.counter("serve.client.retries").inc()
 
     # --- data path ----------------------------------------------------
 
@@ -940,20 +1209,51 @@ class RemoteClient:
         """Object ingest. Large batches stream as bounded chunks under
         the depth-W windowed-ack pipeline (``pipeline=None`` decides by
         item count; force ``True``/``False`` to pin a path — the bench
-        pins both to record the streamed-vs-monolithic win)."""
+        pins both to record the streamed-vs-monolithic win).
+
+        A set the cached placement map shows as PARTITIONED routes
+        instead: items split across the owning shards (hash or range,
+        per the set's placement) and every partition ships directly to
+        its shard in parallel — aggregate ingest bandwidth scales with
+        the pool. A stale map rejects typed and the retry re-routes."""
+        from netsdb_tpu.serve import placement as _pl
+
         items = list(items)
+        entry = self._placement_entry(db, set_name)
+        if entry is not None:
+            cb = int(chunk_bytes or self.ingest_chunk_bytes)
+            parts = dict(_pl.split_items(items, entry))
+            self._routed_ingest(db, set_name, parts, as_table=False,
+                                date_cols=(), chunk_bytes=cb)
+            return
         use = (pipeline if pipeline is not None
                else len(items) >= self.PIPELINE_MIN_ITEMS)
         if not use:
-            self._request(MsgType.SEND_DATA,
-                          {"db": db, "set": set_name, "items": items},
-                          codec=CODEC_PICKLE)
+            try:
+                self._request(MsgType.SEND_DATA,
+                              {"db": db, "set": set_name,
+                               "items": items},
+                              codec=CODEC_PICKLE)
+            except PlacementStaleError:
+                # the set sharded after this client's map snapshot:
+                # refresh and route (the one-hop upgrade path)
+                if self._placement_entry(db, set_name,
+                                         refresh=True) is None:
+                    raise
+                self.send_data(db, set_name, items, pipeline=pipeline,
+                               chunk_bytes=chunk_bytes)
             return
         cb = int(chunk_bytes or self.ingest_chunk_bytes)
-        self._bulk_request(
-            MsgType.SEND_DATA,
-            {"db": db, "set": set_name, "mode": "items"},
-            self._item_chunks(items, cb))
+        try:
+            self._bulk_request(
+                MsgType.SEND_DATA,
+                {"db": db, "set": set_name, "mode": "items"},
+                self._item_chunks(items, cb))
+        except PlacementStaleError:
+            if self._placement_entry(db, set_name, refresh=True) is None:
+                raise
+            self.send_data(db, set_name, items, pipeline=pipeline,
+                           chunk_bytes=chunk_bytes)
 
     def _table_chunks(self, table, chunk_bytes: int):
         """Row-range slices of a ColumnTable's columns: numpy views
@@ -991,10 +1291,72 @@ class RemoteClient:
         copies of the column bytes); a rows list goes out as adaptive
         pickled batches. Both run ``ingest_window`` chunks deep under
         the windowed-ack pipeline. ``pipeline=None`` decides by size;
-        pin ``True``/``False`` to force a path."""
+        pin ``True``/``False`` to force a path.
+
+        A PARTITIONED set (cached placement map) routes instead: the
+        rows split across the owning shards and every partition
+        streams directly to its shard in parallel. ``append=False``
+        first clears the set pool-wide (the leader fans the clear
+        out), then appends each shard's partition."""
         from netsdb_tpu.relational.table import ColumnTable
 
         cb = int(chunk_bytes or self.ingest_chunk_bytes)
+        entry = self._placement_entry(db, set_name)
+        if entry is not None:
+            return self._send_table_routed(db, set_name, rows_or_table,
+                                           date_cols, append, cb)
+        try:
+            return self._send_table_plain(db, set_name, rows_or_table,
+                                          date_cols, append, pipeline,
+                                          cb)
+        except PlacementStaleError:
+            # the set sharded after this client's map snapshot
+            if self._placement_entry(db, set_name, refresh=True) is None:
+                raise
+            return self.send_table(db, set_name, rows_or_table,
+                                   date_cols=date_cols, append=append,
+                                   pipeline=pipeline,
+                                   chunk_bytes=chunk_bytes)
+
+    def _send_table_routed(self, db: str, set_name: str, rows_or_table,
+                           date_cols, append: bool,
+                           chunk_bytes: int) -> "RemoteTableInfo":
+        from netsdb_tpu.relational.table import ColumnTable
+        from netsdb_tpu.serve import placement as _pl
+
+        entry = self._placement_entry(db, set_name)
+        if not append:
+            # replace = pool-wide clear (leader fans out), then append
+            # partitions; the slot idempotency tokens keep the append
+            # half at-most-once across retries
+            self.clear_set(db, set_name)
+        if isinstance(rows_or_table, ColumnTable):
+            table = rows_or_table
+            parts = dict(_pl.split_table(table, entry))
+            replies = self._routed_ingest(db, set_name, parts,
+                                          as_table=True,
+                                          date_cols=date_cols,
+                                          chunk_bytes=chunk_bytes)
+            cols = sorted(table.cols)
+            total = int(table.compact().num_rows
+                        if table.valid is not None else table.num_rows)
+        else:
+            items = list(rows_or_table)
+            parts = dict(_pl.split_items(items, entry))
+            replies = self._routed_ingest(db, set_name, parts,
+                                          as_table=True,
+                                          date_cols=date_cols,
+                                          chunk_bytes=chunk_bytes)
+            cols = sorted({c for r in replies.values()
+                           if isinstance(r, dict)
+                           for c in (r.get("columns") or ())})
+            total = len(items)
+        return RemoteTableInfo(total, cols)
+
+    def _send_table_plain(self, db, set_name, rows_or_table, date_cols,
+                          append, pipeline, cb) -> "RemoteTableInfo":
+        from netsdb_tpu.relational.table import ColumnTable
+
         if isinstance(rows_or_table, ColumnTable):
             table = rows_or_table
             if table.valid is not None:

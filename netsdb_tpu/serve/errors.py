@@ -116,6 +116,39 @@ class CorruptFrame(ServeFault):
     retryable = True
 
 
+class PlacementStale(ServeFault):
+    """A frame routed under an out-of-date placement map: its epoch no
+    longer matches the target set's (the leader evicted or readmitted
+    a shard since the sender's map was fetched), or the sender didn't
+    know the set was partitioned at all. Nothing was applied — the
+    typed retryable contract is refresh-then-re-route: the client
+    re-fetches the map (``RemoteClient`` does this automatically
+    between attempts) and re-partitions against current membership.
+    ``epoch`` carries the receiver's current epoch for the set."""
+
+    retryable = True
+
+    def __init__(self, *args, epoch=None):
+        super().__init__(*args)
+        self.epoch = epoch
+
+
+class ShardUnavailable(ServeFault):
+    """A scatter-gather coordinator (or routed ingest) needs a shard
+    slot that is currently degraded/unreachable. The query was NOT
+    partially merged — partials are discarded whole, never combined
+    across epochs — and retrying after the shard readmits (or the
+    leader revises placement) succeeds. Carries the affected ``slot``
+    and the set's current ``epoch``."""
+
+    retryable = True
+
+    def __init__(self, *args, slot=None, epoch=None):
+        super().__init__(*args)
+        self.slot = slot
+        self.epoch = epoch
+
+
 class RequestInFlight(ServeFault):
     """A duplicate idempotency token arrived while the original request
     is still executing; the retry should back off and re-ask (it will
@@ -143,6 +176,9 @@ class RemoteError(RuntimeError):
         self.retry_after_s = None
         self.queue_depth = None
         self.lane = None
+        # placement details (PlacementStale/ShardUnavailable family)
+        self.epoch = None
+        self.slot = None
 
 
 class RetryableRemoteError(RemoteError):
@@ -196,6 +232,20 @@ class CorruptFrameError(RetryableRemoteError):
     decode; the request never ran."""
 
 
+class PlacementStaleError(RetryableRemoteError):
+    """Server-side :class:`PlacementStale` — the frame rode an
+    out-of-date placement map and was rejected whole. ``epoch`` (when
+    the frame carried it) is the receiver's current epoch for the set;
+    :class:`RemoteClient` refreshes its cached map between attempts so
+    the retry re-routes against current membership."""
+
+
+class ShardUnavailableError(RetryableRemoteError):
+    """Server-side :class:`ShardUnavailable` — a shard slot the
+    request needs is degraded. Nothing was partially applied or
+    merged; retry after the pool heals (backoff applies)."""
+
+
 class AuthError(RemoteError):
     """Handshake refused — fatal, retrying cannot help."""
 
@@ -219,14 +269,20 @@ _KIND_MAP: Dict[str, type] = {
     "CoalesceAborted": CoalesceAbortedError,
     "FollowerDegraded": FollowerDegradedError,
     "CorruptFrame": CorruptFrameError,
+    "PlacementStale": PlacementStaleError,
+    "ShardUnavailable": ShardUnavailableError,
     "AuthError": AuthError,
     "ProtocolVersionError": ProtocolVersionError,
 }
 
 #: scheduler-backpressure detail fields that cross the wire inside the
 #: ERR payload (server ``_send_err`` includes them when the fault
-#: carries them; ``classify_remote`` rebuilds them on the error)
-BACKPRESSURE_FIELDS = ("retry_after_s", "queue_depth", "lane")
+#: carries them; ``classify_remote`` rebuilds them on the error).
+#: ``epoch``/``slot`` are the placement family's analogues: the
+#: receiver's current epoch rides the rejection so a client can tell
+#: "my map is stale" from "the pool is degraded".
+BACKPRESSURE_FIELDS = ("retry_after_s", "queue_depth", "lane",
+                       "epoch", "slot")
 
 
 def classify_remote(reply: Dict[str, Any]) -> RemoteError:

@@ -1,0 +1,263 @@
+"""Leader-owned placement map for the sharded worker pool.
+
+netsDB's real topology is master/worker *partitioned* storage: the
+master plans TCAP into JobStages that are scheduled across workers
+over 64 MB pages (``QuerySchedulerServer.cc:216-330``), so adding a
+node buys capacity, not a copy. The serve layer's mirror pool (every
+follower holds a full replica) keeps that role for redundancy; THIS
+module is the capacity half: a set created with ``placement="hash"``
+(or ``"range"``) partitions its pages across a pool of daemons, and
+the leader owns the authoritative, **versioned** map of which daemon
+holds which shard slot.
+
+The map is:
+
+* shipped to clients inside the v3 handshake (the HELLO reply gains a
+  ``placement`` section — only when sharded sets exist, so the
+  un-sharded handshake stays byte-identical) and re-fetched over the
+  ``PLACEMENT`` frame;
+* **epoch-versioned** per set: every membership change (a shard
+  evicted into handoff, a readmit) bumps the set's epoch. Routed
+  frames carry the sender's epoch (``protocol.PLACEMENT_EPOCH_KEY``)
+  and a receiver whose registration disagrees rejects with the typed
+  retryable ``PlacementStale`` — the stale-map retry loop. An epoch
+  mismatch can therefore never partially apply an ingest or merge
+  partials computed against two different memberships;
+* slot-stable: eviction flips a slot's state to ``handoff`` (ingest
+  for it buffers at the leader; scatter-gather refuses typed) instead
+  of re-assigning its hash space — a readmitted shard gets exactly
+  its own buffered pages back, never a rebalance.
+
+Routing is deterministic and shared by client and server:
+``range`` mode splits each batch into contiguous row ranges
+(even spread, zero hashing cost — the default); ``hash`` mode routes
+rows by a splitmix64-mixed key column so equal keys co-locate
+(ingest-time co-partitioning for key-local work).
+"""
+
+from __future__ import annotations
+
+import hashlib
+from typing import Any, Dict, List, Optional, Tuple
+
+import numpy as np
+
+from netsdb_tpu.utils.locks import TrackedLock
+
+_MASK64 = np.uint64(0xFFFFFFFFFFFFFFFF)
+
+#: slot states: ``live`` (the shard daemon owns the slot) and
+#: ``handoff`` (degraded — the leader buffers the slot's ingest and
+#: drains it on readmit; queries refuse typed while any slot is here)
+LIVE = "live"
+HANDOFF = "handoff"
+
+
+def mix64_array(values: np.ndarray) -> np.ndarray:
+    """Vectorized splitmix64 finalizer over an integer column — the
+    same full-avalanche mix the wire checksum and the grace-hash
+    partitioner use, so ingest-time hash placement and the distributed
+    shuffle agree on what "hash of key" means."""
+    with np.errstate(over="ignore"):
+        v = values.astype(np.uint64)
+        v ^= v >> np.uint64(33)
+        v *= np.uint64(0xFF51AFD7ED558CCD)
+        v ^= v >> np.uint64(29)
+        v *= np.uint64(0xC4CEB9FE1A85EC53)
+        v ^= v >> np.uint64(32)
+    return v
+
+
+def hash_slot_ids(key_col: np.ndarray, nslots: int) -> np.ndarray:
+    """Row → owning slot for hash placement (int key columns)."""
+    return (mix64_array(np.asarray(key_col)) % np.uint64(nslots)).astype(
+        np.int64)
+
+
+def item_slot(item: Any, nslots: int) -> int:
+    """Stable slot for one opaque object row (hash mode over object
+    sets): digest of the pickled item — content-stable across
+    processes, unlike ``hash()``."""
+    import pickle
+
+    blob = pickle.dumps(item, protocol=pickle.HIGHEST_PROTOCOL)
+    return int.from_bytes(hashlib.blake2s(blob, digest_size=8).digest(),
+                          "little") % nslots
+
+
+def range_slices(nrows: int, nslots: int) -> List[Tuple[int, int]]:
+    """Contiguous even split of one batch's rows across slots (range
+    mode). Deterministic: slot i gets rows [i*n/k, (i+1)*n/k)."""
+    out = []
+    for i in range(nslots):
+        start = (nrows * i) // nslots
+        stop = (nrows * (i + 1)) // nslots
+        out.append((start, stop))
+    return out
+
+
+def split_table(table, entry: Dict[str, Any]):
+    """One ColumnTable batch → per-slot row-slice tables (numpy views
+    in range mode — zero copies; one fancy-index gather per slot in
+    hash mode). Returns ``[(slot_index, sub_table)]`` with empty slots
+    omitted. Shared by the routing client and the leader's handoff
+    drain so the two can never partition differently."""
+    from netsdb_tpu.relational.table import ColumnTable
+
+    nslots = len(entry["slots"])
+    if table.valid is not None:
+        table = table.compact()
+    cols = {k: np.asarray(v) for k, v in table.cols.items()}
+    nrows = table.num_rows
+    out = []
+    if entry.get("mode") == "hash" and entry.get("key") \
+            and entry["key"] not in cols:
+        # silently range-splitting here would break the set's key
+        # co-location contract batch by batch — refuse loudly
+        raise ValueError(
+            f"hash-placed set declares key {entry['key']!r} but this "
+            f"batch carries columns {sorted(cols)}")
+    if entry.get("mode") == "hash" and entry.get("key") in cols:
+        slot_ids = hash_slot_ids(cols[entry["key"]], nslots)
+        for i in range(nslots):
+            idx = np.nonzero(slot_ids == i)[0]
+            if idx.size:
+                out.append((i, ColumnTable(
+                    {k: v[idx] for k, v in cols.items()},
+                    dict(table.dicts), None)))
+        return out
+    for i, (start, stop) in enumerate(range_slices(nrows, nslots)):
+        if stop > start:
+            out.append((i, ColumnTable(
+                {k: v[start:stop] for k, v in cols.items()},
+                dict(table.dicts), None)))
+    return out
+
+
+def split_items(items: list, entry: Dict[str, Any]):
+    """One object-row batch → per-slot sublists (same contract as
+    :func:`split_table`)."""
+    nslots = len(entry["slots"])
+    buckets: List[list] = [[] for _ in range(nslots)]
+    if entry.get("mode") == "hash":
+        key = entry.get("key")
+        if key and items and all(isinstance(it, dict) and key in it
+                                 for it in items):
+            # one vectorized hash over the whole batch (the per-item
+            # pipeline below costs an array construction + five u64
+            # ops PER ROW — ruinous on the routed-ingest hot path)
+            slot_ids = hash_slot_ids(
+                np.asarray([it[key] for it in items]), nslots)
+            for item, slot in zip(items, slot_ids):
+                buckets[int(slot)].append(item)
+            return [(i, b) for i, b in enumerate(buckets) if b]
+        for item in items:
+            if key and isinstance(item, dict) and key in item:
+                slot = int(hash_slot_ids(
+                    np.asarray([item[key]]), nslots)[0])
+            else:
+                slot = item_slot(item, nslots)
+            buckets[slot].append(item)
+    else:
+        for i, (start, stop) in enumerate(range_slices(len(items),
+                                                       nslots)):
+            buckets[i] = items[start:stop]
+    return [(i, b) for i, b in enumerate(buckets) if b]
+
+
+class PlacementMap:
+    """The leader's authoritative set → shard-slot table. All methods
+    are thread-safe; readers get deep-enough copies (slot dicts are
+    rebuilt) so no caller ever mutates shared state."""
+
+    def __init__(self):
+        self._mu = TrackedLock("serve.PlacementMap._mu")
+        self._entries: Dict[Tuple[str, str], Dict[str, Any]] = {}
+        self._epoch = 0
+
+    # --- registration -------------------------------------------------
+    def create(self, db: str, set_name: str, addrs: List[str],
+               mode: str = "range",
+               key: Optional[str] = None) -> Dict[str, Any]:
+        if mode not in ("hash", "range"):
+            raise ValueError(f"placement mode must be 'hash' or "
+                             f"'range', got {mode!r}")
+        with self._mu:
+            self._epoch += 1
+            entry = {"mode": mode, "key": key, "epoch": self._epoch,
+                     "slots": [{"addr": a, "state": LIVE}
+                               for a in addrs]}
+            self._entries[(db, set_name)] = entry
+            return self._copy(entry)
+
+    def remove(self, db: str, set_name: str) -> None:
+        with self._mu:
+            self._entries.pop((db, set_name), None)
+
+    # --- reads --------------------------------------------------------
+    @staticmethod
+    def _copy(entry: Dict[str, Any]) -> Dict[str, Any]:
+        return {"mode": entry["mode"], "key": entry["key"],
+                "epoch": entry["epoch"],
+                "slots": [dict(s) for s in entry["slots"]]}
+
+    def entry(self, db: str, set_name: str) -> Optional[Dict[str, Any]]:
+        with self._mu:
+            e = self._entries.get((db, set_name))
+            return self._copy(e) if e is not None else None
+
+    def sets(self) -> List[Tuple[str, str]]:
+        with self._mu:
+            return sorted(self._entries)
+
+    def __len__(self) -> int:
+        with self._mu:
+            return len(self._entries)
+
+    def sets_for_addr(self, addr: str) -> List[Tuple[str, str]]:
+        """Every (db, set) with a slot on ``addr`` — the readmit
+        drain's work list."""
+        with self._mu:
+            return sorted(k for k, e in self._entries.items()
+                          if any(s["addr"] == addr for s in e["slots"]))
+
+    # --- membership changes (each bumps affected epochs) --------------
+    def _flip(self, addr: str, state: str) -> List[Tuple[str, str]]:
+        changed = []
+        with self._mu:
+            for ident, e in self._entries.items():
+                hit = False
+                for s in e["slots"]:
+                    if s["addr"] == addr and s["state"] != state:
+                        s["state"] = state
+                        hit = True
+                if hit:
+                    self._epoch += 1
+                    e["epoch"] = self._epoch
+                    changed.append(ident)
+        return changed
+
+    def degrade_addr(self, addr: str) -> List[Tuple[str, str]]:
+        """Evict one shard daemon: its slots flip to handoff, every
+        affected set's epoch bumps (in-flight frames routed under the
+        old epoch now reject typed)."""
+        return self._flip(addr, HANDOFF)
+
+    def readmit_addr(self, addr: str) -> List[Tuple[str, str]]:
+        """Readmit one shard daemon after its handoff drained."""
+        return self._flip(addr, LIVE)
+
+    # --- wire form ----------------------------------------------------
+    def to_wire(self) -> Dict[str, Any]:
+        with self._mu:
+            return {"epoch": self._epoch,
+                    "sets": {f"{db}:{s}": self._copy(e)
+                             for (db, s), e in self._entries.items()}}
+
+    @staticmethod
+    def entry_from_wire(wire: Dict[str, Any], db: str,
+                        set_name: str) -> Optional[Dict[str, Any]]:
+        """Client-side read of one set's entry out of a shipped map."""
+        if not wire:
+            return None
+        return (wire.get("sets") or {}).get(f"{db}:{set_name}")

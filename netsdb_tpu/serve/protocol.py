@@ -181,6 +181,29 @@ class MsgType(IntEnum):
     BULK_BEGIN = 60
     BULK_CHUNK = 61
     BULK_COMMIT = 62
+    # --- horizontal scale-out (sharded worker pool) -------------------
+    # the leader's versioned placement map: which daemon owns which
+    # shard slot of each hash/range-partitioned set. Shipped in the v3
+    # handshake when the pool holds sharded sets, re-fetched by clients
+    # on a PlacementStale rejection (the stale-map retry loop).
+    PLACEMENT = 70
+    # coordinator → shard: execute one pushed subplan (Scan→Filter/
+    # Apply→Aggregate region, a partial fold, or one leg of a
+    # distributed shuffle join) over the shard's LOCAL pages and reply
+    # with the bounded partial the coordinator merges — the reference's
+    # master scheduling JobStages onto workers over their local
+    # partitions (QuerySchedulerServer.cc:216-330).
+    SUBPLAN = 71
+    # shard → shard: one hash bucket of a distributed shuffle (the
+    # grace-hash partition step run across daemons). Column buffers
+    # ride as out-of-band segments — no tobytes copies on the shuffle
+    # path, same zero-copy framing as BULK table chunks.
+    SHUFFLE_PUT = 72
+    # leader → readmitted shard: re-register the shard's placement
+    # epochs ahead of the handoff drain (the shard-scoped resync — a
+    # readmitted shard receives only its OWN buffered pages, never a
+    # whole-store snapshot like RESYNC_FOLLOWER)
+    SHARD_RESYNC = 73
 
 
 #: payload key carrying the client-generated idempotency token on
@@ -215,6 +238,22 @@ CLIENT_ID_KEY = "__client__"
 #: client can only name a lane, never grant itself priority the
 #: operator didn't configure.
 LANE_KEY = "__lane__"
+
+#: payload key carrying the placement-map epoch on frames ROUTED to a
+#: shard slot of a partitioned set (ingest the client aimed at an
+#: owning daemon, coordinator→shard subplans). The receiving daemon
+#: validates it against the epoch it was registered under; a mismatch
+#: is the typed retryable ``PlacementStale`` — the client/coordinator
+#: refreshes the map and re-routes instead of applying against a
+#: membership the leader already revised (the partial/doubled-merge
+#: hazard the epoch exists to close).
+PLACEMENT_EPOCH_KEY = "__pepoch__"
+
+#: payload key carrying the target shard SLOT index on routed ingest.
+#: A slot in handoff state routes to the LEADER with this key intact:
+#: the leader buffers the batch for the degraded shard and drains it
+#: on readmit (the shard-scoped resync).
+SHARD_SLOT_KEY = "__slot__"
 
 #: frame types that mutate daemon state or launch jobs — the set the
 #: client attaches idempotency tokens to before retrying. Reads are

@@ -81,9 +81,21 @@ class QueryScheduler:
                  coalesce_wait_s: Optional[float] = 300.0,
                  coalesce_done_ttl_s: float = 0.0,
                  coalesce_done_max: int = 32,
-                 cache_probe=None):
+                 cache_probe=None,
+                 feedback: bool = False, feedback_every: int = 64):
+        from netsdb_tpu.utils.locks import TrackedLock
+
         self.lanes = LaneScheduler(slots, lanes=lanes, quota=quota,
                                    aging_every=aging_every)
+        # feedback loop (serve/sched/feedback.py): reseed lane weights
+        # and per-lane quotas from the attribution + operator ledgers
+        # every `feedback_every` admissions (opt-in)
+        self.feedback_enabled = bool(feedback)
+        self._feedback_every = max(int(feedback_every or 0), 1)
+        self._base_quota = max(int(quota or 0), 0)
+        self._fb_mu = TrackedLock("sched.QueryScheduler._fb_mu")
+        self._fb_count = 0
+        self._fb_running = False
         self.coalesce_enabled = bool(coalesce)
         self.coalesce_wait_s = coalesce_wait_s
         self._coalesce = CoalesceTable(
@@ -97,7 +109,50 @@ class QueryScheduler:
     # --- lanes --------------------------------------------------------
     def acquire(self, lane: Optional[str],
                 timeout_s: float) -> AdmissionTicket:
+        if self.feedback_enabled:
+            self._maybe_feedback()
         return self.lanes.acquire(lane, timeout_s)
+
+    def _maybe_feedback(self) -> None:
+        import threading
+
+        with self._fb_mu:
+            self._fb_count += 1
+            due = (self._fb_count % self._feedback_every == 0
+                   and not self._fb_running)
+            if due:
+                self._fb_running = True
+        if due:
+            # OFF the admission hot path: the two-ledger snapshot +
+            # reseed must not become a periodic latency spike in the
+            # very p99 the scheduler exists to protect
+            threading.Thread(target=self._feedback_bg,
+                             daemon=True,
+                             name="netsdb-sched-feedback").start()
+
+    def _feedback_bg(self) -> None:
+        try:
+            self.refresh_feedback()
+        finally:
+            with self._fb_mu:
+                self._fb_running = False
+
+    def refresh_feedback(self):
+        """Recompute lane weights/quotas from the attribution +
+        operator ledgers (serve/sched/feedback.py's pinned formula)
+        and apply them. Returns (weights, quotas) for tests/tooling;
+        empty when no lane cleared the evidence floor."""
+        from netsdb_tpu.serve.sched import feedback as _feedback
+
+        weights, quotas = _feedback.seed_lanes(
+            obs.attrib.LEDGER.snapshot(),
+            obs.operators.LEDGER.snapshot(),
+            base_quota=self._base_quota,
+            reserved=self.lanes.reserved_lanes)
+        if weights:
+            self.lanes.reseed(weights, quotas)
+            obs.REGISTRY.counter("sched.feedback_reseeds").inc()
+        return weights, quotas
 
     def release(self, ticket: AdmissionTicket) -> None:
         self.lanes.release(ticket)

@@ -107,8 +107,37 @@ class LaneScheduler:
         self._grants_since_aged = 0
         self._weights = {str(k): float(v)
                          for k, v in (lanes or {}).items()}
+        # lane names the OPERATOR configured — the feedback reseed
+        # never overrides an explicit weight
+        self.reserved_lanes = frozenset(self._weights)
+        # per-lane quota overrides (feedback-seeded); lanes not listed
+        # keep the global _quota
+        self._lane_quotas: Dict[str, int] = {}
         self._lanes: "OrderedDict[str, _Lane]" = OrderedDict()
         self._depth = 0
+
+    def reseed(self, weights: Dict[str, float],
+               quotas: Optional[Dict[str, int]] = None) -> None:
+        """Apply feedback-derived lane weights (and per-lane quota
+        overrides). Existing lanes keep their served counts — only the
+        weight moves, so the WFQ share shifts without resetting
+        virtual time; reserved (operator-configured) lanes are never
+        touched."""
+        with self._mu:
+            for name, w in (weights or {}).items():
+                if name in self.reserved_lanes:
+                    continue
+                self._weights[name] = max(float(w), 1e-6)
+                lane = self._lanes.get(name)
+                if lane is not None:
+                    lane.weight = max(float(w), 1e-6)
+            for name, q in (quotas or {}).items():
+                if name in self.reserved_lanes:
+                    continue
+                self._lane_quotas[name] = max(int(q), 1)
+
+    def _quota_for_locked(self, name: str) -> int:
+        return self._lane_quotas.get(name, self._quota)
 
     # --- lane bookkeeping --------------------------------------------
     def _lane_locked(self, name: str) -> _Lane:
@@ -155,12 +184,13 @@ class LaneScheduler:
         deadline = deadline_after(timeout_s)
         with self._mu:
             lane = self._lane_locked(name)
-            if self._quota and len(lane.q) >= self._quota:
+            quota = self._quota_for_locked(lane.name)
+            if quota and len(lane.q) >= quota:
                 depth = len(lane.q)
                 obs.REGISTRY.counter("sched.quota_rejects").inc()
                 raise LaneSaturated(
                     f"lane {lane.name!r} quota full ({depth} queued, "
-                    f"quota {self._quota}) — per-tenant backoff",
+                    f"quota {quota}) — per-tenant backoff",
                     lane=lane.name, queue_depth=depth,
                     retry_after_s=lane.wait_hist.quantile(0.5))
             if not lane.q:
@@ -258,6 +288,7 @@ class LaneScheduler:
                 "free_slots": self._free,
                 "queued": self._depth,
                 "quota": self._quota,
+                "lane_quotas": dict(self._lane_quotas),
                 "aging_every": self._aging_every,
                 "lanes": {
                     name: {"weight": ln.weight, "depth": len(ln.q),

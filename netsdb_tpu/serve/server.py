@@ -20,6 +20,7 @@ overcommitting the controller.
 from __future__ import annotations
 
 import contextlib
+import contextvars
 import importlib
 import importlib.util
 import inspect
@@ -38,13 +39,17 @@ from netsdb_tpu import obs
 from netsdb_tpu.client import Client
 from netsdb_tpu.config import Configuration, DEFAULT_CONFIG
 from netsdb_tpu.serve import sched as _sched
+from netsdb_tpu.serve import placement as _placement
+from netsdb_tpu.serve import shard as _shard
 from netsdb_tpu.serve.errors import (
     BACKPRESSURE_FIELDS,
     AdmissionFull,
     CorruptFrame,
     FollowerDegraded,
     LaneSaturated,
+    PlacementStale,
     RequestInFlight,
+    ShardUnavailable,
 )
 from netsdb_tpu.serve.protocol import (
     CLIENT_ID_KEY,
@@ -53,8 +58,10 @@ from netsdb_tpu.serve.protocol import (
     IDEMPOTENCY_KEY,
     LANE_KEY,
     MAX_FRAME_BYTES,
+    PLACEMENT_EPOCH_KEY,
     PROTO_VERSION,
     QUERY_ID_KEY,
+    SHARD_SLOT_KEY,
     MsgType,
     ProtocolError,
     decode_body,
@@ -73,6 +80,15 @@ from netsdb_tpu.utils.timing import deadline_after, seconds_left, wall_now
 OBS_FRAMES = frozenset({MsgType.PING, MsgType.COLLECT_STATS,
                         MsgType.GET_TRACE, MsgType.PUT_TRACE,
                         MsgType.HEALTH, MsgType.GET_METRICS})
+
+#: the in-flight frame's idempotency token, installed for the
+#: handler's dynamic extent. The handoff path needs it: a batch
+#: buffered for a degraded shard must drain under the CLIENT's token,
+#: so a retry re-routed through the leader after the shard already
+#: applied the original (reply lost, then eviction) deduplicates at
+#: the shard instead of double-appending.
+_idem_token_var: "contextvars.ContextVar[Optional[str]]" = \
+    contextvars.ContextVar("netsdb_idem_token", default=None)
 
 
 def resolve_entry_point(entry: str, source: Optional[str] = None) -> Any:
@@ -541,6 +557,7 @@ class ServeController:
                  mirror_ack_timeout_s: Optional[float] = 300.0,
                  resync_grace_s: float = 30.0,
                  resync_timeout_s: float = 120.0,
+                 workers: Optional[list] = None,
                  chaos=None, follower_chaos=None):
         """``followers``: addresses of worker daemons (one per other
         jax.distributed process). Every state-mutating/job frame this
@@ -549,6 +566,18 @@ class ServeController:
         same order, which is what XLA's multi-controller collectives
         require (compilation is a rendezvous; sequential forwarding
         would deadlock it). The reference's master→worker job flow.
+
+        ``workers``: addresses of SHARD daemons forming this leader's
+        partitioned worker pool (the horizontal scale-out topology —
+        distinct from ``followers``, which mirror for redundancy; the
+        two pools are orthogonal and a sharded set's pages are never
+        mirrored beyond the leader's own slot). Sets created with
+        ``placement="hash"``/``"range"`` partition their pages across
+        ``[this daemon] + workers``; ingest routes to owning shards,
+        queries scatter-gather (``serve/shard.py``), and the leader
+        owns the versioned placement map shipped in the handshake.
+        Plain ``placement=None`` sets are untouched — the
+        single-daemon and mirror paths stay byte-for-byte identical.
 
         Fault-tolerance knobs (defaults are production-shaped; the
         chaos tests shrink them):
@@ -598,6 +627,27 @@ class ServeController:
         self._links: Dict[str, _FollowerLink] = {}
         self._degraded: Dict[str, str] = {}
         self._followers_mu = TrackedLock("ServeController._followers_mu")
+        # --- sharded worker pool (horizontal scale-out) ---------------
+        # the leader's authoritative set→shard map (empty on plain
+        # daemons — every placement probe then answers None and the
+        # un-sharded paths run unchanged)
+        self._worker_addrs: list = list(workers or [])
+        self.placement = _placement.PlacementMap()
+        # worker-side registrations: (db, set) → {"epoch", "slot"} for
+        # sets this daemon holds ONE slot of (written by CREATE_SET's
+        # __shard__ marker and SHARD_RESYNC, read on every routed frame)
+        self._shard_sets: Dict[Tuple[str, str], Dict[str, int]] = {}
+        self._shard_mu = TrackedLock("ServeController._shard_mu")
+        # pool connections + handoff buffers + the scatter coordinator
+        self.shards = _shard.ShardPool(
+            self, handoff_max_bytes=getattr(config,
+                                            "shard_handoff_bytes",
+                                            256 << 20))
+        # inbound distributed-shuffle buckets (shard side)
+        self._shuffle = _shard.ShuffleInbox()
+        #: this daemon's pool identity — rewritten by start() once the
+        #: real port is bound (port=0 tests)
+        self.advertise_addr = f"{host}:{port}"
         # the runtime lock-order witness (utils/locks.py): config-
         # gated so a production daemon can run lockdep-style checks
         if getattr(config, "lock_witness", False):
@@ -702,7 +752,9 @@ class ServeController:
                 config, "sched_coalesce_done_ttl_s", 0.0),
             coalesce_done_max=getattr(
                 config, "sched_coalesce_done_max", 32),
-            cache_probe=self._devcache_warm)
+            cache_probe=self._devcache_warm,
+            feedback=getattr(config, "sched_feedback", False),
+            feedback_every=getattr(config, "sched_feedback_every", 64))
         self._job_seq = itertools.count(1)
         self._jobs: Dict[int, Dict[str, Any]] = {}
         self._jobs_lock = TrackedLock("ServeController._jobs_lock")
@@ -742,6 +794,10 @@ class ServeController:
             MsgType.LOCAL_SHARDS: self._on_local_shards,
             MsgType.PAGED_MATMUL: self._on_paged_matmul,
             MsgType.RESYNC_FOLLOWER: self._on_resync_follower,
+            MsgType.PLACEMENT: self._on_placement,
+            MsgType.SUBPLAN: self._on_subplan,
+            MsgType.SHUFFLE_PUT: self._on_shuffle_put,
+            MsgType.SHARD_RESYNC: self._on_shard_resync,
         }
 
     # --- lifecycle ----------------------------------------------------
@@ -753,6 +809,7 @@ class ServeController:
         self._listener.bind((self.host, self.port))
         self._listener.listen(128)
         self.port = self._listener.getsockname()[1]
+        self.advertise_addr = f"{self.host}:{self.port}"
         t = threading.Thread(target=self._accept_loop, daemon=True,
                              name="netsdb-serve-accept")
         t.start()
@@ -764,6 +821,12 @@ class ServeController:
                                  name="netsdb-serve-health")
             h.start()
             self._threads.append(h)
+        if self._worker_addrs:
+            s = threading.Thread(target=self._pool_health_loop,
+                                 daemon=True,
+                                 name="netsdb-serve-pool-health")
+            s.start()
+            self._threads.append(s)
         return self.port
 
     def serve_forever(self) -> None:
@@ -791,6 +854,7 @@ class ServeController:
             links = list(self._links.values())
         for link in links:
             link.close()
+        self.shards.close()
         self._idem.close()
         if self._listener is not None:
             try:
@@ -837,8 +901,14 @@ class ServeController:
                     send_frame(conn, MsgType.ERR,
                                {"error": "AuthError", "message": "bad token"})
                     return
-                send_frame(conn, MsgType.OK, {"server": "netsdb_tpu",
-                                              "version": PROTO_VERSION})
+                ok_reply = {"server": "netsdb_tpu",
+                            "version": PROTO_VERSION}
+                if len(self.placement):
+                    # v3 handshake placement shipping: ONLY when sharded
+                    # sets exist, so the plain handshake (and every
+                    # existing test's frame trace) stays byte-identical
+                    ok_reply["placement"] = self.placement.to_wire()
+                send_frame(conn, MsgType.OK, ok_reply)
                 conn.settimeout(None)
             except (ProtocolError, ConnectionError, OSError):
                 return
@@ -1189,12 +1259,17 @@ class ServeController:
                                               qid=qid, client=client)
                 return handler(payload)
 
-            with obs.attrib.client_context(client), \
-                    _sched.lane_context(lane):
-                if typ in self.COALESCED_FRAMES:
-                    out = self.sched.coalesced(typ, payload, invoke)
-                else:
-                    out = invoke()
+            tok_reset = _idem_token_var.set(token)
+            try:
+                with obs.attrib.client_context(client), \
+                        _sched.lane_context(lane):
+                    if typ in self.COALESCED_FRAMES:
+                        out = self.sched.coalesced(typ, payload,
+                                                   invoke)
+                    else:
+                        out = invoke()
+            finally:
+                _idem_token_var.reset(tok_reset)
         except FollowerDegraded as e:
             # the LOCAL mutation applied; only the mirror failed.
             # Cache the local reply under the token so the client's
@@ -1289,6 +1364,14 @@ class ServeController:
                 # allow_pickle off): typed fatal ERR instead of "go";
                 # the connection stays frame-synchronized
                 return self._send_err(conn, e, retryable=False)
+            if meta.get("pepoch") is not None or self.is_sharded(
+                    meta.get("db"), meta.get("set")):
+                # placement-epoch gate at BEGIN — a stale map must
+                # reject before the client streams the payload, not
+                # after (the COMMIT-time check below still guards the
+                # race where the epoch moves mid-conversation)
+                self._shard_route(meta.get("db"), meta.get("set"),
+                                  meta.get("pepoch"), meta.get("slot"))
             self._send_reply(conn, MsgType.OK, {"go": True})
             total_in = 0
             while True:
@@ -1322,6 +1405,15 @@ class ServeController:
                             f"{payload.get('chunks')} chunks, received "
                             f"{asm.chunks}")
                     final_payload, fwd_codec = asm.finish()
+                    if meta.get("pepoch") is not None \
+                            and isinstance(final_payload, dict):
+                        # the routed conversation's epoch/slot ride to
+                        # the apply (validated again there — COMMIT
+                        # must reject a mid-stream membership change)
+                        final_payload[PLACEMENT_EPOCH_KEY] = \
+                            meta["pepoch"]
+                        if meta.get("slot") is not None:
+                            final_payload[SHARD_SLOT_KEY] = meta["slot"]
                     owned = False  # _execute_frame consumes the token
                     result = self._execute_frame(op, final_payload,
                                                  fwd_codec, token,
@@ -1410,6 +1502,213 @@ class ServeController:
         with self._followers_mu:
             return {"active": sorted(self._links),
                     "degraded": dict(self._degraded)}
+
+    # --- sharded worker pool (horizontal scale-out) -------------------
+    def is_sharded(self, db: str, set_name: str) -> bool:
+        """Placement probe: does this daemon coordinate a partitioned
+        placement for (db, set)? Empty map → always False — the
+        un-sharded paths never branch."""
+        return self.placement.entry(db, set_name) is not None
+
+    def shard_registration(self, db: str,
+                           set_name: str) -> Optional[Dict[str, int]]:
+        """Worker-side shard registration for (db, set), or None."""
+        with self._shard_mu:
+            reg = self._shard_sets.get((db, set_name))
+            return dict(reg) if reg is not None else None
+
+    def _register_shard(self, db: str, set_name: str, slot: int,
+                        epoch: int) -> None:
+        with self._shard_mu:
+            self._shard_sets[(db, set_name)] = {"epoch": int(epoch),
+                                                "slot": int(slot)}
+
+    def _shard_route(self, db: Optional[str], set_name: Optional[str],
+                     epoch, slot) -> str:
+        """Classify one (possibly routed) mutating frame against this
+        daemon's placement knowledge: ``"local"`` (apply here),
+        ``"handoff"`` (buffer for a degraded slot), or a typed
+        retryable :class:`PlacementStale` — the placement-epoch
+        rejection. Validation happens BEFORE any execution, so a
+        revised membership can never partially apply."""
+        if not db or not set_name:
+            return "local"
+        entry = self.placement.entry(db, set_name)
+        if entry is not None:  # this daemon coordinates the set
+            current = entry["epoch"]
+            if epoch is None:
+                self._reject_stale(
+                    f"set {db}:{set_name} is partitioned across a "
+                    f"worker pool; fetch the placement map and route "
+                    f"to the owning shards", current)
+            if int(epoch) != current:
+                self._reject_stale(
+                    f"placement epoch rejected for {db}:{set_name}: "
+                    f"frame rode epoch {epoch}, current is {current}",
+                    current)
+            if slot is None or not (0 <= int(slot)
+                                    < len(entry["slots"])):
+                self._reject_stale(
+                    f"routed frame for {db}:{set_name} carries no "
+                    f"valid shard slot", current)
+            sl = entry["slots"][int(slot)]
+            if sl["state"] == _placement.HANDOFF:
+                return "handoff"
+            if sl["addr"] == self.advertise_addr:
+                return "local"
+            self._reject_stale(
+                f"slot {slot} of {db}:{set_name} is owned by "
+                f"{sl['addr']}, not this daemon", current)
+        reg = self.shard_registration(db, set_name)
+        if reg is not None:  # this daemon holds one slot
+            if epoch is None or int(epoch) != reg["epoch"]:
+                self._reject_stale(
+                    f"placement epoch rejected for {db}:{set_name}: "
+                    f"frame rode epoch {epoch}, shard registered "
+                    f"{reg['epoch']}", reg["epoch"])
+        return "local"
+
+    @staticmethod
+    def _reject_stale(message: str, epoch) -> None:
+        obs.REGISTRY.counter("shard.epoch_rejects").inc()
+        raise PlacementStale(message, epoch=epoch)
+
+    def _pool_health_loop(self) -> None:
+        """Leader-side shard liveness: heartbeat every pool worker
+        over a dedicated short-timeout connection, evict into the
+        degraded (handoff) state after ``heartbeat_misses`` failures,
+        and readmit — shard-scoped resync + handoff drain, never a
+        whole-store snapshot — once the worker answers again."""
+        from netsdb_tpu.serve.client import RemoteClient, RetryPolicy
+
+        probes: Dict[str, Any] = {}
+        misses: Dict[str, int] = {}
+        while not self._stop.wait(self.heartbeat_interval_s):
+            for addr in list(self._worker_addrs):
+                try:
+                    probe = probes.get(addr)
+                    if probe is None:
+                        probe = RemoteClient(
+                            addr, token=self.token,
+                            timeout=self.heartbeat_timeout_s,
+                            retry=RetryPolicy(max_attempts=1))
+                        probes[addr] = probe
+                    probe.ping()
+                    misses[addr] = 0
+                    if self.shards.is_degraded(addr):
+                        self._try_readmit_shard(addr)
+                except Exception as e:  # noqa: BLE001 — counted below
+                    probe = probes.pop(addr, None)
+                    if probe is not None:
+                        probe.close()
+                    misses[addr] = misses.get(addr, 0) + 1
+                    if misses[addr] >= self.heartbeat_misses \
+                            and not self.shards.is_degraded(addr):
+                        misses[addr] = 0
+                        self._evict_shard(
+                            addr, f"{self.heartbeat_misses} missed "
+                                  f"heartbeats: {type(e).__name__}: {e}")
+        for probe in probes.values():
+            probe.close()
+
+    def _evict_shard(self, addr: str, reason: str) -> None:
+        """Degrade one pool worker: its slots flip to handoff (epoch
+        bump — in-flight stale routes reject typed), its ingest
+        buffers at this leader until readmit, and every OTHER live
+        worker learns the new epochs (``ShardPool.degrade`` pushes,
+        best-effort). Idempotent."""
+        self.shards.degrade(addr, reason)
+
+    def _push_epochs(self, exclude: Tuple[str, ...] = ()) -> None:
+        """Re-register CURRENT placement epochs on every live worker —
+        an epoch bump is leader-local until this push, and a live
+        worker still registered under the old epoch would reject every
+        correctly-routed new-epoch frame. Best-effort per worker: a
+        push failure leaves that worker answering typed-retryable
+        (clients back off) until a later push lands."""
+        sets_by_addr: Dict[str, list] = {}
+        for db, s in self.placement.sets():
+            entry = self.placement.entry(db, s)
+            for i, sl in enumerate(entry["slots"]):
+                addr = sl["addr"]
+                if addr == self.advertise_addr or addr in exclude \
+                        or sl["state"] != _placement.LIVE:
+                    continue
+                sets_by_addr.setdefault(addr, []).append(
+                    {"db": db, "set": s, "slot": i,
+                     "epoch": entry["epoch"]})
+        for addr, sets in sets_by_addr.items():
+            try:
+                self.shards.peer_request(addr, MsgType.SHARD_RESYNC,
+                                         {"sets": sets})
+            except Exception as e:  # noqa: BLE001 — best-effort push
+                del e
+                self.shards.drop_client(addr)
+
+    def _try_readmit_shard(self, addr: str) -> bool:
+        """Readmit one degraded shard: re-register its placement
+        epochs (SHARD_RESYNC — required, a failure re-degrades), push
+        the bumped epochs to the REST of the pool, then drain ONLY the
+        shard's own buffered pages. The drain's per-batch idempotency
+        tokens make a retried drain safe."""
+        try:
+            self.placement.readmit_addr(addr)
+            sets = []
+            for db, s in self.placement.sets_for_addr(addr):
+                entry = self.placement.entry(db, s)
+                for i, sl in enumerate(entry["slots"]):
+                    if sl["addr"] == addr:
+                        sets.append({"db": db, "set": s, "slot": i,
+                                     "epoch": entry["epoch"]})
+            if sets:
+                self.shards.peer_request(addr, MsgType.SHARD_RESYNC,
+                                         {"sets": sets})
+                self._push_epochs(exclude=(addr,))
+                self.shards.drain_handoff(addr)
+            self.shards.clear_degraded(addr)
+            obs.REGISTRY.counter("shard.readmits").inc()
+            return True
+        except Exception as e:  # noqa: BLE001 — re-degraded, retried
+            self.shards.degrade(addr, f"readmit failed: "
+                                      f"{type(e).__name__}: {e}")
+            return False
+
+    # --- shard-pool handlers ------------------------------------------
+    def _on_placement(self, p):
+        """The placement map (the PLACEMENT frame a client's stale-map
+        retry re-fetches)."""
+        return MsgType.OK, self.placement.to_wire()
+
+    def _on_subplan(self, p):
+        """Shard side of scatter-gather: run one pushed subplan over
+        this daemon's local pages. Admission happened at the
+        coordinator (one client EXECUTE = one admission slot pool-
+        wide); the shard's own devcache/staging/fusion state still
+        applies — that is the per-shard payoff."""
+        return MsgType.OK, _shard.execute_subplan(self, p), CODEC_PICKLE
+
+    def _on_shuffle_put(self, p):
+        """One inbound distributed-shuffle bucket (shard → shard)."""
+        cols = p.get("cols")
+        nbytes = sum(np.asarray(v).nbytes for v in (cols or {}).values())
+        obs.REGISTRY.counter("shard.shuffle_parts").inc()
+        if nbytes:
+            obs.REGISTRY.counter("shard.shuffle_bytes").inc(nbytes)
+        self._shuffle.put(p["sid"], p["side"], int(p["slot"]), cols,
+                          p.get("dicts"))
+        return MsgType.OK, {}
+
+    def _on_shard_resync(self, p):
+        """Leader → readmitted shard: re-register placement epochs for
+        this daemon's slots (the metadata half of the shard-scoped
+        resync; the data half is the handoff drain of ordinary routed
+        SEND_DATA frames that follows)."""
+        count = 0
+        for s in p.get("sets", ()):
+            self._register_shard(s["db"], s["set"], s["slot"],
+                                 s["epoch"])
+            count += 1
+        return MsgType.OK, {"sets": count}
 
     # --- follower health + graceful degradation -----------------------
     def _health_loop(self) -> None:
@@ -1852,7 +2151,80 @@ class ServeController:
         self.library.create_database(p["db"])
         return MsgType.OK, {}
 
+    @staticmethod
+    def _shard_mode(placement_arg) -> Tuple[Optional[str], Optional[str]]:
+        """(mode, key) when ``placement`` asks for pool sharding —
+        the string forms ``"hash"``/``"range"`` or ``{"shard": mode,
+        "key": col}`` — else (None, None): mesh Placement metas and
+        plain sets flow through untouched."""
+        if isinstance(placement_arg, str) \
+                and placement_arg in ("hash", "range"):
+            return placement_arg, None
+        if isinstance(placement_arg, dict) and placement_arg.get("shard"):
+            return str(placement_arg["shard"]), placement_arg.get("key")
+        return None, None
+
+    def _create_local_set(self, p) -> None:
+        self.library.create_set(
+            p["db"], p["set"], type_name=p.get("type_name", "tensor"),
+            persistence=p.get("persistence", "transient"),
+            eviction=p.get("eviction", "lru"),
+            partition_lambda=p.get("partition_lambda"),
+            placement=None,
+            storage=p.get("storage", "memory"))
+
     def _on_create_set(self, p):
+        shard_info = p.get("__shard__")
+        if shard_info is not None:
+            # worker side of a sharded create: one local slot set plus
+            # the epoch registration routed frames validate against
+            # (create_database is idempotent — workers need the db
+            # even though only the leader saw CREATE_DATABASE)
+            self.library.create_database(p["db"])
+            self._create_local_set(p)
+            self._register_shard(p["db"], p["set"],
+                                 shard_info["slot"],
+                                 shard_info["epoch"])
+            return MsgType.OK, {}
+        if p.get("placement") == "mirror":
+            # the explicit spelling of the default replication mode:
+            # full copy on every follower, nothing sharded
+            p = {**p, "placement": None}
+        mode, key = self._shard_mode(p.get("placement"))
+        if mode is not None:
+            # leader side: this daemon is slot 0; every pool worker
+            # gets one slot. A degraded pool refuses typed BEFORE any
+            # mutation — registering a dead worker's slot as live
+            # would turn every later routed frame into a raw
+            # connection error instead of the typed story.
+            degraded = self.shards.degraded()
+            if degraded:
+                raise ShardUnavailable(
+                    f"cannot create partitioned set "
+                    f"{p['db']}:{p['set']}: pool worker(s) "
+                    f"{sorted(degraded)} are degraded; retry after "
+                    f"readmit")
+            self._create_local_set(p)
+            addrs = [self.advertise_addr] + list(self._worker_addrs)
+            entry = self.placement.create(p["db"], p["set"], addrs,
+                                          mode=mode, key=key)
+            fwd = {k: v for k, v in p.items() if k != "placement"}
+            try:
+                for i, addr in enumerate(addrs[1:], start=1):
+                    self.shards.peer_request(
+                        addr, MsgType.CREATE_SET,
+                        {**fwd, "__shard__": {"slot": i,
+                                              "epoch": entry["epoch"]}})
+            except Exception as e:
+                # a worker died mid-create: unregister the half-born
+                # entry (the local set stays — harmless, and a retry
+                # recreates over it) and surface typed retryable
+                self.placement.remove(p["db"], p["set"])
+                raise ShardUnavailable(
+                    f"partitioned create of {p['db']}:{p['set']} "
+                    f"failed mid-fanout ({type(e).__name__}: {e}); "
+                    f"placement rolled back — retry") from e
+            return MsgType.OK, {"placement": entry}
         self.library.create_set(
             p["db"], p["set"], type_name=p.get("type_name", "tensor"),
             persistence=p.get("persistence", "transient"),
@@ -1862,11 +2234,44 @@ class ServeController:
             storage=p.get("storage", "memory"))
         return MsgType.OK, {}
 
+    def _fanout_sharded_ddl(self, typ, p) -> bool:
+        """Forward one DDL frame to every worker slot of a sharded
+        set. DDL is all-or-nothing like the partial merges: a
+        degraded slot REFUSES typed-retryable (a clear/remove that
+        skipped an unreachable shard would leave it holding pages
+        every other slot deleted — divergence at readmit), and a
+        forward failure raises. True when the set was sharded."""
+        entry = self.placement.entry(p["db"], p["set"])
+        if entry is None:
+            return False
+        for i, sl in enumerate(entry["slots"]):
+            if sl["state"] != _placement.LIVE:
+                raise ShardUnavailable(
+                    f"slot {i} of {p['db']}:{p['set']} ({sl['addr']}) "
+                    f"is degraded; pool-wide DDL refused rather than "
+                    f"diverge the absent shard — retry after readmit",
+                    slot=i, epoch=entry["epoch"])
+        for sl in entry["slots"]:
+            if sl["addr"] != self.advertise_addr:
+                self.shards.peer_request(sl["addr"], typ,
+                                         {"db": p["db"],
+                                          "set": p["set"]})
+        return True
+
     def _on_remove_set(self, p):
+        if self._fanout_sharded_ddl(MsgType.REMOVE_SET, p):
+            self.placement.remove(p["db"], p["set"])
+        # bytes-accounting hygiene: any buffered handoff for the set
+        # dies with it (unreachable once the placement entry is gone)
+        self.shards.purge_handoff(p["db"], p["set"])
+        with self._shard_mu:
+            self._shard_sets.pop((p["db"], p["set"]), None)
         self.library.remove_set(p["db"], p["set"])
         return MsgType.OK, {}
 
     def _on_clear_set(self, p):
+        if self._fanout_sharded_ddl(MsgType.CLEAR_SET, p):
+            self.shards.purge_handoff(p["db"], p["set"])
         self.library.clear_set(p["db"], p["set"])
         return MsgType.OK, {}
 
@@ -1892,6 +2297,23 @@ class ServeController:
         return resolve_entry_point(name_or_entry)
 
     def _on_send_data(self, p):
+        epoch = p.pop(PLACEMENT_EPOCH_KEY, None)
+        slot = p.pop(SHARD_SLOT_KEY, None)
+        route = self._shard_route(p.get("db"), p.get("set"), epoch, slot)
+        if route == "handoff":
+            # the slot's shard is away: buffer EXACTLY this slot's
+            # batch at the leader; the readmit drain ships it (and
+            # only it) back — the shard-scoped resync. The drain rides
+            # the CLIENT's idempotency token: if the shard already
+            # applied this batch before the eviction (reply lost), its
+            # cache dedupes the drained copy instead of doubling it.
+            items = p.get("items")
+            count = int(getattr(items, "num_rows", None)
+                        or (len(items) if hasattr(items, "__len__")
+                            else 0))
+            self.shards.handoff_put(p["db"], p["set"], int(slot),
+                                    _idem_token_var.get(), p)
+            return MsgType.OK, {"count": count, "handoff": True}
         # objects arrive via the pickle codec (whole payload is a dict)
         if p.get("as_table"):
             # rows → one dictionary-encoded ColumnTable, sharded by the
@@ -1906,6 +2328,11 @@ class ServeController:
         return MsgType.OK, {"count": len(p["items"])}
 
     def _on_send_matrix(self, p):
+        if self.is_sharded(p.get("db"), p.get("set")):
+            raise ValueError(
+                f"set {p['db']}:{p['set']} is partitioned across the "
+                f"worker pool; tensor sets do not shard — use "
+                f"placement=None (mirror) for matrices")
         dense, block_shape = tensor_from_wire(p["tensor"])
         t = self.library.send_matrix(p["db"], p["set"], dense, block_shape)
         if t is None:
@@ -2086,6 +2513,29 @@ class ServeController:
         ships it page by page instead), and mesh-spanning placed items
         assemble their global value first (``_fetch_global``) — clients
         wanting summaries only should use ANALYZE_SET instead."""
+        entry = self.placement.entry(db, set_name)
+        if entry is not None:
+            # sharded set: chain every slot's scan in slot order — the
+            # leader's own partition streams locally, worker partitions
+            # stream over their pool connections (bounded frames)
+            for i, sl in enumerate(entry["slots"]):
+                if sl["state"] != _placement.LIVE:
+                    raise ShardUnavailable(
+                        f"slot {i} of {db}:{set_name} ({sl['addr']}) "
+                        f"is degraded; scan refused rather than return "
+                        f"a partial set", slot=i, epoch=entry["epoch"])
+            for sl in entry["slots"]:
+                if sl["addr"] == self.advertise_addr:
+                    yield from self._scan_items_local(db, set_name)
+                else:
+                    client = self.shards.client(sl["addr"])
+                    with contextlib.closing(
+                            client.scan_stream(db, set_name)) as items:
+                        yield from items
+            return
+        yield from self._scan_items_local(db, set_name)
+
+    def _scan_items_local(self, db: str, set_name: str):
         from netsdb_tpu.relational.outofcore import PagedColumns
         from netsdb_tpu.storage.paged import PagedObjects
         from netsdb_tpu.storage.store import _PagedMatrix
@@ -2172,7 +2622,11 @@ class ServeController:
         # non-paged set's items here would double-iterate it
         pc = None
         store = getattr(self.library, "store", None)
-        if store is not None:
+        if store is not None \
+                and not self.is_sharded(p["db"], p["set"]):
+            # a SHARDED set must take the generic path — _scan_items
+            # chains every slot; the paged fast-path below would
+            # stream only this daemon's local partition
             from netsdb_tpu.storage.store import SetIdentifier
 
             ident = SetIdentifier(p["db"], p["set"])
@@ -2322,6 +2776,8 @@ class ServeController:
         when the frame carried a qid."""
         sinks = p["sinks"]
         job_name = p.get("job_name", "remote-job")
+        if self._scatter_touched(sinks):
+            return self._execute_scatter(p, job_name, sinks)
 
         def run():
             results = self.library.execute_computations(
@@ -2334,6 +2790,54 @@ class ServeController:
         return self._execute_with_explain(
             p, job_name, run,
             scopes=_sched.sets_touched(MsgType.EXECUTE_COMPUTATIONS, p))
+
+    def _scatter_touched(self, sinks) -> bool:
+        """Does this DAG scan any set this daemon coordinates a
+        partitioned placement for? Empty map (every non-pool daemon)
+        short-circuits — the local path never pays a walk."""
+        if not len(self.placement):
+            return False
+        from netsdb_tpu.plan import scatter
+
+        return bool(scatter.sharded_scan_sets(sinks, self.is_sharded))
+
+    def _execute_scatter(self, p, job_name, sinks):
+        """Coordinator path for queries over partitioned sets: admit
+        ONE job (admission/lanes/affinity at the coordinator — one
+        client EXECUTE is one pool-wide execution), scatter subplans
+        to every shard slot, merge partials all-or-nothing, reply with
+        the same summary shape the local path produces. ``explain``
+        replies carry the coordinator slot's tree as ``operators``
+        (rendered exactly like a local EXPLAIN) plus the full
+        per-shard forest as ``shard_operators`` — every node annotated
+        with the daemon that executed its region."""
+        explain = bool(p.get("explain"))
+        tr = obs.current_trace()
+        qid = tr.qid if tr is not None else None
+        client = obs.attrib.current_client()
+        holder: Dict[str, Any] = {}
+
+        def run():
+            results, shard_ops = self.shards.scatter_execute(
+                sinks, job_name,
+                materialize=p.get("materialize", True),
+                explain=explain, qid=qid, client_id=client)
+            if p.get("sync", True):
+                self._sync_results(results)
+            holder["ops"] = shard_ops
+            return results
+
+        scopes = _sched.sets_touched(MsgType.EXECUTE_COMPUTATIONS,
+                                     {"sinks": sinks})
+        results = self._run_job(job_name, run, scopes=scopes)
+        out: Dict[str, Any] = {"results": self._result_summaries(results)}
+        if explain:
+            ops = holder.get("ops") or {}
+            local = ops.get(self.advertise_addr)
+            if local is not None:
+                out["operators"] = local
+            out["shard_operators"] = ops
+        return MsgType.OK, out
 
     def _execute_with_explain(self, p, job_name, run, scopes=()):
         """Shared EXECUTE tail: run the job (under an explain capture
@@ -2373,6 +2877,8 @@ class ServeController:
                     f"string or kwargs dict")
         sinks = parse_plan(p["plan"]).to_computations(registry)
         job_name = p.get("job_name", "remote-plan")
+        if self._scatter_touched(sinks):
+            return self._execute_scatter(p, job_name, sinks)
 
         def run():
             results = self.library.execute_computations(
@@ -2432,6 +2938,13 @@ class ServeController:
                                           {"local_only": True})
             if followers:
                 out["followers"] = followers
+            shards = self.shards.fanout(MsgType.COLLECT_STATS,
+                                        {"local_only": True})
+            if shards:
+                # per-shard sections, same best-effort merge contract
+                # as the follower fan-out (a slow shard reports an
+                # error entry, never gets evicted by a stats read)
+                out["shards"] = shards
         return MsgType.OK, out
 
     def _on_put_trace(self, p):
@@ -2478,6 +2991,15 @@ class ServeController:
                                           {"local_only": True})
             if followers:
                 out["followers"] = followers
+            shards = self.shards.fanout(MsgType.HEALTH,
+                                        {"local_only": True})
+            if shards:
+                out["shards"] = shards
+        if self._worker_addrs:
+            out["pool"] = {"workers": list(self._worker_addrs),
+                           "degraded": self.shards.degraded(),
+                           "placement_epoch":
+                               self.placement.to_wire()["epoch"]}
         return MsgType.OK, out
 
     def _on_get_trace(self, p):
@@ -2509,24 +3031,39 @@ class ServeController:
             profiles = self.trace_ring.last(int(n) if n else None)
         out: Dict[str, Any] = {"profiles": profiles,
                                "enabled": self._obs_enabled}
+
+        def _merge_sections(profs, replies, section):
+            merged = []
+            for prof in profs:
+                sections = {
+                    addr: [fp for fp in reply.get("profiles", ())
+                           if fp.get("qid") == prof.get("qid")]
+                    for addr, reply in replies.items()
+                    if "error" not in reply}
+                sections = {a: s for a, s in sections.items() if s}
+                if sections:
+                    prof = {**prof, section: sections}
+                merged.append(prof)
+            return merged
+
         if not p.get("local_only"):
             freplies = self._fanout_read(
                 MsgType.GET_TRACE, {"local_only": True, "qid": qid,
                                     "last": n})
             if freplies:
-                merged = []
-                for prof in profiles:
-                    sections = {
-                        addr: [fp for fp in reply.get("profiles", ())
-                               if fp.get("qid") == prof.get("qid")]
-                        for addr, reply in freplies.items()
-                        if "error" not in reply}
-                    sections = {a: s for a, s in sections.items() if s}
-                    if sections:
-                        prof = {**prof, "followers": sections}
-                    merged.append(prof)
-                out["profiles"] = merged
+                out["profiles"] = _merge_sections(out["profiles"],
+                                                  freplies, "followers")
                 out["followers"] = freplies
+            sreplies = self.shards.fanout(
+                MsgType.GET_TRACE, {"local_only": True, "qid": qid,
+                                    "last": n})
+            if sreplies:
+                # per-shard trace sections: a scatter-gather query's
+                # subplans ran on the shards UNDER THE SAME qid, so
+                # one logical query decomposes across the whole pool
+                out["profiles"] = _merge_sections(out["profiles"],
+                                                  sreplies, "shards")
+                out["shards"] = sreplies
         return MsgType.OK, out
 
     def _on_get_metrics(self, p):
@@ -2580,6 +3117,12 @@ class ServeController:
         from netsdb_tpu.client import table_info
         from netsdb_tpu.relational.table import ColumnTable
 
+        if self.is_sharded(p.get("db"), p.get("set")):
+            raise ValueError(
+                f"ANALYZE_SET over the partitioned set "
+                f"{p['db']}:{p['set']} is not supported yet — "
+                f"statistics would cover one shard's pages only; "
+                f"derive plan statics from ingest-side knowledge")
         items = self.library.store.get_items(
             SetIdentifier(p["db"], p["set"]))
         if len(items) == 1 and isinstance(items[0], ColumnTable):
@@ -2597,15 +3140,20 @@ class ServeController:
 def run_daemon(config: Configuration, host: str = "127.0.0.1",
                port: int = 8108, token: Optional[str] = None,
                max_jobs: Optional[int] = None,
-               followers: Optional[list] = None) -> int:
+               followers: Optional[list] = None,
+               workers: Optional[list] = None) -> int:
     """Start a daemon and block until shutdown — shared by the CLI
     ``serve`` subcommand and :func:`main`. ``followers``: worker-daemon
     addresses for multi-host fan-out (one per other jax.distributed
-    process; call ``parallel.distributed.initialize_cluster`` first)."""
+    process; call ``parallel.distributed.initialize_cluster`` first).
+    ``workers``: shard-daemon addresses forming this leader's
+    partitioned pool (horizontal scale-out — plain daemons, no
+    jax.distributed requirement)."""
     from netsdb_tpu.utils.profiling import get_logger
 
     ctl = ServeController(config, host=host, port=port, token=token,
-                          max_jobs=max_jobs, followers=followers)
+                          max_jobs=max_jobs, followers=followers,
+                          workers=workers)
     bound = ctl.start()
     get_logger("netsdb_tpu.serve", level="INFO").info(
         "netsdb_tpu serving on %s:%s", host, bound)
@@ -2628,13 +3176,19 @@ def main(argv=None) -> int:
                     help="comma-separated worker daemon addresses for "
                          "multi-host fan-out (jax.distributed must be "
                          "initialized in every process)")
+    ap.add_argument("--workers", default=None,
+                    help="comma-separated shard daemon addresses "
+                         "forming this leader's partitioned worker "
+                         "pool (horizontal scale-out)")
     args = ap.parse_args(argv)
     config = Configuration(root_dir=args.root) if args.root else DEFAULT_CONFIG
     followers = ([a.strip() for a in args.followers.split(",") if a.strip()]
                  if args.followers else None)
+    workers = ([a.strip() for a in args.workers.split(",") if a.strip()]
+               if args.workers else None)
     return run_daemon(config, host=args.host, port=args.port,
                       token=args.token, max_jobs=args.max_jobs,
-                      followers=followers)
+                      followers=followers, workers=workers)
 
 
 if __name__ == "__main__":

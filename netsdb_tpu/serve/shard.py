@@ -1,0 +1,895 @@
+"""Scatter-gather execution engine for the sharded worker pool.
+
+The coordinator half (:class:`ShardPool`, owned by the leader) maps a
+query's :class:`~netsdb_tpu.plan.scatter.ScatterSpec` onto the pool:
+one SUBPLAN per shard slot (the leader executes its own slot
+in-process — it IS slot 0 of every set it placed), bounded partials
+collected under one shared deadline, merged in slot order, the merged
+result materialized into the coordinator's store exactly like a local
+execution — so reads of the output set need no new wire surface.
+
+The shard half (:func:`execute_subplan`) runs a shipped subplan
+through the daemon's OWN executor over its local pages: staging, the
+device cache, scheduler affinity state and PR 10's fusion regions all
+apply per shard with zero new code — a shard executes its region
+program over local pages and ships only the bounded partial back
+(the *Large Scale Distributed Linear Algebra With TPUs* shape: each
+worker computes over only its panel, the coordinator merges bounded
+partials).
+
+The distributed shuffle (``shuffle_join`` specs) runs shard→shard:
+every slot hash-partitions both local join sides by the key's
+splitmix64 mix and ships bucket *j* to slot *j* as a SHUFFLE_PUT
+whose column buffers ride out-of-band v3 segments (no ``tobytes``
+copies anywhere on the path); each slot folds its own bucket and the
+coordinator merges outputs with the fold's declared ``merge`` — the
+grace-hash partition step run across daemons instead of arena spill
+partitions.
+
+Failure discipline: partials are merged ALL-or-nothing. Any slot
+failing (connection loss, epoch mismatch, deadline) discards every
+partial, evicts unreachable shards from placement (epoch bump) and
+surfaces the typed retryable ``ShardUnavailable``/``PlacementStale``
+to the client — never a partial or doubled merge.
+"""
+
+from __future__ import annotations
+
+import itertools
+import threading
+import time
+from typing import Any, Dict, List, Optional, Tuple
+
+import numpy as np
+
+from netsdb_tpu import obs
+from netsdb_tpu.serve import placement as _placement
+from netsdb_tpu.serve.errors import PlacementStale, ShardUnavailable
+from netsdb_tpu.serve.protocol import (
+    CLIENT_ID_KEY,
+    CODEC_MSGPACK,
+    CODEC_PICKLE,
+    IDEMPOTENCY_KEY,
+    PLACEMENT_EPOCH_KEY,
+    QUERY_ID_KEY,
+    SHARD_SLOT_KEY,
+    MsgType,
+)
+from netsdb_tpu.utils.locks import TrackedLock
+from netsdb_tpu.utils.timing import deadline_after, seconds_left
+
+_shuffle_ids = itertools.count(1)
+
+
+def _np_tree(value: Any) -> Any:
+    """Fold state → numpy pytree for the wire (device arrays must not
+    ride a pickle frame)."""
+    import jax
+
+    return jax.tree_util.tree_map(np.asarray, value)
+
+
+def local_table(ctl, db: str, set_name: str):
+    """This daemon's local partition of a table set as ONE host
+    ColumnTable (paged relations assemble off the arena; resident
+    relations compact their validity). None when the set holds no
+    table — an empty shard's legitimate state."""
+    from netsdb_tpu.relational.outofcore import PagedColumns
+    from netsdb_tpu.relational.table import ColumnTable
+    from netsdb_tpu.storage.store import SetIdentifier
+
+    items = ctl.library.store.get_items(SetIdentifier(db, set_name))
+    for item in items:
+        if isinstance(item, PagedColumns):
+            return item.to_host_table()
+        if isinstance(item, ColumnTable):
+            return item.compact() if item.valid is not None else item
+    return None
+
+
+def local_schema(ctl, db: str, set_name: str) -> Tuple[Dict, int]:
+    """(dicts, num_rows) of this daemon's local partition — the schema
+    surface a scatterable fold's coordinator-side finalize may read."""
+    from netsdb_tpu.relational.outofcore import PagedColumns
+    from netsdb_tpu.relational.table import ColumnTable
+    from netsdb_tpu.storage.store import SetIdentifier
+
+    items = ctl.library.store.get_items(SetIdentifier(db, set_name))
+    for item in items:
+        if isinstance(item, (PagedColumns, ColumnTable)):
+            return dict(item.dicts), int(item.num_rows)
+    return {}, 0
+
+
+class ShuffleInbox:
+    """Bounded store of inbound distributed-shuffle buckets, keyed by
+    (shuffle id, side, sender slot). Senders may retry — a duplicate
+    put overwrites its own key (byte-identical content), so the
+    receiving leg can never double-count a bucket. Entries a leg never
+    claims are pruned by TTL on later puts."""
+
+    def __init__(self, max_bytes: int = 1 << 30, ttl_s: float = 600.0):
+        self._mu = TrackedLock("serve.ShuffleInbox._mu")
+        self._cv = threading.Condition(self._mu)
+        self._entries: Dict[str, Dict[str, Any]] = {}
+        self._bytes = 0
+        self._max_bytes = int(max_bytes)
+        self._ttl_s = float(ttl_s)
+
+    @staticmethod
+    def _size(cols: Optional[Dict[str, np.ndarray]]) -> int:
+        return sum(np.asarray(v).nbytes for v in (cols or {}).values())
+
+    def put(self, sid: str, side: str, slot: int,
+            cols: Optional[Dict[str, np.ndarray]],
+            dicts: Optional[Dict] = None) -> None:
+        nbytes = self._size(cols)
+        with self._cv:
+            self._prune_locked()
+            entry = self._entries.setdefault(
+                sid, {"sides": {}, "bytes": 0, "t": time.monotonic()})
+            old = entry["sides"].get(side, {}).get(slot)
+            # the cap judges the NET delta: a duplicate put (sender
+            # retry) overwrites its own byte-identical key, so it must
+            # never be refused against bytes it is about to replace
+            old_bytes = self._size(old[0]) if old is not None else 0
+            if self._bytes - old_bytes + nbytes > self._max_bytes:
+                raise ShardUnavailable(
+                    f"shuffle inbox over its {self._max_bytes}-byte "
+                    f"bound; retry shortly")
+            if old is not None:
+                entry["bytes"] -= old_bytes
+                self._bytes -= old_bytes
+            entry["sides"].setdefault(side, {})[slot] = (cols, dicts)
+            entry["bytes"] += nbytes
+            self._bytes += nbytes
+            self._cv.notify_all()
+
+    def wait(self, sid: str, sides: Dict[str, int],
+             timeout_s: float) -> Dict[str, Dict[int, Tuple]]:
+        """Block until ``sid`` holds ``sides[side]`` buckets per side
+        (or raise typed retryable on timeout), then POP the entry."""
+        if not sides or all(n <= 0 for n in sides.values()):
+            return {}  # single-slot pool: nothing to exchange
+        deadline = deadline_after(timeout_s)
+        with self._cv:
+            while True:
+                entry = self._entries.get(sid)
+                if entry is not None and all(
+                        len(entry["sides"].get(side, {})) >= n
+                        for side, n in sides.items()):
+                    self._entries.pop(sid)
+                    self._bytes -= entry["bytes"]
+                    return entry["sides"]
+                left = seconds_left(deadline)
+                if left <= 0 or not self._cv.wait(left):
+                    # re-check ONCE under the lock before failing:
+                    # the final bucket's put may have landed (and
+                    # notified) in the same instant the wait timed out
+                    entry = self._entries.get(sid)
+                    if entry is not None and all(
+                            len(entry["sides"].get(side, {})) >= n
+                            for side, n in sides.items()):
+                        continue
+                    got = {s: len((entry or {}).get("sides", {})
+                                  .get(s, {})) for s in sides}
+                    raise ShardUnavailable(
+                        f"distributed shuffle {sid} incomplete after "
+                        f"{timeout_s}s (received {got}, expected "
+                        f"{sides}) — a peer shard is unreachable")
+
+    def _prune_locked(self) -> None:
+        cutoff = time.monotonic() - self._ttl_s
+        for sid in [s for s, e in self._entries.items()
+                    if e["t"] < cutoff]:
+            self._bytes -= self._entries[sid]["bytes"]
+            self._entries.pop(sid)
+
+
+# --- shard-side subplan execution ------------------------------------
+
+def check_epochs(ctl, epochs: Dict[str, int]) -> None:
+    """Validate a routed frame's placement epochs against what this
+    daemon was registered under (worker: ``_shard_sets``; leader: its
+    own placement map). A mismatch is the typed retryable
+    placement-epoch rejection — the frame is refused WHOLE before any
+    execution, so a revised membership can never partially apply."""
+    for scope, epoch in (epochs or {}).items():
+        db, _, set_name = scope.partition(":")
+        current = None
+        reg = ctl.shard_registration(db, set_name)
+        if reg is not None:
+            current = reg["epoch"]
+        else:
+            entry = ctl.placement.entry(db, set_name)
+            if entry is not None:
+                current = entry["epoch"]
+        if current is None or int(epoch) != int(current):
+            obs.REGISTRY.counter("shard.epoch_rejects").inc()
+            raise PlacementStale(
+                f"placement epoch rejected for {scope}: frame rode "
+                f"epoch {epoch}, daemon registered "
+                f"{current if current is not None else 'none'}",
+                epoch=current)
+
+
+def execute_subplan(ctl, p: dict) -> dict:
+    """One shard's leg of a scatter-gather execution (also run
+    in-process by the coordinator for its own slot). Returns the
+    bounded partial the coordinator merges."""
+    obs.REGISTRY.counter("shard.subplans").inc()
+    check_epochs(ctl, p.get("epochs"))
+    kind = p["kind"]
+    if kind == "shuffle_join":
+        return _execute_shuffle_leg(ctl, p)
+    explain = bool(p.get("explain"))
+
+    def run():
+        results = ctl.library.execute_computations(
+            *p["sinks"], job_name=f"{p.get('job_name', 'scatter')}@shard",
+            materialize=False)
+        return next(iter(results.values()))
+
+    with obs.span("server.shard.subplan", "serve"):
+        if explain:
+            with obs.operators.explain_capture() as cap:
+                value = run()
+            tree = cap.get("operators")
+        else:
+            value = run()
+            tree = None
+    out: Dict[str, Any] = {}
+    if kind == "fold_state":
+        db, set_name = p["scan"]
+        dicts, rows = local_schema(ctl, db, set_name)
+        out.update(state=_np_tree(value), dicts=dicts, rows=rows)
+    else:  # group_partial — the dict IS the partial
+        out["groups"] = value
+    if tree is not None:
+        out["operators"] = tree
+    return out
+
+
+def _partition_cols(table, key: str, nslots: int,
+                    columns: Optional[Tuple[str, ...]] = None
+                    ) -> List[Optional[Dict[str, np.ndarray]]]:
+    """Hash-partition one table's rows by ``key`` into per-slot column
+    dicts (splitmix64 mix — the same rule ingest-time hash placement
+    uses, so the two agree). ``columns`` projects the carried columns
+    (the fold's declared probe columns + the key), cutting shuffle
+    bytes the way the arena grace partitioner already does."""
+    if table is None:
+        return [None] * nslots
+    names = list(table.cols)
+    if columns:
+        keep = set(columns) | {key}
+        names = [n for n in names if n in keep]
+    cols = {n: np.asarray(table.cols[n]) for n in names}
+    slot_ids = _placement.hash_slot_ids(cols[key], nslots)
+    out: List[Optional[Dict[str, np.ndarray]]] = []
+    for j in range(nslots):
+        idx = np.nonzero(slot_ids == j)[0]
+        out.append({n: v[idx] for n, v in cols.items()})
+    return out
+
+
+def _execute_shuffle_leg(ctl, p: dict) -> dict:
+    """One slot's leg of the distributed shuffle join: partition both
+    local sides, exchange buckets with every peer slot, fold the own
+    bucket, return the partial output."""
+    from netsdb_tpu.relational.table import ColumnTable
+
+    fold = p["fold"]
+    slot = int(p["slot"])
+    addrs = list(p["addrs"])
+    nslots = len(addrs)
+    sid = p["sid"]
+    sides = (("probe", tuple(p["probe"]), fold.probe_key,
+              tuple(fold.probe_columns) if fold.probe_columns else None),
+             ("build", tuple(p["build"]), fold.build_key, None))
+    own: Dict[str, Tuple] = {}
+    dicts_by_side: Dict[str, Dict] = {}
+    with obs.span("server.shard.shuffle", "serve"):
+        for side, (db, set_name), key, columns in sides:
+            table = local_table(ctl, db, set_name)
+            dicts_by_side[side] = dict(table.dicts) if table is not None \
+                else {}
+            buckets = _partition_cols(table, key, nslots, columns)
+            for j in range(nslots):
+                if j == slot:
+                    own[side] = (buckets[j], dicts_by_side[side])
+                    continue
+                payload = {"sid": sid, "side": side, "slot": slot,
+                           "cols": buckets[j],
+                           "dicts": dicts_by_side[side]}
+                # data connection: the peer's CONTROL connection is
+                # busy carrying its own in-flight SUBPLAN
+                ctl.shards.data_client(addrs[j])._request(
+                    MsgType.SHUFFLE_PUT, payload, CODEC_MSGPACK)
+        inbound = ctl._shuffle.wait(
+            sid, {side: nslots - 1 for side, *_ in sides} if nslots > 1
+            else {},
+            float(p.get("shuffle_timeout_s") or 120.0))
+
+    tables: Dict[str, Any] = {}
+    for side, _ident, key, _cols in sides:
+        parts: List[Dict[str, np.ndarray]] = []
+        dicts = dict(dicts_by_side.get(side) or {})
+        for j in range(nslots):
+            if j == slot:
+                cols = own[side][0]
+            else:
+                cols, peer_dicts = inbound.get(side, {}).get(
+                    j, (None, None))
+                for name, vocab in (peer_dicts or {}).items():
+                    if name in dicts and list(dicts[name]) \
+                            != list(vocab):
+                        # concatenating RAW code columns is only sound
+                        # when every shard encoded under the SAME
+                        # dictionary; divergent vocabularies (possible
+                        # under multi-batch hash ingest where a batch
+                        # skipped a slot) would silently decode codes
+                        # through the wrong vocab — refuse loudly
+                        raise ValueError(
+                            f"distributed shuffle: shard {j}'s "
+                            f"dictionary for column {name!r} diverges "
+                            f"from shard {slot}'s; re-ingest the set "
+                            f"with aligned dictionaries")
+                    dicts.setdefault(name, vocab)
+            if cols is not None and cols:
+                parts.append(cols)
+        if not parts:
+            tables[side] = None
+            continue
+        names = list(parts[0])
+        tables[side] = ColumnTable(
+            {n: np.concatenate([np.asarray(c[n]) for c in parts])
+             for n in names}, dicts, None)
+    if tables["probe"] is None or tables["build"] is None:
+        # a legitimately empty bucket: the fold still needs SOME table
+        # shape — report the empty partial and let the merge skip it
+        return {"table": None}
+    t0 = time.perf_counter()
+    with obs.span("server.shard.subplan", "serve"):
+        out = fold.whole(tables["probe"], tables["build"])
+    reply: Dict[str, Any] = {"table": out}
+    if p.get("explain"):
+        # the shuffle leg runs outside the executor (no per-node
+        # recorder) — report a one-node tree so the per-shard EXPLAIN
+        # forest stays complete: kind, wall, probe/build row counts
+        wall = time.perf_counter() - t0
+        reply["operators"] = {
+            "job": p.get("job_name", "scatter"), "mode": "shuffle",
+            "total_wall_s": wall,
+            "nodes": [{
+                "id": 0, "kind": "ShuffleJoin",
+                "label": f"{fold.probe_key}={fold.build_key}",
+                "inputs": [], "wall_s": wall,
+                "rows_in": int(tables["probe"].num_rows),
+                "rows_out": int(getattr(out, "num_rows", 0) or 0),
+                "counters": {}}]}
+    return reply
+
+
+# --- results materialization (the executor's rule, shared) -----------
+
+def materialize_result(store, ident, out) -> None:
+    """Write one merged scatter result into the coordinator's store
+    exactly the way ``plan/executor.py`` materializes a sink — reads
+    of the output set then behave identically to a local execution."""
+    import jax
+
+    from netsdb_tpu.core.blocked import BlockedTensor
+    from netsdb_tpu.relational.table import ColumnTable
+
+    store.create_set(ident)
+    if isinstance(out, BlockedTensor):
+        store.put_tensor(ident, out)
+    elif isinstance(out, (ColumnTable, jax.Array)):
+        store.clear_set(ident)
+        store.add_data(ident, [out])
+    elif isinstance(out, dict):
+        store.clear_set(ident)
+        store.add_data(ident, list(out.items()))
+    else:
+        store.clear_set(ident)
+        store.add_data(ident, list(out))
+
+
+def _annotate_shard(tree: Any, addr: str) -> Any:
+    """Mark every node of one shard's EXPLAIN tree with the daemon
+    that executed it (the pushed-region annotation)."""
+    if isinstance(tree, dict):
+        out = {k: _annotate_shard(v, addr) if k == "children" else v
+               for k, v in tree.items()}
+        out["shard"] = addr
+        return out
+    if isinstance(tree, list):
+        return [_annotate_shard(t, addr) for t in tree]
+    return tree
+
+
+class ShardPool:
+    """Per-controller pool state: cached connections to shard peers,
+    the leader's handoff buffers for degraded slots, and the
+    coordinator entry point. Workers carry one too (empty worker list)
+    purely as the peer-connection cache the distributed shuffle
+    dials through."""
+
+    def __init__(self, ctl, handoff_max_bytes: int = 256 << 20):
+        self.ctl = ctl
+        self._mu = TrackedLock("serve.ShardPool._mu")
+        self._clients: Dict[str, Any] = {}
+        self._degraded: Dict[str, str] = {}
+        # (db, set, slot) → [(token, payload)] ingest buffered while
+        # the slot's shard is away; drained — only these pages, never
+        # a whole-store snapshot — on readmit
+        self._handoff: Dict[Tuple[str, str, int], List[Tuple[str, dict]]] \
+            = {}
+        self._handoff_bytes = 0
+        self._handoff_max = int(handoff_max_bytes)
+
+    # --- connections --------------------------------------------------
+    def client(self, addr: str):
+        """Cached pool connection (mirror-path semantics: no silent
+        client-side retries — a failure must surface so the
+        coordinator can evict + refuse typed)."""
+        from netsdb_tpu.serve.client import RemoteClient, RetryPolicy
+
+        with self._mu:
+            c = self._clients.get(addr)
+        if c is not None:
+            return c
+        dial = addr.partition(":")[2] if addr.startswith("data:") \
+            else addr
+        c = RemoteClient(dial, token=self.ctl.token,
+                         retry=RetryPolicy(max_attempts=1),
+                         timeout=self.ctl.mirror_ack_timeout_s,
+                         connect_timeout=self.ctl.handshake_timeout_s)
+        with self._mu:
+            other = self._clients.setdefault(addr, c)
+        if other is not c:
+            c.close()
+        return other
+
+    def data_client(self, addr: str):
+        """Separate connection pool for SHUFFLE_PUT traffic. The
+        control connection to a shard is OCCUPIED for the whole
+        in-flight SUBPLAN (one request per connection), and a shuffle
+        leg must push buckets to that same shard WHILE its subplan
+        runs — sharing the connection would deadlock the exchange
+        (bucket waits for subplan reply, subplan waits for bucket)."""
+        return self.client(f"data:{addr}")
+
+    def fresh_client(self, addr: str):
+        """UNCACHED connection for one in-flight subplan. Subplans do
+        not share the pooled control connection: (a) concurrent
+        scatter queries would serialize per shard behind its one
+        connection lock, and (b) the scatter deadline unsticks a slow
+        slot by force-closing its socket — which must kill exactly
+        THAT query's request, never a concurrent healthy query that
+        happened to share the connection (whose failure would then
+        evict a healthy shard). The caller owns close()."""
+        from netsdb_tpu.serve.client import RemoteClient, RetryPolicy
+
+        return RemoteClient(addr, token=self.ctl.token,
+                            retry=RetryPolicy(max_attempts=1),
+                            timeout=self.ctl.mirror_ack_timeout_s,
+                            connect_timeout=self.ctl.handshake_timeout_s)
+
+    def drop_client(self, addr: str) -> None:
+        for key in (addr, f"data:{addr}"):
+            with self._mu:
+                c = self._clients.pop(key, None)
+            if c is not None:
+                c._force_close()
+
+    def peer_request(self, addr: str, typ, payload,
+                     codec: int = CODEC_MSGPACK):
+        return self.client(addr)._request(typ, payload, codec)
+
+    def close(self) -> None:
+        with self._mu:
+            clients = list(self._clients.values())
+            self._clients.clear()
+        for c in clients:
+            c.close()
+
+    # --- degraded bookkeeping ----------------------------------------
+    def degrade(self, addr: str, reason: str) -> None:
+        with self._mu:
+            fresh = addr not in self._degraded
+            self._degraded[addr] = reason
+        if fresh:
+            obs.REGISTRY.counter("shard.evictions").inc()
+        changed = self.ctl.placement.degrade_addr(addr)
+        self.drop_client(addr)
+        if changed:
+            # the bump is leader-local until the surviving workers
+            # re-register under it (best-effort push)
+            self.ctl._push_epochs(exclude=(addr,))
+
+    def is_degraded(self, addr: str) -> bool:
+        with self._mu:
+            return addr in self._degraded
+
+    def clear_degraded(self, addr: str) -> None:
+        with self._mu:
+            self._degraded.pop(addr, None)
+
+    def degraded(self) -> Dict[str, str]:
+        with self._mu:
+            return dict(self._degraded)
+
+    # --- handoff (the shard-scoped resync buffer) ---------------------
+    @staticmethod
+    def _payload_bytes(p: dict) -> int:
+        items = p.get("items")
+        if hasattr(items, "cols"):
+            return int(sum(np.asarray(v).nbytes
+                           for v in items.cols.values()))
+        try:
+            return 256 * len(items)
+        except TypeError:
+            return 1 << 20
+
+    def handoff_put(self, db: str, set_name: str, slot: int,
+                    token: Optional[str], payload: dict) -> None:
+        import uuid
+
+        # the batch drains under the CLIENT's idempotency token when
+        # the frame carried one (a shard that already applied the
+        # original then dedupes the drained copy); otherwise a DRAIN
+        # token minted here keeps a retried drain itself at-most-once
+        token = token or uuid.uuid4().hex
+        nbytes = self._payload_bytes(payload)
+        rec = (token, dict(payload))
+        key = (db, set_name, slot)
+        with self._mu:
+            if self._handoff_bytes + nbytes > self._handoff_max:
+                raise ShardUnavailable(
+                    f"handoff buffer for degraded shard slot {slot} is "
+                    f"full ({self._handoff_max} bytes); retry later",
+                    slot=slot)
+            self._handoff.setdefault(key, []).append(rec)
+            self._handoff_bytes += nbytes
+        # close the buffer-vs-readmit race: if the slot flipped LIVE
+        # while this frame was in flight, the readmit drain may
+        # already have run — a batch inserted after its final sweep
+        # would otherwise strand in the buffer forever. Re-check and,
+        # when the slot is no longer in handoff, pull the batch back
+        # out and reject typed (the client re-routes to the live
+        # shard); if the drain already shipped it, it was delivered.
+        entry = self.ctl.placement.entry(db, set_name)
+        sl = (entry["slots"][slot]
+              if entry is not None and slot < len(entry["slots"])
+              else None)
+        if sl is None or sl["state"] != _placement.HANDOFF:
+            with self._mu:
+                cur = self._handoff.get(key, [])
+                if rec in cur:
+                    cur.remove(rec)
+                    self._handoff_bytes -= nbytes
+                    if not cur:
+                        self._handoff.pop(key, None)
+                    raise PlacementStale(
+                        f"slot {slot} of {db}:{set_name} readmitted "
+                        f"mid-buffer; re-route to the live shard",
+                        epoch=entry["epoch"] if entry else None)
+            return  # drained concurrently — delivered, not buffered
+        obs.REGISTRY.counter("shard.handoff_batches").inc()
+
+    def handoff_pending(self, addr: str) -> int:
+        """Buffered batches destined for ``addr``'s slots (test and
+        readmit-drain probe)."""
+        count = 0
+        for db, set_name in self.ctl.placement.sets_for_addr(addr):
+            entry = self.ctl.placement.entry(db, set_name)
+            for i, s in enumerate(entry["slots"]):
+                if s["addr"] != addr:
+                    continue
+                with self._mu:
+                    count += len(self._handoff.get((db, set_name, i),
+                                                   ()))
+        return count
+
+    def purge_handoff(self, db: str, set_name: str) -> int:
+        """Drop every buffered handoff batch of one set (REMOVE/CLEAR
+        — the pages it would have delivered no longer exist). Returns
+        the batch count dropped; keeps the byte accounting exact."""
+        dropped = 0
+        with self._mu:
+            for key in [k for k in self._handoff
+                        if k[0] == db and k[1] == set_name]:
+                gone = self._handoff.pop(key)
+                dropped += len(gone)
+                self._handoff_bytes -= sum(self._payload_bytes(p)
+                                           for _, p in gone)
+        return dropped
+
+    def drain_handoff(self, addr: str) -> int:
+        """Ship a readmitted shard exactly its own buffered pages (the
+        shard-scoped resync — contrast RESYNC_FOLLOWER's whole-store
+        snapshot). Buffered idempotency tokens ride along, so a drain
+        retried after a mid-drain failure can never double-apply.
+        Batches are removed from the buffer only AFTER they shipped,
+        exactly the ones that shipped — a batch buffered concurrently
+        (a frame classified handoff just before the epoch flipped) is
+        picked up by the drain loop's next round, never dropped."""
+        drained = 0
+        for db, set_name in self.ctl.placement.sets_for_addr(addr):
+            entry = self.ctl.placement.entry(db, set_name)
+            for i, s in enumerate(entry["slots"]):
+                if s["addr"] != addr:
+                    continue
+                key = (db, set_name, i)
+                while True:
+                    with self._mu:
+                        batches = list(self._handoff.get(key, ()))
+                    if not batches:
+                        break
+                    for token, payload in batches:
+                        fwd = dict(payload)
+                        fwd[PLACEMENT_EPOCH_KEY] = entry["epoch"]
+                        fwd[SHARD_SLOT_KEY] = i
+                        if token:
+                            fwd[IDEMPOTENCY_KEY] = token
+                        self.peer_request(addr, MsgType.SEND_DATA,
+                                          fwd, CODEC_PICKLE)
+                        drained += 1
+                    with self._mu:
+                        cur = self._handoff.get(key, [])
+                        # the sent batches are the FIFO prefix; drop
+                        # exactly them, keep any concurrent arrivals
+                        rest = cur[len(batches):]
+                        self._handoff_bytes -= sum(
+                            self._payload_bytes(p)
+                            for _, p in cur[:len(batches)])
+                        if rest:
+                            self._handoff[key] = rest
+                        else:
+                            self._handoff.pop(key, None)
+        if drained:
+            obs.REGISTRY.counter("shard.handoff_drained").inc(drained)
+        return drained
+
+    # --- read fan-out (stats/trace/health shard sections) -------------
+    def fanout(self, typ, payload) -> Dict[str, Any]:
+        """Best-effort read fan-out to every worker — the shard twin
+        of the follower ``_fanout_read`` merge: one shared deadline, a
+        slow shard reports an error entry and is NEVER evicted by a
+        stats read."""
+        addrs = list(self.ctl._worker_addrs)
+        if not addrs:
+            return {}
+        out: Dict[str, Any] = {}
+        deadline = deadline_after(self.ctl.frame_timeout_s)
+        threads = []
+
+        def ask(addr):
+            try:
+                out[addr] = self.peer_request(addr, typ, payload)
+            except Exception as e:  # noqa: BLE001 — best-effort section
+                out[addr] = {"error": f"{type(e).__name__}: {e}"}
+
+        for addr in addrs:
+            t = threading.Thread(target=ask, args=(addr,), daemon=True)
+            t.start()
+            threads.append(t)
+        for t in threads:
+            t.join(max(0.0, seconds_left(deadline)))
+        for addr in addrs:
+            out.setdefault(addr, {"error": "no reply within "
+                                           f"{self.ctl.frame_timeout_s}s"})
+        return out
+
+    # --- the coordinator ----------------------------------------------
+    def scatter_execute(self, sinks: List[Any], job_name: str,
+                        materialize: bool = True,
+                        explain: bool = False,
+                        qid: Optional[str] = None,
+                        client_id: Optional[str] = None):
+        """Execute one sink DAG over the pool: analyze, fan out, merge
+        all-or-nothing, materialize. Returns ``(results, shard_ops)``
+        — ``shard_ops`` is the per-shard EXPLAIN forest (None unless
+        ``explain``)."""
+        from netsdb_tpu.plan import scatter
+        from netsdb_tpu.storage.store import SetIdentifier
+
+        ctl = self.ctl
+        spec = scatter.analyze_sinks(sinks, ctl.is_sharded)
+        if spec is None:
+            touched = scatter.sharded_scan_sets(sinks, ctl.is_sharded)
+            raise ValueError(
+                f"query scans partitioned set(s) "
+                f"{[f'{d}:{s}' for d, s in touched]} in a shape "
+                f"scatter-gather cannot push (supported: single-pass "
+                f"folds declaring state_merge, dict group-bys with "
+                f"combine, grace-hash joins with declared keys+merge); "
+                f"a partitioned set's pages live only on its shards, "
+                f"so there is no local fallback")
+        entries = {}
+        for db, s in spec.scan_sets:
+            entry = ctl.placement.entry(db, s)
+            entries[(db, s)] = entry
+            for i, sl in enumerate(entry["slots"]):
+                if sl["state"] != _placement.LIVE:
+                    raise ShardUnavailable(
+                        f"shard slot {i} of {db}:{s} ({sl['addr']}) is "
+                        f"degraded; scatter-gather refuses rather than "
+                        f"merge a partial result", slot=i,
+                        epoch=entry["epoch"])
+        first = entries[spec.scan_sets[0]]
+        addrs = [sl["addr"] for sl in first["slots"]]
+        for (db, s), e in entries.items():
+            if [sl["addr"] for sl in e["slots"]] != addrs:
+                raise ValueError(
+                    f"sets {spec.scan_sets} are not co-placed on one "
+                    f"pool; cross-pool scatter is unsupported")
+        epochs = {f"{db}:{s}": e["epoch"] for (db, s), e in
+                  entries.items()}
+        payload: Dict[str, Any] = {
+            "kind": spec.kind, "job_name": job_name,
+            "explain": bool(explain), "epochs": epochs,
+        }
+        if spec.kind == "shuffle_join":
+            payload.update(
+                sid=f"{ctl.advertise_addr}#{next(_shuffle_ids)}",
+                addrs=addrs, probe=list(spec.probe),
+                build=list(spec.build), fold=spec.fold,
+                shuffle_timeout_s=min(
+                    ctl.mirror_ack_timeout_s or 120.0, 120.0))
+        else:
+            psink = scatter.partial_sink(spec)
+            payload["sinks"] = [psink]
+            if spec.kind == "fold_state":
+                payload["scan"] = [spec.scan_sets[0][0],
+                                   spec.scan_sets[0][1]]
+        obs.REGISTRY.counter("shard.scatter_queries").inc()
+
+        replies: List[Optional[dict]] = [None] * len(addrs)
+        failures: List[Tuple[int, str, BaseException]] = []
+        conns: Dict[int, Any] = {}  # this query's OWN connections
+
+        def run_slot(i: int, addr: str) -> None:
+            p = dict(payload)
+            if spec.kind == "shuffle_join":
+                p["slot"] = i
+            try:
+                if addr == ctl.advertise_addr:
+                    replies[i] = execute_subplan(ctl, p)
+                    return
+                if qid is not None:
+                    p[QUERY_ID_KEY] = qid
+                if client_id is not None:
+                    p[CLIENT_ID_KEY] = client_id
+                sc = self.fresh_client(addr)
+                conns[i] = sc
+                try:
+                    replies[i] = sc._request(MsgType.SUBPLAN, p,
+                                             CODEC_PICKLE)
+                finally:
+                    sc.close()
+            except BaseException as e:  # noqa: BLE001 — typed below
+                failures.append((i, addr, e))
+
+        threads = []
+        local = None
+        for i, addr in enumerate(addrs):
+            if addr == ctl.advertise_addr:
+                local = (i, addr)
+                continue
+            t = threading.Thread(target=run_slot, args=(i, addr),
+                                 daemon=True,
+                                 name=f"netsdb-scatter-{i}")
+            t.start()
+            threads.append((i, addr, t))
+        if local is not None:
+            run_slot(*local)
+        deadline = deadline_after(ctl.mirror_ack_timeout_s or 300.0)
+        for i, addr, t in threads:
+            t.join(max(0.0, seconds_left(deadline)))
+            if t.is_alive():
+                failures.append((i, addr, TimeoutError(
+                    f"no subplan reply within the "
+                    f"{ctl.mirror_ack_timeout_s}s budget")))
+                # force-close THIS query's own connection — unblocks
+                # the parked thread without touching any concurrent
+                # query's traffic to the same shard
+                sc = conns.get(i)
+                if sc is not None:
+                    sc._force_close()
+        if failures:
+            self._raise_scatter_failure(spec, entries, failures)
+        return self._merge(spec, entries, addrs, replies, materialize,
+                           explain)
+
+    def _raise_scatter_failure(self, spec, entries, failures) -> None:
+        """ALL partials are discarded; unreachable shards evict
+        (epoch bump — in-flight stale routes now reject typed)."""
+        from netsdb_tpu.serve.errors import (
+            PlacementStaleError,
+            RemoteError,
+            ShardUnavailableError,
+        )
+
+        parts = []
+        fatal: Optional[BaseException] = None
+        stale = 0
+        for i, addr, e in failures:
+            parts.append(f"slot {i} ({addr}): {type(e).__name__}: {e}")
+            if isinstance(e, PlacementStaleError):
+                stale += 1  # membership moved; the shard is healthy
+            elif isinstance(e, ShardUnavailableError):
+                # an ANSWERED capacity refusal (e.g. a peer's shuffle
+                # inbox over budget) — the refusing daemon is alive
+                # and so is this one; evicting the SENDER for the
+                # receiver's backpressure would churn pool membership
+                # on transient load. Surface retryable, evict nobody.
+                pass
+            elif isinstance(e, RemoteError) and not e.retryable:
+                # the shard ANSWERED with a deterministic refusal —
+                # the query is wrong, not the pool; don't evict
+                fatal = fatal or e
+            else:
+                # transport loss / timeout / retryable fault: the
+                # shard is unreachable or unhealthy — evict it so the
+                # map (and every in-flight stale route) moves on
+                self.degrade(addr, f"subplan failed: "
+                                   f"{type(e).__name__}: {e}")
+        if fatal is not None:
+            raise fatal
+        if stale == len(failures):
+            raise PlacementStale(
+                "scatter-gather raced a placement change; partials "
+                "discarded — retry re-routes against the current map: "
+                + "; ".join(parts))
+        raise ShardUnavailable(
+            "scatter-gather failed; partials discarded (never merged): "
+            + "; ".join(parts))
+
+    def _merge(self, spec, entries, addrs, replies, materialize,
+               explain):
+        from netsdb_tpu.plan import scatter
+        from netsdb_tpu.storage.store import SetIdentifier
+
+        obs.REGISTRY.counter("shard.partials_merged").inc(len(replies))
+        shard_ops = None
+        if explain:
+            shard_ops = {
+                addrs[i]: _annotate_shard(r["operators"], addrs[i])
+                for i, r in enumerate(replies)
+                if r and r.get("operators") is not None}
+        if spec.kind == "fold_state":
+            states = [r["state"] for r in replies]
+            dicts: Dict[str, list] = {}
+            rows = 0
+            for r in replies:
+                for k, v in (r.get("dicts") or {}).items():
+                    if k in dicts and list(dicts[k]) != list(v):
+                        # per-shard group codes were accumulated under
+                        # divergent vocabularies — a merged finalize
+                        # would decode them wrong; refuse loudly
+                        raise ValueError(
+                            f"scatter merge: shard dictionaries for "
+                            f"column {k!r} diverge; re-ingest the set "
+                            f"with aligned dictionaries")
+                    dicts.setdefault(k, v)
+                rows += int(r.get("rows") or 0)
+            value = scatter.merge_fold_states(spec.fold, states, dicts,
+                                              rows)
+        elif spec.kind == "group_partial":
+            value = scatter.merge_group_dicts(
+                spec.node, [r["groups"] for r in replies])
+        else:
+            tables = [r["table"] for r in replies
+                      if r.get("table") is not None]
+            if not tables:
+                raise ValueError(
+                    "distributed shuffle produced no partials (both "
+                    "join sides empty on every shard)")
+            value = scatter.merge_join_outputs(spec.fold, tables)
+        ident = SetIdentifier(spec.sink.db, spec.sink.set_name)
+        if materialize:
+            materialize_result(self.ctl.library.store, ident, value)
+        return {ident: value}, shard_ops

@@ -536,6 +536,279 @@ def run_device_cache_bench(rows: int = 1_200_000, page_rows: int = 65_536,
     return out
 
 
+# --- horizontal scale-out (--scale) ----------------------------------
+
+def scaleout_table(rows: int, seed: int = 0):
+    """The q01-style paged workload with INTEGER measures: partial
+    sums stay exactly representable, so the 4-daemon scatter-gather
+    result must be BYTE-equal to the 1-daemon run (float q01 differs
+    by merge-order reassociation in the last ulp — this workload is
+    the acceptance oracle, the shape is identical)."""
+    import numpy as np
+
+    from netsdb_tpu.relational.table import ColumnTable
+
+    rng = np.random.default_rng(seed)
+    cols = {
+        "l_shipdate": rng.integers(19920101, 19981231, rows,
+                                   dtype=np.int32),
+        "l_returnflag": rng.integers(0, 3, rows, dtype=np.int32),
+        "l_linestatus": rng.integers(0, 2, rows, dtype=np.int32),
+        "l_quantity": rng.integers(1, 51, rows, dtype=np.int32),
+        "l_price": rng.integers(1, 1000, rows, dtype=np.int32),
+    }
+    return ColumnTable(cols, {"l_returnflag": ["A", "N", "R"],
+                              "l_linestatus": ["F", "O"]})
+
+
+def scaleout_q01_sink(db: str, cutoff: int = 19980902,
+                      lineitem_set: str = "lineitem",
+                      output_set: str = "scale_q01_out"):
+    """SCAN(lineitem) → APPLY(int group-by fold) → OUTPUT: per
+    (returnflag, linestatus) group, int32 count + sum(qty) +
+    sum(price) under a shipdate cutoff. Single-pass fold with a
+    declared ``state_merge`` (tree add) — the scatterable q01 shape
+    with exact integer accumulators."""
+    import jax.numpy as jnp
+
+    from netsdb_tpu.plan.computations import Apply, ScanSet, WriteSet
+    from netsdb_tpu.plan.fold import single_pass, tree_add_states
+    from netsdb_tpu.relational.table import ColumnTable
+
+    n_groups = 6  # 3 returnflags x 2 linestatuses
+
+    def init(prev, src):
+        z = jnp.zeros((n_groups,), jnp.int32)
+        return (z, z, z)
+
+    def step(state, chunk):
+        counts, qty, price = state
+        ok = chunk.mask() & (chunk["l_shipdate"] <= cutoff)
+        gid = jnp.where(ok, chunk["l_returnflag"] * 2
+                        + chunk["l_linestatus"], 0)
+        one = jnp.where(ok, 1, 0).astype(jnp.int32)
+        return (counts.at[gid].add(one),
+                qty.at[gid].add(jnp.where(ok, chunk["l_quantity"], 0)),
+                price.at[gid].add(jnp.where(ok, chunk["l_price"], 0)))
+
+    def fin(state, src):
+        counts, qty, price = state
+        gid = jnp.arange(n_groups, dtype=jnp.int32)
+        return ColumnTable(
+            cols={"l_returnflag": gid // 2, "l_linestatus": gid % 2,
+                  "count": counts, "sum_qty": qty, "sum_price": price},
+            dicts={"l_returnflag": src.dicts["l_returnflag"],
+                   "l_linestatus": src.dicts["l_linestatus"]},
+            valid=counts > 0)
+
+    return WriteSet(Apply(ScanSet(db, lineitem_set),
+                          fold=single_pass(init, step, fin,
+                                           state_merge=tree_add_states),
+                          label=f"scaleq01:{cutoff}"),
+                    db, output_set)
+
+
+def scaleout_join_sink(db: str, key_space: int,
+                       lineitem_set: str = "lineitem",
+                       orders_set: str = "orders",
+                       output_set: str = "scale_join_out"):
+    """Grace-hash-capable revenue join with INTEGER accumulators:
+    per-order sum of lineitem prices via a LUT probe. Declared
+    probe/build keys + an output merge make it a distributed-shuffle
+    join over a sharded pool; every order's lineitems co-locate on its
+    key's shuffle bucket, so the sharded result is byte-equal to the
+    single-node run."""
+    import jax.numpy as jnp
+
+    from netsdb_tpu.plan.computations import Join, ScanSet, WriteSet
+    from netsdb_tpu.plan.fold import single_pass
+    from netsdb_tpu.relational.table import ColumnTable
+
+    def init(prev, src, orders):
+        return jnp.zeros((orders.num_rows,), jnp.int32)
+
+    def step(acc, li, orders):
+        lut = jnp.full((key_space,), -1, jnp.int32).at[
+            orders["o_orderkey"]].set(
+            jnp.arange(orders.num_rows, dtype=jnp.int32))
+        oidx = lut[li["l_orderkey"]]
+        ok = (oidx >= 0) & li.mask()
+        return acc.at[jnp.where(ok, oidx, 0)].add(
+            jnp.where(ok, li["l_price"], 0))
+
+    def fin(acc, src, orders):
+        return ColumnTable(cols={"okey": orders["o_orderkey"],
+                                 "rev": acc},
+                           valid=acc > 0)
+
+    def merge(a, b):
+        return ColumnTable(
+            cols={"okey": jnp.concatenate([a["okey"], b["okey"]]),
+                  "rev": jnp.concatenate([a["rev"], b["rev"]])},
+            valid=jnp.concatenate([a.mask(), b.mask()]))
+
+    return WriteSet(
+        Join(ScanSet(db, lineitem_set), ScanSet(db, orders_set),
+             fold=single_pass(init, step, fin, merge,
+                              probe_key="l_orderkey",
+                              build_key="o_orderkey",
+                              probe_columns=("l_price",)),
+             label=f"scalejoin:{key_space}"),
+        db, output_set)
+
+
+def _scale_rows(client, db: str, out_set: str):
+    """Decoded, canonically-ordered result rows (the byte-equality
+    probe)."""
+    import numpy as np
+
+    t = client.get_table(db, out_set)
+    ok = np.asarray(t.mask()) if t.valid is not None \
+        else np.ones(t.num_rows, bool)
+    names = sorted(t.cols)
+    rows = [tuple(int(np.asarray(t[n])[i]) for n in names)
+            for i in range(t.num_rows) if ok[i]]
+    return sorted(rows)
+
+
+def run_scaleout_bench(rows: int = 6_000_000, daemons: int = 4,
+                       queries: int = 6, page_rows: int = 65_536,
+                       join_orders: int = 2048,
+                       join_rows: int = 400_000) -> Dict[str, Any]:
+    """Paired 1 vs N-daemon arm (``--scale``): aggregate ingest MB/s
+    (client-routed partitions vs one daemon) and cold scatter-gather
+    q01 QPS over the same paged workload, plus the byte-equality
+    checks — the sharded q01 result AND a grace-hash join routed
+    through the distributed shuffle must equal the single-node run
+    exactly (integer accumulators).
+
+    Daemons are real subprocesses (parallel apply needs separate
+    GILs). The device cache is disabled daemon-side so every query
+    re-streams its pages — the COLD query path is what capacity
+    scaling is about. CPU-container caveat: all daemons share one
+    machine's cores, so the reported scale is a lower bound on a
+    real multi-host pool (same caveat class as BENCH_r06/r07)."""
+    import tempfile
+
+    import numpy as np
+
+    from netsdb_tpu.serve.client import RemoteClient
+
+    table = scaleout_table(rows)
+    payload_mb = sum(np.asarray(v).nbytes
+                     for v in table.cols.values()) / 2**20
+    rng = np.random.default_rng(7)
+    join_li_cols = {
+        "l_orderkey": rng.integers(0, join_orders, join_rows,
+                                   dtype=np.int32),
+        "l_price": rng.integers(1, 1000, join_rows, dtype=np.int32)}
+    from netsdb_tpu.relational.table import ColumnTable
+
+    join_li = ColumnTable(join_li_cols, {}, None)
+    join_orders_tbl = ColumnTable(
+        {"o_orderkey": np.arange(join_orders, dtype=np.int32)}, {},
+        None)
+
+    def spawn(port: int, workers: Optional[List[str]] = None):
+        env = dict(os.environ)
+        env.setdefault("JAX_PLATFORMS", "cpu")
+        argv = [_python(), "-m", "netsdb_tpu", "serve",
+                "--port", str(port),
+                "--root", tempfile.mkdtemp(prefix=f"scale_{port}_"),
+                "--device-cache-mb", "0",
+                "--page-kb", str(page_rows * 4 // 1024)]
+        if workers:
+            argv += ["--workers", ",".join(workers)]
+        return subprocess.Popen(
+            argv, env=env, stdout=subprocess.DEVNULL,
+            stderr=subprocess.DEVNULL,
+            cwd=os.path.dirname(os.path.dirname(os.path.dirname(
+                os.path.abspath(__file__)))))
+
+    def run_arm(n: int) -> Dict[str, Any]:
+        ports = [_free_port() for _ in range(n)]
+        worker_addrs = [f"127.0.0.1:{p}" for p in ports[1:]]
+        procs = [spawn(p) for p in ports[1:]]
+        procs.insert(0, spawn(ports[0], workers=worker_addrs or None))
+        out: Dict[str, Any] = {"daemons": n}
+        try:
+            for p in ports:
+                _wait_port("127.0.0.1", p)
+            c = RemoteClient(f"127.0.0.1:{ports[0]}")
+            c.create_database("d")
+            kw = {"placement": "range"} if n > 1 else {}
+            # ingest warmup: every daemon's first ingest pays lazy
+            # imports + arena setup once — both arms exclude it
+            c.create_set("d", "warm", type_name="table",
+                         storage="paged", **kw)
+            c.send_table("d", "warm", scaleout_table(4096, seed=9))
+            c.create_set("d", "lineitem", type_name="table",
+                         storage="paged", **kw)
+            t0 = time.perf_counter()
+            c.send_table("d", "lineitem", table)
+            ingest_s = time.perf_counter() - t0
+            out["ingest_s"] = round(ingest_s, 3)
+            out["ingest_mb_per_s"] = round(payload_mb / ingest_s, 1)
+
+            sink = scaleout_q01_sink("d")
+            # warmup compiles (both arms pay it once, excluded)
+            c.execute_computations(sink, job_name="scale-q01-warm",
+                                   fetch_results=False)
+            t0 = time.perf_counter()
+            for _ in range(queries):
+                c.execute_computations(sink, job_name="scale-q01",
+                                       fetch_results=False)
+            q_s = time.perf_counter() - t0
+            out["query_s_total"] = round(q_s, 3)
+            out["cold_query_qps"] = round(queries / q_s, 3)
+            out["q01_rows"] = _scale_rows(c, "d", "scale_q01_out")
+
+            # the distributed-shuffle join leg
+            jkw = {"placement": "hash"} if n > 1 else {}
+            c.create_set("d", "jli", type_name="table", **jkw)
+            c.create_set("d", "jorders", type_name="table", **jkw)
+            c.send_table("d", "jli", join_li)
+            c.send_table("d", "jorders", join_orders_tbl)
+            jsink = scaleout_join_sink("d", join_orders,
+                                       lineitem_set="jli",
+                                       orders_set="jorders")
+            t0 = time.perf_counter()
+            c.execute_computations(jsink, job_name="scale-join",
+                                   fetch_results=False)
+            out["join_s"] = round(time.perf_counter() - t0, 3)
+            out["join_rows"] = _scale_rows(c, "d", "scale_join_out")
+            c.close()
+        finally:
+            for proc in procs:
+                proc.terminate()
+            for proc in procs:
+                try:
+                    proc.wait(timeout=10)
+                except subprocess.TimeoutExpired:
+                    proc.kill()
+        return out
+
+    single = run_arm(1)
+    pool = run_arm(daemons)
+    out: Dict[str, Any] = {
+        "rows": rows, "payload_mb": round(payload_mb, 1),
+        "daemons": daemons, "queries": queries,
+        "single": {k: v for k, v in single.items()
+                   if not k.endswith("_rows")},
+        "pool": {k: v for k, v in pool.items()
+                 if not k.endswith("_rows")},
+        "ingest_scale_x": round(pool["ingest_mb_per_s"]
+                                / single["ingest_mb_per_s"], 2),
+        "query_scale_x": round(pool["cold_query_qps"]
+                               / single["cold_query_qps"], 2),
+        "q01_byte_equal": pool["q01_rows"] == single["q01_rows"],
+        "join_byte_equal": pool["join_rows"] == single["join_rows"],
+    }
+    out["scaleout_throughput_x"] = round(
+        min(out["ingest_scale_x"], out["query_scale_x"]), 2)
+    return out
+
+
 def run_scheduler_bench(clients: int = 8, rows: int = 600_000,
                         page_rows: int = 65_536, pool_mb: int = 8,
                         cache_mb: int = 256) -> Dict[str, Any]:
@@ -706,11 +979,22 @@ def main(argv=None) -> int:
                          "EXECUTEs with the query scheduler on vs "
                          "off — executions run, devcache installs, "
                          "coalesce hits, client p50/p99")
+    ap.add_argument("--scale", action="store_true",
+                    help="horizontal scale-out: paired 1 vs N-daemon "
+                         "arm — aggregate routed-ingest MB/s, cold "
+                         "scatter-gather q01 QPS, byte-equality incl. "
+                         "a distributed-shuffle join")
+    ap.add_argument("--daemons", type=int, default=4,
+                    help="pool size for --scale (leader + N-1 shards)")
+    ap.add_argument("--rows", type=int, default=6_000_000,
+                    help="lineitem rows for --scale")
     ap.add_argument("--table-mb", type=int, default=64)
     args = ap.parse_args(argv)
     if args.worker:
         out = run_client_worker(args.address, args.client_id, args.jobs,
                                 args.batch)
+    elif args.scale:
+        out = run_scaleout_bench(rows=args.rows, daemons=args.daemons)
     elif args.scheduler:
         out = run_scheduler_bench(
             clients=args.clients if args.clients is not None else 8)

@@ -881,3 +881,91 @@ def test_coalesce_done_ttl_zero_disables_retention():
     assert ct.done_entries() == 0
     assert ct.run("k", fn, 10.0) == 2
     assert calls == [1, 1]
+
+
+# --- the feedback loop (PR 13 satellite): ledger-seeded lanes --------
+
+def test_feedback_formula_pinned():
+    """The documented seed_lanes formula, constant by constant: the
+    OperatorLedger supplies seconds-per-chunk, attribution supplies
+    per-client volumes, weight = clamp(median_rate / rate, 0.25, 4)."""
+    from netsdb_tpu.serve.sched import feedback as FB
+
+    ops = {"job": {"apply": {"wall_s": 2.0, "chunks": 1000.0}}}
+    assert FB.sec_per_chunk(ops) == pytest.approx(0.002)
+    assert FB.sec_per_chunk({}) == FB.DEFAULT_SEC_PER_CHUNK
+
+    attrib = {
+        # light tenant: 100 requests, 100 chunks -> rate 0.002
+        "light": {"d:a": {"requests": 100.0,
+                          "executor.chunks": 100.0}},
+        # median tenant: 100 requests, 1000 chunks -> rate 0.02
+        "mid": {"d:a": {"requests": 100.0,
+                        "executor.chunks": 1000.0}},
+        # heavy tenant: 100 requests, 100k chunks -> rate 2.0
+        "heavy": {"d:a": {"requests": 100.0,
+                          "executor.chunks": 100000.0}},
+        # below the evidence floor: ignored entirely
+        "sparse": {"d:a": {"requests": 2.0,
+                           "executor.chunks": 1e9}},
+    }
+    weights, quotas = FB.seed_lanes(attrib, ops, base_quota=8)
+    assert "sparse" not in weights
+    # median rate = mid's 0.02: light = 0.02/0.002 = 10 -> clamped 4;
+    # mid = 1.0; heavy = 0.02/2.0 = 0.01 -> clamped 0.25
+    assert weights == {"light": 4.0, "mid": 1.0, "heavy": 0.25}
+    assert quotas == {"light": 32, "mid": 8, "heavy": 2}
+    # reserved (operator-configured) lanes are never reseeded
+    w2, q2 = FB.seed_lanes(attrib, ops, base_quota=8,
+                           reserved={"heavy"})
+    assert "heavy" not in w2 and "heavy" not in q2
+
+
+def test_feedback_reseed_applies_to_scheduler():
+    sched = LaneScheduler(slots=1, lanes={"vip": 9.0}, quota=4)
+    sched.reseed({"light": 4.0, "vip": 0.1}, {"light": 16, "vip": 1})
+    snap_quota = sched._quota_for_locked("light")
+    assert snap_quota == 16
+    assert sched._quota_for_locked("other") == 4  # global fallback
+    # operator-configured lane untouched by the reseed
+    assert sched._weights["vip"] == 9.0
+    assert "vip" not in sched._lane_quotas
+    # a reseeded lane materializes with the seeded weight
+    t = sched.acquire("light", timeout_s=1.0)
+    assert sched.snapshot()["lanes"]["light"]["weight"] == 4.0
+    sched.release(t)
+
+
+def test_feedback_loop_end_to_end():
+    """config.sched_feedback wires the ledgers into live lane weights:
+    populate attribution + operator rows, refresh, and the scheduler's
+    lane table reflects the pinned formula."""
+    from netsdb_tpu.serve.sched import QueryScheduler
+
+    obs.attrib.LEDGER.reset()
+    for _ in range(20):
+        obs.attrib.account("requests", 1, scope="d:a", client="lightc")
+        obs.attrib.account("executor.chunks", 1, scope="d:a",
+                           client="lightc")
+        obs.attrib.account("requests", 1, scope="d:a", client="heavyc")
+        obs.attrib.account("executor.chunks", 500, scope="d:a",
+                           client="heavyc")
+    obs.operators.LEDGER.add("j", "apply:x",
+                             {"wall_s": 1.0,
+                              "counters": {"chunks": 1000}})
+    sched = QueryScheduler(slots=2, quota=10, feedback=True,
+                           feedback_every=4)
+    before = obs.REGISTRY.counter("sched.feedback_reseeds").value
+    weights, quotas = sched.refresh_feedback()
+    assert obs.REGISTRY.counter("sched.feedback_reseeds").value \
+        == before + 1
+    # two lanes, median = one of the two rates; light earns the upper
+    # clamp relative to heavy (500x cost gap >> 16x clamp span)
+    assert weights["lightc"] > weights["heavyc"]
+    assert quotas["lightc"] > quotas["heavyc"]
+    t = sched.acquire("lightc", timeout_s=1.0)
+    assert sched.snapshot()["lanes"]["lightc"]["weight"] \
+        == weights["lightc"]
+    sched.release(t)
+    obs.attrib.LEDGER.reset()
+    obs.REGISTRY.unregister_collector("sched", sched.snapshot)
