@@ -90,7 +90,8 @@ def _cpu_reference_rows_per_sec() -> float:
 HEADLINE_METRICS = {"ff_inference_rows_per_sec_per_chip": "higher",
                     "serve_sched_p99_speedup": "higher",
                     "plan_fusion_speedup": "higher",
-                    "serve_scaleout_throughput_x": "higher"}
+                    "serve_scaleout_throughput_x": "higher",
+                    "devcache_partial_speedup": "higher"}
 REGRESSION_PCT = 15.0
 
 
@@ -321,6 +322,35 @@ def main():
             # not noise) omits the record rather than snapshotting it
             print(f"-- scale arm unusable; metric omitted: "
                   f"{json.dumps(sc)}", file=sys.stderr)
+    if "--partial-cache" in sys.argv:
+        # block-granular partial-run caching A/B (serve_bench
+        # --partial-cache): warm re-query after a 1% append under
+        # dirty-range vs whole-run invalidation. The record is only
+        # taken when the structural proof holds (zero evictions of
+        # pre-append blocks, partial hits advancing) — a fast-but-
+        # wrong arm must not snapshot. CPU-container caveat: the
+        # "device" is host RAM, the ratio understates HBM savings.
+        from netsdb_tpu.workloads.serve_bench import run_partial_cache_bench
+
+        pc = run_partial_cache_bench()
+        if pc.get("devcache_partial_speedup") \
+                and pc.get("partial_zero_evictions") \
+                and pc.get("partial_hits_positive"):
+            records.append({
+                "metric": "devcache_partial_speedup",
+                "value": pc["devcache_partial_speedup"],
+                "unit": "x (warm re-query after 1% append, partial "
+                        "vs whole-run invalidation)",
+                "detail": {
+                    "partial": pc.get("partial"),
+                    "whole_run": pc.get("whole_run"),
+                    "rows": pc.get("rows"),
+                    "append_rows": pc.get("append_rows"),
+                },
+            })
+        else:
+            print(f"-- partial-cache A/B unusable; metric omitted: "
+                  f"{json.dumps(pc)}", file=sys.stderr)
     # one JSON line: a single record stays the historical shape; with
     # --sched the line is a list (compare_runs accepts both)
     print(json.dumps(records if len(records) > 1 else result))

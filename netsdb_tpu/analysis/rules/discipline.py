@@ -287,6 +287,47 @@ class PrintBanRule(Rule):
 
 
 @register
+class RowwiseShadowRule(Rule):
+    """Manual ``rowwise=True`` declarations on Apply labels the
+    derived registry already covers."""
+
+    id = "rowwise-shadow"
+    rationale = ("plan/computations.ROWWISE_SAFE_LABELS is the one "
+                 "source of truth for the suite's audited "
+                 "row-decomposable transforms; a per-node re-"
+                 "declaration shadows it and drifts when the registry "
+                 "is re-audited")
+
+    def select(self, mod: Module) -> bool:
+        return mod.rel.endswith(".py") \
+            and not mod.rel.startswith("tests/fixtures/")
+
+    def check_module(self, mod: Module) -> Iterable[Diagnostic]:
+        # the registry lives in a jax-free module, importable from the
+        # lint process (the framework bans jax imports at lint time)
+        from netsdb_tpu.plan.computations import rowwise_safe
+
+        for node in mod.walk():
+            if not (isinstance(node, ast.Call)
+                    and terminal_name(node.func) == "Apply"):
+                continue
+            kw = {k.arg: k.value for k in node.keywords
+                  if k.arg is not None}
+            rw = kw.get("rowwise")
+            label = kw.get("label")
+            if (isinstance(rw, ast.Constant) and rw.value is True
+                    and isinstance(label, ast.Constant)
+                    and isinstance(label.value, str)
+                    and rowwise_safe(label.value)):
+                yield self.diag(
+                    mod, node,
+                    f"rowwise=True on label {label.value!r} shadows "
+                    f"the derived registry (plan/computations."
+                    f"ROWWISE_SAFE_LABELS) — drop the argument; the "
+                    f"declaration is auto-derived")
+
+
+@register
 class QidMintRule(Rule):
     """``new_query_id`` outside obs/ (unsampled tracing on hot
     paths)."""

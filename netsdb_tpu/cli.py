@@ -1069,6 +1069,10 @@ def _cmd_serve_bench(args) -> int:
 
         out = run_scheduler_bench(
             clients=args.clients if args.clients is not None else 8)
+    elif getattr(args, "partial_cache", False):
+        from netsdb_tpu.workloads.serve_bench import run_partial_cache_bench
+
+        out = run_partial_cache_bench()
     elif getattr(args, "device_cache", False):
         from netsdb_tpu.workloads.serve_bench import run_device_cache_bench
 
@@ -1239,6 +1243,10 @@ def main(argv=None) -> int:
                    help="cold vs warm EXECUTE latency over a "
                         "device-cache-resident paged set instead "
                         "(hit/miss counters included)")
+    p.add_argument("--partial-cache", action="store_true",
+                   help="partial-run caching paired A/B instead: "
+                        "warm re-query after a 1%% append under "
+                        "dirty-range vs whole-run invalidation")
     p.add_argument("--scheduler", action="store_true",
                    help="query-scheduler paired A/B instead: N "
                         "concurrent identical cold EXECUTEs, "

@@ -97,6 +97,30 @@ class Configuration:
     # path bumps the set version, so the cache can never serve stale
     # blocks. 0 disables. LRU-evicted under the budget.
     device_cache_bytes: int = 256 * 1024 * 1024
+    # block-granular PARTIAL-RUN caching (the netsDB pin-per-page
+    # discipline): entries install per block under (scope, kind,
+    # bucket, sharding, block_range) as they stream — partial
+    # consumption caches the consumed prefix — and lookups STITCH
+    # contiguous cached ranges into the staged stream (cached ranges
+    # serve from HBM with zero arena reads, gaps fall through to the
+    # host-prefetch→upload pipeline). Invalidation is per-page dirty
+    # ranges (SetStore._touch): an append drops only entries
+    # intersecting the appended tail, so a huge set's warm prefix
+    # survives small writes. False restores the whole-run
+    # version-keyed behavior byte-for-byte (same keys, counters,
+    # EXPLAIN — the rollback contract pinned by test).
+    device_cache_partial: bool = True
+    # pinnable hot-prefix budget (bytes, partial mode only): a set's
+    # HEAD blocks — the contiguous prefix from row 0, in install
+    # order — are marked pinned until this global budget is spent;
+    # pinned entries are skipped by LRU eviction (dirty-range
+    # invalidation still drops them). 0 disables pinning.
+    device_cache_pin_bytes: int = 0
+    # bound on the per-set dirty-range log (SetStore._touch): beyond
+    # this many un-collapsed ranges the log folds to whole-scope (a
+    # pathological writer degrades to today's invalidate-everything,
+    # never to unbounded memory).
+    device_cache_dirty_log: int = 64
     # donate fold-step accumulators to XLA (donate_argnums on arg 0) so
     # per-block state updates reuse the same HBM buffer. None = auto:
     # on for backends that implement donation (TPU/GPU), off for CPU.
@@ -208,6 +232,15 @@ class Configuration:
     # default.
     sched_feedback: bool = False
     sched_feedback_every: int = 64
+    # SLO burn-rate load shedding (serve/sched/feedback.py): when an
+    # obs/slo.py objective breaches on ALL windows, the scheduler
+    # temporarily halves the heaviest non-reserved lane's quota
+    # (pinned formula: quota × SHED_FACTOR, floored at 1) and ticks
+    # ``sched.shed_events``; the override lifts on the first breach-
+    # free check. Checked on the feedback cadence
+    # (sched_feedback_every admissions). Opt-in; needs a configured
+    # sched_lane_quota to have any quota to halve.
+    sched_slo_shed: bool = False
     # --- concurrency correctness (netsdb_tpu/analysis/ + utils/locks) ---
     # lockdep-style runtime lock-order witness: on, every TrackedLock/
     # named-RWLock acquisition records rank edges (held -> acquired)

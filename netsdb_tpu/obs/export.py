@@ -47,7 +47,8 @@ from netsdb_tpu.obs import metrics as _metrics
 #: they are catalogued here and in docs/METRICS.md explicitly)
 ATTRIB_METRICS = (
     "requests", "staged_bytes", "staged_chunks", "devcache.hits",
-    "devcache.misses", "devcache.installs", "executor.chunks",
+    "devcache.misses", "devcache.installs", "devcache.partial_hits",
+    "executor.chunks",
 )
 
 
@@ -103,6 +104,9 @@ def _catalog() -> Dict[str, Tuple[str, str]]:
         ("sched.feedback_reseeds", "lane weight/quota reseeds applied "
                                    "from the attribution + operator "
                                    "ledgers (sched_feedback)"),
+        ("sched.shed_events", "heaviest-lane quota halvings applied "
+                              "by SLO burn-rate load shedding "
+                              "(sched_slo_shed)"),
         ("devcache.lookups", "device block cache lookups (hits+misses)"),
         ("devcache.hits", "device block cache hits"),
         ("devcache.misses", "device block cache misses"),
@@ -111,6 +115,15 @@ def _catalog() -> Dict[str, Tuple[str, str]]:
         ("devcache.evictions", "device cache LRU evictions"),
         ("devcache.invalidations", "device cache entries dropped by "
                                    "write-path invalidation"),
+        ("devcache.partial_hits", "individual device-resident blocks "
+                                  "served by range-stitched streams "
+                                  "(partial-run caching)"),
+        ("devcache.stitched_ranges", "contiguous cached ranges "
+                                     "stitched into staged streams"),
+        ("devcache.dirty_invalidations", "block entries dropped by "
+                                         "dirty-RANGE invalidation "
+                                         "(intersecting a written row "
+                                         "range)"),
         ("staging.chunks", "chunks staged host->device"),
         ("staging.bytes", "bytes staged host->device (accounted "
                           "streams)"),
@@ -186,6 +199,9 @@ def _catalog() -> Dict[str, Tuple[str, str]]:
                                              "(untested concurrency)"),
         ("sched.queue_depth", "requests currently queued across all "
                               "scheduler lanes"),
+        ("devcache.pinned_bytes", "bytes of head blocks currently "
+                                  "pinned against LRU eviction "
+                                  "(device_cache_pin_bytes)"),
     )
     hists = (
         ("sched.queue_wait_s", "seconds a job waited in its scheduler "

@@ -267,6 +267,18 @@ class SLOEngine:
             out.append(res)
         return out
 
+    def breached_objectives(self, evaluate: bool = True) -> List[str]:
+        """Names of objectives currently breached on ALL their windows
+        (the multi-window agreement rule). ``evaluate=True`` takes a
+        fresh evaluation first — the scheduler's load-shedding probe
+        (serve/sched/feedback.py) must not depend on HEALTH polling
+        cadence; ``False`` reads the last evaluation's state."""
+        if evaluate:
+            return [r["name"] for r in self.evaluate()
+                    if r.get("breached")]
+        with self._mu:
+            return sorted(n for n, b in self._breached.items() if b)
+
     # --- events -------------------------------------------------------
     def _transition(self, o: Objective, res: Dict[str, Any]) -> None:
         breached = bool(res.get("breached"))

@@ -20,6 +20,33 @@ from typing import Any, Callable, List, Optional, Sequence
 
 _ids = itertools.count()
 
+#: Labels of the suite's AUDITED row-decomposable chunk transforms —
+#: the DERIVED ``rowwise`` set (PR 10 follow-on). An :class:`Apply`
+#: whose label matches an entry (exact match, or prefix match for
+#: entries ending in ``:``) auto-derives ``rowwise=True`` instead of
+#: requiring a per-node declaration; call sites must NOT additionally
+#: pass ``rowwise=True`` for these labels (the ``rowwise-shadow`` lint
+#: rule flags the shadowing declaration — one source of truth).
+#:
+#: Membership is a CORRECTNESS contract, audited like a FoldSpec
+#: decomposition: every listed label names a per-row transform that
+#: (a) maps any row-slice to exactly the matching row-slice of the
+#: whole-input result and (b) preserves the chunk contract AND the
+#: schema surface (see the ``rowwise`` docstring below). The in-repo
+#: members are the bench/suite pre-chain transforms under the
+#: ``pre:`` namespace: affine per-row column maps (``pre:affine``),
+#: column projections/renames-free selections (``pre:project``) and
+#: per-row scaling (``pre:scale``).
+ROWWISE_SAFE_LABELS = ("pre:affine", "pre:project", "pre:scale")
+
+
+def rowwise_safe(label: str) -> bool:
+    """True when ``label`` is in the derived rowwise set (exact entry,
+    or namespace entry ending in ``:`` matched as a prefix)."""
+    lab = str(label or "")
+    return any(lab.startswith(entry) if entry.endswith(":")
+               else lab == entry for entry in ROWWISE_SAFE_LABELS)
+
 
 class Computation:
     """DAG node. ``inputs`` are upstream Computations; ``op_kind`` mirrors
@@ -69,7 +96,7 @@ class Apply(Computation):
 
     def __init__(self, input_: Computation, fn: Optional[Callable[[Any], Any]] = None,
                  label: str = "", traceable: bool = True, fold=None,
-                 tensor_fold=None, rowwise: bool = False):
+                 tensor_fold=None, rowwise: Optional[bool] = None):
         """``traceable=False`` marks a host-side projection (numpy / Python
         object work) that must run eagerly outside jit — the reference
         analogue is a C++ lambda that touches non-tensor state.
@@ -119,11 +146,20 @@ class Apply(Computation):
         (sorts, global statistics, cross-row joins) or reshapes the
         schema surface silently computes the wrong answer on paged
         inputs — the same class of contract as a FoldSpec's
-        decomposition."""
+        decomposition.
+
+        ``rowwise=None`` (the default) DERIVES the declaration from
+        the audited label registry (:data:`ROWWISE_SAFE_LABELS`): the
+        suite's known-safe pre-chain transforms fuse without per-node
+        declarations, and a label outside the registry stays
+        non-rowwise. Passing an explicit True/False always wins —
+        but an explicit ``rowwise=True`` on a registry label shadows
+        the derived set and is flagged by the ``rowwise-shadow`` lint
+        rule (drop the argument; the registry is the one source of
+        truth for those labels)."""
         super().__init__([input_])
         self.fold = fold
         self.tensor_fold = tensor_fold
-        self.rowwise = rowwise
         if fn is None:
             if fold is None:
                 raise ValueError("Apply needs fn or fold")
@@ -131,6 +167,11 @@ class Apply(Computation):
         self.fn = fn
         self.traceable = traceable
         self.label = label or getattr(fn, "__name__", "fn")
+        # None = derive from the audited registry; an explicit
+        # declaration (True OR False) always wins over derivation
+        self.rowwise_declared = rowwise is not None
+        self.rowwise = (bool(rowwise) if rowwise is not None
+                        else rowwise_safe(self.label))
 
     def evaluate(self, x):
         return self.fn(x)

@@ -504,6 +504,23 @@ def _run_tensor_stream(node, tfold, in_vals, src, step_jit):
         # leave a dead (old-version) entry squatting on the budget
         return version_fn is None or version_fn() == scope[1]
 
+    def partial_plan(kind):
+        # block-granular caching for the weight stream (partial mode):
+        # base key drops the write VERSION — put_tensor/restore are
+        # whole-set writes, so dirty-range invalidation drops every
+        # block anyway — and keeps the layout/sharding components
+        if (cache is None or scope is None
+                or not getattr(cache, "partial", False)
+                or not cache.enabled):
+            return None
+        pl = placement.label() if placement is not None else None
+        ranges = pt.store.block_ranges(pt.name)
+        if not ranges:
+            return None
+        return staging.PartialPlan(
+            cache, (scope[0], kind, rb, bucketing, density, pl), ranges,
+            lambda idxs: pt.stream_blocks(blocks=idxs))
+
     def to_device(block):
         b = jnp.asarray(block)
         if placement is not None:
@@ -535,7 +552,10 @@ def _run_tensor_stream(node, tfold, in_vals, src, step_jit):
                     pt.stream_blocks(), place, depth,
                     name=f"trows:{pt.name}",
                     cache=cache, cache_key=cache_key("trows"),
-                    cache_validator=still_current)) as blocks:
+                    cache_validator=still_current,
+                    partial=partial_plan("trows"),
+                    scope=None if scope is None else str(scope[0])
+                    )) as blocks:
             for n, block in blocks:
                 t0 = time.perf_counter()
                 out = jstep(block, *others)
@@ -578,7 +598,10 @@ def _run_tensor_stream(node, tfold, in_vals, src, step_jit):
                 pt.stream_blocks(), place, depth,
                 name=f"treduce:{pt.name}",
                 cache=cache, cache_key=cache_key("treduce"),
-                cache_validator=still_current)) as blocks:
+                cache_validator=still_current,
+                partial=partial_plan("treduce"),
+                scope=None if scope is None else str(scope[0])
+                )) as blocks:
         nblk = 0
         for start, block in blocks:
             t0 = time.perf_counter()

@@ -529,10 +529,164 @@ class _CacheRecorder:
                             client=self._client)
 
 
+class PartialPlan:
+    """Everything :func:`stage_stream` needs to range-stitch one
+    stream against the partial-run device cache (built by the caller,
+    which knows the set's block layout):
+
+    * ``cache`` — the :class:`~netsdb_tpu.storage.devcache.
+      DeviceBlockCache` (must have ``partial`` on);
+    * ``base_key`` — the composite ``(scope, kind, bucket, sharding)``
+      block entries key under (scope FIRST — the invalidation index
+      relies on it); NO write version — freshness is dirty-range
+      invalidation's job;
+    * ``ranges`` — the full ordered ``[(start_row, end_row)]`` block
+      layout of the set (metadata only, zero arena reads);
+    * ``source_for(gap_indices)`` — builds a host iterator yielding
+      ONLY those block positions (the arena never reads pages whose
+      chunks are already device-resident).
+    """
+
+    __slots__ = ("cache", "base_key", "ranges", "source_for")
+
+    def __init__(self, cache, base_key, ranges, source_for):
+        self.cache = cache
+        self.base_key = tuple(base_key)
+        self.ranges = [(int(s), int(e)) for s, e in ranges]
+        self.source_for = source_for
+
+
+class _BlockInstaller:
+    """Wraps ``place`` so every placed GAP block installs into the
+    partial cache as it streams — partial consumption caches the
+    consumed prefix (an early-exit consumer keeps what it paid for,
+    unlike the whole-run recorder which discarded everything). Runs on
+    the staging thread; the attributed client identity is captured on
+    the consumer thread at construction. Installs are epoch-gated, so
+    a write racing the stream refuses the in-flight blocks instead of
+    stranding stale entries."""
+
+    def __init__(self, cache, base_key, gap_ranges, epoch, place):
+        self._cache = cache
+        self._base_key = base_key
+        self._gaps = list(gap_ranges)  # consumed positionally, in order
+        self._epoch = epoch
+        self._place = place
+        self._i = 0
+        self._all_installed = True
+        self._client = obs.attrib.current_client()
+
+    def __call__(self, item):
+        placed = self._place(item)
+        if self._i < len(self._gaps):
+            ok = self._cache.install_block(
+                self._base_key, self._gaps[self._i], placed,
+                epoch=self._epoch, client=self._client)
+            self._all_installed = self._all_installed and ok
+            self._i += 1
+        return placed
+
+    def complete(self) -> None:
+        # natural exhaustion with every gap block landed = the
+        # partial-mode analogue of one whole-run install (run-level
+        # counter semantics preserved for dashboards/SLOs/tests)
+        if self._all_installed and self._i == len(self._gaps):
+            self._cache.record_run_install(str(self._base_key[0]),
+                                           client=self._client)
+
+
+class _StitchedStream:
+    """Row-order interleave of device-cached blocks and a staged gap
+    stream — what :func:`stage_stream` returns on a PARTIAL cache hit:
+    cached ranges serve from HBM (zero arena reads, zero transfers,
+    ticked as ``devcache.partial_hits``) while gap ranges arrive
+    through the normal host-prefetch→upload pipeline, so the consumer
+    sees one seamless stream in block order. Same ``close()``
+    discipline as :class:`StagedStream`."""
+
+    def __init__(self, segments, staged, cache, scope: str, name: str):
+        # segments: [("hit", block) | ("gap", None)] in block order
+        self._segments = segments
+        self._staged = staged  # StagedStream over the gaps (or None)
+        self._cache = cache
+        self._scope = scope
+        self._name = name
+        self._i = 0
+        self._closed = False
+        # count the stitch joints once, up front: a contiguous run of
+        # cached blocks is ONE stitched range
+        stitched = sum(1 for j, (kind, _b) in enumerate(segments)
+                       if kind == "hit"
+                       and (j == 0 or segments[j - 1][0] != "hit"))
+        self._pending_ranges = stitched
+
+    def __iter__(self):
+        return self
+
+    def __next__(self):
+        if self._closed:
+            raise StopIteration
+        if self._i >= len(self._segments):
+            self.close()
+            raise StopIteration
+        kind, block = self._segments[self._i]
+        self._i += 1
+        if kind == "hit":
+            # per-block residency tick (the counters the partial-
+            # invalidation proof reads) + the one-time stitch count
+            self._cache.tick_partial(self._scope, 1,
+                                     self._pending_ranges)
+            self._pending_ranges = 0
+            return block
+        return next(self._staged)
+
+    def close(self) -> None:
+        self._closed = True
+        if self._staged is not None:
+            self._staged.close()
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.close()
+
+    def __del__(self):
+        with contextlib.suppress(Exception):
+            self.close()
+
+
+def _stage_partial(plan: PartialPlan, place, depth: int, name: str,
+                   scope: Optional[str]):
+    """The partial-mode leg of :func:`stage_stream`: consult, stitch,
+    install-as-you-go."""
+    scope = scope if scope is not None else str(plan.base_key[0])
+    epoch, covered = plan.cache.plan_ranges(plan.base_key, plan.ranges)
+    gaps = [i for i, r in enumerate(plan.ranges) if r not in covered]
+    if not gaps:
+        _emit("cache_hit", name)
+        # a fully resident stream: the query profile's zero-transfer
+        # marker keeps its whole-run meaning
+        obs.add("stage.cached_runs")
+        obs.operators.op_add("stage.cached_runs")
+        segments = [("hit", covered[r]) for r in plan.ranges]
+        return _StitchedStream(segments, None, plan.cache, scope, name)
+    rec = _BlockInstaller(plan.cache, plan.base_key,
+                          [plan.ranges[i] for i in gaps], epoch, place)
+    staged = StagedStream(plan.source_for(gaps), rec, depth=depth,
+                          name=name, on_complete=rec.complete,
+                          scope=scope)
+    if not covered:
+        return staged  # fully cold: plain staged stream, installing
+    segments = [("hit", covered[r]) if r in covered else ("gap", None)
+                for r in plan.ranges]
+    return _StitchedStream(segments, staged, plan.cache, scope, name)
+
+
 def stage_stream(source: Iterable, place: Callable[[Any], Any],
                  depth: int = 2, name: str = "stage",
                  cache=None, cache_key=None, cache_validator=None,
-                 scope: Optional[str] = None):
+                 scope: Optional[str] = None, partial=None):
     """Wrap ``source`` so ``place`` (pad + upload via
     ``storage/devcache.to_device``) runs up to ``depth`` items ahead on
     a background thread.  The ONE constructor every out-of-core
@@ -554,7 +708,21 @@ def stage_stream(source: Iterable, place: Callable[[Any], Any],
     ``scope`` names the set ("db:set") the per-(client, set) resource
     ledger attributes this stream's staged bytes to; defaults to the
     cache key's scope component for cache-aware streams (store-bound
-    handles), None for uncached temporaries (grace-hash spills)."""
+    handles), None for uncached temporaries (grace-hash spills).
+
+    ``partial`` (a :class:`PartialPlan`) takes the BLOCK-GRANULAR
+    cache path instead: cached ranges stitch into the stream from HBM
+    (zero arena reads), gap ranges stream + install per block, and
+    ``source`` is ignored (the plan's ``source_for`` builds the
+    gap-only feed). Mutually exclusive with ``cache``/``cache_key``."""
+    if partial is not None and partial.cache.enabled \
+            and getattr(partial.cache, "partial", False) \
+            and partial.ranges:
+        return _stage_partial(partial, place, depth, name, scope)
+    if partial is not None and source is None:
+        # partial plan declined (cache off / empty layout): fall back
+        # to a plain uncached stream over the plan's full block feed
+        source = partial.source_for(None)
     if scope is None and cache_key is not None:
         scope = str(cache_key[0])
     if cache is not None and cache_key is not None and cache.enabled:

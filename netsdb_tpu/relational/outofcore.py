@@ -203,8 +203,17 @@ class PagedColumns:
                 self.stats[name] = (new if old is None else ColumnStats(
                     old.n_rows + new.n_rows, min(old.min_val, new.min_val),
                     max(old.max_val, new.max_val), -1))
+            n_before = self.num_rows
             self.num_rows += n_new
-            self._mutations += 1  # cached runs of the old rows are dead
+            self._mutations += 1  # cached whole RUNS of the old rows
+            # are dead (their key carries this counter); cached BLOCKS
+            # are range-keyed and survive — only the appended tail is
+            # dirty. Invalidating here (not just in SetStore._touch)
+            # covers direct pc.append callers that bypass the store.
+        if (self.devcache is not None and self.cache_scope is not None
+                and getattr(self.devcache, "partial", False)):
+            self.devcache.invalidate_range(self.cache_scope, n_before,
+                                           self.num_rows)
 
     # ------------------------------------------------------------ stream
     def pad_rows(self) -> int:
@@ -253,31 +262,36 @@ class PagedColumns:
             depth=getattr(self.store.config, "stage_depth", 2),
             name=f"cols:{self.name}")
 
-    def _host_stream(self, prefetch: Optional[int] = None
+    def _host_stream(self, prefetch: Optional[int] = None,
+                     blocks: Optional[List[int]] = None
                      ) -> Iterator[Tuple[Dict[str, np.ndarray],
                                          np.ndarray, int]]:
         """Locked host-side chunk generator (numpy columns). Runs —
         lock acquisition included — on whichever thread iterates it:
         the consumer directly (``device=False``) or the staging thread
-        (``device=True``)."""
+        (``device=True``). ``blocks`` restricts to those page indices
+        (the stitched gap feed — cached pages never touch the arena)."""
         with self.rw.read():
             if self.dropped:
                 raise KeyError(f"paged relation {self.name!r} was "
                                f"dropped; cannot stream")
-            yield from self._stream_unlocked(prefetch)
+            yield from self._stream_unlocked(prefetch, blocks)
 
-    def _stream_unlocked(self, prefetch: Optional[int] = None
+    def _stream_unlocked(self, prefetch: Optional[int] = None,
+                         blocks: Optional[List[int]] = None
                          ) -> Iterator[Tuple[Dict[str, np.ndarray],
                                              np.ndarray, int]]:
         streams = []
         if self.int_names:
             streams.append((self.int_names,
                             self.store.stream_blocks(f"{self.name}.int",
-                                                     prefetch)))
+                                                     prefetch,
+                                                     blocks=blocks)))
         if self.float_names:
             streams.append((self.float_names,
                             self.store.stream_blocks(
-                                f"{self.name}.float", prefetch)))
+                                f"{self.name}.float", prefetch,
+                                blocks=blocks)))
         while True:
             chunk: Dict[str, np.ndarray] = {}
             start = n = None
@@ -338,6 +352,37 @@ class PagedColumns:
                placement.label() if placement is not None else None)
         return cache, key
 
+    def _partial_plan(self, kind: str, placement, prefetch):
+        """A :class:`~netsdb_tpu.plan.staging.PartialPlan` for one
+        stream of this relation under the block-granular cache, or
+        None (cache off / whole-run mode / unbound temporary). The
+        base key is the tentpole's ``(scope, kind, bucket, sharding)``
+        — NO write version and NO mutation counter: block freshness is
+        dirty-range invalidation's job, which is exactly what lets a
+        tail append keep every pre-append block matchable."""
+        from netsdb_tpu.plan.staging import PartialPlan
+
+        cache = self.devcache
+        if (cache is None or not cache.enabled
+                or not getattr(cache, "partial", False)
+                or self.cache_scope is None or self.dropped):
+            return None
+        base_key = (self.cache_scope, kind, self.pad_rows(),
+                    placement.label() if placement is not None else None)
+        ranges = self.block_ranges()
+        if not ranges:
+            return None
+        return PartialPlan(
+            cache, base_key, ranges,
+            lambda idxs: self._host_stream(prefetch, blocks=idxs))
+
+    def block_ranges(self) -> List[Tuple[int, int]]:
+        """The relation's [(start_row, end_row)] block layout —
+        metadata only (the co-paged int/float matrices share one
+        blocking, so either matrix's layout is THE layout)."""
+        suffix = ".int" if self.int_names else ".float"
+        return self.store.block_ranges(self.name + suffix)
+
     def drop(self) -> None:
         """Free this relation's pages from the shared arena (both the
         int and float matrices). After this the PagedColumns is dead.
@@ -374,12 +419,16 @@ class PagedColumns:
         way).
 
         Store-owned relations consult the cross-query DEVICE CACHE
-        first (``storage/devcache.py``): a warm stream replays the
-        placed chunk tables already in device memory — zero arena
-        reads, zero host→device transfers — and a cold stream installs
-        the completed run on the way through. Cached chunks are owned
-        by the cache, never donation targets (fold steps donate only
-        their carried accumulator)."""
+        first (``storage/devcache.py``). Whole-run mode
+        (``device_cache_partial=off``): a warm stream replays the
+        placed chunk run already in device memory and a cold stream
+        installs the completed run on the way through. Partial mode
+        (the default): each cached BLOCK range serves from HBM — zero
+        arena reads — stitched in row order with gap ranges streaming
+        through the normal pipeline, and every placed gap block
+        installs as it goes (early exit keeps the consumed prefix).
+        Cached chunks are owned by the cache, never donation targets
+        (fold steps donate only their carried accumulator)."""
         from netsdb_tpu.plan.staging import stage_stream
 
         cache, cache_key = self._cache_ref("tables", placement)
@@ -403,6 +452,13 @@ class PagedColumns:
             return ColumnTable({k: jnp.asarray(v) for k, v in cols.items()},
                                dicts, jnp.asarray(valid))
 
+        partial = self._partial_plan("tables", placement, prefetch)
+        if partial is not None:
+            return stage_stream(
+                None, place,
+                depth=getattr(self.store.config, "stage_depth", 2),
+                name=f"tables:{self.name}", partial=partial,
+                scope=str(self.cache_scope))
         return stage_stream(
             self._host_stream(prefetch), place,
             depth=getattr(self.store.config, "stage_depth", 2),

@@ -82,7 +82,8 @@ class QueryScheduler:
                  coalesce_done_ttl_s: float = 0.0,
                  coalesce_done_max: int = 32,
                  cache_probe=None,
-                 feedback: bool = False, feedback_every: int = 64):
+                 feedback: bool = False, feedback_every: int = 64,
+                 slo_source=None):
         from netsdb_tpu.utils.locks import TrackedLock
 
         self.lanes = LaneScheduler(slots, lanes=lanes, quota=quota,
@@ -91,6 +92,14 @@ class QueryScheduler:
         # and per-lane quotas from the attribution + operator ledgers
         # every `feedback_every` admissions (opt-in)
         self.feedback_enabled = bool(feedback)
+        # SLO burn-rate load shedding (config.sched_slo_shed):
+        # ``slo_source()`` returns the objective names currently
+        # breached on ALL windows; any breach halves the heaviest
+        # non-reserved lane's quota (feedback.SHED_FACTOR, pinned)
+        # until the first breach-free check. Shares the feedback
+        # cadence and background thread.
+        self.shed_enabled = slo_source is not None
+        self._slo_source = slo_source
         self._feedback_every = max(int(feedback_every or 0), 1)
         self._base_quota = max(int(quota or 0), 0)
         self._fb_mu = TrackedLock("sched.QueryScheduler._fb_mu")
@@ -109,7 +118,7 @@ class QueryScheduler:
     # --- lanes --------------------------------------------------------
     def acquire(self, lane: Optional[str],
                 timeout_s: float) -> AdmissionTicket:
-        if self.feedback_enabled:
+        if self.feedback_enabled or self.shed_enabled:
             self._maybe_feedback()
         return self.lanes.acquire(lane, timeout_s)
 
@@ -132,10 +141,44 @@ class QueryScheduler:
 
     def _feedback_bg(self) -> None:
         try:
-            self.refresh_feedback()
+            if self.feedback_enabled:
+                self.refresh_feedback()
+            if self.shed_enabled:
+                self.refresh_shed()
         finally:
             with self._fb_mu:
                 self._fb_running = False
+
+    def refresh_shed(self):
+        """One SLO load-shedding check (serve/sched/feedback.py's
+        pinned formula): any objective breached on all windows →
+        halve the heaviest non-reserved lane's quota and tick
+        ``sched.shed_events``; no breach → lift every shed override.
+        Returns the lane shed this check (None otherwise) — for
+        tests/tooling."""
+        from netsdb_tpu.serve.sched import feedback as _feedback
+
+        try:
+            breached = list(self._slo_source() or ())
+        except Exception as e:  # noqa: BLE001 — a broken probe must
+            del e              # never wedge admission; skip the check
+            return None
+        if not breached:
+            self.lanes.unshed()
+            return None
+        if self.lanes.shed_lanes():
+            return None  # one shed at a time; wait for recovery
+        snap = self.lanes.snapshot()
+        lane = _feedback.pick_shed_lane(snap.get("lanes", {}),
+                                        reserved=self.lanes.reserved_lanes)
+        if lane is None:
+            return None
+        shed_q = self.lanes.shed(lane, _feedback.SHED_FACTOR,
+                                 _feedback.SHED_MIN_QUOTA)
+        if shed_q is None:
+            return None
+        obs.REGISTRY.counter("sched.shed_events").inc()
+        return lane
 
     def refresh_feedback(self):
         """Recompute lane weights/quotas from the attribution +

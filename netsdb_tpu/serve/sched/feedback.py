@@ -45,6 +45,13 @@ MIN_REQUESTS = 8
 CLAMP = (0.25, 4.0)
 #: seconds per executor chunk when the OperatorLedger is cold
 DEFAULT_SEC_PER_CHUNK = 1e-3
+#: SLO load shedding (``config.sched_slo_shed``): when an objective
+#: breaches on ALL its windows, the heaviest non-reserved lane's quota
+#: is multiplied by SHED_FACTOR (floored at SHED_MIN_QUOTA) until the
+#: first breach-free check restores it. Both constants are part of the
+#: pinned test contract, like the weight formula above.
+SHED_FACTOR = 0.5
+SHED_MIN_QUOTA = 1
 
 
 def sec_per_chunk(op_snapshot: Dict[str, Dict[str, Dict[str, float]]]
@@ -106,3 +113,26 @@ def seed_lanes(attrib_snapshot: Dict[str, Dict[str, Dict[str, float]]],
         if base_quota > 0:
             quotas[client] = max(1, round(base_quota * w))
     return weights, quotas
+
+
+def pick_shed_lane(lane_snapshot: Dict[str, Dict[str, float]],
+                   reserved: Optional[set] = None) -> Optional[str]:
+    """The lane SLO load shedding targets: the HEAVIEST non-reserved
+    lane — most admissions (the wait histogram's exact ``count`` is
+    one tick per grant; the WFQ ``served`` number is join-adjusted
+    virtual time and would misrank late joiners), queue depth breaking
+    ties (deepest first), then name for determinism. None when every
+    lane is reserved or the table is empty — explicit operator
+    configuration outranks shedding, like it outranks the weight
+    reseed."""
+    best = None
+    for name, row in (lane_snapshot or {}).items():
+        if reserved and name in reserved:
+            continue
+        admissions = float((row.get("wait") or {}).get("count")
+                           or row.get("served") or 0.0)
+        key = (admissions, float(row.get("depth") or 0.0))
+        if best is None or key > best[1] \
+                or (key == best[1] and name < best[0]):
+            best = (name, key)
+    return best[0] if best else None

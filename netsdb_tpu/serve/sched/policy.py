@@ -93,28 +93,55 @@ def sets_touched(typ: Any, payload: Any) -> FrozenSet[str]:
 
 
 class AffinityGate:
-    """Cold-set single-installer gate. ``cache_probe(scope) -> bool``
-    answers "is this set warm in the device cache?" (the PR 4
-    buffer-pool); queries whose cold-set key matches an in-progress
-    installer wait (bounded) for its completion and then run into the
-    warm cache."""
+    """Cold-set single-installer gate, keyed per PAGE RANGE.
 
-    def __init__(self, cache_probe: Callable[[str], bool],
+    ``cache_probe(scope)`` answers three ways (the partial-run cache's
+    coverage probe, ``ServeController._devcache_warm``):
+
+    * ``True`` — warm (fully resident / ungated): admit immediately.
+      With block-granular caching this is what a query over an
+      already-warm set gets even though earlier streams installed it
+      piecemeal — full coverage, zero gating.
+    * ``False`` — cold from row 0: classic single-installer gating.
+    * an ``int`` — partially covered: the contiguous resident prefix
+      ends at that row, so only the COLD REMAINDER ``[covered, end)``
+      needs installing. The query still serializes as that
+      remainder's gap installer (two gap installers racing the same
+      remainder is exactly the cold-stream thrash the gate exists to
+      prevent), but the gate's key records the remainder start — a
+      sibling arriving after the gap landed probes warm and admits
+      without ever touching the gate.
+
+    Queries whose cold/remainder key matches an in-progress installer
+    wait (bounded) for its completion and then run into the warm
+    cache."""
+
+    def __init__(self, cache_probe: Callable[[str], Any],
                  wait_s: float = 30.0):
         self._mu = TrackedLock("sched.AffinityGate._mu")
         # scope -> the installer's completion event. Membership is
         # PER SCOPE, not per cold-set key: a query whose cold sets
         # merely OVERLAP an in-progress installer's must still wait
         # (two "installers" sharing one cold set would race exactly
-        # the cold streams the gate exists to prevent).
+        # the cold streams the gate exists to prevent). The remainder
+        # start of the current installer rides alongside for
+        # introspection/annotation.
         self._installing: Dict[str, threading.Event] = {}
+        self._remainder: Dict[str, int] = {}
         self._probe = cache_probe
         self.wait_s = float(wait_s)
 
     @contextlib.contextmanager
     def admit(self, scopes: Iterable[str]):
-        cold = frozenset(s for s in (scopes or ())
-                         if not self._probe(s))
+        # remainder-aware cold map: scope -> first cold row (0 = fully
+        # cold; >0 = the resident prefix ends there and only the gap
+        # serializes)
+        cold: Dict[str, int] = {}
+        for s in (scopes or ()):
+            p = self._probe(s)
+            if p is True:
+                continue
+            cold[s] = 0 if p is False else max(int(p), 0)
         if not cold:
             yield
             return
@@ -133,11 +160,16 @@ class AffinityGate:
                 ev = threading.Event()
                 for s in mine:
                     self._installing[s] = ev
+                    self._remainder[s] = cold[s]
         if mine:
             obs.REGISTRY.counter("sched.affinity_installs").inc()
             if tr is not None:
                 tr.annotate("sched.affinity",
                             "install" if not busy else "install+wait")
+                # which ranges this installer owns: row 0 for a fully
+                # cold set, the warm prefix's end for a gap install
+                tr.annotate("sched.affinity_remainder",
+                            {s: cold[s] for s in mine})
         if busy:
             obs.REGISTRY.counter("sched.affinity_hits").inc()
             if tr is not None:
@@ -160,4 +192,5 @@ class AffinityGate:
                     for s in mine:
                         if self._installing.get(s) is ev:
                             del self._installing[s]
+                            self._remainder.pop(s, None)
                 ev.set()

@@ -113,6 +113,10 @@ class LaneScheduler:
         # per-lane quota overrides (feedback-seeded); lanes not listed
         # keep the global _quota
         self._lane_quotas: Dict[str, int] = {}
+        # SLO load-shed override: lane -> the pre-shed quota override
+        # (None = the lane had no override; restore deletes the entry).
+        # At most one lane is shed at a time.
+        self._shed: Dict[str, Optional[int]] = {}
         self._lanes: "OrderedDict[str, _Lane]" = OrderedDict()
         self._depth = 0
 
@@ -134,10 +138,58 @@ class LaneScheduler:
             for name, q in (quotas or {}).items():
                 if name in self.reserved_lanes:
                     continue
-                self._lane_quotas[name] = max(int(q), 1)
+                if name in self._shed:
+                    # the lane is under a shed override: reseed the
+                    # REMEMBERED quota so unshed restores the fresh
+                    # value, never a pre-reseed stale one
+                    self._shed[name] = max(int(q), 1)
+                else:
+                    self._lane_quotas[name] = max(int(q), 1)
 
     def _quota_for_locked(self, name: str) -> int:
         return self._lane_quotas.get(name, self._quota)
+
+    def shed(self, lane: str, factor: float,
+             min_quota: int = 1) -> Optional[int]:
+        """Apply the SLO load-shed quota override to ``lane``:
+        ``quota × factor`` (floored at ``min_quota``), remembering the
+        pre-shed state for :meth:`unshed`. Returns the shed quota, or
+        None when there is nothing to shed (no effective quota, lane
+        already shed, or reserved). The override halves QUEUEING
+        capacity only — admitted work is never cancelled."""
+        name = str(lane)
+        with self._mu:
+            if name in self._shed or name in self.reserved_lanes:
+                return None
+            current = self._quota_for_locked(name)
+            if current <= 0:  # unbounded lanes have no quota to halve
+                return None
+            shed_q = max(int(current * factor), int(min_quota))
+            if shed_q >= current:
+                return None  # already at the floor
+            self._shed[name] = self._lane_quotas.get(name)
+            self._lane_quotas[name] = shed_q
+            return shed_q
+
+    def unshed(self) -> list:
+        """Lift every load-shed quota override (the first breach-free
+        check restores full capacity). Returns the lane names
+        restored."""
+        with self._mu:
+            restored = []
+            for name, prev in self._shed.items():
+                if prev is None:
+                    self._lane_quotas.pop(name, None)
+                else:
+                    self._lane_quotas[name] = prev
+                restored.append(name)
+            self._shed.clear()
+            return restored
+
+    def shed_lanes(self) -> list:
+        """Lane names currently under a shed override (introspection)."""
+        with self._mu:
+            return sorted(self._shed)
 
     # --- lane bookkeeping --------------------------------------------
     def _lane_locked(self, name: str) -> _Lane:
@@ -289,6 +341,7 @@ class LaneScheduler:
                 "queued": self._depth,
                 "quota": self._quota,
                 "lane_quotas": dict(self._lane_quotas),
+                "shed_lanes": sorted(self._shed),
                 "aging_every": self._aging_every,
                 "lanes": {
                     name: {"weight": ln.weight, "depth": len(ln.q),

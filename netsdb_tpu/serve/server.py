@@ -754,7 +754,14 @@ class ServeController:
                 config, "sched_coalesce_done_max", 32),
             cache_probe=self._devcache_warm,
             feedback=getattr(config, "sched_feedback", False),
-            feedback_every=getattr(config, "sched_feedback_every", 64))
+            feedback_every=getattr(config, "sched_feedback_every", 64),
+            # SLO burn-rate load shedding (opt-in): the scheduler
+            # halves the heaviest non-reserved lane's quota while any
+            # objective breaches on all windows (obs/slo.py's
+            # multi-window agreement), restoring on recovery
+            slo_source=(self.slo.breached_objectives
+                        if getattr(config, "sched_slo_shed", False)
+                        else None))
         self._job_seq = itertools.count(1)
         self._jobs: Dict[int, Dict[str, Any]] = {}
         self._jobs_lock = TrackedLock("ServeController._jobs_lock")
@@ -1197,7 +1204,7 @@ class ServeController:
     COALESCED_FRAMES = frozenset({MsgType.EXECUTE_COMPUTATIONS,
                                   MsgType.EXECUTE_PLAN})
 
-    def _devcache_warm(self, scope: str) -> bool:
+    def _devcache_warm(self, scope: str):
         """The scheduler's cache probe: is ``scope`` ("db:set") warm in
         the device cache? Answers warm (= no gating) for a disabled
         cache AND for non-paged sets: resident sets never enter the
@@ -1205,10 +1212,28 @@ class ServeController:
         serialize concurrent queries with no warm cache to wake into.
         Only a COLD PAGED set — the one whose first stream installs
         the run every later sibling replays — is worth queueing
-        behind."""
+        behind.
+
+        With block-granular partial caching the answer is RANGE-aware
+        (the AffinityGate's per-page-range keying): ``True`` when the
+        set's block coverage is complete (a query over an
+        already-warm prefix admits immediately — mere ``has_scope``
+        would also read one resident block as "warm" and let every
+        sibling race the gap installs), an ``int`` (the contiguous
+        covered prefix's end row) when partially covered so only the
+        cold-remainder installer serializes, ``False`` when cold."""
         cache = self.library.store.device_cache()
-        if not cache.enabled or cache.has_scope(scope):
+        if not cache.enabled:
             return True
+        partial = getattr(cache, "partial", False)
+        if partial:
+            covered, total = cache.coverage(scope)
+            if total is not None and 0 < total <= covered:
+                return True  # fully resident: no gating
+        elif cache.has_scope(scope):
+            return True
+        else:
+            covered = 0
         db, _, set_name = scope.partition(":")
         try:
             storage = self.library.store.storage_of(
@@ -1216,7 +1241,9 @@ class ServeController:
         except Exception as e:  # noqa: BLE001 — unknown set → ungated
             del e
             return True
-        return storage != "paged"
+        if storage != "paged":
+            return True
+        return int(covered) if covered > 0 else False
 
     def _execute_frame(self, typ, payload, codec_in, token, qid=None,
                        client=None, lane=None):

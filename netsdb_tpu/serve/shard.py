@@ -615,7 +615,14 @@ class ShardPool:
         Batches are removed from the buffer only AFTER they shipped,
         exactly the ones that shipped — a batch buffered concurrently
         (a frame classified handoff just before the epoch flipped) is
-        picked up by the drain loop's next round, never dropped."""
+        picked up by the drain loop's next round, never dropped.
+
+        Device-cache coherence: drained batches land on the shard
+        through the ordinary SEND_DATA mutators, so ``SetStore._touch``
+        logs each one as an APPEND-TAIL dirty range — under partial-run
+        caching the shard's pre-buffered cached blocks stay resident
+        and only the drained tail re-stages (pinned by
+        tests/test_devcache_partial.py)."""
         drained = 0
         for db, set_name in self.ctl.placement.sets_for_addr(addr):
             entry = self.ctl.placement.entry(db, set_name)

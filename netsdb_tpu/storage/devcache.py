@@ -38,6 +38,22 @@ not kernel tweaks.
   with ``donate_argnums`` covering it, or XLA would free a buffer the
   next query expects to reuse.
 
+With ``config.device_cache_partial`` (the default) the cache is
+additionally **block-granular** — netsDB pins per PAGE, never per set
+(``PageCache.h`` pin/unpin is a page-level contract), and the
+whole-run design above could not keep a huge set's hot prefix
+resident across appends: one small write unkeyed the entire run.
+Partial mode installs each placed chunk as its own entry under
+``(scope, kind, bucket, sharding, block_range)``, stitches contiguous
+cached ranges into cold streams (``plan/staging.stage_stream`` serves
+cached ranges from HBM with zero arena reads while gaps stream
+normally), replaces version keying with per-page **dirty-range
+invalidation** (``SetStore._touch`` passes the appended tail range;
+only intersecting blocks drop), and optionally PINS a set's head
+blocks under ``config.device_cache_pin_bytes`` so the hot prefix
+survives LRU pressure. ``device_cache_partial=False`` restores the
+whole-run behavior byte-for-byte.
+
 The one blessed upload helper, :func:`to_device`, lives here so the
 static check (``tests/test_static_checks.py``) can ban direct
 ``device_put`` of store-owned set blocks everywhere else in
@@ -103,23 +119,47 @@ def _value_nbytes(value) -> int:
 
 
 class DeviceBlockCache:
-    """LRU cache of placed set-block runs under one byte budget.
+    """LRU cache of placed set blocks under one byte budget.
 
-    A cache ENTRY is one whole run — the ordered list of placed chunks
-    one full stream of a set produces (matching the key's bucket and
-    sharding). Whole-run granularity matches the key the tentpole
-    names: ``(db, set, version, bucket, sharding)`` — a warm consumer
-    replays the run without touching the arena or the transfer path at
-    all, which is what makes the warm serve ``EXECUTE`` zero-copy.
+    Two entry granularities share the budget, the LRU order and the
+    invalidation index:
+
+    * **whole-run entries** (the PR 4 design, and the only kind when
+      ``partial=False``) — one entry per complete stream of a set,
+      keyed ``(scope, version, mutations, kind, bucket, sharding)``;
+      version keying is the correctness mechanism, eviction is only
+      about memory.
+    * **block entries** (``partial=True`` — the netsDB pin-per-page
+      discipline) — one entry per placed chunk, keyed
+      ``base_key + ((start, end),)`` where ``base_key`` is
+      ``(scope, kind, bucket, sharding, …)`` WITHOUT the write
+      version: freshness comes from **dirty-range invalidation**
+      (:meth:`invalidate_range` drops only intersecting blocks), so a
+      tail append leaves every pre-append block resident and a warm
+      re-query re-stages only the gap. A per-scope **epoch** (bumped
+      by every invalidation touching the scope) gates installs: a
+      block placed before a racing write carries the old epoch and is
+      refused, so a dead entry can never squat on the budget.
+
+    Partial-mode run-level counters keep their PR 4 meaning: a
+    ``plan_ranges`` consult with FULL coverage counts one ``hit``, any
+    gap counts one ``miss``, and an installer that lands every gap
+    block of its stream counts one ``install`` — while per-block
+    serving ticks ``partial_hits`` and stitched contiguous cached
+    ranges tick ``stitched_ranges``.
 
     Thread-safe: consults happen on consumer threads, installs on
     staging threads, invalidations on serve handler threads.
     """
 
-    def __init__(self, budget_bytes: int = 0):
+    def __init__(self, budget_bytes: int = 0, partial: bool = False,
+                 pin_bytes: int = 0):
         self._mu = TrackedLock("DeviceBlockCache._mu")
         self._budget = int(budget_bytes or 0)
-        # key -> (blocks, nbytes); insertion order IS recency order
+        self.partial = bool(partial)
+        self._pin_budget = int(pin_bytes or 0)
+        # key -> (blocks, nbytes); insertion order IS recency order.
+        # Block entries hold a single-element blocks list.
         self._entries: "OrderedDict[Tuple, Tuple[List[Any], int]]" = \
             OrderedDict()
         # scope -> keys, for prompt invalidation (version keying alone
@@ -129,6 +169,24 @@ class DeviceBlockCache:
         self._stats = {"hits": 0, "misses": 0, "installs": 0,
                        "evictions": 0, "invalidations": 0,
                        "rejected": 0}
+        if self.partial:
+            self._stats.update({"partial_hits": 0, "stitched_ranges": 0,
+                                "dirty_invalidations": 0,
+                                "pinned_bytes": 0})
+        # --- partial-mode state (all guarded by _mu) ---
+        # scope -> monotonic dirty epoch (bumped by every invalidation
+        # touching the scope; installs are epoch-gated)
+        self._epochs: Dict[str, int] = {}
+        # pinned block keys (skipped by LRU eviction) + the global
+        # pinned-byte total under _pin_budget
+        self._pinned: set = set()
+        self._pinned_bytes = 0
+        # base_key -> end row of the contiguous pinned head prefix
+        # (pinning only ever extends the prefix, in install order)
+        self._pin_hw: Dict[Tuple, int] = {}
+        # base_key -> total rows of the set as of the last plan (the
+        # coverage probe's denominator)
+        self._totals: Dict[Tuple, int] = {}
 
     # --- sizing -------------------------------------------------------
     @property
@@ -144,6 +202,14 @@ class DeviceBlockCache:
         cache-off baseline). Shrinking evicts immediately."""
         with self._mu:
             self._budget = int(budget_bytes or 0)
+            if self._budget < self._pinned_bytes:
+                # a shrink below the pinned total lifts every pin —
+                # the operator explicitly chose the smaller pool
+                self._pinned.clear()
+                self._pinned_bytes = 0
+                self._pin_hw.clear()
+                if "pinned_bytes" in self._stats:
+                    self._stats["pinned_bytes"] = 0
             self._evict_to_fit_locked(0)
 
     # --- the data path ------------------------------------------------
@@ -247,25 +313,277 @@ class DeviceBlockCache:
         return True
 
     def _evict_to_fit_locked(self, incoming: int) -> None:
-        while self._entries and self._bytes + incoming > self._budget:
-            old_key, (_, old_bytes) = self._entries.popitem(last=False)
-            self._bytes -= old_bytes
-            scoped = self._by_scope.get(str(old_key[0]))
-            if scoped is not None:
-                scoped.discard(old_key)
-                if not scoped:
-                    self._by_scope.pop(str(old_key[0]), None)
+        # ONE pass in LRU order collecting victims, skipping PINNED
+        # block entries (a set's hot head prefix under the pin budget
+        # — only invalidation drops them; when everything left is
+        # pinned, eviction stops and the caller's install simply fails
+        # to fit). A restart-per-victim scan would re-walk the pinned
+        # head for every eviction — O(pinned × evicted) inside _mu.
+        if self._bytes + incoming <= self._budget:
+            return
+        victims = []
+        freed = 0
+        for key, (_, nbytes) in self._entries.items():
+            if key in self._pinned:
+                continue
+            victims.append(key)
+            freed += nbytes
+            if self._bytes - freed + incoming <= self._budget:
+                break
+        for key in victims:
+            self._drop_entry_locked(key)
             self._stats["evictions"] += 1
-            obs.REGISTRY.counter("devcache.evictions").inc()
+        if victims:
+            obs.REGISTRY.counter("devcache.evictions").inc(len(victims))
+
+    def _drop_entry_locked(self, key: Tuple) -> bool:
+        """Remove one entry (any granularity) from every index."""
+        entry = self._entries.pop(key, None)
+        if entry is None:
+            return False
+        self._bytes -= entry[1]
+        scoped = self._by_scope.get(str(key[0]))
+        if scoped is not None:
+            scoped.discard(key)
+            if not scoped:
+                self._by_scope.pop(str(key[0]), None)
+        if key in self._pinned:
+            self._pinned.discard(key)
+            self._pinned_bytes -= entry[1]
+        return True
+
+    # --- partial mode: per-block entries + range stitching ------------
+    @staticmethod
+    def _block_key(base_key: Tuple, rng: Tuple[int, int]) -> Tuple:
+        return tuple(base_key) + ((int(rng[0]), int(rng[1])),)
+
+    def scope_epoch(self, scope: str) -> int:
+        """The scope's current dirty epoch — captured by a stream at
+        plan time and checked again at each block install, so a write
+        racing the stream can never strand a stale block entry."""
+        with self._mu:
+            return self._epochs.get(str(scope), 0)
+
+    def plan_ranges(self, base_key: Tuple,
+                    ranges: List[Tuple[int, int]]
+                    ) -> Tuple[int, Dict[Tuple[int, int], Any]]:
+        """(epoch, {range: block}) for the block entries of
+        ``base_key`` matching the expected ``ranges`` of one stream —
+        the stitching consult. Run-level counters keep their whole-run
+        meaning: full coverage is one hit, any gap one miss; the
+        per-block ``partial_hits`` tick happens when blocks are
+        actually SERVED (staging._StitchedStream), not here."""
+        scope = str(base_key[0])
+        with self._mu:
+            if not (self.enabled and self.partial):
+                return 0, {}
+            epoch = self._epochs.get(scope, 0)
+            if ranges:
+                self._totals[tuple(base_key)] = int(ranges[-1][1])
+            covered: Dict[Tuple[int, int], Any] = {}
+            for rng in ranges:
+                key = self._block_key(base_key, rng)
+                entry = self._entries.get(key)
+                if entry is not None:
+                    self._entries.move_to_end(key)
+                    covered[(int(rng[0]), int(rng[1]))] = entry[0][0]
+            full = bool(ranges) and len(covered) == len(ranges)
+            self._stats["hits" if full else "misses"] += 1
+        obs.REGISTRY.counter("devcache.lookups").inc()
+        name = "devcache.hits" if full else "devcache.misses"
+        obs.REGISTRY.counter(name).inc()
+        obs.add(name)
+        obs.operators.op_add(name)
+        obs.attrib.account(name, scope=scope)
+        return epoch, covered
+
+    def install_block(self, base_key: Tuple, rng: Tuple[int, int],
+                      block: Any, epoch: int,
+                      client: Optional[str] = None) -> bool:
+        """Insert ONE placed block under ``base_key + (range,)``.
+        Refused when the scope's dirty epoch moved past ``epoch`` (a
+        write raced the stream — the block may predate it), when the
+        block alone exceeds the budget, or when eviction cannot make
+        room without touching pinned entries. Head blocks (the
+        contiguous prefix from row 0, in install order) are PINNED
+        while the global pin budget lasts."""
+        nbytes = _value_nbytes(block)
+        scope = str(base_key[0])
+        with self._mu:
+            if not (self.enabled and self.partial):
+                return False
+            if self._epochs.get(scope, 0) != int(epoch):
+                return False  # a write landed since the stream planned
+            if nbytes > self._budget:
+                self._stats["rejected"] += 1
+                return False
+            key = self._block_key(base_key, rng)
+            if key in self._entries:  # concurrent stream won the race
+                self._entries.move_to_end(key)
+                return True
+            self._evict_to_fit_locked(nbytes)
+            if self._bytes + nbytes > self._budget:
+                # everything evictable is gone and pinned entries hold
+                # the rest — a cache full of pinned heads must not
+                # thrash, the block simply streams uncached
+                self._stats["rejected"] += 1
+                return False
+            self._entries[key] = ([block], nbytes)
+            self._bytes += nbytes
+            self._by_scope.setdefault(scope, set()).add(key)
+            base = tuple(base_key)
+            hw = self._pin_hw.get(base, 0)
+            if (self._pin_budget > 0 and int(rng[0]) == hw
+                    and self._pinned_bytes + nbytes <= self._pin_budget):
+                self._pinned.add(key)
+                self._pinned_bytes += nbytes
+                self._pin_hw[base] = int(rng[1])
+            self._stats["pinned_bytes"] = self._pinned_bytes
+        obs.REGISTRY.gauge("devcache.pinned_bytes").set(
+            self._pinned_bytes)
+        return True
+
+    def record_run_install(self, scope: str,
+                           client: Optional[str] = None) -> None:
+        """Tick the run-level ``installs`` counter once a stitched
+        stream's installer landed every gap block of its run — the
+        partial-mode analogue of one whole-run :meth:`install`."""
+        with self._mu:
+            if not (self.enabled and self.partial):
+                return
+            self._stats["installs"] += 1
+        obs.REGISTRY.counter("devcache.installs").inc()
+        obs.add("devcache.installs")
+        obs.attrib.account("devcache.installs", scope=str(scope),
+                           client=client)
+
+    def tick_partial(self, scope: str, blocks_served: int,
+                     stitched_ranges: int) -> None:
+        """Account blocks served device-resident by a stitched stream
+        (called from the consumer side as cached blocks are yielded)."""
+        if blocks_served <= 0 and stitched_ranges <= 0:
+            return
+        with self._mu:
+            if "partial_hits" in self._stats:
+                self._stats["partial_hits"] += int(blocks_served)
+                self._stats["stitched_ranges"] += int(stitched_ranges)
+        if blocks_served > 0:
+            obs.REGISTRY.counter("devcache.partial_hits").inc(
+                int(blocks_served))
+            obs.add("devcache.partial_hits", int(blocks_served))
+            obs.operators.op_add("devcache.partial_hits",
+                                 int(blocks_served))
+            # attributed under the per-block name: the ledger's
+            # "devcache.hits" stays run-level (plan_ranges ticks it),
+            # so per-client hit-rate math against lookups never
+            # exceeds 100%
+            obs.attrib.account("devcache.partial_hits",
+                               int(blocks_served), scope=str(scope))
+        if stitched_ranges > 0:
+            obs.REGISTRY.counter("devcache.stitched_ranges").inc(
+                int(stitched_ranges))
+
+    def coverage(self, scope: str) -> Tuple[int, Optional[int]]:
+        """(covered_prefix_rows, total_rows) — the best contiguous
+        cached prefix from row 0 over any base key of ``scope``, and
+        that key's last-planned total (None when never planned). The
+        scheduler's remainder-range probe (serve/sched/policy.py);
+        counter-free like :meth:`has_scope`."""
+        best = (0, None)
+        with self._mu:
+            keys = self._by_scope.get(str(scope), ())
+            by_base: Dict[Tuple, List[Tuple[int, int]]] = {}
+            for key in keys:
+                rng = key[-1]
+                if (isinstance(rng, tuple) and len(rng) == 2
+                        and isinstance(rng[0], int)):
+                    by_base.setdefault(key[:-1], []).append(rng)
+            for base, rngs in by_base.items():
+                covered = 0
+                for s0, e0 in sorted(rngs):
+                    if s0 > covered:
+                        break
+                    covered = max(covered, e0)
+                total = self._totals.get(base)
+                if covered > best[0] or (covered == best[0]
+                                         and best[1] is None):
+                    best = (covered, total)
+        return best
+
+    def invalidate_range(self, scope: str, start: int,
+                         end: Optional[int] = None) -> int:
+        """Drop only the entries a dirty row range intersects: block
+        entries overlapping ``[start, end)`` (end=None → to infinity)
+        plus every whole-run entry of the scope (version-keyed, so
+        already unmatchable — dropping returns their bytes now). Bumps
+        the scope's epoch either way, refusing in-flight installs
+        planned before the write. Returns entries dropped."""
+        scope = str(scope)
+        dropped = dirty = 0
+        with self._mu:
+            self._epochs[scope] = self._epochs.get(scope, 0) + 1
+            # the write may have GROWN the set: last-planned totals are
+            # stale until the next plan_ranges, and a stale total would
+            # make coverage() report "fully resident" right after a
+            # tail append — exactly when the affinity gate must
+            # serialize the cold-tail installer, not admit everyone
+            for base in [b for b in self._totals if str(b[0]) == scope]:
+                self._totals.pop(base, None)
+            keys = list(self._by_scope.get(scope, ()))
+            for key in keys:
+                rng = key[-1]
+                is_block = (isinstance(rng, tuple) and len(rng) == 2
+                            and isinstance(rng[0], int))
+                if is_block:
+                    s0, e0 = rng
+                    if e0 <= start or (end is not None and s0 >= end):
+                        continue  # disjoint: the block stays resident
+                    dirty += 1
+                if self._drop_entry_locked(key):
+                    dropped += 1
+            # the pinned head prefix may have been truncated: recompute
+            # each base's high water from the SURVIVING pinned blocks
+            # so re-installs re-pin from the break, not from scratch
+            for base in [b for b in self._pin_hw if str(b[0]) == scope]:
+                rngs = sorted(k[-1] for k in self._pinned
+                              if k[:-1] == base)
+                hw = 0
+                for s0, e0 in rngs:
+                    if s0 > hw:
+                        break
+                    hw = max(hw, e0)
+                self._pin_hw[base] = hw
+            if "dirty_invalidations" in self._stats:
+                self._stats["dirty_invalidations"] += dirty
+                self._stats["pinned_bytes"] = self._pinned_bytes
+            self._stats["invalidations"] += dropped
+        if dropped:
+            obs.REGISTRY.counter("devcache.invalidations").inc(dropped)
+        if dirty and self.partial:
+            obs.REGISTRY.counter("devcache.dirty_invalidations").inc(
+                dirty)
+        obs.REGISTRY.gauge("devcache.pinned_bytes").set(
+            self._pinned_bytes)
+        return dropped
 
     # --- invalidation -------------------------------------------------
     def invalidate(self, scope: str) -> int:
         """Drop every entry of one set NOW (the write-path hook —
-        version keying already prevents stale reads; this returns the
-        dead bytes to the budget immediately). Returns entries
-        dropped."""
+        version keying already prevents stale reads for whole-run
+        entries; block entries NEED this, it is their correctness
+        mechanism for whole-set writes). Bumps the scope's dirty epoch
+        in partial mode. Returns entries dropped."""
+        scope = str(scope)
         with self._mu:
-            keys = self._by_scope.pop(str(scope), None)
+            if self.partial:
+                self._epochs[scope] = self._epochs.get(scope, 0) + 1
+                for base in [b for b in self._pin_hw
+                             if str(b[0]) == scope]:
+                    self._pin_hw.pop(base, None)
+                for base in [b for b in self._totals
+                             if str(b[0]) == scope]:
+                    self._totals.pop(base, None)
+            keys = self._by_scope.pop(scope, None)
             if not keys:
                 return 0
             dropped = 0
@@ -274,8 +592,16 @@ class DeviceBlockCache:
                 if entry is not None:
                     self._bytes -= entry[1]
                     dropped += 1
+                if key in self._pinned:
+                    self._pinned.discard(key)
+                    self._pinned_bytes -= entry[1] if entry else 0
             self._stats["invalidations"] += dropped
+            if "pinned_bytes" in self._stats:
+                self._stats["pinned_bytes"] = self._pinned_bytes
         obs.REGISTRY.counter("devcache.invalidations").inc(dropped)
+        if self.partial:
+            obs.REGISTRY.gauge("devcache.pinned_bytes").set(
+                self._pinned_bytes)
         return dropped
 
     def clear(self) -> int:
@@ -283,10 +609,19 @@ class DeviceBlockCache:
         was just replaced wholesale)."""
         with self._mu:
             dropped = len(self._entries)
+            if self.partial:
+                for scope in {str(k[0]) for k in self._entries}:
+                    self._epochs[scope] = self._epochs.get(scope, 0) + 1
             self._entries.clear()
             self._by_scope.clear()
+            self._pinned.clear()
+            self._pinned_bytes = 0
+            self._pin_hw.clear()
+            self._totals.clear()
             self._bytes = 0
             self._stats["invalidations"] += dropped
+            if "pinned_bytes" in self._stats:
+                self._stats["pinned_bytes"] = 0
             return dropped
 
     # --- introspection ------------------------------------------------

@@ -127,15 +127,22 @@ class PagedTensor:
     def num_blocks(self) -> int:
         return self.store.num_blocks(self.name)
 
-    def stream_blocks(self, prefetch: Optional[int] = None
+    def stream_blocks(self, prefetch: Optional[int] = None,
+                      blocks: Optional[list] = None
                       ) -> Iterator[Tuple[int, np.ndarray]]:
         """Yield (start_row, block) holding the read lock for the
         generator's lifetime (a concurrent drop/replace must not free
         pages mid-stream); consumers should close() abandoned streams.
         ``prefetch=None`` takes the ``config.stream_prefetch_pages``
-        read-ahead knob."""
+        read-ahead knob; ``blocks`` restricts to those page indices
+        (the stitched gap feed — see ``PagedTensorStore.stream_blocks``)."""
         with self.rw.read():
-            yield from self.store.stream_blocks(self.name, prefetch)
+            yield from self.store.stream_blocks(self.name, prefetch,
+                                                blocks=blocks)
+
+    def block_ranges(self) -> list:
+        """[(start_row, end_row)] per page block, metadata only."""
+        return self.store.block_ranges(self.name)
 
 
 class PagedObjects:
@@ -427,8 +434,18 @@ class PagedTensorStore:
     def num_blocks(self, name: str) -> int:
         return len(self.backend.set_pages(self._ids[name]))
 
+    def block_ranges(self, name: str) -> list:
+        """[(start_row, end_row)] per block, METADATA ONLY (derived
+        from page sizes — zero page-data reads). The partial-run
+        device cache plans its range stitching against this: each
+        streamed chunk's identity is its row range."""
+        sid = self._ids[name]
+        ns, starts = self._block_layout(sid)
+        return [(s, s + n) for s, n in zip(starts, ns)]
+
     def stream_blocks(self, name: str,
-                      prefetch: Optional[int] = None
+                      prefetch: Optional[int] = None,
+                      blocks: Optional[list] = None
                       ) -> Iterator[Tuple[int, np.ndarray]]:
         """Yield (start_row, block) in order — the PageScanner loop.
 
@@ -437,6 +454,11 @@ class PagedTensorStore:
         pipeline threads — ``src/storage/headers/PageCircularBuffer.h``)
         so disk/arena reads overlap the consumer's compute; 0 disables,
         None takes the ``config.stream_prefetch_pages`` knob.
+
+        ``blocks`` (sorted block indices) restricts the stream to just
+        those pages — the GAP feed of a range-stitched cached stream
+        (``plan/staging``): pages whose chunks are already device-
+        resident are never read from the arena at all.
         """
         if prefetch is None:
             prefetch = getattr(self.config, "stream_prefetch_pages", 2)
@@ -444,6 +466,9 @@ class PagedTensorStore:
         (rows, cols), _, dtype = self._meta[sid]
         pids = self.backend.set_pages(sid)
         _, starts = self._block_layout(sid)
+        if blocks is not None:
+            pids = [pids[i] for i in blocks]
+            starts = [starts[i] for i in blocks]
 
         def view(raw, start):
             n = len(raw) // max(dtype.itemsize * cols, 1)

@@ -29,7 +29,8 @@ import random
 import threading
 import time
 from collections import OrderedDict
-from typing import Any, Dict, Iterator, List, NamedTuple, Optional
+from typing import (Any, Dict, Iterator, List, NamedTuple, Optional,
+                    Tuple)
 
 import jax
 import numpy as np
@@ -97,6 +98,13 @@ class _StoredSet:
     # cached block can ever match again. Store-wide numbering means a
     # removed-and-recreated set can never reuse an old version.
     version: int = 0
+    # bounded per-set dirty-range log (partial-run device caching):
+    # every _touch appends (start, end) — end=None for whole-scope
+    # writes (replace/clear/restore), the appended tail for appends —
+    # and the cache drops only intersecting block entries. Beyond
+    # config.device_cache_dirty_log un-collapsed entries the log folds
+    # to one whole-scope range (bounded memory, conservative cache).
+    dirty_log: list = dataclasses.field(default_factory=list)
 
 
 def _item_nbytes(item: Any) -> int:
@@ -206,19 +214,54 @@ class SetStore:
                 from netsdb_tpu.storage.devcache import DeviceBlockCache
 
                 self._device_cache = DeviceBlockCache(
-                    getattr(self.config, "device_cache_bytes", 0) or 0)
+                    getattr(self.config, "device_cache_bytes", 0) or 0,
+                    partial=bool(getattr(self.config,
+                                         "device_cache_partial", False)),
+                    pin_bytes=getattr(self.config,
+                                      "device_cache_pin_bytes", 0) or 0)
             return self._device_cache
 
-    def _touch(self, s: _StoredSet) -> None:
-        """Advance a set's write version and drop its cached device
-        blocks NOW. Called by EVERY path that can change the set's
-        content — direct ingest, appends, BULK COMMIT (which lands
-        through these same mutators), mirrored frames, resync restore,
-        checkpoint/spill reload — so the device cache can never serve a
-        stale block: the version is part of every cache key."""
+    def _touch(self, s: _StoredSet,
+               rows: Optional[Tuple[int, int]] = None) -> None:
+        """Advance a set's write version, log the dirty row range and
+        drop the intersecting cached device blocks NOW. Called by EVERY
+        path that can change the set's content — direct ingest,
+        appends, BULK COMMIT (which lands through these same mutators),
+        mirrored frames, resync restore, checkpoint/spill reload.
+
+        ``rows=(start, end)`` names the dirty row range (an append
+        passes its tail); None means the whole scope changed
+        (replace/clear/restore — today's behavior). In whole-run cache
+        mode the range is advisory only and invalidation stays
+        whole-scope, byte-for-byte as before. In partial mode a
+        ranged touch is only LOGGED here: the per-range cache
+        invalidation already happened inside ``PagedColumns.append``
+        (the one mutator every ranged caller routes through — it owns
+        the range invalidation so store-bypassing direct appends stay
+        coherent, and doing it again here would double-bump the scope
+        epoch and refuse installs of streams planned between the two
+        bumps). A caller adding a NEW ``rows=...`` site that does not
+        route through ``pc.append`` must invalidate the range itself.
+
+        When the bounded log overflows it folds to one whole-scope
+        entry AND the cache degrades to a whole-scope invalidation —
+        a pathological writer gets today's invalidate-everything
+        behavior, never unbounded memory or silent fidelity loss."""
         s.version = next(self._version_ctr)
+        bound = max(int(getattr(self.config, "device_cache_dirty_log",
+                                64) or 64), 1)
+        folded = len(s.dirty_log) >= bound
+        if folded:
+            s.dirty_log[:] = [(0, None)]  # fold to whole-scope
+        else:
+            s.dirty_log.append((int(rows[0]), int(rows[1]))
+                               if rows is not None else (0, None))
         if self._device_cache is not None:
-            self._device_cache.invalidate(str(s.ident))
+            if rows is not None and self._device_cache.partial \
+                    and not folded:
+                pass  # range already invalidated by pc.append (above)
+            else:
+                self._device_cache.invalidate(str(s.ident))
 
     def version_of(self, ident: SetIdentifier) -> int:
         """The set's current write version (0 = unknown set) — the
@@ -673,15 +716,21 @@ class SetStore:
                         # lint: disable=lock-blocking-call -- first batch of a fresh relation (comment above): no streams exist, the append wait cannot occur
                         dead = self._ingest_paged(s, [table],
                                                   append=True)
+                rows = None
                 if pc is not None:
                     # live relation: append outside the store lock
                     # (waits for in-flight streams via pc.rw; a
                     # concurrent remove/replace drops pc, making
                     # pc.append fail loudly instead of resurrecting)
+                    before = pc.num_rows
                     self._append_paged_existing(s, pc, table)
+                    # the appended tail is the ONLY dirty range: the
+                    # partial device cache keeps every pre-append
+                    # block resident (whole-run mode ignores it)
+                    rows = (before, pc.num_rows)
                     dead = []
                 with self._lock:
-                    self._touch(s)
+                    self._touch(s, rows=rows)
             self._drop_detached(dead)
             return
         self._append_table_memory(ident, table)
@@ -1107,4 +1156,7 @@ class SetStore:
             "placement": s.placement.label() if s.placement is not None else None,
             "storage": s.storage,
             "version": s.version,
+            # the bounded dirty-range log (partial-run device caching):
+            # (start, end) per write, end=None for whole-scope writes
+            "dirty_ranges": list(s.dirty_log),
         }

@@ -1002,7 +1002,10 @@ def bench_fusion(spine: int = 12, dim_rows: int = 65_536,
             s = ScanSet("fz", "fact")
             pre = Apply(s, lambda t: ColumnTable(
                 {"k": t["k"], "v": t["v"] * 1.5 + 0.25},
-                t.dicts, t.valid), label="pre:affine", rowwise=True)
+                t.dicts, t.valid), label="pre:affine")
+            # rowwise derives from the label: "pre:affine" is in the
+            # audited ROWWISE_SAFE_LABELS registry (a manual
+            # rowwise=True here would trip the rowwise-shadow rule)
 
             def init(prev, src):
                 return jnp.zeros((nk,), jnp.float32)

@@ -536,6 +536,126 @@ def run_device_cache_bench(rows: int = 1_200_000, page_rows: int = 65_536,
     return out
 
 
+def run_partial_cache_bench(rows: int = 1_200_000,
+                            page_rows: int = 65_536,
+                            pool_mb: int = 8, cache_mb: int = 256,
+                            append_frac: float = 0.01,
+                            cycles: int = 3) -> Dict[str, Any]:
+    """Paired A/B for block-granular partial-run caching
+    (``--partial-cache``): the WARM RE-QUERY AFTER A SMALL APPEND,
+    partial dirty-range invalidation vs whole-run invalidation.
+
+    Both arms run the identical protocol on a fresh in-process daemon:
+    ingest a 1.2M-row paged q01 ``lineitem``, warm the device cache
+    (install + one warm run), then ``cycles`` rounds of: append
+    ``append_frac`` of the rows → time ONE warm re-query. Under
+    whole-run invalidation the append unkeys the entire cached run, so
+    the re-query re-reads/re-uploads every page; under partial
+    invalidation only the appended tail range is dirty, so the
+    re-query stitches every pre-append block from HBM and stages only
+    the tail. Reported per arm: best-of-cycles warm-after-append
+    seconds; plus the partial arm's structural proof — ZERO evictions
+    of pre-append blocks across the appends and ``partial_hits`` > 0.
+
+    ``devcache_partial_speedup`` = whole_run / partial (the bench.py
+    ``--compare`` headline; acceptance floor 2×). CPU-container
+    caveat: the "device" is host RAM, so re-upload savings understate
+    real HBM numbers — the ratio is the claim, not the absolute
+    seconds (same caveat as ``--device-cache``)."""
+    import shutil
+    import tempfile
+
+    import numpy as np
+
+    from netsdb_tpu.config import Configuration
+    from netsdb_tpu.relational import dag as rdag
+    from netsdb_tpu.relational.table import ColumnTable
+    from netsdb_tpu.serve.client import RemoteClient
+    from netsdb_tpu.serve.server import ServeController
+
+    rng = np.random.default_rng(0)
+    cols = {
+        "l_shipdate": rng.integers(19920101, 19981231, rows,
+                                   dtype=np.int32),
+        "l_returnflag": rng.integers(0, 3, rows, dtype=np.int32),
+        "l_linestatus": rng.integers(0, 2, rows, dtype=np.int32),
+        "l_quantity": rng.integers(1, 51, rows,
+                                   dtype=np.int32).astype(np.float32),
+        "l_extendedprice": rng.uniform(1000, 100000,
+                                       rows).astype(np.float32),
+        "l_discount": rng.uniform(0, 0.1, rows).astype(np.float32),
+        "l_tax": rng.uniform(0, 0.08, rows).astype(np.float32),
+    }
+    dicts = {"l_returnflag": ["A", "N", "R"],
+             "l_linestatus": ["F", "O"]}
+    n_extra = max(int(rows * append_frac), 1)
+    out: Dict[str, Any] = {"rows": rows, "pool_mb": pool_mb,
+                           "cache_mb": cache_mb,
+                           "append_rows": n_extra, "cycles": cycles}
+
+    def arm(partial: bool) -> Dict[str, Any]:
+        root = tempfile.mkdtemp(prefix="partial_bench_")
+        cfg = Configuration(root_dir=root,
+                            page_size_bytes=page_rows * 4,
+                            page_pool_bytes=pool_mb << 20,
+                            device_cache_bytes=cache_mb << 20,
+                            device_cache_partial=partial)
+        ctl = ServeController(cfg, port=0)
+        port = ctl.start()
+        try:
+            c = RemoteClient(f"127.0.0.1:{port}")
+            c.create_database("d")
+            c.create_set("d", "lineitem", type_name="table",
+                         storage="paged")
+            c.send_table("d", "lineitem", ColumnTable(cols, dicts))
+            sink = rdag.q01_sink("d")
+            cache = ctl.library.store.device_cache()
+
+            def run_once() -> float:
+                t0 = time.perf_counter()
+                c.execute_computations(sink, job_name="q01-partial",
+                                       fetch_results=False)
+                return time.perf_counter() - t0
+
+            run_once()                      # cold (compile + install)
+            warm_s = run_once()             # fully warm
+            blocks0 = cache.stats()["entries"]
+            ev0 = cache.stats()["evictions"]
+            times = []
+            for i in range(cycles):
+                extra = {k: v[:n_extra] for k, v in cols.items()}
+                c.send_table("d", "lineitem",
+                             ColumnTable(extra, dicts), append=True)
+                times.append(run_once())    # warm-after-append
+            st = cache.stats()
+            res = {"warm_s": round(warm_s, 4),
+                   "warm_after_append_s": round(min(times), 4),
+                   "warm_after_append_all": [round(t, 4)
+                                             for t in times],
+                   "blocks_before_appends": blocks0,
+                   "cache_stats": st}
+            if partial:
+                res["pre_append_evictions"] = st["evictions"] - ev0
+                res["partial_hits"] = st["partial_hits"]
+            c.close()
+            return res
+        finally:
+            ctl.shutdown()
+            shutil.rmtree(root, ignore_errors=True)
+
+    out["whole_run"] = arm(False)
+    out["partial"] = arm(True)
+    p, w = out["partial"], out["whole_run"]
+    if p["warm_after_append_s"] > 0:
+        out["devcache_partial_speedup"] = round(
+            w["warm_after_append_s"] / p["warm_after_append_s"], 2)
+    # the acceptance structure: appends evicted NOTHING and the warm
+    # re-queries stitched resident blocks
+    out["partial_zero_evictions"] = (p.get("pre_append_evictions") == 0)
+    out["partial_hits_positive"] = (p.get("partial_hits", 0) > 0)
+    return out
+
+
 # --- horizontal scale-out (--scale) ----------------------------------
 
 def scaleout_table(rows: int, seed: int = 0):
@@ -974,6 +1094,10 @@ def main(argv=None) -> int:
                     help="cold vs warm EXECUTE latency over a "
                          "device-cache-resident paged set, plus "
                          "hit/miss counters")
+    ap.add_argument("--partial-cache", action="store_true",
+                    help="paired A/B: warm re-query after a 1%% "
+                         "append, partial dirty-range invalidation "
+                         "vs whole-run invalidation")
     ap.add_argument("--scheduler", action="store_true",
                     help="paired A/B: N concurrent identical cold "
                          "EXECUTEs with the query scheduler on vs "
@@ -998,6 +1122,8 @@ def main(argv=None) -> int:
     elif args.scheduler:
         out = run_scheduler_bench(
             clients=args.clients if args.clients is not None else 8)
+    elif args.partial_cache:
+        out = run_partial_cache_bench()
     elif args.device_cache:
         out = run_device_cache_bench()
     elif args.data_plane:
