@@ -91,7 +91,9 @@ HEADLINE_METRICS = {"ff_inference_rows_per_sec_per_chip": "higher",
                     "serve_sched_p99_speedup": "higher",
                     "plan_fusion_speedup": "higher",
                     "serve_scaleout_throughput_x": "higher",
-                    "devcache_partial_speedup": "higher"}
+                    "devcache_partial_speedup": "higher",
+                    "summa_staging_reduction_x": "higher",
+                    "reshard_collective_speedup": "higher"}
 REGRESSION_PCT = 15.0
 
 
@@ -150,6 +152,18 @@ def main():
             json.dump({"cpu_ff_rows_per_sec": rps}, f)
         print(json.dumps({"metric": "cpu_ff_rows_per_sec", "value": rps}))
         return
+
+    if "--summa" in sys.argv:
+        # the SUMMA A/B needs a mesh: on a single-accelerator (or
+        # CPU-only) box, force the virtual host-platform mesh BEFORE
+        # jax initializes its backends (jax reads XLA_FLAGS at backend
+        # init, not import — the `import jax` below is the first use)
+        _flags = os.environ.get("XLA_FLAGS", "")
+        if "xla_force_host_platform_device_count" not in _flags:
+            os.environ.setdefault("JAX_PLATFORMS", "cpu")
+            os.environ["XLA_FLAGS"] = (
+                _flags + " --xla_force_host_platform_device_count=4"
+            ).strip()
 
     compare_path = None
     if "--compare" in sys.argv:
@@ -351,6 +365,54 @@ def main():
         else:
             print(f"-- partial-cache A/B unusable; metric omitted: "
                   f"{json.dumps(pc)}", file=sys.stderr)
+    if "--summa" in sys.argv:
+        # distributed linear algebra (micro_bench --summa): SUMMA
+        # panel staging vs replicated operands on the virtual mesh
+        # (the per-host staged-byte reduction is the headline — it is
+        # exact on any container; wall times on a CPU container
+        # measure core contention, not a pod) plus reshard-via-
+        # collectives vs re-stage-from-arena. Records are gated on
+        # the structural proofs: byte-equality between arms and zero
+        # arena reads during the reshard — a fast-but-wrong arm must
+        # not snapshot.
+        from netsdb_tpu.workloads.micro_bench import bench_summa
+
+        sm = bench_summa()
+        if sm.get("summa_staging_reduction_x") and sm.get("byte_equal"):
+            records.append({
+                "metric": "summa_staging_reduction_x",
+                "value": sm["summa_staging_reduction_x"],
+                "unit": "x (per-host staged bytes, replicated "
+                        "operands vs SUMMA panels, N=%s)"
+                        % sm.get("participants"),
+                "detail": {
+                    "per_host_staged_frac":
+                        sm.get("per_host_staged_frac"),
+                    "summa_s": sm.get("summa_s"),
+                    "replicated_s": sm.get("replicated_s"),
+                },
+            })
+        else:
+            print(f"-- summa arm unusable; metric omitted: "
+                  f"{json.dumps(sm, default=str)}", file=sys.stderr)
+        if sm.get("reshard_collective_speedup") \
+                and sm.get("reshard_zero_arena_reads"):
+            records.append({
+                "metric": "reshard_collective_speedup",
+                "value": sm["reshard_collective_speedup"],
+                "unit": "x (layout change + warm re-query: collective "
+                        "steps vs re-stage from arena; CPU container "
+                        "understates — the 'device' is host RAM)",
+                "detail": {
+                    "blocks_moved": sm.get("reshard_blocks_moved"),
+                    "steps": sm.get("reshard_steps"),
+                    "reshard_s": sm.get("reshard_s"),
+                    "restage_s": sm.get("restage_s"),
+                },
+            })
+        else:
+            print(f"-- reshard arm unusable (zero-arena proof "
+                  f"failed?); metric omitted", file=sys.stderr)
     # one JSON line: a single record stays the historical shape; with
     # --sched the line is a list (compare_runs accepts both)
     print(json.dumps(records if len(records) > 1 else result))

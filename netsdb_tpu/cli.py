@@ -600,6 +600,21 @@ def _cmd_attention_bench(args) -> int:
 
 
 def _cmd_micro_bench(args) -> int:
+    if getattr(args, "summa", False):
+        # the SUMMA A/B needs a mesh: on a single-accelerator (or
+        # CPU-only) box, force the virtual host-platform mesh BEFORE
+        # jax initializes its backends — the same fixture tier-1 uses
+        import os as _os
+
+        # jax reads XLA_FLAGS at BACKEND initialization (the first
+        # devices()/computation), not at import — setting it here is
+        # early enough as long as nothing above dispatched to a device
+        _flags = _os.environ.get("XLA_FLAGS", "")
+        if "xla_force_host_platform_device_count" not in _flags:
+            _os.environ.setdefault("JAX_PLATFORMS", "cpu")
+            _os.environ["XLA_FLAGS"] = (
+                _flags + " --xla_force_host_platform_device_count=4"
+            ).strip()
     from netsdb_tpu.workloads import micro_bench
 
     if getattr(args, "staging", False):
@@ -631,6 +646,12 @@ def _cmd_micro_bench(args) -> int:
         import json
 
         print(json.dumps(micro_bench.bench_fusion(), indent=2))
+        return 0
+    if getattr(args, "summa", False):
+        import json
+
+        print(json.dumps(micro_bench.bench_summa(), indent=2,
+                         default=str))
         return 0
     names = None
     if args.only is not None:
@@ -1172,6 +1193,13 @@ def main(argv=None) -> int:
                         "(plan_fusion on vs off on the staged fold "
                         "stream + a resident-spine mixed plan; "
                         "reports plan_fusion_speedup + trace counts)")
+    p.add_argument("--summa", action="store_true",
+                   help="distributed linear algebra paired A/B: SUMMA "
+                        "panel staging vs replicated operands on the "
+                        "virtual mesh (per-host staged bytes ~1/N, "
+                        "byte-equality gated) + reshard-via-"
+                        "collectives vs re-stage-from-arena (zero "
+                        "arena reads proof)")
 
     sub.add_parser("selftest",
                    help="scripted integration sequence (integratedTests.py)")

@@ -121,6 +121,25 @@ class Configuration:
     # pathological writer degrades to today's invalidate-everything,
     # never to unbounded memory).
     device_cache_dirty_log: int = 64
+    # --- distributed linear algebra (parallel/summa.py + reshard.py) ---
+    # route streamed matmuls over paged operands through the
+    # SUMMA-style distributed engine when >1 device is visible: each
+    # mesh participant stages ONLY its own panel of the operands
+    # (1/N of the bytes per host) and one compiled round program
+    # broadcasts B panels per step over the mesh axis, accumulating
+    # C tiles in place (arxiv 2112.09017). Off (default) keeps the
+    # single-device block stream byte-for-byte.
+    distributed_matmul: bool = False
+    # participants for the SUMMA mesh: None = every visible device;
+    # N caps it at the first N devices (the tier-1 virtual mesh tests
+    # pin 4 of the suite's 8 host-platform devices)
+    summa_participants: Optional[int] = None
+    # derive the hot-prefix pin budget AUTOMATICALLY from the
+    # attribution ledger's hot-set table on the scheduler-feedback
+    # cadence (serve/sched/feedback.pin_budget — pinned formula),
+    # when device_cache_pin_bytes is unset (0). The devcache stats
+    # section annotates the active budget with "pin_auto": true.
+    device_cache_pin_auto: bool = False
     # donate fold-step accumulators to XLA (donate_argnums on arg 0) so
     # per-block state updates reuse the same HBM buffer. None = auto:
     # on for backends that implement donation (TPU/GPU), off for CPU.

@@ -131,6 +131,27 @@ class NativePageStore:
         finally:
             self._lib.ps_unpin(self._h, page_id, 0)
 
+    def overwrite_page(self, page_id: int,
+                       payload: bytes | np.ndarray) -> None:
+        """Replace one page's bytes IN PLACE (same size): pin, copy,
+        unpin dirty — the update-a-column-in-its-page path."""
+        buf = np.frombuffer(payload if isinstance(payload, bytes)
+                            else np.ascontiguousarray(payload).tobytes(),
+                            dtype=np.uint8)
+        size = ctypes.c_uint64()
+        ptr = self._lib.ps_pin(self._h, page_id, ctypes.byref(size))
+        if not ptr:
+            raise KeyError(f"unknown or unloadable page {page_id}")
+        try:
+            if size.value != buf.nbytes:
+                raise ValueError(
+                    f"overwrite_page: size change {size.value} -> "
+                    f"{buf.nbytes} not allowed")
+            view = np.ctypeslib.as_array(ptr, shape=(buf.nbytes,))
+            view[:] = buf
+        finally:
+            self._lib.ps_unpin(self._h, page_id, 1)
+
     def free_page(self, page_id: int) -> None:
         rc = self._lib.ps_free_page(self._h, page_id)
         if rc != 0:

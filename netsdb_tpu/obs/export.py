@@ -124,6 +124,24 @@ def _catalog() -> Dict[str, Tuple[str, str]]:
                                          "dirty-RANGE invalidation "
                                          "(intersecting a written row "
                                          "range)"),
+        ("summa.rounds", "SUMMA round programs dispatched over the "
+                         "mesh (one per N-block batch)"),
+        ("summa.panel_bcasts", "B panels broadcast over the mesh axis "
+                               "by SUMMA steps"),
+        ("summa.panel_bytes", "bytes moved by SUMMA panel broadcasts "
+                              "(interconnect, not host transfers)"),
+        ("summa.staged_bytes", "operand bytes staged host->device by "
+                               "SUMMA runs (sum over participants; "
+                               "~1/N of operand bytes per host)"),
+        ("reshard.plans", "collective-step reshard schedules planned"),
+        ("reshard.steps", "collective steps executed by reshards "
+                          "(all_gather / all_to_all / local_slice / "
+                          "replace)"),
+        ("reshard.blocks_moved", "device-resident blocks moved between "
+                                 "layouts device-to-device (zero arena "
+                                 "reads)"),
+        ("reshard.bytes_moved", "bytes moved between layouts without a "
+                                "host round-trip"),
         ("staging.chunks", "chunks staged host->device"),
         ("staging.bytes", "bytes staged host->device (accounted "
                           "streams)"),

@@ -37,7 +37,8 @@ def _contract(ad, bd, a_pad_k, b_pad_k, k, compute_dtype, accum_dtype=None):
 
 def matmul(a: BlockedTensor, b: BlockedTensor,
            compute_dtype: Optional[str] = None,
-           accum_dtype: Optional[str] = None) -> BlockedTensor:
+           accum_dtype: Optional[str] = None,
+           distributed: Optional[bool] = None) -> BlockedTensor:
     """C = A·B (reference ``FFInputLayerJoin`` + ``FFAggMatrix``).
 
     ``accum_dtype`` sets the output dtype (default f32). Passing
@@ -45,10 +46,43 @@ def matmul(a: BlockedTensor, b: BlockedTensor,
     this is the difference between ~73% and ~94% MXU utilization for
     inference chains, at the precision the caller already opted into
     via ``compute_dtype``.
+
+    ``distributed`` routes the contraction through the SUMMA panel
+    engine (``parallel/summa.py``: A rows mesh-sharded, B contraction
+    panels broadcast per step, C tiles accumulated in place) — None
+    reads the ``config.distributed_matmul`` knob; the route engages
+    only when >1 device is visible. Single-device behavior is
+    byte-for-byte the one ``dot_general`` below.
     """
     (m, ka), (kb, n) = a.shape, b.shape
     if ka != kb:
         raise ValueError(f"matmul contraction mismatch {a.shape} x {b.shape}")
+    from netsdb_tpu.config import DEFAULT_CONFIG
+
+    if distributed is None:
+        distributed = getattr(DEFAULT_CONFIG, "distributed_matmul",
+                              False)
+    # the SUMMA engine accumulates f32 (the default contract); a
+    # caller opting into reduced-precision compute or a non-f32
+    # accumulator keeps the single-device path that honors both
+    if distributed and compute_dtype is None and accum_dtype is None:
+        import jax
+
+        cap = getattr(DEFAULT_CONFIG, "summa_participants", None)
+        devices = jax.devices()[:int(cap)] if cap else jax.devices()
+        if len(devices) >= 2:
+            from netsdb_tpu.parallel import summa
+
+            out = summa.summa_matmul_resident(a.data[:m, :ka],
+                                              b.data[:kb, :n],
+                                              devices=devices)
+            meta = BlockMeta((m, n), (a.meta.block_shape[0],
+                                      b.meta.block_shape[1]))
+            pad = [(0, p - s) for s, p in zip((m, n),
+                                              meta.padded_shape)]
+            if any(p for _, p in pad):
+                out = jnp.pad(out, pad)
+            return BlockedTensor(out, meta)
     out = _contract(a.data, b.data, a.meta.padded_shape[1],
                     b.meta.padded_shape[0], ka, compute_dtype, accum_dtype)
     meta = BlockMeta((m, n), (a.meta.block_shape[0], b.meta.block_shape[1]))

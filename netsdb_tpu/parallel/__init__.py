@@ -16,11 +16,21 @@ from netsdb_tpu.parallel.mesh import (
     shard_blocked,
 )
 from netsdb_tpu.parallel.pipeline import pipeline_apply
+from netsdb_tpu.parallel.reshard import (
+    plan_steps,
+    reshard_set,
+)
 from netsdb_tpu.parallel.ring import ring_attention, ulysses_attention
+from netsdb_tpu.parallel.summa import (
+    summa_matmul_resident,
+    summa_matmul_streamed,
+)
 
 __all__ = [
     "default_mesh", "make_mesh", "shard_blocked", "replicate",
     "matmul_psum", "matmul_psum_scatter", "matmul_allgather",
     "all_to_all_resharding", "ring_attention", "ulysses_attention",
     "initialize_cluster", "hybrid_mesh", "cluster_info", "pipeline_apply",
+    "summa_matmul_streamed", "summa_matmul_resident", "plan_steps",
+    "reshard_set",
 ]

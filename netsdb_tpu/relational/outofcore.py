@@ -215,6 +215,59 @@ class PagedColumns:
             self.devcache.invalidate_range(self.cache_scope, n_before,
                                            self.num_rows)
 
+    def update_column(self, name: str, values) -> None:
+        """Overwrite ONE column's values in place (same row count) —
+        the update-in-place write. Each page the column lives in is
+        rewritten where it sits (``PagedTensorStore.rewrite_block``,
+        same shape — no layout change, no page movement), and the
+        device cache drops only block entries whose stream PROJECTED
+        this column (per-column dirty ranges): a query over the other
+        columns keeps serving its cached blocks with zero re-stages.
+        Column-projected streams key their blocks by their projection
+        (``_partial_plan(columns=...)``); full-table streams carry no
+        projection marker and always drop — they contain this column."""
+        values = np.asarray(values)
+        if name in self.dicts:
+            raise ValueError(f"update_column: {name!r} is dict-encoded"
+                             f" — update through re-ingest (codes would"
+                             f" be meaningless)")
+        if name in self.int_names:
+            if values.dtype.kind not in _INT_KINDS:
+                raise TypeError(
+                    f"update_column {name!r}: stored column is "
+                    f"int-classified; casting floats would truncate")
+            suffix, names = ".int", self.int_names
+        elif name in self.float_names:
+            suffix, names = ".float", self.float_names
+        else:
+            raise KeyError(f"no column {name!r} in {self.name!r}")
+        if len(values) != self.num_rows:
+            raise ValueError(
+                f"update_column {name!r}: {len(values)} values for "
+                f"{self.num_rows} rows (in-place updates replace the "
+                f"whole column)")
+        full = self.name + suffix
+        j = names.index(name)
+        with self.rw.write():  # drain in-flight streams first
+            if self.dropped:
+                raise KeyError(f"paged relation {self.name!r} was "
+                               f"dropped; cannot update")
+            for idx, (s0, e0) in enumerate(self.store.block_ranges(full)):
+                _start, blk = self.store.read_block(full, idx)
+                arr = np.array(blk)  # read_block views are read-only
+                arr[:, j] = values[s0:e0]
+                self.store.rewrite_block(full, idx, arr)
+            if name in self.int_names:
+                from netsdb_tpu.relational.stats import analyze_array
+
+                self.stats[name] = analyze_array(values.astype(np.int32))
+            self._mutations += 1  # whole-run keys of old content die
+        if (self.devcache is not None and self.cache_scope is not None
+                and getattr(self.devcache, "partial", False)):
+            self.devcache.invalidate_range(self.cache_scope, 0,
+                                           self.num_rows,
+                                           columns=(name,))
+
     # ------------------------------------------------------------ stream
     def pad_rows(self) -> int:
         """Row count every streamed chunk pads to: ``row_block``'s
@@ -263,31 +316,42 @@ class PagedColumns:
             name=f"cols:{self.name}")
 
     def _host_stream(self, prefetch: Optional[int] = None,
-                     blocks: Optional[List[int]] = None
+                     blocks: Optional[List[int]] = None,
+                     columns: Optional[List[str]] = None
                      ) -> Iterator[Tuple[Dict[str, np.ndarray],
                                          np.ndarray, int]]:
         """Locked host-side chunk generator (numpy columns). Runs —
         lock acquisition included — on whichever thread iterates it:
         the consumer directly (``device=False``) or the staging thread
         (``device=True``). ``blocks`` restricts to those page indices
-        (the stitched gap feed — cached pages never touch the arena)."""
+        (the stitched gap feed — cached pages never touch the arena);
+        ``columns`` projects: a matrix none of whose columns are
+        requested is never read at all."""
         with self.rw.read():
             if self.dropped:
                 raise KeyError(f"paged relation {self.name!r} was "
                                f"dropped; cannot stream")
-            yield from self._stream_unlocked(prefetch, blocks)
+            yield from self._stream_unlocked(prefetch, blocks, columns)
 
     def _stream_unlocked(self, prefetch: Optional[int] = None,
-                         blocks: Optional[List[int]] = None
+                         blocks: Optional[List[int]] = None,
+                         columns: Optional[List[str]] = None
                          ) -> Iterator[Tuple[Dict[str, np.ndarray],
                                              np.ndarray, int]]:
+        if columns is not None:
+            missing = set(columns) - (set(self.int_names)
+                                      | set(self.float_names))
+            if missing:
+                raise KeyError(f"no columns {sorted(missing)} in "
+                               f"{self.name!r}")
+        want = (lambda n: columns is None or n in columns)
         streams = []
-        if self.int_names:
+        if self.int_names and any(want(n) for n in self.int_names):
             streams.append((self.int_names,
                             self.store.stream_blocks(f"{self.name}.int",
                                                      prefetch,
                                                      blocks=blocks)))
-        if self.float_names:
+        if self.float_names and any(want(n) for n in self.float_names):
             streams.append((self.float_names,
                             self.store.stream_blocks(
                                 f"{self.name}.float", prefetch,
@@ -310,7 +374,8 @@ class PagedColumns:
                         "int/float page streams desynchronized "
                         f"({s0},{block.shape[0]}) vs ({start},{n})")
                 for j, name in enumerate(names):
-                    chunk[name] = block[:, j]
+                    if want(name):
+                        chunk[name] = block[:, j]
             if exhausted:
                 # both streams must end on the same round — one ending
                 # early would otherwise silently truncate the other's
@@ -334,13 +399,14 @@ class PagedColumns:
         suffix = ".int" if self.int_names else ".float"
         return self.store.num_blocks(self.name + suffix)
 
-    def _cache_ref(self, kind: str, placement):
+    def _cache_ref(self, kind: str, placement, columns=None):
         """(cache, key) when this relation is store-owned and the
         device cache is on, else (None, None). The key is the
         tentpole's ``(db:set, version, bucket, sharding)`` — plus this
-        handle's own mutation counter and the stream kind — so a warm
-        stream of the SAME content/shape/sharding replays device-
-        resident blocks and any write anywhere unkeys every old run."""
+        handle's own mutation counter, the stream kind and any column
+        PROJECTION — so a warm stream of the SAME content/shape/
+        sharding replays device-resident blocks and any write anywhere
+        unkeys every old run."""
         cache = self.devcache
         if (cache is None or not cache.enabled
                 or self.cache_scope is None or self.dropped):
@@ -350,16 +416,32 @@ class PagedColumns:
         key = (self.cache_scope, ver, self._mutations, kind,
                self.pad_rows(),
                placement.label() if placement is not None else None)
+        if columns is not None:
+            key = key + (("cols",) + tuple(sorted(columns)),)
         return cache, key
 
-    def _partial_plan(self, kind: str, placement, prefetch):
+    def partial_base_key(self, kind: str, placement, columns=None):
+        """The block-entry base key for one stream shape of this
+        relation: ``(scope, kind, bucket, sharding)`` — NO write
+        version and NO mutation counter (block freshness is
+        dirty-range invalidation's job) — plus, for column-PROJECTED
+        streams, a trailing ``frozenset`` of the projected columns:
+        the marker per-column invalidation matches against (an entry
+        whose projection is disjoint from an updated column survives;
+        unmarked entries contain every column and always drop). Also
+        the key ``parallel/reshard.reshard_set`` moves entries
+        between: same shape, different sharding label."""
+        base = (self.cache_scope, kind, self.pad_rows(),
+                placement.label() if placement is not None else None)
+        if columns is not None:
+            base = base + (frozenset(columns),)
+        return base
+
+    def _partial_plan(self, kind: str, placement, prefetch,
+                      columns=None):
         """A :class:`~netsdb_tpu.plan.staging.PartialPlan` for one
         stream of this relation under the block-granular cache, or
-        None (cache off / whole-run mode / unbound temporary). The
-        base key is the tentpole's ``(scope, kind, bucket, sharding)``
-        — NO write version and NO mutation counter: block freshness is
-        dirty-range invalidation's job, which is exactly what lets a
-        tail append keep every pre-append block matchable."""
+        None (cache off / whole-run mode / unbound temporary)."""
         from netsdb_tpu.plan.staging import PartialPlan
 
         cache = self.devcache
@@ -367,14 +449,14 @@ class PagedColumns:
                 or not getattr(cache, "partial", False)
                 or self.cache_scope is None or self.dropped):
             return None
-        base_key = (self.cache_scope, kind, self.pad_rows(),
-                    placement.label() if placement is not None else None)
+        base_key = self.partial_base_key(kind, placement, columns)
         ranges = self.block_ranges()
         if not ranges:
             return None
         return PartialPlan(
             cache, base_key, ranges,
-            lambda idxs: self._host_stream(prefetch, blocks=idxs))
+            lambda idxs: self._host_stream(prefetch, blocks=idxs,
+                                           columns=columns))
 
     def block_ranges(self) -> List[Tuple[int, int]]:
         """The relation's [(start_row, end_row)] block layout —
@@ -396,7 +478,8 @@ class PagedColumns:
             self.devcache.invalidate(self.cache_scope)
 
     def stream_tables(self, prefetch: Optional[int] = None,
-                      placement=None):
+                      placement=None,
+                      columns: Optional[List[str]] = None):
         """The PageScanner feed for the set/DAG API: a
         :class:`~netsdb_tpu.plan.staging.StagedStream` of chunk
         ColumnTables (validity-masked, plus a ``_rowid`` global-row-
@@ -428,12 +511,20 @@ class PagedColumns:
         through the normal pipeline, and every placed gap block
         installs as it goes (early exit keeps the consumed prefix).
         Cached chunks are owned by the cache, never donation targets
-        (fold steps donate only their carried accumulator)."""
+        (fold steps donate only their carried accumulator).
+
+        ``columns`` projects the stream to just those columns: a
+        packed matrix none of whose columns are requested is never
+        read from the arena, and the cached blocks key on the
+        projection — a per-column dirty range from ``update_column``
+        drops only the streams that contained the touched column."""
         from netsdb_tpu.plan.staging import stage_stream
 
-        cache, cache_key = self._cache_ref("tables", placement)
+        cache, cache_key = self._cache_ref("tables", placement, columns)
         base_rowid = np.arange(self.pad_rows(), dtype=np.int32)
         dicts = self.dicts
+        if columns is not None:
+            dicts = {k: v for k, v in dicts.items() if k in columns}
 
         def place(item):
             cols, valid, start = item
@@ -452,7 +543,8 @@ class PagedColumns:
             return ColumnTable({k: jnp.asarray(v) for k, v in cols.items()},
                                dicts, jnp.asarray(valid))
 
-        partial = self._partial_plan("tables", placement, prefetch)
+        partial = self._partial_plan("tables", placement, prefetch,
+                                     columns)
         if partial is not None:
             return stage_stream(
                 None, place,
@@ -460,14 +552,14 @@ class PagedColumns:
                 name=f"tables:{self.name}", partial=partial,
                 scope=str(self.cache_scope))
         return stage_stream(
-            self._host_stream(prefetch), place,
+            self._host_stream(prefetch, columns=columns), place,
             depth=getattr(self.store.config, "stage_depth", 2),
             name=f"tables:{self.name}",
             cache=cache, cache_key=cache_key,
             cache_validator=(
                 None if cache is None else
-                lambda: self._cache_ref("tables", placement)[1]
-                == cache_key))
+                lambda: self._cache_ref("tables", placement,
+                                        columns)[1] == cache_key))
 
     def stream_host_tables(self, prefetch: Optional[int] = None
                            ) -> Iterator[ColumnTable]:

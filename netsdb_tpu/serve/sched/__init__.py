@@ -83,7 +83,7 @@ class QueryScheduler:
                  coalesce_done_max: int = 32,
                  cache_probe=None,
                  feedback: bool = False, feedback_every: int = 64,
-                 slo_source=None):
+                 slo_source=None, pin_auto=None):
         from netsdb_tpu.utils.locks import TrackedLock
 
         self.lanes = LaneScheduler(slots, lanes=lanes, quota=quota,
@@ -100,6 +100,11 @@ class QueryScheduler:
         # cadence and background thread.
         self.shed_enabled = slo_source is not None
         self._slo_source = slo_source
+        # pin-budget auto-sizing (config.device_cache_pin_auto): a
+        # no-arg callable re-deriving the devcache hot-prefix pin
+        # budget from the attribution ledger's hot-set table
+        # (feedback.pin_budget), run on the same cadence/thread
+        self._pin_auto_cb = pin_auto
         self._feedback_every = max(int(feedback_every or 0), 1)
         self._base_quota = max(int(quota or 0), 0)
         self._fb_mu = TrackedLock("sched.QueryScheduler._fb_mu")
@@ -118,7 +123,8 @@ class QueryScheduler:
     # --- lanes --------------------------------------------------------
     def acquire(self, lane: Optional[str],
                 timeout_s: float) -> AdmissionTicket:
-        if self.feedback_enabled or self.shed_enabled:
+        if self.feedback_enabled or self.shed_enabled \
+                or self._pin_auto_cb is not None:
             self._maybe_feedback()
         return self.lanes.acquire(lane, timeout_s)
 
@@ -145,6 +151,12 @@ class QueryScheduler:
                 self.refresh_feedback()
             if self.shed_enabled:
                 self.refresh_shed()
+            if self._pin_auto_cb is not None:
+                try:
+                    self._pin_auto_cb()
+                except Exception as e:  # noqa: BLE001 — a broken pin
+                    del e               # probe must never wedge
+                    pass                # admission; skip the pass
         finally:
             with self._fb_mu:
                 self._fb_running = False

@@ -52,6 +52,43 @@ DEFAULT_SEC_PER_CHUNK = 1e-3
 #: pinned test contract, like the weight formula above.
 SHED_FACTOR = 0.5
 SHED_MIN_QUOTA = 1
+#: pin-budget auto-sizing (``config.device_cache_pin_auto``): the
+#: hottest scope's attributed staged bytes become the hot-prefix pin
+#: budget ONLY when that scope carries at least PIN_HOT_SHARE of all
+#: attributed staged bytes, and never more than PIN_FRACTION of the
+#: device-cache budget. Both constants are pinned test contract.
+PIN_HOT_SHARE = 0.25
+PIN_FRACTION = 0.5
+
+
+def pin_budget(attrib_snapshot: Dict[str, Dict[str, Dict[str, float]]],
+               cache_budget: int) -> int:
+    """The auto-derived ``device_cache_pin_bytes`` (pinned formula).
+
+    The attribution ledger's hot-set table — per-scope staged bytes
+    summed over every client (``anon`` included, the ``overflow``
+    fold-in bucket and the scope-free ``*`` row skipped) — names the
+    HOTTEST scope. Its observed staged bytes (a ceiling on the bytes
+    worth pinning: re-stages only inflate it, and the cap bounds the
+    damage) become the pin budget when the scope carries at least
+    ``PIN_HOT_SHARE`` of all attributed staged bytes; otherwise 0 —
+    no set is hot enough to deserve eviction immunity."""
+    by_scope: Dict[str, float] = {}
+    for client, scopes in (attrib_snapshot or {}).items():
+        if client == "overflow":
+            continue
+        for scope, metrics in scopes.items():
+            if scope == "*":
+                continue
+            by_scope[scope] = by_scope.get(scope, 0.0) + float(
+                metrics.get("staged_bytes") or 0.0)
+    total = sum(by_scope.values())
+    if total <= 0:
+        return 0
+    hot_bytes = max(by_scope.values())
+    if hot_bytes / total < PIN_HOT_SHARE:
+        return 0
+    return int(min(hot_bytes, PIN_FRACTION * max(int(cache_budget), 0)))
 
 
 def sec_per_chunk(op_snapshot: Dict[str, Dict[str, Dict[str, float]]]

@@ -761,7 +761,17 @@ class ServeController:
             # multi-window agreement), restoring on recovery
             slo_source=(self.slo.breached_objectives
                         if getattr(config, "sched_slo_shed", False)
-                        else None))
+                        else None),
+            # pin-budget auto-sizing: when the static knob is unset,
+            # the feedback cadence re-derives the devcache hot-prefix
+            # pin budget from the attribution ledger's hot-set table
+            # (serve/sched/feedback.pin_budget — pinned formula)
+            pin_auto=(self._refresh_pin_auto
+                      if (getattr(config, "device_cache_pin_auto",
+                                  False)
+                          and not getattr(config,
+                                          "device_cache_pin_bytes", 0))
+                      else None))
         self._job_seq = itertools.count(1)
         self._jobs: Dict[int, Dict[str, Any]] = {}
         self._jobs_lock = TrackedLock("ServeController._jobs_lock")
@@ -1244,6 +1254,22 @@ class ServeController:
         if storage != "paged":
             return True
         return int(covered) if covered > 0 else False
+
+    def _refresh_pin_auto(self) -> None:
+        """One pin-budget auto-sizing pass (config.device_cache_pin_
+        auto, run on the scheduler-feedback cadence): the attribution
+        ledger's hot-set table → ``feedback.pin_budget`` (pinned
+        formula) → the devcache pin budget, annotated ``pin_auto`` in
+        its stats section."""
+        from netsdb_tpu.serve.sched import feedback as _feedback
+
+        cache = self.library.store.device_cache()
+        if not (cache.enabled and getattr(cache, "partial", False)):
+            return
+        cache.set_pin_budget(
+            _feedback.pin_budget(obs.attrib.LEDGER.snapshot(),
+                                 cache.budget_bytes),
+            auto=True)
 
     def _execute_frame(self, typ, payload, codec_in, token, qid=None,
                        client=None, lane=None):

@@ -187,6 +187,10 @@ class DeviceBlockCache:
         # base_key -> total rows of the set as of the last plan (the
         # coverage probe's denominator)
         self._totals: Dict[Tuple, int] = {}
+        # True when the pin budget is being driven by the feedback
+        # loop (config.device_cache_pin_auto) rather than the static
+        # knob — annotated in stats() so operators can tell which
+        self._pin_auto = False
 
     # --- sizing -------------------------------------------------------
     @property
@@ -211,6 +215,28 @@ class DeviceBlockCache:
                 if "pinned_bytes" in self._stats:
                     self._stats["pinned_bytes"] = 0
             self._evict_to_fit_locked(0)
+
+    def set_pin_budget(self, pin_bytes: int, auto: bool = False) -> None:
+        """Re-point the hot-prefix pin budget (partial mode only) —
+        the ``device_cache_pin_auto`` feedback hook and the serve knob
+        path. Shrinking below the currently pinned total lifts every
+        pin (head blocks re-pin as streams reinstall them — the
+        conservative reset; LRU then treats them like any entry).
+        ``auto`` annotates :meth:`stats` with who is driving the
+        budget."""
+        with self._mu:
+            if not self.partial:
+                return
+            self._pin_budget = max(int(pin_bytes or 0), 0)
+            self._pin_auto = bool(auto)
+            if self._pinned_bytes > self._pin_budget:
+                self._pinned.clear()
+                self._pinned_bytes = 0
+                self._pin_hw.clear()
+            if "pinned_bytes" in self._stats:
+                self._stats["pinned_bytes"] = self._pinned_bytes
+        obs.REGISTRY.gauge("devcache.pinned_bytes").set(
+            self._pinned_bytes)
 
     # --- the data path ------------------------------------------------
     def get(self, key: Tuple) -> Optional[List[Any]]:
@@ -511,14 +537,24 @@ class DeviceBlockCache:
         return best
 
     def invalidate_range(self, scope: str, start: int,
-                         end: Optional[int] = None) -> int:
+                         end: Optional[int] = None,
+                         columns=None) -> int:
         """Drop only the entries a dirty row range intersects: block
         entries overlapping ``[start, end)`` (end=None → to infinity)
         plus every whole-run entry of the scope (version-keyed, so
         already unmatchable — dropping returns their bytes now). Bumps
         the scope's epoch either way, refusing in-flight installs
-        planned before the write. Returns entries dropped."""
+        planned before the write. Returns entries dropped.
+
+        ``columns`` names the touched columns of an update-in-place
+        write (the per-COLUMN dirty range): a block entry whose base
+        key carries a column-projection marker (a ``frozenset`` —
+        ``PagedColumns.partial_base_key(columns=...)``) DISJOINT from
+        the touched set survives — its stream never contained the
+        updated column, so its blocks are still byte-fresh. Unmarked
+        entries contain every column and always drop."""
         scope = str(scope)
+        columns = frozenset(columns) if columns is not None else None
         dropped = dirty = 0
         with self._mu:
             self._epochs[scope] = self._epochs.get(scope, 0) + 1
@@ -538,6 +574,11 @@ class DeviceBlockCache:
                     s0, e0 = rng
                     if e0 <= start or (end is not None and s0 >= end):
                         continue  # disjoint: the block stays resident
+                    if (columns is not None
+                            and isinstance(key[-2], frozenset)
+                            and key[-2].isdisjoint(columns)):
+                        continue  # projected stream never held the
+                        # updated column — still byte-fresh
                     dirty += 1
                 if self._drop_entry_locked(key):
                     dropped += 1
@@ -634,4 +675,9 @@ class DeviceBlockCache:
             out["bytes"] = self._bytes
             out["entries"] = len(self._entries)
             out["budget_bytes"] = self._budget
+            if self.partial:
+                # who drives the hot-prefix pin budget: the static
+                # knob or the feedback loop (device_cache_pin_auto)
+                out["pin_budget_bytes"] = self._pin_budget
+                out["pin_auto"] = self._pin_auto
             return out

@@ -66,6 +66,22 @@ class _PyPageBackend:
                 if page_id in pages:
                     pages.remove(page_id)
 
+    def overwrite_page(self, page_id, payload) -> None:
+        """Replace one page's bytes IN PLACE (same size — the
+        update-a-column-in-its-page path; a size change would shift
+        every derived block layout)."""
+        data = payload if isinstance(payload, bytes) else \
+            np.ascontiguousarray(payload).tobytes()
+        with self._mu:
+            old = self._pages.get(page_id)
+            if old is None:
+                raise KeyError(f"unknown page {page_id}")
+            if len(old) != len(data):
+                raise ValueError(
+                    f"overwrite_page: size change {len(old)} -> "
+                    f"{len(data)} not allowed")
+            self._pages[page_id] = data
+
     def set_pages(self, set_id):
         with self._mu:
             return list(self._sets[set_id])
@@ -431,6 +447,28 @@ class PagedTensorStore:
         return starts[index], np.frombuffer(raw, dtype=dtype).reshape(
             ns[index], cols)
 
+    def rewrite_block(self, name: str, index: int,
+                      block: np.ndarray) -> None:
+        """Overwrite one row-block IN PLACE (same shape — the
+        update-in-place write path: a column update rewrites each page
+        it lives in without moving any other page). The block layout
+        is unchanged by construction, so derived metadata stays
+        valid."""
+        sid = self._ids[name]
+        (_rows, cols), _, dtype = self._meta[sid]
+        pids = self.backend.set_pages(sid)
+        if not 0 <= index < len(pids):
+            raise IndexError(f"block {index} out of range "
+                             f"({len(pids)} blocks in {name!r})")
+        ns, _starts = self._block_layout(sid)
+        block = np.ascontiguousarray(block, dtype=dtype)
+        if block.shape != (ns[index], cols):
+            raise ValueError(
+                f"rewrite_block: block {index} of {name!r} is "
+                f"{(ns[index], cols)}, got {block.shape} — in-place "
+                f"rewrites must preserve the block's shape")
+        self.backend.overwrite_page(pids[index], block.tobytes())
+
     def num_blocks(self, name: str) -> int:
         return len(self.backend.set_pages(self._ids[name]))
 
@@ -560,7 +598,9 @@ class PagedTensorStore:
         return BlockedTensor(data, meta)
 
     def matmul_streamed(self, name: str, rhs: np.ndarray,
-                        stage_depth: Optional[int] = None) -> np.ndarray:
+                        stage_depth: Optional[int] = None,
+                        devcache=None,
+                        cache_scope: Optional[str] = None) -> np.ndarray:
         """out = M @ rhs with M streamed page-by-page through the device
         — the larger-than-HBM compute pattern (reference: pipelines over
         pinned pages). Only one page + rhs (plus the staged NEXT page)
@@ -571,7 +611,16 @@ class PagedTensorStore:
         back off — exact) so the whole stream runs ONE compiled
         program. ``stage_depth`` pins the staging depth (None = the
         ``config.stage_depth`` knob; 0 = the synchronous baseline the
-        staging bench measures against)."""
+        staging bench measures against).
+
+        With ``config.distributed_matmul`` on and >1 device visible,
+        the stream routes through the SUMMA engine instead
+        (``parallel/summa.py``): each mesh participant stages only its
+        own panel of M and rhs, per-step panel broadcasts move B over
+        the mesh axis, and per-host staged bytes drop to ~1/N.
+        ``devcache``/``cache_scope`` (store-owned sets pass them) opt
+        the SUMMA panels into the block-granular device cache under
+        the mesh-labelled key."""
         import contextlib
 
         import jax
@@ -579,6 +628,19 @@ class PagedTensorStore:
 
         from netsdb_tpu.plan.staging import pad_rows_target, stage_stream
         from netsdb_tpu.storage.devcache import to_device
+
+        if getattr(self.config, "distributed_matmul", False):
+            from netsdb_tpu.parallel import summa
+
+            devices = jax.devices()
+            cap = getattr(self.config, "summa_participants", None)
+            if cap:
+                devices = devices[:int(cap)]
+            if len(devices) >= 2:
+                return summa.summa_matmul_streamed(
+                    self, name, rhs, devices=devices,
+                    stage_depth=stage_depth, cache=devcache,
+                    cache_scope=cache_scope)
 
         depth = getattr(self.config, "stage_depth", 2) \
             if stage_depth is None else stage_depth

@@ -222,7 +222,8 @@ class SetStore:
             return self._device_cache
 
     def _touch(self, s: _StoredSet,
-               rows: Optional[Tuple[int, int]] = None) -> None:
+               rows: Optional[Tuple[int, int]] = None,
+               columns: Optional[Tuple[str, ...]] = None) -> None:
         """Advance a set's write version, log the dirty row range and
         drop the intersecting cached device blocks NOW. Called by EVERY
         path that can change the set's content — direct ingest,
@@ -243,6 +244,15 @@ class SetStore:
         bumps). A caller adding a NEW ``rows=...`` site that does not
         route through ``pc.append`` must invalidate the range itself.
 
+        ``columns=(name, ...)`` additionally names the touched COLUMNS
+        (an update-in-place write): the dirty log entry is keyed by
+        column — ``(start, end, cols)`` — and the per-range cache
+        invalidation (owned by ``PagedColumns.update_column``, same
+        contract as ``pc.append`` above) drops only block entries
+        whose stream PROJECTED one of those columns, so a
+        single-column update keeps every other column's cached blocks
+        resident.
+
         When the bounded log overflows it folds to one whole-scope
         entry AND the cache degrades to a whole-scope invalidation —
         a pathological writer gets today's invalidate-everything
@@ -253,9 +263,13 @@ class SetStore:
         folded = len(s.dirty_log) >= bound
         if folded:
             s.dirty_log[:] = [(0, None)]  # fold to whole-scope
+        elif rows is None:
+            s.dirty_log.append((0, None))
+        elif columns is not None:
+            s.dirty_log.append((int(rows[0]), int(rows[1]),
+                                tuple(sorted(columns))))
         else:
-            s.dirty_log.append((int(rows[0]), int(rows[1]))
-                               if rows is not None else (0, None))
+            s.dirty_log.append((int(rows[0]), int(rows[1])))
         if self._device_cache is not None:
             if rows is not None and self._device_cache.partial \
                     and not folded:
@@ -307,6 +321,25 @@ class SetStore:
     def placement_of(self, ident: SetIdentifier) -> Optional[Any]:
         s = self._sets.get(ident)
         return s.placement if s is not None else None
+
+    @_locked
+    def set_placement(self, ident: SetIdentifier, placement,
+                      items: Optional[List[Any]] = None) -> None:
+        """Swap a set's DECLARED placement without re-staging its data
+        — the commit step of ``parallel/reshard.reshard_set``, which
+        has already moved the device-resident blocks (or resident
+        ``items``, passed here) through collective steps. Content is
+        unchanged, so no write version moves and no dirty range is
+        logged: cached blocks installed under the NEW layout's key
+        stay matchable, which is the whole point. NOT the path for
+        re-placing from host — ``create_set(placement=...)`` keeps
+        that behavior (re-place + whole-scope invalidation)."""
+        s = self._require(ident)
+        s.placement = placement
+        if items is not None:
+            s.items = items
+            s.nbytes = sum(_item_nbytes(i) for i in items)
+        s.last_access = time.time()
 
     def storage_of(self, ident: SetIdentifier) -> str:
         s = self._sets.get(ident)
@@ -616,7 +649,13 @@ class SetStore:
             s.last_access = time.time()
             ps = self.page_store()
         with pm.rw.read():
-            return ps.matmul_streamed(f"{pm.ident}.mat", np.asarray(rhs))
+            # the devcache binding lets the SUMMA route (config.
+            # distributed_matmul) install its per-participant panels
+            # as block entries keyed by the mesh label — a warm
+            # distributed matmul re-run stages zero bytes
+            return ps.matmul_streamed(f"{pm.ident}.mat", np.asarray(rhs),
+                                      devcache=self.device_cache(),
+                                      cache_scope=str(ident))
 
     @_locked
     def paged_tensor(self, ident: SetIdentifier):
@@ -734,6 +773,47 @@ class SetStore:
             self._drop_detached(dead)
             return
         self._append_table_memory(ident, table)
+
+    def update_columns(self, ident: SetIdentifier,
+                       cols: Dict[str, Any]) -> None:
+        """Overwrite whole COLUMNS of a paged table set in place —
+        the update-in-place write path (netsDB's UpdateSet over one
+        attribute). Pages are rewritten where they live (same shape,
+        no layout change); the device cache drops ONLY block entries
+        whose stream projected a touched column (per-column dirty
+        ranges — an untouched column's cached blocks keep serving
+        with zero re-stages).
+
+        Lock discipline mirrors ``append_table``: the store lock only
+        locates and pins the relation; the page rewrites run outside
+        it under the set's ``append_mu`` (they wait on the relation's
+        own rw lock for in-flight streams)."""
+        from netsdb_tpu.relational.outofcore import PagedColumns
+
+        with self._lock:
+            s = self._require(ident)
+            if s.alias_of is not None:
+                raise ValueError(f"set {ident} aliases {s.alias_of}; "
+                                 f"it is read-only")
+            if s.storage != "paged":
+                raise ValueError(f"update_columns needs a paged table "
+                                 f"set; {ident} is {s.storage!r}")
+            pc = next((i for i in (s.items or [])
+                       if isinstance(i, PagedColumns)), None)
+            if pc is None:
+                raise ValueError(f"set {ident} holds no paged relation")
+        with s.append_mu:
+            with self._lock:
+                if self._sets.get(ident) is not s:
+                    raise KeyError(f"set {ident} was removed during "
+                                   f"update")
+            for name, values in cols.items():
+                # pc.update_column owns the per-range, per-column
+                # cache invalidation (the pc.append contract)
+                pc.update_column(name, values)
+            with self._lock:
+                self._touch(s, rows=(0, pc.num_rows),
+                            columns=tuple(sorted(cols)))
 
     @_locked
     def _append_table_memory(self, ident: SetIdentifier, table) -> None:

@@ -44,6 +44,24 @@ def client(config):
     return Client(config)
 
 
+@pytest.fixture()
+def mesh4():
+    """The tier-1 virtual 4-device mesh (marker ``mesh``): the first 4
+    of the suite's forced host-platform CPU devices under one 1-d
+    ``data`` axis — the same sharding/collective code paths a real TPU
+    mesh exercises (``XLA_FLAGS=--xla_force_host_platform_device_
+    count``), without touching the default mesh the rest of the suite
+    sees. Skips when the environment could not force >= 4 devices."""
+    import numpy as np
+    from jax.sharding import Mesh
+
+    devs = jax.devices()
+    if len(devs) < 4:
+        pytest.skip("needs >= 4 virtual devices "
+                    "(xla_force_host_platform_device_count)")
+    return Mesh(np.asarray(devs[:4]), ("data",))
+
+
 def pytest_configure(config):
     config.addinivalue_line(
         "markers", "slow: long-running integration tests (multi-process "
@@ -51,6 +69,11 @@ def pytest_configure(config):
     config.addinivalue_line(
         "markers", "chaos: seeded-deterministic fault-injection tests for "
         "the serve control plane (fast, CPU-only — these stay in tier-1)")
+    config.addinivalue_line(
+        "markers", "mesh: distributed linear-algebra tests that run on "
+        "the N=4 virtual host-platform device mesh (the `mesh4` "
+        "fixture — a sub-mesh of the suite's 8 forced CPU devices, so "
+        "the rest of the suite is unperturbed)")
     # lockdep-style runtime witness (utils/locks.py): record the
     # cross-thread lock acquisition-order graph for the WHOLE suite —
     # an AB/BA inversion that never actually interleaves still gets

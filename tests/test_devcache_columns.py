@@ -1,0 +1,233 @@
+"""Per-COLUMN dirty ranges + pin-budget auto-sizing (ISSUE 15
+satellites, PR 14 follow-ons).
+
+* ``PagedColumns.update_column`` / ``SetStore.update_columns`` —
+  update-in-place writes rewrite a column's pages where they sit and
+  dirty ONLY that column: cached blocks of streams that projected the
+  column away keep serving with zero re-stages (the regression shape
+  from the issue: update one column of a cached 2-column set, the
+  untouched column's stream re-serves from HBM);
+* column-projected streams (``stream_tables(columns=[...])``) read
+  only the packed matrices they need and key their cached blocks by
+  the projection;
+* the dirty log records ``(start, end, cols)`` entries for column
+  writes;
+* ``feedback.pin_budget`` — the pinned auto-sizing formula over the
+  attribution ledger's hot-set table — and the devcache
+  ``set_pin_budget(auto=...)`` hook + stats annotation.
+"""
+
+import contextlib
+
+import numpy as np
+import pytest
+
+from netsdb_tpu import obs
+from netsdb_tpu.client import Client
+from netsdb_tpu.config import Configuration
+from netsdb_tpu.plan import staging
+from netsdb_tpu.relational.outofcore import PagedColumns
+from netsdb_tpu.relational.table import ColumnTable
+from netsdb_tpu.serve.sched import feedback
+from netsdb_tpu.storage.devcache import DeviceBlockCache
+from netsdb_tpu.storage.store import SetIdentifier
+
+IDENT = SetIdentifier("d", "t")
+
+
+def _client(tmp_path, name="p", **cfg):
+    cfg.setdefault("page_size_bytes", 4096)
+    c = Client(Configuration(root_dir=str(tmp_path / name), **cfg))
+    c.create_database("d")
+    c.create_set("d", "t", type_name="table", storage="paged")
+    return c
+
+
+def _cols(n, seed=0):
+    rng = np.random.default_rng(seed)
+    return {"k": rng.integers(0, 100, n).astype(np.int32),
+            "v": rng.uniform(0, 1, n).astype(np.float32)}
+
+
+def _pc(c):
+    return next(i for i in c.store.get_items(IDENT)
+                if isinstance(i, PagedColumns))
+
+
+def _consume(pc, columns=None):
+    out = []
+    with contextlib.closing(pc.stream_tables(columns=columns)) as s:
+        for t in s:
+            out.append({k: np.asarray(v) for k, v in t.cols.items()})
+    return out
+
+
+# ------------------------------------------- the issue's regression
+def test_update_one_column_keeps_other_columns_blocks(tmp_path):
+    """Update one column of a cached 2-column set: the untouched
+    column's projected stream serves with ZERO re-stages; the touched
+    column's stream re-stages; the dirty log entry is column-keyed."""
+    c = _client(tmp_path)
+    cols = _cols(6000)
+    c.send_table("d", "t", ColumnTable(cols, {}))
+    pc = _pc(c)
+    cache = c.store.device_cache()
+    assert cache.partial
+
+    _consume(pc, columns=["v"])  # cold: installs under cols={v}
+    _consume(pc, columns=["k"])  # cold: installs under cols={k}
+    nblocks = len(pc.block_ranges())
+    st0 = cache.stats()
+    assert st0["entries"] == 2 * nblocks
+
+    new_k = np.arange(6000, dtype=np.int32) % 7
+    c.store.update_columns(IDENT, {"k": new_k})
+
+    st1 = cache.stats()
+    # only the k-projected blocks dropped; the v blocks survive
+    assert st1["entries"] == nblocks
+    assert st1["dirty_invalidations"] == st0["dirty_invalidations"] \
+        + nblocks
+
+    # untouched column: full coverage, zero re-stages
+    chunks0 = obs.REGISTRY.counter("staging.chunks").value
+    got_v = _consume(pc, columns=["v"])
+    assert obs.REGISTRY.counter("staging.chunks").value == chunks0
+    merged_v = np.concatenate(
+        [t["v"][np.asarray(t["_rowid"]) < 6000] for t in got_v])
+    assert np.array_equal(np.sort(merged_v), np.sort(cols["v"]))
+
+    # touched column: re-stages and sees the NEW values
+    got_k = _consume(pc, columns=["k"])
+    assert obs.REGISTRY.counter("staging.chunks").value \
+        == chunks0 + nblocks
+    merged = {}
+    for t in got_k:
+        rid = np.asarray(t["_rowid"])
+        keep = rid < 6000
+        for r, kv in zip(rid[keep], t["k"][keep]):
+            merged[int(r)] = int(kv)
+    assert all(merged[i] == int(new_k[i]) for i in range(6000))
+
+    # the dirty log keyed the entry by column
+    stats = c.store.set_stats(IDENT)
+    assert stats["dirty_ranges"][-1] == (0, 6000, ("k",))
+    assert staging.active_count() == 0
+
+
+def test_update_column_drops_unprojected_full_streams(tmp_path):
+    """A full-table (unprojected) cached stream contains EVERY column
+    — any column update must drop its blocks."""
+    c = _client(tmp_path)
+    c.send_table("d", "t", ColumnTable(_cols(4000), {}))
+    pc = _pc(c)
+    cache = c.store.device_cache()
+    _consume(pc)  # unprojected: no column marker on the base key
+    nblocks = len(pc.block_ranges())
+    assert cache.stats()["entries"] == nblocks
+    c.store.update_columns(IDENT, {"v": np.zeros(4000, np.float32)})
+    assert cache.stats()["entries"] == 0
+    got = _consume(pc)
+    merged = np.concatenate(
+        [t["v"][np.asarray(t["_rowid"]) < 4000] for t in got])
+    assert float(np.abs(merged).sum()) == 0.0
+
+
+def test_update_column_guards(tmp_path):
+    c = _client(tmp_path)
+    c.send_table("d", "t", ColumnTable(_cols(1000), {}))
+    pc = _pc(c)
+    with pytest.raises(KeyError):
+        pc.update_column("nope", np.zeros(1000, np.float32))
+    with pytest.raises(ValueError):
+        pc.update_column("v", np.zeros(999, np.float32))
+    with pytest.raises(TypeError):  # float values on an int column
+        pc.update_column("k", np.zeros(1000, np.float32))
+    # int stats refresh on update
+    pc.update_column("k", np.full(1000, 42, np.int32))
+    assert pc.stats["k"].min_val == 42
+    assert pc.stats["k"].max_val == 42
+
+
+def test_projection_streams_only_requested_columns(tmp_path):
+    c = _client(tmp_path)
+    cols = _cols(3000, seed=9)
+    c.send_table("d", "t", ColumnTable(cols, {}))
+    pc = _pc(c)
+    got = _consume(pc, columns=["v"])
+    for t in got:
+        assert set(t) == {"v", "_rowid"}
+    with pytest.raises(KeyError):
+        _consume(pc, columns=["nope"])
+    # uncached relation (no store binding) projects too
+    assert staging.active_count() == 0
+
+
+# ------------------------------------------------ pin-budget auto-sizing
+def test_pin_budget_pinned_formula():
+    budget = 1000
+    # hottest scope below the share floor -> 0
+    snap = {"a": {"d:x": {"staged_bytes": 10.0},
+                  "d:y": {"staged_bytes": 90.0}}}
+    assert feedback.pin_budget(
+        {"a": {f"d:s{i}": {"staged_bytes": 10.0} for i in range(10)}},
+        budget) == 0
+    # one hot scope: its bytes, summed across clients
+    snap = {"a": {"d:hot": {"staged_bytes": 300.0}},
+            "b": {"d:hot": {"staged_bytes": 100.0},
+                  "d:cold": {"staged_bytes": 50.0}}}
+    assert feedback.pin_budget(snap, budget) == 400
+    # capped at PIN_FRACTION x cache budget
+    snap = {"a": {"d:hot": {"staged_bytes": 900.0}}}
+    assert feedback.pin_budget(snap, budget) == 500
+    # overflow bucket and scope-free rows never count
+    snap = {"overflow": {"d:hot": {"staged_bytes": 1e9}},
+            "a": {"*": {"staged_bytes": 1e9}}}
+    assert feedback.pin_budget(snap, budget) == 0
+    assert feedback.pin_budget({}, budget) == 0
+    # the constants are contract
+    assert feedback.PIN_HOT_SHARE == 0.25
+    assert feedback.PIN_FRACTION == 0.5
+
+
+def test_set_pin_budget_auto_annotation_and_shrink():
+    cache = DeviceBlockCache(1 << 20, partial=True, pin_bytes=0)
+    st = cache.stats()
+    assert st["pin_budget_bytes"] == 0
+    assert st["pin_auto"] is False
+    cache.set_pin_budget(4096, auto=True)
+    st = cache.stats()
+    assert st["pin_budget_bytes"] == 4096
+    assert st["pin_auto"] is True
+    # install a pinned head block, then shrink below it: pins lift
+    base = ("d:s", "tables", 8, None)
+    epoch = cache.scope_epoch("d:s")
+    blk = np.zeros(512, np.float32)  # 2048 bytes
+    assert cache.install_block(base, (0, 8), blk, epoch=epoch)
+    assert cache.stats()["pinned_bytes"] == 2048
+    cache.set_pin_budget(1024, auto=True)
+    st = cache.stats()
+    assert st["pinned_bytes"] == 0  # conservative reset
+    assert st["entries"] == 1      # the block itself stays resident
+
+
+def test_scheduler_pin_auto_runs_on_feedback_cadence():
+    from netsdb_tpu.serve import sched as _sched
+
+    calls = []
+    qs = _sched.QueryScheduler(slots=2, coalesce=False, affinity=False,
+                               feedback_every=1,
+                               pin_auto=lambda: calls.append(1))
+    try:
+        for _ in range(3):
+            t = qs.acquire(None, 1.0)
+            qs.release(t)
+        deadline = 50
+        import time
+
+        while not calls and deadline:
+            time.sleep(0.02)
+            deadline -= 1
+        assert calls  # the cadence thread invoked the pin hook
+    finally:
+        obs.REGISTRY.unregister_collector("sched")

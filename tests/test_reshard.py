@@ -1,0 +1,257 @@
+"""Collective-step resharding (``parallel/reshard.py``).
+
+What these tests pin, on the tier-1 virtual 4-device mesh:
+
+* **the planner** — the schedule lattice (no-op, all_gather,
+  local_slice, all_to_all, cross-mesh fallback) with bounded-memory
+  ``peak`` annotations;
+* **the primitive** — resharding a placed PAGED set moves its
+  device-cached blocks between layouts with ZERO arena reads and zero
+  re-staging; the post-reshard stream is byte-equal to a fresh stream
+  ingested under the destination layout;
+* **the sharding-aware devcache key across a reshard** (ISSUE 15
+  satellite) — the old layout's key MISSes afterwards, the new
+  layout's key serves full coverage, no stale-layout hit, no leak in
+  ``staging.active_count`` or the cache's entry count;
+* **memory sets** — resident BlockedTensors move through an
+  all_to_all without a host round-trip.
+"""
+
+import contextlib
+
+import numpy as np
+import pytest
+
+from netsdb_tpu import obs
+from netsdb_tpu.client import Client
+from netsdb_tpu.config import Configuration
+from netsdb_tpu.parallel.placement import Placement
+from netsdb_tpu.parallel.reshard import (
+    Step,
+    execute_steps,
+    plan_steps,
+    reshard_set,
+)
+from netsdb_tpu.plan import staging
+from netsdb_tpu.relational.outofcore import PagedColumns
+from netsdb_tpu.relational.table import ColumnTable
+from netsdb_tpu.storage.store import SetIdentifier
+
+pytestmark = pytest.mark.mesh
+
+SRC = Placement((("data", 4),), ("data",))
+REPL = Placement((("data", 4),), (None,))
+IDENT = SetIdentifier("d", "t")
+
+
+def _cols(n, seed=0):
+    rng = np.random.default_rng(seed)
+    return {"k": rng.integers(0, 100, n).astype(np.int32),
+            "v": rng.uniform(0, 1, n).astype(np.float32)}
+
+
+def _client(tmp_path, name="p", placement=SRC, **cfg):
+    cfg.setdefault("page_size_bytes", 4096)
+    c = Client(Configuration(root_dir=str(tmp_path / name), **cfg))
+    c.create_database("d")
+    c.create_set("d", "t", type_name="table", storage="paged",
+                 placement=placement)
+    return c
+
+
+def _consume(pc, placement):
+    out = []
+    with contextlib.closing(pc.stream_tables(placement=placement)) as s:
+        for t in s:
+            out.append({k: np.asarray(v) for k, v in t.cols.items()})
+    return out
+
+
+def _pc(c):
+    return next(i for i in c.store.get_items(IDENT)
+                if isinstance(i, PagedColumns))
+
+
+# ------------------------------------------------------- the planner
+def test_plan_steps_lattice():
+    assert plan_steps(("data",), ("data",), 1) == []
+    # a gather materializes a full replica per device: peak = the
+    # axis size when the planner knows the mesh, 0 (= unresolved
+    # full replica) when it doesn't
+    assert plan_steps(("data",), (None,), 1,
+                      axis_sizes={"data": 4}) == \
+        [Step("all_gather", dim=0, axis="data", peak=4)]
+    assert plan_steps(("data",), (None,), 1) == \
+        [Step("all_gather", dim=0, axis="data", peak=0)]
+    assert plan_steps((None,), ("data",), 1) == \
+        [Step("local_slice", dim=0, axis="data", peak=1)]
+    # the 2112.01075 headline case: dim move over one axis = ONE
+    # all-to-all, shard-sized messages, no transient replica
+    assert plan_steps(("data", None), (None, "data"), 2) == \
+        [Step("all_to_all", dim=0, dim_to=1, axis="data", peak=1)]
+    # cross-mesh: gather then device-to-device re-place (bounded
+    # two-step fallback; still no host round-trip)
+    steps = plan_steps(("data",), ("data",), 1, same_mesh=False)
+    assert [s.kind for s in steps] == ["all_gather", "replace"]
+    # missing trailing entries mean replicated
+    assert plan_steps(("data",), ("data", None), 2) == []
+
+
+# --------------------------------------------- the paged-set primitive
+def test_reshard_paged_set_zero_arena_reads(tmp_path, mesh4):
+    """The acceptance shape: a warm placed set reshards sharded →
+    replicated entirely device-to-device — no page is read from the
+    arena, no chunk is staged, and the post-reshard stream is
+    byte-equal to a fresh ingest under the destination layout."""
+    c = _client(tmp_path)
+    cols = _cols(6000)
+    c.send_table("d", "t", ColumnTable(cols, {}))
+    pc = _pc(c)
+    cache = c.store.device_cache()
+    assert cache.partial
+
+    _consume(pc, c.store.placement_of(IDENT))  # cold: install src runs
+    entries0 = cache.stats()["entries"]
+    assert entries0 == len(pc.block_ranges())
+
+    pages0 = pc.pages_streamed
+    chunks0 = obs.REGISTRY.counter("staging.chunks").value
+    rep = reshard_set(c.store, IDENT, REPL)
+    assert rep.labels() == ["all_gather[data:0]"]
+    assert rep.steps[0].peak == 4  # full replica over the 4-axis
+    assert rep.blocks_moved == entries0
+    assert rep.bytes_moved > 0
+    assert pc.pages_streamed == pages0  # ZERO arena reads
+
+    assert c.store.placement_of(IDENT) is REPL
+    warm = _consume(pc, c.store.placement_of(IDENT))
+    # the warm re-query under the NEW layout staged nothing either
+    assert obs.REGISTRY.counter("staging.chunks").value == chunks0
+    assert pc.pages_streamed == pages0
+
+    # byte-equality vs a fresh uncached stream ingested under REPL
+    cu = _client(tmp_path, "fresh", placement=REPL,
+                 device_cache_bytes=0)
+    cu.send_table("d", "t", ColumnTable(cols, {}))
+    ref = _consume(_pc(cu), REPL)
+    assert len(warm) == len(ref)
+    for a, b in zip(warm, ref):
+        assert a.keys() == b.keys()
+        for k in a:
+            assert np.array_equal(a[k], b[k]), k
+    assert staging.active_count() == 0
+
+
+def test_reshard_devcache_key_miss_old_hit_new(tmp_path, mesh4):
+    """ISSUE 15 satellite: across a reshard the old layout's
+    sharding-keyed entries are GONE (a consult MISSes — no stale-
+    layout hit is possible), the new layout's key serves full
+    coverage, and nothing leaks (entry count flat, no live staging
+    threads)."""
+    c = _client(tmp_path)
+    c.send_table("d", "t", ColumnTable(_cols(5000, seed=3), {}))
+    pc = _pc(c)
+    cache = c.store.device_cache()
+    _consume(pc, SRC)
+    entries0 = cache.stats()["entries"]
+
+    reshard_set(c.store, IDENT, REPL)
+    st = cache.stats()
+    assert st["entries"] == entries0  # moved, not duplicated/leaked
+
+    ranges = pc.block_ranges()
+    _e, old_cov = cache.plan_ranges(pc.partial_base_key("tables", SRC),
+                                    ranges)
+    assert old_cov == {}  # MISS under the old layout key
+    _e, new_cov = cache.plan_ranges(pc.partial_base_key("tables", REPL),
+                                    ranges)
+    assert len(new_cov) == len(ranges)  # clean install under the new
+    misses0 = cache.stats()["misses"]
+    assert misses0 >= 1
+    assert staging.active_count() == 0
+
+
+def test_reshard_replicated_to_sharded_local_slice(tmp_path, mesh4):
+    """The zero-communication direction: every device already holds
+    its piece — one local_slice step, still zero arena reads."""
+    c = _client(tmp_path, placement=REPL)
+    cols = _cols(4000, seed=5)
+    c.send_table("d", "t", ColumnTable(cols, {}))
+    pc = _pc(c)
+    _consume(pc, REPL)
+    pages0 = pc.pages_streamed
+    rep = reshard_set(c.store, IDENT, SRC)
+    assert rep.labels() == ["local_slice[data:0]"]
+    assert rep.blocks_moved == len(pc.block_ranges())
+    assert pc.pages_streamed == pages0
+    warm = _consume(pc, SRC)
+    merged = np.concatenate([t["v"][np.asarray(t["_rowid"])
+                                    < len(cols["v"])]
+                             for t in warm])
+    # row content survived the round trip (padding masked rows aside)
+    assert np.array_equal(np.sort(merged), np.sort(cols["v"]))
+    assert staging.active_count() == 0
+
+
+# ------------------------------------------------------- memory sets
+def test_reshard_memory_blocked_tensor_all_to_all(tmp_path, mesh4):
+    from netsdb_tpu.core.blocked import BlockedTensor
+
+    src = Placement((("data", 4),), ("data", None))
+    dst = Placement((("data", 4),), (None, "data"))
+    c = Client(Configuration(root_dir=str(tmp_path / "m")))
+    c.create_database("d")
+    c.create_set("d", "t", type_name="tensor", placement=src)
+    rng = np.random.default_rng(1)
+    dense = rng.integers(-8, 8, (512, 512)).astype(np.float32)
+    c.send_matrix("d", "t", dense)
+    rep = reshard_set(c.store, IDENT, dst)
+    assert rep.items_moved == 1
+    assert [s.kind for s in rep.steps] == ["all_to_all"]
+    item = next(i for i in c.store.get_items(IDENT)
+                if isinstance(i, BlockedTensor))
+    assert np.array_equal(np.asarray(item.to_dense()), dense)
+    assert c.store.placement_of(IDENT) is dst
+
+
+def test_reshard_memory_table_set(tmp_path, mesh4):
+    """A resident (memory-storage) table set moves its columns and
+    validity mask through the schedule too — the declared placement
+    and the committed shardings swap together."""
+    c = Client(Configuration(root_dir=str(tmp_path / "mt")))
+    c.create_database("d")
+    c.create_set("d", "t", type_name="table", placement=SRC)
+    cols = _cols(4096, seed=11)
+    c.send_table("d", "t", ColumnTable(cols, {}))
+    rep = reshard_set(c.store, IDENT, REPL)
+    assert rep.items_moved == 1
+    assert [s.kind for s in rep.steps] == ["all_gather"]
+    item = next(i for i in c.store.get_items(IDENT)
+                if hasattr(i, "cols"))
+    got = np.asarray(item["v"])
+    valid = item.mask()
+    kept = got[np.asarray(valid)] if valid is not None else got
+    assert np.array_equal(np.sort(kept), np.sort(cols["v"]))
+
+
+def test_execute_steps_values_and_sharding(mesh4):
+    import jax
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    src = Placement((("data", 4),), ("data",))
+    dst = Placement((("data", 4),), (None,))
+    x = jax.device_put(np.arange(64, dtype=np.float32),
+                       src.sharding())
+    steps = plan_steps(tuple(src.spec), tuple(dst.spec), 1)
+    out = execute_steps(x, steps, src, dst)
+    assert np.array_equal(np.asarray(out), np.arange(64))
+    # the committed sharding is EQUIVALENT to a fresh dst placement
+    # (the normalizing re-place fires whenever a step's output is
+    # not — the jit-cache-parity requirement)
+    assert out.sharding.is_equivalent_to(
+        NamedSharding(dst.mesh(), P(None)), out.ndim)
+    # and the reverse direction normalizes onto the sharded spec
+    back = execute_steps(out, plan_steps((None,), ("data",), 1),
+                         dst, src)
+    assert np.array_equal(np.asarray(back), np.arange(64))
+    assert back.sharding.is_equivalent_to(src.sharding(), back.ndim)
