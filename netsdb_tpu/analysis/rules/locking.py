@@ -56,6 +56,13 @@ SEED_EDGES: Tuple[Tuple[str, str], ...] = (
     ("ServeController._mirror_lock", "ServeController._followers_mu"),
     ("ServeController._set_locks_mu", "ServeController._set_locks[]"),
     ("ServeController._set_locks[]", "ServeController._mirror_lock"),
+    # HA durability (ISSUE 16): the mirror path appends to the durable
+    # mutation log INSIDE the mirror critical section (log order must
+    # equal link FIFO order), and the shard pool spills its handoff
+    # buffer to the same log class under its own mutex; the log's lock
+    # is a strict leaf, so neither edge can close a cycle
+    ("ServeController._mirror_lock", "storage.MutationLog._mu"),
+    ("serve.ShardPool._mu", "storage.MutationLog._mu"),
 )
 
 #: modules that IMPLEMENT the primitives (their internals necessarily

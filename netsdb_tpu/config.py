@@ -238,6 +238,23 @@ class Configuration:
     # refusal beyond the bound) and drains — only those pages — when
     # the shard readmits. The shard-scoped resync's memory ceiling.
     shard_handoff_bytes: int = 256 * 1024 * 1024
+    # --- multi-host HA (serve/ha.py + storage/mutlog.py) ---
+    # how long a follower must see EVERY earlier succession peer
+    # unreachable before promoting itself leader under a new term.
+    # Also the client's worst-case election window: a NotLeader
+    # rejection with no leader address backs off within this bound.
+    # The chaos tests shrink it to fractions of a second; production
+    # wants it comfortably above one heartbeat_timeout_s.
+    ha_election_timeout_s: float = 5.0
+    # durable mutation log (storage/mutlog.py) under <root_dir>/mutlog:
+    # on, the leader appends every mirrored frame on the mirror path
+    # (log-replay resync for readmitted followers instead of a whole-
+    # store snapshot) and the degraded-slot handoff buffer spills its
+    # batches + drain tombstones (buffered ingest survives a leader
+    # RESTART; the placement map persists alongside). Off (default),
+    # resync falls back to the PR 2 snapshot stream and the handoff
+    # buffer is memory-only — the pre-HA behavior, byte-identical.
+    ha_mutlog: bool = False
     # --- scheduler feedback loop (serve/sched/) ---
     # seed lane weights (and per-lane quotas, when sched_lane_quota is
     # set) from observed behavior instead of the static sched_lanes

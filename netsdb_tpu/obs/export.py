@@ -79,6 +79,18 @@ def _catalog() -> Dict[str, Tuple[str, str]]:
                                              "rejection"),
         ("serve.client.routed_ingests", "logical ingests routed "
                                         "directly to owning shards"),
+        ("serve.mirror_dropped", "queued mirror frames dropped by an "
+                                 "abort-closed follower link"),
+        ("ha.terms", "HA term adoptions (promotions plus higher-term "
+                     "observations)"),
+        ("ha.promotions", "follower-to-leader promotions won after the "
+                          "election window"),
+        ("ha.stragglers_rejected", "stale-term frames from a deposed "
+                                   "leader rejected with a typed "
+                                   "NotLeader"),
+        ("mutlog.appended_bytes", "bytes appended to the durable "
+                                  "mutation log (mirror frames, token "
+                                  "aliases, handoff spill)"),
         ("shard.scatter_queries", "queries executed scatter-gather "
                                   "across the shard pool by this "
                                   "coordinator"),

@@ -37,6 +37,7 @@ from netsdb_tpu.serve.errors import (  # noqa: F401 — re-exported API
     DeadlineExceededError,
     FollowerDegradedError,
     LaneSaturatedError,
+    NotLeaderError,
     PlacementStaleError,
     ProtocolVersionError,
     RemoteError,
@@ -163,7 +164,8 @@ class RemoteClient:
                  client_id: Optional[str] = None,
                  lane: Optional[str] = None,
                  trace_sample: Optional[int] = None,
-                 ship_traces: bool = True):
+                 ship_traces: bool = True,
+                 failover: Optional[Sequence[str]] = None):
         """``timeout``: socket-level timeout applied to every blocking
         recv after the handshake (None = block; a hung server then
         surfaces as :class:`RemoteTimeoutError` instead of a wedged
@@ -216,7 +218,16 @@ class RemoteClient:
         own connection — never the request critical path) so GET_TRACE
         returns one merged client→leader→follower decomposition;
         best-effort — a lost ship costs the client section, never the
-        request. :meth:`flush_traces` drains the queue."""
+        request. :meth:`flush_traces` drains the queue.
+
+        ``failover``: candidate leader addresses (the HA succession
+        list). Two rediscovery paths use it: a typed ``NotLeader``
+        refusal that NAMES the current leader re-points there
+        immediately; a connection loss (or a NotLeader with no known
+        leader — mid-election) rotates through the candidates across
+        the normal retry/backoff schedule, which doubles as the
+        bounded election-window wait. Empty = PR 9 behavior (retries
+        stay pinned to one address)."""
         host, _, port = address.rpartition(":")
         self.host = host or "127.0.0.1"
         self.port = int(port)
@@ -288,6 +299,13 @@ class RemoteClient:
         self._placement_fetch_mu = TrackedLock(
             "RemoteClient._placement_fetch_mu")
         self._refreshing_placement: Optional[int] = None
+        # HA failover: candidate leaders + rotation cursor (guarded by
+        # _lock with the rest of the connection state)
+        self._failover = [a for a in (failover or [])]
+        self._failover_idx = 0
+        #: times this client re-pointed at a different daemon
+        #: (observability for the failover tests)
+        self.failovers = 0
         self._connect()
 
     # --- transport ----------------------------------------------------
@@ -451,6 +469,28 @@ class RemoteClient:
                 failure = ConnectionLostError(type(e).__name__, str(e))
             if attempt >= policy.max_attempts:
                 raise failure
+            if isinstance(failure, NotLeaderError):
+                addr = getattr(failure, "leader_addr", None)
+                if addr:
+                    # the refusal NAMES the leader: re-point and retry
+                    # immediately — deterministic redirect, not
+                    # congestion, so backoff would only add latency
+                    self._switch_address(addr)
+                    attempt += 1
+                    self.total_retries += 1
+                    obs.REGISTRY.counter("serve.client.retries").inc()
+                    continue
+                # mid-election (no leader known yet): fall through to
+                # the normal backoff — it doubles as the bounded
+                # election-window wait — rotating candidates meanwhile
+                self._rotate_failover()
+            elif isinstance(failure, (ConnectionLostError,
+                                      RemoteTimeoutError)) \
+                    and self._failover:
+                # the daemon died outright (no typed refusal to carry
+                # a leader address): walk the succession list — one of
+                # the candidates is (or is about to become) the leader
+                self._rotate_failover()
             if isinstance(failure, PlacementStaleError):
                 # the frame rode an out-of-date placement map: refresh
                 # the cache and retry IMMEDIATELY — the rejection is
@@ -832,6 +872,39 @@ class RemoteClient:
                 s.close()
             except OSError:
                 pass
+
+    def _switch_address(self, address: str) -> None:
+        """Re-point this client at a different daemon (HA failover:
+        a NotLeader refusal named the real leader, or the candidate
+        rotation picked the next succession peer). The persistent
+        connection drops; the next attempt re-dials the new address.
+        The placement cache is KEPT — epochs validate it, and the
+        promotion's rebind bumped exactly the epochs that moved, so a
+        genuinely stale map costs one typed PlacementStale, not a
+        mandatory refetch on every failover."""
+        host, _, port = address.rpartition(":")
+        with self._lock:
+            if (host or "127.0.0.1") == self.host \
+                    and int(port) == self.port:
+                return
+            self.host = host or "127.0.0.1"
+            self.port = int(port)
+            self._drop_connection()
+        self.failovers += 1
+
+    def _rotate_failover(self) -> None:
+        """Advance to the next failover candidate (skipping the
+        current address). No-op without a candidate list."""
+        if not self._failover:
+            return
+        n = len(self._failover)
+        for _ in range(n):
+            cand = self._failover[self._failover_idx % n]
+            self._failover_idx += 1
+            h, _, p = cand.rpartition(":")
+            if (h or "127.0.0.1") != self.host or int(p) != self.port:
+                self._switch_address(cand)
+                return
 
     def _force_close(self) -> None:
         """Unstick an in-flight request from ANOTHER thread: shut the

@@ -149,6 +149,24 @@ class ShardUnavailable(ServeFault):
         self.epoch = epoch
 
 
+class NotLeader(ServeFault):
+    """This daemon cannot accept the write: it is an HA follower (the
+    client aimed at the wrong daemon, or a failover moved the role),
+    or the frame carried a STALE term (a deposed leader's straggler —
+    fenced, never applied). ``leader_addr`` carries the leader this
+    daemon knows about (None mid-election) so the client re-points
+    WITHOUT a discovery scan; ``term`` is this daemon's current term.
+    Retryable by contract: nothing was applied, and the retry against
+    the right leader dedupes under the same idempotency token."""
+
+    retryable = True
+
+    def __init__(self, *args, leader_addr=None, term=None):
+        super().__init__(*args)
+        self.leader_addr = leader_addr
+        self.term = term
+
+
 class RequestInFlight(ServeFault):
     """A duplicate idempotency token arrived while the original request
     is still executing; the retry should back off and re-ask (it will
@@ -179,6 +197,10 @@ class RemoteError(RuntimeError):
         # placement details (PlacementStale/ShardUnavailable family)
         self.epoch = None
         self.slot = None
+        # HA failover details (NotLeader family): where the leader
+        # moved and the rejecting daemon's term
+        self.leader_addr = None
+        self.term = None
 
 
 class RetryableRemoteError(RemoteError):
@@ -246,6 +268,16 @@ class ShardUnavailableError(RetryableRemoteError):
     merged; retry after the pool heals (backoff applies)."""
 
 
+class NotLeaderError(RetryableRemoteError):
+    """Server-side :class:`NotLeader` — the daemon is a follower (or a
+    deposed leader that already fenced this client's frame).
+    ``leader_addr`` (when the rejection carried one) names the daemon
+    to re-point at; :class:`RemoteClient` switches its address and
+    retries immediately, or backs off through the election window when
+    no leader is known yet. ``term`` is the rejecting daemon's current
+    term."""
+
+
 class AuthError(RemoteError):
     """Handshake refused — fatal, retrying cannot help."""
 
@@ -271,6 +303,7 @@ _KIND_MAP: Dict[str, type] = {
     "CorruptFrame": CorruptFrameError,
     "PlacementStale": PlacementStaleError,
     "ShardUnavailable": ShardUnavailableError,
+    "NotLeader": NotLeaderError,
     "AuthError": AuthError,
     "ProtocolVersionError": ProtocolVersionError,
 }
@@ -281,8 +314,10 @@ _KIND_MAP: Dict[str, type] = {
 #: ``epoch``/``slot`` are the placement family's analogues: the
 #: receiver's current epoch rides the rejection so a client can tell
 #: "my map is stale" from "the pool is degraded".
+#: ``leader_addr``/``term`` are the HA family's: a NotLeader rejection
+#: names the daemon to re-point at and the rejecting daemon's term.
 BACKPRESSURE_FIELDS = ("retry_after_s", "queue_depth", "lane",
-                       "epoch", "slot")
+                       "epoch", "slot", "leader_addr", "term")
 
 
 def classify_remote(reply: Dict[str, Any]) -> RemoteError:

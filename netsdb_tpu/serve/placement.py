@@ -247,12 +247,59 @@ class PlacementMap:
         """Readmit one shard daemon after its handoff drained."""
         return self._flip(addr, LIVE)
 
+    def rebind_addr(self, old: str, new: str) -> List[Tuple[str, str]]:
+        """Rewrite every slot owned by ``old`` to ``new`` (state LIVE)
+        and bump the affected sets' epochs — the promotion step: the
+        new leader inherited the old leader's slot DATA through the
+        mirror stream, so it takes over the slot identity too. The
+        epoch bump is what keeps re-pointing cheap and safe: a client
+        still routing under the old map gets exactly one typed
+        ``PlacementStale``, refreshes, and re-routes — no discovery
+        scan, no partial application."""
+        changed = []
+        with self._mu:
+            for ident, e in self._entries.items():
+                hit = False
+                for s in e["slots"]:
+                    if s["addr"] == old:
+                        s["addr"] = new
+                        s["state"] = LIVE
+                        hit = True
+                if hit:
+                    self._epoch += 1
+                    e["epoch"] = self._epoch
+                    changed.append(ident)
+        return changed
+
     # --- wire form ----------------------------------------------------
     def to_wire(self) -> Dict[str, Any]:
         with self._mu:
             return {"epoch": self._epoch,
                     "sets": {f"{db}:{s}": self._copy(e)
                              for (db, s), e in self._entries.items()}}
+
+    def restore(self, wire: Dict[str, Any]) -> int:
+        """Install a map previously captured by :meth:`to_wire` —
+        the replicated-map half of failover (a freshly promoted
+        leader) and of a durable leader restart. Epochs are preserved
+        EXACTLY: per-set epochs and the global counter resume where
+        the map left off, so routed frames from clients holding the
+        old leader's map validate against the same numbers (the
+        promotion's ``rebind_addr`` then bumps only the sets whose
+        slots actually moved). Returns the restored set count."""
+        sets = (wire or {}).get("sets") or {}
+        with self._mu:
+            self._entries = {}
+            for key, entry in sets.items():
+                db, _, set_name = key.partition(":")
+                self._entries[(db, set_name)] = {
+                    "mode": entry["mode"], "key": entry.get("key"),
+                    "epoch": int(entry["epoch"]),
+                    "slots": [dict(s) for s in entry["slots"]]}
+            self._epoch = max(
+                [int((wire or {}).get("epoch") or 0)]
+                + [e["epoch"] for e in self._entries.values()])
+            return len(self._entries)
 
     @staticmethod
     def entry_from_wire(wire: Dict[str, Any], db: str,

@@ -204,6 +204,20 @@ class MsgType(IntEnum):
     # readmitted shard receives only its OWN buffered pages, never a
     # whole-store snapshot like RESYNC_FOLLOWER)
     SHARD_RESYNC = 73
+    # --- multi-host HA (leader election + failover) -------------------
+    # leader → follower: the authoritative HA record — current term,
+    # leader address and the placement map's wire form, shipped on
+    # every placement-epoch bump (and at resync/promotion) so a
+    # freshly promoted follower serves routed ingest from its
+    # REPLICATED map immediately instead of starting empty.
+    HA_STATE = 74
+    # leader → follower: alias one idempotency token to another's
+    # cached reply. The coalesce path executes ONE leader token but
+    # finishes every waiter's token locally; this frame ships the
+    # waiter→leader mapping across the mirror hop, so a waiter client
+    # retrying a coalesced EXECUTE against the PROMOTED follower still
+    # dedupes instead of re-executing (the PR 9 failover-scope gap).
+    TOKEN_ALIAS = 75
 
 
 #: payload key carrying the client-generated idempotency token on
@@ -248,6 +262,17 @@ LANE_KEY = "__lane__"
 #: membership the leader already revised (the partial/doubled-merge
 #: hazard the epoch exists to close).
 PLACEMENT_EPOCH_KEY = "__pepoch__"
+
+#: payload key carrying the sender's HA TERM on every leader-
+#: originated frame (mirrored forwards, handoff drains, resync) in an
+#: HA-armed topology. The receiver validates it against the term it
+#: knows: a HIGHER term is adopted (a new leader was elected), a STALE
+#: term is the deposed-leader straggler — rejected with the typed
+#: retryable ``NotLeader`` naming both terms, never applied. Routed
+#: frames carry this alongside ``PLACEMENT_EPOCH_KEY`` — the
+#: ``(term, epoch)`` fencing pair. Absent in non-HA topologies, so
+#: every existing frame stays byte-identical.
+HA_TERM_KEY = "__term__"
 
 #: payload key carrying the target shard SLOT index on routed ingest.
 #: A slot in handoff state routes to the LEADER with this key intact:

@@ -216,15 +216,22 @@ class QueryScheduler:
         return self.lanes.retry_after_s(lane)
 
     # --- coalescing ---------------------------------------------------
-    def coalesced(self, typ: Any, payload: Any, fn) -> Any:
+    def coalesced(self, typ: Any, payload: Any, fn,
+                  token: Optional[str] = None,
+                  waiter_info: Optional[Dict[str, Any]] = None) -> Any:
         """Single-flight ``fn`` when the frame fingerprints (and
-        coalescing is on); otherwise just run it."""
+        coalescing is on); otherwise just run it. ``token`` /
+        ``waiter_info`` ride through to
+        :meth:`~netsdb_tpu.serve.sched.coalesce.CoalesceTable.run` —
+        the token-alias plumbing that keeps waiter idempotency tokens
+        replayable across the mirror hop."""
         if not self.coalesce_enabled:
             return fn()
         key = frame_fingerprint(typ, payload)
         if key is None:
             return fn()
-        return self._coalesce.run(key, fn, self.coalesce_wait_s)
+        return self._coalesce.run(key, fn, self.coalesce_wait_s,
+                                  token=token, waiter_info=waiter_info)
 
     def coalesce_waiters(self, typ: Any, payload: Any) -> int:
         """Waiters currently parked behind this frame's fingerprint

@@ -26,15 +26,15 @@ decoded payload AFTER the per-request metadata (qid, client id,
 idempotency token, lane hint) was popped — "byte-identical" means
 identical in every byte the execution can observe.
 
-Failover scope note: a WAITER's idempotency token is finished in the
-LEADER DAEMON's reply cache only — the mirror hop forwards the
-coalesce leader's token, not the N−1 waiter tokens (they would need a
-token-alias frame; ROADMAP follow-on). After a leader-daemon loss, a
-waiter client's retry against the promoted follower therefore
-re-executes instead of replaying — safe by the same argument that
-makes coalescing sound at all (these frames are idempotent: same
-sinks, same values), but at-most-once degrades to
-at-least-once-same-result across that one failover edge.
+Failover scope: the mirror hop forwards the coalesce LEADER's token;
+each waiter's token is finished in the leader daemon's reply cache
+AND shipped to followers as a TOKEN_ALIAS frame mapping it onto the
+leader token's cached reply (``run``'s ``token``/``waiter_info``
+plumbing surfaces the leader token to the serve layer, which emits
+the alias after the mirrored execution acked). A waiter client's
+retry against a PROMOTED follower therefore still dedupes —
+at-most-once survives the failover edge instead of degrading to
+at-least-once-same-result (the PR 9 gap, now closed).
 """
 
 from __future__ import annotations
@@ -50,14 +50,18 @@ from netsdb_tpu.utils.locks import TrackedLock
 
 
 class _Flight:
-    __slots__ = ("done", "result", "error", "leader_qid", "waiters",
-                 "t0")
+    __slots__ = ("done", "result", "error", "leader_qid",
+                 "leader_token", "waiters", "t0")
 
-    def __init__(self, leader_qid: Optional[str]):
+    def __init__(self, leader_qid: Optional[str],
+                 leader_token: Optional[str] = None):
         self.done = threading.Event()
         self.result: Any = None
         self.error: Optional[BaseException] = None
         self.leader_qid = leader_qid
+        # the leader request's idempotency token — what a waiter's
+        # token aliases to across the mirror hop (TOKEN_ALIAS)
+        self.leader_token = leader_token
         self.waiters = 0
         self.t0 = time.perf_counter()
 
@@ -83,27 +87,30 @@ class CoalesceTable:
         self._inflight: Dict[str, _Flight] = {}
         self._done_ttl_s = float(done_ttl_s or 0.0)
         self._done_max = int(done_max)
-        # fingerprint → (result, finished_at); LRU-ordered, TTL-pruned
-        # on every touch (monotonic clock — the serve discipline)
-        self._done: "OrderedDict[str, Tuple[Any, float]]" = OrderedDict()
+        # fingerprint → (result, finished_at, leader_token);
+        # LRU-ordered, TTL-pruned on every touch (monotonic clock —
+        # the serve discipline)
+        self._done: "OrderedDict[str, Tuple[Any, float, Optional[str]]]" \
+            = OrderedDict()
 
     def _prune_done(self, now: float) -> None:
         """Drop expired/overflow entries (caller holds ``_mu``)."""
         ttl = self._done_ttl_s
         while self._done:
-            _k, (_v, t) = next(iter(self._done.items()))
+            _k, (_v, t, _tok) = next(iter(self._done.items()))
             if now - t <= ttl and len(self._done) <= self._done_max:
                 break
             self._done.popitem(last=False)
 
-    def _retain(self, key: str, result: Any) -> None:
+    def _retain(self, key: str, result: Any,
+                leader_token: Optional[str] = None) -> None:
         """Record a leader's completed reply for the late-hit window
         (no-op when retention is disabled)."""
         if self._done_ttl_s <= 0:
             return
         now = time.monotonic()
         with self._mu:
-            self._done[key] = (result, now)
+            self._done[key] = (result, now, leader_token)
             self._done.move_to_end(key)
             self._prune_done(now)
 
@@ -122,12 +129,22 @@ class CoalesceTable:
             return fl.waiters if fl is not None else 0
 
     def run(self, key: str, fn: Callable[[], Any],
-            wait_s: Optional[float]) -> Any:
+            wait_s: Optional[float],
+            token: Optional[str] = None,
+            waiter_info: Optional[Dict[str, Any]] = None) -> Any:
         """Single-flight ``fn`` under ``key``. The leader runs ``fn``
         OUTSIDE the table lock; waiters park on its event (bounded by
         ``wait_s``) and return the leader's result verbatim. Leader
         exceptions propagate unchanged to the leader and surface to
-        every waiter as the typed retryable :class:`CoalesceAborted`."""
+        every waiter as the typed retryable :class:`CoalesceAborted`.
+
+        ``token`` is THIS request's idempotency token; the leader's is
+        stashed on the flight (and the retained late-hit entry).
+        ``waiter_info`` (a caller-owned dict) gets
+        ``waiter_info["leader_token"]`` filled when this request was
+        absorbed by another flight — the serve layer then ships a
+        TOKEN_ALIAS frame so the waiter's token dedupes on followers
+        across a failover, not just here."""
         tr = obs.current_trace()
         with self._mu:
             if self._done_ttl_s > 0:
@@ -143,7 +160,7 @@ class CoalesceTable:
                 # under this request's own qid/token
                 hit = self._done.get(key)
                 if hit is not None:
-                    result, t_done = hit
+                    result, t_done, ltok = hit
                     if time.monotonic() - t_done <= self._done_ttl_s:
                         self._done.move_to_end(key)
                         obs.REGISTRY.counter(
@@ -151,11 +168,14 @@ class CoalesceTable:
                         if tr is not None:
                             tr.annotate("sched.coalesce_late_hit", key[:16])
                             tr.add("sched.coalesce_late_hits")
+                        if waiter_info is not None and ltok is not None:
+                            waiter_info["leader_token"] = ltok
                         return result
                     self._done.pop(key, None)
             if fl is None:
                 fl = self._inflight[key] = _Flight(
-                    tr.qid if tr is not None else None)
+                    tr.qid if tr is not None else None,
+                    leader_token=token)
                 leader = True
             elif wait_s is not None \
                     and time.perf_counter() - fl.t0 >= wait_s:
@@ -180,7 +200,7 @@ class CoalesceTable:
                 raise
             else:
                 fl.result = out
-                self._retain(key, out)
+                self._retain(key, out, leader_token=fl.leader_token)
                 return out
             finally:
                 # the flight leaves the table BEFORE the event fires:
@@ -211,4 +231,6 @@ class CoalesceTable:
                 f"coalesced leader {fl.leader_qid or '?'} failed "
                 f"({type(fl.error).__name__}: {fl.error}) — this "
                 f"request never ran; retry re-executes")
+        if waiter_info is not None and fl.leader_token is not None:
+            waiter_info["leader_token"] = fl.leader_token
         return fl.result

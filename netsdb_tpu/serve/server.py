@@ -41,12 +41,15 @@ from netsdb_tpu.config import Configuration, DEFAULT_CONFIG
 from netsdb_tpu.serve import sched as _sched
 from netsdb_tpu.serve import placement as _placement
 from netsdb_tpu.serve import shard as _shard
+from netsdb_tpu.serve import ha as _ha
 from netsdb_tpu.serve.errors import (
     BACKPRESSURE_FIELDS,
     AdmissionFull,
     CorruptFrame,
     FollowerDegraded,
     LaneSaturated,
+    NotLeader,
+    NotLeaderError,
     PlacementStale,
     RequestInFlight,
     ShardUnavailable,
@@ -55,6 +58,7 @@ from netsdb_tpu.serve.protocol import (
     CLIENT_ID_KEY,
     CODEC_MSGPACK,
     CODEC_PICKLE,
+    HA_TERM_KEY,
     IDEMPOTENCY_KEY,
     LANE_KEY,
     MAX_FRAME_BYTES,
@@ -70,6 +74,7 @@ from netsdb_tpu.serve.protocol import (
     send_frame,
     tensor_from_wire,
 )
+from netsdb_tpu.storage.mutlog import MutationLog
 from netsdb_tpu.storage.store import SetIdentifier
 from netsdb_tpu.utils.locks import TrackedLock
 from netsdb_tpu.utils.timing import deadline_after, seconds_left, wall_now
@@ -176,11 +181,22 @@ class _FollowerLink:
         # enqueued behind it and wait forever on its "done" event
         self._lk = TrackedLock("_FollowerLink._lk")
         self._closed = False
+        #: mutation-log END offset of the last frame this follower
+        #: ACKED — the log-replay resync's resume position. Written
+        #: only by the drain thread (FIFO: monotone by construction),
+        #: read by the evictor after close(); None until the first
+        #: logged frame acks (or when the mutation log is off).
+        self.acked_offset: Optional[int] = None
         self.thread = threading.Thread(target=self._drain, daemon=True)
         self.thread.start()
 
-    def submit(self, typ, payload, codec) -> Dict[str, Any]:
-        rec: Dict[str, Any] = {"done": threading.Event()}
+    def submit(self, typ, payload, codec,
+               offset: Optional[int] = None) -> Dict[str, Any]:
+        """Enqueue one frame; ``offset`` is its mutation-log END
+        offset (None when the frame was not logged — stats fan-outs,
+        HA_STATE announcements, or the log is off)."""
+        rec: Dict[str, Any] = {"done": threading.Event(),
+                               "mutlog_off": offset}
         with self._lk:
             if self._closed:
                 rec["error"] = (f"{self.addr}: follower link closed "
@@ -213,15 +229,22 @@ class _FollowerLink:
                 # evicted mid-queue: items behind the failed one must
                 # fail fast, NOT re-dial the dead follower (the client
                 # would reconnect with no timeout and could hang this
-                # thread forever, un-abortable — the link is done)
+                # thread forever, un-abortable — the link is done).
+                # Each such frame never reached the follower — counted
+                # so operators see the divergence depth before the
+                # resync closes it (COLLECT_STATS mirror section).
+                obs.REGISTRY.counter("serve.mirror_dropped").inc()
                 rec["error"] = (f"{self.addr}: follower link closed "
                                 f"(evicted) — frame not forwarded")
                 rec["done"].set()
                 continue
             try:
                 rec["reply"] = self.client._request(typ, payload, codec)
+                if rec.get("mutlog_off") is not None:
+                    self.acked_offset = rec["mutlog_off"]
             except Exception as e:  # noqa: BLE001 — surfaced by caller
                 rec["error"] = (f"{self.addr}: {type(e).__name__}: {e}")
+                rec["exc"] = e  # typed inspection (NotLeader fencing)
             finally:
                 rec["done"].set()
 
@@ -371,6 +394,31 @@ class _IdempotencyCache:
             ev = self._inflight.pop(token, None)
         if ev is not None:
             ev.set()
+
+    def alias(self, token: str, target: str) -> bool:
+        """Finish ``token`` with ``target``'s cached reply — the
+        follower half of the TOKEN_ALIAS frame: a coalesce WAITER's
+        token maps onto its leader's mirrored execution, so the
+        waiter's post-failover retry dedupes here instead of
+        re-executing. False when ``target`` is unknown (the alias
+        outran or outlived the mirrored execution's cached reply —
+        the retry then degrades to re-execution, never divergence)."""
+        with self._mu:
+            result = self._done.get(target)
+            if result is not None:
+                self._done.move_to_end(target)
+            else:
+                result = self._load_persisted(target)
+            if result is None:
+                return False
+            self._done[token] = result
+            self._persist(token, result)
+            while len(self._done) > self._capacity:
+                self._done.popitem(last=False)
+            ev = self._inflight.pop(token, None)
+        if ev is not None:
+            ev.set()
+        return True
 
     def prune(self) -> None:
         """Drop the oldest persisted tokens beyond ``capacity`` — runs
@@ -558,6 +606,7 @@ class ServeController:
                  resync_grace_s: float = 30.0,
                  resync_timeout_s: float = 120.0,
                  workers: Optional[list] = None,
+                 ha_peers: Optional[list] = None,
                  chaos=None, follower_chaos=None):
         """``followers``: addresses of worker daemons (one per other
         jax.distributed process). Every state-mutating/job frame this
@@ -598,7 +647,14 @@ class ServeController:
         * ``chaos``/``follower_chaos`` — explicit
           :class:`~netsdb_tpu.serve.chaos.ChaosInjector` objects for
           the client-facing and the leader→follower frame paths
-          (tests only; production pays one ``is None`` check)."""
+          (tests only; production pays one ``is None`` check).
+
+        ``ha_peers``: the ordered succession list arming automatic
+        failover (``serve/ha.py``) — index 0 is the initial leader,
+        every daemon in the pool passes the SAME list. Armed at the
+        end of :meth:`start` (equivalently: call :meth:`arm_ha` after
+        start). Orthogonal to ``followers``/``workers``: HA decides
+        WHO leads; the mirror stream is still what carries the data."""
         self.config = config
         self.host = host
         self.port = port
@@ -638,11 +694,35 @@ class ServeController:
         # __shard__ marker and SHARD_RESYNC, read on every routed frame)
         self._shard_sets: Dict[Tuple[str, str], Dict[str, int]] = {}
         self._shard_mu = TrackedLock("ServeController._shard_mu")
+        # --- HA runtime (serve/ha.py) ---------------------------------
+        # armed by arm_ha() / the ha_peers ctor list; None keeps every
+        # single-daemon and plain-mirror path byte-identical
+        self._ha: Optional[_ha.HAState] = None
+        self._ha_monitor: Optional[_ha.HAMonitor] = None
+        self._ha_peers: list = list(ha_peers or [])
+        # per-follower mutation-log resume offsets: the END offset of
+        # the last frame each (possibly former) follower is known to
+        # have applied — written at eviction (link.acked_offset) and
+        # after every resync; guarded by _followers_mu
+        self._follower_offsets: Dict[str, int] = {}
+        # durable mutation log (config.ha_mutlog): the mirror path
+        # appends every forwarded frame, so a readmitted follower
+        # resyncs by log REPLAY from its last applied offset instead
+        # of a whole-store snapshot; `spill` is the handoff buffer's
+        # disk shadow — buffered routed ingest survives leader restart
+        self.mutlog: Optional[MutationLog] = None
+        spill: Optional[MutationLog] = None
+        if getattr(config, "ha_mutlog", False):
+            self.mutlog = MutationLog(os.path.join(
+                config.root_dir, "mutlog", "mirror.log"))
+            spill = MutationLog(os.path.join(
+                config.root_dir, "mutlog", "handoff.log"))
         # pool connections + handoff buffers + the scatter coordinator
         self.shards = _shard.ShardPool(
             self, handoff_max_bytes=getattr(config,
                                             "shard_handoff_bytes",
-                                            256 << 20))
+                                            256 << 20),
+            spill=spill)
         # inbound distributed-shuffle buckets (shard side)
         self._shuffle = _shard.ShuffleInbox()
         #: this daemon's pool identity — rewritten by start() once the
@@ -779,6 +859,10 @@ class ServeController:
         self._stop = threading.Event()
         self._listener: Optional[socket.socket] = None
         self._threads: list = []
+        # health/pool loop handles — promotion must be able to start
+        # them on a daemon that booted with neither role
+        self._health_thread: Optional[threading.Thread] = None
+        self._pool_thread: Optional[threading.Thread] = None
         # handler map keyed by frame type — PDBServer::registerHandler
         self.handlers: Dict[MsgType, Callable[[Any], Tuple[MsgType, Any]]] = {
             MsgType.PING: self._on_ping,
@@ -815,6 +899,8 @@ class ServeController:
             MsgType.SUBPLAN: self._on_subplan,
             MsgType.SHUFFLE_PUT: self._on_shuffle_put,
             MsgType.SHARD_RESYNC: self._on_shard_resync,
+            MsgType.HA_STATE: self._on_ha_state,
+            MsgType.TOKEN_ALIAS: self._on_token_alias,
         }
 
     # --- lifecycle ----------------------------------------------------
@@ -827,24 +913,220 @@ class ServeController:
         self._listener.listen(128)
         self.port = self._listener.getsockname()[1]
         self.advertise_addr = f"{self.host}:{self.port}"
+        if self.mutlog is not None:
+            # durable HA restart: reload the persisted placement map +
+            # spilled handoff buffer BEFORE serving any frame, so a
+            # restarted leader routes (and drains) exactly what it
+            # owned when it died
+            self._restore_ha_runtime()
         t = threading.Thread(target=self._accept_loop, daemon=True,
                              name="netsdb-serve-accept")
         t.start()
         self._threads.append(t)
         if (getattr(self.config, "obs_history_len", 120) or 0) >= 2:
             self.history.start()
-        if self._follower_addrs:
+        self._start_pool_threads()
+        if self._ha_peers:
+            self.arm_ha(self._ha_peers)
+        return self.port
+
+    def _start_pool_threads(self) -> None:
+        """(Re)start the follower-health and shard-pool-health loops
+        for whichever roles this daemon currently has. Idempotent —
+        called at start() and again by :meth:`_promote_self`, which
+        GRANTS roles to a daemon that booted with neither."""
+        if self._follower_addrs and (self._health_thread is None
+                                     or not self._health_thread.is_alive()):
             h = threading.Thread(target=self._health_loop, daemon=True,
                                  name="netsdb-serve-health")
             h.start()
+            self._health_thread = h
             self._threads.append(h)
-        if self._worker_addrs:
+        if self._worker_addrs and (self._pool_thread is None
+                                   or not self._pool_thread.is_alive()):
             s = threading.Thread(target=self._pool_health_loop,
                                  daemon=True,
                                  name="netsdb-serve-pool-health")
             s.start()
+            self._pool_thread = s
             self._threads.append(s)
-        return self.port
+
+    # --- HA: arming, promotion, durable restart -----------------------
+    def arm_ha(self, peers: list,
+               election_timeout_s: Optional[float] = None,
+               probe_interval_s: Optional[float] = None):
+        """Arm automatic failover over the ordered succession list
+        ``peers`` (index 0 = initial leader; this daemon's
+        ``advertise_addr`` must appear in it). Call after
+        :meth:`start` so the advertised address carries the real
+        bound port. Returns the live :class:`~netsdb_tpu.serve.ha.HAState`."""
+        if election_timeout_s is None:
+            election_timeout_s = getattr(
+                self.config, "ha_election_timeout_s", 5.0)
+        self._ha = _ha.HAState(
+            self.advertise_addr, list(peers),
+            state_dir=os.path.join(self.config.root_dir, "ha"))
+        self._ha_monitor = _ha.HAMonitor(
+            self, self._ha, election_timeout_s,
+            probe_interval_s=probe_interval_s)
+        self._ha_monitor.start()
+        return self._ha
+
+    def _promote_self(self) -> None:
+        """Follower → leader, called by the HA monitor once every
+        earlier succession peer stayed dead through the election
+        window. Mints the new term (fencing every straggler from the
+        deposed leader), adopts the replicated placement map with the
+        dead leader's slots rebound to THIS daemon, adopts the LATER
+        succession peers as mirror followers, and replicates the new
+        epoch so routed clients re-point after exactly one typed
+        ``PlacementStale``."""
+        ha = self._ha
+        if ha is None or ha.role == _ha.LEADER:
+            return
+        old_leader = ha.leader_addr
+        term = ha.promote()
+        wire = ha.placement_wire()
+        if wire and (wire.get("sets") or {}):
+            self.placement.restore(wire)
+        if old_leader and old_leader != self.advertise_addr:
+            self.placement.rebind_addr(old_leader, self.advertise_addr)
+        later = list(ha.later_peers())
+        with self._followers_mu:
+            self._follower_addrs = list(later)
+        # shard daemons named by the map (minus self and the corpse)
+        # become this leader's pool; their health loop starts below
+        pool = set()
+        for ident in self.placement.sets():
+            entry = self.placement.entry(*ident)
+            for slot in (entry or {}).get("slots", ()):
+                pool.add(slot["addr"])
+        pool.discard(self.advertise_addr)
+        if old_leader:
+            pool.discard(old_leader)
+        for addr in sorted(pool):
+            if addr not in self._worker_addrs:
+                self._worker_addrs.append(addr)
+        self._start_pool_threads()
+        if self._worker_addrs:
+            self._push_epochs()
+        try:
+            # eagerly dial the adopted followers (bounded — a dead
+            # later peer degrades and reattaches on the normal path)
+            self._ensure_followers(
+                timeout_s=min(self.heartbeat_timeout_s, 5.0))
+        except FollowerDegraded as e:
+            del e  # degraded peers reattach via the health loop
+        self._replicate_placement()
+        from netsdb_tpu.utils.profiling import get_logger
+
+        get_logger("netsdb_tpu.serve").warning(
+            "promoted %s to leader (term %d, deposed %s)",
+            self.advertise_addr, term, old_leader)
+
+    def _restore_ha_runtime(self) -> None:
+        """Durable-restart half of ``ha_mutlog``: reload the persisted
+        placement map (rebinding this daemon's possibly-changed
+        advertise address) and the spilled handoff buffer, then mark
+        the still-absent shard owners degraded so the pool health loop
+        readmits them and DRAINS the restored buffer."""
+        stored = self._load_placement()
+        if stored:
+            wire = stored.get("wire") or {}
+            if wire.get("sets"):
+                self.placement.restore(wire)
+                old_addr = stored.get("advertise_addr")
+                if old_addr and old_addr != self.advertise_addr:
+                    self.placement.rebind_addr(old_addr,
+                                               self.advertise_addr)
+        pending = self.shards.load_spill()
+        if pending:
+            owners = set()
+            for ident in self.placement.sets():
+                entry = self.placement.entry(*ident)
+                for slot in (entry or {}).get("slots", ()):
+                    if slot.get("state") == _placement.HANDOFF \
+                            and slot["addr"] != self.advertise_addr:
+                        owners.add(slot["addr"])
+            for addr in sorted(owners):
+                self.shards.note_degraded(
+                    addr, "handoff pending across leader restart")
+
+    def _placement_path(self) -> str:
+        return os.path.join(self.config.root_dir, "ha",
+                            "placement.json")
+
+    def _save_placement(self) -> None:
+        """Best-effort durable copy of the placement map (only when
+        the mutation log is on — the durability opt-in). Atomic
+        tmp+replace; a failed save degrades to snapshot-era behavior,
+        never a crash on the ingest path."""
+        if self.mutlog is None:
+            return
+        import json
+
+        path = self._placement_path()
+        try:
+            os.makedirs(os.path.dirname(path), exist_ok=True)
+            tmp = path + ".tmp"
+            with open(tmp, "w", encoding="utf-8") as f:
+                json.dump({"advertise_addr": self.advertise_addr,
+                           "wire": self.placement.to_wire()}, f)
+            os.replace(tmp, path)
+        except OSError as e:
+            del e  # best-effort: an unsaved map degrades the NEXT
+            pass   # restart to snapshot-era recovery, never this frame
+
+    def _load_placement(self) -> Optional[Dict[str, Any]]:
+        import json
+
+        try:
+            with open(self._placement_path(), "r", encoding="utf-8") as f:
+                return json.load(f)
+        except (OSError, ValueError):
+            return None
+
+    def _ha_state_payload(self) -> Dict[str, Any]:
+        snap = self._ha.snapshot()
+        return {"term": snap["term"], "leader": snap["leader"],
+                "placement": self.placement.to_wire()}
+
+    def _replicate_placement(self) -> None:
+        """Ship the current (term, leader, placement) to every active
+        follower — called on every epoch bump so a promoted leader
+        serves routed ingest from the instant it wins, without a
+        discovery scan. Fire-and-forget through the FIFO links: the
+        map rides the same ordered stream as the data it describes."""
+        self._save_placement()
+        if self._ha is None or self._ha.role != _ha.LEADER:
+            return
+        payload = self._ha_state_payload()
+        with self._followers_mu:
+            links = list(self._links.values())
+        for link in links:
+            link.submit(MsgType.HA_STATE, dict(payload), CODEC_MSGPACK)
+
+    def _send_token_alias(self, alias: str, target: str) -> None:
+        """Ship one waiter-token → leader-token alias to every active
+        follower (satellite of the coalesce/failover contract). Sent
+        AFTER the leader's mirrored execution acked, through the same
+        FIFO links — so the target token's reply is already cached on
+        the follower when the alias lands. Bounded wait; a miss
+        degrades that follower to re-execution on retry, never
+        divergence."""
+        payload: Dict[str, Any] = {"alias": alias, "target": target}
+        if self._ha is not None:
+            payload[HA_TERM_KEY] = self._ha.term
+        if self.mutlog is not None:
+            self.mutlog.append({"op": "alias", "alias": alias,
+                                "target": target})
+        with self._followers_mu:
+            pending = [link.submit(MsgType.TOKEN_ALIAS, dict(payload),
+                                   CODEC_MSGPACK)
+                       for link in self._links.values()]
+        deadline = deadline_after(self.heartbeat_timeout_s)
+        for rec in pending:
+            rec["done"].wait(max(seconds_left(deadline), 0.0))
 
     def serve_forever(self) -> None:
         if self._listener is None:
@@ -873,6 +1155,8 @@ class ServeController:
             link.close()
         self.shards.close()
         self._idem.close()
+        if self.mutlog is not None:
+            self.mutlog.close()
         if self._listener is not None:
             try:
                 self._listener.close()
@@ -1159,6 +1443,11 @@ class ServeController:
 
         token = payload.pop(IDEMPOTENCY_KEY, None) \
             if isinstance(payload, dict) else None
+        # the sender's HA term (mirrored frames, handoff drains, log
+        # replays): popped here so handlers never see it, fenced in
+        # _execute_frame against the receiver's own term
+        term = payload.pop(HA_TERM_KEY, None) \
+            if isinstance(payload, dict) else None
         try:
             if token is not None:
                 cached = self._idem.claim(token, wait_s=self.frame_timeout_s)
@@ -1172,7 +1461,7 @@ class ServeController:
                           "serve"):
                 out = self._execute_frame(typ, payload, codec_in, token,
                                           qid=qid, client=client,
-                                          lane=lane)
+                                          lane=lane, term=term)
             if inspect.isgenerator(out):
                 # streaming handler: each yielded (type, payload
                 # [, codec]) goes out as its own frame; TCP
@@ -1272,7 +1561,7 @@ class ServeController:
             auto=True)
 
     def _execute_frame(self, typ, payload, codec_in, token, qid=None,
-                       client=None, lane=None):
+                       client=None, lane=None, term=None):
         """Run one request's handler with the idempotency-token
         lifecycle (the caller has already claimed ``token``). Returns a
         generator (streaming handlers) or the normalized ``(type,
@@ -1304,6 +1593,17 @@ class ServeController:
         try:
             if handler is None:
                 raise ProtocolError(f"no handler for {typ!r}")
+            if self._ha is not None:
+                if term is not None:
+                    # peer-originated frame (mirror/drain/replay): a
+                    # STALE term means a deposed leader's straggler —
+                    # reject typed, never double-apply
+                    self._ha.observe_term(term)
+                elif typ in self.MIRRORED:
+                    # client-originated mutation: only the leader
+                    # accepts; the typed NotLeader carries the
+                    # current leader's address for rediscovery
+                    self._ha.check_client_write()
 
             def invoke():
                 if self._follower_addrs and typ in self.MIRRORED:
@@ -1317,9 +1617,12 @@ class ServeController:
                 with obs.attrib.client_context(client), \
                         _sched.lane_context(lane):
                     if typ in self.COALESCED_FRAMES:
+                        winfo: Dict[str, Any] = {}
                         out = self.sched.coalesced(typ, payload,
-                                                   invoke)
+                                                   invoke, token=token,
+                                                   waiter_info=winfo)
                     else:
+                        winfo = None
                         out = invoke()
             finally:
                 _idem_token_var.reset(tok_reset)
@@ -1351,6 +1654,13 @@ class ServeController:
         result = self._normalize_reply(out)
         if token is not None:
             self._idem.finish(token, result)
+            # coalesce WAITER absorbed by another flight: its token
+            # finished HERE but followers only saw the leader's —
+            # ship the alias so the waiter's post-failover retry
+            # still dedupes (the PR 9 at-most-once gap)
+            ltok = winfo.get("leader_token") if winfo else None
+            if ltok and ltok != token and self._follower_addrs:
+                self._send_token_alias(token, ltok)
         return result
 
     @staticmethod
@@ -1425,6 +1735,12 @@ class ServeController:
                 # race where the epoch moves mid-conversation)
                 self._shard_route(meta.get("db"), meta.get("set"),
                                   meta.get("pepoch"), meta.get("slot"))
+            if self._ha is not None and op in self.MIRRORED \
+                    and HA_TERM_KEY not in (p or {}):
+                # leadership gate at BEGIN, same rationale as the
+                # epoch gate: a demoted daemon must bounce the client
+                # BEFORE it streams gigabytes, not at COMMIT
+                self._ha.check_client_write()
             self._send_reply(conn, MsgType.OK, {"go": True})
             total_in = 0
             while True:
@@ -1547,14 +1863,21 @@ class ServeController:
         leader checkpoint before readmitting it. Idempotent."""
         with self._followers_mu:
             link = self._links.pop(addr, None)
+            if link is not None and link.acked_offset is not None:
+                # the log-replay resume position: everything at or
+                # before this END offset is applied on that follower
+                self._follower_offsets[addr] = link.acked_offset
             self._degraded[addr] = reason
         if link is not None:
             link.close(abort=True)
 
     def follower_status(self) -> Dict[str, Any]:
         with self._followers_mu:
-            return {"active": sorted(self._links),
-                    "degraded": dict(self._degraded)}
+            out = {"active": sorted(self._links),
+                   "degraded": dict(self._degraded)}
+        out["mirror_dropped"] = int(
+            obs.REGISTRY.counter("serve.mirror_dropped").value)
+        return out
 
     # --- sharded worker pool (horizontal scale-out) -------------------
     def is_sharded(self, db: str, set_name: str) -> bool:
@@ -1720,6 +2043,7 @@ class ServeController:
                 self.shards.drain_handoff(addr)
             self.shards.clear_degraded(addr)
             obs.REGISTRY.counter("shard.readmits").inc()
+            self._replicate_placement()
             return True
         except Exception as e:  # noqa: BLE001 — re-degraded, retried
             self.shards.degrade(addr, f"readmit failed: "
@@ -1820,7 +2144,16 @@ class ServeController:
         except OSError:
             return False
         try:
-            self._resync_follower(addr, fc)
+            with self._followers_mu:
+                offset = self._follower_offsets.get(addr)
+            if self.mutlog is not None and offset is not None \
+                    and offset <= self.mutlog.last_offset():
+                # log replay: re-send only the frames this follower
+                # missed since its last ack — minutes of divergence
+                # costs kilobytes, not a whole-store snapshot
+                self._resync_follower_log(addr, fc, offset)
+            else:
+                self._resync_follower(addr, fc)
             return True
         except Exception as e:  # noqa: BLE001 — recorded, retried later
             fc.close()
@@ -1862,13 +2195,76 @@ class ServeController:
                 # for minutes) — so the readmitted link gets a fresh
                 # unbounded-reply connection
                 fc.close()
+                if self.mutlog is not None:
+                    # the snapshot captures everything up to HERE in
+                    # the log (we hold the exclusive order — no frame
+                    # can append concurrently); a later eviction of
+                    # this follower resumes replay from this offset
+                    off = self.mutlog.last_offset()
+                    with self._followers_mu:
+                        self._follower_offsets[addr] = off
+                    checkpoint.save_meta(root, step,
+                                         {"mutlog_offset": off})
                 link_client = self._dial_follower(addr)
                 with self._followers_mu:
                     self._degraded.pop(addr, None)
-                    self._links[addr] = _FollowerLink(addr, link_client)
+                    link = self._links[addr] = _FollowerLink(
+                        addr, link_client)
+                if self._ha is not None \
+                        and self._ha.role == _ha.LEADER:
+                    # the readmitted follower may have missed epochs
+                    # (or a whole term) — re-announce on its fresh link
+                    link.submit(MsgType.HA_STATE,
+                                self._ha_state_payload(), CODEC_MSGPACK)
                 checkpoint.prune_steps(root, keep=1)
                 self._idem.prune()  # same disk-bounding moment: old
                 # persisted idempotency tokens go with old snapshots
+        finally:
+            self._order.release_write()
+            self._resync_idle.set()
+
+    def _resync_follower_log(self, addr: str, fc, offset: int) -> None:
+        """Log-replay readmission (``ha_mutlog``): re-send every
+        mutation-log frame past ``offset`` to the reattached follower,
+        then readmit it — the snapshot's store-equality argument holds
+        because the replay runs under the same exclusive frame order
+        (nothing can append between 'replay bound captured' and 'link
+        installed'). Each replayed frame carries a deterministic
+        fallback idempotency token (``mutlog-<end>``) so a frame the
+        follower DID apply before dying dedupes instead of
+        double-applying, and the CURRENT term so a deposed leader's
+        replay is rejected typed."""
+        self._resync_idle.clear()
+        self._order.acquire_write()
+        try:
+            with self._collective_lock:
+                bound = self.mutlog.last_offset()
+                for end, rec in self.mutlog.replay(offset):
+                    if rec.get("op") == "alias":
+                        fc._request(MsgType.TOKEN_ALIAS,
+                                    {"alias": rec["alias"],
+                                     "target": rec["target"]},
+                                    CODEC_MSGPACK)
+                        continue
+                    if rec.get("op") != "frame":
+                        continue
+                    payload = dict(rec["payload"])
+                    payload.setdefault(IDEMPOTENCY_KEY, f"mutlog-{end}")
+                    if self._ha is not None:
+                        payload[HA_TERM_KEY] = self._ha.term
+                    fc._request(MsgType(rec["typ"]), payload,
+                                rec.get("codec", CODEC_PICKLE))
+                fc.close()
+                link_client = self._dial_follower(addr)
+                with self._followers_mu:
+                    self._degraded.pop(addr, None)
+                    self._follower_offsets[addr] = bound
+                    link = self._links[addr] = _FollowerLink(
+                        addr, link_client)
+                if self._ha is not None \
+                        and self._ha.role == _ha.LEADER:
+                    link.submit(MsgType.HA_STATE,
+                                self._ha_state_payload(), CODEC_MSGPACK)
         finally:
             self._order.release_write()
             self._resync_idle.set()
@@ -2088,7 +2484,7 @@ class ServeController:
         lane = _sched.current_lane()  # the frame's hint, if any —
         # followers admit their mirrored copy through the same lane
         if token is not None or qid is not None or client is not None \
-                or lane is not None:
+                or lane is not None or self._ha is not None:
             fwd = dict(payload)
             if token is not None:
                 fwd[IDEMPOTENCY_KEY] = token
@@ -2098,15 +2494,46 @@ class ServeController:
                 fwd[CLIENT_ID_KEY] = client
             if lane is not None:
                 fwd[LANE_KEY] = lane
+            if self._ha is not None:
+                # every mirrored frame is fenced by the sender's term:
+                # a follower that adopted a newer leader rejects this
+                # straggler typed instead of double-applying it
+                fwd[HA_TERM_KEY] = self._ha.term
         with self._mirror_lock:  # short: dial + ordered enqueue only
             self._ensure_followers()
+            offset = None
+            if self.mutlog is not None:
+                # append INSIDE the enqueue lock: log order == every
+                # FIFO link's frame order, so "replay from offset N"
+                # reconstructs exactly the stream a follower missed
+                offset = self.mutlog.append(
+                    {"op": "frame", "typ": int(typ), "codec": codec,
+                     "payload": fwd})
             with self._followers_mu:
-                pending = [(addr, link.submit(typ, fwd, codec))
+                pending = [(addr, link.submit(typ, fwd, codec,
+                                              offset=offset))
                            for addr, link in self._links.items()]
         try:
             out = handler(payload)
         finally:
-            failures = self._collect_mirror_failures(pending)
+            failures, deposed = self._collect_mirror_failures(pending)
+        if deposed is not None:
+            # a follower answered NotLeader: it adopted a NEWER term —
+            # this daemon was deposed while the frame was in flight.
+            # Step down (keeping the follower: its link is healthy and
+            # the new leader owns resyncing it) and bounce the client
+            # to the real leader. The locally-applied copy is private
+            # divergence — wiped when this daemon rejoins as a
+            # follower and resyncs; the client's retry executes on
+            # the real leader, exactly once in authoritative history.
+            addr, exc = deposed
+            self._ha.step_down(getattr(exc, "term", None),
+                               getattr(exc, "leader_addr", None))
+            raise NotLeader(
+                f"this daemon was deposed mid-mirror ({addr} rejected "
+                f"the frame: {exc}); retry against the current leader",
+                leader_addr=getattr(exc, "leader_addr", None),
+                term=self._ha.term)
         if failures:
             exc = FollowerDegraded(
                 "mirror failed; follower(s) evicted for resync: "
@@ -2115,16 +2542,23 @@ class ServeController:
             raise exc
         return out
 
-    def _collect_mirror_failures(self, pending) -> list:
+    def _collect_mirror_failures(self, pending) -> Tuple[list, Any]:
         """Wait (bounded) for every follower ack; evict the ones that
         errored or hung. ONE shared deadline covers the whole frame —
         three hung followers cost one timeout, not three stacked. The
         ack-timeout eviction aborts the link's socket, so its drain
         thread unblocks — a hung follower can never wedge the leader's
-        handler thread."""
+        handler thread.
+
+        Returns ``(failures, deposed)``: ``deposed`` is ``(addr,
+        NotLeaderError)`` when a follower rejected the frame because
+        it follows a NEWER term — that is a fencing verdict on THIS
+        daemon, not a follower fault, so the follower is NOT
+        evicted."""
         deadline = (deadline_after(self.mirror_ack_timeout_s)
                     if self.mirror_ack_timeout_s is not None else None)
         failures = []
+        deposed = None
         for addr, rec in pending:
             left = (max(0.0, seconds_left(deadline))
                     if deadline is not None else None)
@@ -2136,9 +2570,15 @@ class ServeController:
                     addr, f"mirror ack timeout "
                           f"({self.mirror_ack_timeout_s}s)")
             elif rec.get("error"):
+                exc = rec.get("exc")
+                if self._ha is not None \
+                        and isinstance(exc, NotLeaderError):
+                    if deposed is None:
+                        deposed = (addr, exc)
+                    continue
                 failures.append((addr, rec["error"]))
                 self._evict_follower(addr, rec["error"])
-        return failures
+        return failures, deposed
 
     # --- job bookkeeping ----------------------------------------------
     def _run_job(self, job_name: str, fn: Callable[[], Any],
@@ -2198,7 +2638,33 @@ class ServeController:
                "sets": len(self.library.store.list_sets())}
         if self._follower_addrs:
             out["followers"] = self.follower_status()
+        if self._ha is not None:
+            # the probe doubles as leader discovery: a follower's ping
+            # reply names who IT believes leads, and the HA monitor's
+            # liveness check reads the role straight off this
+            out["ha"] = self._ha.snapshot()
         return MsgType.OK, out
+
+    def _on_ha_state(self, p):
+        """Leader → follower state announcement (term, leader address,
+        placement map) — shipped through the ordered mirror links on
+        every epoch bump and on arming, so a promoted follower already
+        HOLDS the routing map the instant it wins an election."""
+        if self._ha is None:
+            return MsgType.OK, {"armed": False}
+        self._ha.adopt_leader(p.get("leader"), int(p.get("term") or 0))
+        placement = p.get("placement")
+        if placement:
+            self._ha.store_placement(placement)
+        return MsgType.OK, self._ha.snapshot()
+
+    def _on_token_alias(self, p):
+        """Leader → follower: finish a coalesce WAITER's idempotency
+        token with its leader-token's cached reply (the frame rides
+        the same FIFO link as the mirrored execution, so the target is
+        already cached when this lands)."""
+        ok = self._idem.alias(str(p["alias"]), str(p["target"]))
+        return MsgType.OK, {"aliased": bool(ok)}
 
     def _on_create_database(self, p):
         self.library.create_database(p["db"])
@@ -2277,6 +2743,7 @@ class ServeController:
                     f"partitioned create of {p['db']}:{p['set']} "
                     f"failed mid-fanout ({type(e).__name__}: {e}); "
                     f"placement rolled back — retry") from e
+            self._replicate_placement()
             return MsgType.OK, {"placement": entry}
         self.library.create_set(
             p["db"], p["set"], type_name=p.get("type_name", "tensor"),
@@ -2314,6 +2781,7 @@ class ServeController:
     def _on_remove_set(self, p):
         if self._fanout_sharded_ddl(MsgType.REMOVE_SET, p):
             self.placement.remove(p["db"], p["set"])
+            self._replicate_placement()
         # bytes-accounting hygiene: any buffered handoff for the set
         # dies with it (unreachable once the placement entry is gone)
         self.shards.purge_handoff(p["db"], p["set"])
@@ -2986,6 +3454,13 @@ class ServeController:
                "cache": self.library.store.stats.as_dict(),
                "device_cache": self.library.store.device_cache().stats(),
                "metrics": obs.REGISTRY.snapshot()}
+        if self._follower_addrs:
+            # the mirror section: active/degraded links plus the
+            # silently-dropped-frame count (satellite of the HA work —
+            # an abort-closed link's queued frames now surface here)
+            out["mirror"] = self.follower_status()
+        if self._ha is not None:
+            out["ha"] = self._ha.snapshot()
         if not p.get("local_only"):
             followers = self._fanout_read(MsgType.COLLECT_STATS,
                                           {"local_only": True})
@@ -3194,19 +3669,22 @@ def run_daemon(config: Configuration, host: str = "127.0.0.1",
                port: int = 8108, token: Optional[str] = None,
                max_jobs: Optional[int] = None,
                followers: Optional[list] = None,
-               workers: Optional[list] = None) -> int:
+               workers: Optional[list] = None,
+               ha_peers: Optional[list] = None) -> int:
     """Start a daemon and block until shutdown — shared by the CLI
     ``serve`` subcommand and :func:`main`. ``followers``: worker-daemon
     addresses for multi-host fan-out (one per other jax.distributed
     process; call ``parallel.distributed.initialize_cluster`` first).
     ``workers``: shard-daemon addresses forming this leader's
     partitioned pool (horizontal scale-out — plain daemons, no
-    jax.distributed requirement)."""
+    jax.distributed requirement). ``ha_peers``: the ordered succession
+    list arming automatic failover (index 0 = initial leader; pass the
+    SAME list to every daemon in the pool)."""
     from netsdb_tpu.utils.profiling import get_logger
 
     ctl = ServeController(config, host=host, port=port, token=token,
                           max_jobs=max_jobs, followers=followers,
-                          workers=workers)
+                          workers=workers, ha_peers=ha_peers)
     bound = ctl.start()
     get_logger("netsdb_tpu.serve", level="INFO").info(
         "netsdb_tpu serving on %s:%s", host, bound)
@@ -3233,15 +3711,22 @@ def main(argv=None) -> int:
                     help="comma-separated shard daemon addresses "
                          "forming this leader's partitioned worker "
                          "pool (horizontal scale-out)")
+    ap.add_argument("--ha-peers", default=None,
+                    help="comma-separated ORDERED succession list for "
+                         "automatic failover (index 0 = initial "
+                         "leader; pass the same list to every daemon)")
     args = ap.parse_args(argv)
     config = Configuration(root_dir=args.root) if args.root else DEFAULT_CONFIG
     followers = ([a.strip() for a in args.followers.split(",") if a.strip()]
                  if args.followers else None)
     workers = ([a.strip() for a in args.workers.split(",") if a.strip()]
                if args.workers else None)
+    ha_peers = ([a.strip() for a in args.ha_peers.split(",") if a.strip()]
+                if args.ha_peers else None)
     return run_daemon(config, host=args.host, port=args.port,
                       token=args.token, max_jobs=args.max_jobs,
-                      followers=followers, workers=workers)
+                      followers=followers, workers=workers,
+                      ha_peers=ha_peers)
 
 
 if __name__ == "__main__":

@@ -49,6 +49,7 @@ from netsdb_tpu.serve.protocol import (
     CLIENT_ID_KEY,
     CODEC_MSGPACK,
     CODEC_PICKLE,
+    HA_TERM_KEY,
     IDEMPOTENCY_KEY,
     PLACEMENT_EPOCH_KEY,
     QUERY_ID_KEY,
@@ -416,7 +417,8 @@ class ShardPool:
     purely as the peer-connection cache the distributed shuffle
     dials through."""
 
-    def __init__(self, ctl, handoff_max_bytes: int = 256 << 20):
+    def __init__(self, ctl, handoff_max_bytes: int = 256 << 20,
+                 spill=None):
         self.ctl = ctl
         self._mu = TrackedLock("serve.ShardPool._mu")
         self._clients: Dict[str, Any] = {}
@@ -428,6 +430,11 @@ class ShardPool:
             = {}
         self._handoff_bytes = 0
         self._handoff_max = int(handoff_max_bytes)
+        # the buffer's disk shadow (storage/mutlog.py, config.ha_mutlog):
+        # every put/drain/purge appends a record under _mu, so a leader
+        # restart replays the buffer via load_spill() instead of losing
+        # buffered routed ingest. None keeps the buffer memory-only.
+        self._spill = spill
 
     # --- connections --------------------------------------------------
     def client(self, addr: str):
@@ -508,6 +515,19 @@ class ShardPool:
             # the bump is leader-local until the surviving workers
             # re-register under it (best-effort push)
             self.ctl._push_epochs(exclude=(addr,))
+        # every membership change replicates (and persists, under
+        # ha_mutlog) the map — a follower promoted mid-outage must
+        # already know which slots are in handoff
+        self.ctl._replicate_placement()
+
+    def note_degraded(self, addr: str, reason: str) -> None:
+        """Record-only degrade (durable restart): the placement map
+        ALREADY holds the slot in handoff state — re-running the full
+        :meth:`degrade` would bump epochs a second time and invalidate
+        every client map for nothing. The pool health loop sees the
+        entry and runs the normal readmit + drain."""
+        with self._mu:
+            self._degraded.setdefault(addr, reason)
 
     def is_degraded(self, addr: str) -> bool:
         with self._mu:
@@ -553,6 +573,12 @@ class ShardPool:
                     slot=slot)
             self._handoff.setdefault(key, []).append(rec)
             self._handoff_bytes += nbytes
+            if self._spill is not None:
+                # appended under _mu: spill-record order == buffer
+                # order, so load_spill() reconstructs exact FIFO state
+                self._spill.append({"op": "put", "key": list(key),
+                                    "token": token,
+                                    "payload": dict(payload)})
         # close the buffer-vs-readmit race: if the slot flipped LIVE
         # while this frame was in flight, the readmit drain may
         # already have run — a batch inserted after its final sweep
@@ -572,6 +598,10 @@ class ShardPool:
                     self._handoff_bytes -= nbytes
                     if not cur:
                         self._handoff.pop(key, None)
+                    if self._spill is not None:
+                        self._spill.append({"op": "unput",
+                                            "key": list(key),
+                                            "token": token})
                     raise PlacementStale(
                         f"slot {slot} of {db}:{set_name} readmitted "
                         f"mid-buffer; re-route to the live shard",
@@ -605,6 +635,9 @@ class ShardPool:
                 dropped += len(gone)
                 self._handoff_bytes -= sum(self._payload_bytes(p)
                                            for _, p in gone)
+            if dropped and self._spill is not None:
+                self._spill.append({"op": "purge", "db": db,
+                                    "set": set_name})
         return dropped
 
     def drain_handoff(self, addr: str) -> int:
@@ -641,6 +674,11 @@ class ShardPool:
                         fwd[SHARD_SLOT_KEY] = i
                         if token:
                             fwd[IDEMPOTENCY_KEY] = token
+                        if getattr(self.ctl, "_ha", None) is not None:
+                            # drains are peer frames: a shard that
+                            # adopted a newer leader must fence a
+                            # deposed leader's drain, same as mirrors
+                            fwd[HA_TERM_KEY] = self.ctl._ha.term
                         self.peer_request(addr, MsgType.SEND_DATA,
                                           fwd, CODEC_PICKLE)
                         drained += 1
@@ -656,9 +694,63 @@ class ShardPool:
                             self._handoff[key] = rest
                         else:
                             self._handoff.pop(key, None)
+                        if self._spill is not None:
+                            self._spill.append(
+                                {"op": "drain", "key": list(key),
+                                 "n": len(batches)})
+                            if not self._handoff:
+                                # buffer fully empty: the spill's
+                                # history is dead weight — truncate so
+                                # it never grows without bound
+                                self._spill.truncate()
         if drained:
             obs.REGISTRY.counter("shard.handoff_drained").inc(drained)
         return drained
+
+    def load_spill(self) -> int:
+        """Rebuild the handoff buffer from the spill log (leader
+        restart under ``ha_mutlog``): replay put/unput/drain/purge in
+        order — the surviving suffix is exactly what was buffered and
+        undelivered when the daemon died. Returns the pending batch
+        count."""
+        if self._spill is None:
+            return 0
+        with self._mu:
+            self._handoff.clear()
+            self._handoff_bytes = 0
+            for _end, rec in self._spill.replay():
+                op = rec.get("op")
+                if op == "put":
+                    key = tuple(rec["key"])
+                    self._handoff.setdefault(key, []).append(
+                        (rec.get("token"), rec["payload"]))
+                elif op == "unput":
+                    key = tuple(rec["key"])
+                    cur = self._handoff.get(key, [])
+                    for j in range(len(cur) - 1, -1, -1):
+                        if cur[j][0] == rec.get("token"):
+                            cur.pop(j)
+                            break
+                    if not cur:
+                        self._handoff.pop(key, None)
+                elif op == "drain":
+                    key = tuple(rec["key"])
+                    cur = self._handoff.get(key, [])
+                    rest = cur[int(rec.get("n") or 0):]
+                    if rest:
+                        self._handoff[key] = rest
+                    else:
+                        self._handoff.pop(key, None)
+                elif op == "purge":
+                    for key in [k for k in self._handoff
+                                if k[0] == rec.get("db")
+                                and k[1] == rec.get("set")]:
+                        self._handoff.pop(key)
+            self._handoff_bytes = sum(
+                self._payload_bytes(p)
+                for batches in self._handoff.values()
+                for _, p in batches)
+            return sum(len(b) for b in self._handoff.values())
 
     # --- read fan-out (stats/trace/health shard sections) -------------
     def fanout(self, typ, payload) -> Dict[str, Any]:

@@ -189,6 +189,40 @@ def prune_steps(root: str, keep: int = 1) -> list:
     return victims
 
 
+_META_FILE = "meta.json"
+
+
+def save_meta(root: str, step: int, meta: dict) -> str:
+    """Attach a small JSON metadata sidecar to a snapshot step (the
+    serve layer records the mutation-log offset a snapshot captured,
+    so a restart knows where log replay resumes). Atomic via rename,
+    same as the blob itself."""
+    path = _step_dir(root, step)
+    os.makedirs(path, exist_ok=True)
+    final = os.path.join(path, _META_FILE)
+    tmp = final + ".tmp"
+    with open(tmp, "w", encoding="utf-8") as f:
+        json.dump(meta, f)
+    os.replace(tmp, final)
+    return final
+
+
+def load_meta(root: str, step: Optional[int] = None) -> Optional[dict]:
+    """Read a :func:`save_meta` sidecar (``step`` defaults to the
+    latest). None when the step has no sidecar — older snapshots
+    predate the convention."""
+    if step is None:
+        step = latest_step(root)
+        if step is None:
+            return None
+    final = os.path.join(_step_dir(root, step), _META_FILE)
+    try:
+        with open(final, "r", encoding="utf-8") as f:
+            return json.load(f)
+    except (OSError, ValueError):
+        return None
+
+
 def load_store(root: str, step: Optional[int] = None) -> Any:
     """Load a :func:`save_store` snapshot; ``step`` defaults to the
     latest under ``root``. Raises FileNotFoundError when absent."""
