@@ -134,6 +134,14 @@ class Configuration:
     # N caps it at the first N devices (the tier-1 virtual mesh tests
     # pin 4 of the suite's 8 host-platform devices)
     summa_participants: Optional[int] = None
+    # 2-d processor grid for SUMMA ("PRxPC", e.g. "2x2", or a (pr, pc)
+    # pair): operands whose BOTH dims exceed one host tile over the
+    # full grid — each device stages 1/(pr*pc) of A AND of B, with
+    # dual masked-psum broadcasts per step (arxiv 2112.09017 §III).
+    # None (default) keeps the 1-d row-dealt mesh. A grid that does
+    # not fit the visible device set falls back to 1-d; cached device
+    # blocks move between the layouts via parallel/reshard.py.
+    summa_grid: Optional[str] = None
     # derive the hot-prefix pin budget AUTOMATICALLY from the
     # attribution ledger's hot-set table on the scheduler-feedback
     # cadence (serve/sched/feedback.pin_budget — pinned formula),

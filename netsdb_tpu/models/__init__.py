@@ -6,11 +6,13 @@ from netsdb_tpu.models.conv2d import Conv2DModel
 from netsdb_tpu.models.ff import FFModel
 from netsdb_tpu.models.logreg import LogRegModel
 from netsdb_tpu.models.lstm_model import LSTMModel
+from netsdb_tpu.models.serving import ModelServing, ff_serving
 from netsdb_tpu.models.text_classifier import TextClassifierModel
 from netsdb_tpu.models.transformer import TransformerLayerModel
 from netsdb_tpu.models.word2vec import Word2VecModel
 
 __all__ = [
     "Conv2DModel", "FFModel", "LogRegModel", "LSTMModel",
-    "TextClassifierModel", "TransformerLayerModel", "Word2VecModel",
+    "ModelServing", "TextClassifierModel", "TransformerLayerModel",
+    "Word2VecModel", "ff_serving",
 ]

@@ -142,8 +142,24 @@ class FFModel:
         # ignore the fold entirely
         from netsdb_tpu.plan.fold import TensorFold
 
+        def _dense(v):
+            return np.asarray(v.to_dense()) \
+                if isinstance(v, BlockedTensor) else np.asarray(v)
+
+        # the SUMMA declarations (fn(block, x) == block @ rhs(x)) make
+        # both weight streams routable through the distributed engine
+        # under config.distributed_matmul — declared ONLY under full-
+        # precision compute: SUMMA's k-panel accumulation reassociates
+        # the contraction (exact for f32 HIGHEST over integer-valued
+        # operands, last-ulp for reduced precision epilogues)
         wfold = TensorFold(mode="rows",
-                           out_block=(self.block[0], self.block[0]))
+                           out_block=(self.block[0], self.block[0]),
+                           summa_rhs=(lambda x: _dense(x).T)
+                           if cd is None else None)
+        rfold = TensorFold(mode="rows",
+                           out_block=(self.block[0], self.block[0]),
+                           summa_rhs=(lambda y: _dense(y))
+                           if cd is None else None)
         # FFTransposeMult + FFAggMatrix: w1 · inputsᵀ → (hidden x batch)
         h = Join(w1, inputs, fn=lambda w, x: matmul_t(w, x, cd,
                                                       accum_dtype=cd),
@@ -154,7 +170,7 @@ class FFModel:
                   label="FFReluBiasSum")
         # FFInputLayerJoin + FFAggMatrix: wo · y1 → (labels x batch)
         yo_lin = Join(wo, y1, fn=lambda w, y: matmul(w, y, cd),
-                      label="FFInputLayerJoin", tensor_fold=wfold)
+                      label="FFInputLayerJoin", tensor_fold=rfold)
         # FFTransposeBiasSum → FFRowAggregate → FFOutputLayer, fused
         out = Join(yo_lin, bo,
                    fn=lambda y, b: nn_ops.ff_output_layer(y, b, axis=0),

@@ -113,6 +113,17 @@ def _catalog() -> Dict[str, Tuple[str, str]]:
                             "(slots flip to handoff, epochs bump)"),
         ("shard.readmits", "shard daemons readmitted after a "
                            "shard-scoped resync"),
+        ("shard.analyze_fanouts", "ANALYZE_SET requests fanned out "
+                                  "over a partitioned set's slots and "
+                                  "merged (rows sum, min/max envelope, "
+                                  "dict union)"),
+        ("models.deploys", "model-as-blocked-sets deployments over a "
+                           "serving pool (weights mirrored to every "
+                           "member)"),
+        ("models.batches_scored", "scoring frames executed over the "
+                                  "serving pool"),
+        ("models.rows_scored", "batch rows scored over the serving "
+                               "pool (the rows/s headline numerator)"),
         ("sched.feedback_reseeds", "lane weight/quota reseeds applied "
                                    "from the attribution + operator "
                                    "ledgers (sched_feedback)"),
@@ -145,6 +156,15 @@ def _catalog() -> Dict[str, Tuple[str, str]]:
         ("summa.staged_bytes", "operand bytes staged host->device by "
                                "SUMMA runs (sum over participants; "
                                "~1/N of operand bytes per host)"),
+        ("summa.grid_rounds", "2-d grid SUMMA round programs "
+                              "dispatched (one per pr-block batch)"),
+        ("summa.grid_steps", "dual-broadcast steps executed by 2-d "
+                             "grid SUMMA rounds (pr*pc per round)"),
+        ("summa.grid_panel_bcasts", "A and B slices broadcast over the "
+                                    "grid axes (2 per grid step)"),
+        ("summa.grid_staged_bytes", "operand bytes staged host->device "
+                                    "by 2-d grid SUMMA runs (~1/(pr*pc) "
+                                    "of each operand per device)"),
         ("reshard.plans", "collective-step reshard schedules planned"),
         ("reshard.steps", "collective steps executed by reshards "
                           "(all_gather / all_to_all / local_slice / "

@@ -296,6 +296,199 @@ class ReshardReport:
         return [s.label() for s in self.steps]
 
 
+def _move_tensor_entry(val, steps: List[Step], src_placement,
+                       dst_placement):
+    """Run one cached tensor-stream entry through a schedule. Entries
+    are the tuples the stream's ``place`` produced — ``(n, block)``
+    for "trows", ``(start, block)`` for "treduce" — so only the 2-d
+    array element moves; the bookkeeping scalars ride along."""
+    out = []
+    for el in (val if isinstance(val, (tuple, list)) else (val,)):
+        if getattr(el, "ndim", None) == 2:
+            el = execute_steps(el, steps, src_placement, dst_placement)
+        out.append(el)
+    if isinstance(val, (tuple, list)):
+        return tuple(out)
+    return out[0]
+
+
+def _reshard_paged_tensor(store, ident, pm, src_placement,
+                          dst_placement, report: ReshardReport) -> None:
+    """The paged-TENSOR leg of :func:`reshard_set` (the ROADMAP
+    carry-over: tables-only before this): the set's device-cached
+    stream blocks — "trows"/"treduce" entries under the OLD
+    placement's label — are invalidated through the dirty-range path,
+    run through the collective schedule one block at a time, and
+    installed under the NEW placement's key, so a warm re-stream under
+    the new sharding performs zero arena reads. SUMMA panel entries
+    (mesh-labelled, device-committed) move via
+    :func:`reshard_summa_layout` instead — a placement change does not
+    touch them."""
+    from netsdb_tpu.storage.devcache import _value_nbytes
+
+    ps = store.page_store()
+    name = f"{pm.ident}.mat"
+    steps = plan_steps(_spec_for(src_placement, 2),
+                       _spec_for(dst_placement, 2), 2,
+                       same_mesh=_same_mesh(src_placement, dst_placement),
+                       axis_sizes=_axis_sizes(src_placement))
+    report.steps = steps
+    cache = store.device_cache()
+    if cache is None or not getattr(cache, "partial", False) \
+            or not cache.enabled:
+        return
+    cfg = store.config
+    rb = ps.meta(name)[1][0]
+    bucketing = getattr(cfg, "shape_bucketing", True)
+    density = getattr(cfg, "bucket_density", 2)
+    scope = str(ident)
+    src_pl = src_placement.label() if src_placement is not None else None
+    dst_pl = dst_placement.label() if dst_placement is not None else None
+    ranges = ps.block_ranges(name)
+    if not ranges:
+        return
+    # collect EVERY kind's covered map BEFORE invalidating: the
+    # dirty-range drop is scope-wide, so reading after it would see
+    # nothing to move
+    covered_by = {}
+    for kind in ("trows", "treduce"):
+        src_key = (scope, kind, rb, bucketing, density, src_pl)
+        _epoch, covered = cache.plan_ranges(src_key, ranges)
+        if covered:
+            covered_by[kind] = covered
+    if not covered_by:
+        return
+    lo = min(r[0] for cov in covered_by.values() for r in cov)
+    hi = max(r[1] for cov in covered_by.values() for r in cov)
+    cache.invalidate_range(scope, lo, hi)
+    epoch = cache.scope_epoch(scope)
+    for kind, covered in covered_by.items():
+        dst_key = (scope, kind, rb, bucketing, density, dst_pl)
+        for rng in ranges:
+            val = covered.get((int(rng[0]), int(rng[1])))
+            if val is None:
+                continue
+            moved = _move_tensor_entry(val, steps, src_placement,
+                                       dst_placement)
+            if cache.install_block(dst_key, rng, moved, epoch=epoch):
+                report.blocks_moved += 1
+                report.bytes_moved += _value_nbytes(moved)
+
+
+def reshard_summa_layout(store, ident, src_devices, dst_devices,
+                         src_grid: Optional[Tuple[int, int]] = None,
+                         dst_grid: Optional[Tuple[int, int]] = None,
+                         axis: str = "data") -> ReshardReport:
+    """Move a paged TENSOR set's cached SUMMA panel blocks between
+    mesh LAYOUTS — 1-d row-dealt (``src_grid``/``dst_grid`` None) and
+    2-d processor grids — without re-staging from the arena: each
+    cached block re-places device-to-device (splitting into per-grid-
+    column tiles or concatenating them as the layouts require) and
+    installs under the destination layout's label, so the next
+    distributed matmul under the new mesh serves every A panel from
+    HBM. Both layouts must have the SAME participant count (the
+    contraction padding ``k_pad`` is participant-derived; differing
+    counts would need a host re-pad — callers re-stage instead)."""
+    import jax
+    from jax.sharding import SingleDeviceSharding
+
+    from netsdb_tpu.parallel import summa as _summa
+    from netsdb_tpu.plan import staging
+    from netsdb_tpu.storage.devcache import _value_nbytes
+
+    t0 = time.perf_counter()
+    report = ReshardReport(steps=[Step("replace", peak=1)])
+    obs.REGISTRY.counter("reshard.plans").inc()
+    items = store.get_items(ident)
+    pm = next((i for i in items
+               if type(i).__name__ == "_PagedMatrix"), None)
+    if pm is None:
+        raise ValueError(f"reshard_summa_layout: {ident} holds no "
+                         f"paged matrix")
+    src_devices = list(src_devices)
+    dst_devices = list(dst_devices)
+    n_src = (src_grid[0] * src_grid[1] if src_grid is not None
+             else len(src_devices))
+    n_dst = (dst_grid[0] * dst_grid[1] if dst_grid is not None
+             else len(dst_devices))
+    if n_src != n_dst:
+        raise ValueError(f"summa layout move needs equal participant "
+                         f"counts (k padding), got {n_src} -> {n_dst}")
+    src_devices = src_devices[:n_src]
+    dst_devices = dst_devices[:n_dst]
+    src_label = (_summa.grid_label(src_devices, *src_grid)
+                 if src_grid is not None
+                 else _summa.mesh_label(axis, src_devices))
+    dst_label = (_summa.grid_label(dst_devices, *dst_grid)
+                 if dst_grid is not None
+                 else _summa.mesh_label(axis, dst_devices))
+    cache = store.device_cache()
+    if cache is None or not getattr(cache, "partial", False) \
+            or not cache.enabled:
+        report.elapsed_s = time.perf_counter() - t0
+        return report
+    ps = store.page_store()
+    name = f"{pm.ident}.mat"
+    cfg = store.config
+    rb = ps.meta(name)[1][0]
+    bucket = staging.pad_rows_target(
+        rb, getattr(cfg, "shape_bucketing", True),
+        density=getattr(cfg, "bucket_density", 2))
+    scope = str(ident)
+    src_key = (scope, _summa.CACHE_KIND, bucket, src_label)
+    dst_key = (scope, _summa.CACHE_KIND, bucket, dst_label)
+    ranges = ps.block_ranges(name)
+    _epoch, covered = cache.plan_ranges(src_key, ranges)
+    if not covered:
+        report.elapsed_s = time.perf_counter() - t0
+        return report
+    lo = min(r[0] for r in covered)
+    hi = max(r[1] for r in covered)
+    cache.invalidate_range(scope, lo, hi)
+    epoch = cache.scope_epoch(scope)
+    import jax.numpy as jnp
+
+    for rng in ranges:
+        val = covered.get((int(rng[0]), int(rng[1])))
+        if val is None:
+            continue
+        i, nrows, payload = val
+        # normalize to the full (bucket, k_pad) block on ONE device —
+        # grid tiles concatenate on their destination (device-side
+        # concat, no host trip), 1-d panels are already whole
+        if isinstance(payload, tuple):
+            anchor = (dst_devices[(i % dst_grid[0]) * dst_grid[1]]
+                      if dst_grid is not None
+                      else dst_devices[i % n_dst])
+            full = jnp.concatenate(
+                [jax.device_put(t, SingleDeviceSharding(anchor))
+                 for t in payload], axis=1)
+        else:
+            full = payload
+        if dst_grid is not None:
+            pr, pc = dst_grid
+            r = i % pr
+            apc = full.shape[1] // pc
+            moved_payload = tuple(
+                jax.device_put(full[:, c * apc:(c + 1) * apc],
+                               SingleDeviceSharding(
+                                   dst_devices[r * pc + c]))
+                for c in range(pc))
+        else:
+            moved_payload = jax.device_put(
+                full, SingleDeviceSharding(dst_devices[i % n_dst]))
+        moved = (i, nrows, moved_payload)
+        obs.REGISTRY.counter("reshard.steps").inc()
+        if cache.install_block(dst_key, rng, moved, epoch=epoch):
+            report.blocks_moved += 1
+            report.bytes_moved += _value_nbytes(moved)
+    report.elapsed_s = time.perf_counter() - t0
+    obs.REGISTRY.counter("reshard.blocks_moved").inc(report.blocks_moved)
+    obs.REGISTRY.counter("reshard.bytes_moved").inc(report.bytes_moved)
+    obs.operators.op_add("reshard.blocks_moved", report.blocks_moved)
+    return report
+
+
 def reshard_set(store, ident, dst_placement,
                 kind: str = "tables") -> ReshardReport:
     """Move set ``ident`` from its current placement to
@@ -328,9 +521,22 @@ def reshard_set(store, ident, dst_placement,
         items = store.get_items(ident)
         pc = next((i for i in items if isinstance(i, PagedColumns)), None)
         if pc is None:
-            raise ValueError(f"reshard_set: {ident} holds no paged "
-                             f"relation (tensor sets reshard on their "
-                             f"next stream)")
+            pm = next((i for i in items
+                       if type(i).__name__ == "_PagedMatrix"), None)
+            if pm is None:
+                raise ValueError(f"reshard_set: {ident} holds no paged "
+                                 f"relation or matrix")
+            _reshard_paged_tensor(store, ident, pm, src_placement,
+                                  dst_placement, report)
+            store.set_placement(ident, dst_placement)
+            report.elapsed_s = time.perf_counter() - t0
+            obs.REGISTRY.counter("reshard.blocks_moved").inc(
+                report.blocks_moved)
+            obs.REGISTRY.counter("reshard.bytes_moved").inc(
+                report.bytes_moved)
+            obs.operators.op_add("reshard.blocks_moved",
+                                 report.blocks_moved)
+            return report
         steps = plan_steps(_spec_for(src_placement, 1),
                            _spec_for(dst_placement, 1), 1,
                            same_mesh=_same_mesh(src_placement,

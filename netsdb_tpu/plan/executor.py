@@ -445,6 +445,60 @@ def _run_fold(node, fold, pc, resident, placement, step_jit):
     return _run_fold_once(fold, pc, resident, placement, step_jit)
 
 
+def _summa_tensor_route(tfold, pt, others):
+    """Route a MATMUL-SHAPED tensor-fold stream through the SUMMA
+    engine — the ``config.distributed_matmul`` plan leg: a node whose
+    :class:`~netsdb_tpu.plan.fold.TensorFold` declares ``summa_rhs``
+    (``fn(block, *others) == block @ summa_rhs(*others)``) skips the
+    per-block loop entirely; each mesh participant stages only its
+    panel of the paged operand (1/N staged bytes per host, 1/(pr·pc)
+    under a ``config.summa_grid`` 2-d mesh) and one compiled round
+    program does the contraction (``parallel/summa.py``). Returns the
+    assembled BlockedTensor, or None when the route does not apply
+    (knob off, no declaration, or the declared RHS does not match
+    these inputs) — the caller then takes the per-block path,
+    byte-for-byte as before. Device/grid selection lives in ONE place
+    — ``PagedTensorStore.matmul_streamed`` — so the plan leg and the
+    set-property leg (``store.paged_matmul``) can never route
+    differently; with fewer than 2 devices that router falls back to
+    the single-device blocked stream, which is byte-equal anyway."""
+    import jax.numpy as jnp
+    import numpy as np
+
+    rhs_fn = getattr(tfold, "summa_rhs", None)
+    cfg = pt.store.config
+    if rhs_fn is None or not getattr(cfg, "distributed_matmul", False):
+        return None
+    rhs = rhs_fn(*others)
+    if rhs is None:
+        return None
+    rhs = np.asarray(rhs)
+    (rows, k), _blk, _dtype = pt.store.meta(pt.name)
+    if rhs.ndim != 2 or rhs.shape[0] != k:
+        return None  # declaration does not fit these inputs
+    cache = getattr(pt, "devcache", None)
+    scope = getattr(pt, "cache_scope", None)
+    cache_scope = None if scope is None else str(scope[0])
+    stats = {}
+    with obs.span("executor.tensor_summa", "executor") as sp, \
+            pt.rw.read():
+        out = pt.store.matmul_streamed(pt.name, rhs, devcache=cache,
+                                       cache_scope=cache_scope,
+                                       stats_out=stats)
+        if sp is not None:
+            sp.counters["summa.participants"] = stats.get(
+                "participants", 0)
+            sp.counters["summa.rounds"] = stats.get("rounds", 0)
+    obs.operators.op_add("summa.participants",
+                         stats.get("participants", 0))
+    obs.attrib.account("executor.chunks", stats.get("rounds", 0),
+                       scope=cache_scope)
+    dense = jnp.asarray(out)
+    if tfold.out_block is not None:
+        return BlockedTensor.from_dense(dense, tfold.out_block)
+    return BlockedTensor.from_dense(dense, tuple(dense.shape))
+
+
 def _run_tensor_stream(node, tfold, in_vals, src, step_jit):
     """Stream a paged TENSOR input through a node — in-DB inference
     over storage-managed weights (ref ``SimpleFF.cc:94-290``: FF
@@ -528,6 +582,10 @@ def _run_tensor_stream(node, tfold, in_vals, src, step_jit):
         return b
 
     if tfold.mode == "rows":
+        routed = _summa_tensor_route(tfold, pt, others)
+        if routed is not None:
+            return routed
+
         def place(item):
             _start, block = item
             n = block.shape[0]

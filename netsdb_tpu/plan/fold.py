@@ -136,12 +136,28 @@ class TensorFold:
       ``finalize(carry, *others)`` applies any epilogue (e.g. the gelu
       after an MLP up-projection). ``others`` are the node's non-paged
       input values in input order.
+
+    ``summa_rhs`` (mode="rows" only) declares the node MATMUL-SHAPED:
+    ``summa_rhs(*others)`` returns the dense right-hand operand R such
+    that ``fn(block, *others) == block @ R`` row-for-row (or ``None``
+    when the declaration does not apply to these inputs). With
+    ``config.distributed_matmul`` on, the executor routes the stream
+    through the SUMMA engine (``parallel/summa.py``) instead of the
+    per-block loop: each mesh participant stages only its panel of the
+    paged operand and per-host staged bytes drop to ~1/N (2-d grid
+    meshes via ``config.summa_grid`` drop the panel to ~1/(pr·pc)).
+    Contract caveat: SUMMA accumulates the contraction in k-panels, a
+    reassociation of the single-block ``dot_general`` — byte-equal for
+    integer-valued f32 operands, last-ulp for arbitrary floats — so
+    models declare it only under full-precision compute (see
+    ``models/ff.py``).
     """
 
     mode: str = "rows"
     out_block: Optional[Tuple[int, int]] = None
     partial: Optional[Callable] = None
     finalize: Optional[Callable] = None
+    summa_rhs: Optional[Callable] = None
 
     def __post_init__(self):
         if self.mode not in ("rows", "reduce"):

@@ -32,6 +32,19 @@ shapes and produces a :class:`ScatterSpec` the coordinator
   bucket; the coordinator merges the per-slot outputs with the fold's
   declared ``merge``. Keys co-locate whole, so no group is ever split
   across partials.
+* ``tensor_chain`` — a layer-chain sink DAG (the FF/conv inference
+  shape) whose ONLY sharded leaf is the batch-partitioned input
+  tensor set; every other input subtree scans sets mirrored on each
+  daemon (the model's weights). Each shard runs the WHOLE chain over
+  its local batch partition through its own executor — so PR 10's
+  region mapper compiles the layer chain as ONE fused program per
+  shard, not per-row pre-chains — and the coordinator concatenates
+  the dense per-slot outputs along the batch axis in slot order. The
+  shape is opted into by the sink's ``scatter_gather`` declaration
+  (``{"axis": batch_axis, "block": out_block}``, set by the serving
+  layer — ``models/serving.py``): the declaration IS the contract
+  that the chain is batch-decomposable along that axis, exactly as a
+  fold's ``state_merge`` declares mergeability.
 
 Anything else touching a sharded set is refused typed (the
 coordinator raises; mirrored/local sets are untouched by all of
@@ -62,7 +75,7 @@ from netsdb_tpu.plan.fold import FoldSpec
 class ScatterSpec:
     """One sink's scatter decomposition (see module docstring)."""
 
-    kind: str  # "fold_state" | "group_partial" | "shuffle_join"
+    kind: str  # "fold_state" | "group_partial" | "shuffle_join" | "tensor_chain"
     sink: WriteSet
     node: Computation
     #: sharded (db, set) leaves the spec scans, in deterministic order
@@ -71,6 +84,9 @@ class ScatterSpec:
     #: shuffle_join: (db, set) of the streamed/probe and build sides
     probe: Optional[Tuple[str, str]] = None
     build: Optional[Tuple[str, str]] = None
+    #: tensor_chain: the sink's ``scatter_gather`` declaration —
+    #: ``{"axis": batch_axis, "block": out_block_shape | None}``
+    gather: Optional[dict] = None
 
 
 #: node types that are row-decomposable over object/table partitions —
@@ -91,6 +107,40 @@ def _scan_leaf(node: Computation) -> Optional[ScanSet]:
             return None
         node = node.inputs[0]
     return node
+
+
+def _subtree_touches_sharded(node: Computation,
+                             is_sharded: Callable[[str, str], bool]
+                             ) -> bool:
+    seen, stack = set(), [node]
+    while stack:
+        n = stack.pop()
+        if id(n) in seen:
+            continue
+        seen.add(id(n))
+        if isinstance(n, ScanSet) and is_sharded(n.db, n.set_name):
+            return True
+        stack.extend(n.inputs)
+    return False
+
+
+def _tensor_chain_leaf(node: Computation,
+                       is_sharded: Callable[[str, str], bool]
+                       ) -> Optional[ScanSet]:
+    """Follow the batch spine from the sink's input to its sharded
+    scan leaf: every chain node must have EXACTLY ONE input whose
+    subtree touches a sharded set (the spine — the batch-partitioned
+    activations); all other input subtrees scan only sets mirrored on
+    each daemon (the weights), so the chain ships to the shards
+    unchanged. None when the spine forks or dead-ends."""
+    cur = node
+    while not isinstance(cur, ScanSet):
+        spine = [i for i in cur.inputs
+                 if _subtree_touches_sharded(i, is_sharded)]
+        if len(spine) != 1:
+            return None
+        cur = spine[0]
+    return cur if is_sharded(cur.db, cur.set_name) else None
 
 
 def sharded_scan_sets(sinks, is_sharded: Callable[[str, str], bool]
@@ -163,6 +213,16 @@ def analyze_sinks(sinks, is_sharded: Callable[[str, str], bool]
             return ScatterSpec(kind="group_partial", sink=sink,
                                node=node, scan_sets=tuple(touched))
 
+    # tensor_chain: sink-declared batch-decomposable layer chain over
+    # ONE sharded input tensor set (module docstring) — opted in via
+    # the sink's scatter_gather attribute, never inferred
+    gather = getattr(sink, "scatter_gather", None)
+    if gather is not None and len(touched) == 1 \
+            and _tensor_chain_leaf(node, is_sharded) is not None:
+        return ScatterSpec(kind="tensor_chain", sink=sink, node=node,
+                           scan_sets=tuple(touched),
+                           gather=dict(gather))
+
     return None
 
 
@@ -201,9 +261,9 @@ def partial_sink(spec: ScatterSpec) -> WriteSet:
     the id-keyed topo sort (a false cycle — the cross-process hazard
     the in-process tests can never see)."""
     node = spec.node
-    if spec.kind == "group_partial":
-        # the Aggregate chain runs unchanged over the shard's local
-        # rows; its dict output IS the partial
+    if spec.kind in ("group_partial", "tensor_chain"):
+        # the chain runs unchanged over the shard's local partition;
+        # its output (group dict / local-batch tensor) IS the partial
         sink = WriteSet(node, spec.sink.db, "__scatter_partial__")
         sink.node_id = _max_node_id(node) + 1
         sink.output_name = f"{sink.op_kind}_{sink.node_id}"
@@ -266,3 +326,32 @@ def merge_join_outputs(fold: FoldSpec, parts: List[Any]) -> Any:
     for p in parts[1:]:
         merged = fold.merge(merged, p)
     return merged
+
+
+def merge_tensor_chain(gather: dict, parts: List[Any]) -> Any:
+    """Assemble the per-slot outputs in slot order — slot order equals
+    ingest partition order (range slices are contiguous and
+    ascending), so the assembled batch is byte-identical to a
+    single-daemon run: every output element is computed from exactly
+    one shard's batch rows, never summed across shards.
+
+    ``mode="concat"`` (default) concatenates dense arrays along the
+    declared batch ``axis``, re-blocking with ``block`` when declared
+    so downstream padded shapes match the local engine's;
+    ``mode="items"`` chains per-slot item LISTS (the conv2d shape —
+    one output tensor per input image)."""
+    import numpy as np
+
+    if gather.get("mode") == "items":
+        out: List[Any] = []
+        for p in parts:
+            out.extend(p)
+        return out
+    dense = np.concatenate([np.asarray(p) for p in parts],
+                           axis=int(gather.get("axis", 0)))
+    block = gather.get("block")
+    if block:
+        from netsdb_tpu.core.blocked import BlockedTensor
+
+        return BlockedTensor.from_dense(dense, tuple(block))
+    return dense

@@ -1498,10 +1498,68 @@ class RemoteClient:
     def send_matrix(self, db: str, set_name: str, dense, block_shape=None,
                     dtype=None) -> RemoteTensor:
         dense = np.asarray(dense, dtype=dtype)
+        entry = self._placement_entry(db, set_name)
+        if entry is not None:
+            return self._send_matrix_routed(db, set_name, dense,
+                                            block_shape, entry)
         reply = self._request(MsgType.SEND_MATRIX, {
             "db": db, "set": set_name,
             "tensor": tensor_to_wire(dense, block_shape)})
         return RemoteTensor(dense, reply.get("block_shape"))
+
+    def _send_matrix_routed(self, db: str, set_name: str, dense,
+                            block_shape, entry) -> RemoteTensor:
+        """Batch-partitioned tensor ingest — the model-serving scoring
+        frame: rows split by the placement's contiguous range slices,
+        slice *i* to slot *i*, so slot order IS batch order and the
+        tensor-chain scatter-gather concat reassembles the exact input
+        order byte-for-byte. Slices go out in parallel (aggregate
+        ingest bandwidth scales with the pool, like routed tables); a
+        degraded slot's typed refusal surfaces to the caller — scoring
+        batches are transient, so there is no handoff buffering to
+        fall back on."""
+        from netsdb_tpu.serve import placement as _pl
+
+        if entry.get("mode") != "range":
+            raise ValueError(
+                f"tensor set {db}:{set_name} is partitioned "
+                f"{entry.get('mode')!r}; matrices shard by contiguous "
+                f"row ranges only — create with placement=\"range\"")
+        slots = entry["slots"]
+        slices = _pl.range_slices(int(dense.shape[0]), len(slots))
+        errors: Dict[int, BaseException] = {}
+        lock = threading.Lock()
+
+        def send_slot(i: int, lo: int, hi: int) -> None:
+            sl = slots[i]
+            addr = (f"{self.host}:{self.port}"
+                    if sl["state"] != "live" else sl["addr"])
+            try:
+                sc = self._shard_client(addr)
+                sc._request(MsgType.SEND_MATRIX, {
+                    "db": db, "set": set_name,
+                    "tensor": tensor_to_wire(
+                        np.ascontiguousarray(dense[lo:hi]), block_shape),
+                    PLACEMENT_EPOCH_KEY: int(entry["epoch"]),
+                    SHARD_SLOT_KEY: i})
+            except Exception as e:  # noqa: BLE001 — surfaced below
+                self._drop_shard_client(addr)
+                with lock:
+                    errors[i] = e
+
+        threads = []
+        for i, (lo, hi) in enumerate(slices):
+            t = threading.Thread(target=send_slot, args=(i, lo, hi),
+                                 daemon=True)
+            t.start()
+            threads.append(t)
+        for t in threads:
+            t.join()
+        if errors:
+            raise errors[min(errors)]
+        obs.REGISTRY.counter("serve.client.routed_ingests").inc()
+        return RemoteTensor(dense,
+                            list(block_shape) if block_shape else None)
 
     def get_tensor(self, db: str, set_name: str) -> RemoteTensor:
         reply = self._request(MsgType.GET_TENSOR, {"db": db, "set": set_name})

@@ -31,7 +31,7 @@ import threading
 import time
 import traceback
 from collections import OrderedDict
-from typing import Any, Callable, Dict, Optional, Tuple
+from typing import Any, Callable, Dict, List, Optional, Tuple
 
 import numpy as np
 
@@ -2849,11 +2849,23 @@ class ServeController:
         return MsgType.OK, {"count": len(p["items"])}
 
     def _on_send_matrix(self, p):
-        if self.is_sharded(p.get("db"), p.get("set")):
-            raise ValueError(
-                f"set {p['db']}:{p['set']} is partitioned across the "
-                f"worker pool; tensor sets do not shard — use "
-                f"placement=None (mirror) for matrices")
+        # a batch-partitioned TENSOR set (the model-serving input
+        # shape) takes routed frames exactly like SEND_DATA: the
+        # client splits rows by the placement's range slices and each
+        # slot daemon ingests its contiguous slice as the local
+        # partition. An unrouted frame against a sharded set gets
+        # _shard_route's typed placement rejection.
+        epoch = p.pop(PLACEMENT_EPOCH_KEY, None)
+        slot = p.pop(SHARD_SLOT_KEY, None)
+        route = self._shard_route(p.get("db"), p.get("set"), epoch, slot)
+        if route == "handoff":
+            # matrix slices are not handoff-buffered (a scoring batch
+            # is transient, unlike durable table rows): refuse typed
+            # retryable — the client re-routes after readmit
+            raise ShardUnavailable(
+                f"slot {slot} of {p['db']}:{p['set']} is degraded; "
+                f"matrix ingest refused — retry after readmit",
+                slot=slot, epoch=epoch)
         dense, block_shape = tensor_from_wire(p["tensor"])
         t = self.library.send_matrix(p["db"], p["set"], dense, block_shape)
         if t is None:
@@ -3645,12 +3657,9 @@ class ServeController:
         from netsdb_tpu.client import table_info
         from netsdb_tpu.relational.table import ColumnTable
 
-        if self.is_sharded(p.get("db"), p.get("set")):
-            raise ValueError(
-                f"ANALYZE_SET over the partitioned set "
-                f"{p['db']}:{p['set']} is not supported yet — "
-                f"statistics would cover one shard's pages only; "
-                f"derive plan statics from ingest-side knowledge")
+        if self.is_sharded(p.get("db"), p.get("set")) \
+                and not p.get("local_only"):
+            return MsgType.OK, self._analyze_sharded(p["db"], p["set"])
         items = self.library.store.get_items(
             SetIdentifier(p["db"], p["set"]))
         if len(items) == 1 and isinstance(items[0], ColumnTable):
@@ -3663,6 +3672,62 @@ class ServeController:
             "dicts": {k: list(v) for k, v in info["dicts"].items()},
             "stats": {k: [s.n_rows, s.min_val, s.max_val, s.n_distinct]
                       for k, s in info["stats"].items()}}
+
+    def _analyze_sharded(self, db: str, set_name: str) -> Dict[str, Any]:
+        """ANALYZE_SET fan-out over a partitioned set: every LIVE slot
+        analyzes its local pages, the coordinator merges the summaries
+        — rows sum, per-column [n_rows, min, max, n_distinct] merge by
+        sum/min/max, dictionaries union in slot order. ``n_distinct``
+        merges as the max over shards: a shard-local distinct count
+        never exceeds the global one, so the merged figure is the
+        tightest lower bound the summaries can give (exact when the
+        partition key correlates with the column — range ingest keeps
+        runs together). Degraded slots refuse, like scatter-gather:
+        stats covering a subset of shards would silently mis-cost every
+        plan built on them."""
+        entry = self.placement.entry(db, set_name)
+        parts: List[Tuple[int, Dict[str, Any]]] = []
+        payload = {"db": db, "set": set_name, "local_only": True}
+        for i, sl in enumerate(entry["slots"]):
+            if sl["state"] != _placement.LIVE:
+                raise ShardUnavailable(
+                    f"slot {i} of {db}:{set_name} ({sl['addr']}) is "
+                    f"degraded; partial statistics would mis-cost "
+                    f"every plan — retry after readmit",
+                    slot=i, epoch=entry["epoch"])
+            if sl["addr"] == self.advertise_addr:
+                _typ, rep = self._on_analyze_set(dict(payload))
+            else:
+                rep = self.shards.peer_request(
+                    sl["addr"], MsgType.ANALYZE_SET, payload)
+            parts.append((i, rep))
+        merged_rows = 0
+        dicts: Dict[str, List[Any]] = {}
+        stats: Dict[str, List[Any]] = {}
+        for _i, rep in parts:
+            merged_rows += int(rep.get("num_rows") or 0)
+            for k, vals in (rep.get("dicts") or {}).items():
+                seen = dicts.setdefault(k, [])
+                known = set(seen)
+                for v in vals:
+                    if v not in known:
+                        seen.append(v)
+                        known.add(v)
+            for k, row in (rep.get("stats") or {}).items():
+                n, lo, hi, nd = row
+                cur = stats.get(k)
+                if cur is None:
+                    stats[k] = [int(n), lo, hi, int(nd)]
+                else:
+                    cur[0] += int(n)
+                    if lo is not None:
+                        cur[1] = lo if cur[1] is None else min(cur[1], lo)
+                    if hi is not None:
+                        cur[2] = hi if cur[2] is None else max(cur[2], hi)
+                    cur[3] = max(cur[3], int(nd))
+        obs.REGISTRY.counter("shard.analyze_fanouts").inc()
+        return {"num_rows": merged_rows, "dicts": dicts, "stats": stats,
+                "sharded": len(parts)}
 
 
 def run_daemon(config: Configuration, host: str = "127.0.0.1",

@@ -244,6 +244,21 @@ def execute_subplan(ctl, p: dict) -> dict:
         db, set_name = p["scan"]
         dicts, rows = local_schema(ctl, db, set_name)
         out.update(state=_np_tree(value), dicts=dicts, rows=rows)
+    elif kind == "tensor_chain":
+        # the local-batch output rides the wire dense and UNPADDED
+        # (to_dense strips block padding) — the coordinator's concat
+        # must see true batch extents, not bucket-padded ones. Item
+        # lists (the conv2d shape: one tensor per input image) ship
+        # as per-item host arrays.
+        from netsdb_tpu.core.blocked import BlockedTensor
+
+        def _host(v):
+            if isinstance(v, BlockedTensor):
+                v = v.to_dense()
+            return np.asarray(v)
+
+        out["tensor"] = [_host(v) for v in value] \
+            if isinstance(value, (list, tuple)) else _host(value)
     else:  # group_partial — the dict IS the partial
         out["groups"] = value
     if tree is not None:
@@ -804,7 +819,9 @@ class ShardPool:
                 f"{[f'{d}:{s}' for d, s in touched]} in a shape "
                 f"scatter-gather cannot push (supported: single-pass "
                 f"folds declaring state_merge, dict group-bys with "
-                f"combine, grace-hash joins with declared keys+merge); "
+                f"combine, grace-hash joins with declared keys+merge, "
+                f"layer chains with a sink scatter_gather "
+                f"declaration); "
                 f"a partitioned set's pages live only on its shards, "
                 f"so there is no local fallback")
         entries = {}
@@ -980,6 +997,9 @@ class ShardPool:
         elif spec.kind == "group_partial":
             value = scatter.merge_group_dicts(
                 spec.node, [r["groups"] for r in replies])
+        elif spec.kind == "tensor_chain":
+            value = scatter.merge_tensor_chain(
+                spec.gather, [r["tensor"] for r in replies])
         else:
             tables = [r["table"] for r in replies
                       if r.get("table") is not None]

@@ -19,7 +19,7 @@ from __future__ import annotations
 
 import os
 import threading
-from typing import Dict, Iterator, Optional, Tuple
+from typing import Any, Dict, Iterator, Optional, Tuple
 
 import numpy as np
 
@@ -600,7 +600,9 @@ class PagedTensorStore:
     def matmul_streamed(self, name: str, rhs: np.ndarray,
                         stage_depth: Optional[int] = None,
                         devcache=None,
-                        cache_scope: Optional[str] = None) -> np.ndarray:
+                        cache_scope: Optional[str] = None,
+                        stats_out: Optional[Dict[str, Any]] = None
+                        ) -> np.ndarray:
         """out = M @ rhs with M streamed page-by-page through the device
         — the larger-than-HBM compute pattern (reference: pipelines over
         pinned pages). Only one page + rhs (plus the staged NEXT page)
@@ -636,11 +638,17 @@ class PagedTensorStore:
             cap = getattr(self.config, "summa_participants", None)
             if cap:
                 devices = devices[:int(cap)]
+            grid = summa.grid_shape(self.config, len(devices))
+            if grid is not None:
+                return summa.summa_grid_matmul_streamed(
+                    self, name, rhs, devices=devices, grid=grid,
+                    stage_depth=stage_depth, cache=devcache,
+                    cache_scope=cache_scope, stats_out=stats_out)
             if len(devices) >= 2:
                 return summa.summa_matmul_streamed(
                     self, name, rhs, devices=devices,
                     stage_depth=stage_depth, cache=devcache,
-                    cache_scope=cache_scope)
+                    cache_scope=cache_scope, stats_out=stats_out)
 
         depth = getattr(self.config, "stage_depth", 2) \
             if stage_depth is None else stage_depth

@@ -255,3 +255,146 @@ def test_execute_steps_values_and_sharding(mesh4):
                          dst, src)
     assert np.array_equal(np.asarray(back), np.arange(64))
     assert back.sharding.is_equivalent_to(src.sharding(), back.ndim)
+
+
+# --------------------------------- paged TENSOR sets (ISSUE 17 sat. 1)
+def test_reshard_paged_tensor_stream_blocks_round_trip(tmp_path, mesh4):
+    """A placed paged TENSOR set (FF weight stream) reshards its
+    cached ``trows`` blocks through the collective schedule — sharded
+    → replicated (all_gather) and back (local_slice) — and the warm
+    inference under each NEW layout stages ZERO chunks (no arena
+    reads) while staying byte-equal (integer-valued f32 weights make
+    every reassociation exact)."""
+    from netsdb_tpu.models.ff import FFModel
+
+    src = Placement((("data", 4),), ("data", None))
+    repl = Placement((("data", 4),), (None, None))
+    rng = np.random.default_rng(9)
+    F, H, L, B = 96, 128, 10, 32
+    ints = lambda shape: rng.integers(-2, 2, shape).astype(np.float32)  # noqa: E731
+    c = Client(Configuration(root_dir=str(tmp_path / "ff"),
+                             page_size_bytes=4096,
+                             page_pool_bytes=16384))
+    m = FFModel(db="ff", block=(32, 32))
+    m.setup(c, storages={"w1": "paged"}, placements={"w1": src})
+    m.load_weights(c, ints((H, F)), ints((H,)), ints((L, H)), ints((L,)))
+    m.load_inputs(c, ints((B, F)))
+    cold = np.asarray(m.inference(c).to_dense())
+
+    ident = SetIdentifier("ff", "w1")
+    cache = c.store.device_cache()
+    pm = next(i for i in c.store.get_items(ident)
+              if type(i).__name__ == "_PagedMatrix")
+    nblocks = len(c.store.page_store().block_ranges(f"{pm.ident}.mat"))
+    assert nblocks > 1
+
+    rep = reshard_set(c.store, ident, repl)
+    assert rep.labels() == ["all_gather[data:0]"]
+    assert rep.blocks_moved == nblocks
+    assert rep.bytes_moved > 0
+    assert c.store.placement_of(ident) is repl
+
+    chunks0 = obs.REGISTRY.counter("staging.chunks").value
+    warm = np.asarray(m.inference(c).to_dense())
+    assert obs.REGISTRY.counter("staging.chunks").value == chunks0
+    np.testing.assert_array_equal(cold, warm)
+
+    # the zero-communication direction back onto the sharded layout
+    rep2 = reshard_set(c.store, ident, src)
+    assert rep2.labels() == ["local_slice[data:0]"]
+    assert rep2.blocks_moved == nblocks
+    chunks1 = obs.REGISTRY.counter("staging.chunks").value
+    back = np.asarray(m.inference(c).to_dense())
+    assert obs.REGISTRY.counter("staging.chunks").value == chunks1
+    np.testing.assert_array_equal(cold, back)
+    assert staging.active_count() == 0
+
+
+def test_reshard_summa_layout_1d_to_2d_and_back(tmp_path, mesh4):
+    """ISSUE 17 satellite: cached SUMMA panel blocks move between the
+    1-d row-dealt mesh and the 2-d processor grid WITHOUT re-staging —
+    after the move the distributed matmul under the new layout serves
+    every A panel from HBM (zero staged chunks; only the B tiles
+    upload) and stays byte-equal."""
+    import jax
+
+    from netsdb_tpu.parallel.reshard import reshard_summa_layout
+    from netsdb_tpu.parallel.summa import (summa_grid_matmul_streamed,
+                                           summa_matmul_streamed)
+
+    c = Client(Configuration(root_dir=str(tmp_path / "sm"),
+                             page_size_bytes=64 * 1024))
+    c.create_database("d")
+    c.create_set("d", "m", type_name="tensor", storage="paged")
+    rng = np.random.default_rng(2)
+    a = rng.integers(-4, 4, (512, 64)).astype(np.float32)
+    rhs = rng.integers(-4, 4, (64, 32)).astype(np.float32)
+    c.send_matrix("d", "m", a)
+    ident = SetIdentifier("d", "m")
+    pm = next(i for i in c.store.get_items(ident)
+              if type(i).__name__ == "_PagedMatrix")
+    name = f"{pm.ident}.mat"
+    ps = c.store.page_store()
+    cache = c.store.device_cache()
+    devs = jax.devices()[:4]
+
+    base = summa_matmul_streamed(ps, name, rhs, devices=devs,
+                                 cache=cache, cache_scope=str(ident))
+    assert np.array_equal(base, a @ rhs)
+    assert cache.stats()["entries"] > 0
+
+    moved0 = obs.REGISTRY.counter("reshard.blocks_moved").value
+    rep = reshard_summa_layout(c.store, ident, devs, devs,
+                               dst_grid=(2, 2))
+    assert rep.blocks_moved > 0 and rep.bytes_moved > 0
+    assert obs.REGISTRY.counter("reshard.blocks_moved").value \
+        == moved0 + rep.blocks_moved
+
+    chunks0 = obs.REGISTRY.counter("staging.chunks").value
+    warm = {}
+    out = summa_grid_matmul_streamed(ps, name, rhs, devices=devs,
+                                     grid=(2, 2), cache=cache,
+                                     cache_scope=str(ident),
+                                     stats_out=warm)
+    assert out.tobytes() == base.tobytes()
+    assert obs.REGISTRY.counter("staging.chunks").value == chunks0
+    assert warm["staged_bytes_total"] <= rhs.nbytes  # only B tiles
+
+    # round trip: the grid tiles concatenate back into 1-d panels
+    rep2 = reshard_summa_layout(c.store, ident, devs, devs,
+                                src_grid=(2, 2))
+    assert rep2.blocks_moved == rep.blocks_moved
+    chunks1 = obs.REGISTRY.counter("staging.chunks").value
+    o1 = summa_matmul_streamed(ps, name, rhs, devices=devs,
+                               cache=cache, cache_scope=str(ident))
+    assert o1.tobytes() == base.tobytes()
+    assert obs.REGISTRY.counter("staging.chunks").value == chunks1
+    assert staging.active_count() == 0
+
+
+def test_reshard_summa_layout_guards(tmp_path, mesh4):
+    """Layout moves need equal participant counts (the contraction
+    padding is participant-derived) and an actual paged matrix."""
+    import jax
+
+    from netsdb_tpu.parallel.reshard import reshard_summa_layout
+
+    c = Client(Configuration(root_dir=str(tmp_path / "g"),
+                             page_size_bytes=64 * 1024))
+    c.create_database("d")
+    c.create_set("d", "m", type_name="tensor", storage="paged")
+    c.send_matrix("d", "m",
+                  np.arange(64 * 32, dtype=np.float32).reshape(64, 32))
+    devs = jax.devices()[:4]
+    with pytest.raises(ValueError, match="equal participant counts"):
+        reshard_summa_layout(c.store, SetIdentifier("d", "m"),
+                             devs, devs[:2])
+    with pytest.raises(ValueError, match="equal participant counts"):
+        reshard_summa_layout(c.store, SetIdentifier("d", "m"),
+                             devs, devs, src_grid=(2, 2),
+                             dst_grid=(1, 2))
+    c.create_set("d", "mem", type_name="tensor")
+    c.send_matrix("d", "mem", np.eye(8, dtype=np.float32))
+    with pytest.raises(ValueError, match="no[ \\n]+paged matrix"):
+        reshard_summa_layout(c.store, SetIdentifier("d", "mem"),
+                             devs, devs)

@@ -202,3 +202,148 @@ def test_summa_counters_catalogued():
                  "reshard.plans", "reshard.steps",
                  "reshard.blocks_moved", "reshard.bytes_moved"):
         assert name in names, name
+
+
+# --- 2-d processor grid (PR 17) ---------------------------------------
+
+def test_summa_grid_byte_equal_single_device_engine(tmp_path, mesh4):
+    """The 2-d grid engine (2112.09017 §III) matches the single-device
+    blocked engine byte for byte — same f32 HIGHEST contraction, the
+    dual-broadcast steps only reassociate exactly."""
+    from netsdb_tpu.parallel.summa import summa_grid_matmul_streamed
+
+    pts, m, rhs = _store(tmp_path)
+    base = pts.matmul_streamed("m", rhs)
+    out = summa_grid_matmul_streamed(pts, "m", rhs,
+                                     devices=list(mesh4.devices.flat),
+                                     grid=(2, 2))
+    assert out.tobytes() == base.tobytes()
+    assert staging.active_count() == 0
+
+
+def test_summa_grid_staged_fraction_and_counters(tmp_path, mesh4):
+    """Each grid device stages ~1/(pr*pc) of A — the both-dims-
+    exceed-one-host layout's defining property — and the grid counter
+    family ticks."""
+    from netsdb_tpu.parallel.summa import summa_grid_matmul_streamed
+
+    rounds0 = obs.REGISTRY.counter("summa.grid_rounds").value
+    pts, m, rhs = _store(tmp_path, rows=2048, k=64, cols=32,
+                         row_block=256)  # 8 blocks / 2 grid rows
+    stats = {}
+    out = summa_grid_matmul_streamed(pts, "m", rhs,
+                                     devices=list(mesh4.devices.flat),
+                                     grid=(2, 2), stats_out=stats)
+    assert np.array_equal(out, m @ rhs)
+    assert stats["grid"] == (2, 2) and stats["participants"] == 4
+    assert stats["rounds"] == 4  # pr blocks per round
+    a_bytes = m.nbytes
+    for d, nbytes in stats["staged_bytes_per_participant"].items():
+        # 1/4 of A split as (row-deal over pr) x (column-split over
+        # pc); 60% headroom for contraction padding to k_pad
+        assert nbytes <= a_bytes / 4 * 1.6, (d, nbytes)
+    assert obs.REGISTRY.counter("summa.grid_rounds").value == rounds0 + 4
+    assert obs.REGISTRY.counter("summa.grid_steps").value > 0
+    assert staging.active_count() == 0
+
+
+def test_summa_grid_knob_routes_and_label_keys(tmp_path, mesh4):
+    """config.summa_grid="2x2" routes matmul_streamed through the grid
+    engine; the grid label never aliases the 1-d label for the same
+    scope (different layouts = different cached-panel homes)."""
+    from netsdb_tpu.parallel.summa import grid_label, grid_shape, mesh_label
+
+    g0 = obs.REGISTRY.counter("summa.grid_rounds").value
+    pts, m, rhs = _store(tmp_path, distributed_matmul=True,
+                         summa_participants=4, summa_grid="2x2")
+    out = pts.matmul_streamed("m", rhs)
+    assert obs.REGISTRY.counter("summa.grid_rounds").value > g0
+    assert np.array_equal(out, m @ rhs)
+
+    devs = list(mesh4.devices.flat)
+    assert grid_label(devs, 2, 2) != mesh_label("data", devs)
+    assert grid_label(devs, 2, 2) != grid_label(devs, 1, 4)
+
+    class _C:
+        summa_grid = "2x2"
+
+    assert grid_shape(_C(), 4) == (2, 2)
+    assert grid_shape(_C(), 3) is None  # grid does not fit
+    _C.summa_grid = None
+    assert grid_shape(_C(), 4) is None
+    _C.summa_grid = "2xbogus"
+    with pytest.raises(ValueError, match="PRxPC"):
+        grid_shape(_C(), 4)
+
+
+def test_summa_grid_warm_rerun_zero_arena_reads(tmp_path, mesh4):
+    """A warm grid re-run serves every A tile from the device cache:
+    zero staged chunks (no arena reads), only the B tiles re-upload —
+    byte-equal output."""
+    from netsdb_tpu.parallel.summa import summa_grid_matmul_streamed
+
+    pts, m, rhs = _store(tmp_path, rows=2048, k=64, cols=32,
+                         row_block=256)
+    devs = list(mesh4.devices.flat)
+    cache = DeviceBlockCache(64 * 1024 * 1024, partial=True)
+    o1 = summa_grid_matmul_streamed(pts, "m", rhs, devices=devs,
+                                    grid=(2, 2), cache=cache,
+                                    cache_scope="d:m")
+    chunks0 = obs.REGISTRY.counter("staging.chunks").value
+    warm = {}
+    o2 = summa_grid_matmul_streamed(pts, "m", rhs, devices=devs,
+                                    grid=(2, 2), cache=cache,
+                                    cache_scope="d:m", stats_out=warm)
+    assert o2.tobytes() == o1.tobytes()
+    assert obs.REGISTRY.counter("staging.chunks").value == chunks0
+    # nothing of A re-staged: the warm total is exactly one B upload
+    assert warm["staged_bytes_total"] <= rhs.nbytes
+    assert staging.active_count() == 0
+
+
+def test_summa_grid_counters_catalogued():
+    from netsdb_tpu.obs.export import CATALOG
+
+    for name in ("summa.grid_rounds", "summa.grid_steps",
+                 "summa.grid_panel_bcasts", "summa.grid_staged_bytes",
+                 "models.deploys", "models.batches_scored",
+                 "models.rows_scored", "serve.client.routed_ingests",
+                 "shard.analyze_fanouts"):
+        assert name in CATALOG, name
+
+
+def test_ff_plan_leg_routes_tensor_stream_through_summa(tmp_path, mesh4):
+    """Tentpole (a) pinned: a COMPILED PLAN's tensor-fold stream (FF
+    inference over paged weights) routes through SUMMA when
+    ``distributed_matmul`` is on — byte-equal to the knob-off run,
+    summa.rounds ticks, and the 2-d grid knob routes the same stream
+    through the grid engine."""
+    from netsdb_tpu.client import Client
+    from netsdb_tpu.models.ff import FFModel
+
+    rng = np.random.default_rng(5)
+    F, H, L = 96, 128, 10
+    w1, b1 = _int_f32(rng, (H, F), -2, 2), _int_f32(rng, (H,), -2, 2)
+    wo, bo = _int_f32(rng, (L, H), -2, 2), _int_f32(rng, (L,), -2, 2)
+    x = _int_f32(rng, (32, F), -2, 2)
+
+    def _run(tag, **cfg):
+        c = Client(Configuration(root_dir=str(tmp_path / tag),
+                                 page_size_bytes=4096,
+                                 page_pool_bytes=16384, **cfg))
+        m = FFModel(db="ff", block=(32, 32))
+        m.setup(c, storages={"w1": "paged", "wo": "paged"})
+        m.load_weights(c, w1, b1, wo, bo)
+        m.load_inputs(c, x)
+        return np.asarray(m.inference(c).to_dense())
+
+    base = _run("base")
+    r0 = obs.REGISTRY.counter("summa.rounds").value
+    dist = _run("dist", distributed_matmul=True, summa_participants=4)
+    assert obs.REGISTRY.counter("summa.rounds").value > r0
+    np.testing.assert_array_equal(base, dist)
+    g0 = obs.REGISTRY.counter("summa.grid_rounds").value
+    grid = _run("grid", distributed_matmul=True, summa_participants=4,
+                summa_grid="2x2")
+    assert obs.REGISTRY.counter("summa.grid_rounds").value > g0
+    np.testing.assert_array_equal(base, grid)
