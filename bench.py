@@ -90,6 +90,7 @@ def _cpu_reference_rows_per_sec() -> float:
 HEADLINE_METRICS = {"ff_inference_rows_per_sec_per_chip": "higher",
                     "serve_sched_p99_speedup": "higher",
                     "plan_fusion_speedup": "higher",
+                    "plan_fusion_distributed_speedup": "higher",
                     "serve_scaleout_throughput_x": "higher",
                     "devcache_partial_speedup": "higher",
                     "summa_staging_reduction_x": "higher",
@@ -386,6 +387,36 @@ def main():
         else:
             print(f"-- fusion A/B produced no speedup figure; metric "
                   f"omitted: {json.dumps(fz)}", file=sys.stderr)
+    if "--fusion-distributed" in sys.argv:
+        # distributed fusion A/B (serve_bench --fusion-distributed):
+        # the 4-daemon scatter q01 + 3-sink fan under the optimal
+        # mapper vs plan_fusion=off, gated on the structural proofs
+        # (one compiled partial-fold program per shard + one
+        # coordinator merge+finalize program, fan shipped as one
+        # multi-sink subplan per daemon, byte-equality across all
+        # three arms). CPU-container caveat: tiny q01 fold states
+        # make the paired delta a lower bound — the gates are the
+        # platform-independent part.
+        from netsdb_tpu.workloads.serve_bench import (
+            run_fusion_distributed_bench)
+
+        fd = run_fusion_distributed_bench()
+        if fd.get("plan_fusion_distributed_speedup") \
+                and fd.get("gates_ok"):
+            records.append({
+                "metric": "plan_fusion_distributed_speedup",
+                "value": fd["plan_fusion_distributed_speedup"],
+                "unit": "x (4-daemon scatter q01 + 3-sink fan, warm "
+                        "rounds, optimal mapper vs plan_fusion=off; "
+                        "one-program-per-shard + byte-equal gates "
+                        "held)",
+                "detail": dict(fd),
+            })
+        else:
+            # a broken arm or a failed gate (which is a BUG, not
+            # noise) must omit the record, not snapshot it
+            print(f"-- fusion-distributed arm unusable; metric "
+                  f"omitted: {json.dumps(fd)}", file=sys.stderr)
     if "--scale" in sys.argv:
         # horizontal scale-out (serve_bench --scale): paired 1 vs
         # 4-daemon arm over the q01-style paged workload — aggregate

@@ -4,6 +4,7 @@ Importing this package loads every rule; ``lint.all_rules()`` does it
 lazily so the framework core stays import-cheap.
 """
 
+from netsdb_tpu.analysis.rules import compilation  # noqa: F401
 from netsdb_tpu.analysis.rules import discipline  # noqa: F401
 from netsdb_tpu.analysis.rules import drift  # noqa: F401
 from netsdb_tpu.analysis.rules import locking  # noqa: F401

@@ -807,6 +807,12 @@ def _render_explain(prof) -> None:
     print(f"qid={qid} [{prof.get('origin')}] "
           f"total={1e3 * (prof.get('total_s') or 0.0):.2f}ms")
     print(operators.render_tree(tree, total_s=prof.get("total_s")))
+    shard_ops = prof.get("shard_operators")
+    if shard_ops:
+        # the distributed region tree: the coordinator's regions above,
+        # each shard's region forest below, all under one qid
+        print(operators.render_shard_forest(
+            shard_ops, total_s=prof.get("total_s")))
     for addr, fprofs in sorted((prof.get("followers") or {}).items()):
         for fp in fprofs:
             if fp.get("operators"):
@@ -1081,7 +1087,13 @@ def _cmd_lint(args) -> int:
 
 
 def _cmd_serve_bench(args) -> int:
-    if getattr(args, "scale", False):
+    if getattr(args, "fusion_distributed", False):
+        from netsdb_tpu.workloads.serve_bench import (
+            run_fusion_distributed_bench)
+
+        out = run_fusion_distributed_bench(
+            daemons=getattr(args, "daemons", 4))
+    elif getattr(args, "scale", False):
         from netsdb_tpu.workloads.serve_bench import run_scaleout_bench
 
         out = run_scaleout_bench(daemons=getattr(args, "daemons", 4))
@@ -1288,6 +1300,12 @@ def main(argv=None) -> int:
                         "join")
     p.add_argument("--daemons", type=int, default=4,
                    help="pool size for --scale (leader + N-1 shards)")
+    p.add_argument("--fusion-distributed", action="store_true",
+                   help="distributed fusion paired A/B instead: "
+                        "4-daemon scatter q01 + 3-sink fan under "
+                        "the optimal mapper vs greedy vs "
+                        "plan_fusion=off — one-program-per-shard, "
+                        "one-subplan fan and byte-equality gates")
 
     p = sub.add_parser("obs",
                        help="observability readout of a running daemon: "

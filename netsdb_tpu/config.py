@@ -89,6 +89,23 @@ class Configuration:
     # to a static estimate for never-seen labels; "static" forces the
     # fallback everywhere (cold daemons, deterministic tests)
     fusion_cost_source: str = "ledger"
+    # region partitioner: "optimal" solves each maximal fusable run
+    # exactly (DP over the region lattice — the runs are
+    # topo-contiguous and convex, so contiguous-segment DP IS the
+    # exact solution) under the staged-bytes budget below, splitting
+    # an over-budget region at its cheapest edge; "greedy" restores
+    # the PR 10 flush-the-whole-run mapper byte-for-byte (same region
+    # ids, fingerprints, jit keys, counters) — the rollback arm the
+    # A/B advisor compares against.
+    fusion_mapper: str = "optimal"
+    # HBM/pin byte budget one fused region's staged inputs may occupy
+    # (cost model: per-label ledger means of bytes_in/stage.bytes,
+    # static per-node fallback for cold labels). A run whose single-
+    # region staging estimate exceeds this SPLITS at the cheapest
+    # edge (fusion.splits ticks) instead of falling back per-node.
+    # 0 = unbounded (the default — budget pressure is an operator/
+    # TPU-rig decision, not something a CPU container can size).
+    fusion_stage_budget_bytes: int = 0
     # --- cross-query device-resident set cache (storage/devcache.py) ---
     # byte budget for placed set blocks kept DEVICE-RESIDENT across
     # queries and serve requests (the buffer-pool role: the second
@@ -322,6 +339,12 @@ class Configuration:
             raise ValueError(f"fusion_cost_source must be 'ledger' or "
                              f"'static', got "
                              f"{self.fusion_cost_source!r}")
+        if self.fusion_mapper not in ("optimal", "greedy"):
+            raise ValueError(f"fusion_mapper must be 'optimal' or "
+                             f"'greedy', got {self.fusion_mapper!r}")
+        if self.fusion_stage_budget_bytes < 0:
+            raise ValueError(f"fusion_stage_budget_bytes must be >= 0, "
+                             f"got {self.fusion_stage_budget_bytes!r}")
 
     @property
     def catalog_path(self) -> str:

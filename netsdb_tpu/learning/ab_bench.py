@@ -405,6 +405,114 @@ def bench_fusion_ab(rows: int = 120_000, spine: int = 6,
             "learned_speedup": round(worst / best, 2) if best else None}
 
 
+def bench_mapper_ab(rows: int = 120_000, spine: int = 6,
+                    rounds: int = 4, reps: int = 3,
+                    shape: str = "mixed",
+                    history_path: str = ":memory:",
+                    seed: int = 0) -> Dict[str, object]:
+    """Live A/B where the advisor decides the fusion MAPPER (optimal
+    DP vs greedy whole-run) for one plan SHAPE
+    (:func:`~netsdb_tpu.learning.advisor.mapper_candidates`).  The
+    history key carries the shape (``ab-mapper:<shape>``), so the
+    bandit learns a per-shape winner — ``shape="spine"`` runs the
+    resident Apply chain alone (where the DP's segmentation can
+    differ), ``shape="mixed"`` the same mixed paged/resident DAG
+    :func:`bench_fusion_ab` measures."""
+    import os
+
+    import jax
+
+    from netsdb_tpu.learning.advisor import mapper_candidates
+    from netsdb_tpu.plan.computations import Apply, Join, ScanSet, WriteSet
+    from netsdb_tpu.relational import dag as rdag
+    from netsdb_tpu.relational.table import ColumnTable
+
+    hdb = HistoryDB(history_path)
+    cands = list(mapper_candidates())
+    advisor = PlacementAdvisor(cands, hdb)
+    job = f"ab-mapper:{shape}"
+    rng = np.random.default_rng(seed)
+    uid = os.getuid() if hasattr(os, "getuid") else "u"
+    cache_dir = os.path.join(tempfile.gettempdir(),
+                             f"netsdb_ab_cache_{uid}")
+    li = {
+        "l_shipdate": rng.integers(19940101, 19950101, rows,
+                                   dtype=np.int32),
+        "l_discount": np.full(rows, 0.06, np.float32),
+        "l_quantity": np.full(rows, 10.0, np.float32),
+        "l_extendedprice": rng.uniform(1000, 2000, rows
+                                       ).astype(np.float32),
+    }
+    dim = {"x": rng.standard_normal(4096).astype(np.float32)}
+
+    def build_sink():
+        import jax.numpy as jnp
+
+        s = ScanSet("ab", "dim")
+        node = s
+        for i in range(spine):
+            node = Apply(node, lambda t, _i=i: ColumnTable(
+                {"x": t["x"] * 1.000001 + _i * 0.0}, t.dicts, t.valid),
+                label=f"spine{i}")
+        if shape == "spine":
+            return WriteSet(node, "ab", "mapper_out")
+        z = Apply(node, lambda t: jnp.sum(t["x"]) * 0.0, label="zsum")
+        q06 = rdag.q06_sink("ab")
+        j = Join(q06.inputs[0], z, fn=lambda rev, v: ColumnTable(
+            {"revenue": rev["revenue"] + v}, rev.dicts, rev.valid),
+            label="combine")
+        return WriteSet(j, "ab", "mapper_out")
+
+    def one_round(arm):
+        root = tempfile.mkdtemp(prefix="ab_mapper_")
+        try:
+            cfg = Configuration(root_dir=root,
+                                compilation_cache_dir=cache_dir,
+                                fusion_cost_source="static")
+            cfg.fusion_mapper = str(arm.specs["fusion_mapper"])
+            client = Client(cfg)
+            client.create_database("ab")
+            client.create_set("ab", "lineitem", type_name="table",
+                              storage="paged")
+            client.send_table("ab", "lineitem", ColumnTable(li, {}))
+            client.create_set("ab", "dim", type_name="table")
+            client.send_table("ab", "dim", ColumnTable(dim, {}))
+
+            def one():
+                out = client.execute_computations(build_sink(),
+                                                  job_name=job)
+                v = next(iter(out.values()))
+                leaf = v["revenue"] if shape != "spine" else v["x"]
+                jax.block_until_ready(leaf)
+
+            one()  # warm
+            elapsed = float("inf")
+            for _ in range(reps):
+                t0 = time.perf_counter()
+                one()
+                elapsed = min(elapsed, time.perf_counter() - t0)
+            return elapsed
+        finally:
+            shutil.rmtree(root, ignore_errors=True)
+
+    for cand in cands:  # warm both arms' programs, unrecorded
+        one_round(cand)
+    chosen = []
+    for _ in range(rounds):
+        cand = advisor.choose(job)
+        elapsed = one_round(cand)
+        advisor.record(job, cand, elapsed)
+        chosen.append((cand.label, round(elapsed, 4)))
+    means = {c.label: hdb.mean_elapsed(job, c.label) for c in cands}
+    winner = advisor.choose(job).label
+    vals = {k: v for k, v in means.items() if v is not None}
+    worst = max(vals.values()) if vals else None
+    best = min(vals.values()) if vals else None
+    return {"shape": shape, "rounds": chosen, "mean_s": means,
+            "winner": winner,
+            "learned_speedup": round(worst / best, 2) if best else None}
+
+
 def bench_distribution_ab(scale: int = 16, rounds: int = 4,
                           history_path: str = ":memory:",
                           seed: int = 0,

@@ -56,6 +56,23 @@ def fusion_candidates() -> tuple:
     )
 
 
+def mapper_candidates() -> tuple:
+    """The fusion MAPPER choice (optimal DP vs PR 10's greedy) as
+    advisor arms (``arm.specs["fusion_mapper"]`` applied to the
+    executing client's ``config.fusion_mapper`` by
+    :func:`~netsdb_tpu.learning.ab_bench.bench_mapper_ab`).  The DP is
+    exact under its cost model, but the cost model is learned — so
+    whether its splits actually beat greedy whole-run fusion on a
+    given plan SHAPE is a measured decision, recorded per job the same
+    way placements are."""
+    return (
+        PlacementCandidate("mapper_optimal", (1,),
+                           {"fusion_mapper": "optimal"}),
+        PlacementCandidate("mapper_greedy", (1,),
+                           {"fusion_mapper": "greedy"}),
+    )
+
+
 class PlacementAdvisor:
     def __init__(self, candidates: Sequence[PlacementCandidate],
                  db: Optional[HistoryDB] = None,

@@ -230,6 +230,15 @@ def _catalog() -> Dict[str, Tuple[str, str]]:
                              "ran per-node instead"),
         ("fusion.cost_estimates", "per-node cost-model estimates "
                                   "computed by the fusion mapper"),
+        ("fusion.splits", "fusion regions split at their cheapest "
+                          "edge because the single-region staged-"
+                          "bytes estimate exceeded "
+                          "fusion_stage_budget_bytes"),
+        ("fusion.distributed_regions", "fusion regions compiled "
+                                       "across the scatter boundary "
+                                       "(per-shard partial-fold "
+                                       "programs + coordinator "
+                                       "merge+finalize programs)"),
         ("slo.breaches", "SLO objective breach transitions"),
         ("slo.recoveries", "SLO objective recovery transitions"),
         ("analysis.violations", "runtime lock-order cycles detected "

@@ -301,7 +301,8 @@ class OperatorLedger:
     #: the per-node numeric fields worth aggregating across queries
     FIELDS = ("wall_s", "device_est_s")
     COUNTER_FIELDS = ("chunks", "blocks", "traces", "devcache.hits",
-                      "devcache.misses", "stage.wait_s", "stage.bytes")
+                      "devcache.misses", "stage.wait_s", "stage.bytes",
+                      "bytes_in")
 
     def __init__(self, max_keys: int = 2048):
         self._mu = TrackedLock("OperatorLedger._mu")
@@ -506,3 +507,21 @@ def render_tree(tree: Dict[str, Any],
     for r in roots:
         walk(r, 0, seen)
     return "\n".join(lines)
+
+
+def render_shard_forest(shard_ops: Optional[Dict[str, Any]],
+                        total_s: Optional[float] = None) -> str:
+    """Text rendering of a scatter-gather query's per-shard EXPLAIN
+    forest (``shard_operators``): each member's subplan tree rendered
+    by the SAME :func:`render_tree` the coordinator tree gets — so
+    region ids, ``┆rN`` boundary markers and the ``*`` streaming-
+    anchor annotation are shape-identical across the distributed tree.
+    Members sort by address for deterministic output under one qid."""
+    if not shard_ops:
+        return "(no shard operator forest)"
+    parts = []
+    for addr in sorted(shard_ops):
+        tree = shard_ops[addr] or {}
+        parts.append(f"-- shard {addr}")
+        parts.append(render_tree(tree, total_s))
+    return "\n".join(parts)

@@ -87,6 +87,16 @@ def compile_stats() -> Dict[str, Any]:
 obs.REGISTRY.register_collector("compile", compile_stats)
 
 
+def compiled_cache_keys() -> List[str]:
+    """Snapshot of the compiled-program cache's keys (LRU order). The
+    rollback-parity tests pin that ``plan_fusion=off`` and
+    ``fusion_mapper="greedy"`` produce byte-for-byte the same key sets
+    (``fold::``/``eager::``/``region::``) as the paths they roll back
+    to — a key drift here is a silent recompile in production."""
+    with _cache_lock:
+        return list(_compiled_cache)
+
+
 def _cached_jit(key: str, fn, donate_argnums: tuple = (),
                 region: Optional[str] = None) -> Any:
     """compiled-cache get-or-insert with the ONE LRU discipline (all

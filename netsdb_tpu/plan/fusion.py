@@ -45,6 +45,31 @@ observable: ``fusion.regions_formed`` / ``fusion.nodes_fused`` /
 in docs/METRICS.md), per-node ``region`` ids in the EXPLAIN ANALYZE
 tree, and per-region trace counters in ``executor.compile_stats()``.
 
+Two mappers share the region machinery (``config.fusion_mapper``):
+
+* ``"optimal"`` (default) — each maximal fusable run is partitioned
+  EXACTLY by DP over its region lattice (runs are topo-contiguous and
+  convex, so the lattice is the contiguous segmentations and the DP
+  is exact, not a heuristic).  The cost model additionally learns
+  per-label **staged bytes** from the ledger, so a run whose
+  single-region staging estimate exceeds
+  ``fusion_stage_budget_bytes`` SPLITS at its cheapest admissible
+  edges (``fusion.splits``) instead of abandoning the run per-node.
+* ``"greedy"`` — the PR 10 flush-the-whole-run mapper, byte-for-byte
+  (same region ids, fingerprints, jit keys and counters): the
+  rollback arm the A/B advisor compares against
+  (:func:`~netsdb_tpu.learning.advisor.mapper_candidates`).
+
+The mapper also owns the **scatter boundary**: a shard-side partial
+fold (``scatter_partial`` sinks shipped by plan/scatter.py) forms a
+region even when it has nothing local to graft — the shard's one
+compiled program — and :func:`compile_scatter_merge` compiles the
+coordinator's merge+finalize seam as ONE program through the same
+``_cached_jit`` discipline (the only sanctioned route: the
+``scatter-jit-route`` lint rule bans direct program construction for
+scatter subplans anywhere else).  Both tick
+``fusion.distributed_regions``.
+
 ``config.plan_fusion=False`` disables the mapper entirely — the
 executor then takes byte-for-byte the per-node paths (same jit-cache
 keys, same trace counts, same EXPLAIN shape), so the knob is a safe
@@ -194,6 +219,10 @@ STATIC_DISPATCH_S = 50e-6
 #: its nodes OUT of regions: chronic retracing would recompile the
 #: whole fused program instead of one operator
 RETRACE_RATE_CAP = 1.5
+#: static per-node staged-bytes estimate for labels the ledger has
+#: never seen — conservative enough that budget pressure with a cold
+#: ledger still splits a long run rather than over-packing HBM/pin
+STATIC_STAGED_BYTES = 4 * 1024 * 1024
 
 
 class CostModel:
@@ -239,6 +268,20 @@ class CostModel:
         if row and row.get("count"):
             return row.get("traces", 0.0) / row["count"]
         return 0.0
+
+    def staged_bytes(self, node: Computation) -> float:
+        """Mean bytes one execution of this node's label holds on
+        device: the ledger's per-label ``stage.bytes`` (streamed
+        chunk uploads) plus ``bytes_in`` (resident input surface),
+        per execution.  Cold labels fall back to the static per-node
+        estimate, mirroring :meth:`dispatch_overhead_s`."""
+        row = self._row(node)
+        if row and row.get("count"):
+            b = (row.get("stage.bytes", 0.0)
+                 + row.get("bytes_in", 0.0)) / row["count"]
+            if b > 0:
+                return b
+        return float(STATIC_STAGED_BYTES)
 
     def region_profitable(self, nodes: Sequence[Computation]) -> bool:
         """Fuse when the summed dispatch saving is positive and no
@@ -334,6 +377,8 @@ def map_regions(plan: LogicalPlan, scan_values: Dict[int, Any],
         traceable = lambda n: getattr(n, "traceable", True)  # noqa: E731
     min_region = max(2, int(getattr(config, "fusion_min_region", 2)))
     source = getattr(config, "fusion_cost_source", "ledger")
+    mapper = getattr(config, "fusion_mapper", "optimal")
+    budget = int(getattr(config, "fusion_stage_budget_bytes", 0) or 0)
     cost = CostModel(job_name, source=source)
     if consumers is None:
         consumers = plan.consumers()
@@ -404,6 +449,22 @@ def map_regions(plan: LogicalPlan, scan_values: Dict[int, Any],
             post.append(nxt)
             cur_id = nxt.node_id
         if not pre and not post:
+            if mapper == "optimal" and getattr(node, "scatter_partial",
+                                               False):
+                # a shard-side scatter partial fold with nothing local
+                # to graft still IS the shard's one compiled program —
+                # form the anchor-only region so the distributed
+                # EXPLAIN forest carries the same region ids/boundary
+                # markers the coordinator tree gets. Greedy skips it:
+                # the PR 10 map stays byte-for-byte.
+                ids = (node.node_id,)
+                regions.append(Region(
+                    rid, "graft", ids, _fingerprint(plan, ids),
+                    anchor=node.node_id))
+                graft_covered.update(ids)
+                rid += 1
+                obs.REGISTRY.counter(
+                    "fusion.distributed_regions").inc()
             continue
         members = pre + [node] + post
         if not cost.region_profitable(members):
@@ -417,6 +478,10 @@ def map_regions(plan: LogicalPlan, scan_values: Dict[int, Any],
             stream_src=stream_src))
         graft_covered.update(ids)
         rid += 1
+        if getattr(node, "scatter_partial", False):
+            # the shard's partial fold + its grafted pre/post chain:
+            # one per-shard program spanning the scatter boundary
+            obs.REGISTRY.counter("fusion.distributed_regions").inc()
 
     # --- spine regions over the remainder: maximal topo-contiguous
     # traceable resident runs ---------------------------------------
@@ -440,8 +505,18 @@ def map_regions(plan: LogicalPlan, scan_values: Dict[int, Any],
 
     def flush_run():
         nonlocal rid
-        if len(run) >= min_region and cost.region_profitable(run):
-            ids = tuple(n.node_id for n in run)
+        if mapper == "greedy":
+            # PR 10 mapper, byte-for-byte: fuse the whole run or
+            # nothing (the rollback/A-B arm)
+            if len(run) >= min_region and cost.region_profitable(run):
+                ids = tuple(n.node_id for n in run)
+                regions.append(Region(rid, "spine", ids,
+                                      _fingerprint(plan, ids)))
+                rid += 1
+            run.clear()
+            return
+        for seg in _optimal_segments(run, cost, min_region, budget):
+            ids = tuple(n.node_id for n in seg)
             regions.append(Region(rid, "spine", ids,
                                   _fingerprint(plan, ids)))
             rid += 1
@@ -459,6 +534,113 @@ def map_regions(plan: LogicalPlan, scan_values: Dict[int, Any],
         obs.REGISTRY.counter("fusion.nodes_fused").inc(
             sum(len(r.node_ids) for r in regions))
     return RegionMap(regions)
+
+
+def _optimal_segments(run: List[Computation], cost: CostModel,
+                      min_region: int,
+                      budget: int) -> List[List[Computation]]:
+    """Exact minimum-cost partition of ONE maximal fusable run into
+    fused segments (the ``fusion_mapper="optimal"`` spine planner).
+
+    The runs the mapper accumulates are topo-contiguous and convex, so
+    the region lattice over a run is exactly its set of contiguous
+    segmentations — and minimum-cost segmentation is solved EXACTLY by
+    an O(n²) DP, not a heuristic: state ``i`` is the best plan for the
+    run's first ``i`` nodes; a node either stays per-node (cost: its
+    measured dispatch overhead) or closes a fused segment (cost: ONE
+    dispatch).  A segment is admissible when it meets the min-region
+    floor, is profitable, contains no chronic retracer, and its
+    staged-bytes estimate fits ``fusion_stage_budget_bytes``.  Ties on
+    modeled cost break toward more fused nodes, then fewer segments —
+    with no budget pressure an admissible run therefore fuses WHOLE,
+    reproducing the greedy mapper's regions (and jit keys) exactly.
+    Under pressure the DP splits at the cheapest admissible edges
+    instead of abandoning the run per-node; ``fusion.splits`` counts
+    the extra seams of runs that were fusable whole but for the
+    budget."""
+    n = len(run)
+    if n == 0:
+        return []
+    over = [cost.dispatch_overhead_s(x) for x in run]
+    veto = [cost.retrace_rate(x) > RETRACE_RATE_CAP for x in run]
+    staged = [cost.staged_bytes(x) for x in run]
+    p_over, p_staged, p_veto = [0.0], [0.0], [0]
+    for i in range(n):
+        p_over.append(p_over[-1] + over[i])
+        p_staged.append(p_staged[-1] + staged[i])
+        p_veto.append(p_veto[-1] + (1 if veto[i] else 0))
+
+    def admissible(j: int, i: int) -> bool:
+        """run[j:i] as one fused segment?"""
+        if i - j < min_region or p_veto[i] - p_veto[j]:
+            return False
+        if p_over[i] - p_over[j] <= STATIC_DISPATCH_S:
+            return False  # fusing must beat the one kept dispatch
+        return not budget or p_staged[i] - p_staged[j] <= budget
+
+    # best[i] = (cost, -nodes_fused, segments) for run[:i]
+    best: List[Tuple[float, int, List[Tuple[int, int]]]] = [(0.0, 0, [])]
+    for i in range(1, n + 1):
+        c, f, segs = best[i - 1]
+        cand = (c + over[i - 1], f, segs)  # run[i-1] stays per-node
+        for j in range(i - min_region, -1, -1):
+            if not admissible(j, i):
+                continue
+            cj, fj, sj = best[j]
+            t = (cj + STATIC_DISPATCH_S, fj - (i - j), sj + [(j, i)])
+            if (t[0], t[1], len(t[2])) < (cand[0], cand[1],
+                                          len(cand[2])):
+                cand = t
+        best.append(cand)
+    chosen = best[n][2]
+    if budget and len(chosen) > 1 and not p_veto[n] \
+            and n >= min_region and p_over[n] > STATIC_DISPATCH_S \
+            and p_staged[n] > budget:
+        # the run was fusable whole but for the byte budget: it SPLIT
+        # at the cheapest edges instead of falling back per-node
+        obs.REGISTRY.counter("fusion.splits").inc(len(chosen) - 1)
+    return [run[j:i] for j, i in chosen]
+
+
+# ------------------------------------------------------------------
+# the scatter boundary (used by plan/scatter.py + serve/shard.py)
+# ------------------------------------------------------------------
+
+def compile_scatter_merge(fold, nslots: int, src, job_name: str,
+                          label: str) -> Callable:
+    """ONE compiled program for a scatter-gather fold_state
+    coordinator: the left-fold of the N shards' partial states through
+    ``fold.state_merge`` AND ``fold.finalize`` over the merged state —
+    the merge+finalize seam that used to dispatch eagerly per shard
+    compiles across the scatter boundary.
+
+    ``src`` (the coordinator's SchemaProxy) is closed over as trace
+    constants — ``finalize`` may only read ``src.dicts``/
+    ``src.num_rows`` (the FoldSpec contract), so the jit key carries a
+    structural digest of exactly that surface: a changed dict table or
+    row count re-traces rather than serving stale constants.  This is
+    the ONLY sanctioned ``_cached_jit`` route for scatter programs
+    outside the region executor (the ``scatter-jit-route`` lint rule
+    enforces it); callers fall back to the eager merge via
+    :func:`fallback` when states or the fold are not jit-safe."""
+    from netsdb_tpu.plan import executor as _executor
+
+    dicts = getattr(src, "dicts", None) or {}
+    src_fp = hashlib.blake2s(repr(
+        (sorted((k, tuple(v)) for k, v in dicts.items()),
+         int(getattr(src, "num_rows", 0) or 0))).encode()
+    ).hexdigest()[:12]
+    key = (f"region::{job_name}::scatter::{label}::merge"
+           f"::k{int(nslots)}::{src_fp}")
+
+    def merge_finalize(states):
+        merged = states[0]
+        for s in states[1:]:
+            merged = fold.state_merge(merged, s)
+        return fold.finalize(merged, src)
+
+    obs.REGISTRY.counter("fusion.distributed_regions").inc()
+    return _executor._cached_jit(key, merge_finalize)
 
 
 # ------------------------------------------------------------------

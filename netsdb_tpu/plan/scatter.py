@@ -89,6 +89,24 @@ class ScatterSpec:
     gather: Optional[dict] = None
 
 
+@dataclasses.dataclass
+class MultiScatterSpec:
+    """A dashboard-style fan of N ``fold_state`` sinks over ONE shared
+    sharded scan set (the PR 13 multi-sink carry-over): the pool ships
+    ONE subplan per shard whose combined tuple-state fold runs every
+    component's (grafted pre-chain + step) over each streamed chunk in
+    one compiled program, and the coordinator splits the tuple and
+    merges+finalizes every component — also as one program
+    (:func:`merge_fold_states_compiled`).  Byte-equal to running the
+    sinks separately: each component's math is unchanged, only the
+    dispatch seams fuse."""
+
+    kind: str  # "multi_fold"
+    components: Tuple[ScatterSpec, ...]
+    #: the ONE sharded (db, set) every component scans
+    scan_sets: Tuple[Tuple[str, str], ...]
+
+
 #: node types that are row-decomposable over object/table partitions —
 #: a chain of these between the sharded scan and the aggregating node
 #: ships to the shards unchanged
@@ -172,7 +190,7 @@ def analyze_sinks(sinks, is_sharded: Callable[[str, str], bool]
     if not touched:
         return None
     if len(sinks) != 1:
-        return None
+        return analyze_multi_sinks(sinks, is_sharded, touched)
     sink = sinks[0]
     if not isinstance(sink, WriteSet):
         return None
@@ -226,6 +244,54 @@ def analyze_sinks(sinks, is_sharded: Callable[[str, str], bool]
     return None
 
 
+def _bakeable_prechain(node: Computation) -> Optional[List[Apply]]:
+    """The rowwise Apply chain between a fold node's stream input and
+    its scan leaf, scan→fold order — the shape the combined multi-sink
+    fold can bake into its chunk steps (exactly what the fusion mapper
+    grafts: Filter/MultiApply chains cannot bake, their evaluation is
+    not a chunk→chunk callable). None when anything else sits on the
+    chain; ``[]`` when the input IS the scan."""
+    chain: List[Apply] = []
+    cur = node
+    while not isinstance(cur, ScanSet):
+        if not (isinstance(cur, Apply)
+                and getattr(cur, "rowwise", False)
+                and cur.fn is not None
+                and getattr(cur, "traceable", True)
+                and cur.fold is None and len(cur.inputs) == 1):
+            return None
+        chain.append(cur)
+        cur = cur.inputs[0]
+    chain.reverse()
+    return chain
+
+
+def analyze_multi_sinks(sinks, is_sharded: Callable[[str, str], bool],
+                        touched: List[Tuple[str, str]]
+                        ) -> Optional[MultiScatterSpec]:
+    """The multi-sink decomposition: every sink must independently be
+    a pushable ``fold_state`` over the SAME single sharded set, with a
+    pre-chain the combined fold can bake into its steps. None
+    otherwise — callers keep the typed refusal a lone unpushable shape
+    already gets (a partitioned set's pages live only on its
+    shards)."""
+    if len(sinks) < 2 or len(touched) != 1:
+        return None
+    comps: List[ScatterSpec] = []
+    for s in sinks:
+        spec = analyze_sinks([s], is_sharded)
+        if spec is None or spec.kind != "fold_state" \
+                or spec.scan_sets != tuple(touched) \
+                or len(spec.node.inputs) != 1 \
+                or spec.fold.probe_key is not None \
+                or spec.fold.build_key is not None \
+                or _bakeable_prechain(spec.node.inputs[0]) is None:
+            return None
+        comps.append(spec)
+    return MultiScatterSpec(kind="multi_fold", components=tuple(comps),
+                            scan_sets=tuple(touched))
+
+
 # --- shard-side sink construction ------------------------------------
 
 def _state_finalize(state, src, *resident):
@@ -276,10 +342,69 @@ def partial_sink(spec: ScatterSpec) -> WriteSet:
                     traceable=node.traceable)
     partial.node_id = _max_node_id(node.inputs[0]) + 1
     partial.output_name = f"{partial.op_kind}_{partial.node_id}"
+    # the marker the fusion mapper keys distributed regions on: a
+    # scatter partial fold IS the shard's one compiled program, so the
+    # optimal mapper forms its region even with nothing local to graft
+    partial.scatter_partial = True
     sink = WriteSet(partial, spec.sink.db, "__scatter_partial__")
     sink.node_id = partial.node_id + 1
     sink.output_name = f"{sink.op_kind}_{sink.node_id}"
     return sink
+
+
+def _combined_fold(comps: Tuple[ScatterSpec, ...]) -> FoldSpec:
+    """ONE FoldSpec whose state is the tuple of every component's
+    state: each streamed chunk runs every component's (baked pre-chain
+    + step) inside one compiled step, ``state_merge`` is
+    componentwise, finalize returns the tuple itself (the multi
+    partial the coordinator splits)."""
+    from netsdb_tpu.plan import fusion as _fusion
+
+    wrapped = []
+    for c in comps:
+        chain = _bakeable_prechain(c.node.inputs[0]) or []
+        f = c.fold
+        if chain:
+            f = _fusion.wrap_fold_prechain(f, [a.fn for a in chain])
+        wrapped.append(f)
+    folds = tuple(wrapped)
+
+    def init(prev, src, *resident):
+        del prev
+        return tuple(f.passes[0][0](None, src, *resident)
+                     for f in folds)
+
+    def step(state, chunk, *resident):
+        return tuple(f.passes[0][1](state[i], chunk, *resident)
+                     for i, f in enumerate(folds))
+
+    def state_merge(a, b):
+        return tuple(c.fold.state_merge(a[i], b[i])
+                     for i, c in enumerate(comps))
+
+    return FoldSpec(((init, step),), _state_finalize,
+                    state_merge=state_merge)
+
+
+def multi_partial_sink(mspec: MultiScatterSpec) -> WriteSet:
+    """The ONE sink a shard executes for a ``multi_fold`` spec:
+    ``Scan(shared set) → Apply(combined tuple-state fold) → partial
+    write`` — fresh coordinator-minted nodes throughout (no client ids
+    to collide with).  The combined label keys the shard's compiled
+    step apart from every component's own jit entries, so a fan and
+    its separately-run components never alias cache entries."""
+    db, set_name = mspec.scan_sets[0]
+    scan = ScanSet(db, set_name)
+    label = "multi::" + "+".join(
+        (getattr(c.node, "label", "") or c.node.op_kind)
+        for c in mspec.components) + "::partial"
+    partial = Apply(scan, fold=_combined_fold(mspec.components),
+                    label=label,
+                    traceable=all(getattr(c.node, "traceable", True)
+                                  for c in mspec.components))
+    partial.scatter_partial = True
+    return WriteSet(partial, mspec.components[0].sink.db,
+                    "__scatter_partial__")
 
 
 # --- coordinator-side merges -----------------------------------------
@@ -301,6 +426,56 @@ def merge_fold_states(fold: FoldSpec, states: List[Any],
     """Left-fold the per-slot states in slot order, then finalize over
     the schema proxy — ONE canonical merge order, so repeated runs
     are bit-identical to each other."""
+    merged = states[0]
+    for s in states[1:]:
+        merged = fold.state_merge(merged, s)
+    return fold.finalize(merged, SchemaProxy(dicts, num_rows))
+
+
+class MultiFoldMerge:
+    """The merge/finalize surface of a ``multi_fold`` coordinator: the
+    shards' tuple states merge componentwise and each component's own
+    ``finalize`` runs over the shared schema proxy, yielding the tuple
+    of per-sink results in sink order.  Duck-types FoldSpec's
+    state_merge/finalize so both merge paths (compiled and eager)
+    treat a fan exactly like a single fold."""
+
+    def __init__(self, components: Tuple[ScatterSpec, ...]):
+        self.components = tuple(components)
+        self.state_merge = self._state_merge  # FoldSpec surface
+
+    def _state_merge(self, a, b):
+        return tuple(c.fold.state_merge(a[i], b[i])
+                     for i, c in enumerate(self.components))
+
+    def finalize(self, merged, src):
+        return tuple(c.fold.finalize(merged[i], src)
+                     for i, c in enumerate(self.components))
+
+
+def merge_fold_states_compiled(fold, states: List[Any],
+                               dicts: Dict[str, list], num_rows: int,
+                               job_name: str, label: str,
+                               traceable: bool = True) -> Any:
+    """:func:`merge_fold_states` through ONE compiled program
+    (``fusion.compile_scatter_merge``) when the fold and the shards'
+    states are jit-safe; the eager left-fold otherwise — a counted
+    fallback (``fusion.fallbacks``), never an error.  Both paths share
+    the same canonical slot-order left fold, so results are
+    bit-identical either way."""
+    from netsdb_tpu.plan import executor as _executor
+    from netsdb_tpu.plan import fusion
+
+    if traceable and getattr(fold, "state_merge", None) is not None \
+            and _executor._jit_safe_values(states):
+        try:
+            prog = fusion.compile_scatter_merge(
+                fold, len(states), SchemaProxy(dicts, num_rows),
+                job_name, label)
+            return prog(tuple(states))
+        except Exception as e:  # noqa: BLE001 — counted fallback
+            fusion.fallback("scatter merge+finalize fell back eager: "
+                            f"{type(e).__name__}: {e}")
     merged = states[0]
     for s in states[1:]:
         merged = fold.state_merge(merged, s)

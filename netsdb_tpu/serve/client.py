@@ -1892,7 +1892,14 @@ class RemoteClient:
             codec=CODEC_PICKLE)
         results = self._collect_results(reply["results"], fetch_results)
         if explain:
-            return results, reply.get("operators")
+            tree = reply.get("operators")
+            if reply.get("shard_operators") and isinstance(tree, dict):
+                # scatter queries: the per-shard region forest rides
+                # the coordinator tree (render with
+                # obs.operators.render_shard_forest)
+                tree = dict(tree,
+                            shard_operators=reply["shard_operators"])
+            return results, tree
         return results
 
     def execute_plan(self, plan_text: str, registry: Dict[str, Any],

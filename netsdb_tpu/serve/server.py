@@ -3346,6 +3346,12 @@ class ServeController:
         with the daemon that executed its region."""
         explain = bool(p.get("explain"))
         tr = obs.current_trace()
+        # mirror the local path's default: a traced query records its
+        # operator tree when obs_explain is on, explicit explain or
+        # not — so GET_TRACE shows the distributed region forest for
+        # every traced scatter query, not only EXPLAIN requests
+        collect = explain or (tr is not None and getattr(
+            self.config, "obs_explain", True))
         qid = tr.qid if tr is not None else None
         client = obs.attrib.current_client()
         holder: Dict[str, Any] = {}
@@ -3354,7 +3360,7 @@ class ServeController:
             results, shard_ops = self.shards.scatter_execute(
                 sinks, job_name,
                 materialize=p.get("materialize", True),
-                explain=explain, qid=qid, client_id=client)
+                explain=collect, qid=qid, client_id=client)
             if p.get("sync", True):
                 self._sync_results(results)
             holder["ops"] = shard_ops
@@ -3364,12 +3370,17 @@ class ServeController:
                                      {"sinks": sinks})
         results = self._run_job(job_name, run, scopes=scopes)
         out: Dict[str, Any] = {"results": self._result_summaries(results)}
+        ops = holder.get("ops") or {}
         if explain:
-            ops = holder.get("ops") or {}
             local = ops.get(self.advertise_addr)
             if local is not None:
                 out["operators"] = local
             out["shard_operators"] = ops
+        if collect and tr is not None and ops:
+            # the distributed region forest rides the query's own
+            # trace — GET_TRACE shows coordinator regions AND every
+            # shard's region forest under ONE qid
+            tr.attach_section("shard_operators", ops)
         return MsgType.OK, out
 
     def _execute_with_explain(self, p, job_name, run, scopes=()):

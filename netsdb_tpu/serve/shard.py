@@ -217,7 +217,12 @@ def check_epochs(ctl, epochs: Dict[str, int]) -> None:
 def execute_subplan(ctl, p: dict) -> dict:
     """One shard's leg of a scatter-gather execution (also run
     in-process by the coordinator for its own slot). Returns the
-    bounded partial the coordinator merges."""
+    bounded partial the coordinator merges, plus the leg's compiled-
+    program delta (``compile_stats`` misses/traces across the run —
+    the distributed-compilation proof the one-program tests pin;
+    process-global, so only meaningful on a quiesced daemon)."""
+    from netsdb_tpu.plan import executor as _executor
+
     obs.REGISTRY.counter("shard.subplans").inc()
     check_epochs(ctl, p.get("epochs"))
     kind = p["kind"]
@@ -231,6 +236,7 @@ def execute_subplan(ctl, p: dict) -> dict:
             materialize=False)
         return next(iter(results.values()))
 
+    before = _executor.compile_stats()
     with obs.span("server.shard.subplan", "serve"):
         if explain:
             with obs.operators.explain_capture() as cap:
@@ -239,8 +245,14 @@ def execute_subplan(ctl, p: dict) -> dict:
         else:
             value = run()
             tree = None
-    out: Dict[str, Any] = {}
-    if kind == "fold_state":
+    after = _executor.compile_stats()
+    out: Dict[str, Any] = {
+        "compile": {
+            "programs": after["misses"] - before["misses"],
+            "traces": after["traces"] - before["traces"],
+        },
+    }
+    if kind in ("fold_state", "multi_fold"):
         db, set_name = p["scan"]
         dicts, rows = local_schema(ctl, db, set_name)
         out.update(state=_np_tree(value), dicts=dicts, rows=rows)
@@ -414,10 +426,15 @@ def materialize_result(store, ident, out) -> None:
 
 def _annotate_shard(tree: Any, addr: str) -> Any:
     """Mark every node of one shard's EXPLAIN tree with the daemon
-    that executed it (the pushed-region annotation)."""
+    that executed it (the pushed-region annotation). Operator trees
+    carry their nodes as a flat ``nodes`` list (obs/operators.py), so
+    that list is what gets stamped — recursing only into ``children``
+    keys used to stamp nothing but the root."""
     if isinstance(tree, dict):
-        out = {k: _annotate_shard(v, addr) if k == "children" else v
-               for k, v in tree.items()}
+        out = dict(tree)
+        if isinstance(out.get("nodes"), list):
+            out["nodes"] = [dict(n, shard=addr) if isinstance(n, dict)
+                            else n for n in out["nodes"]]
         out["shard"] = addr
         return out
     if isinstance(tree, list):
@@ -855,6 +872,12 @@ class ShardPool:
                 build=list(spec.build), fold=spec.fold,
                 shuffle_timeout_s=min(
                     ctl.mirror_ack_timeout_s or 120.0, 120.0))
+        elif spec.kind == "multi_fold":
+            # the fan ships as ONE subplan per shard: a single scan,
+            # one combined tuple-state fold, one partial sink
+            payload["sinks"] = [scatter.multi_partial_sink(spec)]
+            payload["scan"] = [spec.scan_sets[0][0],
+                               spec.scan_sets[0][1]]
         else:
             psink = scatter.partial_sink(spec)
             payload["sinks"] = [psink]
@@ -918,7 +941,7 @@ class ShardPool:
         if failures:
             self._raise_scatter_failure(spec, entries, failures)
         return self._merge(spec, entries, addrs, replies, materialize,
-                           explain)
+                           explain, job_name)
 
     def _raise_scatter_failure(self, spec, entries, failures) -> None:
         """ALL partials are discarded; unreachable shards evict
@@ -965,7 +988,7 @@ class ShardPool:
             + "; ".join(parts))
 
     def _merge(self, spec, entries, addrs, replies, materialize,
-               explain):
+               explain, job_name="scatter"):
         from netsdb_tpu.plan import scatter
         from netsdb_tpu.storage.store import SetIdentifier
 
@@ -976,7 +999,7 @@ class ShardPool:
                 addrs[i]: _annotate_shard(r["operators"], addrs[i])
                 for i, r in enumerate(replies)
                 if r and r.get("operators") is not None}
-        if spec.kind == "fold_state":
+        if spec.kind in ("fold_state", "multi_fold"):
             states = [r["state"] for r in replies]
             dicts: Dict[str, list] = {}
             rows = 0
@@ -992,8 +1015,31 @@ class ShardPool:
                             f"with aligned dictionaries")
                     dicts.setdefault(k, v)
                 rows += int(r.get("rows") or 0)
-            value = scatter.merge_fold_states(spec.fold, states, dicts,
-                                              rows)
+            if spec.kind == "multi_fold":
+                fold = scatter.MultiFoldMerge(spec.components)
+                label = "multi::" + "+".join(
+                    (getattr(c.node, "label", "") or c.node.op_kind)
+                    for c in spec.components)
+                traceable = all(getattr(c.node, "traceable", True)
+                                for c in spec.components)
+            else:
+                fold = spec.fold
+                label = getattr(spec.node, "label", "") \
+                    or spec.node.op_kind
+                traceable = bool(getattr(spec.node, "traceable", True))
+            cfg = getattr(self.ctl, "config", None)
+            if getattr(cfg, "plan_fusion", True) and \
+                    getattr(cfg, "fusion_mapper", "optimal") \
+                    == "optimal":
+                # the coordinator's merge + finalize as ONE compiled
+                # program — plan_fusion=off and greedy keep the eager
+                # per-shard merge byte-for-byte (the rollback arms)
+                value = scatter.merge_fold_states_compiled(
+                    fold, states, dicts, rows, job_name, label,
+                    traceable=traceable)
+            else:
+                value = scatter.merge_fold_states(fold, states, dicts,
+                                                  rows)
         elif spec.kind == "group_partial":
             value = scatter.merge_group_dicts(
                 spec.node, [r["groups"] for r in replies])
@@ -1008,6 +1054,16 @@ class ShardPool:
                     "distributed shuffle produced no partials (both "
                     "join sides empty on every shard)")
             value = scatter.merge_join_outputs(spec.fold, tables)
+        if spec.kind == "multi_fold":
+            # split the merged tuple back into per-sink results —
+            # sink order, exactly as running the components separately
+            results: Dict[Any, Any] = {}
+            for c, v in zip(spec.components, value):
+                ident = SetIdentifier(c.sink.db, c.sink.set_name)
+                if materialize:
+                    materialize_result(self.ctl.library.store, ident, v)
+                results[ident] = v
+            return results, shard_ops
         ident = SetIdentifier(spec.sink.db, spec.sink.set_name)
         if materialize:
             materialize_result(self.ctl.library.store, ident, value)

@@ -1317,6 +1317,186 @@ def run_failover_bench(batches: int = 24, rows_each: int = 2000,
     return out
 
 
+# --- distributed fusion A/B (--fusion-distributed) --------------------
+
+def run_fusion_distributed_bench(rows: int = 400_000, daemons: int = 4,
+                                 queries: int = 6) -> Dict[str, Any]:
+    """Paired mapper A/B over the distributed compilation path
+    (``--fusion-distributed``): the 4-daemon scatter q01 plus a
+    3-sink dashboard fan, run under three arms — ``optimal`` (the
+    region path: ONE compiled partial-fold program per shard, ONE
+    coordinator merge+finalize program, the fan shipped as one
+    multi-sink subplan per shard), ``greedy`` (``fusion_mapper=
+    greedy``: the pre-region scatter path) and ``off``
+    (``plan_fusion=False``). Arms share nothing but the workload:
+    each gets its own in-process pool, ingest and job names, so every
+    arm cold-compiles its own programs.
+
+    The headline ``plan_fusion_distributed_speedup`` is off-arm p50
+    round latency over optimal-arm p50 across the warm timed rounds
+    (each round = one q01 + one 3-cutoff fan), and is only trusted
+    when the
+    structural gates hold on THIS run: (1) the optimal arm's cold
+    q01 minted exactly one ``fold::`` key and one
+    ``region::…::merge`` key with ``shard.subplans`` advancing by
+    ``daemons``; (2) the fan ran as ONE scatter query with one
+    multi-sink subplan per daemon; (3) q01 rows and every fan sink
+    are byte-equal across all three arms. CPU-container caveat: all
+    daemons share one machine's cores and the q01 fold states are
+    small, so the paired delta is a lower bound on a pool whose
+    merge+finalize closes over real state width; the gates are
+    platform-independent."""
+    import tempfile
+
+    from netsdb_tpu import obs
+    from netsdb_tpu.config import Configuration
+    from netsdb_tpu.plan import executor
+    from netsdb_tpu.serve.client import RemoteClient
+    from netsdb_tpu.serve.server import ServeController
+
+    cuts = (19950101, 19970101, 19980902)
+
+    def counter(name: str) -> int:
+        return obs.REGISTRY.counter(name).value
+
+    def pool(tag: str, **cfg_extra):
+        cfg = dict({"page_size_bytes": 64 * 1024}, **cfg_extra)
+        ctls = []
+        for i in range(daemons - 1):
+            w = ServeController(Configuration(
+                root_dir=tempfile.mkdtemp(prefix=f"fzd_{tag}_w{i}_"),
+                **cfg), port=0)
+            w.start()
+            ctls.append(w)
+        leader = ServeController(Configuration(
+            root_dir=tempfile.mkdtemp(prefix=f"fzd_{tag}_l_"), **cfg),
+            port=0, workers=[f"127.0.0.1:{w.port}" for w in ctls])
+        leader.start()
+        return [leader] + ctls
+
+    table = scaleout_table(rows)
+
+    def run_arm(tag: str, **cfg_extra) -> Dict[str, Any]:
+        ctls = pool(tag, **cfg_extra)
+        try:
+            c = RemoteClient(f"127.0.0.1:{ctls[0].port}")
+            c.create_database("d")
+            c.create_set("d", "lineitem", type_name="table",
+                         storage="paged", placement="range")
+            c.send_table("d", "lineitem", table)
+
+            def fan_sinks(prefix: str):
+                return [scaleout_q01_sink(
+                    "d", cutoff=ct, output_set=f"{prefix}_{i}")
+                    for i, ct in enumerate(cuts)]
+
+            # cold round: compiles every program the warm rounds ride
+            keys0 = set(executor.compiled_cache_keys())
+            sp0 = counter("shard.subplans")
+            sq0 = counter("shard.scatter_queries")
+            c.execute_computations(scaleout_q01_sink("d"),
+                                   job_name=f"fzd-{tag}-q01",
+                                   fetch_results=False)
+            q01_new = set(executor.compiled_cache_keys()) - keys0
+            q01_subplans = counter("shard.subplans") - sp0
+            sp1 = counter("shard.subplans")
+            sq1 = counter("shard.scatter_queries")
+            c.execute_computations(*fan_sinks("fan"),
+                                   job_name=f"fzd-{tag}-fan",
+                                   fetch_results=False)
+            arm = {
+                "q01_fold_keys": sum(
+                    1 for k in q01_new if k.startswith("fold::")),
+                "q01_merge_keys": sum(
+                    1 for k in q01_new
+                    if k.startswith(f"region::fzd-{tag}-q01::scatter::")
+                    and f"::merge::k{daemons}::" in k),
+                "q01_other_keys": sum(
+                    1 for k in q01_new
+                    if not k.startswith(("fold::", "region::"))),
+                "q01_subplans": q01_subplans,
+                "fan_scatter_queries":
+                    counter("shard.scatter_queries") - sq1,
+                "fan_subplans": counter("shard.subplans") - sp1,
+                "q01_scatter_queries": sq1 - sq0,
+            }
+
+            # warm timed rounds: every program cached, so the paired
+            # delta isolates the dispatch path (region executor +
+            # compiled merge vs eager per-node + eager merge). Two
+            # untimed warm rounds first — the jit dispatch path keeps
+            # warming for a couple of calls after the cold compile,
+            # and timing those would charge warmup to the fused arm.
+            def round_once() -> float:
+                t0 = time.perf_counter()
+                c.execute_computations(scaleout_q01_sink("d"),
+                                       job_name=f"fzd-{tag}-q01",
+                                       fetch_results=False)
+                c.execute_computations(*fan_sinks("fan"),
+                                       job_name=f"fzd-{tag}-fan",
+                                       fetch_results=False)
+                return time.perf_counter() - t0
+
+            for _ in range(2):
+                round_once()
+            lat = sorted(round_once() for _ in range(queries))
+            arm["wall_s"] = round(sum(lat), 4)
+            arm["round_p50_s"] = round(lat[len(lat) // 2], 4)
+            arm["round_min_s"] = round(lat[0], 4)
+            arm["rounds_per_sec"] = round(queries / max(
+                arm["wall_s"], 1e-9), 2)
+            arm["q01_rows"] = _scale_rows(c, "d", "scale_q01_out")
+            arm["fan_rows"] = [_scale_rows(c, "d", f"fan_{i}")
+                               for i in range(len(cuts))]
+            c.close()
+            return arm
+        finally:
+            for d in ctls:
+                d.shutdown()
+
+    opt = run_arm("opt")
+    greedy = run_arm("greedy", fusion_mapper="greedy")
+    off = run_arm("off", plan_fusion=False)
+
+    rows_equal = bool(
+        opt["q01_rows"] == greedy["q01_rows"] == off["q01_rows"]
+        and opt["fan_rows"] == greedy["fan_rows"] == off["fan_rows"])
+    one_program = bool(
+        opt["q01_fold_keys"] == 1 and opt["q01_merge_keys"] == 1
+        and opt["q01_other_keys"] == 0
+        and opt["q01_subplans"] == daemons
+        and opt["q01_scatter_queries"] == 1)
+    fan_one_subplan = bool(opt["fan_scatter_queries"] == 1
+                           and opt["fan_subplans"] == daemons)
+    rollback_clean = bool(
+        greedy["q01_merge_keys"] == 0 and off["q01_merge_keys"] == 0)
+
+    def strip(arm):
+        return {k: v for k, v in arm.items()
+                if k not in ("q01_rows", "fan_rows")}
+
+    out: Dict[str, Any] = {
+        "rows": rows, "daemons": daemons, "queries": queries,
+        "optimal": strip(opt), "greedy": strip(greedy),
+        "off": strip(off),
+        "byte_equal": rows_equal,
+        "one_program_per_shard_plus_merge": one_program,
+        "fan_one_subplan_per_shard": fan_one_subplan,
+        "rollback_no_region_keys": rollback_clean,
+        "gates_ok": bool(rows_equal and one_program
+                         and fan_one_subplan and rollback_clean),
+    }
+    if opt["round_p50_s"] > 0:
+        # p50 of per-round latency, not total wall: one straggler
+        # round (GC, a page-cache miss) would otherwise decide a
+        # paired A/B whose honest signal is the typical round
+        out["plan_fusion_distributed_speedup"] = round(
+            off["round_p50_s"] / opt["round_p50_s"], 3)
+        out["speedup_vs_greedy"] = round(
+            greedy["round_p50_s"] / opt["round_p50_s"], 3)
+    return out
+
+
 def main(argv=None) -> int:
     import argparse
 
@@ -1364,6 +1544,11 @@ def main(argv=None) -> int:
                     help="failover-under-traffic: client-observed "
                          "p99 blip across a leader kill on an armed "
                          "HA pair, exact-totals gated")
+    ap.add_argument("--fusion-distributed", action="store_true",
+                    help="distributed fusion paired A/B: 4-daemon "
+                         "scatter q01 + 3-sink fan under the optimal "
+                         "mapper vs greedy vs plan_fusion=off, with "
+                         "one-program-per-shard + byte-equality gates")
     ap.add_argument("--daemons", type=int, default=4,
                     help="pool size for --scale (leader + N-1 shards)")
     ap.add_argument("--rows", type=int, default=6_000_000,
@@ -1377,6 +1562,8 @@ def main(argv=None) -> int:
         out = run_serving_bench(daemons=args.daemons)
     elif args.failover:
         out = run_failover_bench()
+    elif args.fusion_distributed:
+        out = run_fusion_distributed_bench(daemons=args.daemons)
     elif args.scale:
         out = run_scaleout_bench(rows=args.rows, daemons=args.daemons)
     elif args.scheduler:
