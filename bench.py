@@ -92,6 +92,7 @@ HEADLINE_METRICS = {"ff_inference_rows_per_sec_per_chip": "higher",
                     "plan_fusion_speedup": "higher",
                     "plan_fusion_distributed_speedup": "higher",
                     "serve_scaleout_throughput_x": "higher",
+                    "serve_rebalance_recovery_x": "higher",
                     "devcache_partial_speedup": "higher",
                     "summa_staging_reduction_x": "higher",
                     "reshard_collective_speedup": "higher",
@@ -443,6 +444,35 @@ def main():
             # not noise) omits the record rather than snapshotting it
             print(f"-- scale arm unusable; metric omitted: "
                   f"{json.dumps(sc)}", file=sys.stderr)
+    if "--rebalance" in sys.argv:
+        # self-rebalancing placement (serve_bench --rebalance): a
+        # 4-daemon pool under a live 80/20 skewed read mix registers
+        # a 5th daemon mid-run — rebalance-on (the forced campaign
+        # moves slot ownership under traffic) vs frozen. The headline
+        # is the recovery-window throughput ratio; it only records
+        # when the flagship gates hold: zero failed client requests
+        # in EITHER arm (typed retries absorbed inside the client),
+        # exact row/checksum totals post-campaign, and byte-equal
+        # results across arms. Same single-machine caveat as --scale.
+        from netsdb_tpu.workloads.serve_bench import run_rebalance_bench
+
+        rb = run_rebalance_bench()
+        if rb.get("serve_rebalance_recovery_x") \
+                and rb.get("zero_failed_requests") \
+                and rb.get("totals_exact") \
+                and rb.get("byte_equal"):
+            records.append({
+                "metric": "serve_rebalance_recovery_x",
+                "value": rb["serve_rebalance_recovery_x"],
+                "unit": "x (recovery-window routed QPS after a 5th "
+                        "daemon joins, rebalance on vs frozen)",
+                "detail": dict(rb),
+            })
+        else:
+            # a failed exactness gate is a BUG, not noise — omit the
+            # record rather than snapshotting it
+            print(f"-- rebalance arm unusable; metric omitted: "
+                  f"{json.dumps(rb)}", file=sys.stderr)
     if "--partial-cache" in sys.argv:
         # block-granular partial-run caching A/B (serve_bench
         # --partial-cache): warm re-query after a 1% append under

@@ -686,6 +686,8 @@ def _cmd_serve(args) -> int:
         overrides["page_pool_bytes"] = args.page_pool_mb << 20
     if getattr(args, "page_kb", None) is not None:
         overrides["page_size_bytes"] = args.page_kb << 10
+    if getattr(args, "rebalance", False):
+        overrides["rebalance"] = True
     config = Configuration(**overrides) if overrides else DEFAULT_CONFIG
     followers = ([a.strip() for a in args.followers.split(",") if a.strip()]
                  if getattr(args, "followers", None) else None)
@@ -953,6 +955,38 @@ def _print_sched(view) -> None:
               f"p99={h['p99'] * 1e3:.2f}ms max={h['max'] * 1e3:.2f}ms")
 
 
+def _print_placement(view) -> None:
+    """The `obs --placement` readout: per-member heat/byte/slot
+    totals, the per-slot ownership table for every sharded set, and
+    the rebalancer's status + last-move log (serve/rebalance.py)."""
+    st = view.get("status") or {}
+    print(f"== placement (epoch {st.get('epoch')}, skew "
+          f"{view.get('skew_ratio')}, rebalance "
+          f"{'on' if st.get('enabled') else 'off'}, "
+          f"{'running' if st.get('running') else 'idle'}, "
+          f"streak {st.get('streak')}) ==")
+    for m in view.get("members") or []:
+        print(f"  member {m['addr']:<22} slots={m['slots']:<3} "
+              f"heat={m['heat']:<10} bytes={m['nbytes']}")
+    for s in view.get("sets") or []:
+        print(f"  set {s['db']}:{s['set']} mode={s['mode']} "
+              f"epoch={s['epoch']} heat={s['heat']}")
+        for sl in s.get("slots") or []:
+            print(f"    slot {sl['slot']:<3} {sl['addr']:<22} "
+                  f"{sl['state']:<8} bytes={sl['nbytes']:<10} "
+                  f"heat={sl['heat']}")
+    moves = st.get("moves") or []
+    if moves:
+        print(f"  -- last {len(moves)} move(s) --")
+        for mv in moves:
+            print(f"    {mv.get('db')}:{mv.get('set')}[{mv.get('slot')}]"
+                  f" {mv.get('src')} -> {mv.get('dst')} "
+                  f"{'ok' if mv.get('ok') else 'ABORT'} "
+                  f"bytes={mv.get('nbytes', 0)}"
+                  + (f" ({mv.get('error')})" if mv.get('error')
+                     else ""))
+
+
 def _cmd_obs(args) -> int:
     """Pretty-print a running daemon's observability surface: the
     COLLECT_STATS "metrics" section (central registry), the last N
@@ -973,6 +1007,13 @@ def _cmd_obs(args) -> int:
                 print(json.dumps(view, indent=2, default=str))
             else:
                 _print_sched(view)
+            return 0
+        if getattr(args, "placement", False):
+            view = c.placement_view()
+            if args.json:
+                print(json.dumps(view, indent=2, default=str))
+            else:
+                _print_placement(view)
             return 0
         if getattr(args, "openmetrics", False):
             print(c.get_metrics(format="openmetrics")["text"], end="")
@@ -1097,6 +1138,10 @@ def _cmd_serve_bench(args) -> int:
         from netsdb_tpu.workloads.serve_bench import run_scaleout_bench
 
         out = run_scaleout_bench(daemons=getattr(args, "daemons", 4))
+    elif getattr(args, "rebalance", False):
+        from netsdb_tpu.workloads.serve_bench import run_rebalance_bench
+
+        out = run_rebalance_bench(daemons=getattr(args, "daemons", 4))
     elif getattr(args, "scheduler", False):
         from netsdb_tpu.workloads.serve_bench import run_scheduler_bench
 
@@ -1256,6 +1301,12 @@ def main(argv=None) -> int:
                         "paged-set arena cap")
     p.add_argument("--page-kb", type=int, default=None,
                    help="override config.page_size_bytes (KB)")
+    p.add_argument("--rebalance", action="store_true",
+                   help="enable live shard rebalancing on this "
+                        "daemon (config.rebalance): the leader's "
+                        "skew detector moves slot ownership between "
+                        "pool members with zero client-visible "
+                        "downtime")
     p.add_argument("--platform", default=None,
                    help="force a jax platform (e.g. cpu) — env overrides "
                    "are ignored by the ambient plugin, only jax.config "
@@ -1300,6 +1351,12 @@ def main(argv=None) -> int:
                         "join")
     p.add_argument("--daemons", type=int, default=4,
                    help="pool size for --scale (leader + N-1 shards)")
+    p.add_argument("--rebalance", action="store_true",
+                   help="self-rebalancing paired A/B instead: a "
+                        "4-daemon pool under an 80/20 skewed mix "
+                        "registers a 5th daemon mid-run — rebalance "
+                        "on vs frozen (recovery throughput ratio, "
+                        "zero failed requests, exact totals)")
     p.add_argument("--fusion-distributed", action="store_true",
                    help="distributed fusion paired A/B instead: "
                         "4-daemon scatter q01 + 3-sink fan under "
@@ -1328,6 +1385,11 @@ def main(argv=None) -> int:
                         "table (weights, depths, queue-wait "
                         "percentiles) + admission/coalesce/affinity "
                         "counters")
+    p.add_argument("--placement", action="store_true",
+                   help="the leader's live placement table instead: "
+                        "per-slot owner/state/bytes/heat for every "
+                        "sharded set, per-member totals, skew ratio, "
+                        "rebalancer status + last-move log")
     p.add_argument("--slowlog", action="store_true",
                    help="the persisted slow-query ring instead "
                         "(<root>/slowlog/ — outliers that survived "

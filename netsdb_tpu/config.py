@@ -263,6 +263,31 @@ class Configuration:
     # refusal beyond the bound) and drains — only those pages — when
     # the shard readmits. The shard-scoped resync's memory ceiling.
     shard_handoff_bytes: int = 256 * 1024 * 1024
+    # --- live shard rebalancing (serve/rebalance.py) ---
+    # master switch for the self-rebalancing placement loop: on, the
+    # leader watches per-shard load on the sched-feedback cadence (the
+    # attribution ledger + shard COLLECT_STATS fan-out feed the pinned
+    # skew formula), and sustained imbalance — or the pool growing/
+    # shrinking — emits a bounded slot-move plan executed over the
+    # RESHARD sub-protocol: copy while the source keeps serving, seal,
+    # drain the tail, commit one epoch bump (old-epoch frames get the
+    # typed retryable PlacementStale), drop the source copy. Off
+    # (default), slots stay frozen at create_set — the PR 13 behavior,
+    # byte-identical.
+    rebalance: bool = False
+    # max-shard-heat / mean-shard-heat ratio beyond which the detector
+    # counts a window as skewed (must exceed 1.0 — a ratio of 1 is
+    # perfect balance and would move data forever)
+    rebalance_skew_ratio: float = 2.0
+    # consecutive skewed feedback windows required before the planner
+    # emits moves (pool growth/shrink bypasses this — new capacity
+    # absorbs load immediately, not rebalance_windows cadences later)
+    rebalance_windows: int = 3
+    # byte bound on one planning round's moves: the planner stops
+    # adding slot moves once their estimated bytes exceed this, so a
+    # rebalance campaign trickles instead of saturating the data
+    # plane. 0 = unbounded rounds.
+    rebalance_max_bytes_per_round: int = 64 * 1024 * 1024
     # --- multi-host HA (serve/ha.py + storage/mutlog.py) ---
     # how long a follower must see EVERY earlier succession peer
     # unreachable before promoting itself leader under a new term.
@@ -345,6 +370,16 @@ class Configuration:
         if self.fusion_stage_budget_bytes < 0:
             raise ValueError(f"fusion_stage_budget_bytes must be >= 0, "
                              f"got {self.fusion_stage_budget_bytes!r}")
+        if self.rebalance_skew_ratio <= 1.0:
+            raise ValueError(f"rebalance_skew_ratio must be > 1.0, got "
+                             f"{self.rebalance_skew_ratio!r}")
+        if self.rebalance_windows < 1:
+            raise ValueError(f"rebalance_windows must be >= 1, got "
+                             f"{self.rebalance_windows!r}")
+        if self.rebalance_max_bytes_per_round < 0:
+            raise ValueError(f"rebalance_max_bytes_per_round must be "
+                             f">= 0, got "
+                             f"{self.rebalance_max_bytes_per_round!r}")
 
     @property
     def catalog_path(self) -> str:

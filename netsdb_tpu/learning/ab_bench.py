@@ -618,3 +618,120 @@ def bench_distribution_ab(scale: int = 16, rounds: int = 4,
     if advisor_kind == "drl":
         out["converged"] = _converged(winner, means)
     return out
+
+
+def bench_rebalance_ab(rows: int = 24_000, rounds: int = 2,
+                       queries: int = 20,
+                       history_path: str = ":memory:",
+                       seed: int = 0) -> Dict[str, object]:
+    """Live A/B where the advisor decides ``config.rebalance`` for a
+    skewed serving pool — the self-rebalancing loop as a bandit arm
+    (:func:`~netsdb_tpu.learning.advisor.rebalance_candidates`).
+
+    Each round spins a fresh 4-daemon pool, ingests an 80/20
+    hot/cold pair of range-sharded tables, registers a 5th daemon
+    mid-run, then serves a skewed routed-read mix and records the
+    measured wall against the arm. The ``rebalance_on`` arm drives
+    the FULL advisor protocol on the live pool —
+    :meth:`~netsdb_tpu.serve.rebalance.Rebalancer.advise` measures
+    baseline routed throughput, applies the skew-planner's moves,
+    re-measures, and commits (ticking ``rebalance.advisor_commits``)
+    or reverts the campaign — while ``rebalance_frozen`` leaves the
+    new member slot-less. Exactness is asserted every round: the
+    scanned-back tables must be row-exact regardless of arm."""
+    from netsdb_tpu.learning.advisor import rebalance_candidates
+    from netsdb_tpu.serve.client import RemoteClient
+    from netsdb_tpu.serve.server import ServeController
+    from netsdb_tpu.workloads.serve_bench import scaleout_table
+
+    hdb = HistoryDB(history_path)
+    cands = list(rebalance_candidates())
+    advisor = PlacementAdvisor(cands, hdb)
+    job = "ab-rebalance"
+    hot = scaleout_table(rows, seed=seed + 1)
+    cold = scaleout_table(max(rows // 10, 8), seed=seed + 2)
+    decisions = []
+
+    def one_round(arm):
+        root = tempfile.mkdtemp(prefix="ab_rebalance_")
+        daemons = []
+        client = None
+        try:
+            on = bool(arm.specs["rebalance"])
+            workers = []
+            for i in range(3):
+                w = ServeController(Configuration(
+                    root_dir=f"{root}/w{i}", rebalance=on), port=0)
+                w.start()
+                daemons.append(w)
+                workers.append(w)
+            leader = ServeController(
+                Configuration(root_dir=f"{root}/leader", rebalance=on),
+                port=0,
+                workers=[f"127.0.0.1:{w.port}" for w in workers])
+            leader.start()
+            daemons.append(leader)
+            client = RemoteClient(f"127.0.0.1:{leader.port}")
+            client.create_database("ab")
+            client.create_set("ab", "hot", type_name="table",
+                              placement="range")
+            client.create_set("ab", "cold", type_name="table",
+                              placement="range")
+            client.send_table("ab", "hot", hot)
+            client.send_table("ab", "cold", cold)
+            w4 = ServeController(Configuration(
+                root_dir=f"{root}/w4", rebalance=on), port=0)
+            w4.start()
+            daemons.append(w4)
+            # register only — the move decision belongs to the
+            # measured advisor pass below, not the registration
+            leader.add_worker(f"127.0.0.1:{w4.port}", campaign=False)
+
+            def routed_throughput() -> float:
+                t0 = time.perf_counter()
+                for i in range(queries):
+                    name = "hot" if i % 5 else "cold"
+                    t = client.get_table_streamed("ab", name)
+                    want = rows if name == "hot" else cold.num_rows
+                    if t.num_rows != want:
+                        raise AssertionError(
+                            f"{name}: {t.num_rows} != {want}")
+                return queries / (time.perf_counter() - t0)
+
+            if on:
+                verdict = leader.rebalancer.advise(routed_throughput)
+                decisions.append((arm.label, verdict["decision"],
+                                  len(verdict.get("moves") or [])))
+            t0 = time.perf_counter()
+            routed_throughput()
+            elapsed = time.perf_counter() - t0
+            # exactness gate: the campaign (or its absence) must not
+            # change a single row the clients see
+            back = client.get_table_streamed("ab", "hot")
+            if back.num_rows != rows:
+                raise AssertionError(
+                    f"hot rows drifted: {back.num_rows} != {rows}")
+            return elapsed
+        finally:
+            if client is not None:
+                client.close()
+            for d in daemons:
+                d.shutdown()
+            shutil.rmtree(root, ignore_errors=True)
+
+    for cand in cands:  # warm both arms' pools, unrecorded
+        one_round(cand)
+    chosen = []
+    for _ in range(rounds):
+        cand = advisor.choose(job)
+        elapsed = one_round(cand)
+        advisor.record(job, cand, elapsed)
+        chosen.append((cand.label, round(elapsed, 4)))
+    means = {c.label: hdb.mean_elapsed(job, c.label) for c in cands}
+    winner = advisor.choose(job).label
+    vals = {k: v for k, v in means.items() if v is not None}
+    worst = max(vals.values()) if vals else None
+    best = min(vals.values()) if vals else None
+    return {"rounds": chosen, "mean_s": means, "winner": winner,
+            "advise_decisions": decisions,
+            "learned_speedup": round(worst / best, 2) if best else None}

@@ -73,6 +73,23 @@ def mapper_candidates() -> tuple:
     )
 
 
+def rebalance_candidates() -> tuple:
+    """Live shard rebalancing on/off as advisor arms
+    (``arm.specs["rebalance"]`` applied to the serving pool's
+    ``config.rebalance`` by
+    :func:`~netsdb_tpu.learning.ab_bench.bench_rebalance_ab`).  The
+    skew detector in ``serve/rebalance.py`` decides WHAT to move;
+    these arms let measured routed throughput decide WHETHER moving
+    pays for a given traffic mix — the observe → propose → measure →
+    commit-or-revert loop of the self-rebalancing placement design,
+    with the advisor's history DB as its memory."""
+    return (
+        PlacementCandidate("rebalance_on", (1,), {"rebalance": True}),
+        PlacementCandidate("rebalance_frozen", (1,),
+                           {"rebalance": False}),
+    )
+
+
 class PlacementAdvisor:
     def __init__(self, candidates: Sequence[PlacementCandidate],
                  db: Optional[HistoryDB] = None,

@@ -243,8 +243,25 @@ def _catalog() -> Dict[str, Tuple[str, str]]:
         ("slo.recoveries", "SLO objective recovery transitions"),
         ("analysis.violations", "runtime lock-order cycles detected "
                                 "by the lockdep witness"),
+        ("rebalance.moves", "shard slot moves committed by the live "
+                            "rebalancer (epoch-bumped, "
+                            "count-verified)"),
+        ("rebalance.bytes_moved", "partition bytes shipped by "
+                                  "committed rebalance moves"),
+        ("rebalance.aborts", "rebalance moves unwound before their "
+                             "epoch commit (peer death, count "
+                             "mismatch, source shrank)"),
+        ("rebalance.skew_checks", "skew-detector passes run on the "
+                                  "sched-feedback / pool-health "
+                                  "cadence"),
+        ("rebalance.advisor_commits", "rebalance moves kept by the "
+                                      "placement-advisor arm after a "
+                                      "measured throughput win"),
     )
     gauges = (
+        ("placement.epoch", "the placement map's global epoch (bumps "
+                            "on every membership change and "
+                            "committed slot move)"),
         ("analysis.lock_edges", "distinct lock-rank acquisition-order "
                                 "edges observed by the witness"),
         ("analysis.callgraph_edges", "resolved call edges in the "

@@ -1980,3 +1980,28 @@ class RemoteClient:
         if window_s is not None:
             payload["window_s"] = float(window_s)
         return self._request(MsgType.GET_METRICS, payload)
+
+    def placement_view(self) -> Dict[str, Any]:
+        """The leader's live placement table (serve/rebalance.py):
+        per-slot owner/state/bytes/heat for every sharded set, the
+        per-member heat/byte totals, the current skew ratio, and the
+        rebalancer's status + last-move log — what ``cli obs
+        --placement`` renders."""
+        return self._request(MsgType.RESHARD, {"op": "view"},
+                             codec=CODEC_PICKLE)
+
+    def rebalance_status(self) -> Dict[str, Any]:
+        """The rebalancer's own state (enabled/running/last skew
+        ratio/streak/epoch + move log), without the per-slot join."""
+        return self._request(MsgType.RESHARD, {"op": "status"},
+                             codec=CODEC_PICKLE)
+
+    def add_worker(self, addr: str,
+                   campaign: bool = True) -> Dict[str, Any]:
+        """Register one new pool worker on a live leader (the
+        scale-out path the rebalancer treats as a forced trigger).
+        ``campaign=False`` registers without moving anything."""
+        return self._request(
+            MsgType.RESHARD,
+            {"op": "add_worker", "addr": str(addr),
+             "campaign": bool(campaign)}, codec=CODEC_PICKLE)

@@ -271,6 +271,27 @@ class PlacementMap:
                     changed.append(ident)
         return changed
 
+    def move_slot(self, db: str, set_name: str, slot: int,
+                  new_addr: str) -> Optional[Dict[str, Any]]:
+        """Re-own ONE shard slot — the rebalance commit point. The
+        slot's addr is rewritten to ``new_addr`` (state LIVE) and the
+        set's epoch bumps, so every frame routed under the old epoch —
+        including ingest still aimed at the sealed source — rejects
+        with the typed retryable ``PlacementStale`` and re-routes to
+        the new owner. Slot COUNT never changes (slot-stable routing:
+        ``% nslots`` hash spaces are untouched); only ownership moves.
+        Returns the updated entry copy, or ``None`` if the set or the
+        slot index does not exist (the move aborts typed upstream)."""
+        with self._mu:
+            e = self._entries.get((db, set_name))
+            if e is None or not (0 <= slot < len(e["slots"])):
+                return None
+            e["slots"][slot]["addr"] = new_addr
+            e["slots"][slot]["state"] = LIVE
+            self._epoch += 1
+            e["epoch"] = self._epoch
+            return self._copy(e)
+
     # --- wire form ----------------------------------------------------
     def to_wire(self) -> Dict[str, Any]:
         with self._mu:

@@ -218,6 +218,16 @@ class MsgType(IntEnum):
     # retrying a coalesced EXECUTE against the PROMOTED follower still
     # dedupes instead of re-executing (the PR 9 failover-scope gap).
     TOKEN_ALIAS = 75
+    # live shard rebalancing (serve/rebalance.py): one frame, an "op"
+    # field dispatches the sub-protocol. Worker-side ops run one leg of
+    # a slot move (prepare the destination's local set, seal the source
+    # registration behind a TTL, count rows, drop the source copy — the
+    # bulk copy itself rides plain SEND_DATA frames with the epoch keys,
+    # the drain_handoff idiom); leader-side ops are the admin plane
+    # (status, plan, run a bounded round, register a new pool member).
+    # Epoch-bumped all-or-nothing per move: the source keeps serving
+    # until the destination acks and the new epoch commits.
+    RESHARD = 76
 
 
 #: payload key carrying the client-generated idempotency token on

@@ -83,7 +83,7 @@ class QueryScheduler:
                  coalesce_done_max: int = 32,
                  cache_probe=None,
                  feedback: bool = False, feedback_every: int = 64,
-                 slo_source=None, pin_auto=None):
+                 slo_source=None, pin_auto=None, rebalance_cb=None):
         from netsdb_tpu.utils.locks import TrackedLock
 
         self.lanes = LaneScheduler(slots, lanes=lanes, quota=quota,
@@ -105,6 +105,11 @@ class QueryScheduler:
         # budget from the attribution ledger's hot-set table
         # (feedback.pin_budget), run on the same cadence/thread
         self._pin_auto_cb = pin_auto
+        # live shard rebalancing (config.rebalance): a no-arg callable
+        # running one skew-detector pass (serve/rebalance.py) on the
+        # same cadence/thread — the "sched-feedback cadence" the
+        # self-rebalancing loop rides
+        self._rebalance_cb = rebalance_cb
         self._feedback_every = max(int(feedback_every or 0), 1)
         self._base_quota = max(int(quota or 0), 0)
         self._fb_mu = TrackedLock("sched.QueryScheduler._fb_mu")
@@ -124,7 +129,8 @@ class QueryScheduler:
     def acquire(self, lane: Optional[str],
                 timeout_s: float) -> AdmissionTicket:
         if self.feedback_enabled or self.shed_enabled \
-                or self._pin_auto_cb is not None:
+                or self._pin_auto_cb is not None \
+                or self._rebalance_cb is not None:
             self._maybe_feedback()
         return self.lanes.acquire(lane, timeout_s)
 
@@ -156,6 +162,12 @@ class QueryScheduler:
                     self._pin_auto_cb()
                 except Exception as e:  # noqa: BLE001 — a broken pin
                     del e               # probe must never wedge
+                    pass                # admission; skip the pass
+            if self._rebalance_cb is not None:
+                try:
+                    self._rebalance_cb()
+                except Exception as e:  # noqa: BLE001 — a broken skew
+                    del e               # check must never wedge
                     pass                # admission; skip the pass
         finally:
             with self._fb_mu:

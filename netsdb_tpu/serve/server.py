@@ -40,6 +40,7 @@ from netsdb_tpu.client import Client
 from netsdb_tpu.config import Configuration, DEFAULT_CONFIG
 from netsdb_tpu.serve import sched as _sched
 from netsdb_tpu.serve import placement as _placement
+from netsdb_tpu.serve import rebalance as _rebalance
 from netsdb_tpu.serve import shard as _shard
 from netsdb_tpu.serve import ha as _ha
 from netsdb_tpu.serve.errors import (
@@ -694,6 +695,15 @@ class ServeController:
         # __shard__ marker and SHARD_RESYNC, read on every routed frame)
         self._shard_sets: Dict[Tuple[str, str], Dict[str, int]] = {}
         self._shard_mu = TrackedLock("ServeController._shard_mu")
+        # live-rebalance move state (serve/rebalance.py), guarded by
+        # _shard_mu with the registrations they fence: write-seals
+        # ((db, set) → monotonic expiry — sealed slots answer routed
+        # writes typed retryable while their copy drains) and move
+        # tombstones (scopes whose local copy a committed move
+        # dropped — stale-epoch frames must reject, never apply into
+        # the cleared set)
+        self._reshard_seals: Dict[Tuple[str, str], float] = {}
+        self._reshard_moved: set = set()
         # --- HA runtime (serve/ha.py) ---------------------------------
         # armed by arm_ha() / the ha_peers ctor list; None keeps every
         # single-daemon and plain-mirror path byte-identical
@@ -725,6 +735,10 @@ class ServeController:
             spill=spill)
         # inbound distributed-shuffle buckets (shard side)
         self._shuffle = _shard.ShuffleInbox()
+        # the self-rebalancing loop's leader-side driver: skew
+        # detector on the sched-feedback cadence + the byte-bounded
+        # move planner/executor (no-op until config.rebalance)
+        self.rebalancer = _rebalance.Rebalancer(self)
         #: this daemon's pool identity — rewritten by start() once the
         #: real port is bound (port=0 tests)
         self.advertise_addr = f"{host}:{port}"
@@ -851,7 +865,14 @@ class ServeController:
                                   False)
                           and not getattr(config,
                                           "device_cache_pin_bytes", 0))
-                      else None))
+                      else None),
+            # live shard rebalancing: one skew-detector pass per
+            # feedback window (serve/rebalance.py) — the loop that
+            # turns sustained per-slot imbalance into bounded,
+            # epoch-bumped slot moves
+            rebalance_cb=(self.rebalancer.check
+                          if getattr(config, "rebalance", False)
+                          else None))
         self._job_seq = itertools.count(1)
         self._jobs: Dict[int, Dict[str, Any]] = {}
         self._jobs_lock = TrackedLock("ServeController._jobs_lock")
@@ -901,6 +922,7 @@ class ServeController:
             MsgType.SHARD_RESYNC: self._on_shard_resync,
             MsgType.HA_STATE: self._on_ha_state,
             MsgType.TOKEN_ALIAS: self._on_token_alias,
+            MsgType.RESHARD: self._on_reshard,
         }
 
     # --- lifecycle ----------------------------------------------------
@@ -1009,7 +1031,10 @@ class ServeController:
                 self._worker_addrs.append(addr)
         self._start_pool_threads()
         if self._worker_addrs:
-            self._push_epochs()
+            # prune: the adopted map is authoritative — a slot move
+            # the deposed leader committed but never dropped finishes
+            # here (stale source registrations retire tombstoned)
+            self._push_epochs(prune=True)
         try:
             # eagerly dial the adopted followers (bounded — a dead
             # later peer degrades and reattaches on the normal path)
@@ -1039,6 +1064,12 @@ class ServeController:
                 if old_addr and old_addr != self.advertise_addr:
                     self.placement.rebind_addr(old_addr,
                                                self.advertise_addr)
+                # the reconcile push: workers re-register under the
+                # persisted (post-move) epochs, and registrations the
+                # map no longer grants are pruned — a restart
+                # mid-rebalance resumes from the committed map, with
+                # any undropped source copy retired here
+                self._push_epochs(prune=True)
         pending = self.shards.load_spill()
         if pending:
             owners = set()
@@ -1931,17 +1962,40 @@ class ServeController:
             if sl["state"] == _placement.HANDOFF:
                 return "handoff"
             if sl["addr"] == self.advertise_addr:
+                if _rebalance.sealed(self, db, set_name):
+                    raise ShardUnavailable(
+                        f"slot {slot} of {db}:{set_name} is "
+                        f"write-sealed for rebalancing; retry after "
+                        f"the move commits", slot=int(slot),
+                        epoch=current)
                 return "local"
             self._reject_stale(
                 f"slot {slot} of {db}:{set_name} is owned by "
                 f"{sl['addr']}, not this daemon", current)
         reg = self.shard_registration(db, set_name)
         if reg is not None:  # this daemon holds one slot
+            # the write-seal outranks the epoch check: a mid-move
+            # source must answer retryable even to correctly-routed
+            # frames — the tail drain after the seal is what makes
+            # the copy's row count exact
+            if _rebalance.sealed(self, db, set_name):
+                raise ShardUnavailable(
+                    f"shard slot of {db}:{set_name} is write-sealed "
+                    f"for rebalancing; retry after the move commits",
+                    slot=reg["slot"], epoch=reg["epoch"])
             if epoch is None or int(epoch) != reg["epoch"]:
                 self._reject_stale(
                     f"placement epoch rejected for {db}:{set_name}: "
                     f"frame rode epoch {epoch}, shard registered "
                     f"{reg['epoch']}", reg["epoch"])
+        elif epoch is not None \
+                and _rebalance.tombstoned(self, db, set_name):
+            # a committed move dropped this daemon's copy: a frame
+            # still riding the old map must reject typed — applying
+            # it into the cleared set would silently lose the row
+            self._reject_stale(
+                f"shard slot of {db}:{set_name} moved away from this "
+                f"daemon; re-fetch the placement map", None)
         return "local"
 
     @staticmethod
@@ -1984,6 +2038,16 @@ class ServeController:
                         self._evict_shard(
                             addr, f"{self.heartbeat_misses} missed "
                                   f"heartbeats: {type(e).__name__}: {e}")
+            if getattr(self.config, "rebalance", False):
+                # liveness for the rebalance loop on pools with no
+                # query traffic (the sched-feedback cadence only
+                # fires on admissions): a cheap no-op unless the
+                # detector's verdict or a pool change is pending
+                try:
+                    self.rebalancer.check()
+                except Exception as e:  # noqa: BLE001 — a broken
+                    del e              # planner must never kill the
+                    pass               # heartbeat loop; skip the pass
         for probe in probes.values():
             probe.close()
 
@@ -1992,31 +2056,62 @@ class ServeController:
         bump — in-flight stale routes reject typed), its ingest
         buffers at this leader until readmit, and every OTHER live
         worker learns the new epochs (``ShardPool.degrade`` pushes,
-        best-effort). Idempotent."""
+        best-effort). Idempotent. A membership change is also a
+        rebalance trigger: the remaining LIVE members re-plan on the
+        next skew check without waiting out the sustained windows."""
         self.shards.degrade(addr, reason)
+        self.rebalancer.pool_changed()
 
-    def _push_epochs(self, exclude: Tuple[str, ...] = ()) -> None:
+    def _push_epochs(self, exclude: Tuple[str, ...] = (),
+                     prune: bool = False) -> None:
         """Re-register CURRENT placement epochs on every live worker —
         an epoch bump is leader-local until this push, and a live
         worker still registered under the old epoch would reject every
         correctly-routed new-epoch frame. Best-effort per worker: a
         push failure leaves that worker answering typed-retryable
-        (clients back off) until a later push lands."""
+        (clients back off) until a later push lands.
+
+        ``prune=True`` (the restart/promotion reconcile) additionally
+        sends the push to EVERY pool worker — slotless ones get an
+        empty list — with the prune marker: each worker drops (and
+        tombstones + clears) registrations absent from its list. This
+        finishes any slot move a dead leader committed but never got
+        to drop: the persisted map is authoritative, the stale source
+        copy must not keep applying old-epoch frames."""
         sets_by_addr: Dict[str, list] = {}
+        keep_by_addr: Dict[str, list] = {}
         for db, s in self.placement.sets():
             entry = self.placement.entry(db, s)
             for i, sl in enumerate(entry["slots"]):
                 addr = sl["addr"]
-                if addr == self.advertise_addr or addr in exclude \
-                        or sl["state"] != _placement.LIVE:
+                if addr == self.advertise_addr or addr in exclude:
+                    continue
+                if sl["state"] != _placement.LIVE:
+                    # A handoff slot still BELONGS to its degraded
+                    # owner — the prune keep-list must cover it, or
+                    # the reconcile would strip a worker that is
+                    # merely awaiting readmit. Epochs are not
+                    # re-registered for it here; that is readmit's
+                    # job.
+                    keep_by_addr.setdefault(addr, []).append(
+                        {"db": db, "set": s})
                     continue
                 sets_by_addr.setdefault(addr, []).append(
                     {"db": db, "set": s, "slot": i,
                      "epoch": entry["epoch"]})
+        if prune:
+            for addr in self._worker_addrs:
+                if addr not in exclude:
+                    sets_by_addr.setdefault(addr, [])
         for addr, sets in sets_by_addr.items():
             try:
+                payload: Dict[str, Any] = {"sets": sets}
+                if prune:
+                    payload["prune"] = True
+                    if keep_by_addr.get(addr):
+                        payload["keep"] = keep_by_addr[addr]
                 self.shards.peer_request(addr, MsgType.SHARD_RESYNC,
-                                         {"sets": sets})
+                                         payload)
             except Exception as e:  # noqa: BLE001 — best-effort push
                 del e
                 self.shards.drop_client(addr)
@@ -2079,13 +2174,80 @@ class ServeController:
         """Leader → readmitted shard: re-register placement epochs for
         this daemon's slots (the metadata half of the shard-scoped
         resync; the data half is the handoff drain of ordinary routed
-        SEND_DATA frames that follows)."""
+        SEND_DATA frames that follows). ``prune: true`` (the leader's
+        restart/promotion reconcile) makes the list AUTHORITATIVE:
+        registrations absent from it are dropped, tombstoned, and
+        their local copies cleared — the worker-side completion of
+        any slot move the map committed but a dead leader never got
+        to drop."""
         count = 0
         for s in p.get("sets", ()):
             self._register_shard(s["db"], s["set"], s["slot"],
                                  s["epoch"])
             count += 1
+        if p.get("prune"):
+            keep = {(s["db"], s["set"]) for s in p.get("sets", ())}
+            keep |= {(s["db"], s["set"]) for s in p.get("keep", ())}
+            with self._shard_mu:
+                stale = [k for k in self._shard_sets
+                         if k not in keep]
+                for k in stale:
+                    del self._shard_sets[k]
+                    self._reshard_seals.pop(k, None)
+                    self._reshard_moved.add(k)
+            for db, set_name in stale:
+                try:
+                    self.library.clear_set(db, set_name)
+                except Exception as e:  # noqa: BLE001 — tombstoned
+                    del e              # above; a clear failure only
+                    pass               # leaves unreachable garbage
         return MsgType.OK, {"sets": count}
+
+    def _on_reshard(self, p):
+        """The RESHARD frame (serve/rebalance.py): worker ops run one
+        leg of a slot move against this daemon's local state; admin
+        ops (status / check / add_worker) drive the leader's
+        campaign. Everything answers CODEC_PICKLE — partitions ride
+        the reply."""
+        op = p.get("op")
+        if op == "status":
+            return MsgType.OK, self.rebalancer.status(), CODEC_PICKLE
+        if op == "view":
+            return (MsgType.OK, self.rebalancer.placement_view(),
+                    CODEC_PICKLE)
+        if op == "check":
+            moves = self.rebalancer.check(force=bool(p.get("force")))
+            return MsgType.OK, {"moves": moves}, CODEC_PICKLE
+        if op == "add_worker":
+            return (MsgType.OK,
+                    self.add_worker(p["addr"],
+                                    campaign=bool(
+                                        p.get("campaign", True))),
+                    CODEC_PICKLE)
+        return (MsgType.OK, _rebalance.handle_reshard(self, p),
+                CODEC_PICKLE)
+
+    def add_worker(self, addr: str,
+                   campaign: bool = True) -> Dict[str, Any]:
+        """Register one NEW pool worker on a live leader (the 5th
+        daemon joining a running 4-daemon pool). The health loop
+        starts heartbeating it immediately; the rebalancer treats the
+        growth as a forced trigger — when ``config.rebalance`` is on,
+        a move round runs synchronously and the reply carries its
+        results, so callers (tests, the CLI, the bench's mid-run
+        registration) observe the pool absorb the member.
+        ``campaign=False`` registers only, leaving the move decision
+        to a later pass (the advisor's measured commit-or-revert)."""
+        addr = str(addr)
+        if addr != self.advertise_addr \
+                and addr not in self._worker_addrs:
+            self._worker_addrs.append(addr)
+        self._start_pool_threads()
+        self.rebalancer.pool_changed()
+        moves = None
+        if campaign and getattr(self.config, "rebalance", False):
+            moves = self.rebalancer.check()
+        return {"workers": list(self._worker_addrs), "moves": moves}
 
     # --- follower health + graceful degradation -----------------------
     def _health_loop(self) -> None:

@@ -1497,6 +1497,190 @@ def run_fusion_distributed_bench(rows: int = 400_000, daemons: int = 4,
     return out
 
 
+def run_rebalance_bench(rows: int = 400_000, daemons: int = 4,
+                        clients: int = 4, measure_s: float = 6.0,
+                        settle_s: float = 4.0) -> Dict[str, Any]:
+    """Self-rebalancing paired A/B (``--rebalance``): a
+    ``daemons``-strong pool serves an 80/20 hot/cold routed-read mix
+    from ``clients`` concurrent threads; mid-run a fresh daemon
+    registers (``RESHARD op=add_worker``). The **on** arm lets the
+    rebalancer run its forced campaign — slot ownership moves onto
+    the new member under live traffic — while the **frozen** arm
+    leaves it slot-less. The headline is the RECOVERED throughput
+    ratio (``serve_rebalance_recovery_x``): the recovery window opens
+    ``settle_s`` after the campaign returns, so it measures the
+    steady state the pool recovers TO, not the one-time transient of
+    the move itself (the moved slot's first scans re-stage cold
+    pages; that cost is the campaign's, not the recovered level's).
+    The ratio is gated on the
+    flagship exactness story: ZERO failed client requests in either
+    arm (in-flight old-epoch frames absorb typed ``PlacementStale``/
+    ``ShardUnavailable`` retries inside the client), and the
+    post-campaign scan-back must be row- and checksum-exact against
+    the ingested tables in BOTH arms.
+
+    Daemons are real subprocesses (parallel scans need separate
+    GILs); same single-machine caveat class as ``--scale``."""
+    import tempfile
+    import threading
+
+    import numpy as np
+
+    from netsdb_tpu.serve.client import RemoteClient
+
+    hot = scaleout_table(rows, seed=1)
+    cold = scaleout_table(max(rows // 10, 64), seed=2)
+
+    def checksum(t) -> int:
+        return int(np.asarray(t["l_price"], dtype=np.int64).sum())
+
+    want = {"hot": (hot.num_rows, checksum(hot)),
+            "cold": (cold.num_rows, checksum(cold))}
+
+    def spawn(port: int, on: bool,
+              workers: Optional[List[str]] = None):
+        env = dict(os.environ)
+        env.setdefault("JAX_PLATFORMS", "cpu")
+        argv = [_python(), "-m", "netsdb_tpu", "serve",
+                "--port", str(port),
+                "--root", tempfile.mkdtemp(prefix=f"rebal_{port}_"),
+                "--device-cache-mb", "0"]
+        if on:
+            argv.append("--rebalance")
+        if workers:
+            argv += ["--workers", ",".join(workers)]
+        return subprocess.Popen(
+            argv, env=env, stdout=subprocess.DEVNULL,
+            stderr=subprocess.DEVNULL,
+            cwd=os.path.dirname(os.path.dirname(os.path.dirname(
+                os.path.abspath(__file__)))))
+
+    def run_arm(on: bool) -> Dict[str, Any]:
+        ports = [_free_port() for _ in range(daemons + 1)]
+        worker_addrs = [f"127.0.0.1:{p}" for p in ports[1:daemons]]
+        procs = [spawn(p, on) for p in ports[1:daemons]]
+        procs.insert(0, spawn(ports[0], on,
+                              workers=worker_addrs or None))
+        leader_addr = f"127.0.0.1:{ports[0]}"
+        out: Dict[str, Any] = {"rebalance": on}
+        try:
+            for p in ports[:daemons]:
+                _wait_port("127.0.0.1", p)
+            c = RemoteClient(leader_addr)
+            c.create_database("d")
+            c.create_set("d", "hot", type_name="table",
+                         placement="range")
+            c.create_set("d", "cold", type_name="table",
+                         placement="range")
+            c.send_table("d", "hot", hot)
+            c.send_table("d", "cold", cold)
+            c.get_table_streamed("d", "hot")  # warm the scan path
+
+            stop = threading.Event()
+            counts = [0] * clients
+            failures: List[str] = []
+            retries = [0] * clients
+
+            def load(i: int) -> None:
+                lc = RemoteClient(leader_addr)
+                n = 0
+                try:
+                    while not stop.is_set():
+                        name = "hot" if n % 5 else "cold"
+                        try:
+                            t = lc.get_table_streamed("d", name)
+                            if t.num_rows != want[name][0]:
+                                failures.append(
+                                    f"{name}: {t.num_rows} rows")
+                        except Exception as e:  # noqa: BLE001 — the
+                            # gate: NOTHING typed-retryable may
+                            # escape the client during the campaign
+                            failures.append(f"{name}: {e!r}")
+                        n += 1
+                        counts[i] = n
+                finally:
+                    retries[i] = lc.total_retries
+                    lc.close()
+
+            threads = [threading.Thread(target=load, args=(i,),
+                                        daemon=True)
+                       for i in range(clients)]
+            for t in threads:
+                t.start()
+            time.sleep(measure_s)
+            baseline = sum(counts)
+            out["baseline_qps"] = round(baseline / measure_s, 2)
+
+            # the 5th daemon joins mid-run; on the on arm the forced
+            # campaign moves slots under this very traffic
+            w5 = spawn(ports[daemons], on)
+            procs.append(w5)
+            _wait_port("127.0.0.1", ports[daemons])
+            t0 = time.perf_counter()
+            reply = c.add_worker(f"127.0.0.1:{ports[daemons]}")
+            out["campaign_s"] = round(time.perf_counter() - t0, 3)
+            out["moves"] = [
+                {k: m[k] for k in ("db", "set", "slot", "src", "dst",
+                                   "ok") if k in m}
+                for m in (reply.get("moves") or [])]
+            # settle: let the moved slot's cold first scans drain so
+            # the recovery window measures the steady state (both
+            # arms wait, keeping the within-run warming symmetric)
+            time.sleep(settle_s)
+            at_join = sum(counts)
+            time.sleep(measure_s)
+            recovered = sum(counts) - at_join
+            stop.set()
+            for t in threads:
+                t.join(timeout=30)
+            out["recovery_qps"] = round(recovered / measure_s, 2)
+            out["failed_requests"] = len(failures)
+            out["failures"] = failures[:8]
+            out["retries_absorbed"] = sum(retries)
+
+            # exactness gates: the campaign must not lose or double
+            # a single row
+            totals = {}
+            for name in ("hot", "cold"):
+                t = c.get_table_streamed("d", name)
+                totals[name] = (t.num_rows, checksum(t))
+            out["totals"] = {k: list(v) for k, v in totals.items()}
+            out["totals_exact"] = totals == want
+            view = c.placement_view()
+            out["placement_epoch"] = (view.get("status")
+                                      or {}).get("epoch")
+            out["member_slots"] = {m["addr"]: m["slots"]
+                                   for m in view.get("members") or []}
+            c.close()
+        finally:
+            for proc in procs:
+                proc.terminate()
+            for proc in procs:
+                try:
+                    proc.wait(timeout=10)
+                except subprocess.TimeoutExpired:
+                    proc.kill()
+        return out
+
+    frozen = run_arm(False)
+    live = run_arm(True)
+    out: Dict[str, Any] = {
+        "rows": rows, "daemons": daemons, "clients": clients,
+        "measure_s": measure_s, "settle_s": settle_s,
+        "frozen": frozen, "on": live,
+        "moved_slots": sum(1 for m in live.get("moves") or []
+                           if m.get("ok")),
+        "zero_failed_requests": frozen["failed_requests"] == 0
+        and live["failed_requests"] == 0,
+        "totals_exact": frozen["totals_exact"]
+        and live["totals_exact"],
+        "byte_equal": frozen["totals"] == live["totals"],
+    }
+    out["serve_rebalance_recovery_x"] = round(
+        live["recovery_qps"] / max(frozen["recovery_qps"], 1e-9), 2)
+    return out
+
+
 def main(argv=None) -> int:
     import argparse
 
@@ -1549,6 +1733,12 @@ def main(argv=None) -> int:
                          "scatter q01 + 3-sink fan under the optimal "
                          "mapper vs greedy vs plan_fusion=off, with "
                          "one-program-per-shard + byte-equality gates")
+    ap.add_argument("--rebalance", action="store_true",
+                    help="self-rebalancing paired A/B: 80/20 skewed "
+                         "mix over a 4-daemon pool, a 5th daemon "
+                         "registers mid-run — rebalance on vs "
+                         "frozen, recovery throughput + exactness "
+                         "gates")
     ap.add_argument("--daemons", type=int, default=4,
                     help="pool size for --scale (leader + N-1 shards)")
     ap.add_argument("--rows", type=int, default=6_000_000,
@@ -1564,6 +1754,8 @@ def main(argv=None) -> int:
         out = run_failover_bench()
     elif args.fusion_distributed:
         out = run_fusion_distributed_bench(daemons=args.daemons)
+    elif args.rebalance:
+        out = run_rebalance_bench(daemons=args.daemons)
     elif args.scale:
         out = run_scaleout_bench(rows=args.rows, daemons=args.daemons)
     elif args.scheduler:

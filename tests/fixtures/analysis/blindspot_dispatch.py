@@ -1,0 +1,48 @@
+"""BLIND-SPOT fixture: the two call shapes the static resolver
+cannot see through, harvested from the live `cli lint
+--witness-coverage` report of the serve suites (PR 19).  Both shapes
+are real in serve/server.py:
+
+* a handler passed as a FUNCTION VALUE and invoked while a lock is
+  held (`_run_mirrored(..., handler)` calls `handler(payload)` under
+  the per-set lock) — the witness records
+  `ServeController._set_locks[] -> SetStore._lock` at runtime while
+  the static call graph derives nothing for the opaque call;
+* a dispatch TABLE of bound methods indexed by a frame type
+  (`self._handlers[typ](payload)`) — same blindness: the callee is a
+  subscript result, not a resolvable attribute.
+
+Parsed by tests/test_callgraph.py, never imported.  The tests assert
+the MISS on purpose — the runtime witness is the compensating
+control for exactly these edges — so that the day the resolver
+learns either shape, the flipped assertion forces this fixture (and
+the ANALYSIS.md blind-spot note) to be updated together.
+"""
+
+import threading
+
+
+class Dispatcher:
+    """Holds ``_route_mu`` across two opaque call shapes; the real
+    lock nesting (`_route_mu -> _store_mu`) only exists through
+    them."""
+
+    def __init__(self):
+        self._route_mu = threading.Lock()
+        self._store_mu = threading.Lock()
+        self._handlers = {"apply": self._apply}
+
+    def run(self, handler):
+        with self._route_mu:
+            return handler()  # opaque: a function VALUE
+
+    def run_table(self, op):
+        with self._route_mu:
+            return self._handlers[op]()  # opaque: a subscript result
+
+    def _apply(self):
+        with self._store_mu:
+            return 1
+
+    def entry(self):
+        return self.run(self._apply)

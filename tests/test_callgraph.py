@@ -194,6 +194,59 @@ def test_witness_coverage_classifies_edges():
     assert "static blind spots" in text
 
 
+def test_witness_blindspot_dispatch_shapes_not_derived():
+    """The two opaque call shapes harvested from the live serve-suite
+    witness report (handler-as-value under a held lock; bound-method
+    dispatch table) produce NO static lock edge — the miss is the
+    point: the runtime witness is the compensating control.  When the
+    resolver learns either shape this flips, and the fixture + the
+    docs/ANALYSIS.md blind-spot note must move together."""
+    from netsdb_tpu.analysis.rules.locking import static_lock_edges
+
+    project = load_project(paths=fx("blindspot_dispatch.py"))
+    edges = set(static_lock_edges(project))
+    assert ("Dispatcher._route_mu", "Dispatcher._store_mu") not in edges
+    # the locks themselves ARE seen lexically (each nests nothing on
+    # its own path, so neither rank grows an out-edge from this file)
+    non_seed = {e for e in edges if "Dispatcher" in e[0] + e[1]}
+    assert non_seed == set()
+
+
+def test_witness_blindspot_reconciles_as_unpredicted():
+    """Feeding the blind-spot edge back through the reconciler
+    classifies it as a static blind spot (dynamic_unpredicted), not
+    as covered — i.e. `cli lint --witness-coverage` keeps pointing at
+    the resolver gap instead of silently absorbing it."""
+    from netsdb_tpu.analysis import witnesscov as W
+    from netsdb_tpu.utils.locks import witness_scope
+
+    project = load_project(paths=fx("blindspot_dispatch.py"))
+    with witness_scope() as w:
+        # what a real run of Dispatcher.entry() records
+        w.note_acquire("Dispatcher._route_mu", "blindspot_dispatch.py:36")
+        w.note_acquire("Dispatcher._store_mu", "blindspot_dispatch.py:44")
+        w.note_release("Dispatcher._store_mu")
+        w.note_release("Dispatcher._route_mu")
+        dynamic = w.export_edges()
+    report = W.coverage(dynamic, project=project)
+    unpredicted = {tuple(r["edge"])
+                   for r in report["dynamic_unpredicted"]}
+    assert ("Dispatcher._route_mu", "Dispatcher._store_mu") in unpredicted
+
+
+def test_rebalancer_lock_is_a_static_leaf():
+    """`serve.Rebalancer._mu` (PR 19) is designed as a LEAF rank:
+    placement reads, ledger snapshots and all RESHARD network legs
+    run strictly OUTSIDE it.  The static graph must agree — no
+    lock-order edge may leave or enter the rebalancer's mutex."""
+    from netsdb_tpu.analysis.rules.locking import static_lock_edges
+
+    project = load_project()
+    edges = static_lock_edges(project)
+    offenders = [e for e in edges if "Rebalancer" in e[0] + e[1]]
+    assert offenders == [], offenders
+
+
 def test_witness_dump_roundtrip_through_cli(tmp_path, capsys):
     from netsdb_tpu.cli import main
     from netsdb_tpu.utils.locks import LockWitness, witness_scope
