@@ -93,6 +93,7 @@ HEADLINE_METRICS = {"ff_inference_rows_per_sec_per_chip": "higher",
                     "plan_fusion_distributed_speedup": "higher",
                     "serve_scaleout_throughput_x": "higher",
                     "serve_rebalance_recovery_x": "higher",
+                    "serve_sessions_steps_per_sec": "higher",
                     "devcache_partial_speedup": "higher",
                     "summa_staging_reduction_x": "higher",
                     "reshard_collective_speedup": "higher",
@@ -473,6 +474,45 @@ def main():
             # record rather than snapshotting it
             print(f"-- rebalance arm unusable; metric omitted: "
                   f"{json.dumps(rb)}", file=sys.stderr)
+    if "--sessions" in sys.argv:
+        # stateful interactive serving (serve_bench --sessions): 8
+        # concurrent decode sessions over one model on a sharded pool,
+        # batched into one padded step program. The headline is
+        # aggregate warm steps/s; it only records when the structural
+        # gates hold: ONE compiled step program across the whole timed
+        # phase (trace count pinned by the bucket ladder), zero arena
+        # reads on the warm path (state stays devcache-resident), and
+        # every session's stream byte-equal to a solo unbatched
+        # replay. CPU-container caveat: in-process daemons share the
+        # GIL, so the steps/s is a lower bound; the gates are exact.
+        from netsdb_tpu.workloads.serve_bench import run_sessions_bench
+
+        ss = run_sessions_bench()
+        if ss.get("serve_sessions_steps_per_sec") \
+                and ss.get("one_program") \
+                and ss.get("zero_warm_arena_reads") \
+                and ss.get("byte_equal") \
+                and not ss.get("errors"):
+            records.append({
+                "metric": "serve_sessions_steps_per_sec",
+                "value": ss["serve_sessions_steps_per_sec"],
+                "unit": "steps/s (%s concurrent sessions x %s warm "
+                        "decode steps, sharded pool, batched into "
+                        "one compiled program)"
+                        % (ss.get("sessions"), ss.get("steps")),
+                "detail": {
+                    "wall_s": ss.get("wall_s"),
+                    "batch_occupancy_avg":
+                        ss.get("batch_occupancy_avg"),
+                    "decode": ss.get("decode"),
+                    "workers": ss.get("workers"),
+                },
+            })
+        else:
+            # a failed structural gate is a BUG, not noise — omit the
+            # record rather than snapshotting it
+            print(f"-- sessions arm unusable; metric omitted: "
+                  f"{json.dumps(ss, default=str)}", file=sys.stderr)
     if "--partial-cache" in sys.argv:
         # block-granular partial-run caching A/B (serve_bench
         # --partial-cache): warm re-query after a 1% append under

@@ -327,6 +327,55 @@ class RowwiseShadowRule(Rule):
                     f"declaration is auto-derived")
 
 
+#: the two modules allowed to touch per-session device-cache state:
+#: the owner (serve/sessions.py drives every install/update/spill
+#: decision) and the cache that implements the primitives
+_SESSION_STATE_EXEMPT = ("netsdb_tpu/serve/sessions.py",
+                         "netsdb_tpu/storage/devcache.py")
+#: the session-state mutators (devcache session API + spill wiring)
+_SESSION_STATE_CALLS = ("session_put", "session_update",
+                        "session_drop", "session_sweep",
+                        "set_session_spill")
+
+
+@register
+class SessionStateMutationRule(Rule):
+    """Per-session device-cache state mutated outside the session
+    manager (breaks step-tag consistency and the TTL accounting)."""
+
+    id = "session-state-mutation"
+    rationale = ("session state carries step tags and TTL/LRU "
+                 "accounting that only serve/sessions.py maintains "
+                 "coherently; a stray session_put desyncs the "
+                 "devcache copy from the arena spill and tears "
+                 "revived state")
+
+    def select(self, mod: Module) -> bool:
+        return mod.rel.startswith("netsdb_tpu/") \
+            and mod.rel not in _SESSION_STATE_EXEMPT
+
+    def check_module(self, mod: Module) -> Iterable[Diagnostic]:
+        for node in mod.walk():
+            name = None
+            if isinstance(node, ast.Call):
+                t = terminal_name(node.func)
+                if t in _SESSION_STATE_CALLS:
+                    name = t
+            elif isinstance(node, ast.ImportFrom):
+                for a in node.names:
+                    if a.name in _SESSION_STATE_CALLS:
+                        name = a.name
+                        break
+            if name:
+                yield self.diag(
+                    mod, node,
+                    f"{name}() outside serve/sessions.py — session "
+                    f"state mutations (step tags, TTL, spill wiring) "
+                    f"are the session manager's alone; route through "
+                    f"SessionManager so devcache and arena stay "
+                    f"consistent")
+
+
 @register
 class QidMintRule(Rule):
     """``new_query_id`` outside obs/ (unsampled tracing on hot

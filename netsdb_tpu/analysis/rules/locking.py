@@ -29,7 +29,7 @@ from typing import Dict, Iterable, List, Optional, Set, Tuple
 from netsdb_tpu.analysis.callgraph import fmt_key
 from netsdb_tpu.analysis.lint import (Diagnostic, Project, Rule,
                                       register)
-from netsdb_tpu.analysis.summaries import summaries
+from netsdb_tpu.analysis.summaries import base_token, summaries
 
 #: the seeded known hierarchy (audited in PR 8 — note the direction:
 #: ``append_table`` nests append_mu -> store lock, and the ingest /
@@ -111,17 +111,25 @@ def static_lock_edges(project: Project
             if edges.get(key) is None:
                 edges[key] = site
 
+        # instance qualifiers (``C.mu@self._a``) are a RACE-rule
+        # refinement; lock ORDER is about ranks, where every instance
+        # of a class is one level — strip before edges so the graph
+        # keeps matching the runtime witness rank grammar
         for key, facts in S.facts.items():
             for outer, inner, line in facts.lex_edges:
-                note((outer, inner), EdgeSite(key[0], line))
+                outer, inner = base_token(outer), base_token(inner)
+                if outer != inner:
+                    note((outer, inner), EdgeSite(key[0], line))
             for site in facts.calls:
                 if not site.held:
                     continue
                 callee_locks = S.trans_locks.get(site.callee, {})
                 for inner, (irel, iline) in callee_locks.items():
+                    inner = base_token(inner)
                     if inner.startswith("*."):
                         continue
                     for outer in site.held:
+                        outer = base_token(outer)
                         if inner != outer:
                             note((outer, inner),
                                  EdgeSite(key[0], site.line,

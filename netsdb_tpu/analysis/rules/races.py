@@ -39,8 +39,10 @@ from typing import Dict, Iterable, List, Optional, Set, Tuple
 from netsdb_tpu.analysis.callgraph import FuncKey, fmt_key
 from netsdb_tpu.analysis.lint import (Diagnostic, Project, Rule,
                                       register, set_gauge)
-from netsdb_tpu.analysis.summaries import (Summaries, is_lock_name,
-                                           summaries, token_owner)
+from netsdb_tpu.analysis.summaries import (Summaries, base_token,
+                                           is_lock_name, summaries,
+                                           token_owner,
+                                           token_qualifier)
 
 #: owner classes of the audited lock hierarchy (docs/ANALYSIS.md) —
 #: instances of these are shared across threads BY DESIGN, so every
@@ -60,6 +62,22 @@ _CONSTRUCTION = {"__init__", "__post_init__", "__new__",
                  "__init_subclass__"}
 
 
+def _covers(token: str, cls: str,
+            receiver: Optional[str]) -> bool:
+    """Does a held ``token`` cover class ``cls`` at a call site whose
+    receiver path is ``receiver``?  Unqualified ranks cover the whole
+    class; an instance-qualified rank (``C.mu@self._a``) covers only
+    calls dispatched on that same instance path (or a member of it —
+    ``self._a.inner.step()`` stays under ``self._a``'s lock)."""
+    if token_owner(base_token(token)) != cls:
+        return False
+    qual = token_qualifier(token)
+    if qual is None:
+        return True
+    return receiver is not None and (
+        receiver == qual or receiver.startswith(qual + "."))
+
+
 def _reach(S: Summaries, root: FuncKey,
            uncovered_for: Optional[str] = None) -> Set[FuncKey]:
     """Call-graph reachability from ``root`` with the CONSTRUCTION
@@ -71,7 +89,14 @@ def _reach(S: Summaries, root: FuncKey,
     holding a lock token covering owner class ``C`` — the callee runs
     entirely inside the ``with``, so the whole subtree below a
     covered site is covered. The result is then the set of functions
-    some path reaches with NO covering lock held."""
+    some path reaches with NO covering lock held.
+
+    Coverage is INSTANCE-SENSITIVE for member-object locks: a token
+    qualified ``C.mu@self._a`` only covers a call whose receiver is
+    that same instance path (``self._a.step()``) — holding
+    ``self._a.mu`` says nothing about the ``C`` instance behind
+    ``self._b``. Unqualified tokens (``C.mu`` from ``with self.mu:``)
+    keep their class-wide coverage."""
     seen: Set[FuncKey] = {root}
     stack = [root]
     while stack:
@@ -83,7 +108,7 @@ def _reach(S: Summaries, root: FuncKey,
             continue
         for site in facts.calls:
             if uncovered_for is not None and any(
-                    token_owner(t) == uncovered_for
+                    _covers(t, uncovered_for, site.receiver)
                     for t in site.held):
                 continue
             if site.callee not in seen:
@@ -146,7 +171,11 @@ class SharedStateRaceRule(Rule):
             if len(roots) < 2:
                 continue
             for key, line, held in muts:
-                if any(token_owner(t) == cls for t in held):
+                # the mutated object is always ``self``, so a member-
+                # object lock (``C.mu@self._a``) guards a DIFFERENT
+                # instance and never covers the site
+                if any(token_qualifier(t) is None
+                       and token_owner(t) == cls for t in held):
                     continue  # the mutation site itself is covered
                 bad_roots = []
                 for r in roots:

@@ -135,12 +135,49 @@ def _field_factory_is_lock(value: ast.AST) -> bool:
     return False
 
 
+def self_path(expr: ast.AST) -> Optional[str]:
+    """The dotted source path of a ``self``-rooted attribute chain
+    (``self._a``, ``self._a.cache``), or None for anything else.
+    Instance qualifiers and call receivers share this spelling so the
+    race rule can compare them with string equality."""
+    parts: List[str] = []
+    cur = expr
+    while isinstance(cur, ast.Attribute):
+        parts.append(cur.attr)
+        cur = cur.value
+    if isinstance(cur, ast.Name) and cur.id == "self" and parts:
+        return ".".join(["self"] + list(reversed(parts)))
+    return None
+
+
+def base_token(token: str) -> str:
+    """Strip the instance qualifier (``C.mu@self._a`` → ``C.mu``).
+    Rank consumers (lock-order edges, witness reconciliation) operate
+    on lock LEVELS, where every instance of a class is one rank; only
+    the race rule's coverage check is instance-sensitive."""
+    return token.split("@", 1)[0]
+
+
+def token_qualifier(token: str) -> Optional[str]:
+    """The instance qualifier of a token (``C.mu@self._a`` →
+    ``self._a``), or None for an unqualified rank."""
+    if "@" in token:
+        return token.split("@", 1)[1]
+    return None
+
+
 def lock_token(expr: ast.AST, cls: Optional[str], mod: Module,
                aliases: Dict[str, ast.AST],
                attr_index: Dict[str, Set[str]],
                _depth: int = 0) -> Optional[str]:
     """Normalize a ``with`` context expression to a rank token, or
-    None when it doesn't look like a lock."""
+    None when it doesn't look like a lock.
+
+    Acquisitions through a member object (``with self._a.mu:``) carry
+    an ``@self._a`` instance qualifier: ``self._a.mu`` and
+    ``self._b.mu`` are the same rank but DIFFERENT locks, and the race
+    rule must not let one cover mutations guarded by the other.  Bare
+    ``self.mu`` stays unqualified (``C.mu``)."""
     if _depth > 3:
         return None
     # rw.read() / rw.write() → the owner class's rw rank (each
@@ -158,9 +195,13 @@ def lock_token(expr: ast.AST, cls: Optional[str], mod: Module,
                     and base.value.id == "self" and cls:
                 return f"{cls}.rw"
             owners = attr_index.get("rw", set())
+            qual = self_path(base.value) \
+                if isinstance(base, ast.Attribute) else None
             if len(owners) == 1:
-                return f"{next(iter(owners))}.rw"
-            return "*.rw"  # ambiguous owner: contributes no edges
+                tok = f"{next(iter(owners))}.rw"
+            else:
+                tok = "*.rw"  # ambiguous owner: contributes no edges
+            return f"{tok}@{qual}" if qual else tok
         # self._set_lock(db, s) style: a method returning a lock
         if is_lock_name(expr.func.attr) or expr.func.attr.endswith(
                 ("_lock", "_mu")):
@@ -186,8 +227,11 @@ def lock_token(expr: ast.AST, cls: Optional[str], mod: Module,
             return f"{cls}.{name}"
         owners = attr_index.get(name, set())
         if len(owners) == 1:
-            return f"{next(iter(owners))}.{name}"
-        return f"*.{name}"
+            tok = f"{next(iter(owners))}.{name}"
+        else:
+            tok = f"*.{name}"
+        qual = self_path(base)
+        return f"{tok}@{qual}" if qual else tok
     if isinstance(expr, ast.Name):
         if expr.id in aliases:
             return lock_token(aliases[expr.id], cls, mod, aliases,
@@ -237,15 +281,22 @@ def blocking_what(call: ast.Call) -> Optional[str]:
 
 
 class CallSite:
-    """One resolved call, with the lock context held at the site."""
+    """One resolved call, with the lock context held at the site.
 
-    __slots__ = ("callee", "line", "held")
+    ``receiver`` is the dotted ``self``-rooted path of the call's
+    receiver (``self._a.step()`` → ``"self._a"``), or None — the race
+    rule matches it against instance qualifiers on held tokens to
+    decide whether a member-object lock covers the callee subtree."""
+
+    __slots__ = ("callee", "line", "held", "receiver")
 
     def __init__(self, callee: FuncKey, line: int,
-                 held: Tuple[str, ...]):
+                 held: Tuple[str, ...],
+                 receiver: Optional[str] = None):
         self.callee = callee
         self.line = line
         self.held = held
+        self.receiver = receiver
 
 
 class FnFacts:
@@ -324,8 +375,10 @@ class Summaries:
             callee = self.graph.resolve(mod, cls, node.func, aliases)
             held_toks = full_held(node, held)
             if callee is not None:
+                receiver = self_path(node.func.value) \
+                    if isinstance(node.func, ast.Attribute) else None
                 facts.calls.append(CallSite(callee, node.lineno,
-                                            held_toks))
+                                            held_toks, receiver))
             what = blocking_what(node)
             if what is not None:
                 facts.blocking.append((what, node.lineno, held_toks))

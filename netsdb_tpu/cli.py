@@ -955,6 +955,64 @@ def _print_sched(view) -> None:
               f"p99={h['p99'] * 1e3:.2f}ms max={h['max'] * 1e3:.2f}ms")
 
 
+def _sessions_view(stats) -> dict:
+    """The session/decode slice of COLLECT_STATS — one extractor for
+    both `obs --sessions` renderings (pretty and --json)."""
+    m = stats.get("metrics") or {}
+    counters = m.get("counters") or {}
+    gauges = m.get("gauges") or {}
+    return {
+        "sessions": stats.get("sessions") or {},
+        "counters": {k: v for k, v in counters.items()
+                     if k.startswith("session.")},
+        "gauges": {k: v for k, v in gauges.items()
+                   if k.startswith(("session.", "dedup."))},
+    }
+
+
+def _print_sessions(view) -> None:
+    """The `obs --sessions` readout: the open-session table (owner,
+    step counts), batcher coalescing stats, arena spill accounting,
+    decode program/trace counts, resident-state bytes, and — when
+    model_dedup pooled anything — the per-model page attribution."""
+    s = view["sessions"]
+    batcher = s.get("batcher") or {}
+    arena = s.get("arena") or {}
+    dec = s.get("decode") or {}
+    print(f"== sessions (open {s.get('open', 0)}, resident "
+          f"{s.get('resident_bytes', 0)} B) ==")
+    for row in s.get("sessions") or []:
+        print(f"  session {row['sid'][:12]:<14} db={row['db']:<12} "
+              f"steps={row['steps']:<6} owner={row['owner']}")
+    print(f"  batcher batches={batcher.get('batches', 0)} "
+          f"coalesced={batcher.get('coalesced', 0)} "
+          f"max_occupancy={batcher.get('max_occupancy', 0)} "
+          f"pending={batcher.get('pending', 0)}")
+    print(f"  arena entries={arena.get('entries', 0)} "
+          f"reads={arena.get('reads', 0)} "
+          f"writes={arena.get('writes', 0)} "
+          f"bytes={arena.get('bytes', 0)}")
+    print(f"  decode programs={dec.get('programs', 0)} "
+          f"traces={dec.get('traces', 0)} "
+          f"batches={dec.get('batches', 0)} "
+          f"steps={dec.get('steps', 0)} "
+          f"pad_rows={dec.get('pad_rows', 0)}")
+    rep = s.get("residency")
+    if rep:
+        print(f"  dedup models={rep.get('models', 0)} "
+              f"unique_page_bytes={rep.get('unique_page_bytes', 0)} "
+              f"undeduped={rep.get('total_page_bytes', 0)} "
+              f"(pooling "
+              f"{'on' if rep.get('model_dedup') else 'off'})")
+        for name, b in sorted(
+                (rep.get("charged_by_model") or {}).items()):
+            print(f"    model {name:<16} charged_bytes={b}")
+    for k, v in sorted(view["counters"].items()):
+        print(f"  {k:<34} {v}")
+    for k, v in sorted(view["gauges"].items()):
+        print(f"  {k:<34} {v}")
+
+
 def _print_placement(view) -> None:
     """The `obs --placement` readout: per-member heat/byte/slot
     totals, the per-slot ownership table for every sharded set, and
@@ -1007,6 +1065,13 @@ def _cmd_obs(args) -> int:
                 print(json.dumps(view, indent=2, default=str))
             else:
                 _print_sched(view)
+            return 0
+        if getattr(args, "sessions", False):
+            view = _sessions_view(c.collect_stats())
+            if args.json:
+                print(json.dumps(view, indent=2, default=str))
+            else:
+                _print_sessions(view)
             return 0
         if getattr(args, "placement", False):
             view = c.placement_view()
@@ -1390,6 +1455,12 @@ def main(argv=None) -> int:
                         "per-slot owner/state/bytes/heat for every "
                         "sharded set, per-member totals, skew ratio, "
                         "rebalancer status + last-move log")
+    p.add_argument("--sessions", action="store_true",
+                   help="the stateful-serving view instead: open "
+                        "decode sessions (owner, steps), batch "
+                        "coalescing stats, arena spill accounting, "
+                        "resident-state bytes and the dedup page "
+                        "attribution")
     p.add_argument("--slowlog", action="store_true",
                    help="the persisted slow-query ring instead "
                         "(<root>/slowlog/ — outliers that survived "

@@ -327,6 +327,30 @@ class Configuration:
     # (sched_feedback_every admissions). Opt-in; needs a configured
     # sched_lane_quota to have any quota to halve.
     sched_slo_shed: bool = False
+    # --- stateful interactive serving (serve/sessions.py) ---
+    # idle TTL for an open decode session: state untouched for this
+    # long is evicted from the devcache (spilling to the host arena)
+    # and, past a second TTL window, dropped from the table entirely.
+    # Chaos tests shrink it to fractions of a second.
+    session_ttl_s: float = 600.0
+    # per-session cap on resident state bytes (recurrent h/c vectors,
+    # KV cache pages). SESSION_OPEN rejects a model whose per-session
+    # state would exceed it — the admission guard that keeps one fat
+    # session from evicting everyone else's working set. 0 = uncapped.
+    session_state_bytes: int = 16 * 1024 * 1024
+    # max concurrent sessions coalesced into ONE padded decode step
+    # program (the batched GENERATE path). Batch sizes quantize onto
+    # the bucket_rows ladder, so churn between 1..decode_batch_max
+    # live sessions never retraces.
+    decode_batch_max: int = 8
+    # multi-model residency dedup (dedup/ package): on, model-set
+    # ingest through models/decode.py fingerprints weight pages with
+    # dedup.detector and identical pages across fine-tuned model sets
+    # install ONCE under a shared mapping — N near-identical models
+    # resident for ~1 model's bytes + deltas. Attribution still
+    # charges each client its exact share (shared pages split by
+    # refcount). Off (default), every model's pages install privately.
+    model_dedup: bool = False
     # --- concurrency correctness (netsdb_tpu/analysis/ + utils/locks) ---
     # lockdep-style runtime lock-order witness: on, every TrackedLock/
     # named-RWLock acquisition records rank edges (held -> acquired)
@@ -380,6 +404,15 @@ class Configuration:
             raise ValueError(f"rebalance_max_bytes_per_round must be "
                              f">= 0, got "
                              f"{self.rebalance_max_bytes_per_round!r}")
+        if self.session_ttl_s <= 0:
+            raise ValueError(f"session_ttl_s must be > 0, got "
+                             f"{self.session_ttl_s!r}")
+        if self.session_state_bytes < 0:
+            raise ValueError(f"session_state_bytes must be >= 0, got "
+                             f"{self.session_state_bytes!r}")
+        if self.decode_batch_max < 1:
+            raise ValueError(f"decode_batch_max must be >= 1, got "
+                             f"{self.decode_batch_max!r}")
 
     @property
     def catalog_path(self) -> str:

@@ -3,6 +3,7 @@
 ``src/conv2d_proj``, ``src/conv2d_memory_fusion``, ``src/LSTM``)."""
 
 from netsdb_tpu.models.conv2d import Conv2DModel
+from netsdb_tpu.models.decode import DecodeRuntime, deploy_decode_model
 from netsdb_tpu.models.ff import FFModel
 from netsdb_tpu.models.logreg import LogRegModel
 from netsdb_tpu.models.lstm_model import LSTMModel
@@ -12,7 +13,8 @@ from netsdb_tpu.models.transformer import TransformerLayerModel
 from netsdb_tpu.models.word2vec import Word2VecModel
 
 __all__ = [
-    "Conv2DModel", "FFModel", "LogRegModel", "LSTMModel",
-    "ModelServing", "TextClassifierModel", "TransformerLayerModel",
-    "Word2VecModel", "ff_serving",
+    "Conv2DModel", "DecodeRuntime", "FFModel", "LogRegModel",
+    "LSTMModel", "ModelServing", "TextClassifierModel",
+    "TransformerLayerModel", "Word2VecModel", "deploy_decode_model",
+    "ff_serving",
 ]

@@ -1,0 +1,475 @@
+"""Session-serving decode workloads — batched autoregressive steps.
+
+The stateful-serving path (``serve/sessions.py``) turns the one-shot
+analytics models in this package into INTERACTIVE workloads: a client
+opens a session, the session's recurrent/KV state stays resident in
+the device cache between requests, and every ``GENERATE`` advances it
+by one (or a few) decode steps. Per *Compiler-First State Space
+Duality and Portable O(1) Autoregressive Caching* (arxiv 2603.09555),
+the decode loop wants exactly two disciplines:
+
+* **One compiled step program shared by all concurrent sessions.**
+  Concurrent ``GENERATE`` requests for the same model coalesce into a
+  single padded batch; batch sizes quantize onto the
+  ``plan/staging.bucket_rows`` ladder, so batch churn between 1 and
+  ``decode_batch_max`` live sessions re-dispatches a cached executable
+  instead of retracing. :func:`decode_stats`'s ``traces`` counter is
+  the proof — the sessions bench pins it to the number of distinct
+  (model-shape, bucket) pairs.
+* **O(1) per-step state.** The LSTM carries ``(h, c)``; the
+  transformer layer carries a RING-BUFFER KV cache of fixed
+  ``kv_max`` entries (position writes at ``pos % kv_max`` — the
+  portable O(1) cache: step cost never grows with sequence length).
+
+Every step function is ROW-INDEPENDENT: row ``i`` of the output
+depends only on row ``i`` of the inputs and the (shared) weights, so
+a session decoded inside a padded batch of 8 produces bit-identical
+outputs to the same session decoded alone — the byte-equality gate
+``bench.py --sessions`` enforces, and the property that lets HA
+followers replay mirrored GENERATE frames solo yet converge on the
+leader's exact state.
+
+**Multi-model residency** (``config.model_dedup``): model-set ingest
+here is the serve-path consumer of the ``dedup/`` package. Each
+registered model's weight pages are fingerprinted with
+``dedup.detector.block_fingerprints``; once two models of the same
+block class are registered, the sets pool through
+``Client.dedup_resident`` → ``SetStore.set_pooled`` — byte-identical
+pages resident ONCE under a shared device pool, fine-tuned variants
+paying only for their deltas — while :meth:`DecodeRuntime.
+residency_report` splits every shared page's bytes across its
+referents (``page_bytes / refcount``) so per-client attribution stays
+exact: the charges sum to the pool, and no client ever pays for
+another's private pages.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from netsdb_tpu import obs
+from netsdb_tpu.dedup import detector as _detector
+from netsdb_tpu.plan.staging import bucket_rows
+from netsdb_tpu.utils.locks import TrackedLock
+
+#: decode model kinds the runtime can drive. "lstm" reuses the
+#: recurrent cell family of ``ops/lstm.py`` (dense, batched);
+#: "transformer_layer" is one attention+FFN layer with a ring-buffer
+#: KV cache (``models/transformer.py``'s shape, O(1) per step).
+DECODE_KINDS = ("lstm", "transformer_layer")
+
+#: weight set names per kind — one store set per tensor, so the dedup
+#: detector sees every fine-tuned variant's pages as ordinary
+#: BlockedTensor blocks.
+LSTM_WEIGHTS = ("w_i", "w_f", "w_c", "w_o",
+                "u_i", "u_f", "u_c", "u_o",
+                "b_i", "b_f", "b_c", "b_o")
+TRANSFORMER_WEIGHTS = ("wq", "wk", "wv", "wo", "w1", "w2")
+
+# process-global compiled-step cache + counters, the
+# ``plan/executor.compile_stats`` idiom: ONE map of jitted step
+# programs keyed (kind, shape signature, bucket), and monotonic
+# counters the trace-pinning gates read. (serve/ cannot host this —
+# the scatter-jit-route rule keeps compile caches out of the serve
+# layer — so the decode programs live with the models they serve.)
+_programs: Dict[Tuple, Callable] = {}
+_stats = {"traces": 0, "programs": 0, "batches": 0, "steps": 0,
+          "pad_rows": 0}
+_mu = threading.Lock()
+
+
+def decode_stats() -> Dict[str, int]:
+    """Snapshot of the decode compile cache — ``traces`` counts actual
+    jit traces (the one-program-per-bucket proof), ``batches``/
+    ``steps``/``pad_rows`` the coalescing efficiency."""
+    with _mu:
+        out = dict(_stats)
+    out["programs"] = len(_programs)
+    return out
+
+
+def clear_decode_programs() -> None:
+    """Drop every cached step program and zero the counters (test
+    isolation — mirrors ``plan/executor.clear_compiled_cache``)."""
+    with _mu:
+        _programs.clear()
+        for k in _stats:
+            _stats[k] = 0
+
+
+obs.REGISTRY.register_collector("decode", decode_stats)
+
+
+def decode_bucket(n: int) -> int:
+    """The padded batch size for ``n`` concurrent sessions — the
+    ``bucket_rows`` ladder (floor 8, {2^k, 3·2^(k-1)} rungs), so live
+    session counts churning 1..8 all land on ONE program and growth
+    past 8 adds at most O(log) more."""
+    return bucket_rows(int(n))
+
+
+def _program(key: Tuple, build: Callable) -> Callable:
+    """The jitted step program for ``key``, tracing at most once per
+    key for the process lifetime. The trace counter ticks inside the
+    traced python body — it runs at trace time only, so ``traces``
+    counts compilations, not dispatches."""
+    fn = _programs.get(key)
+    if fn is None:
+        import jax
+
+        def traced(*args, _inner=build):
+            with _mu:
+                _stats["traces"] += 1
+            return _inner(*args)
+
+        with _mu:
+            fn = _programs.get(key)
+            if fn is None:
+                fn = jax.jit(traced)
+                _programs[key] = fn
+    return fn
+
+
+# --- step functions (row-independent by construction) -----------------
+
+def _lstm_step(params, h, c, x):
+    """One batched LSTM cell step: ``(B, hidden) x (B, in)`` →
+    ``(h', c')``. Dense weights (``w``: hidden×in, ``u``:
+    hidden×hidden, ``b``: hidden) — the ops/lstm.py gate algebra on a
+    session batch axis."""
+    import jax.numpy as jnp
+
+    def gate(name, act):
+        z = (x @ params["w_" + name].T + h @ params["u_" + name].T
+             + params["b_" + name])
+        return act(z)
+
+    import jax.nn as jnn
+    i = gate("i", jnn.sigmoid)
+    f = gate("f", jnn.sigmoid)
+    g = gate("c", jnp.tanh)
+    o = gate("o", jnn.sigmoid)
+    c2 = f * c + i * g
+    h2 = o * jnp.tanh(c2)
+    return h2, c2
+
+
+def _transformer_step(params, k_cache, v_cache, pos, x, heads):
+    """One batched transformer-layer decode step with a ring-buffer KV
+    cache: write this step's k/v at ``pos % kv_max`` per row, attend
+    over the ``min(pos+1, kv_max)`` live entries, add the FFN. All
+    ops are per-row (matmuls, one-hot scatter, masked softmax), so
+    batch composition never changes any single session's bits."""
+    import jax.nn as jnn
+    import jax.numpy as jnp
+
+    kv_max = k_cache.shape[1]
+    embed = x.shape[-1]
+    dh = embed // heads
+    q = x @ params["wq"].T
+    k = x @ params["wk"].T
+    v = x @ params["wv"].T
+    # ring-buffer write: one-hot over the slot axis per row
+    slot = pos % kv_max  # (B,)
+    onehot = (jnp.arange(kv_max)[None, :] == slot[:, None])  # (B, T)
+    k_cache2 = jnp.where(onehot[:, :, None], k[:, None, :], k_cache)
+    v_cache2 = jnp.where(onehot[:, :, None], v[:, None, :], v_cache)
+    live = jnp.minimum(pos + 1, kv_max)  # (B,) valid cache entries
+    mask = jnp.arange(kv_max)[None, :] < live[:, None]  # (B, T)
+    qh = q.reshape(-1, heads, dh)
+    kh = k_cache2.reshape(-1, kv_max, heads, dh)
+    vh = v_cache2.reshape(-1, kv_max, heads, dh)
+    scores = jnp.einsum("bhd,bthd->bht", qh, kh) / jnp.sqrt(
+        jnp.asarray(dh, x.dtype))
+    scores = jnp.where(mask[:, None, :], scores, -jnp.inf)
+    attn = jnn.softmax(scores, axis=-1)
+    ctx = jnp.einsum("bht,bthd->bhd", attn, vh).reshape(-1, embed)
+    y = x + ctx @ params["wo"].T
+    ff = jnn.relu(y @ params["w1"].T) @ params["w2"].T
+    return k_cache2, v_cache2, pos + 1, y + ff
+
+
+# --- model deployment (the ingest path the dedup detector watches) ----
+
+def _gen_dense(kind: str, hidden: int, heads: int,
+               rng: "np.random.Generator") -> Dict[str, np.ndarray]:
+    scale = 1.0 / np.sqrt(hidden)
+    out: Dict[str, np.ndarray] = {}
+    if kind == "lstm":
+        for name in LSTM_WEIGHTS:
+            if name.startswith("b_"):
+                out[name] = np.zeros((hidden, 1), np.float32)
+            else:
+                out[name] = (rng.standard_normal((hidden, hidden))
+                             * scale).astype(np.float32)
+    else:
+        ffn = 2 * hidden
+        for name in ("wq", "wk", "wv", "wo"):
+            out[name] = (rng.standard_normal((hidden, hidden))
+                         * scale).astype(np.float32)
+        out["w1"] = (rng.standard_normal((ffn, hidden))
+                     * scale).astype(np.float32)
+        out["w2"] = (rng.standard_normal((hidden, ffn))
+                     * scale).astype(np.float32)
+    return out
+
+
+def deploy_decode_model(client, db: str, *, kind: str = "lstm",
+                        hidden: int = 64, heads: int = 4,
+                        seed: int = 0, base_seed: Optional[int] = None,
+                        finetune_frac: float = 0.25,
+                        block: Tuple[int, int] = (32, 32)) -> Dict:
+    """Create ``db`` and load one decode model's weight sets.
+
+    ``base_seed`` models FINE-TUNING: weights generate from the base
+    seed, then ``finetune_frac`` of each tensor's block-grid tiles
+    (chosen by ``seed``) are perturbed — two variants deployed from
+    one base share exactly ``1 - finetune_frac`` of their weight
+    pages bit-identically, the sharing the dedup detector collapses.
+    Returns the model spec the server's SESSION_OPEN consumes."""
+    if kind not in DECODE_KINDS:
+        raise ValueError(f"kind must be one of {DECODE_KINDS}, "
+                         f"got {kind!r}")
+    rng = np.random.default_rng(base_seed if base_seed is not None
+                                else seed)
+    dense = _gen_dense(kind, hidden, heads, rng)
+    if base_seed is not None:
+        tune = np.random.default_rng(seed)
+        for name, w in dense.items():
+            if w.shape[1] == 1:
+                continue  # biases stay shared
+            bh, bw = block
+            gh = max(1, w.shape[0] // bh)
+            gw = max(1, w.shape[1] // bw)
+            n_tiles = gh * gw
+            picked = tune.choice(n_tiles,
+                                 size=max(1, int(finetune_frac
+                                                 * n_tiles)),
+                                 replace=False)
+            for t in picked:
+                i, j = divmod(int(t), gw)
+                w[i * bh:(i + 1) * bh, j * bw:(j + 1) * bw] += (
+                    tune.standard_normal((min(bh, w.shape[0] - i * bh),
+                                          min(bw, w.shape[1] - j * bw)))
+                    * 0.01).astype(np.float32)
+    client.create_database(db)
+    for name, w in dense.items():
+        client.create_set(db, name, type_name="matrix")
+        shape = (block[0], 1) if w.shape[1] == 1 else tuple(block)
+        client.send_matrix(db, name, w, block_shape=shape)
+    return {"kind": kind, "hidden": int(hidden), "heads": int(heads)}
+
+
+# --- the per-daemon decode runtime ------------------------------------
+
+class DecodeRuntime:
+    """Per-daemon model registry + batched step executor.
+
+    Owns the device-resident weights of every registered decode model
+    (assembled once from the store, shared-pooled when
+    ``model_dedup``), and runs one padded, bucketed step program over
+    a session batch. Stateless with respect to SESSIONS — per-session
+    state lives in the devcache (``serve/sessions.py``); this class
+    only maps ``(states, inputs) → (states', outputs)``."""
+
+    def __init__(self, library, *, model_dedup: bool = False,
+                 kv_max: int = 64, dedup_bands: int = 16):
+        self._library = library
+        self._model_dedup = bool(model_dedup)
+        self._kv_max = int(kv_max)
+        self._dedup_bands = int(dedup_bands)
+        self._mu = TrackedLock("DecodeRuntime._mu")
+        # db -> {"spec", "params" (device dense), "client",
+        #        "fps" {(set, idx): hash}, "page_bytes" {hash: nbytes}}
+        self._models: Dict[str, Dict[str, Any]] = {}
+        self._dedup_report: Optional[Dict[str, Any]] = None
+
+    # -- registration / residency -------------------------------------
+    def register_model(self, db: str, kind: str,
+                       client: Optional[str] = None,
+                       heads: Optional[int] = None) -> Dict[str, Any]:
+        """Load ``db``'s weight sets device-resident (idempotent).
+        Fingerprints every weight page with ``dedup.detector``; with
+        ``model_dedup`` on and a second model of the same class
+        registered, re-pools ALL registered models' sets through
+        ``Client.dedup_resident`` so shared pages install once."""
+        import jax.numpy as jnp
+
+        with self._mu:
+            reg = self._models.get(db)
+            if reg is not None:
+                return reg["spec"]
+        if kind not in DECODE_KINDS:
+            raise ValueError(f"unknown decode kind {kind!r}")
+        names = LSTM_WEIGHTS if kind == "lstm" else TRANSFORMER_WEIGHTS
+        tensors = {n: self._library.get_tensor(db, n) for n in names}
+        fps: Dict[Tuple[str, tuple], str] = {}
+        page_bytes: Dict[str, int] = {}
+        for n, t in tensors.items():
+            for idx, h in _detector.block_fingerprints(t).items():
+                fps[(n, idx)] = h
+                bh, bw = t.meta.block_shape
+                page_bytes[h] = bh * bw * t.data.dtype.itemsize
+        hidden = tensors[names[0]].meta.shape[0]
+        spec = {"kind": kind, "hidden": int(hidden),
+                "heads": int(heads or 4), "kv_max": self._kv_max}
+        params = {n: jnp.asarray(t.data[:t.meta.shape[0],
+                                        :t.meta.shape[1]])
+                  for n, t in tensors.items()}
+        if kind == "lstm":
+            for b in ("b_i", "b_f", "b_c", "b_o"):
+                params[b] = params[b].reshape(-1)
+        with self._mu:
+            self._models[db] = {"spec": spec, "params": params,
+                                "client": client, "fps": fps,
+                                "page_bytes": page_bytes}
+            pool_now = (self._model_dedup and len(self._models) > 1)
+            dbs = list(self._models)
+        if pool_now:
+            sets = [(d, n) for d in dbs
+                    for n in self._weight_names(d)]
+            report = self._library.dedup_resident(
+                sets, bands=self._dedup_bands)
+            with self._mu:
+                self._dedup_report = report
+            obs.REGISTRY.gauge("dedup.page_bytes").set(
+                int(report.get("hbm_bytes_pooled", 0)))
+        return spec
+
+    def _weight_names(self, db: str) -> Sequence[str]:
+        kind = self._models[db]["spec"]["kind"]
+        return LSTM_WEIGHTS if kind == "lstm" else TRANSFORMER_WEIGHTS
+
+    def spec(self, db: str) -> Optional[Dict[str, Any]]:
+        with self._mu:
+            reg = self._models.get(db)
+            return dict(reg["spec"]) if reg else None
+
+    def drop_model(self, db: str) -> bool:
+        with self._mu:
+            return self._models.pop(db, None) is not None
+
+    def residency_report(self) -> Dict[str, Any]:
+        """Exact multi-model residency accounting. ``charged`` splits
+        every page's bytes across the models referencing it
+        (``page_bytes / refcount``) and rolls up per client — the
+        charges sum to the unique-page total, so attribution stays
+        exact under any degree of sharing."""
+        with self._mu:
+            refs: Dict[str, int] = {}
+            for reg in self._models.values():
+                for h in set(reg["fps"].values()):
+                    refs[h] = refs.get(h, 0) + 1
+            charged: Dict[str, float] = {}
+            by_model: Dict[str, float] = {}
+            unique_bytes = 0
+            sized: Dict[str, int] = {}
+            for reg in self._models.values():
+                sized.update(reg["page_bytes"])
+            for h, n in refs.items():
+                unique_bytes += sized.get(h, 0)
+            for db, reg in self._models.items():
+                share = sum(sized.get(h, 0) / refs[h]
+                            for h in set(reg["fps"].values()))
+                by_model[db] = share
+                who = reg.get("client") or db
+                charged[who] = charged.get(who, 0.0) + share
+            out = {
+                "models": len(self._models),
+                "unique_page_bytes": int(unique_bytes),
+                "total_page_bytes": int(sum(
+                    sum(sized.get(h, 0)
+                        for h in set(reg["fps"].values()))
+                    for reg in self._models.values())),
+                "charged_bytes": {k: int(round(v))
+                                  for k, v in charged.items()},
+                "charged_by_model": {k: int(round(v))
+                                     for k, v in by_model.items()},
+                "model_dedup": self._model_dedup,
+            }
+            if self._dedup_report is not None:
+                out["pool"] = dict(self._dedup_report)
+        return out
+
+    # -- state ---------------------------------------------------------
+    def state_layers(self, db: str) -> Dict[str, Tuple]:
+        """{layer name: shape} of one session's state for ``db``."""
+        spec = self.spec(db)
+        if spec is None:
+            raise KeyError(db)
+        h = spec["hidden"]
+        if spec["kind"] == "lstm":
+            return {"h": (h,), "c": (h,)}
+        return {"k": (spec["kv_max"], h), "v": (spec["kv_max"], h),
+                "pos": ()}
+
+    def init_state(self, db: str) -> Dict[str, np.ndarray]:
+        out = {}
+        for layer, shape in self.state_layers(db).items():
+            dtype = np.int32 if layer == "pos" else np.float32
+            out[layer] = np.zeros(shape, dtype)
+        return out
+
+    def state_nbytes(self, db: str) -> int:
+        return sum(int(np.prod(s or (1,))) * 4
+                   for s in self.state_layers(db).values())
+
+    # -- the batched step ----------------------------------------------
+    def step_batch(self, db: str,
+                   states: List[Dict[str, Any]],
+                   xs: List[Any]
+                   ) -> Tuple[List[Dict[str, Any]], List[np.ndarray]]:
+        """Advance ``len(states)`` sessions of one model by ONE step in
+        a single padded program dispatch. Returns per-session new
+        states (device arrays) and outputs (host). Row independence
+        makes the result per session bit-equal to a solo run."""
+        import jax.numpy as jnp
+
+        with self._mu:
+            reg = self._models.get(db)
+        if reg is None:
+            raise KeyError(f"model {db!r} not registered")
+        spec = reg["spec"]
+        params = reg["params"]
+        n = len(states)
+        bucket = decode_bucket(n)
+        pad = bucket - n
+        hidden = spec["hidden"]
+
+        def stack(layer, shape, dtype=np.float32):
+            rows = [np.asarray(s[layer], dtype) for s in states]
+            rows += [np.zeros(shape, dtype)] * pad
+            return jnp.asarray(np.stack(rows))
+
+        x = jnp.asarray(np.stack(
+            [np.asarray(v, np.float32) for v in xs]
+            + [np.zeros((hidden,), np.float32)] * pad))
+        if spec["kind"] == "lstm":
+            key = ("lstm", hidden, bucket)
+            fn = _program(key, _lstm_step)
+            h2, c2 = fn(params, stack("h", (hidden,)),
+                        stack("c", (hidden,)), x)
+            new = [{"h": h2[i], "c": c2[i]} for i in range(n)]
+            outs = [np.asarray(h2[i]) for i in range(n)]
+        else:
+            kv = spec["kv_max"]
+            heads = spec["heads"]
+            key = ("transformer_layer", hidden, kv, heads, bucket)
+            fn = _program(
+                key, lambda p, kc, vc, pos, xx:
+                _transformer_step(p, kc, vc, pos, xx, heads))
+            k2, v2, pos2, y = fn(
+                params, stack("k", (kv, hidden)),
+                stack("v", (kv, hidden)),
+                stack("pos", (), np.int32), x)
+            new = [{"k": k2[i], "v": v2[i], "pos": pos2[i]}
+                   for i in range(n)]
+            outs = [np.asarray(y[i]) for i in range(n)]
+        with _mu:
+            _stats["batches"] += 1
+            _stats["steps"] += n
+            _stats["pad_rows"] += pad
+        return new, outs

@@ -257,6 +257,28 @@ def _catalog() -> Dict[str, Tuple[str, str]]:
         ("rebalance.advisor_commits", "rebalance moves kept by the "
                                       "placement-advisor arm after a "
                                       "measured throughput win"),
+        ("session.opened", "interactive decode sessions opened "
+                           "(SESSION_OPEN accepted; idempotent "
+                           "re-opens excluded)"),
+        ("session.closed", "interactive decode sessions closed "
+                           "(explicit SESSION_CLOSE; TTL expiry "
+                           "counts under session.evicted)"),
+        ("session.evicted", "per-session state entries evicted from "
+                            "the device cache (TTL expiry or LRU "
+                            "pressure; spilled to the arena first)"),
+        ("session.decode_steps", "decode steps applied to session "
+                                 "state (one per session per batch "
+                                 "dispatch)"),
+        ("session.batch_occupancy", "summed batch occupancy across "
+                                    "decode dispatches (divide by "
+                                    "batches for mean coalescing)"),
+        ("session.spill_errors", "session state spill callbacks that "
+                                 "failed (state copy missed, cache "
+                                 "unharmed)"),
+        ("session.spill_push_errors", "dirty-state pushes to the "
+                                      "session's home daemon that "
+                                      "failed (re-marked, retried "
+                                      "next housekeeping tick)"),
     )
     gauges = (
         ("placement.epoch", "the placement map's global epoch (bumps "
@@ -278,6 +300,13 @@ def _catalog() -> Dict[str, Tuple[str, str]]:
         ("devcache.pinned_bytes", "bytes of head blocks currently "
                                   "pinned against LRU eviction "
                                   "(device_cache_pin_bytes)"),
+        ("session.resident_bytes", "bytes of per-session decode state "
+                                   "currently resident in the device "
+                                   "cache"),
+        ("dedup.page_bytes", "unique model weight-page bytes resident "
+                             "after cross-model deduplication "
+                             "(compare against the per-model "
+                             "attribution sum)"),
     )
     hists = (
         ("sched.queue_wait_s", "seconds a job waited in its scheduler "

@@ -43,6 +43,8 @@ from netsdb_tpu.serve.errors import (  # noqa: F401 — re-exported API
     RemoteError,
     RemoteTimeoutError,
     RetryableRemoteError,
+    SessionMovedError,
+    SessionUnknownError,
     ShardUnavailableError,
     classify_remote,
 )
@@ -56,6 +58,7 @@ from netsdb_tpu.serve.protocol import (
     PLACEMENT_EPOCH_KEY,
     PROTO_VERSION,
     QUERY_ID_KEY,
+    SESSION_KEY,
     SHARD_SLOT_KEY,
     MsgType,
     ProtocolError,
@@ -68,9 +71,11 @@ from netsdb_tpu.utils.timing import deadline_after, seconds_left
 
 #: frame types that open a client-side query trace (and mint the query
 #: id the daemon's trace joins on) — the query-shaped requests whose
-#: time decomposition GET_TRACE answers
+#: time decomposition GET_TRACE answers; decode steps trace too, so a
+#: slow GENERATE decomposes into coalesce-wait / state-load / device
 TRACED_TYPES = frozenset({MsgType.EXECUTE_COMPUTATIONS,
-                          MsgType.EXECUTE_PLAN})
+                          MsgType.EXECUTE_PLAN,
+                          MsgType.GENERATE})
 
 
 @dataclasses.dataclass
@@ -1868,6 +1873,34 @@ class RemoteClient:
     def load_set(self, db: str, set_name: str) -> None:
         self._request(MsgType.LOAD_SET, {"db": db, "set": set_name})
 
+    # --- stateful serving (serve/sessions.py) -------------------------
+    @property
+    def current_address(self) -> str:
+        return f"{self.host}:{self.port}"
+
+    def open_session(self, db: str, kind: str = "lstm",
+                     ttl_s: Optional[float] = None,
+                     heads: Optional[int] = None,
+                     session_id: Optional[str] = None) -> "SessionHandle":
+        """Open one interactive decode session over model ``db``.
+        The session id is CLIENT-minted: the mirrored open replays at
+        every follower with the same sid (handler-side minting would
+        not reach them — mirror forwards copy the payload before the
+        handler runs). Returns a :class:`SessionHandle` whose
+        ``generate`` calls route sticky to the owning daemon."""
+        sid = str(session_id or uuid.uuid4().hex)
+        payload: Dict[str, Any] = {"op": "open", "sid": sid, "db": db,
+                                   "kind": kind, SESSION_KEY: sid}
+        if ttl_s is not None:
+            payload["ttl_s"] = float(ttl_s)
+        if heads is not None:
+            payload["heads"] = int(heads)
+        rep = self._request(MsgType.SESSION_OPEN, payload)
+        return SessionHandle(self, sid, db, kind,
+                             owner=rep.get("owner"),
+                             spec=rep.get("spec"),
+                             steps=int(rep.get("steps", 0)))
+
     # --- query execution ----------------------------------------------
     def execute_computations(self, *sinks, job_name: str = "remote-job",
                              materialize: bool = True,
@@ -2005,3 +2038,151 @@ class RemoteClient:
             MsgType.RESHARD,
             {"op": "add_worker", "addr": str(addr),
              "campaign": bool(campaign)}, codec=CODEC_PICKLE)
+
+
+class SessionHandle:
+    """Client-side handle for one interactive decode session.
+
+    Stickiness: ``generate`` targets the session's OWNER directly —
+    the main client when the leader owns it, a cached single-attempt
+    shard connection when a pool worker does. Every hop the session
+    takes shows up as a typed retryable signal, and the handle owns
+    the re-pointing loop (the shard clients are deliberately
+    max_attempts=1, so no nested retry fights it):
+
+    * ``SessionMoved`` — the refusal NAMES the new owner: re-point and
+      retry immediately.
+    * ``NotLeader`` — the leader moved: follow the named leader (or
+      the main client's failover rotation) and re-LOOKUP the owner.
+    * connection loss / timeout / other retryables — the owner (or
+      mid-election leader) died: re-LOOKUP through the main client,
+      whose own retry driver rides the succession list, then retry
+      here with jittered backoff.
+
+    Each logical step mints ONE idempotency token and resends it
+    across every re-route, so an applied-but-unanswered step dedupes
+    at whichever daemon applied it instead of double-advancing the
+    state, and a step re-applied by a NEW owner after failover
+    recomputes bit-identically from the last durable state."""
+
+    def __init__(self, client: RemoteClient, sid: str, db: str,
+                 kind: str, owner: Optional[str] = None,
+                 spec: Optional[Dict[str, Any]] = None, steps: int = 0):
+        self._client = client
+        self.sid = sid
+        self.db = db
+        self.kind = kind
+        self.owner = owner or client.current_address
+        self.spec = spec or {}
+        self.steps = int(steps)
+        self.moves = 0  # typed re-points this handle performed
+        self._rng = random.Random(sid)
+        self._closed = False
+
+    def _target(self) -> RemoteClient:
+        if self.owner == self._client.current_address:
+            return self._client
+        return self._client._shard_client(self.owner)
+
+    def _lookup(self) -> str:
+        """Ask the (current) leader who owns the session — riding the
+        main client's NotLeader/failover handling, and healing a
+        dead-owner record leader-side."""
+        rep = self._client._request(
+            MsgType.SESSION_OPEN,
+            {"op": "lookup", "sid": self.sid, "db": self.db})
+        owner = rep.get("owner") or self._client.current_address
+        if owner != self.owner:
+            self.moves += 1
+        self.owner = owner
+        return owner
+
+    def generate(self, x, deadline_s: float = 30.0) -> np.ndarray:
+        """One decode step: returns the model's output row for this
+        session. Retries typed-retryable failures (owner moves,
+        failovers, deaths) under ``deadline_s`` with ONE idempotency
+        token for the whole logical step."""
+        if self._closed:
+            raise RuntimeError(f"session {self.sid!r} is closed")
+        payload = {"db": self.db, "set": self.sid, "sid": self.sid,
+                   "x": np.asarray(x, np.float32),
+                   SESSION_KEY: self.sid,
+                   IDEMPOTENCY_KEY: uuid.uuid4().hex}
+        deadline = deadline_after(deadline_s)
+        attempt = 0
+        while True:
+            attempt += 1
+            try:
+                rep = self._target()._request(
+                    MsgType.GENERATE, dict(payload),
+                    codec=CODEC_PICKLE)
+                new_owner = rep.get("owner")
+                if new_owner and new_owner != self.owner:
+                    self.moves += 1
+                    self.owner = new_owner
+                self.steps = int(rep.get("steps", self.steps + 1))
+                return np.asarray(rep["y"])
+            except SessionMovedError as e:
+                self.moves += 1
+                self.owner = getattr(e, "owner_addr", None) or \
+                    self._safe_lookup(deadline, e)
+            except NotLeaderError as e:
+                addr = getattr(e, "leader_addr", None)
+                if addr:
+                    self._client._switch_address(addr)
+                else:
+                    self._client._rotate_failover()
+                self._safe_lookup(deadline, e)
+            except SessionUnknownError:
+                raise
+            except (RetryableRemoteError, ConnectionLostError,
+                    RemoteTimeoutError, ConnectionError, OSError,
+                    DeadlineExceededError) as e:
+                # owner died or is mid-election: bounded backoff, then
+                # re-discover through the main client's failover path
+                if seconds_left(deadline) <= 0:
+                    raise
+                time.sleep(min(0.5, 0.05 * attempt
+                               * (1.0 + self._rng.random())))
+                self._safe_lookup(deadline, e)
+            if seconds_left(deadline) <= 0:
+                raise DeadlineExceededError(
+                    "DeadlineExceeded",
+                    f"generate deadline of {deadline_s}s exhausted "
+                    f"after {attempt} attempt(s)")
+
+    def _safe_lookup(self, deadline, cause) -> str:
+        """Owner re-discovery that tolerates the election window: a
+        failed lookup keeps the current owner and lets the outer loop
+        back off and try again (bounded by the step's deadline)."""
+        try:
+            return self._lookup()
+        except (RemoteError, ConnectionError, OSError):
+            if seconds_left(deadline) <= 0:
+                raise cause
+            return self.owner
+
+    def close(self, deadline_s: float = 10.0) -> bool:
+        """Close the session everywhere (idempotent; the daemon's TTL
+        sweep collects anything a lost close leaves behind)."""
+        if self._closed:
+            return False
+        self._closed = True
+        try:
+            rep = self._client._request(
+                MsgType.SESSION_CLOSE,
+                {"sid": self.sid, "db": self.db, "set": self.sid},
+                deadline_s=deadline_s)
+            return bool(rep.get("closed"))
+        except (RemoteError, ConnectionError, OSError):
+            return False
+
+    def __enter__(self) -> "SessionHandle":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.close()
+
+    def __repr__(self) -> str:
+        return (f"<SessionHandle {self.sid[:8]} db={self.db!r} "
+                f"owner={self.owner} steps={self.steps}>")

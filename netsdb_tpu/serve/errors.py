@@ -167,6 +167,33 @@ class NotLeader(ServeFault):
         self.term = term
 
 
+class SessionMoved(ServeFault):
+    """A session-scoped frame (GENERATE / SESSION_CLOSE) arrived at a
+    daemon that no longer owns the session's state: the session was
+    relocated (owner death adoption, a live session rebalance) or the
+    frame hit the leader for a worker-owned session. Nothing was
+    applied — the state advanced zero steps here. ``owner_addr`` names
+    the daemon that owns it NOW (None when only a table lookup at the
+    leader can answer), so the client's sticky handle re-points
+    without a discovery scan and retries under the same idempotency
+    token."""
+
+    retryable = True
+
+    def __init__(self, *args, owner_addr=None):
+        super().__init__(*args)
+        self.owner_addr = owner_addr
+
+
+class SessionUnknown(ServeFault):
+    """The session id is not in the (replicated) session table: never
+    opened here, already closed, or expired past its TTL with no spill
+    left to revive from. Fatal by contract — retrying the same handle
+    cannot help; the caller opens a fresh session."""
+
+    retryable = False
+
+
 class RequestInFlight(ServeFault):
     """A duplicate idempotency token arrived while the original request
     is still executing; the retry should back off and re-ask (it will
@@ -201,6 +228,9 @@ class RemoteError(RuntimeError):
         # moved and the rejecting daemon's term
         self.leader_addr = None
         self.term = None
+        # session stickiness details (SessionMoved family): where the
+        # session's state lives now
+        self.owner_addr = None
 
 
 class RetryableRemoteError(RemoteError):
@@ -278,6 +308,21 @@ class NotLeaderError(RetryableRemoteError):
     term."""
 
 
+class SessionMovedError(RetryableRemoteError):
+    """Server-side :class:`SessionMoved` — the session's state lives on
+    a different daemon now. ``owner_addr`` (when the rejection carried
+    one) names the new owner; the client's session handle re-points at
+    it — or re-asks the leader's session table when it didn't — and
+    retries under the same token. The typed relocation signal that
+    makes stickiness survive rebalance and failover."""
+
+
+class SessionUnknownError(RemoteError):
+    """Server-side :class:`SessionUnknown` — the session id is gone
+    (closed or TTL-expired with no spill). Fatal: open a new
+    session."""
+
+
 class AuthError(RemoteError):
     """Handshake refused — fatal, retrying cannot help."""
 
@@ -304,6 +349,8 @@ _KIND_MAP: Dict[str, type] = {
     "PlacementStale": PlacementStaleError,
     "ShardUnavailable": ShardUnavailableError,
     "NotLeader": NotLeaderError,
+    "SessionMoved": SessionMovedError,
+    "SessionUnknown": SessionUnknownError,
     "AuthError": AuthError,
     "ProtocolVersionError": ProtocolVersionError,
 }
@@ -316,8 +363,11 @@ _KIND_MAP: Dict[str, type] = {
 #: "my map is stale" from "the pool is degraded".
 #: ``leader_addr``/``term`` are the HA family's: a NotLeader rejection
 #: names the daemon to re-point at and the rejecting daemon's term.
+#: ``owner_addr`` is the session family's: a SessionMoved rejection
+#: names the daemon holding the session's state now.
 BACKPRESSURE_FIELDS = ("retry_after_s", "queue_depth", "lane",
-                       "epoch", "slot", "leader_addr", "term")
+                       "epoch", "slot", "leader_addr", "term",
+                       "owner_addr")
 
 
 def classify_remote(reply: Dict[str, Any]) -> RemoteError:

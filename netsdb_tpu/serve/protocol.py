@@ -228,6 +228,27 @@ class MsgType(IntEnum):
     # Epoch-bumped all-or-nothing per move: the source keeps serving
     # until the destination acks and the new epoch commits.
     RESHARD = 76
+    # --- stateful interactive serving (serve/sessions.py) -------------
+    # open one decode session against a deployed model: the leader
+    # assigns an OWNER daemon (sticky for every later GENERATE), seeds
+    # the session's recurrent/KV state, and records the session in the
+    # replicated session table. One frame, an "op" field dispatches the
+    # sub-protocol (open / lookup / adopt / spill) — the RESHARD idiom:
+    # lookup is the client's re-route probe after SessionMoved, adopt
+    # installs a packed state at a new owner on relocation, spill is a
+    # worker pushing an evicted session's state to the leader's arena
+    # so owner death never loses it.
+    SESSION_OPEN = 77
+    # one decode step (or a short run of steps) against an open
+    # session's resident state. Routed STICKY to the owning daemon;
+    # concurrent GENERATEs for the same model coalesce into one padded
+    # batched step program on the owner. Mutating (the state advances),
+    # so idempotency tokens fence retries — a replayed step returns the
+    # cached reply instead of advancing the state twice.
+    GENERATE = 78
+    # close one session: drop its devcache/arena state everywhere and
+    # remove it from the replicated table. Idempotent by construction.
+    SESSION_CLOSE = 79
 
 
 #: payload key carrying the client-generated idempotency token on
@@ -290,6 +311,15 @@ HA_TERM_KEY = "__term__"
 #: on readmit (the shard-scoped resync).
 SHARD_SLOT_KEY = "__slot__"
 
+#: payload key carrying the session id on session-scoped frames
+#: (GENERATE / SESSION_CLOSE). The server pops it before dispatch and
+#: admits the frame through the reserved decode lane of the query
+#: scheduler — the session lane shape: one lane for every interactive
+#: decode step, sticky to the owner daemon, so batch coalescing sees
+#: all concurrent sessions of a model in one place and one-shot
+#: analytics never starve behind a decode loop (or vice versa).
+SESSION_KEY = "__session__"
+
 #: frame types that mutate daemon state or launch jobs — the set the
 #: client attaches idempotency tokens to before retrying. Reads are
 #: naturally idempotent and retried bare. (BULK_BEGIN carries its
@@ -301,6 +331,7 @@ MUTATING_TYPES = frozenset({
     MsgType.SEND_MATRIX, MsgType.ADD_SHARED_MAPPING, MsgType.FLUSH_DATA,
     MsgType.LOAD_SET, MsgType.EXECUTE_COMPUTATIONS, MsgType.EXECUTE_PLAN,
     MsgType.DEDUP_RESIDENT, MsgType.RESYNC_FOLLOWER, MsgType.BULK_BEGIN,
+    MsgType.SESSION_OPEN, MsgType.GENERATE, MsgType.SESSION_CLOSE,
 })
 
 
@@ -534,7 +565,11 @@ def send_frame(sock: socket.socket, msg_type: int, payload: Any,
     when the payload holds arrays ≥ :data:`OOB_MIN_BYTES`; everything
     goes out as one vectored ``sendmsg`` either way."""
     segments: List[memoryview] = []
-    if codec == CODEC_MSGPACK:
+    if codec in (CODEC_MSGPACK, CODEC_MSGPACK_OOB):
+        # a caller echoing a RECEIVED frame's wire codec may pass
+        # codec 2 — the payload is a decoded dict again, so re-encode
+        # through the OOB path (the mirror-forward case: a big-tensor
+        # frame arrives as codec 2 and must forward losslessly)
         body, segments = encode_body_oob(payload)
         wire_codec = CODEC_MSGPACK_OOB if segments else CODEC_MSGPACK
     else:

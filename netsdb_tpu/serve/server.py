@@ -43,6 +43,8 @@ from netsdb_tpu.serve import placement as _placement
 from netsdb_tpu.serve import rebalance as _rebalance
 from netsdb_tpu.serve import shard as _shard
 from netsdb_tpu.serve import ha as _ha
+from netsdb_tpu.serve import sessions as _sessions
+from netsdb_tpu.serve.sched.sessions import DECODE_LANE
 from netsdb_tpu.serve.errors import (
     BACKPRESSURE_FIELDS,
     AdmissionFull,
@@ -66,6 +68,7 @@ from netsdb_tpu.serve.protocol import (
     PLACEMENT_EPOCH_KEY,
     PROTO_VERSION,
     QUERY_ID_KEY,
+    SESSION_KEY,
     SHARD_SLOT_KEY,
     MsgType,
     ProtocolError,
@@ -589,6 +592,11 @@ class ServeController:
         MsgType.FLUSH_DATA, MsgType.LOAD_SET,
         MsgType.EXECUTE_COMPUTATIONS, MsgType.EXECUTE_PLAN,
         MsgType.DEDUP_RESIDENT,
+        # the session lane: replaying opens/steps/closes at every
+        # follower is what replicates the session table AND (decode
+        # being deterministic) the per-session state itself — the
+        # leader-kill chaos test's resume-with-no-token-reuse story
+        MsgType.SESSION_OPEN, MsgType.GENERATE, MsgType.SESSION_CLOSE,
     })
 
     def __init__(self, config: Configuration = DEFAULT_CONFIG,
@@ -794,6 +802,11 @@ class ServeController:
         # SKIP (non-blocking acquire), never queue behind the profiler
         self._profiler_mu = TrackedLock("ServeController._profiler_mu")
         self.library = Client(config)  # the resident state
+        # the stateful-serving subsystem (serve/sessions.py): session
+        # table + host arena + per-model decode batcher, TTL'd mutable
+        # state in the devcache above. Constructed unconditionally —
+        # a daemon with no sessions pays one idle object
+        self.sessions = _sessions.SessionManager(self)
         # ORDERING MODEL for mirrored frames (the SPMD argument):
         # - _mirror_lock is held only long enough to ENQUEUE a frame
         #   onto every follower's FIFO sender queue; the enqueue always
@@ -879,6 +892,13 @@ class ServeController:
         self._started = time.monotonic()  # uptime only — never wall
         self._stop = threading.Event()
         self._listener: Optional[socket.socket] = None
+        # live accepted sockets — shutdown() half-closes them so a
+        # "killed" daemon stops serving established connections too
+        # (idle handler threads block in recv and never see _stop;
+        # without this a dead worker could still ACK decode steps into
+        # state nobody will ever push home)
+        self._conns: set = set()
+        self._conns_mu = TrackedLock("ServeController._conns_mu")
         self._threads: list = []
         # health/pool loop handles — promotion must be able to start
         # them on a daemon that booted with neither role
@@ -923,6 +943,9 @@ class ServeController:
             MsgType.HA_STATE: self._on_ha_state,
             MsgType.TOKEN_ALIAS: self._on_token_alias,
             MsgType.RESHARD: self._on_reshard,
+            MsgType.SESSION_OPEN: self._on_session_open,
+            MsgType.GENERATE: self._on_generate,
+            MsgType.SESSION_CLOSE: self._on_session_close,
         }
 
     # --- lifecycle ----------------------------------------------------
@@ -1172,6 +1195,9 @@ class ServeController:
 
     def shutdown(self) -> None:
         self._stop.set()
+        # the session housekeeping thread is JOINED (same discipline
+        # as the history thread below)
+        self.sessions.stop()
         # the telemetry snapshot thread is JOINED, not abandoned — no
         # history thread may outlive its daemon (the leak-registry
         # discipline every obs thread follows)
@@ -1194,6 +1220,13 @@ class ServeController:
             except OSError:
                 pass
             self._listener = None
+        with self._conns_mu:
+            conns = list(self._conns)
+        for sock in conns:
+            try:
+                sock.shutdown(socket.SHUT_RDWR)
+            except OSError:
+                pass
 
     # --- connection handling ------------------------------------------
     def _accept_loop(self) -> None:
@@ -1207,6 +1240,16 @@ class ServeController:
             t.start()
 
     def _serve_connection(self, conn: socket.socket, addr) -> None:
+        with self._conns_mu:
+            self._conns.add(conn)
+        try:
+            self._serve_connection_inner(conn, addr)
+        finally:
+            with self._conns_mu:
+                self._conns.discard(conn)
+
+    def _serve_connection_inner(self, conn: socket.socket,
+                                addr) -> None:
         with conn:
             conn.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
             try:
@@ -1343,6 +1386,13 @@ class ServeController:
             if isinstance(payload, dict) else None
         lane = payload.pop(LANE_KEY, None) \
             if isinstance(payload, dict) else None
+        if isinstance(payload, dict) and SESSION_KEY in payload:
+            # session-scoped frames admit through the reserved decode
+            # lane unless the client pinned one explicitly — decode
+            # loops and one-shot analytics get weighted fairness
+            payload.pop(SESSION_KEY, None)
+            if lane is None:
+                lane = DECODE_LANE
         # introspection frames are EXCLUDED from the request counters
         # and latency histogram (t0=None): the SLOs those instruments
         # feed must measure the workload, not the monitoring of it —
@@ -2579,6 +2629,11 @@ class ServeController:
     SET_SCOPED_FRAMES = frozenset({
         MsgType.CREATE_SET, MsgType.REMOVE_SET, MsgType.CLEAR_SET,
         MsgType.SEND_DATA, MsgType.SEND_MATRIX, MsgType.LOAD_SET,
+        # GENERATE rides the set-scoped lane keyed (model db, sid):
+        # concurrent SESSIONS mirror-execute in parallel (and so can
+        # coalesce into one padded batch), while one session's steps
+        # stay serialized — per-session FIFO to every follower
+        MsgType.GENERATE,
     })
 
     def _set_lock(self, db: str, set_name: str) -> TrackedLock:
@@ -3638,7 +3693,11 @@ class ServeController:
         out = {"sets": self.library.collect_stats(),
                "cache": self.library.store.stats.as_dict(),
                "device_cache": self.library.store.device_cache().stats(),
-               "metrics": obs.REGISTRY.snapshot()}
+               "metrics": obs.REGISTRY.snapshot(),
+               # the stateful-serving section: open sessions, batcher
+               # occupancy, arena revive counters, decode program/
+               # trace counts, multi-model residency attribution
+               "sessions": self.sessions.stats()}
         if self._follower_addrs:
             # the mirror section: active/degraded links plus the
             # silently-dropped-frame count (satellite of the HA work —
@@ -3659,6 +3718,25 @@ class ServeController:
                 # error entry, never gets evicted by a stats read)
                 out["shards"] = shards
         return MsgType.OK, out
+
+    # --- stateful serving (serve/sessions.py) -------------------------
+    def _on_session_open(self, p):
+        """SESSION_OPEN: ``op`` sub-dispatch — ``open`` (client),
+        ``adopt``/``spill``/``handoff`` (daemon→daemon), ``lookup``/
+        ``move`` (routing/rebalance). Mirrored: followers re-derive
+        the session table from the replayed stream."""
+        return self.sessions.handle_open(p)
+
+    def _on_generate(self, p):
+        """GENERATE: one decode step, sticky to the session's owner
+        (typed retryable ``SessionMoved`` elsewhere), coalesced into
+        a padded batch with every concurrent session of the model."""
+        return self.sessions.handle_generate(p)
+
+    def _on_session_close(self, p):
+        """SESSION_CLOSE: drop state everywhere (devcache + arena +
+        table), forwarding to a worker owner. Idempotent."""
+        return self.sessions.handle_close(p)
 
     def _on_put_trace(self, p):
         """Client half of a traced query arriving after its reply: the

@@ -64,8 +64,9 @@ cannot silently bypass the cache/staging layer.
 from __future__ import annotations
 
 import threading
+import time
 from collections import OrderedDict
-from typing import Any, Dict, List, Optional, Tuple
+from typing import Any, Callable, Dict, List, Optional, Tuple
 
 from netsdb_tpu import obs
 from netsdb_tpu.utils.locks import TrackedLock
@@ -81,6 +82,17 @@ def to_device(x, sharding=None):
     if sharding is not None:
         return jax.device_put(x, sharding)
     return jax.device_put(x)
+
+
+#: scope prefix for session-state entries — namespaced so a session
+#: scope can never collide with a set scope ("db:set") in the
+#: by-scope index or the affinity gate's warm probe.
+SESSION_SCOPE_PREFIX = "__session__:"
+
+
+def session_scope(sid: str) -> str:
+    """The by-scope index key for one session's state entries."""
+    return SESSION_SCOPE_PREFIX + str(sid)
 
 
 def _array_nbytes(arr) -> int:
@@ -191,6 +203,28 @@ class DeviceBlockCache:
         # loop (config.device_cache_pin_auto) rather than the static
         # knob — annotated in stats() so operators can tell which
         self._pin_auto = False
+        # --- session-state entries (serve/sessions.py) ---
+        # the third entry family: TTL'd MUTABLE per-session decode
+        # state (recurrent h/c vectors, KV pages), keyed
+        # ``(session_scope(sid), model, layer)``. Never version-keyed —
+        # the blessed sessions.py update path swaps the value in place
+        # on every decode step, so freshness is the writer's contract,
+        # not the cache's. Entries share the LRU order and byte budget
+        # with both block families; eviction and TTL expiry SPILL the
+        # state through ``_session_spill_cb`` (the host-arena escape
+        # hatch) instead of losing it. key -> meta dict
+        # {"deadline": monotonic expiry, "ttl": seconds,
+        #  "expired": bool (set by the sweep for counter attribution)}.
+        self._session_meta: Dict[Tuple, Dict[str, Any]] = {}
+        self._session_spill_cb: Optional[
+            Callable[[str, str, str, Any], None]] = None
+        self._stats.update({"session_evictions": 0,
+                            "session_expirations": 0})
+        # session_* stats keys stay hidden until the session lane is
+        # actually wired (set_session_spill / session_put) — a plain
+        # client cache keeps the original stats surface, same deal as
+        # the partial-mode keys
+        self._session_on = False
 
     # --- sizing -------------------------------------------------------
     @property
@@ -363,7 +397,12 @@ class DeviceBlockCache:
             obs.REGISTRY.counter("devcache.evictions").inc(len(victims))
 
     def _drop_entry_locked(self, key: Tuple) -> bool:
-        """Remove one entry (any granularity) from every index."""
+        """Remove one entry (any granularity) from every index. A
+        SESSION entry additionally spills its live state through the
+        registered callback (host arena) before vanishing — LRU
+        pressure and TTL expiry demote session state, they never lose
+        it — and ticks the eviction/expiry counters the chaos tests
+        and ``cli obs --sessions`` read."""
         entry = self._entries.pop(key, None)
         if entry is None:
             return False
@@ -376,6 +415,20 @@ class DeviceBlockCache:
         if key in self._pinned:
             self._pinned.discard(key)
             self._pinned_bytes -= entry[1]
+        meta = self._session_meta.pop(key, None)
+        if meta is not None:
+            which = ("session_expirations" if meta.get("expired")
+                     else "session_evictions")
+            self._stats[which] += 1
+            obs.REGISTRY.counter("session.evicted").inc()
+            if self._session_spill_cb is not None:
+                sid = str(key[0])[len(SESSION_SCOPE_PREFIX):]
+                try:
+                    self._session_spill_cb(sid, str(key[1]),
+                                           str(key[2]), entry[0][0])
+                except Exception:
+                    pass  # spill is best-effort; the table still
+                    # knows the step count and refuses silent reuse
         return True
 
     # --- partial mode: per-block entries + range stitching ------------
@@ -636,6 +689,9 @@ class DeviceBlockCache:
                 if key in self._pinned:
                     self._pinned.discard(key)
                     self._pinned_bytes -= entry[1] if entry else 0
+                self._session_meta.pop(key, None)  # administrative
+                # drop: no spill — an operator invalidating a session
+                # scope chose to discard it
             self._stats["invalidations"] += dropped
             if "pinned_bytes" in self._stats:
                 self._stats["pinned_bytes"] = self._pinned_bytes
@@ -655,6 +711,7 @@ class DeviceBlockCache:
                     self._epochs[scope] = self._epochs.get(scope, 0) + 1
             self._entries.clear()
             self._by_scope.clear()
+            self._session_meta.clear()
             self._pinned.clear()
             self._pinned_bytes = 0
             self._pin_hw.clear()
@@ -665,16 +722,166 @@ class DeviceBlockCache:
                 self._stats["pinned_bytes"] = 0
             return dropped
 
+    # --- session-state entries (TTL'd MUTABLE; serve/sessions.py) -----
+    # The write methods below are the BLESSED mutation path for
+    # session state: the ``session-state-mutation`` lint rule bans
+    # them everywhere outside ``serve/sessions.py``, the same
+    # discipline that keeps ``device_put`` behind :func:`to_device`.
+
+    def set_session_spill(
+            self, cb: Optional[Callable[[str, str, str, Any], None]]
+    ) -> None:
+        """Register the eviction/expiry escape hatch:
+        ``cb(sid, model, layer, value)`` runs for every session entry
+        LRU pressure or TTL expiry drops. The callback MUST be a leaf
+        (record to the host arena and return) — it runs under the
+        cache lock so a racing decode can never read the entry
+        half-spilled."""
+        with self._mu:
+            self._session_spill_cb = cb
+            if cb is not None:
+                self._session_on = True
+
+    def session_put(self, sid: str, model: str, layer: str, value: Any,
+                    ttl_s: float, client: Optional[str] = None) -> bool:
+        """Install (or replace) one session state entry. Unlike set
+        blocks, session entries install even on a budget-less cache —
+        an operator who disabled the block cache still gets sessions,
+        just with no eviction pressure. Returns False only when the
+        entry cannot fit under an enabled budget."""
+        key = (session_scope(sid), str(model), str(layer))
+        nbytes = _value_nbytes(value)
+        with self._mu:
+            self._session_on = True
+            if self.enabled and nbytes > self._budget:
+                self._stats["rejected"] += 1
+                return False
+            old = self._entries.pop(key, None)
+            if old is not None:
+                self._bytes -= old[1]
+            if self.enabled:
+                self._evict_to_fit_locked(nbytes)
+            self._entries[key] = ([value], nbytes)
+            self._bytes += nbytes
+            self._by_scope.setdefault(key[0], set()).add(key)
+            self._session_meta[key] = {
+                "deadline": time.monotonic() + float(ttl_s),
+                "ttl": float(ttl_s)}
+            self._stats["installs"] += 1
+        obs.REGISTRY.counter("devcache.installs").inc()
+        obs.attrib.account("devcache.installs", scope=key[0],
+                           client=client)
+        obs.REGISTRY.gauge("session.resident_bytes").set(
+            self.session_resident_bytes())
+        return True
+
+    def session_get(self, sid: str, model: str, layer: str,
+                    touch: bool = True) -> Optional[Any]:
+        """The session's resident state for one layer, or None (not
+        resident — evicted/expired/never installed; the caller revives
+        from the arena spill). A hit refreshes BOTH recencies: the LRU
+        position and the TTL deadline — an actively decoding session
+        never expires under it. Expiry is checked lazily here as well
+        as by the sweep, so a shrunk-TTL test observes it without
+        waiting for a cadence."""
+        key = (session_scope(sid), str(model), str(layer))
+        with self._mu:
+            entry = self._entries.get(key)
+            meta = self._session_meta.get(key)
+            if entry is None or meta is None:
+                return None
+            if time.monotonic() >= meta["deadline"]:
+                meta["expired"] = True
+                self._drop_entry_locked(key)
+                return None
+            if touch:
+                self._entries.move_to_end(key)
+                meta["deadline"] = time.monotonic() + meta["ttl"]
+            return entry[0][0]
+
+    def session_update(self, sid: str, model: str, layer: str,
+                       value: Any) -> bool:
+        """Swap one resident entry's value IN PLACE (the decode step's
+        state advance): same key, new blocks, bytes re-accounted, LRU
+        and TTL refreshed. Returns False when the entry is not
+        resident — the caller re-installs via :meth:`session_put`
+        (the revive-from-arena path) instead of mutating a ghost."""
+        key = (session_scope(sid), str(model), str(layer))
+        nbytes = _value_nbytes(value)
+        with self._mu:
+            entry = self._entries.get(key)
+            meta = self._session_meta.get(key)
+            if entry is None or meta is None:
+                return False
+            self._bytes += nbytes - entry[1]
+            self._entries[key] = ([value], nbytes)
+            self._entries.move_to_end(key)
+            meta["deadline"] = time.monotonic() + meta["ttl"]
+            if self.enabled:
+                self._evict_to_fit_locked(0)
+        obs.REGISTRY.gauge("session.resident_bytes").set(
+            self.session_resident_bytes())
+        return True
+
+    def session_drop(self, sid: str) -> int:
+        """Drop EVERY entry of one session with NO spill (the
+        SESSION_CLOSE path — closed state must not linger in the
+        arena). Returns entries dropped."""
+        scope = session_scope(sid)
+        with self._mu:
+            keys = list(self._by_scope.get(scope, ()))
+            for key in keys:
+                self._session_meta.pop(key, None)  # popped FIRST: no
+                # spill, no eviction tick — this is a close, not
+                # memory pressure
+                self._drop_entry_locked(key)
+        obs.REGISTRY.gauge("session.resident_bytes").set(
+            self.session_resident_bytes())
+        return len(keys)
+
+    def session_sweep(self, now: Optional[float] = None) -> int:
+        """Drop (spilling) every session entry past its TTL deadline —
+        the cadence-driven half of expiry (the lazy half lives in
+        :meth:`session_get`). Returns entries expired."""
+        now = time.monotonic() if now is None else now
+        with self._mu:
+            expired = [k for k, m in self._session_meta.items()
+                       if now >= m["deadline"]]
+            for key in expired:
+                self._session_meta[key]["expired"] = True
+                self._drop_entry_locked(key)
+        if expired:
+            obs.REGISTRY.gauge("session.resident_bytes").set(
+                self.session_resident_bytes())
+        return len(expired)
+
+    def session_resident_bytes(self) -> int:
+        """Live bytes across every resident session entry — the
+        ``session.resident_bytes`` gauge's source of truth."""
+        with self._mu:
+            return sum(self._entries[k][1] for k in self._session_meta
+                       if k in self._entries)
+
+    def session_entries(self) -> int:
+        with self._mu:
+            return len(self._session_meta)
+
     # --- introspection ------------------------------------------------
     def stats(self) -> Dict[str, int]:
         """Counter snapshot (the ``compile_stats()`` analogue for the
         transfer path) — also shipped in the serve COLLECT_STATS
         reply."""
         with self._mu:
-            out = dict(self._stats)
+            out = {k: v for k, v in self._stats.items()
+                   if self._session_on or not k.startswith("session_")}
             out["bytes"] = self._bytes
             out["entries"] = len(self._entries)
             out["budget_bytes"] = self._budget
+            if self._session_on:
+                out["session_entries"] = len(self._session_meta)
+                out["session_bytes"] = sum(
+                    self._entries[k][1] for k in self._session_meta
+                    if k in self._entries)
             if self.partial:
                 # who drives the hot-prefix pin budget: the static
                 # knob or the feedback loop (device_cache_pin_auto)

@@ -1681,6 +1681,158 @@ def run_rebalance_bench(rows: int = 400_000, daemons: int = 4,
     return out
 
 
+def run_sessions_bench(sessions: int = 8, steps: int = 32,
+                       hidden: int = 64, workers: int = 2,
+                       kind: str = "lstm") -> Dict[str, Any]:
+    """Stateful interactive serving (``--sessions``): ``sessions``
+    concurrent decode loops over one model on a sharded pool (a
+    leader routing sticky to ``workers`` session-owning shards), each
+    driving ``steps`` GENERATE rounds from its own client thread.
+
+    The headline is aggregate warm decode throughput
+    (``serve_sessions_steps_per_sec``), but the number only records
+    when the structural gates hold — a fast-but-wrong run must never
+    snapshot:
+
+    * **one compiled step program** for the whole timed phase: the
+      bucket-rows padding ladder maps every coalesced batch size to
+      one (kind, hidden, bucket) program, so the decode trace count
+      is PINNED across the run (delta 0 after warmup);
+    * **zero arena reads** on the warm path: session state stays
+      devcache-resident between steps, never revived from the host
+      spill arena;
+    * **byte-equality**: every session's full output stream equals a
+      solo unbatched replay of the same inputs — coalescing must be
+      invisible to results.
+
+    Daemons are in-process (the trace/arena gates read the
+    process-global decode stats); on a CPU container the wall number
+    measures GIL-shared host stepping, so treat the throughput as a
+    lower bound and the gates as the point.
+    """
+    import tempfile
+    import threading
+
+    import numpy as np
+
+    from netsdb_tpu.config import Configuration
+    from netsdb_tpu.models import decode as decode_mod
+    from netsdb_tpu.models.decode import deploy_decode_model
+    from netsdb_tpu.serve.client import RemoteClient
+    from netsdb_tpu.serve.server import ServeController
+
+    root = tempfile.mkdtemp(prefix="sessions_bench_")
+    daemons: List[ServeController] = []
+    out: Dict[str, Any] = {
+        "sessions": sessions, "steps": steps, "hidden": hidden,
+        "workers": workers, "kind": kind,
+    }
+    try:
+        pool = []
+        for i in range(workers):
+            w = ServeController(
+                Configuration(root_dir=os.path.join(root, f"w{i}")),
+                port=0)
+            w.start()
+            daemons.append(w)
+            pool.append(w)
+        leader = ServeController(
+            Configuration(root_dir=os.path.join(root, "leader")),
+            port=0, workers=[w.advertise_addr for w in pool])
+        leader.start()
+        daemons.append(leader)
+
+        deploy = RemoteClient(leader.advertise_addr)
+        deploy_decode_model(deploy, "m", kind=kind, hidden=hidden,
+                            seed=7)
+
+        def x_row(i: int, s: int) -> np.ndarray:
+            rng = np.random.default_rng(7000 + 1000 * i + s)
+            return rng.standard_normal(hidden).astype(np.float32)
+
+        clients = [RemoteClient(leader.advertise_addr)
+                   for _ in range(sessions)]
+        handles = [clients[i].open_session("m", kind=kind)
+                   for i in range(sessions)]
+
+        outputs: Dict[int, List[np.ndarray]] = {
+            i: [] for i in range(sessions)}
+        errors: List[str] = []
+        barrier = threading.Barrier(sessions)
+
+        def drive(i: int) -> None:
+            try:
+                barrier.wait()
+                for s in range(steps):
+                    outputs[i].append(np.asarray(
+                        handles[i].generate(x_row(i, s),
+                                            deadline_s=120.0)))
+            except Exception as e:  # noqa: BLE001 — gate below
+                errors.append(f"session {i}: {e!r}")
+
+        # warmup OUTSIDE the timed window: first steps compile the
+        # padded program and install per-session state
+        for i in range(sessions):
+            outputs[i].append(np.asarray(
+                handles[i].generate(x_row(i, -1), deadline_s=120.0)))
+            outputs[i].clear()
+
+        def arena_reads() -> int:
+            return sum(d.sessions.arena.stats()["reads"]
+                       for d in daemons)
+
+        traces0 = decode_mod.decode_stats()["traces"]
+        reads0 = arena_reads()
+        threads = [threading.Thread(target=drive, args=(i,))
+                   for i in range(sessions)]
+        t0 = time.perf_counter()
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        wall = time.perf_counter() - t0
+
+        stats = decode_mod.decode_stats()
+        out["errors"] = errors
+        out["wall_s"] = round(wall, 3)
+        out["decode"] = dict(stats)
+        out["traces_delta"] = stats["traces"] - traces0
+        out["arena_reads_delta"] = arena_reads() - reads0
+        out["batch_occupancy_avg"] = round(
+            stats["steps"] / stats["batches"], 2) \
+            if stats.get("batches") else None
+
+        # byte-equality: every session vs a solo unbatched replay on
+        # a fresh runtime over the same library (same weights)
+        byte_equal = not errors
+        rt = decode_mod.DecodeRuntime(leader.library)
+        rt.register_model("m", kind)
+        for i in range(sessions):
+            st = rt.init_state("m")
+            for s in range(-1, steps):
+                new, ys = rt.step_batch(
+                    "m", [st], [x_row(i, s if s >= 0 else -1)])
+                st = new[0]
+                if s >= 0 and not np.array_equal(
+                        np.asarray(ys[0]), outputs[i][s]):
+                    byte_equal = False
+        out["byte_equal"] = byte_equal
+        out["one_program"] = out["traces_delta"] == 0
+        out["zero_warm_arena_reads"] = out["arena_reads_delta"] == 0
+        if not errors and wall > 0:
+            out["serve_sessions_steps_per_sec"] = round(
+                sessions * steps / wall, 1)
+        for h in handles:
+            h.close()
+        for c in clients:
+            c.close()
+        deploy.close()
+    finally:
+        for d in daemons:
+            d.shutdown()
+    return out
+
+
 def main(argv=None) -> int:
     import argparse
 
@@ -1733,6 +1885,12 @@ def main(argv=None) -> int:
                          "scatter q01 + 3-sink fan under the optimal "
                          "mapper vs greedy vs plan_fusion=off, with "
                          "one-program-per-shard + byte-equality gates")
+    ap.add_argument("--sessions", action="store_true",
+                    help="stateful serving: N concurrent decode "
+                         "sessions over a sharded pool — aggregate "
+                         "steps/s gated on one-compiled-program, "
+                         "zero warm arena reads, byte-equality vs "
+                         "solo replay")
     ap.add_argument("--rebalance", action="store_true",
                     help="self-rebalancing paired A/B: 80/20 skewed "
                          "mix over a 4-daemon pool, a 5th daemon "
@@ -1754,6 +1912,8 @@ def main(argv=None) -> int:
         out = run_failover_bench()
     elif args.fusion_distributed:
         out = run_fusion_distributed_bench(daemons=args.daemons)
+    elif args.sessions:
+        out = run_sessions_bench()
     elif args.rebalance:
         out = run_rebalance_bench(daemons=args.daemons)
     elif args.scale:

@@ -1,0 +1,40 @@
+"""KNOWN-BAD fixture: a WRONG-INSTANCE lock "covering" a race.
+
+``Router`` holds two ``Cell`` instances.  The left loop takes
+``self._a``'s lock but then steps ``self._b`` — same lock-owning
+class, DIFFERENT lock.  Before instance qualifiers the rule saw
+"a ``Cell.mu`` rank is held" and pruned the subtree, a false
+negative; with ``C.mu@self._a`` tokens the receiver mismatch keeps
+the path uncovered and the finding fires on ``Cell.count``.
+
+Parsed by the lint tests, never imported.
+"""
+
+import threading
+
+
+class Cell:
+    def __init__(self):
+        self.mu = threading.Lock()
+        self.count = 0
+
+    def bump(self):
+        self.count += 1
+
+
+class Router:
+    def __init__(self):
+        self._a = Cell()
+        self._b = Cell()
+        threading.Thread(target=self._left_loop,
+                         daemon=True).start()
+        threading.Thread(target=self._right_loop,
+                         daemon=True).start()
+
+    def _left_loop(self):
+        with self._a.mu:
+            self._b.bump()  # wrong instance's lock — NOT covered
+
+    def _right_loop(self):
+        with self._b.mu:
+            self._b.bump()  # matching instance — covered

@@ -1,0 +1,39 @@
+"""KNOWN-GOOD fixture: instance locks matched to their receivers.
+
+The twin of ``bad_race_instance.py``: both thread loops take the lock
+of the SAME ``Cell`` instance they then step, so every path into the
+shared mutation is covered by the right lock and the race rule must
+stay silent — no class-level suppression needed even though two
+instances of one lock-owning class are in play.
+
+Parsed by the lint tests, never imported.
+"""
+
+import threading
+
+
+class Cell:
+    def __init__(self):
+        self.mu = threading.Lock()
+        self.count = 0
+
+    def bump(self):
+        self.count += 1
+
+
+class Router:
+    def __init__(self):
+        self._a = Cell()
+        self._b = Cell()
+        threading.Thread(target=self._left_loop,
+                         daemon=True).start()
+        threading.Thread(target=self._right_loop,
+                         daemon=True).start()
+
+    def _left_loop(self):
+        with self._a.mu:
+            self._a.bump()
+
+    def _right_loop(self):
+        with self._b.mu:
+            self._b.bump()

@@ -148,6 +148,38 @@ def test_race_via_alias_and_partial_roots():
     assert all("Loader.batches" in d.message for d in diags)
 
 
+def test_wrong_instance_lock_does_not_cover():
+    """``with self._a.mu: self._b.bump()`` — the same lock-owning
+    class, the WRONG lock.  Pre-qualifier tokens pruned this path as
+    covered (a false negative); instance-sensitive coverage fires."""
+    diags = run_lint(paths=fx("bad_race_instance.py"),
+                     rules=["shared-state-race"])
+    assert len(diags) == 1
+    assert "Cell.count" in diags[0].message
+
+
+def test_matched_instance_locks_are_clean():
+    assert run_lint(paths=fx("good_race_instance.py"),
+                    rules=["shared-state-race"]) == []
+
+
+def test_instance_qualifiers_stay_off_the_rank_graph():
+    """Lock-order ranks are instance-INsensitive: ``self._a.mu`` and
+    ``self._b.mu`` are one level, and no qualified token may leak
+    into the static edge set (the witness diff would never match)."""
+    from netsdb_tpu.analysis.lint import load_project
+    from netsdb_tpu.analysis.rules.locking import static_lock_edges
+    from netsdb_tpu.analysis.summaries import (base_token,
+                                               token_qualifier)
+    assert base_token("Cell.mu@self._a") == "Cell.mu"
+    assert token_qualifier("Cell.mu@self._a") == "self._a"
+    assert token_qualifier("Cell.mu") is None
+    project = load_project(paths=fx("bad_race_instance.py",
+                                    "good_race_instance.py"))
+    for a, b in static_lock_edges(project):
+        assert "@" not in a and "@" not in b
+
+
 def test_real_tree_race_rule_is_clean():
     # the acceptance bar: every real finding fixed or suppressed with
     # a documented reason — regressions land here
