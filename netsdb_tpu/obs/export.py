@@ -272,6 +272,10 @@ def _catalog() -> Dict[str, Tuple[str, str]]:
         ("session.batch_occupancy", "summed batch occupancy across "
                                     "decode dispatches (divide by "
                                     "batches for mean coalescing)"),
+        ("session.budget_spills", "advanced state layers larger than "
+                                  "the whole device-cache budget, "
+                                  "written straight to the arena "
+                                  "instead of resident"),
         ("session.spill_errors", "session state spill callbacks that "
                                  "failed (state copy missed, cache "
                                  "unharmed)"),

@@ -257,8 +257,25 @@ class SessionManager:
             self._run_batch,
             max_batch=int(getattr(cfg, "decode_batch_max", 8)))
         # models whose dense weights already shipped to an owner —
-        # later sessions of the same (owner, model) adopt weight-less
+        # later sessions of the same (owner, model) adopt weight-less.
+        # Guarded by _shipped_mu (handler threads race on it) and
+        # invalidated by forget_owner() when the pool health loop
+        # degrades/readmits a member: a restarted worker lost its
+        # resident models, so a weight-less adopt there would fail
+        # register_model and silently degrade placement to
+        # leader-local ownership.
         self._shipped: set = set()
+        self._shipped_mu = TrackedLock("SessionManager._shipped_mu")
+        # per-session last-applied idempotency record
+        # {token, steps, y}: the daemon-local idempotency cache only
+        # dedupes retries that land on the SAME daemon — this record
+        # travels WITH the state (spill push, move, handoff, adopt),
+        # so a retry under the same token landing at the session's
+        # NEW owner replays the recorded reply instead of advancing
+        # the state a second time (the handle's no-double-apply
+        # contract across relocations).
+        self._applied: Dict[str, Dict[str, Any]] = {}
+        self._applied_mu = TrackedLock("SessionManager._applied_mu")
         self._hk_thread: Optional[threading.Thread] = None
         self._hk_stop = threading.Event()
         self._hk_mu = TrackedLock("SessionManager._hk_mu")
@@ -356,23 +373,40 @@ class SessionManager:
     def _load_state(self, sid: str, db: str,
                     ttl_s: float) -> Tuple[Dict[str, Any], int]:
         """Assemble the session's CURRENT state layer by layer:
-        devcache copy when resident, else the arena's newest spill
-        (re-installed resident for the next step). All layers must
-        land on one step — a mixed assembly is a torn state and
-        raises rather than decoding garbage."""
+        newest copy wins — the resident devcache entry, unless the
+        arena's spill for that layer is NEWER (then the arena copy
+        revives and re-installs). A resident copy can legitimately be
+        stale: a mirror follower replays ``op=open`` owning the
+        session itself and installs init state at step 0, while a
+        worker-owned session's durability arrives only via mirrored
+        ``op=spill`` merges into the arena — after promotion the
+        step-0 resident layers would otherwise assemble consistently
+        and silently rewind the session. All layers must land on one
+        step — a mixed assembly is a torn state and raises rather
+        than decoding garbage."""
         layers = self.runtime.state_layers(db)
         out: Dict[str, Any] = {}
         steps_seen = set()
+        # the arena's high-water step, read WITHOUT a read tick: on a
+        # warm step every resident layer is at least this new, so the
+        # zero-warm-arena-reads gate still holds
+        arena_steps = self.arena.steps(sid, db)
         for layer in layers:
             rec = self._cache().session_get(sid, db, layer)
+            if rec is not None and int(rec["step"]) < arena_steps:
+                newer = self.arena.get_layer(sid, db, layer)
+                if newer is not None \
+                        and int(newer["step"]) > int(rec["step"]):
+                    rec = newer
+                    self._cache().session_put(sid, db, layer,
+                                              dict(rec), ttl_s)
             if rec is None:
                 rec = self.arena.get_layer(sid, db, layer)
                 if rec is not None:
                     self._cache().session_put(sid, db, layer,
                                               dict(rec), ttl_s)
             if rec is None:
-                if self.table.steps(sid) == 0 \
-                        and self.arena.steps(sid, db) == 0:
+                if self.table.steps(sid) == 0 and arena_steps == 0:
                     rec = {"step": 0,
                            "v": self.runtime.init_state(db)[layer]}
                     self._cache().session_put(sid, db, layer,
@@ -395,12 +429,25 @@ class SessionManager:
                     state: Dict[str, Any], step: int) -> None:
         for layer, v in state.items():
             rec = {"step": int(step), "v": v}
-            if not self._cache().session_update(sid, db, layer, rec):
-                self._cache().session_put(sid, db, layer, rec, ttl_s)
+            if self._cache().session_update(sid, db, layer, rec):
+                continue
+            if not self._cache().session_put(sid, db, layer, rec,
+                                             ttl_s):
+                # budget-rejected (the layer alone exceeds the whole
+                # cache budget, so eviction can't make room): the
+                # advanced state must still land somewhere durable —
+                # straight into the arena, same as any spill, so the
+                # next step revives it instead of raising
+                # SessionUnknown over silently-dropped state
+                self.arena.merge_layer(sid, db, layer, int(step),
+                                       _host(v), steps_hint=int(step))
+                obs.REGISTRY.counter("session.budget_spills").inc()
 
     def _pack(self, sid: str, db: str) -> Dict[str, Any]:
         """The session's full host-side state (devcache first, arena
-        fallback per layer) — the op=spill/handoff payload."""
+        fallback per layer) — the op=spill/handoff payload. The
+        last-applied idempotency record rides along so the dedup
+        guarantee survives the relocation."""
         layers: Dict[str, Dict[str, Any]] = {}
         for layer in self.runtime.state_layers(db):
             rec = self._cache().session_get(sid, db, layer,
@@ -410,11 +457,42 @@ class SessionManager:
             if rec is not None:
                 layers[layer] = {"step": int(rec["step"]),
                                  "v": _host(rec["v"])}
-        return {"layers": layers,
-                "steps": max([self.table.steps(sid),
-                              self.arena.steps(sid, db)]
-                             + [r["step"] for r in layers.values()]
-                             or [0])}
+        out = {"layers": layers,
+               "steps": max([self.table.steps(sid),
+                             self.arena.steps(sid, db)]
+                            + [r["step"] for r in layers.values()]
+                            or [0])}
+        applied = self._applied_record(sid)
+        if applied is not None:
+            out["applied"] = applied
+        return out
+
+    # --- the per-session applied-token record -------------------------
+    def _applied_record(self, sid: str) -> Optional[Dict[str, Any]]:
+        """Host-copied wire form of the session's last-applied step
+        record, or None."""
+        with self._applied_mu:
+            last = self._applied.get(sid)
+            if last is None:
+                return None
+            return {"token": last["token"],
+                    "steps": int(last["steps"]),
+                    "y": _host(last["y"])}
+
+    def _note_applied(self, sid: str,
+                      rec: Optional[Dict[str, Any]]) -> None:
+        """Adopt a shipped applied-token record (move/handoff/spill
+        push) — newest step wins, so a stale straggler push can never
+        roll the dedup horizon backwards."""
+        if not rec or not rec.get("token"):
+            return
+        with self._applied_mu:
+            cur = self._applied.get(sid)
+            if cur is None \
+                    or int(rec.get("steps", 0)) >= int(cur["steps"]):
+                self._applied[sid] = {"token": rec["token"],
+                                      "steps": int(rec.get("steps", 0)),
+                                      "y": rec["y"]}
 
     # --- the batched decode step --------------------------------------
     def _sid_lock(self, sid: str) -> TrackedLock:
@@ -456,6 +534,19 @@ class SessionManager:
                         f"session {sid!r} moved to {row['owner']}",
                         owner_addr=row["owner"])
                     continue
+                tok = r.get("tok")
+                if tok:
+                    with self._applied_mu:
+                        last = self._applied.get(sid)
+                    if last is not None and last["token"] == tok:
+                        # retry of an applied-but-unanswered step whose
+                        # record travelled here with the state (the
+                        # daemon-local idempotency cache can't have
+                        # seen this token): replay the recorded reply,
+                        # never advance the state twice under one token
+                        results[i] = {"y": last["y"],
+                                      "steps": int(last["steps"])}
+                        continue
                 ttl = float(row["ttl_s"])
                 try:
                     st, step = self._load_state(sid, db, ttl)
@@ -477,6 +568,12 @@ class SessionManager:
                     self._save_state(sid, db, ttls[j], new[j], step)
                     self.table.set_steps(sid, step)
                     results[i] = {"y": outs[j], "steps": step}
+                    tok = reqs[i].get("tok")
+                    if tok:
+                        with self._applied_mu:
+                            self._applied[sid] = {"token": tok,
+                                                  "steps": step,
+                                                  "y": outs[j]}
                 obs.REGISTRY.counter("session.decode_steps").inc(
                     len(live))
                 obs.REGISTRY.counter("session.batch_occupancy").inc(
@@ -545,12 +642,26 @@ class SessionManager:
                    "home": self._me(), "steps": int(steps)}
         if state is not None:
             payload["state"] = state
-        if (owner, db) not in self._shipped:
+        with self._shipped_mu:
+            shipped = (owner, db) in self._shipped
+        if not shipped:
+            # two concurrent opens may both ship — benign: the ingest
+            # is idempotent; what must never happen is a weight-LESS
+            # adopt at an owner that doesn't hold the model
             payload["weights"] = self._export_weights(db, kind)
             payload["block"] = [32, 32]
         self._ctl.shards.peer_request(owner, MsgType.SESSION_OPEN,
                                       payload, codec=CODEC_PICKLE)
-        self._shipped.add((owner, db))
+        with self._shipped_mu:
+            self._shipped.add((owner, db))
+
+    def forget_owner(self, addr: str) -> None:
+        """Invalidate the weights-already-shipped record for one pool
+        member (called by the pool's degrade/readmit bookkeeping): a
+        dead or restarted worker no longer holds the model, so the
+        next session placed there must ship weights again."""
+        with self._shipped_mu:
+            self._shipped = {e for e in self._shipped if e[0] != addr}
 
     def _export_weights(self, db: str, kind: str) -> Dict[str, np.ndarray]:
         names = (_decode.LSTM_WEIGHTS if kind == "lstm"
@@ -580,6 +691,7 @@ class SessionManager:
             self.arena.merge_state(sid, db, state["layers"],
                                    state.get("steps", steps))
             self.table.set_steps(sid, int(state.get("steps", steps)))
+            self._note_applied(sid, state.get("applied"))
         elif steps == 0:
             self._install_state(sid, db, ttl_s,
                                 self.runtime.init_state(db), 0)
@@ -618,6 +730,7 @@ class SessionManager:
         self.arena.merge_state(sid, db, state.get("layers", {}),
                                int(state.get("steps", 0)))
         self.table.set_steps(sid, int(state.get("steps", 0)))
+        self._note_applied(sid, state.get("applied"))
         return MsgType.OK, {"sid": sid,
                             "steps": self.arena.steps(sid, db)}
 
@@ -656,6 +769,12 @@ class SessionManager:
         if row["owner"] == self._me():
             with self._sid_lock(sid):
                 state = self._pack(sid, db)
+                # keep a local arena copy until the adopt lands: a
+                # failed push must not leave the packed dict as the
+                # state's only holder (ownership stays here on
+                # failure, and the next step revives from this copy)
+                self.arena.merge_state(sid, db, state["layers"],
+                                       state["steps"])
                 self._cache().session_drop(sid)
         else:
             rep = self._ctl.shards.peer_request(
@@ -665,12 +784,15 @@ class SessionManager:
         if to == self._me():
             self.arena.merge_state(sid, db, state["layers"],
                                    state["steps"])
+            self._note_applied(sid, state.get("applied"))
             self.table.set_owner(sid, self._me(), home=self._me())
         else:
             self._push_adopt(to, sid, db, kind,
                              self.runtime.spec(db) or {}, row["ttl_s"],
                              state=state, steps=state["steps"])
             self.table.set_owner(sid, to)
+            self.arena.drop(sid)  # the adopt landed; the safety copy
+            # (and any older spill) must not linger here
         self.table.set_steps(sid, int(state["steps"]))
         return MsgType.OK, {"sid": sid, "owner": to,
                             "steps": int(state["steps"])}
@@ -688,6 +810,8 @@ class SessionManager:
             self.arena.drop(sid)
             home = row.get("home") or self._me()
             self.table.set_owner(sid, home)
+        with self._applied_mu:
+            self._applied.pop(sid, None)  # shipped inside ``state``
         return MsgType.OK, {"sid": sid, "state": state}, CODEC_PICKLE
 
     def handle_generate(self, p: Dict[str, Any]):
@@ -713,9 +837,14 @@ class SessionManager:
                     f"session {sid!r} is owned by {owner}",
                     owner_addr=owner)
         db = row["db"]
+        # the in-flight frame's idempotency token (contextvar installed
+        # by the dispatcher; local import — server imports this module)
+        from netsdb_tpu.serve.server import _idem_token_var
+
         with obs.span("session.coalesce", "serve"):
             out = self.batcher.submit(
-                db, sid, {"sid": sid, "x": p["x"]})
+                db, sid, {"sid": sid, "x": p["x"],
+                          "tok": _idem_token_var.get()})
         return MsgType.OK, {"sid": sid, "y": out["y"],
                             "steps": out["steps"],
                             "owner": self._me()}, CODEC_PICKLE
@@ -736,8 +865,14 @@ class SessionManager:
             dropped = self._cache().session_drop(sid)
             self.arena.drop(sid)
             closed = self.table.close(sid)
-        with self._sid_locks_mu:
-            self._sid_locks.pop(sid, None)
+        with self._applied_mu:
+            self._applied.pop(sid, None)
+        # the per-sid lock is deliberately NOT popped: a thread that
+        # already fetched the old lock object but not yet acquired it
+        # would otherwise share the "exclusive" section with a holder
+        # of a fresh object after a same-sid reopen. The map grows by
+        # one small object per sid ever opened — the price of the
+        # exclusion staying airtight.
         if closed:
             obs.REGISTRY.counter("session.closed").inc()
         return MsgType.OK, {"sid": sid, "closed": closed,
@@ -779,6 +914,9 @@ class SessionManager:
             slot = self.arena.snapshot_slot(sid, db)
             if slot is None:
                 continue
+            applied = self._applied_record(sid)
+            if applied is not None:
+                slot["applied"] = applied
             try:
                 self._ctl.shards.peer_request(
                     home, MsgType.SESSION_OPEN,

@@ -535,12 +535,22 @@ class ShardPool:
             c.close()
 
     # --- degraded bookkeeping ----------------------------------------
+    def _forget_session_weights(self, addr: str) -> None:
+        """A member left (or came back) — the session manager's
+        weights-already-shipped record for it is stale: a restarted
+        worker no longer holds the models, and a weight-less adopt
+        there fails register_model."""
+        sessions = getattr(self.ctl, "sessions", None)
+        if sessions is not None:
+            sessions.forget_owner(addr)
+
     def degrade(self, addr: str, reason: str) -> None:
         with self._mu:
             fresh = addr not in self._degraded
             self._degraded[addr] = reason
         if fresh:
             obs.REGISTRY.counter("shard.evictions").inc()
+        self._forget_session_weights(addr)
         changed = self.ctl.placement.degrade_addr(addr)
         self.drop_client(addr)
         if changed:
@@ -560,6 +570,7 @@ class ShardPool:
         entry and runs the normal readmit + drain."""
         with self._mu:
             self._degraded.setdefault(addr, reason)
+        self._forget_session_weights(addr)
 
     def is_degraded(self, addr: str) -> bool:
         with self._mu:
@@ -568,6 +579,7 @@ class ShardPool:
     def clear_degraded(self, addr: str) -> None:
         with self._mu:
             self._degraded.pop(addr, None)
+        self._forget_session_weights(addr)
 
     def degraded(self) -> Dict[str, str]:
         with self._mu:

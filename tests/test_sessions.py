@@ -34,7 +34,8 @@ from netsdb_tpu.models.decode import deploy_decode_model
 from netsdb_tpu.serve import ha as ha_mod
 from netsdb_tpu.serve.client import RemoteClient, RetryPolicy
 from netsdb_tpu.serve.errors import SessionUnknownError
-from netsdb_tpu.serve.protocol import CODEC_PICKLE, MsgType
+from netsdb_tpu.serve.protocol import (CODEC_PICKLE, IDEMPOTENCY_KEY,
+                                       MsgType)
 from netsdb_tpu.serve.sched.sessions import DecodeBatcher
 from netsdb_tpu.serve.server import ServeController
 
@@ -495,6 +496,154 @@ def test_owner_shard_death_revives_from_pushed_spill(tmp_path):
         for g, w in zip(got, want):
             assert g.tobytes() == w.tobytes()
         h.close()
+        c.close()
+
+
+def test_oversized_state_layer_spills_to_arena_not_lost(tmp_path):
+    """A state layer larger than the WHOLE device-cache budget can
+    never be resident: every save is budget-rejected. The advanced
+    state must fall through to the arena (counted), not be silently
+    dropped — the session keeps decoding byte-equal, revived from the
+    arena each step, instead of dying SessionUnknown on step 2."""
+    with _daemon(tmp_path, device_cache_bytes=200) as ctl:
+        c = RemoteClient(ctl.advertise_addr)
+        deploy_decode_model(c, "m1", kind="lstm", hidden=HID, seed=19)
+        spills0 = _counter("session.budget_spills")
+        h = c.open_session("m1", kind="lstm")
+        xs = [_x(0, s) for s in range(3)]
+        got = [np.asarray(h.generate(x)) for x in xs]
+        assert h.steps == 3
+        assert _counter("session.budget_spills") > spills0
+        assert ctl.sessions.arena.steps(h.sid, "m1") == 3
+        want = _solo_outputs(ctl.library, "m1", "lstm", xs)
+        for g, w in zip(got, want):
+            assert g.tobytes() == w.tobytes()
+        h.close()
+        c.close()
+
+
+def test_degrade_invalidates_shipped_weights_record(tmp_path):
+    """The weights-already-shipped memo must not outlive the worker it
+    describes: once the pool marks the member degraded (death or
+    restart), the next session placed there ships weights again
+    instead of a weight-less adopt against an empty store."""
+    with _pool(tmp_path, n_workers=1) as (leader, _, workers):
+        worker = workers[0]
+        c = RemoteClient(leader.advertise_addr)
+        deploy_decode_model(c, "m1", kind="lstm", hidden=HID, seed=23)
+        h = c.open_session("m1", kind="lstm")
+        assert h.owner == worker.advertise_addr
+        with leader.sessions._shipped_mu:
+            assert (worker.advertise_addr, "m1") in \
+                leader.sessions._shipped
+        leader.shards.degrade(worker.advertise_addr, "test kill")
+        with leader.sessions._shipped_mu:
+            assert (worker.advertise_addr, "m1") not in \
+                leader.sessions._shipped
+        h.close()
+        c.close()
+
+
+@pytest.mark.chaos
+def test_retry_same_token_after_live_move_never_double_applies(
+        tmp_path):
+    """The no-double-apply contract across a relocation: a step
+    applied at the old owner whose reply was lost retries under the
+    SAME idempotency token at the NEW owner (whose daemon-local token
+    cache never saw it). The applied-token record travels with the
+    handoff state, so the retry replays the recorded reply instead of
+    advancing the state a second time."""
+    with _pool(tmp_path, n_workers=2) as (leader, _, workers):
+        c = RemoteClient(leader.advertise_addr)
+        deploy_decode_model(c, "m1", kind="lstm", hidden=HID, seed=25)
+        h = c.open_session("m1", kind="lstm")
+        src = h.owner
+        dst = next(w.advertise_addr for w in workers
+                   if w.advertise_addr != src)
+        xs = [_x(0, 0), _x(0, 1)]
+        tok = "step-1-token-fixed"
+        step1 = {"db": "m1", "set": h.sid, "sid": h.sid, "x": xs[0],
+                 IDEMPOTENCY_KEY: tok}
+        cs = RemoteClient(src)
+        rep1 = cs._request(MsgType.GENERATE, dict(step1),
+                           codec=CODEC_PICKLE)
+        assert rep1["steps"] == 1
+        # the reply is "lost"; the session moves live to dst
+        c._request(MsgType.SESSION_OPEN,
+                   {"op": "move", "sid": h.sid, "to": dst})
+        # client retry of the SAME logical step lands at the new owner
+        cd = RemoteClient(dst)
+        rep2 = cd._request(MsgType.GENERATE, dict(step1),
+                           codec=CODEC_PICKLE)
+        assert rep2["steps"] == 1, \
+            "retry under one token double-advanced the state"
+        assert np.asarray(rep2["y"]).tobytes() \
+            == np.asarray(rep1["y"]).tobytes()
+        # a FRESH token advances normally from the moved state
+        rep3 = cd._request(MsgType.GENERATE,
+                           {"db": "m1", "set": h.sid, "sid": h.sid,
+                            "x": xs[1],
+                            IDEMPOTENCY_KEY: "step-2-token-fixed"},
+                           codec=CODEC_PICKLE)
+        assert rep3["steps"] == 2
+        want = _solo_outputs(leader.library, "m1", "lstm", xs)
+        assert np.asarray(rep1["y"]).tobytes() == want[0].tobytes()
+        assert np.asarray(rep3["y"]).tobytes() == want[1].tobytes()
+        for cc in (cs, cd):
+            cc.close()
+        h.close()
+        c.close()
+
+
+@pytest.mark.chaos
+def test_promotion_never_rewinds_worker_owned_session(tmp_path):
+    """The stale-resident rewind: a mirror follower replays op=open
+    owning the session itself, installing step-0 init state — but a
+    WORKER-owned session's decode steps are never mirrored; its
+    durability reaches the follower only as mirrored op=spill merges
+    into the arena. After the worker AND leader die, the promoted
+    follower must revive from its arena copy (newest wins), not
+    assemble the consistent-looking step-0 residents and silently
+    rewind."""
+    with _pool(tmp_path, n_workers=1, n_followers=1, arm=True) \
+            as (leader, followers, workers):
+        worker, follower = workers[0], followers[0]
+        c = RemoteClient(leader.advertise_addr,
+                         failover=[follower.advertise_addr],
+                         retry=FAILOVER)
+        deploy_decode_model(c, "m1", kind="lstm", hidden=HID, seed=27)
+        h = c.open_session("m1", kind="lstm")
+        assert h.owner == worker.advertise_addr
+        pre_steps = 3
+        xs = [_x(0, s) for s in range(pre_steps + 3)]
+        got = [np.asarray(h.generate(xs[s], deadline_s=60.0))
+               for s in range(pre_steps)]
+        # force the worker's TTL expiry NOW (the default TTL keeps the
+        # follower's stale step-0 residents alive — the bug's window);
+        # the worker spills, pushes home, and the leader MIRRORS the
+        # merge — wait until the follower holds it
+        worker.library.store.device_cache().session_sweep(
+            now=time.monotonic() + 1e9)
+        assert _wait_for(
+            lambda: follower.sessions.arena.steps(h.sid, "m1")
+            == pre_steps, timeout_s=20.0), \
+            follower.sessions.arena.stats()
+        # the follower still holds its replayed step-0 resident state
+        assert follower.library.store.device_cache() \
+            .session_entries() > 0
+        worker.shutdown()
+        leader.shutdown()
+        assert _wait_for(
+            lambda: follower._ha.role == ha_mod.LEADER, timeout_s=30.0)
+        for s in range(pre_steps, len(xs)):
+            got.append(np.asarray(h.generate(xs[s], deadline_s=60.0)))
+        # the gate: steps CONTINUE from the pushed spill — a rewind
+        # would answer steps 1..3 again
+        assert h.steps == len(xs)
+        assert follower.sessions.table.steps(h.sid) == len(xs)
+        want = _solo_outputs(follower.library, "m1", "lstm", xs)
+        for g, w in zip(got, want):
+            assert g.tobytes() == w.tobytes()
         c.close()
 
 
